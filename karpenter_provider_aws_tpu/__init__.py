@@ -1,0 +1,38 @@
+"""karpenter_provider_aws_tpu — a TPU-native node-provisioning framework.
+
+A from-scratch reimplementation of the capabilities of Karpenter's AWS
+provider (reference: /root/reference, Go), redesigned TPU-first:
+
+- The provisioning scheduler (reference: sequential Go First-Fit-Decreasing,
+  designs/bin-packing.md) and the consolidation search (designs/consolidation.md)
+  are reformulated as a batched pod x instance-type constraint-satisfaction
+  problem solved by a single jit-compiled grouped-FFD kernel on device
+  (`karpenter_provider_aws_tpu.ops.binpack`).
+- The control plane (operator, controllers, cloud lattice providers, caching,
+  batching, fault feedback, metrics) is rebuilt idiomatically around that
+  solver with a fake cloud backend for tests.
+
+Package map (reference analog in parens):
+
+- ``apis``        CRD-equivalent object model: NodePool / NodeClaim / NodeClass,
+                  requirements algebra (pkg/apis).
+- ``lattice``     instance-type catalog, offerings, pricing, allocatable math
+                  (pkg/providers/instancetype, pkg/providers/pricing).
+- ``ops``         device kernels: requirement->mask compiler, grouped-FFD
+                  bin-packing scan, offering finalization (the core scheduler
+                  hot loop, moved on device).
+- ``solver``      host-facing Solve() API: pod dedup/grouping, bucketed
+                  padding, NodePlan decode, FFD oracle referee.
+- ``parallel``    jax.sharding Mesh plumbing, pod-axis sharded solve
+                  (shard_map), cross-device reductions.
+- ``cloud``       CloudProvider boundary + fake cloud backend
+                  (pkg/cloudprovider, pkg/fake).
+- ``controllers`` reconcile loops: provisioning, disruption, interruption,
+                  nodeclass, gc, tagging, pricing (pkg/controllers + core).
+- ``state``       in-memory cluster state mirror (core state.Cluster).
+- ``cache``       TTL caches incl. unavailable-offerings ICE cache (pkg/cache).
+- ``batcher``     request coalescer (pkg/batcher).
+- ``utils``       unit parsing, hashing, misc (pkg/utils).
+"""
+
+__version__ = "0.1.0"

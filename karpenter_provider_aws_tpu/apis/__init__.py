@@ -1,0 +1,27 @@
+from .resources import RESOURCE_AXES, R, resources_to_vec, resources_to_vec_checked, vec_to_resources
+from .requirements import Operator, Requirement, Requirements
+from .objects import (
+    Taint,
+    TaintEffect,
+    Toleration,
+    tolerates_all,
+    TopologySpreadConstraint,
+    PodAffinityTerm,
+    Pod,
+    NodePoolDisruption,
+    DisruptionBudget,
+    NodePool,
+    NodeClassSelectorTerm,
+    NodeClass,
+    NodeClaim,
+    Node,
+)
+
+__all__ = [
+    "RESOURCE_AXES", "R", "resources_to_vec", "resources_to_vec_checked", "vec_to_resources",
+    "Operator", "Requirement", "Requirements",
+    "Taint", "TaintEffect", "Toleration", "tolerates_all",
+    "TopologySpreadConstraint", "PodAffinityTerm", "Pod",
+    "NodePoolDisruption", "DisruptionBudget", "NodePool",
+    "NodeClassSelectorTerm", "NodeClass", "NodeClaim", "Node",
+]

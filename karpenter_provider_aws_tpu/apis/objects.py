@@ -1,0 +1,264 @@
+"""CRD-equivalent object model.
+
+Python dataclass mirrors of the API types the reference defines or consumes:
+- Pod scheduling surface (requests, nodeSelector/affinity, tolerations,
+  topology spread, pod anti-affinity) — core scheduling semantics per
+  reference website concepts/scheduling.md:23-35,312-446.
+- NodePool (core CRD: pkg/apis/crds/karpenter.sh_nodepools.yaml) — template
+  labels/taints/requirements, limits, weight, disruption policy + budgets.
+- NodeClass (EC2NodeClass analog: pkg/apis/v1beta1/ec2nodeclass.go:28-119) —
+  subnet/SG/AMI selector terms, AMI family, userdata, metadata options.
+- NodeClaim (core CRD: karpenter.sh_nodeclaims.yaml) — the launch contract
+  between scheduler and cloud provider, with lifecycle status.
+- Node — the registered machine mirror used by cluster state.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .requirements import Operator, Requirement, Requirements
+from .resources import resources_to_vec
+from . import wellknown
+
+
+# ---------------------------------------------------------------------------
+# Taints / tolerations (k8s semantics, used by scheduling.md:312+ behaviors)
+# ---------------------------------------------------------------------------
+
+class TaintEffect(str, enum.Enum):
+    NO_SCHEDULE = "NoSchedule"
+    PREFER_NO_SCHEDULE = "PreferNoSchedule"
+    NO_EXECUTE = "NoExecute"
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str
+    value: str = ""
+    effect: TaintEffect = TaintEffect.NO_SCHEDULE
+
+
+@dataclass(frozen=True)
+class Toleration:
+    key: str = ""            # empty key + Exists tolerates everything
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: Optional[TaintEffect] = None  # None tolerates all effects
+
+    def tolerates(self, taint: Taint) -> bool:
+        if self.effect is not None and self.effect != taint.effect:
+            return False
+        if self.operator == "Exists":
+            return self.key == "" or self.key == taint.key
+        return self.key == taint.key and self.value == taint.value
+
+
+def tolerates_all(tolerations: Sequence[Toleration], taints: Sequence[Taint]) -> bool:
+    """A pod schedules onto a node iff every NoSchedule/NoExecute taint is tolerated."""
+    for t in taints:
+        if t.effect == TaintEffect.PREFER_NO_SCHEDULE:
+            continue
+        if not any(tol.tolerates(t) for tol in tolerations):
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Pod scheduling surface
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class TopologySpreadConstraint:
+    max_skew: int
+    topology_key: str                      # zone / hostname / capacity-type
+    when_unsatisfiable: str = "DoNotSchedule"  # or ScheduleAnyway
+    # pods counted toward the spread are those matching these labels
+    label_selector: Tuple[Tuple[str, str], ...] = ()
+
+
+@dataclass(frozen=True)
+class PodAffinityTerm:
+    topology_key: str
+    label_selector: Tuple[Tuple[str, str], ...] = ()
+    anti: bool = False
+
+
+@dataclass
+class Pod:
+    name: str
+    namespace: str = "default"
+    labels: Dict[str, str] = field(default_factory=dict)
+    requests: Dict[str, "str | int | float"] = field(default_factory=dict)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    required_affinity: List[Requirement] = field(default_factory=list)  # nodeAffinity required terms
+    tolerations: List[Toleration] = field(default_factory=list)
+    topology_spread: List[TopologySpreadConstraint] = field(default_factory=list)
+    pod_affinity: List[PodAffinityTerm] = field(default_factory=list)
+    node_name: Optional[str] = None        # bound node (None = pending)
+    owner: Optional[str] = None            # controller owner (daemonset detection etc.)
+    is_daemonset: bool = False
+    priority: int = 0
+    deletion_timestamp: Optional[float] = None
+
+    def scheduling_requirements(self) -> Requirements:
+        reqs = Requirements.from_node_selector(self.node_selector)
+        for r in self.required_affinity:
+            reqs.add(r)
+        return reqs
+
+    def request_vec(self) -> np.ndarray:
+        return resources_to_vec(self.requests, implicit_pod=True)
+
+
+# ---------------------------------------------------------------------------
+# NodePool (core CRD)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class DisruptionBudget:
+    """Rate limit on concurrent voluntary disruptions
+    (CRD karpenter.sh_nodepools.yaml:55-100)."""
+    nodes: str = "10%"                      # count or percentage
+    schedule: Optional[str] = None          # cron; None = always active
+    duration: Optional[float] = None        # seconds the schedule window lasts
+    reasons: Tuple[str, ...] = ()           # empty = all reasons
+
+
+@dataclass
+class NodePoolDisruption:
+    consolidation_policy: str = "WhenUnderutilized"  # or WhenEmpty
+    consolidate_after: Optional[float] = None        # seconds; None = Never
+    expire_after: Optional[float] = None             # seconds; None = Never
+    budgets: List[DisruptionBudget] = field(default_factory=lambda: [DisruptionBudget()])
+
+
+@dataclass
+class NodePool:
+    name: str
+    weight: int = 0                                   # higher tried first (nodepools.md:161-163)
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    taints: List[Taint] = field(default_factory=list)
+    startup_taints: List[Taint] = field(default_factory=list)
+    requirements: List[Requirement] = field(default_factory=list)
+    node_class_ref: str = "default"
+    limits: Dict[str, "str | int | float"] = field(default_factory=dict)  # cpu/memory ceilings
+    disruption: NodePoolDisruption = field(default_factory=NodePoolDisruption)
+
+    def scheduling_requirements(self) -> Requirements:
+        reqs = Requirements.from_labels(self.labels)
+        for r in self.requirements:
+            reqs.add(r)
+        reqs.add(Requirement(wellknown.LABEL_NODEPOOL, Operator.IN, (self.name,)))
+        return reqs
+
+    def limits_vec(self) -> Optional[np.ndarray]:
+        if not self.limits:
+            return None
+        return resources_to_vec(self.limits)
+
+
+# ---------------------------------------------------------------------------
+# NodeClass (provider CRD analog)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class NodeClassSelectorTerm:
+    """Tag/id/name selector term (ec2nodeclass.go subnet/SG/AMI selector terms)."""
+    tags: Tuple[Tuple[str, str], ...] = ()
+    id: Optional[str] = None
+    name: Optional[str] = None
+
+
+@dataclass
+class MetadataOptions:
+    http_endpoint: str = "enabled"
+    http_protocol_ipv6: str = "disabled"
+    http_put_response_hop_limit: int = 2
+    http_tokens: str = "required"
+
+
+@dataclass
+class NodeClass:
+    name: str
+    ami_family: str = "AL2023"   # AL2 | AL2023 | Bottlerocket | Ubuntu | Windows | Custom
+    subnet_selector_terms: List[NodeClassSelectorTerm] = field(default_factory=list)
+    security_group_selector_terms: List[NodeClassSelectorTerm] = field(default_factory=list)
+    ami_selector_terms: List[NodeClassSelectorTerm] = field(default_factory=list)
+    user_data: Optional[str] = None
+    role: Optional[str] = None
+    instance_profile: Optional[str] = None
+    tags: Dict[str, str] = field(default_factory=dict)
+    block_device_mappings: List[Dict] = field(default_factory=list)
+    metadata_options: MetadataOptions = field(default_factory=MetadataOptions)
+    detailed_monitoring: bool = False
+    associate_public_ip: Optional[bool] = None
+    # status (hydrated by the nodeclass controller, reference nodeclass/controller.go:150-233)
+    status_subnets: List[Dict] = field(default_factory=list)
+    status_security_groups: List[Dict] = field(default_factory=list)
+    status_amis: List[Dict] = field(default_factory=list)
+    status_instance_profile: Optional[str] = None
+    status_conditions: Dict[str, bool] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# NodeClaim lifecycle (core CRD + state machine)
+# ---------------------------------------------------------------------------
+
+class NodeClaimPhase(str, enum.Enum):
+    PENDING = "Pending"         # created by scheduler, not yet launched
+    LAUNCHED = "Launched"       # cloud capacity created (providerID set)
+    REGISTERED = "Registered"   # node joined the cluster
+    INITIALIZED = "Initialized" # node ready + startup taints cleared
+    TERMINATING = "Terminating"
+    TERMINATED = "Terminated"
+
+
+@dataclass
+class NodeClaim:
+    name: str
+    node_pool: str
+    requirements: List[Requirement] = field(default_factory=list)
+    resource_requests: Dict[str, float] = field(default_factory=dict)
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    taints: List[Taint] = field(default_factory=list)
+    node_class_ref: str = "default"
+    # status
+    phase: NodeClaimPhase = NodeClaimPhase.PENDING
+    provider_id: Optional[str] = None
+    instance_type: Optional[str] = None
+    zone: Optional[str] = None
+    capacity_type: Optional[str] = None
+    image_id: Optional[str] = None
+    capacity: Dict[str, float] = field(default_factory=dict)
+    allocatable: Dict[str, float] = field(default_factory=dict)
+    created_at: float = field(default_factory=time.time)
+    launched_at: Optional[float] = None
+    registered_at: Optional[float] = None
+    initialized_at: Optional[float] = None
+    deletion_timestamp: Optional[float] = None
+
+    def scheduling_requirements(self) -> Requirements:
+        return Requirements(self.requirements)
+
+
+@dataclass
+class Node:
+    name: str
+    provider_id: str
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    taints: List[Taint] = field(default_factory=list)
+    capacity: Dict[str, float] = field(default_factory=dict)
+    allocatable: Dict[str, float] = field(default_factory=dict)
+    ready: bool = False
+    created_at: float = field(default_factory=time.time)
+    node_pool: Optional[str] = None
+    node_claim: Optional[str] = None

@@ -1,0 +1,171 @@
+"""Requirements algebra tests — oracle-level checks mirroring the core
+`Requirements.Compatible` semantics (SURVEY.md §2.2)."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import Operator, Requirement, Requirements
+from karpenter_provider_aws_tpu.apis import wellknown as wk
+
+
+def R(key, op, *values, min_values=None):
+    return Requirement(key, op, tuple(values), min_values=min_values)
+
+
+class TestSatisfiedBy:
+    def test_in(self):
+        reqs = Requirements([R("arch", Operator.IN, "amd64", "arm64")])
+        assert reqs.satisfied_by({"arch": "amd64"})
+        assert not reqs.satisfied_by({"arch": "s390x"})
+        assert not reqs.satisfied_by({})  # In requires presence
+
+    def test_not_in(self):
+        reqs = Requirements([R("zone", Operator.NOT_IN, "us-west-2a")])
+        assert reqs.satisfied_by({"zone": "us-west-2b"})
+        assert not reqs.satisfied_by({"zone": "us-west-2a"})
+        assert reqs.satisfied_by({})  # NotIn passes on absence
+
+    def test_exists_doesnotexist(self):
+        assert Requirements([R("gpu", Operator.EXISTS)]).satisfied_by({"gpu": "t4"})
+        assert not Requirements([R("gpu", Operator.EXISTS)]).satisfied_by({})
+        assert Requirements([R("gpu", Operator.DOES_NOT_EXIST)]).satisfied_by({})
+        assert not Requirements([R("gpu", Operator.DOES_NOT_EXIST)]).satisfied_by({"gpu": "t4"})
+
+    def test_gt_lt(self):
+        reqs = Requirements([R("cpu", Operator.GT, "4"), R("cpu", Operator.LT, "64")])
+        assert reqs.satisfied_by({"cpu": "8"})
+        assert not reqs.satisfied_by({"cpu": "4"})   # strict
+        assert not reqs.satisfied_by({"cpu": "64"})  # strict
+        assert not reqs.satisfied_by({})
+
+    def test_same_key_intersection(self):
+        reqs = Requirements([
+            R("size", Operator.IN, "large", "xlarge", "2xlarge"),
+            R("size", Operator.NOT_IN, "xlarge"),
+        ])
+        assert reqs.satisfied_by({"size": "large"})
+        assert not reqs.satisfied_by({"size": "xlarge"})
+
+
+class TestIntersects:
+    def test_disjoint_in_sets(self):
+        a = Requirements([R("arch", Operator.IN, "amd64")])
+        b = Requirements([R("arch", Operator.IN, "arm64")])
+        assert not a.intersects(b)
+
+    def test_overlapping_in_sets(self):
+        a = Requirements([R("arch", Operator.IN, "amd64", "arm64")])
+        b = Requirements([R("arch", Operator.IN, "arm64")])
+        assert a.intersects(b)
+
+    def test_unconstrained_well_known_key_is_wildcard(self):
+        a = Requirements([R(wk.LABEL_ARCH, Operator.IN, "amd64")])
+        b = Requirements([R(wk.LABEL_ZONE, Operator.IN, "us-west-2a")])
+        assert a.intersects(b)
+
+    def test_in_vs_notin(self):
+        a = Requirements([R("type", Operator.IN, "m5.large")])
+        b = Requirements([R("type", Operator.NOT_IN, "m5.large")])
+        assert not a.intersects(b)
+        c = Requirements([R("type", Operator.NOT_IN, "c5.large")])
+        assert a.intersects(c)
+
+    def test_exists_vs_doesnotexist(self):
+        a = Requirements([R("gpu", Operator.EXISTS)])
+        b = Requirements([R("gpu", Operator.DOES_NOT_EXIST)])
+        assert not a.intersects(b)
+
+    def test_doesnotexist_vs_notin(self):
+        # absence satisfies both
+        a = Requirements([R("gpu", Operator.DOES_NOT_EXIST)])
+        b = Requirements([R("gpu", Operator.NOT_IN, "t4")])
+        assert a.intersects(b)
+
+    def test_gt_lt_interval_overlap(self):
+        a = Requirements([R("cpu", Operator.GT, "4")])
+        b = Requirements([R("cpu", Operator.LT, "8")])
+        assert a.intersects(b)
+        # integers strictly between 4 and 5: none
+        c = Requirements([R("cpu", Operator.GT, "4"), R("cpu", Operator.LT, "5")])
+        d = Requirements([R("cpu", Operator.EXISTS)])
+        assert not c.intersects(d)
+
+    def test_in_vs_interval(self):
+        a = Requirements([R("cpu", Operator.IN, "2", "4")])
+        b = Requirements([R("cpu", Operator.GT, "3")])
+        assert a.intersects(b)
+        c = Requirements([R("cpu", Operator.GT, "8")])
+        assert not a.intersects(c)
+
+
+class TestMinValues:
+    def test_min_values(self):
+        reqs = Requirements([
+            R("family", Operator.IN, "c5", "m5", "r5", min_values=2),
+        ])
+        assert reqs.min_values_satisfied({"family": ["c5", "m5", "c6i"]})
+        assert not reqs.min_values_satisfied({"family": ["c5"]})
+        assert not reqs.min_values_satisfied({})
+
+
+class TestValidation:
+    def test_gt_requires_single_numeric(self):
+        with pytest.raises(ValueError):
+            Requirement("cpu", Operator.GT, ("a",))
+        with pytest.raises(ValueError):
+            Requirement("cpu", Operator.GT, ("1", "2"))
+
+    def test_exists_no_values(self):
+        with pytest.raises(ValueError):
+            Requirement("k", Operator.EXISTS, ("v",))
+
+    def test_empty_in(self):
+        with pytest.raises(ValueError):
+            Requirement("k", Operator.IN, ())
+
+
+def test_nodepool_requirements_include_pool_label():
+    from karpenter_provider_aws_tpu.apis import NodePool
+    np_ = NodePool(name="default", requirements=[R(wk.LABEL_ARCH, Operator.IN, "amd64")])
+    reqs = np_.scheduling_requirements()
+    assert reqs.satisfied_by({wk.LABEL_NODEPOOL: "default", wk.LABEL_ARCH: "amd64"})
+    assert not reqs.satisfied_by({wk.LABEL_NODEPOOL: "other", wk.LABEL_ARCH: "amd64"})
+
+
+def test_tolerations():
+    from karpenter_provider_aws_tpu.apis.objects import Taint, TaintEffect, Toleration, tolerates_all
+    taints = [Taint("dedicated", "gpu", TaintEffect.NO_SCHEDULE)]
+    assert not tolerates_all([], taints)
+    assert tolerates_all([Toleration("dedicated", "Equal", "gpu")], taints)
+    assert tolerates_all([Toleration("dedicated", "Exists")], taints)
+    assert tolerates_all([Toleration(operator="Exists")], taints)  # tolerate-everything
+    assert not tolerates_all([Toleration("dedicated", "Equal", "ml")], taints)
+    # PreferNoSchedule is soft — never blocks
+    soft = [Taint("x", "y", TaintEffect.PREFER_NO_SCHEDULE)]
+    assert tolerates_all([], soft)
+
+
+class TestUndefinedKeySemantics:
+    """Reference cloudprovider.go:248: Compatible(..., AllowUndefinedWellKnownLabels)."""
+
+    def test_custom_key_undefined_on_other_side_incompatible(self):
+        pod = Requirements([R("example.com/team", Operator.IN, "ml")])
+        claim = Requirements([R(wk.LABEL_ARCH, Operator.IN, "amd64")])
+        assert not pod.intersects(claim)
+        assert not claim.intersects(pod)
+
+    def test_well_known_key_undefined_on_other_side_ok(self):
+        pod = Requirements([R(wk.LABEL_INSTANCE_CPU, Operator.GT, "4")])
+        claim = Requirements([R(wk.LABEL_ARCH, Operator.IN, "amd64")])
+        assert pod.intersects(claim)
+
+    def test_absence_tolerant_custom_key_ok(self):
+        pod = Requirements([R("example.com/team", Operator.NOT_IN, "infra")])
+        claim = Requirements([R(wk.LABEL_ARCH, Operator.IN, "amd64")])
+        assert pod.intersects(claim)
+
+
+def test_resources_to_vec_checked_unknown():
+    from karpenter_provider_aws_tpu.apis import resources_to_vec_checked
+    vec, unknown = resources_to_vec_checked({"cpu": "1", "hugepages-2Mi": "1Gi"}, implicit_pod=True)
+    assert unknown == ("hugepages-2Mi",)
+    assert vec[0] == 1000.0

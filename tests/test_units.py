@@ -1,0 +1,51 @@
+import pytest
+
+from karpenter_provider_aws_tpu.utils.units import (
+    format_quantity,
+    parse_cpu_millis,
+    parse_mem_mib,
+    parse_quantity,
+)
+
+
+def test_plain_numbers():
+    assert parse_quantity("5") == 5
+    assert parse_quantity(3) == 3
+    assert parse_quantity("2.5") == 2.5
+
+
+def test_binary_suffixes():
+    assert parse_quantity("1Ki") == 1024
+    assert parse_quantity("1Mi") == 2**20
+    assert parse_quantity("16Gi") == 16 * 2**30
+
+
+def test_decimal_suffixes():
+    assert parse_quantity("1k") == 1000
+    assert parse_quantity("100m") == pytest.approx(0.1)
+    assert parse_quantity("1G") == 1e9
+
+
+def test_cpu_millis():
+    assert parse_cpu_millis("1") == 1000
+    assert parse_cpu_millis("100m") == pytest.approx(100)
+    assert parse_cpu_millis("2.5") == 2500
+
+
+def test_mem_mib():
+    assert parse_mem_mib("1Gi") == 1024
+    assert parse_mem_mib("512Mi") == 512
+    assert parse_mem_mib(2**20) == 1
+
+
+def test_invalid():
+    with pytest.raises(ValueError):
+        parse_quantity("abc")
+    with pytest.raises(ValueError):
+        parse_quantity("1Qi")
+
+
+def test_format_roundtrip_binary():
+    assert format_quantity(2**30) == "1Gi"
+    assert format_quantity(512 * 2**20) == "512Mi"
+    assert format_quantity(5) == "5"

@@ -49,7 +49,14 @@ class Requirement:
         if op in (Operator.GT, Operator.LT):
             if len(self.values) != 1:
                 raise ValueError(f"{op.value} takes exactly one value (key={self.key})")
-            float(self.values[0])  # must be numeric
+            # k8s NodeSelectorRequirement Gt/Lt compare integers (the
+            # reference inherits this); Constraint.is_empty relies on it
+            try:
+                int(self.values[0])
+            except ValueError:
+                raise ValueError(
+                    f"{op.value} requires an integer value (key={self.key}, got {self.values[0]!r})"
+                ) from None
         if op == Operator.IN and not self.values:
             raise ValueError(f"In with empty values matches nothing (key={self.key})")
 

@@ -1,0 +1,180 @@
+"""Catalog → device tensors (the solver's constraint lattice).
+
+The reference builds `[]cloudprovider.InstanceType` — per-type requirement
+labels + capacity + overhead + offerings (reference
+pkg/providers/instancetype/types.go:56-66,74-155). Here the same information
+becomes the dense tensors the device solver consumes:
+
+- ``alloc [T,R]``           allocatable vector per type (capacity - overhead)
+- ``capacity [T,R]``        raw capacity
+- ``price [T,Z,C]``         offering price (+inf where unavailable)
+- ``available [T,Z,C]``     offering availability
+- ``cat_ids [K_cat,T]``     categorical label value ids (vocab per key)
+- ``num_vals [K_num,T]``    numeric label values (NaN = undefined)
+
+plus host-side mirrors (label dicts per type) for the oracle and for
+requirement evaluation outside jit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..apis import wellknown as wk
+from ..apis.resources import RESOURCE_AXES, R, axis
+from . import catalog as cat
+from .overhead import KubeletConfiguration, allocatable, max_pods, vm_usable_memory_mib
+
+
+def type_labels(spec: cat.InstanceTypeSpec) -> Dict[str, str]:
+    """The ~20 requirement labels one instance type carries
+    (types.go:74-155 computeRequirements)."""
+    labels = {
+        wk.LABEL_INSTANCE_TYPE: spec.name,
+        wk.LABEL_ARCH: spec.arch,
+        wk.LABEL_OS: "linux",
+        wk.LABEL_REGION: cat.REGION,
+        wk.LABEL_INSTANCE_CATEGORY: spec.category,
+        wk.LABEL_INSTANCE_FAMILY: spec.family,
+        wk.LABEL_INSTANCE_GENERATION: str(spec.generation),
+        wk.LABEL_INSTANCE_SIZE: spec.size,
+        wk.LABEL_INSTANCE_CPU: str(spec.vcpus),
+        wk.LABEL_INSTANCE_CPU_MANUFACTURER: spec.cpu_manufacturer,
+        wk.LABEL_INSTANCE_MEMORY: str(spec.memory_mib),
+        wk.LABEL_INSTANCE_NETWORK_BANDWIDTH: str(spec.network_bandwidth_mbps),
+        wk.LABEL_INSTANCE_ENCRYPTION_IN_TRANSIT: "true" if spec.generation >= 5 else "false",
+    }
+    if spec.hypervisor:
+        labels[wk.LABEL_INSTANCE_HYPERVISOR] = spec.hypervisor
+    if spec.local_nvme_gb:
+        labels[wk.LABEL_INSTANCE_LOCAL_NVME] = str(spec.local_nvme_gb)
+    if spec.gpu_count:
+        labels[wk.LABEL_INSTANCE_GPU_NAME] = spec.gpu_name
+        labels[wk.LABEL_INSTANCE_GPU_MANUFACTURER] = spec.gpu_manufacturer
+        labels[wk.LABEL_INSTANCE_GPU_COUNT] = str(spec.gpu_count)
+        labels[wk.LABEL_INSTANCE_GPU_MEMORY] = str(spec.gpu_memory_mib)
+    if spec.accelerator_count:
+        labels[wk.LABEL_INSTANCE_ACCELERATOR_NAME] = spec.accelerator_name
+        labels[wk.LABEL_INSTANCE_ACCELERATOR_MANUFACTURER] = spec.accelerator_manufacturer
+        labels[wk.LABEL_INSTANCE_ACCELERATOR_COUNT] = str(spec.accelerator_count)
+    return labels
+
+
+def capacity_vec(spec: cat.InstanceTypeSpec, kc: Optional[KubeletConfiguration] = None,
+                 vm_memory_overhead_percent: float = 0.075, reserved_enis: int = 0) -> Tuple[np.ndarray, int]:
+    """Capacity vector + pod density (types.go:176-208 computeCapacity)."""
+    vec = np.zeros((R,), dtype=np.float32)
+    pods = max_pods(spec.enis, spec.ipv4_per_eni, spec.vcpus, kc, reserved_enis=reserved_enis)
+    vec[axis("cpu")] = spec.vcpus * 1000.0
+    vec[axis("memory")] = vm_usable_memory_mib(spec.memory_mib, spec.arch, vm_memory_overhead_percent)
+    vec[axis("pods")] = pods
+    # default EBS root volume 20Gi unless local NVMe raid (simplified
+    # instance-store policy; reference ephemeralStorage())
+    vec[axis("ephemeral-storage")] = spec.local_nvme_gb * 1000.0 / 1.048576 if spec.local_nvme_gb else 20 * 1024.0
+    vec[axis("nvidia.com/gpu")] = spec.gpu_count
+    vec[axis("aws.amazon.com/neuron")] = spec.accelerator_count if spec.accelerator_name in ("inferentia", "inferentia2", "trainium") else 0
+    vec[axis("vpc.amazonaws.com/efa")] = spec.efa_count
+    vec[axis("vpc.amazonaws.com/pod-eni")] = spec.pod_eni_count
+    return vec, pods
+
+
+@dataclass
+class Lattice:
+    """The full constraint lattice, device-ready."""
+
+    specs: List[cat.InstanceTypeSpec]
+    names: List[str]
+    labels: List[Dict[str, str]]           # host-side label dicts per type
+    zones: Tuple[str, ...]
+    capacity_types: Tuple[str, ...]
+    capacity: np.ndarray                   # [T,R] float32
+    alloc: np.ndarray                      # [T,R] float32
+    price: np.ndarray                      # [T,Z,C] float32, +inf unavailable
+    available: np.ndarray                  # [T,Z,C] bool
+    cat_vocab: Dict[str, Dict[str, int]]   # key -> value -> id (id 0 = undefined)
+    cat_ids: np.ndarray                    # [K_cat,T] int32
+    num_vals: np.ndarray                   # [K_num,T] float32, NaN undefined
+    name_to_idx: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def T(self) -> int:
+        return len(self.names)
+
+    @property
+    def Z(self) -> int:
+        return len(self.zones)
+
+    @property
+    def C(self) -> int:
+        return len(self.capacity_types)
+
+    def key_values_present(self) -> Dict[str, List[str]]:
+        """key -> distinct values across the lattice (for minValues checks)."""
+        out: Dict[str, set] = {}
+        for lab in self.labels:
+            for k, v in lab.items():
+                out.setdefault(k, set()).add(v)
+        return {k: sorted(v) for k, v in out.items()}
+
+
+def build_lattice(specs: Optional[Sequence[cat.InstanceTypeSpec]] = None,
+                  kc: Optional[KubeletConfiguration] = None,
+                  zones: Sequence[str] = cat.ZONES,
+                  capacity_types: Sequence[str] = cat.CAPACITY_TYPES,
+                  vm_memory_overhead_percent: float = 0.075,
+                  reserved_enis: int = 0) -> Lattice:
+    specs = list(specs) if specs is not None else cat.build_catalog()
+    T, Z, C = len(specs), len(zones), len(capacity_types)
+
+    capacity = np.zeros((T, R), dtype=np.float32)
+    alloc = np.zeros((T, R), dtype=np.float32)
+    labels = []
+    for i, s in enumerate(specs):
+        vec, pods = capacity_vec(s, kc, vm_memory_overhead_percent, reserved_enis)
+        capacity[i] = vec
+        alloc[i] = allocatable(vec, s.vcpus * 1000.0, pods,
+                               vec[axis("memory")], vec[axis("ephemeral-storage")], kc)
+        labels.append(type_labels(s))
+
+    price = np.full((T, Z, C), np.inf, dtype=np.float32)
+    available = np.zeros((T, Z, C), dtype=bool)
+    for i, s in enumerate(specs):
+        for zi, zone in enumerate(zones):
+            for ci, ct in enumerate(capacity_types):
+                if not cat.offering_available(s, zone, ct):
+                    continue
+                available[i, zi, ci] = True
+                price[i, zi, ci] = s.od_price if ct == "on-demand" else cat.spot_price(s, zone)
+
+    # categorical vocab: id 0 reserved for "undefined on this type"
+    cat_keys = wk.DEVICE_CATEGORICAL_KEYS
+    cat_vocab: Dict[str, Dict[str, int]] = {k: {} for k in cat_keys}
+    cat_ids = np.zeros((len(cat_keys), T), dtype=np.int32)
+    for ki, key in enumerate(cat_keys):
+        vocab = cat_vocab[key]
+        for i, lab in enumerate(labels):
+            v = lab.get(key)
+            if v is None:
+                continue
+            if v not in vocab:
+                vocab[v] = len(vocab) + 1
+            cat_ids[ki, i] = vocab[v]
+
+    num_keys = wk.DEVICE_NUMERIC_KEYS
+    num_vals = np.full((len(num_keys), T), np.nan, dtype=np.float32)
+    for ki, key in enumerate(num_keys):
+        for i, lab in enumerate(labels):
+            v = lab.get(key)
+            if v is not None:
+                num_vals[ki, i] = float(v)
+
+    return Lattice(
+        specs=specs, names=[s.name for s in specs], labels=labels,
+        zones=tuple(zones), capacity_types=tuple(capacity_types),
+        capacity=capacity, alloc=alloc, price=price, available=available,
+        cat_vocab=cat_vocab, cat_ids=cat_ids, num_vals=num_vals,
+        name_to_idx={s.name: i for i, s in enumerate(specs)},
+    )

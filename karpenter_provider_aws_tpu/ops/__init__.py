@@ -1,0 +1,3 @@
+from .masks import compile_masks, CompiledMasks
+
+__all__ = ["compile_masks", "CompiledMasks"]

@@ -1,0 +1,192 @@
+"""Lattice tests: catalog shape, overhead math oracle checks, mask compiler."""
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.apis import Operator, Requirement, Requirements
+from karpenter_provider_aws_tpu.apis import wellknown as wk
+from karpenter_provider_aws_tpu.apis.resources import axis
+from karpenter_provider_aws_tpu.lattice import (
+    build_catalog,
+    build_lattice,
+    eni_limited_pods,
+    KubeletConfiguration,
+)
+from karpenter_provider_aws_tpu.lattice.overhead import (
+    _stepwise_cpu_reserved_millis,
+    kube_reserved,
+    eviction_threshold,
+    vm_usable_memory_mib,
+)
+from karpenter_provider_aws_tpu.ops import compile_masks
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    return build_lattice()
+
+
+class TestCatalog:
+    def test_catalog_scale(self):
+        catalog = build_catalog()
+        # the reference works against a ~700+-type EC2 catalog
+        assert len(catalog) >= 700
+        assert len({t.name for t in catalog}) == len(catalog)
+
+    def test_families_present(self):
+        names = {t.name for t in build_catalog()}
+        for expected in ("m5.large", "c6g.2xlarge", "r6i.metal", "t3.medium",
+                         "p4d.24xlarge", "g5.xlarge", "inf1.6xlarge", "trn1.32xlarge"):
+            assert expected in names, expected
+
+    def test_deterministic(self):
+        a, b = build_catalog(), build_catalog()
+        assert [(t.name, t.od_price) for t in a] == [(t.name, t.od_price) for t in b]
+
+
+class TestOverheadMath:
+    """Values checked against the reference formulas (types.go:319-431)."""
+
+    def test_eni_limited_pods_m5_large(self):
+        # m5.large: 3 ENIs x 10 IPs -> 3*(10-1)+2 = 29 (the canonical value)
+        assert eni_limited_pods(3, 10) == 29
+
+    def test_eni_limited_pods_m5_4xlarge(self):
+        # 8 ENIs x 30 IPs -> 8*29+2 = 234
+        assert eni_limited_pods(8, 30) == 234
+
+    def test_reserved_enis(self):
+        assert eni_limited_pods(3, 10, reserved_enis=1) == 2 * 9 + 2
+        assert eni_limited_pods(3, 10, reserved_enis=3) == 0
+
+    def test_stepwise_cpu(self):
+        # 2 vCPU (2000m): 6% of 1000 + 1% of 1000 = 70m
+        assert _stepwise_cpu_reserved_millis(2000) == 70
+        # 4 vCPU: 60 + 10 + 0.5% of 2000 = 80m
+        assert _stepwise_cpu_reserved_millis(4000) == 80
+        # 96 vCPU: 60+10+10 + 0.25% of 92000 = 310m
+        assert _stepwise_cpu_reserved_millis(96000) == 310
+
+    def test_kube_reserved_memory(self):
+        vec = kube_reserved(2000, 29)
+        assert vec[axis("memory")] == 11 * 29 + 255
+        assert vec[axis("ephemeral-storage")] == 1024
+
+    def test_kube_reserved_override(self):
+        kc = KubeletConfiguration(kube_reserved={"cpu": "100m", "memory": "1Gi"})
+        vec = kube_reserved(2000, 29, kc)
+        assert vec[axis("cpu")] == 100
+        assert vec[axis("memory")] == 1024
+
+    def test_eviction_threshold_default(self):
+        vec = eviction_threshold(8192, 20 * 1024)
+        assert vec[axis("memory")] == 100
+        assert vec[axis("ephemeral-storage")] == 2048  # 10% of 20Gi
+
+    def test_eviction_signal_percentage(self):
+        kc = KubeletConfiguration(eviction_hard={"memory.available": "5%"})
+        vec = eviction_threshold(8000, 20 * 1024, kc)
+        assert vec[axis("memory")] == pytest.approx(400)
+
+    def test_vm_memory_overhead(self):
+        # 8GiB amd64: 8192 - ceil(8192*0.075) = 8192 - 615 = 7577
+        assert vm_usable_memory_mib(8192, "amd64") == 7577
+        # arm64 loses 64MiB CMA first
+        assert vm_usable_memory_mib(8192, "arm64") == 8128 - int(np.ceil(8128 * 0.075))
+
+
+class TestLatticeTensors:
+    def test_shapes(self, lattice):
+        T, Z, C = lattice.T, lattice.Z, lattice.C
+        assert T >= 700 and Z == 4 and C == 2
+        assert lattice.alloc.shape == (T, 8)
+        assert lattice.price.shape == (T, Z, C)
+        assert lattice.available.shape == (T, Z, C)
+
+    def test_alloc_less_than_capacity(self, lattice):
+        cpu_ax, mem_ax = axis("cpu"), axis("memory")
+        assert (lattice.alloc[:, cpu_ax] < lattice.capacity[:, cpu_ax]).all()
+        assert (lattice.alloc[:, mem_ax] < lattice.capacity[:, mem_ax]).all()
+        assert (lattice.alloc >= 0).all()
+
+    def test_price_inf_iff_unavailable(self, lattice):
+        assert np.isinf(lattice.price[~lattice.available]).all()
+        assert np.isfinite(lattice.price[lattice.available]).all()
+
+    def test_spot_cheaper_than_od(self, lattice):
+        od = lattice.price[:, :, 0]
+        spot = lattice.price[:, :, 1]
+        both = lattice.available[:, :, 0] & lattice.available[:, :, 1]
+        assert (spot[both] < od[both]).all()
+
+    def test_gpu_capacity(self, lattice):
+        i = lattice.name_to_idx["p4d.24xlarge"]
+        assert lattice.capacity[i, axis("nvidia.com/gpu")] == 8
+        assert lattice.labels[i][wk.LABEL_INSTANCE_GPU_NAME] == "a100"
+
+
+class TestMaskCompiler:
+    def _names(self, lattice, mask):
+        return {lattice.names[i] for i in np.nonzero(mask)[0]}
+
+    def test_instance_family_in(self, lattice):
+        reqs = Requirements([Requirement(wk.LABEL_INSTANCE_FAMILY, Operator.IN, ("m5", "c5"))])
+        m = compile_masks(reqs, lattice)
+        names = self._names(lattice, m.type_mask)
+        assert names and all(n.startswith(("m5.", "c5.")) for n in names)
+
+    def test_numeric_gt(self, lattice):
+        reqs = Requirements([Requirement(wk.LABEL_INSTANCE_CPU, Operator.GT, ("64",))])
+        m = compile_masks(reqs, lattice)
+        for i in np.nonzero(m.type_mask)[0]:
+            assert lattice.specs[i].vcpus > 64
+
+    def test_gpu_exists(self, lattice):
+        reqs = Requirements([Requirement(wk.LABEL_INSTANCE_GPU_NAME, Operator.EXISTS)])
+        m = compile_masks(reqs, lattice)
+        assert all(lattice.specs[i].gpu_count > 0 for i in np.nonzero(m.type_mask)[0])
+        assert m.type_mask.sum() > 0
+
+    def test_zone_and_capacity_axes(self, lattice):
+        reqs = Requirements([
+            Requirement(wk.LABEL_ZONE, Operator.IN, ("us-west-2a",)),
+            Requirement(wk.LABEL_CAPACITY_TYPE, Operator.IN, ("spot",)),
+        ])
+        m = compile_masks(reqs, lattice)
+        assert list(m.zone_mask) == [True, False, False, False]
+        assert list(m.cap_mask) == [False, True]
+
+    def test_extra_labels(self, lattice):
+        reqs = Requirements([Requirement("example.com/team", Operator.IN, ("ml",))])
+        assert not compile_masks(reqs, lattice).type_mask.any()
+        assert compile_masks(reqs, lattice, extra_labels={"example.com/team": "ml"}).type_mask.all()
+        assert not compile_masks(reqs, lattice, extra_labels={"example.com/team": "web"}).type_mask.any()
+
+    def test_oracle_cross_check(self, lattice):
+        """Mask compiler must agree with host-side satisfied_by on every type."""
+        reqs = Requirements([
+            Requirement(wk.LABEL_INSTANCE_CATEGORY, Operator.IN, ("c", "m")),
+            Requirement(wk.LABEL_ARCH, Operator.IN, ("arm64",)),
+            Requirement(wk.LABEL_INSTANCE_CPU, Operator.LT, ("33",)),
+            Requirement(wk.LABEL_INSTANCE_SIZE, Operator.NOT_IN, ("metal",)),
+        ])
+        m = compile_masks(reqs, lattice)
+        for i, lab in enumerate(lattice.labels):
+            assert m.type_mask[i] == reqs.satisfied_by(lab), lattice.names[i]
+
+
+class TestReviewRegressions:
+    def test_extra_labels_cannot_shadow_lattice_keys(self, lattice):
+        reqs = Requirements([Requirement(wk.LABEL_ARCH, Operator.IN, ("arm64",))])
+        m = compile_masks(reqs, lattice, extra_labels={wk.LABEL_ARCH: "arm64"})
+        for i in np.nonzero(m.type_mask)[0]:
+            assert lattice.specs[i].arch == "arm64"
+
+    def test_kube_reserved_explicit_zero(self):
+        kc = KubeletConfiguration(kube_reserved={"memory": "0"})
+        vec = kube_reserved(2000, 29, kc)
+        assert vec[axis("memory")] == 0
+
+    def test_gt_requires_integer(self):
+        with pytest.raises(ValueError):
+            Requirement("cpu", Operator.GT, ("4.2",))

@@ -1,0 +1,97 @@
+"""Benchmark: BASELINE config 5 — 50k pending pods x full catalog.
+
+Generates a realistic 50k-pod pending set (30+ distinct shapes: generic
+cpu/mem mixes, selector-constrained, GPU and Neuron extended resources,
+on-demand-pinned), builds the full 707-type lattice, and measures the
+device Solve() latency (group tensorization excluded, matching the
+reference's own split between watch/cache machinery and its scheduling
+pass).
+
+Prints ONE JSON line: p50 device solve latency in ms vs the 200 ms
+north-star target (vs_baseline > 1.0 means faster than target).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def build_bench_problem():
+    from karpenter_provider_aws_tpu.apis import NodePool, Operator, Pod, Requirement
+    from karpenter_provider_aws_tpu.apis import wellknown as wk
+    from karpenter_provider_aws_tpu.lattice import build_lattice
+    from karpenter_provider_aws_tpu.solver import build_problem
+
+    lattice = build_lattice()
+    rng = np.random.default_rng(0)
+    pods = []
+    # 30 generic deployment shapes (the bulk of a 50k pending wave)
+    shapes = []
+    for s in range(30):
+        cpu = int(rng.choice([100, 250, 500, 1000, 2000, 4000]))
+        mem = int(rng.choice([256, 512, 1024, 2048, 4096, 8192]))
+        sel = {}
+        r = rng.random()
+        if r < 0.2:
+            sel[wk.LABEL_INSTANCE_CATEGORY] = str(rng.choice(["m", "c", "r"]))
+        elif r < 0.3:
+            sel[wk.LABEL_CAPACITY_TYPE] = "on-demand"
+        elif r < 0.35:
+            sel[wk.LABEL_ARCH] = "arm64"
+        shapes.append(({"cpu": f"{cpu}m", "memory": f"{mem}Mi"}, sel))
+    counts = rng.multinomial(48600, np.ones(30) / 30)
+    for s, ((req, sel), n) in enumerate(zip(shapes, counts)):
+        pods += [Pod(name=f"s{s}-{i}", requests=req, node_selector=sel) for i in range(n)]
+    # GPU + Neuron tails (extended resources, config 5)
+    pods += [Pod(name=f"gpu-{i}", requests={"cpu": "4", "memory": "16Gi", "nvidia.com/gpu": 1})
+             for i in range(1000)]
+    pods += [Pod(name=f"neuron-{i}", requests={"cpu": "4", "memory": "8Gi",
+                                               "aws.amazon.com/neuron": 1})
+             for i in range(400)]
+    pools = [
+        NodePool(name="default"),
+        NodePool(name="arm", weight=10, requirements=[
+            Requirement(wk.LABEL_ARCH, Operator.IN, ("arm64",))]),
+        NodePool(name="gpu", weight=20, requirements=[
+            Requirement(wk.LABEL_INSTANCE_GPU_COUNT, Operator.GT, ("0",))]),
+    ]
+    problem = build_problem(pods, pools, lattice)
+    return lattice, problem, len(pods)
+
+
+def main():
+    from karpenter_provider_aws_tpu.solver import Solver
+
+    lattice, problem, n_pods = build_bench_problem()
+    solver = Solver(lattice)
+
+    plan = solver.solve(problem)  # warmup: compile + bucket settle
+    scheduled = sum(len(n.pods) for n in plan.new_nodes) + \
+        sum(len(v) for v in plan.existing_assignments.values())
+    assert scheduled + len(plan.unschedulable) == n_pods
+
+    lat_ms = []
+    for _ in range(10):
+        p = solver.solve(problem)
+        lat_ms.append(p.device_seconds * 1000.0)
+    p50 = float(np.percentile(lat_ms, 50))
+    target_ms = 200.0
+    print(json.dumps({
+        "metric": "solve_p50_latency_50k_pods_x_707_types",
+        "value": round(p50, 3),
+        "unit": "ms",
+        "vs_baseline": round(target_ms / p50, 3),
+        "detail": {
+            "pods": n_pods,
+            "groups": problem.G,
+            "new_nodes": plan.num_new_nodes,
+            "unschedulable": len(plan.unschedulable),
+            "pods_per_sec": round(n_pods / (p50 / 1000.0), 1),
+            "plan_cost_per_hour": round(plan.new_node_cost, 2),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

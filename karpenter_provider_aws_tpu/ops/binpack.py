@@ -1,0 +1,245 @@
+"""Grouped-FFD bin-packing scan — the device scheduler kernel.
+
+The reference packs pods one at a time in a sequential Go loop (core
+provisioner, designs/bin-packing.md:16-43): O(pods x nodes x types) scalar
+work per scheduling pass. This kernel reformulates that loop TPU-first:
+
+- Pods are pre-deduplicated into G groups (solver/problem.py), so the scan
+  is over **groups**, not pods — 50k pods collapse to a few dozen steps.
+- Each scan step is pure dense vector math over [bins x types (x resources)]
+  blocks: per-bin per-type fit counts via broadcasted floor-division,
+  offering availability via an einsum that XLA lowers onto the MXU,
+  first-fit assignment of the *whole group* via an exclusive cumsum over the
+  bin axis, and new-node opening via iota arithmetic — no data-dependent
+  control flow, fully static shapes, jit-compiled once per bucket shape.
+- A group may split across many bins in one step (exactly what per-pod FFD
+  would do for identical pods), so the scan length is G, not P.
+- Every bin keeps the full **set** of instance types that can still hold its
+  contents (a boolean row over the type axis) instead of committing early;
+  finalization picks the cheapest available (type, zone, capacity-type)
+  offering per bin — the same "launch the cheapest compatible shape"
+  decision the reference delegates to CreateFleet's lowest-price strategy
+  (pkg/providers/instance/instance.go:356-372).
+
+Numerical contract: resources are float32 in canonical units (millicores /
+MiB / counts); counts are int32. ``EPS`` absorbs float32 rounding in
+capacity comparisons.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+EPS = 1e-3
+
+
+class BinState(NamedTuple):
+    """Scan carry: the open-bin table."""
+
+    cum: jnp.ndarray        # [B,R] f32 committed resources (incl. daemonset overhead)
+    tmask: jnp.ndarray      # [B,T] bool instance types that can still hold this bin
+    zmask: jnp.ndarray      # [B,Z] bool zones still possible
+    cmask: jnp.ndarray      # [B,C] bool capacity types still possible
+    np_id: jnp.ndarray      # [B] i32 owning nodepool (-1 = unassigned)
+    npods: jnp.ndarray      # [B] i32 pods placed
+    open: jnp.ndarray       # [B] bool
+    fixed: jnp.ndarray      # [B] bool existing capacity (type pinned, not re-priced)
+    alloc_cap: jnp.ndarray  # [B,R] f32 per-bin allocatable ceiling (+inf for new
+                            # bins; a real node's reported allocatable for fixed
+                            # bins, which may differ from the lattice's)
+    next_open: jnp.ndarray  # scalar i32 first unopened bin slot
+
+
+class GroupBatch(NamedTuple):
+    """Scan xs: one row per (FFD-sorted) pod group."""
+
+    req: jnp.ndarray      # [G,R] f32
+    count: jnp.ndarray    # [G] i32 (0 = padding row)
+    g_type: jnp.ndarray   # [G,T] bool
+    g_zone: jnp.ndarray   # [G,Z] bool
+    g_cap: jnp.ndarray    # [G,C] bool
+    g_np: jnp.ndarray        # [G,NP] bool
+    antiaff: jnp.ndarray     # [G] bool  (hostname self-anti-affinity: <=1 pod/bin)
+    strict_custom: jnp.ndarray  # [G] bool: group has existence-requiring custom-key
+                                # constraints -> excluded from unknown-pool bins
+
+
+class PoolParams(NamedTuple):
+    np_type: jnp.ndarray  # [NP,T] bool
+    np_zone: jnp.ndarray  # [NP,Z] bool
+    np_cap: jnp.ndarray   # [NP,C] bool
+    ds: jnp.ndarray       # [NP,R] f32 daemonset overhead for a new node
+
+
+class PackResult(NamedTuple):
+    assign: jnp.ndarray     # [G,B] i32 pods of group g placed into bin b
+    leftover: jnp.ndarray   # [G] i32 pods that fit nowhere (bucket overflow / infeasible)
+    state: BinState
+    chosen_t: jnp.ndarray   # [B] i32 instance-type index (finalized, new bins only)
+    chosen_z: jnp.ndarray   # [B] i32 zone index
+    chosen_c: jnp.ndarray   # [B] i32 capacity-type index
+    chosen_price: jnp.ndarray  # [B] f32 $/hr (+inf for fixed/empty bins)
+
+
+def empty_state(B: int, T: int, Z: int, C: int, R: int) -> BinState:
+    return BinState(
+        cum=jnp.zeros((B, R), jnp.float32),
+        tmask=jnp.zeros((B, T), bool),
+        zmask=jnp.zeros((B, Z), bool),
+        cmask=jnp.zeros((B, C), bool),
+        np_id=jnp.full((B,), -1, jnp.int32),
+        npods=jnp.zeros((B,), jnp.int32),
+        open=jnp.zeros((B,), bool),
+        fixed=jnp.zeros((B,), bool),
+        alloc_cap=jnp.full((B, R), jnp.inf, jnp.float32),
+        next_open=jnp.array(0, jnp.int32),
+    )
+
+
+def _fit_counts(headroom: jnp.ndarray, req: jnp.ndarray) -> jnp.ndarray:
+    """[...,R] headroom, [R] request -> [...] how many replicas fit.
+
+    Axes the group doesn't request don't constrain; a group requesting
+    nothing at all (padding) fits 'infinitely' and is neutralized by count=0.
+    """
+    req_safe = jnp.where(req > 0, req, 1.0)
+    per_axis = jnp.where(req > 0, jnp.floor((headroom + EPS) / req_safe), jnp.inf)
+    n = jnp.min(per_axis, axis=-1)
+    return jnp.clip(jnp.nan_to_num(n, posinf=1e9), 0.0, 1e9)
+
+
+def _offer_reachable(avail_f: jnp.ndarray, zm: jnp.ndarray, cm: jnp.ndarray) -> jnp.ndarray:
+    """avail [T,Z,C] f32, zm [...,Z] bool, cm [...,C] bool -> [...,T] bool:
+    does type t have any available offering inside the zone x captype mask?
+    The contraction is a small matmul -> MXU-friendly."""
+    zc = zm.astype(jnp.float32)[..., :, None] * cm.astype(jnp.float32)[..., None, :]
+    flat = zc.reshape(zc.shape[:-2] + (-1,))             # [...,Z*C]
+    a = avail_f.reshape(avail_f.shape[0], -1)            # [T,Z*C]
+    return (flat @ a.T) > 0.5                            # [...,T]
+
+
+def _pack_step(alloc: jnp.ndarray, avail_f: jnp.ndarray, pools: PoolParams,
+               state: BinState, g: GroupBatch) -> Tuple[BinState, Tuple[jnp.ndarray, jnp.ndarray]]:
+    B, T = state.tmask.shape
+    NP = pools.np_type.shape[0]
+
+    # ---- phase 1: fill existing/open bins, first-fit in bin order ----
+    tm = state.tmask & g.g_type[None, :]                       # [B,T]
+    zm = state.zmask & g.g_zone[None, :]                       # [B,Z]
+    cm = state.cmask & g.g_cap[None, :]                        # [B,C]
+    np_ok = jnp.where(state.np_id >= 0,
+                      g.g_np[jnp.clip(state.np_id, 0, NP - 1)],
+                      # unknown-pool bins: pool-agnostic, but never for groups
+                      # with strict custom-key constraints we cannot verify
+                      ~g.strict_custom)
+    # a running node needs no *market* availability — only new capacity does
+    reachable = _offer_reachable(avail_f, zm, cm) | state.fixed[:, None]  # [B,T]
+    # per-(bin,type) allocatable: lattice truth capped by the bin's own
+    # reported allocatable (real nodes can reserve more than the lattice says)
+    eff_alloc = jnp.minimum(alloc[None, :, :], state.alloc_cap[:, None, :])  # [B,T,R]
+    headroom = eff_alloc - state.cum[:, None, :]               # [B,T,R]
+    n_fit_t = _fit_counts(headroom, g.req)                     # [B,T]
+    valid_t = tm & reachable & np_ok[:, None] & state.open[:, None]
+    n_fit = jnp.max(jnp.where(valid_t, n_fit_t, 0.0), axis=1).astype(jnp.int32)  # [B]
+    n_fit = jnp.where(g.antiaff, jnp.minimum(n_fit, 1), n_fit)
+    prior = jnp.cumsum(n_fit) - n_fit                          # exclusive cumsum = first-fit order
+    take = jnp.clip(g.count - prior, 0, n_fit)                 # [B]
+    rem = g.count - jnp.sum(take)
+
+    updated = take > 0
+    cum1 = state.cum + take[:, None].astype(jnp.float32) * g.req[None, :]
+
+    # ---- phase 2: open new bins for the remainder ----
+    # pick the highest-weight pool (pools are weight-sorted) where a fresh
+    # node can hold >=1 pod of this group
+    tm_np = pools.np_type & g.g_type[None, :]                  # [NP,T]
+    zm_np = pools.np_zone & g.g_zone[None, :]                  # [NP,Z]
+    cm_np = pools.np_cap & g.g_cap[None, :]                    # [NP,C]
+    reach_np = _offer_reachable(avail_f, zm_np, cm_np)         # [NP,T]
+    head_np = alloc[None, :, :] - pools.ds[:, None, :]         # [NP,T,R]
+    n_per_t = _fit_counts(head_np, g.req)                      # [NP,T]
+    valid_np_t = tm_np & reach_np & g.g_np[:, None]
+    n_per_np = jnp.max(jnp.where(valid_np_t, n_per_t, 0.0), axis=1).astype(jnp.int32)  # [NP]
+    n_per_np = jnp.where(g.antiaff, jnp.minimum(n_per_np, 1), n_per_np)
+    ok_np = n_per_np >= 1
+    np_star = jnp.argmax(ok_np).astype(jnp.int32)              # first True (weight order)
+    any_ok = jnp.any(ok_np)
+    n_per = n_per_np[np_star]
+
+    want_new = (rem > 0) & any_ok
+    n_per_safe = jnp.maximum(n_per, 1)
+    n_new = jnp.where(want_new, -(-rem // n_per_safe), 0)      # ceil div
+    n_new = jnp.minimum(n_new, B - state.next_open)            # bucket overflow clamp
+
+    idx = jnp.arange(B, dtype=jnp.int32)
+    rel = idx - state.next_open
+    is_new = (rel >= 0) & (rel < n_new)
+    take_new = jnp.where(is_new, jnp.clip(rem - rel * n_per_safe, 0, n_per_safe), 0)
+
+    cum2 = jnp.where(is_new[:, None],
+                     pools.ds[np_star][None, :] + take_new[:, None].astype(jnp.float32) * g.req[None, :],
+                     cum1)
+
+    # ---- shrink masks once, for updated + new bins together ----
+    still_fits = jnp.all(eff_alloc + EPS >= cum2[:, None, :], axis=-1)  # [B,T]
+    tmask2 = jnp.where(is_new[:, None], tm_np[np_star][None, :] & reach_np[np_star][None, :],
+                       jnp.where(updated[:, None], tm & reachable, state.tmask))
+    tmask2 = tmask2 & jnp.where((is_new | updated)[:, None], still_fits, True)
+    zmask2 = jnp.where(is_new[:, None], zm_np[np_star][None, :],
+                       jnp.where(updated[:, None], zm, state.zmask))
+    cmask2 = jnp.where(is_new[:, None], cm_np[np_star][None, :],
+                       jnp.where(updated[:, None], cm, state.cmask))
+
+    new_state = BinState(
+        cum=cum2,
+        tmask=tmask2,
+        zmask=zmask2,
+        cmask=cmask2,
+        np_id=jnp.where(is_new, np_star, state.np_id),
+        npods=state.npods + take + take_new,
+        open=state.open | is_new,
+        fixed=state.fixed,
+        alloc_cap=state.alloc_cap,
+        next_open=state.next_open + n_new,
+    )
+    leftover = rem - jnp.sum(take_new)
+    return new_state, (take + take_new, leftover)
+
+
+@partial(jax.jit, static_argnames=())
+def pack(alloc: jnp.ndarray, avail: jnp.ndarray, price: jnp.ndarray,
+         groups: GroupBatch, pools: PoolParams, init: BinState) -> PackResult:
+    """Run the grouped-FFD scan + cheapest-offering finalization.
+
+    All shapes static: G groups (padded), B bins (bucketed), T x Z x C
+    lattice. Returns per-group-per-bin assignment counts, per-group leftover
+    (infeasible / bucket overflow — host retries with a bigger bucket), the
+    final bin table, and each new bin's chosen offering.
+    """
+    avail_f = avail.astype(jnp.float32)
+    step = partial(_pack_step, alloc, avail_f, pools)
+    state, (assign, leftover) = jax.lax.scan(step, init, groups)
+
+    # ---- finalization: cheapest available offering per new bin ----
+    B = state.cum.shape[0]
+    p = jnp.where(avail, price, jnp.inf)                          # [T,Z,C]
+    p_bin = jnp.where(state.tmask[:, :, None, None]
+                      & state.zmask[:, None, :, None]
+                      & state.cmask[:, None, None, :],
+                      p[None, :, :, :], jnp.inf)                  # [B,T,Z,C]
+    flat = p_bin.reshape(B, -1)
+    best = jnp.argmin(flat, axis=1)
+    TZC = p.shape
+    chosen_t = (best // (TZC[1] * TZC[2])).astype(jnp.int32)
+    chosen_z = ((best // TZC[2]) % TZC[1]).astype(jnp.int32)
+    chosen_c = (best % TZC[2]).astype(jnp.int32)
+    live = state.open & ~state.fixed & (state.npods > 0)
+    chosen_price = jnp.where(live, flat[jnp.arange(B), best], jnp.inf)
+
+    return PackResult(assign=assign, leftover=leftover, state=state,
+                      chosen_t=chosen_t, chosen_z=chosen_z, chosen_c=chosen_c,
+                      chosen_price=chosen_price)

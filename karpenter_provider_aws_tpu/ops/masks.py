@@ -76,13 +76,18 @@ def _numeric_mask(lattice: Lattice, key: str, c: Constraint) -> np.ndarray:
 
 
 def compile_masks(reqs: Requirements, lattice: Lattice,
-                  extra_labels: Optional[Mapping[str, str]] = None) -> CompiledMasks:
+                  extra_labels: Optional[Mapping[str, str]] = None,
+                  skip_unresolved_custom: bool = False) -> CompiledMasks:
     """Compile a requirement set against the lattice.
 
     ``extra_labels`` are labels the eventual node carries beyond its
     instance-type labels (NodePool template labels, e.g. custom team labels)
     — a constraint on such a key resolves to a scalar and either passes or
     zeroes the whole mask.
+
+    ``skip_unresolved_custom`` leaves constraints on unknown custom keys to
+    the caller (build_problem resolves them exactly per NodePool via
+    ``_custom_keys_ok``) instead of zeroing the mask.
     """
     T, Z, C = lattice.T, lattice.Z, lattice.C
     type_mask = np.ones((T,), dtype=bool)
@@ -115,6 +120,6 @@ def compile_masks(reqs: Requirements, lattice: Lattice,
             # custom key undefined on instance types and not provided by the
             # node template: satisfiable only if the constraint tolerates
             # absence (matches Requirements.intersects semantics)
-            if not c.allows_absent:
+            if not skip_unresolved_custom and not c.allows_absent:
                 type_mask[:] = False
     return CompiledMasks(type_mask=type_mask, zone_mask=zone_mask, cap_mask=cap_mask)

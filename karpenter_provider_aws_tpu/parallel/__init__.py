@@ -1,0 +1,4 @@
+from .mesh import solver_mesh
+from .sharded import sharded_pack, split_counts
+
+__all__ = ["solver_mesh", "sharded_pack", "split_counts"]

@@ -1,0 +1,40 @@
+"""Device mesh plumbing for the sharded solver.
+
+The reference scales its scheduling loop with controller concurrency and
+batching windows (SURVEY.md §2.3); the TPU-native scale axis is the pod
+dimension sharded over a `jax.sharding.Mesh` ('pods' axis), with XLA
+collectives (psum / all_gather over ICI) reducing pack results — the
+DP/SP slot of this build. Multi-host extends the same mesh over DCN.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+
+def solver_mesh(n_devices: Optional[int] = None, axis: str = "pods") -> Mesh:
+    """A 1-D mesh over the pod axis.
+
+    ``n_devices=None`` uses every default-backend device. When the default
+    backend is short (e.g. a single real TPU chip while the virtual CPU
+    backend carries 8 forced host devices for sharding dry-runs), falls back
+    to the cpu backend's device list.
+    """
+    devices = jax.devices()
+    if n_devices is not None and len(devices) < n_devices:
+        try:
+            cpu = jax.devices("cpu")
+        except RuntimeError:
+            cpu = []
+        if len(cpu) >= n_devices:
+            devices = cpu
+        else:
+            raise ValueError(f"need {n_devices} devices, have {len(devices)} "
+                             f"(default backend) and {len(cpu)} (cpu)")
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    import numpy as np
+    return Mesh(np.array(devices), (axis,))

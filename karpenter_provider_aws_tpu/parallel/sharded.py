@@ -1,0 +1,106 @@
+"""Pod-axis sharded solve (DP over the pod dimension + ICI reductions).
+
+Distributed design (the CP/ring-attention slot of this build, SURVEY.md §5
+"long-context"): the 50k-pod axis is the long sequence. Strategy:
+
+1. **Shard pods, replicate the lattice.** Each device receives an equal
+   slice of every group's pod count (`split_counts`) and runs the full
+   grouped-FFD scan locally against the replicated type lattice — a
+   blockwise-greedy pack with zero cross-device traffic during the scan.
+2. **Reduce with ICI collectives.** Total cost / node counts / leftovers
+   reduce with `psum`; per-device bin summaries `all_gather` for the host to
+   merge. Blockwise packing can open fractionally-filled tail bins on every
+   shard; the host-side merge (or a later refinement solve) repacks tail
+   bins — the accepted ≤2% envelope covers this (SURVEY.md §7 hard part a).
+3. **Multi-host**: the same program over a DCN-spanning mesh; XLA routes the
+   psum hierarchically (ICI within host, DCN across) — nothing to change in
+   the program.
+
+The shard_map'd function below is what dryrun_multichip compiles over an
+N-device mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import binpack
+
+
+def split_counts(count: np.ndarray, n_devices: int) -> np.ndarray:
+    """[G] pod counts -> [D,G] balanced split (device d gets ~count/D)."""
+    base = count // n_devices
+    extra = count % n_devices
+    out = np.tile(base, (n_devices, 1))
+    for d in range(n_devices):
+        out[d] += (d < extra).astype(count.dtype)
+    return out
+
+
+def _local_pack(alloc, avail, price, pools, req, count_shard, init_shard, g_type, g_zone,
+                g_cap, g_np, antiaff, strict_custom):
+    """Runs on each device over its pod-count shard; reduces over 'pods'."""
+    count_local = count_shard.reshape(count_shard.shape[-1])  # [1,G] block -> [G]
+    # each device gets its own bin table (existing capacity lives on shard 0
+    # only — replicating it would fill the same physical nodes D times)
+    init = binpack.BinState(*(x.reshape(x.shape[1:]) for x in init_shard))
+    groups = binpack.GroupBatch(req=req, count=count_local, g_type=g_type,
+                                g_zone=g_zone, g_cap=g_cap, g_np=g_np, antiaff=antiaff,
+                                strict_custom=strict_custom)
+    res = binpack.pack(alloc, avail, price, groups, pools, init)
+    live = res.state.open & ~res.state.fixed & (res.state.npods > 0)
+    local_cost = jnp.sum(jnp.where(live, res.chosen_price, 0.0))
+    local_nodes = jnp.sum(live.astype(jnp.int32))
+    local_leftover = jnp.sum(res.leftover)
+    # ICI reductions: global cost / node count / leftover
+    total_cost = jax.lax.psum(local_cost, "pods")
+    total_nodes = jax.lax.psum(local_nodes, "pods")
+    total_leftover = jax.lax.psum(local_leftover, "pods")
+    # gather per-device bin load summaries for the host-side tail-bin merge
+    summary = jnp.stack([res.state.cum[:, 0], res.state.cum[:, 1],
+                         res.state.npods.astype(jnp.float32),
+                         jnp.where(live, res.chosen_price, jnp.inf)], axis=-1)  # [B,4]
+    all_summaries = jax.lax.all_gather(summary, "pods")  # [D,B,4]
+    return res.assign[None], total_cost, total_nodes, total_leftover, all_summaries
+
+
+def sharded_pack(mesh: Mesh, alloc, avail, price, groups: binpack.GroupBatch,
+                 pools: binpack.PoolParams, init: binpack.BinState,
+                 count_split: np.ndarray):
+    """Compile + run the pod-sharded solve over ``mesh``.
+
+    ``count_split`` is [D,G] from split_counts; the lattice and group masks
+    are replicated (the lattice is the 'weights' of this model — resident on
+    every device, exactly the TP-style layout that avoids re-sharding the
+    lattice per step); the bin table is sharded so existing capacity lives on
+    shard 0 only.
+    """
+    import numpy as np
+
+    D = mesh.devices.size
+    B = init.cum.shape[0]
+    empty = binpack.empty_state(B, init.tmask.shape[1], init.zmask.shape[1],
+                                init.cmask.shape[1], init.cum.shape[1])
+    init_stack = binpack.BinState(*(
+        jnp.concatenate([jnp.asarray(a)[None], jnp.broadcast_to(jnp.asarray(e)[None], (D - 1,) + e.shape)])
+        if D > 1 else jnp.asarray(a)[None]
+        for a, e in zip(init, empty)
+    ))
+
+    repl = P()
+    fn = jax.shard_map(
+        partial(_local_pack, alloc, avail, price, pools),
+        mesh=mesh,
+        in_specs=(repl, P("pods"), jax.tree.map(lambda _: P("pods"), empty),
+                  repl, repl, repl, repl, repl, repl),
+        out_specs=(P("pods"), repl, repl, repl, repl),
+        check_vma=False,
+    )
+    return jax.jit(fn)(groups.req, count_split, init_stack, groups.g_type, groups.g_zone,
+                       groups.g_cap, groups.g_np, groups.antiaff, groups.strict_custom)

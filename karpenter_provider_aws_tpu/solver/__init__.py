@@ -1,0 +1,9 @@
+from .problem import Problem, ExistingBin, build_problem
+from .oracle import ffd_oracle, OraclePlan
+from .solve import Solver, NodePlan, PlannedNode
+
+__all__ = [
+    "Problem", "ExistingBin", "build_problem",
+    "ffd_oracle", "OraclePlan",
+    "Solver", "NodePlan", "PlannedNode",
+]

@@ -1,0 +1,142 @@
+"""Host-side First-Fit-Decreasing oracle.
+
+A faithful, per-pod sequential reimplementation of the reference's scheduling
+algorithm (reference designs/bin-packing.md:16-43: sort pods by size
+descending; first-fit into existing simulated nodes; else open a new node
+from the highest-weight compatible NodePool; finally price each node at its
+cheapest compatible offering). Pure Python/numpy, deliberately simple — the
+regression referee for the device kernel's pack quality (the ≤2% cost
+envelope in BASELINE.md) and the semantics oracle for parity tests.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .problem import Problem
+
+
+@dataclass
+class OracleBin:
+    np_idx: int
+    cum: np.ndarray            # [R]
+    tmask: np.ndarray          # [T] feasible types so far
+    zmask: np.ndarray          # [Z]
+    cmask: np.ndarray          # [C]
+    pods: List[str] = field(default_factory=list)
+    group_counts: Dict[int, int] = field(default_factory=dict)
+    existing_idx: Optional[int] = None   # fixed bin: index into problem.existing
+
+    @property
+    def is_existing(self) -> bool:
+        return self.existing_idx is not None
+
+
+@dataclass
+class OraclePlan:
+    bins: List[OracleBin]
+    new_node_cost: float                       # $/hr of newly created nodes
+    chosen: List[Tuple[int, int, int]]         # per new bin: (type, zone, cap) indices
+    unschedulable: Dict[str, str]
+
+    @property
+    def num_new_nodes(self) -> int:
+        return sum(1 for b in self.bins if not b.is_existing and b.pods)
+
+
+def ffd_oracle(problem: Problem) -> OraclePlan:
+    lat = problem.lattice
+    alloc, avail, price = lat.alloc, lat.available, lat.price
+    unschedulable = dict(problem.unschedulable)
+
+    bins: List[OracleBin] = []
+    for ei in range(problem.E):
+        ti = int(problem.e_type[ei])
+        tmask = np.zeros((lat.T,), dtype=bool)
+        tmask[ti] = True
+        zmask = np.zeros((lat.Z,), dtype=bool)
+        zmask[int(problem.e_zone[ei])] = True
+        cmask = np.zeros((lat.C,), dtype=bool)
+        cmask[int(problem.e_cap[ei])] = True
+        bins.append(OracleBin(np_idx=int(problem.e_np[ei]), cum=problem.e_used[ei].copy(),
+                              tmask=tmask, zmask=zmask, cmask=cmask, existing_idx=ei))
+
+    def type_has_offering(tm: np.ndarray, zm: np.ndarray, cm: np.ndarray) -> np.ndarray:
+        """[T] bool: type compatible AND has an available offering in zm x cm."""
+        return tm & (avail & zm[None, :, None] & cm[None, None, :]).any(axis=(1, 2))
+
+    # groups are already FFD-sorted; expand each group pod by pod
+    for gi, group in enumerate(problem.groups):
+        for pod_name in group.pod_names:
+            req = group.req
+            placed = False
+            for b in bins:
+                if b.np_idx >= 0:
+                    if not group.np_ok[b.np_idx]:
+                        continue
+                elif not b.is_existing:
+                    continue
+                elif group.strict_custom:
+                    # unknown-pool node: cannot verify custom-label selectors
+                    continue
+                if group.hostname_anti_affinity and b.group_counts.get(gi, 0) >= 1:
+                    continue
+                if b.is_existing:
+                    # fixed node: capacity check against its own allocatable
+                    new_cum = b.cum + req
+                    ei = b.existing_idx
+                    if (new_cum <= problem.e_alloc[ei] + 1e-3).all() and group.type_mask[int(problem.e_type[ei])] \
+                            and group.zone_mask[int(problem.e_zone[ei])] and group.cap_mask[int(problem.e_cap[ei])]:
+                        b.cum = new_cum
+                        b.pods.append(pod_name)
+                        b.group_counts[gi] = b.group_counts.get(gi, 0) + 1
+                        placed = True
+                        break
+                    continue
+                tm = b.tmask & group.type_mask
+                zm = b.zmask & group.zone_mask
+                cm = b.cmask & group.cap_mask
+                new_cum = b.cum + req
+                fits = tm & (alloc >= new_cum[None, :] - 1e-3).all(axis=1)
+                fits = type_has_offering(fits, zm, cm)
+                if fits.any():
+                    b.cum, b.tmask, b.zmask, b.cmask = new_cum, fits, zm, cm
+                    b.pods.append(pod_name)
+                    b.group_counts[gi] = b.group_counts.get(gi, 0) + 1
+                    placed = True
+                    break
+            if placed:
+                continue
+            # open a new node: highest-weight compatible pool with a feasible type
+            for pi in np.nonzero(group.np_ok)[0]:
+                pi = int(pi)
+                cum = problem.ds_overhead[pi] + req
+                tm = group.type_mask & problem.np_type[pi]
+                zm = group.zone_mask & problem.np_zone[pi]
+                cm = group.cap_mask & problem.np_cap[pi]
+                fits = tm & (alloc >= cum[None, :] - 1e-3).all(axis=1)
+                fits = type_has_offering(fits, zm, cm)
+                if fits.any():
+                    bins.append(OracleBin(np_idx=pi, cum=cum, tmask=fits, zmask=zm, cmask=cm,
+                                          pods=[pod_name], group_counts={gi: 1}))
+                    placed = True
+                    break
+            if not placed:
+                unschedulable[pod_name] = "does not fit any existing node or new-node shape"
+
+    # finalize: cheapest available offering per new bin
+    cost = 0.0
+    chosen: List[Tuple[int, int, int]] = []
+    for b in bins:
+        if b.is_existing or not b.pods:
+            continue
+        p = np.where(avail & b.tmask[:, None, None] & b.zmask[None, :, None] & b.cmask[None, None, :],
+                     price, np.inf)
+        t, z, c = np.unravel_index(int(np.argmin(p)), p.shape)
+        assert np.isfinite(p[t, z, c]), "oracle invariant: open bin must have an offering"
+        chosen.append((int(t), int(z), int(c)))
+        cost += float(p[t, z, c])
+    return OraclePlan(bins=bins, new_node_cost=cost, chosen=chosen, unschedulable=unschedulable)

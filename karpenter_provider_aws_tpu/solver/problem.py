@@ -1,0 +1,315 @@
+"""Pending pods + NodePools + lattice → the batched constraint problem.
+
+This is the tensorization step the reference performs implicitly, one pod at
+a time, inside its Go scheduler loop (core provisioner; see SURVEY.md §2.2).
+Here:
+
+1. Pods are **deduplicated into groups** by scheduling signature (requests +
+   constraints + tolerations + self-anti-affinity). 50k pods from a handful
+   of deployments collapse to a handful of groups — the key observation that
+   makes the packing scan short on device.
+2. Each group's requirements compile to boolean masks over the lattice axes
+   (ops/masks.py) and to a per-NodePool compatibility row (host-side exact
+   algebra, incl. taints/tolerations, custom template labels, minValues).
+3. NodePools compile to their own masks, daemonset overhead vectors, and a
+   weight-descending order (the order the reference tries pools,
+   nodepools.md:161-163).
+4. Existing capacity (in-flight NodeClaims / registered nodes) becomes
+   pre-initialized bins so the solver fills real headroom before opening new
+   nodes — the reference simulates against in-flight nodes the same way.
+
+Everything is plain numpy here; solve.py pads and ships to device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..apis import wellknown as wk
+from ..apis.objects import NodePool, Pod, tolerates_all
+from ..apis.requirements import Requirements
+from ..apis.resources import R, resources_to_vec_checked
+from ..lattice.tensors import Lattice
+from ..ops.masks import _AXIS_KEYS, _CAT_KEY_INDEX, _NUM_KEY_INDEX, compile_masks
+
+
+@dataclass
+class ExistingBin:
+    """A node (or in-flight NodeClaim) offered to the packer as existing
+    headroom. ``fixed`` bins keep their instance type; they are never
+    re-priced at finalization."""
+
+    name: str
+    node_pool: str
+    instance_type: str
+    zone: str
+    capacity_type: str
+    used: np.ndarray                      # [R] resources already committed
+    alloc_override: Optional[np.ndarray] = None  # [R] if real node alloc differs from lattice
+
+
+@dataclass
+class PodGroup:
+    signature: str
+    pod_names: List[str]
+    req: np.ndarray                # [R]
+    type_mask: np.ndarray          # [T]
+    zone_mask: np.ndarray          # [Z]
+    cap_mask: np.ndarray           # [C]
+    np_ok: np.ndarray              # [NP] bool
+    hostname_anti_affinity: bool
+    requirements: Requirements     # merged pod-level requirements (for claims)
+    strict_custom: bool = False    # has existence-requiring custom-key constraints
+                                   # (resolvable only via a known pool's labels)
+
+
+@dataclass
+class Problem:
+    lattice: Lattice
+    node_pools: List[NodePool]     # weight-descending order
+    groups: List[PodGroup]         # FFD order (sorted descending)
+    existing: List[ExistingBin]
+    unschedulable: Dict[str, str]  # pod name -> reason
+    # dense group arrays, FFD-sorted (host numpy; solve.py pads to buckets)
+    req: np.ndarray                # [G,R] f32
+    count: np.ndarray              # [G] i32
+    g_type: np.ndarray             # [G,T] bool
+    g_zone: np.ndarray             # [G,Z] bool
+    g_cap: np.ndarray              # [G,C] bool
+    g_np: np.ndarray               # [G,NP] bool
+    antiaff: np.ndarray            # [G] bool
+    strict_custom: np.ndarray      # [G] bool
+    # nodepool arrays
+    np_type: np.ndarray            # [NP,T] bool
+    np_zone: np.ndarray            # [NP,Z] bool
+    np_cap: np.ndarray             # [NP,C] bool
+    ds_overhead: np.ndarray        # [NP,R] f32 daemonset overhead per new node
+    # existing-bin arrays
+    e_used: np.ndarray             # [E,R] f32
+    e_alloc: np.ndarray            # [E,R] f32 (fixed node allocatable)
+    e_type: np.ndarray             # [E] i32 type index
+    e_zone: np.ndarray             # [E] i32
+    e_cap: np.ndarray              # [E] i32
+    e_np: np.ndarray               # [E] i32 nodepool index (-1 unknown)
+    warnings: List[str] = field(default_factory=list)  # unsupported-constraint notices
+
+    @property
+    def G(self) -> int:
+        return len(self.groups)
+
+    @property
+    def NP(self) -> int:
+        return len(self.node_pools)
+
+    @property
+    def E(self) -> int:
+        return len(self.existing)
+
+
+def _custom_keys_ok(reqs: Requirements, pool_labels: Mapping[str, str]) -> bool:
+    """Exact host-side check of constraints on keys the lattice does not
+    model: they must be satisfied by the pool's template labels (or tolerate
+    absence)."""
+    for key in reqs.keys():
+        if key in _AXIS_KEYS or key in _CAT_KEY_INDEX or key in _NUM_KEY_INDEX or key == wk.LABEL_REGION:
+            continue
+        c = reqs.get(key)
+        if key in pool_labels:
+            if not c.matches(pool_labels[key]):
+                return False
+        elif not c.allows_absent:
+            return False
+    return True
+
+
+def _is_self_hostname_anti_affinity(pod: Pod) -> bool:
+    """Does the pod anti-affine against its own replicas per hostname
+    (the 1-pod-per-node pattern, scale suite provisioning_test.go:82-118)?"""
+    for term in pod.pod_affinity:
+        if term.anti and term.topology_key == wk.LABEL_HOSTNAME:
+            sel = dict(term.label_selector)
+            if all(pod.labels.get(k) == v for k, v in sel.items()):
+                return True
+    return False
+
+
+def _group_signature(pod: Pod) -> str:
+    reqs = pod.scheduling_requirements()
+    parts = [repr(sorted(pod.requests.items()))]
+    parts.append(repr(reqs))
+    parts.append(repr(sorted((t.key, t.operator, t.value, t.effect) for t in pod.tolerations)))
+    parts.append(repr(_is_self_hostname_anti_affinity(pod)))
+    parts.append(repr(sorted(
+        (c.topology_key, c.max_skew, c.when_unsatisfiable, tuple(sorted(c.label_selector)))
+        for c in pod.topology_spread
+    )))
+    return "|".join(parts)
+
+
+def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: Lattice,
+                  existing: Sequence[ExistingBin] = (),
+                  daemonset_pods: Sequence[Pod] = ()) -> Problem:
+    pools = sorted(node_pools, key=lambda p: (-p.weight, p.name))
+    NP = len(pools)
+    T, Z, C = lattice.T, lattice.Z, lattice.C
+    key_values = lattice.key_values_present()
+
+    # --- NodePool masks + daemonset overhead
+    np_type = np.ones((NP, T), dtype=bool)
+    np_zone = np.ones((NP, Z), dtype=bool)
+    np_cap = np.ones((NP, C), dtype=bool)
+    ds_overhead = np.zeros((NP, R), dtype=np.float32)
+    pool_reqs: List[Requirements] = []
+    for pi, pool in enumerate(pools):
+        reqs = pool.scheduling_requirements()
+        pool_reqs.append(reqs)
+        m = compile_masks(reqs, lattice, extra_labels=pool.labels)
+        np_type[pi], np_zone[pi], np_cap[pi] = m.type_mask, m.zone_mask, m.cap_mask
+        for ds in daemonset_pods:
+            # a daemonset lands on the pool's nodes iff it tolerates the pool
+            # taints and its node selectors are compatible (reference
+            # resolves daemonset overhead per simulated node the same way)
+            if not tolerates_all(ds.tolerations, pool.taints + pool.startup_taints):
+                continue
+            ds_reqs = ds.scheduling_requirements()
+            if not ds_reqs.intersects(reqs):
+                continue
+            if not _custom_keys_ok(ds_reqs, pool.labels):
+                continue
+            vec, unknown = resources_to_vec_checked(ds.requests, implicit_pod=True)
+            if unknown:
+                continue
+            ds_overhead[pi] += vec
+
+    # --- group pods
+    unschedulable: Dict[str, str] = {}
+    groups_by_sig: Dict[str, PodGroup] = {}
+    order: List[str] = []
+    for pod in pods:
+        vec, unknown = resources_to_vec_checked(pod.requests, implicit_pod=True)
+        if unknown:
+            unschedulable[pod.name] = f"unknown resource(s): {', '.join(unknown)}"
+            continue
+        sig = _group_signature(pod)
+        g = groups_by_sig.get(sig)
+        if g is not None:
+            g.pod_names.append(pod.name)
+            continue
+        reqs = pod.scheduling_requirements()
+        # custom-key constraints resolve exactly per-pool in np_ok below
+        masks = compile_masks(reqs, lattice, skip_unresolved_custom=True)
+        np_ok = np.zeros((NP,), dtype=bool)
+        for pi, pool in enumerate(pools):
+            if not reqs.intersects(pool_reqs[pi]):
+                continue
+            if not tolerates_all(pod.tolerations, pool.taints + pool.startup_taints):
+                continue
+            if not _custom_keys_ok(reqs, pool.labels):
+                continue
+            merged = reqs.merge(pool_reqs[pi])
+            if not merged.min_values_satisfied(key_values):
+                continue
+            np_ok[pi] = True
+        strict = any(
+            key not in _AXIS_KEYS and key not in _CAT_KEY_INDEX
+            and key not in _NUM_KEY_INDEX and key != wk.LABEL_REGION
+            and not reqs.get(key).allows_absent
+            for key in reqs.keys()
+        )
+        g = PodGroup(
+            signature=sig, pod_names=[pod.name], req=vec,
+            type_mask=masks.type_mask, zone_mask=masks.zone_mask, cap_mask=masks.cap_mask,
+            np_ok=np_ok, hostname_anti_affinity=_is_self_hostname_anti_affinity(pod),
+            requirements=reqs, strict_custom=strict,
+        )
+        groups_by_sig[sig] = g
+        order.append(sig)
+
+    groups = [groups_by_sig[s] for s in order]
+
+    # mark groups with no feasible (pool, type, offering) at all
+    schedulable_groups: List[PodGroup] = []
+    for g in groups:
+        feasible = False
+        for pi in np.nonzero(g.np_ok)[0]:
+            tm = g.type_mask & np_type[pi]
+            zm = g.zone_mask & np_zone[pi]
+            cm = g.cap_mask & np_cap[pi]
+            if (tm[:, None, None] & zm[None, :, None] & cm[None, None, :] & lattice.available).any():
+                feasible = True
+                break
+        if feasible or len(existing) > 0:
+            # groups infeasible for new nodes may still fit existing capacity
+            schedulable_groups.append(g)
+        else:
+            for name in g.pod_names:
+                unschedulable[name] = "no compatible nodepool/instance-type offering"
+    groups = schedulable_groups
+
+    # --- FFD order: dominant normalized request, descending (the grouped
+    # equivalent of the reference's pods-sorted-by-size FFD loop)
+    if groups:
+        mean_alloc = np.maximum(lattice.alloc.mean(axis=0), 1e-6)  # [R]
+        def ffd_key(g: PodGroup):
+            norm = g.req / mean_alloc
+            return (-float(norm.max()), -float(g.req[0]), -float(g.req[1]), g.signature)
+        groups.sort(key=ffd_key)
+
+    G = len(groups)
+    req = np.stack([g.req for g in groups]) if G else np.zeros((0, R), np.float32)
+    count = np.array([len(g.pod_names) for g in groups], dtype=np.int32)
+    g_type = np.stack([g.type_mask for g in groups]) if G else np.zeros((0, T), bool)
+    g_zone = np.stack([g.zone_mask for g in groups]) if G else np.zeros((0, Z), bool)
+    g_cap = np.stack([g.cap_mask for g in groups]) if G else np.zeros((0, C), bool)
+    g_np = np.stack([g.np_ok for g in groups]) if G else np.zeros((0, NP), bool)
+    antiaff = np.array([g.hostname_anti_affinity for g in groups], dtype=bool)
+    strict_custom = np.array([g.strict_custom for g in groups], dtype=bool)
+
+    # surface constraints the solver does not yet enforce instead of silently
+    # violating them (topology spread + non-self pod affinity land with the
+    # topology milestone)
+    warnings = []
+    seen_warn = set()
+    for pod in pods:
+        if pod.topology_spread and "spread" not in seen_warn:
+            seen_warn.add("spread")
+            warnings.append("topologySpreadConstraints not yet enforced by the solver")
+        for term in pod.pod_affinity:
+            supported = (term.anti and term.topology_key == wk.LABEL_HOSTNAME
+                         and all(pod.labels.get(k) == v for k, v in dict(term.label_selector).items()))
+            if not supported and "affinity" not in seen_warn:
+                seen_warn.add("affinity")
+                warnings.append("pod (anti-)affinity beyond hostname self-anti-affinity not yet enforced")
+
+    # --- existing bins
+    E = len(existing)
+    e_used = np.zeros((E, R), np.float32)
+    e_alloc = np.zeros((E, R), np.float32)
+    e_type = np.zeros((E,), np.int32)
+    e_zone = np.zeros((E,), np.int32)
+    e_cap = np.zeros((E,), np.int32)
+    e_np = np.full((E,), -1, np.int32)
+    pool_index = {p.name: i for i, p in enumerate(pools)}
+    zone_index = {z: i for i, z in enumerate(lattice.zones)}
+    cap_index = {c: i for i, c in enumerate(lattice.capacity_types)}
+    for ei, b in enumerate(existing):
+        ti = lattice.name_to_idx[b.instance_type]
+        e_used[ei] = b.used
+        e_alloc[ei] = b.alloc_override if b.alloc_override is not None else lattice.alloc[ti]
+        e_type[ei] = ti
+        e_zone[ei] = zone_index[b.zone]
+        e_cap[ei] = cap_index[b.capacity_type]
+        e_np[ei] = pool_index.get(b.node_pool, -1)
+
+    return Problem(
+        lattice=lattice, node_pools=pools, groups=groups, existing=list(existing),
+        unschedulable=unschedulable,
+        req=req.astype(np.float32), count=count, g_type=g_type, g_zone=g_zone,
+        g_cap=g_cap, g_np=g_np, antiaff=antiaff, strict_custom=strict_custom,
+        warnings=warnings,
+        np_type=np_type, np_zone=np_zone, np_cap=np_cap, ds_overhead=ds_overhead,
+        e_used=e_used, e_alloc=e_alloc, e_type=e_type, e_zone=e_zone, e_cap=e_cap, e_np=e_np,
+    )

@@ -1,0 +1,229 @@
+"""Host-facing Solve() API.
+
+Wraps the device kernel (ops/binpack.py) with the host plumbing the
+reference spreads across its provisioner loop:
+
+- shape bucketing + padding (jit compiles once per bucket; wildly varying
+  pod counts hit a small, warm set of compiled shapes),
+- bin-table overflow retry with the next bucket size,
+- NodePlan decoding: bin table + assignment matrix → named NodeClaims-to-be
+  (instance type, zone, capacity type, price, pod list per node), existing
+  node assignments, and per-pod unschedulable reasons.
+
+The decoded NodePlan is what the provisioning controller turns into
+NodeClaims and hands to the CloudProvider (the reference's scheduler →
+NodeClaim → Create() flow, SURVEY.md §3.2).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..apis.resources import R
+from ..lattice.tensors import Lattice
+from ..ops import binpack
+from .problem import Problem
+
+_G_BUCKETS = (16, 64, 256, 1024, 4096)
+_B_BUCKETS = (32, 128, 512, 2048, 8192)
+
+
+@dataclass
+class PlannedNode:
+    node_pool: str
+    instance_type: str
+    zone: str
+    capacity_type: str
+    price_per_hour: float
+    pods: List[str] = field(default_factory=list)
+
+
+@dataclass
+class NodePlan:
+    new_nodes: List[PlannedNode]
+    existing_assignments: Dict[str, List[str]]   # existing node name -> pods
+    unschedulable: Dict[str, str]                # pod name -> reason
+    new_node_cost: float                         # $/hr
+    solve_seconds: float
+    device_seconds: float
+    warnings: List[str] = field(default_factory=list)
+
+    @property
+    def num_new_nodes(self) -> int:
+        return len(self.new_nodes)
+
+
+def _bucket(n: int, buckets: Sequence[int], clamp: bool = False) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    if clamp:
+        # degrade gracefully: the kernel's overflow path marks what doesn't
+        # fit as leftover-unschedulable rather than crashing the solve
+        return buckets[-1]
+    raise ValueError(f"problem size {n} exceeds the largest bucket {buckets[-1]}")
+
+
+class Solver:
+    """Holds the lattice resident on device; solves padded problems."""
+
+    def __init__(self, lattice: Lattice):
+        self.lattice = lattice
+        self._alloc = jnp.asarray(lattice.alloc)
+        self._avail = jnp.asarray(lattice.available)
+        self._price = jnp.asarray(lattice.price)
+
+    # ---- padding ----
+
+    def _padded_groups(self, problem: Problem, G: int) -> binpack.GroupBatch:
+        lat = self.lattice
+
+        def pad(a: np.ndarray, shape, dtype):
+            out = np.zeros(shape, dtype)
+            if a.size:
+                out[: a.shape[0]] = a
+            return jnp.asarray(out)
+
+        g = problem
+        return binpack.GroupBatch(
+            req=pad(g.req, (G, R), np.float32),
+            count=pad(g.count, (G,), np.int32),
+            g_type=pad(g.g_type, (G, lat.T), bool),
+            g_zone=pad(g.g_zone, (G, lat.Z), bool),
+            g_cap=pad(g.g_cap, (G, lat.C), bool),
+            g_np=pad(g.g_np, (G, max(g.NP, 1)), bool),
+            antiaff=pad(g.antiaff, (G,), bool),
+            strict_custom=pad(g.strict_custom, (G,), bool),
+        )
+
+    def _pool_params(self, problem: Problem) -> binpack.PoolParams:
+        NP = max(problem.NP, 1)
+        lat = self.lattice
+
+        def fit(a, shape, dtype):
+            out = np.zeros(shape, dtype)
+            if a.size:
+                out[: a.shape[0]] = a
+            return jnp.asarray(out)
+
+        return binpack.PoolParams(
+            np_type=fit(problem.np_type, (NP, lat.T), bool),
+            np_zone=fit(problem.np_zone, (NP, lat.Z), bool),
+            np_cap=fit(problem.np_cap, (NP, lat.C), bool),
+            ds=fit(problem.ds_overhead, (NP, R), np.float32),
+        )
+
+    def _init_state(self, problem: Problem, B: int) -> binpack.BinState:
+        lat = self.lattice
+        E = problem.E
+        state = binpack.empty_state(B, lat.T, lat.Z, lat.C, R)
+        if E == 0:
+            return state
+        cum = np.zeros((B, R), np.float32)
+        tmask = np.zeros((B, lat.T), bool)
+        zmask = np.zeros((B, lat.Z), bool)
+        cmask = np.zeros((B, lat.C), bool)
+        np_id = np.full((B,), -1, np.int32)
+        open_ = np.zeros((B,), bool)
+        fixed = np.zeros((B,), bool)
+        alloc_cap = np.full((B, R), np.inf, np.float32)
+        cum[:E] = problem.e_used
+        tmask[np.arange(E), problem.e_type] = True
+        zmask[np.arange(E), problem.e_zone] = True
+        cmask[np.arange(E), problem.e_cap] = True
+        np_id[:E] = problem.e_np
+        open_[:E] = True
+        fixed[:E] = True
+        alloc_cap[:E] = problem.e_alloc  # real node allocatable wins over lattice
+        return binpack.BinState(
+            cum=jnp.asarray(cum), tmask=jnp.asarray(tmask), zmask=jnp.asarray(zmask),
+            cmask=jnp.asarray(cmask), np_id=jnp.asarray(np_id),
+            npods=jnp.zeros((B,), jnp.int32), open=jnp.asarray(open_),
+            fixed=jnp.asarray(fixed), alloc_cap=jnp.asarray(alloc_cap),
+            next_open=jnp.array(E, jnp.int32),
+        )
+
+    # ---- solve ----
+
+    def solve(self, problem: Problem) -> NodePlan:
+        t0 = time.perf_counter()
+        if problem.G == 0:
+            return NodePlan([], {}, dict(problem.unschedulable), 0.0,
+                            time.perf_counter() - t0, 0.0)
+        G = _bucket(problem.G, _G_BUCKETS)
+        total_pods = int(problem.count.sum())
+        b_needed = problem.E + min(total_pods, int(problem.antiaff.any()) * total_pods + 64)
+        B = _bucket(max(b_needed, problem.E + 1), _B_BUCKETS, clamp=True)
+
+        groups = self._padded_groups(problem, G)
+        pools = self._pool_params(problem)
+
+        while True:
+            init = self._init_state(problem, B)
+            td = time.perf_counter()
+            result = binpack.pack(self._alloc, self._avail, self._price, groups, pools, init)
+            result.assign.block_until_ready()
+            device_s = time.perf_counter() - td
+            leftover = np.asarray(result.leftover)
+            overflowed = (leftover.sum() > 0) and int(result.state.next_open) >= B
+            if overflowed and B < _B_BUCKETS[-1]:
+                B = _B_BUCKETS[min(_B_BUCKETS.index(B) + 1, len(_B_BUCKETS) - 1)]
+                continue
+            break
+
+        plan = self._decode(problem, result, device_s)
+        plan.solve_seconds = time.perf_counter() - t0
+        plan.warnings = list(problem.warnings)
+        return plan
+
+    def _decode(self, problem: Problem, result: binpack.PackResult, device_s: float) -> NodePlan:
+        lat = self.lattice
+        assign = np.asarray(result.assign)          # [G,B]
+        leftover = np.asarray(result.leftover)      # [G]
+        npods = np.asarray(result.state.npods)
+        open_ = np.asarray(result.state.open)
+        fixed = np.asarray(result.state.fixed)
+        np_id = np.asarray(result.state.np_id)
+        chosen_t = np.asarray(result.chosen_t)
+        chosen_z = np.asarray(result.chosen_z)
+        chosen_c = np.asarray(result.chosen_c)
+        chosen_price = np.asarray(result.chosen_price)
+
+        unschedulable = dict(problem.unschedulable)
+        existing_assignments: Dict[str, List[str]] = {}
+        new_bins: Dict[int, PlannedNode] = {}
+
+        for gi, group in enumerate(problem.groups):
+            names = group.pod_names
+            cursor = 0
+            for b in np.nonzero(assign[gi])[0]:
+                n = int(assign[gi, b])
+                pod_slice = names[cursor: cursor + n]
+                cursor += n
+                if fixed[b]:
+                    existing_assignments.setdefault(problem.existing[b].name, []).extend(pod_slice)
+                else:
+                    node = new_bins.get(int(b))
+                    if node is None:
+                        t, z, c = int(chosen_t[b]), int(chosen_z[b]), int(chosen_c[b])
+                        node = PlannedNode(
+                            node_pool=problem.node_pools[int(np_id[b])].name,
+                            instance_type=lat.names[t], zone=lat.zones[z],
+                            capacity_type=lat.capacity_types[c],
+                            price_per_hour=float(chosen_price[b]),
+                        )
+                        new_bins[int(b)] = node
+                    node.pods.extend(pod_slice)
+            for name in names[cursor: cursor + int(leftover[gi])]:
+                unschedulable[name] = "does not fit any existing node or new-node shape"
+
+        new_nodes = [new_bins[b] for b in sorted(new_bins)]
+        cost = float(sum(n.price_per_hour for n in new_nodes))
+        return NodePlan(new_nodes=new_nodes, existing_assignments=existing_assignments,
+                        unschedulable=unschedulable, new_node_cost=cost,
+                        solve_seconds=0.0, device_seconds=device_s)

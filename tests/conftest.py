@@ -12,3 +12,9 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# the environment's sitecustomize force-registers the 'axon' TPU platform
+# ahead of JAX_PLATFORMS; pin the cpu backend explicitly for tests
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,317 @@
+"""Device solver tests: kernel vs FFD-oracle parity, constraint handling.
+
+Mirrors the reference's unit strategy (SURVEY.md §4: real scheduler
+in-process over fakes) — the full build_problem → pack → decode path runs on
+the 8-device virtual CPU backend with a reduced lattice for speed.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.apis import (
+    NodePool, Operator, Pod, Requirement, Taint, Toleration,
+)
+from karpenter_provider_aws_tpu.apis.objects import PodAffinityTerm
+from karpenter_provider_aws_tpu.apis import wellknown as wk
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+from karpenter_provider_aws_tpu.solver import (
+    ExistingBin, Solver, build_problem, ffd_oracle,
+)
+
+_FAMILIES = ("m5", "c5", "r5", "m6g", "c6g", "g5", "t3")
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    specs = [s for s in build_catalog() if s.family in _FAMILIES]
+    return build_lattice(specs)
+
+
+@pytest.fixture(scope="module")
+def solver(lattice):
+    return Solver(lattice)
+
+
+def generic_pods(n, cpu="500m", mem="1Gi", prefix="pod", **kw):
+    return [Pod(name=f"{prefix}-{i}", requests={"cpu": cpu, "memory": mem}, **kw) for i in range(n)]
+
+
+def default_pool(**kw):
+    return NodePool(name=kw.pop("name", "default"), **kw)
+
+
+def assert_plan_valid(plan, problem):
+    """Every new node's pods must fit its chosen type's allocatable."""
+    lat = problem.lattice
+    pod_req = {}
+    for g in problem.groups:
+        for name in g.pod_names:
+            pod_req[name] = g.req
+    for node in plan.new_nodes:
+        ti = lat.name_to_idx[node.instance_type]
+        total = np.zeros(8, np.float32)
+        for p in node.pods:
+            total += pod_req[p]
+        assert (total <= lat.alloc[ti] + 1e-2).all(), (
+            f"{node.instance_type} overpacked: {total} > {lat.alloc[ti]}")
+        assert np.isfinite(node.price_per_hour)
+
+
+class TestBasicPacking:
+    def test_config1_100_generic_pods(self, solver, lattice):
+        """BASELINE config 1: 100 generic pods, single NodePool."""
+        pods = generic_pods(100)
+        problem = build_problem(pods, [default_pool()], lattice)
+        plan = solver.solve(problem)
+        oracle = ffd_oracle(problem)
+        assert not plan.unschedulable
+        placed = sum(len(n.pods) for n in plan.new_nodes)
+        assert placed == 100
+        assert_plan_valid(plan, problem)
+        # cost parity: within 2% of the FFD oracle (BASELINE.md envelope)
+        assert plan.new_node_cost <= oracle.new_node_cost * 1.02 + 1e-6
+
+
+    def test_single_pod(self, solver, lattice):
+        problem = build_problem(generic_pods(1), [default_pool()], lattice)
+        plan = solver.solve(problem)
+        assert len(plan.new_nodes) == 1
+        assert plan.new_nodes[0].pods == ["pod-0"]
+        assert_plan_valid(plan, problem)
+
+    def test_empty(self, solver, lattice):
+        problem = build_problem([], [default_pool()], lattice)
+        plan = solver.solve(problem)
+        assert plan.new_nodes == [] and not plan.unschedulable
+
+    def test_large_pod_gets_large_node(self, solver, lattice):
+        pods = [Pod(name="big", requests={"cpu": "60", "memory": "200Gi"})]
+        problem = build_problem(pods, [default_pool()], lattice)
+        plan = solver.solve(problem)
+        assert len(plan.new_nodes) == 1
+        assert_plan_valid(plan, problem)
+
+    def test_cheapest_offering_chosen(self, solver, lattice):
+        """A spot-allowed pod should land on the cheapest compatible offering."""
+        problem = build_problem(generic_pods(1), [default_pool()], lattice)
+        plan = solver.solve(problem)
+        oracle = ffd_oracle(problem)
+        assert plan.new_node_cost == pytest.approx(oracle.new_node_cost, rel=1e-5)
+
+
+class TestConstraints:
+    def test_node_selector_family(self, solver, lattice):
+        pods = generic_pods(10, node_selector={wk.LABEL_INSTANCE_FAMILY: "c5"})
+        problem = build_problem(pods, [default_pool()], lattice)
+        plan = solver.solve(problem)
+        assert not plan.unschedulable
+        for n in plan.new_nodes:
+            assert n.instance_type.startswith("c5.")
+        assert_plan_valid(plan, problem)
+
+    def test_gpu_pods(self, solver, lattice):
+        pods = [Pod(name=f"gpu-{i}", requests={"cpu": "2", "nvidia.com/gpu": 1}) for i in range(4)]
+        problem = build_problem(pods, [default_pool()], lattice)
+        plan = solver.solve(problem)
+        assert not plan.unschedulable
+        for n in plan.new_nodes:
+            assert n.instance_type.startswith("g5."), n.instance_type
+        assert_plan_valid(plan, problem)
+
+    def test_capacity_type_on_demand_only(self, solver, lattice):
+        pool = default_pool(requirements=[
+            Requirement(wk.LABEL_CAPACITY_TYPE, Operator.IN, ("on-demand",))])
+        problem = build_problem(generic_pods(5), [pool], lattice)
+        plan = solver.solve(problem)
+        for n in plan.new_nodes:
+            assert n.capacity_type == "on-demand"
+
+    def test_zone_selector(self, solver, lattice):
+        pods = generic_pods(5, node_selector={wk.LABEL_ZONE: "us-west-2b"})
+        problem = build_problem(pods, [default_pool()], lattice)
+        plan = solver.solve(problem)
+        for n in plan.new_nodes:
+            assert n.zone == "us-west-2b"
+
+    def test_taints_block_intolerant_pods(self, solver, lattice):
+        pool = default_pool(taints=[Taint("dedicated", "gpu")])
+        problem = build_problem(generic_pods(3), [pool], lattice)
+        plan = solver.solve(problem)
+        assert len(plan.unschedulable) == 3
+        tol = [Toleration("dedicated", "Equal", "gpu")]
+        problem = build_problem(generic_pods(3, tolerations=tol), [pool], lattice)
+        plan = solver.solve(problem)
+        assert not plan.unschedulable
+
+    def test_impossible_selector_unschedulable(self, solver, lattice):
+        pods = generic_pods(2, node_selector={wk.LABEL_INSTANCE_FAMILY: "does-not-exist"})
+        problem = build_problem(pods, [default_pool()], lattice)
+        plan = solver.solve(problem)
+        assert len(plan.unschedulable) == 2
+
+    def test_unknown_resource_isolated(self, solver, lattice):
+        pods = generic_pods(3) + [Pod(name="weird", requests={"hugepages-2Mi": "1Gi"})]
+        problem = build_problem(pods, [default_pool()], lattice)
+        plan = solver.solve(problem)
+        assert set(plan.unschedulable) == {"weird"}
+        assert sum(len(n.pods) for n in plan.new_nodes) == 3
+
+
+class TestAntiAffinity:
+    def test_hostname_anti_affinity_one_pod_per_node(self, solver, lattice):
+        """The 500-node scale-suite pattern: every pod its own node."""
+        pods = [
+            Pod(name=f"aa-{i}", labels={"app": "dense"},
+                requests={"cpu": "250m", "memory": "512Mi"},
+                pod_affinity=[PodAffinityTerm(topology_key=wk.LABEL_HOSTNAME,
+                                              label_selector=(("app", "dense"),), anti=True)])
+            for i in range(20)
+        ]
+        problem = build_problem(pods, [default_pool()], lattice)
+        plan = solver.solve(problem)
+        assert not plan.unschedulable
+        assert len(plan.new_nodes) == 20
+        assert all(len(n.pods) == 1 for n in plan.new_nodes)
+
+
+class TestExistingCapacity:
+    def test_fills_existing_first(self, solver, lattice):
+        lat = lattice
+        existing = [ExistingBin(
+            name="node-a", node_pool="default", instance_type="m5.4xlarge",
+            zone="us-west-2a", capacity_type="on-demand",
+            used=np.zeros(8, np.float32))]
+        problem = build_problem(generic_pods(4), [default_pool()], lat, existing=existing)
+        plan = solver.solve(problem)
+        assert plan.new_nodes == []
+        assert plan.existing_assignments == {"node-a": ["pod-0", "pod-1", "pod-2", "pod-3"]}
+
+    def test_overflow_to_new_node(self, solver, lattice):
+        existing = [ExistingBin(
+            name="node-a", node_pool="default", instance_type="m5.large",
+            zone="us-west-2a", capacity_type="on-demand",
+            used=np.zeros(8, np.float32))]
+        # m5.large alloc ~1930m cpu -> 3 pods of 500m fit (with memory to spare)
+        problem = build_problem(generic_pods(10), [default_pool()], lattice, existing=existing)
+        plan = solver.solve(problem)
+        on_existing = sum(len(v) for v in plan.existing_assignments.values())
+        on_new = sum(len(n.pods) for n in plan.new_nodes)
+        assert on_existing >= 1
+        assert on_existing + on_new == 10
+        assert_plan_valid(plan, problem)
+
+
+class TestNodePools:
+    def test_weight_order_preferred(self, solver, lattice):
+        heavy = default_pool(name="preferred", weight=100, requirements=[
+            Requirement(wk.LABEL_INSTANCE_FAMILY, Operator.IN, ("r5",))])
+        light = default_pool(name="fallback", weight=1)
+        problem = build_problem(generic_pods(3), [light, heavy], lattice)
+        plan = solver.solve(problem)
+        assert all(n.node_pool == "preferred" for n in plan.new_nodes)
+        assert all(n.instance_type.startswith("r5.") for n in plan.new_nodes)
+
+    def test_pool_requirements_respected(self, solver, lattice):
+        pool = default_pool(requirements=[
+            Requirement(wk.LABEL_ARCH, Operator.IN, ("arm64",))])
+        problem = build_problem(generic_pods(5), [pool], lattice)
+        plan = solver.solve(problem)
+        for n in plan.new_nodes:
+            assert n.instance_type.split(".")[0] in ("m6g", "c6g")
+
+    def test_custom_template_label_matching(self, solver, lattice):
+        pool_ml = default_pool(name="ml", labels={"example.com/team": "ml"})
+        pods = generic_pods(2, node_selector={"example.com/team": "ml"})
+        problem = build_problem(pods, [default_pool(), pool_ml], lattice)
+        plan = solver.solve(problem)
+        assert not plan.unschedulable
+        assert all(n.node_pool == "ml" for n in plan.new_nodes)
+
+
+class TestDaemonSets:
+    def test_daemonset_overhead_reserved(self, solver, lattice):
+        ds = [Pod(name="ds", requests={"cpu": "1500m", "memory": "1Gi"}, is_daemonset=True)]
+        pods = generic_pods(1, cpu="1", mem="1Gi")
+        problem = build_problem(pods, [default_pool()], lattice, daemonset_pods=ds)
+        plan = solver.solve(problem)
+        assert len(plan.new_nodes) == 1
+        ti = lattice.name_to_idx[plan.new_nodes[0].instance_type]
+        # node must hold pod + daemonset: 2500m cpu > m5.large's 1930m
+        assert lattice.alloc[ti][0] >= 2500
+
+
+class TestOracleParity:
+    """Randomized cost-parity: the device pack must stay within the 2%
+    envelope of sequential FFD (BASELINE.md), both directions checked."""
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_random_workloads(self, solver, lattice, seed):
+        rng = np.random.default_rng(seed)
+        pods = []
+        n_shapes = rng.integers(2, 8)
+        for s in range(n_shapes):
+            cpu = int(rng.choice([100, 250, 500, 1000, 2000, 4000]))
+            mem = int(rng.choice([128, 512, 1024, 2048, 8192]))
+            count = int(rng.integers(1, 60))
+            sel = {}
+            if rng.random() < 0.3:
+                sel[wk.LABEL_INSTANCE_CATEGORY] = str(rng.choice(["m", "c", "r"]))
+            if rng.random() < 0.2:
+                sel[wk.LABEL_CAPACITY_TYPE] = "on-demand"
+            pods += [Pod(name=f"s{s}-{i}", requests={"cpu": f"{cpu}m", "memory": f"{mem}Mi"},
+                         node_selector=sel) for i in range(count)]
+        pools = [default_pool(),
+                 default_pool(name="arm", weight=5, requirements=[
+                     Requirement(wk.LABEL_ARCH, Operator.IN, ("arm64",))])]
+        problem = build_problem(pods, pools, lattice)
+        plan = solver.solve(problem)
+        oracle = ffd_oracle(problem)
+        assert set(plan.unschedulable) == set(oracle.unschedulable)
+        placed = sum(len(n.pods) for n in plan.new_nodes) + \
+            sum(len(v) for v in plan.existing_assignments.values())
+        assert placed + len(plan.unschedulable) == len(pods)
+        assert_plan_valid(plan, problem)
+        assert plan.new_node_cost <= oracle.new_node_cost * 1.02 + 1e-6, (
+            f"kernel ${plan.new_node_cost:.4f} vs oracle ${oracle.new_node_cost:.4f}")
+
+
+class TestReviewRegressions:
+    def test_alloc_override_respected(self, solver, lattice):
+        """A real node reporting less allocatable than the lattice must not be overpacked."""
+        small = lattice.alloc[lattice.name_to_idx["m5.4xlarge"]] * np.float32(0.25)
+        existing = [ExistingBin(
+            name="node-a", node_pool="default", instance_type="m5.4xlarge",
+            zone="us-west-2a", capacity_type="on-demand",
+            used=np.zeros(8, np.float32), alloc_override=small)]
+        problem = build_problem(generic_pods(30, cpu="1"), [default_pool()], lattice,
+                                existing=existing)
+        plan = solver.solve(problem)
+        on_existing = sum(len(v) for v in plan.existing_assignments.values())
+        # 25% of 15.4 cpu => ~3 one-cpu pods max, never the full 30
+        assert 0 < on_existing <= 4
+        assert_plan_valid(plan, problem)
+
+    def test_fixed_bin_ignores_market_availability(self, solver, lattice):
+        """A running node accepts pods even if its offering is no longer for sale."""
+        import copy
+        lat = copy.deepcopy(lattice)
+        from karpenter_provider_aws_tpu.solver.solve import Solver as S
+        ti = lat.name_to_idx["m5.4xlarge"]
+        lat.available[ti] = False          # market dried up
+        lat.price[ti] = np.inf
+        s = S(lat)
+        existing = [ExistingBin(
+            name="node-a", node_pool="default", instance_type="m5.4xlarge",
+            zone="us-west-2a", capacity_type="on-demand",
+            used=np.zeros(8, np.float32))]
+        problem = build_problem(generic_pods(3), [default_pool()], lat, existing=existing)
+        plan = s.solve(problem)
+        assert sum(len(v) for v in plan.existing_assignments.values()) == 3
+        assert plan.new_nodes == []
+
+    def test_topology_spread_surfaces_warning(self, solver, lattice):
+        from karpenter_provider_aws_tpu.apis import TopologySpreadConstraint
+        pods = [Pod(name="p", requests={"cpu": "1"}, topology_spread=[
+            TopologySpreadConstraint(max_skew=1, topology_key=wk.LABEL_ZONE)])]
+        plan = solver.solve(build_problem(pods, [default_pool()], lattice))
+        assert any("topologySpread" in w for w in plan.warnings)

@@ -51,6 +51,10 @@ class BinState(NamedTuple):
     alloc_cap: jnp.ndarray  # [B,R] f32 per-bin allocatable ceiling (+inf for new
                             # bins; a real node's reported allocatable for fixed
                             # bins, which may differ from the lattice's)
+    pm: jnp.ndarray         # [B,A] i32 count of the bin's pods matching class a
+                            # (>0 = presence for affinity; exact count feeds the
+                            # hostname-spread skew cap)
+    po: jnp.ndarray         # [B,A] bool bin holds >=1 pod owning anti-affinity term a
     next_open: jnp.ndarray  # scalar i32 first unopened bin slot
 
 
@@ -63,7 +67,17 @@ class GroupBatch(NamedTuple):
     g_zone: jnp.ndarray   # [G,Z] bool
     g_cap: jnp.ndarray    # [G,C] bool
     g_np: jnp.ndarray        # [G,NP] bool
-    antiaff: jnp.ndarray     # [G] bool  (hostname self-anti-affinity: <=1 pod/bin)
+    max_per_bin: jnp.ndarray  # [G] i32 per-bin cap (hostname spread maxSkew /
+                              # self-anti-affinity=1; INT32_MAX = unlimited)
+    spread_class: jnp.ndarray  # [G] i32 class whose per-bin COUNT the cap tracks
+                               # (hostname spread selector; -1 = cap is per-row,
+                               # counts only this row's own placements)
+    single_bin: jnp.ndarray   # [G] bool all replicas must share one bin
+                              # (hostname self-affinity)
+    match: jnp.ndarray        # [G,A] bool affinity classes matching the group labels
+    owner: jnp.ndarray        # [G,A] bool hostname anti-affinity terms the group owns
+    need: jnp.ndarray         # [G,A] bool classes whose presence the bin must have
+                              # (hostname positive affinity)
     strict_custom: jnp.ndarray  # [G] bool: group has existence-requiring custom-key
                                 # constraints -> excluded from unknown-pool bins
 
@@ -85,7 +99,7 @@ class PackResult(NamedTuple):
     chosen_price: jnp.ndarray  # [B] f32 $/hr (+inf for fixed/empty bins)
 
 
-def empty_state(B: int, T: int, Z: int, C: int, R: int) -> BinState:
+def empty_state(B: int, T: int, Z: int, C: int, R: int, A: int = 1) -> BinState:
     return BinState(
         cum=jnp.zeros((B, R), jnp.float32),
         tmask=jnp.zeros((B, T), bool),
@@ -96,6 +110,8 @@ def empty_state(B: int, T: int, Z: int, C: int, R: int) -> BinState:
         open=jnp.zeros((B,), bool),
         fixed=jnp.zeros((B,), bool),
         alloc_cap=jnp.full((B, R), jnp.inf, jnp.float32),
+        pm=jnp.zeros((B, A), jnp.int32),
+        po=jnp.zeros((B, A), bool),
         next_open=jnp.array(0, jnp.int32),
     )
 
@@ -136,6 +152,14 @@ def _pack_step(alloc: jnp.ndarray, avail_f: jnp.ndarray, pools: PoolParams,
                       # unknown-pool bins: pool-agnostic, but never for groups
                       # with strict custom-key constraints we cannot verify
                       ~g.strict_custom)
+    # hostname (anti-)affinity: both directions of the k8s symmetry check —
+    # the bin may hold no pod the group anti-affines against, no pod whose
+    # anti term matches the group, and must hold every class the group needs
+    pm_pos = state.pm > 0                                      # [B,A]
+    conflict = ((pm_pos & g.owner[None, :]).any(axis=1)
+                | (state.po & g.match[None, :]).any(axis=1))   # [B]
+    need_ok = jnp.all(pm_pos | ~g.need[None, :], axis=1)       # [B]
+    aff_ok = ~conflict & need_ok
     # a running node needs no *market* availability — only new capacity does
     reachable = _offer_reachable(avail_f, zm, cm) | state.fixed[:, None]  # [B,T]
     # per-(bin,type) allocatable: lattice truth capped by the bin's own
@@ -143,11 +167,24 @@ def _pack_step(alloc: jnp.ndarray, avail_f: jnp.ndarray, pools: PoolParams,
     eff_alloc = jnp.minimum(alloc[None, :, :], state.alloc_cap[:, None, :])  # [B,T,R]
     headroom = eff_alloc - state.cum[:, None, :]               # [B,T,R]
     n_fit_t = _fit_counts(headroom, g.req)                     # [B,T]
-    valid_t = tm & reachable & np_ok[:, None] & state.open[:, None]
+    valid_t = tm & reachable & (np_ok & aff_ok & state.open)[:, None]
     n_fit = jnp.max(jnp.where(valid_t, n_fit_t, 0.0), axis=1).astype(jnp.int32)  # [B]
-    n_fit = jnp.where(g.antiaff, jnp.minimum(n_fit, 1), n_fit)
+    # hostname-spread cap: remaining allowance = maxSkew - pods of the spread
+    # class ALREADY in the bin (bound pods + sibling groups count); for
+    # class-less caps (self-anti-affinity) the bin history is covered by the
+    # affinity conflict check, so the row cap alone applies
+    A = state.pm.shape[1]
+    cls_cnt = state.pm[:, jnp.clip(g.spread_class, 0, A - 1)]  # [B]
+    allowance = jnp.where(g.spread_class >= 0,
+                          jnp.maximum(g.max_per_bin - cls_cnt, 0), g.max_per_bin)
+    n_fit = jnp.minimum(n_fit, allowance)
     prior = jnp.cumsum(n_fit) - n_fit                          # exclusive cumsum = first-fit order
-    take = jnp.clip(g.count - prior, 0, n_fit)                 # [B]
+    take_ff = jnp.clip(g.count - prior, 0, n_fit)              # [B]
+    # single-bin groups (hostname self-affinity): all replicas into the first
+    # bin that can hold any; the un-fitting remainder becomes leftover
+    can = n_fit > 0
+    is_first = (jnp.arange(B, dtype=jnp.int32) == jnp.argmax(can).astype(jnp.int32)) & jnp.any(can)
+    take = jnp.where(g.single_bin, jnp.where(is_first, jnp.minimum(g.count, n_fit), 0), take_ff)
     rem = g.count - jnp.sum(take)
 
     updated = take > 0
@@ -164,15 +201,22 @@ def _pack_step(alloc: jnp.ndarray, avail_f: jnp.ndarray, pools: PoolParams,
     n_per_t = _fit_counts(head_np, g.req)                      # [NP,T]
     valid_np_t = tm_np & reach_np & g.g_np[:, None]
     n_per_np = jnp.max(jnp.where(valid_np_t, n_per_t, 0.0), axis=1).astype(jnp.int32)  # [NP]
-    n_per_np = jnp.where(g.antiaff, jnp.minimum(n_per_np, 1), n_per_np)
+    n_per_np = jnp.minimum(n_per_np, g.max_per_bin)
     ok_np = n_per_np >= 1
     np_star = jnp.argmax(ok_np).astype(jnp.int32)              # first True (weight order)
     any_ok = jnp.any(ok_np)
     n_per = n_per_np[np_star]
 
-    want_new = (rem > 0) & any_ok
+    # a fresh (empty) bin satisfies presence requirements only by self-seeding:
+    # every needed class must match the group's own labels
+    seed_ok = jnp.all(g.match | ~g.need)
+    want_new = (rem > 0) & any_ok & seed_ok
+    # single-bin groups never straddle phase-1 bins + a new bin, and open at
+    # most one node
+    want_new &= ~(g.single_bin & (jnp.sum(take) > 0))
     n_per_safe = jnp.maximum(n_per, 1)
     n_new = jnp.where(want_new, -(-rem // n_per_safe), 0)      # ceil div
+    n_new = jnp.where(g.single_bin, jnp.minimum(n_new, 1), n_new)
     n_new = jnp.minimum(n_new, B - state.next_open)            # bucket overflow clamp
 
     idx = jnp.arange(B, dtype=jnp.int32)
@@ -194,6 +238,8 @@ def _pack_step(alloc: jnp.ndarray, avail_f: jnp.ndarray, pools: PoolParams,
     cmask2 = jnp.where(is_new[:, None], cm_np[np_star][None, :],
                        jnp.where(updated[:, None], cm, state.cmask))
 
+    n_placed = take + take_new                                 # [B] i32
+    placed = n_placed > 0
     new_state = BinState(
         cum=cum2,
         tmask=tmask2,
@@ -204,6 +250,8 @@ def _pack_step(alloc: jnp.ndarray, avail_f: jnp.ndarray, pools: PoolParams,
         open=state.open | is_new,
         fixed=state.fixed,
         alloc_cap=state.alloc_cap,
+        pm=state.pm + n_placed[:, None] * g.match[None, :].astype(jnp.int32),
+        po=state.po | (placed[:, None] & g.owner[None, :]),
         next_open=state.next_open + n_new,
     )
     leftover = rem - jnp.sum(take_new)

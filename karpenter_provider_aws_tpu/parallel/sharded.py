@@ -7,6 +7,8 @@ Distributed design (the CP/ring-attention slot of this build, SURVEY.md §5
    slice of every group's pod count (`split_counts`) and runs the full
    grouped-FFD scan locally against the replicated type lattice — a
    blockwise-greedy pack with zero cross-device traffic during the scan.
+   Groups whose pods must co-locate (hostname self-affinity) or join a
+   seeded bin (positive affinity) stay whole on one shard.
 2. **Reduce with ICI collectives.** Total cost / node counts / leftovers
    reduce with `psum`; per-device bin summaries `all_gather` for the host to
    merge. Blockwise packing can open fractionally-filled tail bins on every
@@ -23,7 +25,7 @@ N-device mesh.
 from __future__ import annotations
 
 from functools import partial
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -33,25 +35,39 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops import binpack
 
 
-def split_counts(count: np.ndarray, n_devices: int) -> np.ndarray:
-    """[G] pod counts -> [D,G] balanced split (device d gets ~count/D)."""
+def split_counts(count: np.ndarray, n_devices: int,
+                 keep_whole: Optional[np.ndarray] = None) -> np.ndarray:
+    """[G] pod counts -> [D,G] balanced split (device d gets ~count/D).
+
+    Groups flagged in ``keep_whole`` (co-location / presence-requiring
+    groups) are not split: each lands entirely on one shard, round-robin.
+    """
     base = count // n_devices
     extra = count % n_devices
     out = np.tile(base, (n_devices, 1))
     for d in range(n_devices):
         out[d] += (d < extra).astype(count.dtype)
+    if keep_whole is not None and keep_whole.any():
+        whole = np.nonzero(keep_whole)[0]
+        for i, g in enumerate(whole):
+            out[:, g] = 0
+            out[i % n_devices, g] = count[g]
     return out
 
 
 def _local_pack(alloc, avail, price, pools, req, count_shard, init_shard, g_type, g_zone,
-                g_cap, g_np, antiaff, strict_custom):
+                g_cap, g_np, max_per_bin, spread_class, single_bin, match, owner, need,
+                strict_custom):
     """Runs on each device over its pod-count shard; reduces over 'pods'."""
     count_local = count_shard.reshape(count_shard.shape[-1])  # [1,G] block -> [G]
     # each device gets its own bin table (existing capacity lives on shard 0
     # only — replicating it would fill the same physical nodes D times)
     init = binpack.BinState(*(x.reshape(x.shape[1:]) for x in init_shard))
     groups = binpack.GroupBatch(req=req, count=count_local, g_type=g_type,
-                                g_zone=g_zone, g_cap=g_cap, g_np=g_np, antiaff=antiaff,
+                                g_zone=g_zone, g_cap=g_cap, g_np=g_np,
+                                max_per_bin=max_per_bin, spread_class=spread_class,
+                                single_bin=single_bin,
+                                match=match, owner=owner, need=need,
                                 strict_custom=strict_custom)
     res = binpack.pack(alloc, avail, price, groups, pools, init)
     live = res.state.open & ~res.state.fixed & (res.state.npods > 0)
@@ -81,12 +97,11 @@ def sharded_pack(mesh: Mesh, alloc, avail, price, groups: binpack.GroupBatch,
     lattice per step); the bin table is sharded so existing capacity lives on
     shard 0 only.
     """
-    import numpy as np
-
     D = mesh.devices.size
     B = init.cum.shape[0]
     empty = binpack.empty_state(B, init.tmask.shape[1], init.zmask.shape[1],
-                                init.cmask.shape[1], init.cum.shape[1])
+                                init.cmask.shape[1], init.cum.shape[1],
+                                init.pm.shape[1])
     init_stack = binpack.BinState(*(
         jnp.concatenate([jnp.asarray(a)[None], jnp.broadcast_to(jnp.asarray(e)[None], (D - 1,) + e.shape)])
         if D > 1 else jnp.asarray(a)[None]
@@ -98,9 +113,11 @@ def sharded_pack(mesh: Mesh, alloc, avail, price, groups: binpack.GroupBatch,
         partial(_local_pack, alloc, avail, price, pools),
         mesh=mesh,
         in_specs=(repl, P("pods"), jax.tree.map(lambda _: P("pods"), empty),
-                  repl, repl, repl, repl, repl, repl),
+                  repl, repl, repl, repl, repl, repl, repl, repl, repl, repl, repl),
         out_specs=(P("pods"), repl, repl, repl, repl),
         check_vma=False,
     )
     return jax.jit(fn)(groups.req, count_split, init_stack, groups.g_type, groups.g_zone,
-                       groups.g_cap, groups.g_np, groups.antiaff, groups.strict_custom)
+                       groups.g_cap, groups.g_np, groups.max_per_bin, groups.spread_class,
+                       groups.single_bin, groups.match, groups.owner, groups.need,
+                       groups.strict_custom)

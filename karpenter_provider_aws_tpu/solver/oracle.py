@@ -4,9 +4,12 @@ A faithful, per-pod sequential reimplementation of the reference's scheduling
 algorithm (reference designs/bin-packing.md:16-43: sort pods by size
 descending; first-fit into existing simulated nodes; else open a new node
 from the highest-weight compatible NodePool; finally price each node at its
-cheapest compatible offering). Pure Python/numpy, deliberately simple — the
-regression referee for the device kernel's pack quality (the ≤2% cost
-envelope in BASELINE.md) and the semantics oracle for parity tests.
+cheapest compatible offering), including the hostname-scoped topology rules
+the kernel enforces (per-bin caps, affinity-class presence; zone/captype
+scoped rules are already resolved into the Problem's group rows). Pure
+Python/numpy, deliberately simple — the regression referee for the device
+kernel's pack quality (the ≤2% cost envelope in BASELINE.md) and the
+semantics oracle for parity tests.
 """
 
 from __future__ import annotations
@@ -28,6 +31,8 @@ class OracleBin:
     cmask: np.ndarray          # [C]
     pods: List[str] = field(default_factory=list)
     group_counts: Dict[int, int] = field(default_factory=dict)
+    pm: np.ndarray = None      # [A] i32 count of pods matching each class
+    po: np.ndarray = None      # [A] anti-term owners present
     existing_idx: Optional[int] = None   # fixed bin: index into problem.existing
 
     @property
@@ -51,6 +56,7 @@ def ffd_oracle(problem: Problem) -> OraclePlan:
     lat = problem.lattice
     alloc, avail, price = lat.alloc, lat.available, lat.price
     unschedulable = dict(problem.unschedulable)
+    A = problem.A
 
     bins: List[OracleBin] = []
     for ei in range(problem.E):
@@ -62,18 +68,26 @@ def ffd_oracle(problem: Problem) -> OraclePlan:
         cmask = np.zeros((lat.C,), dtype=bool)
         cmask[int(problem.e_cap[ei])] = True
         bins.append(OracleBin(np_idx=int(problem.e_np[ei]), cum=problem.e_used[ei].copy(),
-                              tmask=tmask, zmask=zmask, cmask=cmask, existing_idx=ei))
+                              tmask=tmask, zmask=zmask, cmask=cmask,
+                              pm=problem.e_pm[ei].copy() if A else np.zeros((0,), np.int32),
+                              po=problem.e_po[ei].copy() if A else np.zeros((0,), bool),
+                              existing_idx=ei))
 
     def type_has_offering(tm: np.ndarray, zm: np.ndarray, cm: np.ndarray) -> np.ndarray:
         """[T] bool: type compatible AND has an available offering in zm x cm."""
         return tm & (avail & zm[None, :, None] & cm[None, None, :]).any(axis=(1, 2))
 
+    single_bin_home: Dict[int, int] = {}  # group idx -> bin idx for single_bin groups
+
     # groups are already FFD-sorted; expand each group pod by pod
     for gi, group in enumerate(problem.groups):
+        cap = int(problem.max_per_bin[gi])
         for pod_name in group.pod_names:
             req = group.req
             placed = False
-            for b in bins:
+            for bi, b in enumerate(bins):
+                if group.single_bin and gi in single_bin_home and single_bin_home[gi] != bi:
+                    continue
                 if b.np_idx >= 0:
                     if not group.np_ok[b.np_idx]:
                         continue
@@ -82,8 +96,22 @@ def ffd_oracle(problem: Problem) -> OraclePlan:
                 elif group.strict_custom:
                     # unknown-pool node: cannot verify custom-label selectors
                     continue
-                if group.hostname_anti_affinity and b.group_counts.get(gi, 0) >= 1:
+                # per-bin cap: hostname spread tracks the whole class's
+                # count (bound + sibling groups, same as the kernel's pm);
+                # class-less caps (self-anti) count this row's placements
+                if group.spread_class >= 0:
+                    if b.pm[group.spread_class] >= cap:
+                        continue
+                elif b.group_counts.get(gi, 0) >= cap:
                     continue
+                if A:
+                    # k8s symmetry (same test as the kernel): bin holds no pod
+                    # we anti-affine against, no pod anti-affining against us,
+                    # and every class we need is present
+                    if ((b.pm > 0) & group.owner).any() or (b.po & group.match).any():
+                        continue
+                    if not np.all((b.pm > 0) | ~group.need):
+                        continue
                 if b.is_existing:
                     # fixed node: capacity check against its own allocatable
                     new_cum = b.cum + req
@@ -93,6 +121,11 @@ def ffd_oracle(problem: Problem) -> OraclePlan:
                         b.cum = new_cum
                         b.pods.append(pod_name)
                         b.group_counts[gi] = b.group_counts.get(gi, 0) + 1
+                        if A:
+                            b.pm += group.match.astype(np.int32)
+                            b.po |= group.owner
+                        if group.single_bin:
+                            single_bin_home[gi] = bi
                         placed = True
                         break
                     continue
@@ -106,9 +139,21 @@ def ffd_oracle(problem: Problem) -> OraclePlan:
                     b.cum, b.tmask, b.zmask, b.cmask = new_cum, fits, zm, cm
                     b.pods.append(pod_name)
                     b.group_counts[gi] = b.group_counts.get(gi, 0) + 1
+                    if A:
+                        b.pm += group.match.astype(np.int32)
+                        b.po |= group.owner
+                    if group.single_bin:
+                        single_bin_home[gi] = bi
                     placed = True
                     break
             if placed:
+                continue
+            if group.single_bin and gi in single_bin_home:
+                unschedulable[pod_name] = "does not fit any existing node or new-node shape"
+                continue
+            # a fresh bin satisfies presence needs only by self-seeding
+            if A and not np.all(group.match | ~group.need):
+                unschedulable[pod_name] = "does not fit any existing node or new-node shape"
                 continue
             # open a new node: highest-weight compatible pool with a feasible type
             for pi in np.nonzero(group.np_ok)[0]:
@@ -120,8 +165,13 @@ def ffd_oracle(problem: Problem) -> OraclePlan:
                 fits = tm & (alloc >= cum[None, :] - 1e-3).all(axis=1)
                 fits = type_has_offering(fits, zm, cm)
                 if fits.any():
-                    bins.append(OracleBin(np_idx=pi, cum=cum, tmask=fits, zmask=zm, cmask=cm,
-                                          pods=[pod_name], group_counts={gi: 1}))
+                    nb = OracleBin(np_idx=pi, cum=cum, tmask=fits, zmask=zm, cmask=cm,
+                                   pods=[pod_name], group_counts={gi: 1},
+                                   pm=group.match.astype(np.int32) if A else np.zeros((0,), np.int32),
+                                   po=group.owner.copy() if A else np.zeros((0,), bool))
+                    bins.append(nb)
+                    if group.single_bin:
+                        single_bin_home[gi] = len(bins) - 1
                     placed = True
                     break
             if not placed:

@@ -5,16 +5,20 @@ a time, inside its Go scheduler loop (core provisioner; see SURVEY.md §2.2).
 Here:
 
 1. Pods are **deduplicated into groups** by scheduling signature (requests +
-   constraints + tolerations + self-anti-affinity). 50k pods from a handful
-   of deployments collapse to a handful of groups — the key observation that
-   makes the packing scan short on device.
+   labels + constraints + tolerations + affinity + spread). 50k pods from a
+   handful of deployments collapse to a handful of groups — the key
+   observation that makes the packing scan short on device.
 2. Each group's requirements compile to boolean masks over the lattice axes
    (ops/masks.py) and to a per-NodePool compatibility row (host-side exact
    algebra, incl. taints/tolerations, custom template labels, minValues).
-3. NodePools compile to their own masks, daemonset overhead vectors, and a
+3. Topology constraints resolve per solver/topology.py: zone/capacity-type
+   scoped ones split groups into per-domain subgroups host-side; hostname
+   scoped ones compile to per-row caps + affinity-class matrices the kernel
+   enforces with per-bin presence masks.
+4. NodePools compile to their own masks, daemonset overhead vectors, and a
    weight-descending order (the order the reference tries pools,
    nodepools.md:161-163).
-4. Existing capacity (in-flight NodeClaims / registered nodes) becomes
+5. Existing capacity (in-flight NodeClaims / registered nodes) becomes
    pre-initialized bins so the solver fills real headroom before opening new
    nodes — the reference simulates against in-flight nodes the same way.
 
@@ -34,6 +38,7 @@ from ..apis.requirements import Requirements
 from ..apis.resources import R, resources_to_vec_checked
 from ..lattice.tensors import Lattice
 from ..ops.masks import _AXIS_KEYS, _CAT_KEY_INDEX, _NUM_KEY_INDEX, compile_masks
+from .topology import _BIG, BoundPod, ClassRegistry, resolve_group_topology
 
 
 @dataclass
@@ -60,8 +65,13 @@ class PodGroup:
     zone_mask: np.ndarray          # [Z]
     cap_mask: np.ndarray           # [C]
     np_ok: np.ndarray              # [NP] bool
-    hostname_anti_affinity: bool
     requirements: Requirements     # merged pod-level requirements (for claims)
+    max_per_bin: int = _BIG        # hostname spread / self-anti-affinity cap
+    spread_class: int = -1         # class whose per-bin count the cap tracks
+    single_bin: bool = False       # hostname self-affinity: all replicas co-locate
+    match: np.ndarray = None       # [A] selector classes matching this group's labels
+    owner: np.ndarray = None       # [A] hostname anti-affinity terms owned
+    need: np.ndarray = None        # [A] hostname affinity presence requirements
     strict_custom: bool = False    # has existence-requiring custom-key constraints
                                    # (resolvable only via a known pool's labels)
 
@@ -80,7 +90,12 @@ class Problem:
     g_zone: np.ndarray             # [G,Z] bool
     g_cap: np.ndarray              # [G,C] bool
     g_np: np.ndarray               # [G,NP] bool
-    antiaff: np.ndarray            # [G] bool
+    max_per_bin: np.ndarray        # [G] i32
+    g_spread: np.ndarray           # [G] i32 spread class (-1 = none)
+    single_bin: np.ndarray         # [G] bool
+    g_match: np.ndarray            # [G,A] bool
+    g_owner: np.ndarray            # [G,A] bool
+    g_need: np.ndarray             # [G,A] bool
     strict_custom: np.ndarray      # [G] bool
     # nodepool arrays
     np_type: np.ndarray            # [NP,T] bool
@@ -94,6 +109,8 @@ class Problem:
     e_zone: np.ndarray             # [E] i32
     e_cap: np.ndarray              # [E] i32
     e_np: np.ndarray               # [E] i32 nodepool index (-1 unknown)
+    e_pm: np.ndarray               # [E,A] i32 count of bound pods matching class a
+    e_po: np.ndarray               # [E,A] bool bin holds a bound pod owning anti-term a
     warnings: List[str] = field(default_factory=list)  # unsupported-constraint notices
 
     @property
@@ -107,6 +124,10 @@ class Problem:
     @property
     def E(self) -> int:
         return len(self.existing)
+
+    @property
+    def A(self) -> int:
+        return self.g_match.shape[1] if self.g_match.ndim == 2 else 0
 
 
 def _custom_keys_ok(reqs: Requirements, pool_labels: Mapping[str, str]) -> bool:
@@ -125,23 +146,16 @@ def _custom_keys_ok(reqs: Requirements, pool_labels: Mapping[str, str]) -> bool:
     return True
 
 
-def _is_self_hostname_anti_affinity(pod: Pod) -> bool:
-    """Does the pod anti-affine against its own replicas per hostname
-    (the 1-pod-per-node pattern, scale suite provisioning_test.go:82-118)?"""
-    for term in pod.pod_affinity:
-        if term.anti and term.topology_key == wk.LABEL_HOSTNAME:
-            sel = dict(term.label_selector)
-            if all(pod.labels.get(k) == v for k, v in sel.items()):
-                return True
-    return False
-
-
 def _group_signature(pod: Pod) -> str:
     reqs = pod.scheduling_requirements()
     parts = [repr(sorted(pod.requests.items()))]
+    parts.append(repr(sorted(pod.labels.items())))
     parts.append(repr(reqs))
     parts.append(repr(sorted((t.key, t.operator, t.value, t.effect) for t in pod.tolerations)))
-    parts.append(repr(_is_self_hostname_anti_affinity(pod)))
+    parts.append(repr(sorted(
+        (t.topology_key, t.anti, tuple(sorted(t.label_selector)))
+        for t in pod.pod_affinity
+    )))
     parts.append(repr(sorted(
         (c.topology_key, c.max_skew, c.when_unsatisfiable, tuple(sorted(c.label_selector)))
         for c in pod.topology_spread
@@ -151,11 +165,13 @@ def _group_signature(pod: Pod) -> str:
 
 def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: Lattice,
                   existing: Sequence[ExistingBin] = (),
-                  daemonset_pods: Sequence[Pod] = ()) -> Problem:
+                  daemonset_pods: Sequence[Pod] = (),
+                  bound_pods: Sequence[BoundPod] = ()) -> Problem:
     pools = sorted(node_pools, key=lambda p: (-p.weight, p.name))
     NP = len(pools)
     T, Z, C = lattice.T, lattice.Z, lattice.C
     key_values = lattice.key_values_present()
+    warnings: List[str] = []
 
     # --- NodePool masks + daemonset overhead
     np_type = np.ones((NP, T), dtype=bool)
@@ -184,9 +200,9 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
                 continue
             ds_overhead[pi] += vec
 
-    # --- group pods
+    # --- group pods by scheduling signature
     unschedulable: Dict[str, str] = {}
-    groups_by_sig: Dict[str, PodGroup] = {}
+    raw_groups: Dict[str, Tuple[Pod, List[str]]] = {}
     order: List[str] = []
     for pod in pods:
         vec, unknown = resources_to_vec_checked(pod.requests, implicit_pod=True)
@@ -194,18 +210,34 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
             unschedulable[pod.name] = f"unknown resource(s): {', '.join(unknown)}"
             continue
         sig = _group_signature(pod)
-        g = groups_by_sig.get(sig)
-        if g is not None:
-            g.pod_names.append(pod.name)
-            continue
-        reqs = pod.scheduling_requirements()
+        if sig in raw_groups:
+            raw_groups[sig][1].append(pod.name)
+        else:
+            raw_groups[sig] = (pod, [pod.name])
+            order.append(sig)
+
+    # --- per raw group: masks, pool compatibility, topology resolution
+    registry = ClassRegistry()
+    # bound pods' hostname anti-affinity terms must be classes too — the k8s
+    # symmetry check keeps pending matches OFF nodes whose resident pods own
+    # such terms, even when no pending pod references the selector
+    for bp in bound_pods:
+        for term in bp.pod.pod_affinity:
+            if term.anti and term.topology_key == wk.LABEL_HOSTNAME:
+                registry.intern(tuple(term.label_selector))
+    groups: List[PodGroup] = []
+    pending_topo: List[Tuple[PodGroup, Pod, np.ndarray, np.ndarray]] = []  # group, rep, owner, need
+    for sig in order:
+        rep, names = raw_groups[sig]
+        vec, _ = resources_to_vec_checked(rep.requests, implicit_pod=True)
+        reqs = rep.scheduling_requirements()
         # custom-key constraints resolve exactly per-pool in np_ok below
         masks = compile_masks(reqs, lattice, skip_unresolved_custom=True)
         np_ok = np.zeros((NP,), dtype=bool)
         for pi, pool in enumerate(pools):
             if not reqs.intersects(pool_reqs[pi]):
                 continue
-            if not tolerates_all(pod.tolerations, pool.taints + pool.startup_taints):
+            if not tolerates_all(rep.tolerations, pool.taints + pool.startup_taints):
                 continue
             if not _custom_keys_ok(reqs, pool.labels):
                 continue
@@ -219,16 +251,41 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
             and not reqs.get(key).allows_absent
             for key in reqs.keys()
         )
-        g = PodGroup(
-            signature=sig, pod_names=[pod.name], req=vec,
-            type_mask=masks.type_mask, zone_mask=masks.zone_mask, cap_mask=masks.cap_mask,
-            np_ok=np_ok, hostname_anti_affinity=_is_self_hostname_anti_affinity(pod),
-            requirements=reqs, strict_custom=strict,
-        )
-        groups_by_sig[sig] = g
-        order.append(sig)
 
-    groups = [groups_by_sig[s] for s in order]
+        splits, topo, cut = resolve_group_topology(
+            rep, len(names), masks.zone_mask, masks.cap_mask,
+            lattice.zones, lattice.capacity_types, registry, bound_pods, warnings)
+        if cut > 0:
+            for name in names[len(names) - cut:]:
+                unschedulable[name] = "zone anti-affinity: more replicas than eligible zones"
+            names = names[: len(names) - cut]
+        cursor = 0
+        for s in splits:
+            sub_names = names[cursor: cursor + s.count]
+            cursor += s.count
+            if not sub_names:
+                continue
+            g = PodGroup(
+                signature=sig, pod_names=sub_names, req=vec,
+                type_mask=masks.type_mask, zone_mask=s.zone_mask, cap_mask=s.cap_mask,
+                np_ok=np_ok, requirements=reqs,
+                max_per_bin=topo.max_per_bin, spread_class=topo.spread_class,
+                single_bin=topo.single_bin,
+                strict_custom=strict,
+            )
+            groups.append(g)
+            pending_topo.append((g, rep, topo.owner, topo.need))
+
+    # --- finalize affinity-class rows at full registry width
+    A = registry.A
+    for g, rep, owner, need in pending_topo:
+        g.match = registry.match_row(rep.labels) if A else np.zeros((0,), dtype=bool)
+        g.owner = np.zeros((A,), dtype=bool)
+        g.need = np.zeros((A,), dtype=bool)
+        if owner is not None and owner.size:
+            g.owner[: owner.size] = owner
+        if need is not None and need.size:
+            g.need[: need.size] = need
 
     # mark groups with no feasible (pool, type, offering) at all
     schedulable_groups: List[PodGroup] = []
@@ -250,12 +307,15 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
     groups = schedulable_groups
 
     # --- FFD order: dominant normalized request, descending (the grouped
-    # equivalent of the reference's pods-sorted-by-size FFD loop)
+    # equivalent of the reference's pods-sorted-by-size FFD loop).
+    # Groups with presence requirements (need) must come after potential
+    # seeders, so they sort by a secondary "needs-presence" key.
     if groups:
         mean_alloc = np.maximum(lattice.alloc.mean(axis=0), 1e-6)  # [R]
         def ffd_key(g: PodGroup):
             norm = g.req / mean_alloc
-            return (-float(norm.max()), -float(g.req[0]), -float(g.req[1]), g.signature)
+            return (bool(g.need.any()), -float(norm.max()), -float(g.req[0]),
+                    -float(g.req[1]), g.signature)
         groups.sort(key=ffd_key)
 
     G = len(groups)
@@ -265,24 +325,13 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
     g_zone = np.stack([g.zone_mask for g in groups]) if G else np.zeros((0, Z), bool)
     g_cap = np.stack([g.cap_mask for g in groups]) if G else np.zeros((0, C), bool)
     g_np = np.stack([g.np_ok for g in groups]) if G else np.zeros((0, NP), bool)
-    antiaff = np.array([g.hostname_anti_affinity for g in groups], dtype=bool)
+    max_per_bin = np.array([min(g.max_per_bin, _BIG) for g in groups], dtype=np.int32)
+    g_spread = np.array([g.spread_class for g in groups], dtype=np.int32)
+    single_bin = np.array([g.single_bin for g in groups], dtype=bool)
+    g_match = np.stack([g.match for g in groups]) if G else np.zeros((0, A), bool)
+    g_owner = np.stack([g.owner for g in groups]) if G else np.zeros((0, A), bool)
+    g_need = np.stack([g.need for g in groups]) if G else np.zeros((0, A), bool)
     strict_custom = np.array([g.strict_custom for g in groups], dtype=bool)
-
-    # surface constraints the solver does not yet enforce instead of silently
-    # violating them (topology spread + non-self pod affinity land with the
-    # topology milestone)
-    warnings = []
-    seen_warn = set()
-    for pod in pods:
-        if pod.topology_spread and "spread" not in seen_warn:
-            seen_warn.add("spread")
-            warnings.append("topologySpreadConstraints not yet enforced by the solver")
-        for term in pod.pod_affinity:
-            supported = (term.anti and term.topology_key == wk.LABEL_HOSTNAME
-                         and all(pod.labels.get(k) == v for k, v in dict(term.label_selector).items()))
-            if not supported and "affinity" not in seen_warn:
-                seen_warn.add("affinity")
-                warnings.append("pod (anti-)affinity beyond hostname self-anti-affinity not yet enforced")
 
     # --- existing bins
     E = len(existing)
@@ -292,9 +341,12 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
     e_zone = np.zeros((E,), np.int32)
     e_cap = np.zeros((E,), np.int32)
     e_np = np.full((E,), -1, np.int32)
+    e_pm = np.zeros((E, A), np.int32)
+    e_po = np.zeros((E, A), bool)
     pool_index = {p.name: i for i, p in enumerate(pools)}
     zone_index = {z: i for i, z in enumerate(lattice.zones)}
     cap_index = {c: i for i, c in enumerate(lattice.capacity_types)}
+    bin_index = {b.name: i for i, b in enumerate(existing)}
     for ei, b in enumerate(existing):
         ti = lattice.name_to_idx[b.instance_type]
         e_used[ei] = b.used
@@ -303,13 +355,29 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
         e_zone[ei] = zone_index[b.zone]
         e_cap[ei] = cap_index[b.capacity_type]
         e_np[ei] = pool_index.get(b.node_pool, -1)
+    # seed affinity-class presence on existing bins from bound pods
+    if A:
+        for bp in bound_pods:
+            ei = bin_index.get(bp.node_name)
+            if ei is None:
+                continue
+            e_pm[ei] += registry.match_row(bp.pod.labels).astype(np.int32)
+            for term in bp.pod.pod_affinity:
+                if term.anti and term.topology_key == wk.LABEL_HOSTNAME:
+                    key = tuple(sorted(term.label_selector))
+                    a = registry.index.get(key)
+                    if a is not None:
+                        e_po[ei, a] = True
 
     return Problem(
         lattice=lattice, node_pools=pools, groups=groups, existing=list(existing),
         unschedulable=unschedulable,
         req=req.astype(np.float32), count=count, g_type=g_type, g_zone=g_zone,
-        g_cap=g_cap, g_np=g_np, antiaff=antiaff, strict_custom=strict_custom,
+        g_cap=g_cap, g_np=g_np, max_per_bin=max_per_bin, g_spread=g_spread,
+        single_bin=single_bin,
+        g_match=g_match, g_owner=g_owner, g_need=g_need, strict_custom=strict_custom,
         warnings=warnings,
         np_type=np_type, np_zone=np_zone, np_cap=np_cap, ds_overhead=ds_overhead,
-        e_used=e_used, e_alloc=e_alloc, e_type=e_type, e_zone=e_zone, e_cap=e_cap, e_np=e_np,
+        e_used=e_used, e_alloc=e_alloc, e_type=e_type, e_zone=e_zone, e_cap=e_cap,
+        e_np=e_np, e_pm=e_pm, e_po=e_po,
     )

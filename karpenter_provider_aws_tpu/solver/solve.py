@@ -82,11 +82,12 @@ class Solver:
 
     def _padded_groups(self, problem: Problem, G: int) -> binpack.GroupBatch:
         lat = self.lattice
+        A = max(problem.A, 1)
 
-        def pad(a: np.ndarray, shape, dtype):
-            out = np.zeros(shape, dtype)
+        def pad(a: np.ndarray, shape, dtype, fill=0):
+            out = np.full(shape, fill, dtype)
             if a.size:
-                out[: a.shape[0]] = a
+                out[tuple(slice(0, s) for s in a.shape)] = a
             return jnp.asarray(out)
 
         g = problem
@@ -97,7 +98,12 @@ class Solver:
             g_zone=pad(g.g_zone, (G, lat.Z), bool),
             g_cap=pad(g.g_cap, (G, lat.C), bool),
             g_np=pad(g.g_np, (G, max(g.NP, 1)), bool),
-            antiaff=pad(g.antiaff, (G,), bool),
+            max_per_bin=pad(g.max_per_bin, (G,), np.int32),
+            spread_class=pad(g.g_spread, (G,), np.int32, fill=-1),
+            single_bin=pad(g.single_bin, (G,), bool),
+            match=pad(g.g_match, (G, A), bool),
+            owner=pad(g.g_owner, (G, A), bool),
+            need=pad(g.g_need, (G, A), bool),
             strict_custom=pad(g.strict_custom, (G,), bool),
         )
 
@@ -121,7 +127,8 @@ class Solver:
     def _init_state(self, problem: Problem, B: int) -> binpack.BinState:
         lat = self.lattice
         E = problem.E
-        state = binpack.empty_state(B, lat.T, lat.Z, lat.C, R)
+        A = max(problem.A, 1)
+        state = binpack.empty_state(B, lat.T, lat.Z, lat.C, R, A)
         if E == 0:
             return state
         cum = np.zeros((B, R), np.float32)
@@ -132,6 +139,8 @@ class Solver:
         open_ = np.zeros((B,), bool)
         fixed = np.zeros((B,), bool)
         alloc_cap = np.full((B, R), np.inf, np.float32)
+        pm = np.zeros((B, A), np.int32)
+        po = np.zeros((B, A), bool)
         cum[:E] = problem.e_used
         tmask[np.arange(E), problem.e_type] = True
         zmask[np.arange(E), problem.e_zone] = True
@@ -140,11 +149,15 @@ class Solver:
         open_[:E] = True
         fixed[:E] = True
         alloc_cap[:E] = problem.e_alloc  # real node allocatable wins over lattice
+        if problem.A:
+            pm[:E, : problem.A] = problem.e_pm
+            po[:E, : problem.A] = problem.e_po
         return binpack.BinState(
             cum=jnp.asarray(cum), tmask=jnp.asarray(tmask), zmask=jnp.asarray(zmask),
             cmask=jnp.asarray(cmask), np_id=jnp.asarray(np_id),
             npods=jnp.zeros((B,), jnp.int32), open=jnp.asarray(open_),
             fixed=jnp.asarray(fixed), alloc_cap=jnp.asarray(alloc_cap),
+            pm=jnp.asarray(pm), po=jnp.asarray(po),
             next_open=jnp.array(E, jnp.int32),
         )
 
@@ -157,7 +170,13 @@ class Solver:
                             time.perf_counter() - t0, 0.0)
         G = _bucket(problem.G, _G_BUCKETS)
         total_pods = int(problem.count.sum())
-        b_needed = problem.E + min(total_pods, int(problem.antiaff.any()) * total_pods + 64)
+        # bins needed ≈ one per group plus the per-bin-capped tail (hostname
+        # spread / anti-affinity forces ~count/max_per_bin bins per group);
+        # the overflow retry below corrects underestimates
+        caps = np.minimum(problem.max_per_bin.astype(np.int64),
+                          np.maximum(problem.count.astype(np.int64), 1))
+        capped_bins = int(np.ceil(problem.count / np.maximum(caps, 1)).sum()) if problem.G else 0
+        b_needed = problem.E + min(total_pods, capped_bins + 64)
         B = _bucket(max(b_needed, problem.E + 1), _B_BUCKETS, clamp=True)
 
         groups = self._padded_groups(problem, G)

@@ -309,9 +309,9 @@ class TestReviewRegressions:
         assert sum(len(v) for v in plan.existing_assignments.values()) == 3
         assert plan.new_nodes == []
 
-    def test_topology_spread_surfaces_warning(self, solver, lattice):
+    def test_unsupported_topology_key_surfaces_warning(self, solver, lattice):
         from karpenter_provider_aws_tpu.apis import TopologySpreadConstraint
         pods = [Pod(name="p", requests={"cpu": "1"}, topology_spread=[
-            TopologySpreadConstraint(max_skew=1, topology_key=wk.LABEL_ZONE)])]
+            TopologySpreadConstraint(max_skew=1, topology_key="example.com/rack")])]
         plan = solver.solve(build_problem(pods, [default_pool()], lattice))
-        assert any("topologySpread" in w for w in plan.warnings)
+        assert any("not supported" in w for w in plan.warnings)

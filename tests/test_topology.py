@@ -1,0 +1,364 @@
+"""Topology spread + pod (anti-)affinity semantics (BASELINE configs 2-3).
+
+Behavioral spec: reference website concepts/scheduling.md:312-446 — zonal /
+hostname / capacity-type topologySpreadConstraints, required podAffinity and
+podAntiAffinity, both directions of the k8s symmetry check. Each test
+validates the decoded NodePlan directly (skew bounds, co-location,
+separation) and, where meaningful, parity with the per-pod FFD oracle.
+"""
+
+from collections import Counter, defaultdict
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.apis import NodePool, Pod
+from karpenter_provider_aws_tpu.apis.objects import PodAffinityTerm, TopologySpreadConstraint
+from karpenter_provider_aws_tpu.apis import wellknown as wk
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+from karpenter_provider_aws_tpu.solver import ExistingBin, Solver, build_problem, ffd_oracle
+from karpenter_provider_aws_tpu.solver.topology import BoundPod, _water_fill
+
+_FAMILIES = ("m5", "c5", "r5", "t3")
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    specs = [s for s in build_catalog() if s.family in _FAMILIES]
+    return build_lattice(specs)
+
+
+@pytest.fixture(scope="module")
+def solver(lattice):
+    return Solver(lattice)
+
+
+def spread_pods(n, key=wk.LABEL_ZONE, max_skew=1, labels=None, prefix="sp", **kw):
+    labels = labels or {"app": "web"}
+    return [Pod(name=f"{prefix}-{i}", labels=dict(labels),
+                requests={"cpu": "500m", "memory": "1Gi"},
+                topology_spread=[TopologySpreadConstraint(
+                    max_skew=max_skew, topology_key=key,
+                    label_selector=tuple(labels.items()))], **kw)
+            for i in range(n)]
+
+
+def zone_of_pod(plan):
+    """pod name -> zone from the decoded plan (new nodes only)."""
+    out = {}
+    for node in plan.new_nodes:
+        for p in node.pods:
+            out[p] = node.zone
+    return out
+
+
+def node_of_pod(plan):
+    out = {}
+    for i, node in enumerate(plan.new_nodes):
+        for p in node.pods:
+            out[p] = i
+    for name, pods in plan.existing_assignments.items():
+        for p in pods:
+            out[p] = name
+    return out
+
+
+class TestWaterFill:
+    def test_even_split(self):
+        assert _water_fill(np.zeros(3, np.int64), 9).tolist() == [3, 3, 3]
+
+    def test_tops_up_lowest_first(self):
+        # zones at 5,1,0 + 7 new pods -> levels equalize toward (5,4,4)
+        add = _water_fill(np.array([5, 1, 0]), 7)
+        final = np.array([5, 1, 0]) + add
+        assert add.sum() == 7
+        assert final.max() - final.min() <= 1
+
+    def test_tail_round_robin(self):
+        add = _water_fill(np.array([2, 2]), 5)
+        assert add.sum() == 5
+        assert abs(add[0] - add[1]) <= 1
+
+    def test_zero_pods(self):
+        assert _water_fill(np.array([3, 1]), 0).tolist() == [0, 0]
+
+
+class TestZoneSpread:
+    def test_even_spread_across_zones(self, solver, lattice):
+        pods = spread_pods(12)
+        problem = build_problem(pods, [NodePool(name="default")], lattice)
+        plan = solver.solve(problem)
+        assert not plan.unschedulable
+        zones = Counter(zone_of_pod(plan).values())
+        assert sum(zones.values()) == 12
+        assert max(zones.values()) - min(zones.values()) <= 1
+        assert len(zones) == lattice.Z
+
+    def test_spread_counts_bound_pods(self, solver, lattice):
+        """Existing replicas skew the domain counts; new pods top up the rest."""
+        labels = {"app": "web"}
+        bound = [BoundPod(pod=Pod(name=f"b{i}", labels=dict(labels)),
+                          node_name=f"n{i}", zone=lattice.zones[0])
+                 for i in range(4)]
+        pods = spread_pods(4, labels=labels)
+        problem = build_problem(pods, [NodePool(name="default")], lattice,
+                                bound_pods=bound)
+        plan = solver.solve(problem)
+        zones = Counter(zone_of_pod(plan).values())
+        # all 4 new pods avoid the already-loaded zone 0
+        assert zones.get(lattice.zones[0], 0) == 0
+        assert sum(zones.values()) == 4
+
+    def test_selector_scopes_the_spread(self, solver, lattice):
+        """Pods outside the label selector don't participate in the spread."""
+        pods = spread_pods(6, labels={"app": "a"})
+        other = [Pod(name=f"o-{i}", labels={"app": "b"},
+                     requests={"cpu": "500m", "memory": "1Gi"}) for i in range(5)]
+        problem = build_problem(pods + other, [NodePool(name="default")], lattice)
+        plan = solver.solve(problem)
+        zones = Counter(z for p, z in zone_of_pod(plan).items() if p.startswith("sp-"))
+        assert max(zones.values()) - min(zones.values()) <= 1
+
+
+class TestHostnameSpread:
+    def test_max_skew_caps_pods_per_node(self, solver, lattice):
+        pods = spread_pods(9, key=wk.LABEL_HOSTNAME, max_skew=2)
+        problem = build_problem(pods, [NodePool(name="default")], lattice)
+        plan = solver.solve(problem)
+        assert not plan.unschedulable
+        per_node = Counter(node_of_pod(plan).values())
+        assert max(per_node.values()) <= 2
+        assert sum(per_node.values()) == 9
+
+    def test_hostname_spread_parity_with_oracle(self, solver, lattice):
+        pods = spread_pods(10, key=wk.LABEL_HOSTNAME, max_skew=1)
+        problem = build_problem(pods, [NodePool(name="default")], lattice)
+        plan = solver.solve(problem)
+        oracle = ffd_oracle(problem)
+        assert len(plan.new_nodes) == oracle.num_new_nodes == 10
+        assert plan.new_node_cost <= oracle.new_node_cost * 1.02 + 1e-6
+
+
+class TestCapacityTypeSpread:
+    def test_spread_across_capacity_types(self, solver, lattice):
+        pods = spread_pods(8, key=wk.LABEL_CAPACITY_TYPE)
+        problem = build_problem(pods, [NodePool(name="default")], lattice)
+        plan = solver.solve(problem)
+        caps = Counter(n.capacity_type for n in plan.new_nodes for _ in n.pods)
+        assert sum(caps.values()) == 8
+        assert max(caps.values()) - min(caps.values()) <= 1 or len(caps) == lattice.C
+
+
+class TestPodAntiAffinity:
+    def test_cross_class_never_share_node(self, solver, lattice):
+        """web anti-affines redis on hostname: no node may hold both."""
+        web = [Pod(name=f"w{i}", labels={"app": "web"},
+                   requests={"cpu": "250m", "memory": "256Mi"},
+                   pod_affinity=[PodAffinityTerm(topology_key=wk.LABEL_HOSTNAME,
+                                                 label_selector=(("app", "redis"),),
+                                                 anti=True)])
+               for i in range(6)]
+        redis = [Pod(name=f"r{i}", labels={"app": "redis"},
+                     requests={"cpu": "250m", "memory": "256Mi"}) for i in range(6)]
+        problem = build_problem(web + redis, [NodePool(name="default")], lattice)
+        plan = solver.solve(problem)
+        assert not plan.unschedulable
+        by_node = defaultdict(set)
+        for p, n in node_of_pod(plan).items():
+            by_node[n].add(p[0])  # 'w' or 'r'
+        for kinds in by_node.values():
+            assert kinds != {"w", "r"}, "anti-affine classes co-located"
+
+    def test_symmetry_blocks_reverse_direction(self, solver, lattice):
+        """redis owns no term, but web's anti-term must still keep redis out
+        of web's nodes when redis packs later (k8s symmetry)."""
+        web = [Pod(name=f"w{i}", labels={"app": "web"},
+                   requests={"cpu": "4", "memory": "8Gi"},
+                   pod_affinity=[PodAffinityTerm(topology_key=wk.LABEL_HOSTNAME,
+                                                 label_selector=(("app", "redis"),),
+                                                 anti=True)])
+               for i in range(2)]
+        redis = [Pod(name=f"r{i}", labels={"app": "redis"},
+                     requests={"cpu": "100m", "memory": "128Mi"}) for i in range(4)]
+        problem = build_problem(web + redis, [NodePool(name="default")], lattice)
+        plan = solver.solve(problem)
+        by_node = defaultdict(set)
+        for p, n in node_of_pod(plan).items():
+            by_node[n].add(p[0])
+        for kinds in by_node.values():
+            assert kinds != {"w", "r"}
+
+    def test_self_anti_zone_limited_by_domains(self, solver, lattice):
+        """Zone self-anti-affinity: one replica per zone; surplus unschedulable."""
+        labels = {"app": "quorum"}
+        pods = [Pod(name=f"q{i}", labels=dict(labels),
+                    requests={"cpu": "500m", "memory": "1Gi"},
+                    pod_affinity=[PodAffinityTerm(topology_key=wk.LABEL_ZONE,
+                                                  label_selector=tuple(labels.items()),
+                                                  anti=True)])
+                for i in range(lattice.Z + 2)]
+        problem = build_problem(pods, [NodePool(name="default")], lattice)
+        plan = solver.solve(problem)
+        zones = zone_of_pod(plan)
+        assert len(set(zones.values())) == len(zones) == lattice.Z
+        assert len(plan.unschedulable) == 2
+
+
+class TestPodAffinity:
+    def test_hostname_self_affinity_colocates(self, solver, lattice):
+        labels = {"app": "pair"}
+        pods = [Pod(name=f"p{i}", labels=dict(labels),
+                    requests={"cpu": "500m", "memory": "512Mi"},
+                    pod_affinity=[PodAffinityTerm(topology_key=wk.LABEL_HOSTNAME,
+                                                  label_selector=tuple(labels.items()))])
+                for i in range(4)]
+        problem = build_problem(pods, [NodePool(name="default")], lattice)
+        plan = solver.solve(problem)
+        assert not plan.unschedulable
+        nodes = set(node_of_pod(plan).values())
+        assert len(nodes) == 1, "self-affine replicas must share one node"
+
+    def test_zone_self_affinity_pins_one_zone(self, solver, lattice):
+        labels = {"app": "zonal"}
+        pods = [Pod(name=f"p{i}", labels=dict(labels),
+                    requests={"cpu": "2", "memory": "4Gi"},
+                    pod_affinity=[PodAffinityTerm(topology_key=wk.LABEL_ZONE,
+                                                  label_selector=tuple(labels.items()))])
+                for i in range(10)]
+        problem = build_problem(pods, [NodePool(name="default")], lattice)
+        plan = solver.solve(problem)
+        assert not plan.unschedulable
+        assert len(set(zone_of_pod(plan).values())) == 1
+
+    def test_cross_class_joins_bound_node(self, solver, lattice):
+        """A pod requiring presence of 'cache' joins the existing node that
+        already runs a cache pod."""
+        cache_pod = Pod(name="cache-0", labels={"app": "cache"})
+        existing = [ExistingBin(
+            name="node-a", node_pool="default", instance_type="m5.2xlarge",
+            zone=lattice.zones[0], capacity_type="on-demand",
+            used=np.zeros(8, np.float32))]
+        bound = [BoundPod(pod=cache_pod, node_name="node-a", zone=lattice.zones[0])]
+        follower = [Pod(name="f0", labels={"app": "follower"},
+                        requests={"cpu": "500m", "memory": "1Gi"},
+                        pod_affinity=[PodAffinityTerm(topology_key=wk.LABEL_HOSTNAME,
+                                                      label_selector=(("app", "cache"),))])]
+        problem = build_problem(follower, [NodePool(name="default")], lattice,
+                                existing=existing, bound_pods=bound)
+        plan = solver.solve(problem)
+        assert plan.existing_assignments.get("node-a") == ["f0"]
+        assert not plan.new_nodes
+        assert not plan.unschedulable
+
+    def test_cross_class_unseedable_is_unschedulable(self, solver, lattice):
+        """Presence requirement with no seeded bin and no self-match cannot
+        open a fresh node."""
+        follower = [Pod(name="f0", labels={"app": "follower"},
+                        requests={"cpu": "500m", "memory": "1Gi"},
+                        pod_affinity=[PodAffinityTerm(topology_key=wk.LABEL_HOSTNAME,
+                                                      label_selector=(("app", "cache"),))])]
+        problem = build_problem(follower, [NodePool(name="default")], lattice)
+        plan = solver.solve(problem)
+        assert "f0" in plan.unschedulable
+
+
+class TestConfig3Composite:
+    def test_anti_affinity_plus_spread_mix(self, solver, lattice):
+        """BASELINE config-3 shape (scaled down): anti-affinity + zonal and
+        hostname topology spread together."""
+        web = spread_pods(30, key=wk.LABEL_ZONE, labels={"app": "web"}, prefix="web")
+        api = spread_pods(20, key=wk.LABEL_HOSTNAME, max_skew=2,
+                          labels={"app": "api"}, prefix="api")
+        singleton = [Pod(name=f"s{i}", labels={"app": "s"},
+                         requests={"cpu": "1", "memory": "2Gi"},
+                         pod_affinity=[PodAffinityTerm(topology_key=wk.LABEL_HOSTNAME,
+                                                       label_selector=(("app", "s"),),
+                                                       anti=True)])
+                     for i in range(5)]
+        problem = build_problem(web + api + singleton, [NodePool(name="default")], lattice)
+        plan = solver.solve(problem)
+        assert not plan.unschedulable
+        zones = Counter(z for p, z in zone_of_pod(plan).items() if p.startswith("web"))
+        assert max(zones.values()) - min(zones.values()) <= 1
+        per_node_api = Counter(n for p, n in node_of_pod(plan).items() if p.startswith("api"))
+        assert max(per_node_api.values()) <= 2
+        nodes_s = [n for p, n in node_of_pod(plan).items() if p.startswith("s")]
+        assert len(set(nodes_s)) == 5
+        # pack quality: within the 2% envelope of the per-pod oracle
+        oracle = ffd_oracle(problem)
+        assert plan.new_node_cost <= oracle.new_node_cost * 1.02 + 1e-6
+
+
+class TestReviewRegressions:
+    def test_bound_pod_anti_term_blocks_pending_match(self, solver, lattice):
+        """A resident pod owning a hostname anti-term keeps pending matches
+        off its node even when no pending pod references that selector."""
+        guard = Pod(name="guard", labels={"app": "guard"},
+                    pod_affinity=[PodAffinityTerm(topology_key=wk.LABEL_HOSTNAME,
+                                                  label_selector=(("app", "web"),),
+                                                  anti=True)])
+        existing = [ExistingBin(
+            name="node-a", node_pool="default", instance_type="m5.4xlarge",
+            zone=lattice.zones[0], capacity_type="on-demand",
+            used=np.zeros(8, np.float32))]
+        bound = [BoundPod(pod=guard, node_name="node-a", zone=lattice.zones[0])]
+        web = [Pod(name=f"w{i}", labels={"app": "web"},
+                   requests={"cpu": "500m", "memory": "1Gi"}) for i in range(3)]
+        problem = build_problem(web, [NodePool(name="default")], lattice,
+                                existing=existing, bound_pods=bound)
+        plan = solver.solve(problem)
+        assert "node-a" not in plan.existing_assignments
+        assert sum(len(n.pods) for n in plan.new_nodes) == 3
+
+    def test_hostname_spread_counts_bound_pods(self, solver, lattice):
+        """maxSkew cap accounts for matching pods already on an existing node."""
+        labels = {"app": "web"}
+        existing = [ExistingBin(
+            name="node-a", node_pool="default", instance_type="m5.4xlarge",
+            zone=lattice.zones[0], capacity_type="on-demand",
+            used=np.zeros(8, np.float32))]
+        bound = [BoundPod(pod=Pod(name=f"b{i}", labels=dict(labels)),
+                          node_name="node-a", zone=lattice.zones[0]) for i in range(2)]
+        pods = spread_pods(4, key=wk.LABEL_HOSTNAME, max_skew=2, labels=labels)
+        problem = build_problem(pods, [NodePool(name="default")], lattice,
+                                existing=existing, bound_pods=bound)
+        plan = solver.solve(problem)
+        # node-a is already at the cap (2 bound matches): nothing new lands there
+        assert "node-a" not in plan.existing_assignments
+        per_node = Counter(node_of_pod(plan).values())
+        assert max(per_node.values()) <= 2
+
+    def test_hostname_spread_counts_sibling_groups(self, solver, lattice):
+        """Two deployments sharing labels (distinct requests) share the
+        per-node skew budget."""
+        labels = {"app": "web"}
+        a = spread_pods(4, key=wk.LABEL_HOSTNAME, max_skew=2, labels=labels, prefix="a")
+        b = [Pod(name=f"b-{i}", labels=dict(labels),
+                 requests={"cpu": "250m", "memory": "512Mi"},
+                 topology_spread=[TopologySpreadConstraint(
+                     max_skew=2, topology_key=wk.LABEL_HOSTNAME,
+                     label_selector=tuple(labels.items()))]) for i in range(4)]
+        problem = build_problem(a + b, [NodePool(name="default")], lattice)
+        plan = solver.solve(problem)
+        assert not plan.unschedulable
+        per_node = Counter(node_of_pod(plan).values())
+        assert max(per_node.values()) <= 2
+
+    def test_capacity_spread_global_across_zone_splits(self, solver, lattice):
+        """Zone spread x capacity-type spread: the captype skew bound is
+        global, not per zone split."""
+        labels = {"app": "web"}
+        pods = [Pod(name=f"p{i}", labels=dict(labels),
+                    requests={"cpu": "500m", "memory": "1Gi"},
+                    topology_spread=[
+                        TopologySpreadConstraint(max_skew=1, topology_key=wk.LABEL_ZONE,
+                                                 label_selector=tuple(labels.items())),
+                        TopologySpreadConstraint(max_skew=1,
+                                                 topology_key=wk.LABEL_CAPACITY_TYPE,
+                                                 label_selector=tuple(labels.items()))])
+                for i in range(9)]
+        problem = build_problem(pods, [NodePool(name="default")], lattice)
+        plan = solver.solve(problem)
+        caps = Counter(n.capacity_type for n in plan.new_nodes for _ in n.pods)
+        assert sum(caps.values()) == 9
+        assert max(caps.values()) - min(caps.values()) <= 1

@@ -80,5 +80,17 @@ def vec_to_resources(vec: np.ndarray) -> Dict[str, float]:
     return {name: float(vec[i]) for i, name in enumerate(RESOURCE_AXES) if vec[i] != 0}
 
 
+def canonical_to_vec(resources: Mapping[str, float]) -> np.ndarray:
+    """Canonical-unit map (cpu millicores, memory MiB — e.g. a NodeClaim's
+    status.capacity round-tripped through vec_to_resources) → vector.
+    No quantity parsing: values are already in axis units."""
+    vec = np.zeros((R,), dtype=np.float32)
+    for name, qty in resources.items():
+        idx = _AXIS_INDEX.get(name)
+        if idx is not None:
+            vec[idx] = float(qty)
+    return vec
+
+
 def axis(name: str) -> int:
     return _AXIS_INDEX[name]

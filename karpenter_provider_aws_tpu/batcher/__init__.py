@@ -1,0 +1,3 @@
+from .batcher import Batcher, BatcherOptions
+
+__all__ = ["Batcher", "BatcherOptions"]

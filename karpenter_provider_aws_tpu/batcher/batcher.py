@@ -1,0 +1,120 @@
+"""Generic request coalescer.
+
+Mirror of the reference's hash-bucketed batcher (reference
+pkg/batcher/batcher.go:61-131): concurrent callers Add() individual
+requests; a worker collects them until an idle window elapses with no new
+arrivals, a max window elapses, or the batch hits max_items, then executes
+one fused call and fans results back out. The reference coalesces
+CreateFleet at 35 ms idle / 1 s max / 1000 items
+(createfleet.go:70-72) and DescribeInstances at 100 ms / 1 s / 500
+(describeinstances.go:185-187); this framework reuses the same windows for
+the fake-cloud launch/terminate paths AND as the device-batch admission
+window in front of Solve() (SURVEY.md §2.3).
+
+Requests are bucketed by an options hash so only like-for-like requests
+fuse (the reference hashes everything but the instance-id list).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Callable, Dict, Generic, Hashable, List, Sequence, Tuple, TypeVar
+
+T = TypeVar("T")  # request
+U = TypeVar("U")  # response
+
+
+@dataclass
+class BatcherOptions:
+    idle_seconds: float = 0.035   # CreateFleet window (createfleet.go:70)
+    max_seconds: float = 1.0
+    max_items: int = 1000
+
+
+class _Bucket(Generic[T, U]):
+    def __init__(self, opts: BatcherOptions,
+                 batch_fn: Callable[[List[T]], Sequence[U]]):
+        self.opts = opts
+        self.batch_fn = batch_fn
+        self.pending: List[Tuple[T, Future]] = []
+        self.wakeup = threading.Event()
+        self.lock = threading.Lock()
+        self.thread: threading.Thread = None
+        self.started_at: float = 0.0
+
+    def run(self):
+        import time
+        while True:
+            time_left = self.opts.max_seconds - (time.monotonic() - self.started_at)
+            self.wakeup.clear()
+            fired = self.wakeup.wait(timeout=min(self.opts.idle_seconds, max(time_left, 0.0)))
+            with self.lock:
+                if len(self.pending) >= self.opts.max_items:
+                    fired = False
+                    time_left = 0.0
+            if fired and time_left > 0:
+                continue  # new arrival inside the idle window: keep coalescing
+            with self.lock:
+                batch, self.pending = self.pending, []
+                self.thread = None
+            self._execute(batch)
+            return
+
+    def _execute(self, batch: List[Tuple[T, Future]]):
+        inputs = [b[0] for b in batch]
+        try:
+            results = self.batch_fn(inputs)
+        except BaseException as e:  # fan the failure out to every caller
+            for _, fut in batch:
+                fut.set_exception(e)
+            return
+        if len(results) != len(batch):
+            err = RuntimeError(
+                f"batch_fn returned {len(results)} results for {len(batch)} requests")
+            for _, fut in batch:
+                fut.set_exception(err)
+            return
+        for (_, fut), res in zip(batch, results):
+            if isinstance(res, BaseException):
+                fut.set_exception(res)
+            else:
+                fut.set_result(res)
+
+
+class Batcher(Generic[T, U]):
+    """``batch_fn(requests) -> responses`` (positionally aligned; a response
+    may be an exception instance to fail just that caller)."""
+
+    def __init__(self, batch_fn: Callable[[List[T]], Sequence[U]],
+                 options: BatcherOptions = None,
+                 hasher: Callable[[T], Hashable] = None):
+        self.batch_fn = batch_fn
+        self.opts = options or BatcherOptions()
+        self.hasher = hasher or (lambda _req: 0)
+        self._buckets: Dict[Hashable, _Bucket] = {}
+        self._lock = threading.Lock()
+
+    def add(self, request: T, timeout: float = 30.0) -> U:
+        """Block until the fused call completes; return this request's result."""
+        import time
+        fut: Future = Future()
+        key = self.hasher(request)
+        with self._lock:
+            bucket = self._buckets.get(key)
+            if bucket is None or bucket.thread is None:
+                bucket = _Bucket(self.opts, self.batch_fn)
+                self._buckets[key] = bucket
+        with bucket.lock:
+            if bucket.thread is None:
+                bucket.started_at = time.monotonic()
+                bucket.thread = threading.Thread(target=bucket.run, daemon=True)
+                start = True
+            else:
+                start = False
+            bucket.pending.append((request, fut))
+            bucket.wakeup.set()
+        if start:
+            bucket.thread.start()
+        return fut.result(timeout=timeout)

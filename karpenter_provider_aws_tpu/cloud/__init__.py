@@ -1,0 +1,3 @@
+from .fake import CloudInstance, FakeCloud, LaunchOverride
+
+__all__ = ["FakeCloud", "CloudInstance", "LaunchOverride"]

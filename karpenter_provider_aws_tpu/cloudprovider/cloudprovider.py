@@ -1,0 +1,312 @@
+"""The CloudProvider plugin boundary.
+
+Mirror of the reference's six-method seam between the core scheduler and
+the cloud (reference pkg/cloudprovider/cloudprovider.go:56-212): Create,
+Delete, Get, List, GetInstanceTypes, IsDrifted (+ LivenessProbe). This is
+the boundary the TPU solver hides behind — the provisioner's NodePlan
+becomes NodeClaims, and each claim's launch resolves here.
+
+Launch semantics mirror the reference instance provider
+(pkg/providers/instance/instance.go):
+- capacity type = spot iff the claim allows spot and a spot offering
+  exists (instance.go:356-372),
+- spot overrides pricier than the cheapest on-demand are dropped
+  (instance.go:413-437),
+- metal/GPU/accelerator types are dropped when a generic type also fits
+  and the claim doesn't ask for them (instance.go:439-463),
+- overrides are the (type x zone) cross-product sorted by price, capped at
+  60 types; the fleet picks the cheapest available pool,
+- insufficient-capacity errors feed the UnavailableOfferings cache
+  (instance.go:348-354) before propagating,
+- launches coalesce through the request batcher (35 ms idle window,
+  reference batcher/createfleet.go:70-72).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..apis import wellknown as wk
+from ..apis.objects import NodeClaim, NodeClaimPhase, NodeClass, NodePool
+from ..apis.requirements import Requirements
+from ..apis.resources import RESOURCE_AXES, axis
+from ..batcher import Batcher, BatcherOptions
+from ..cache.unavailable import UnavailableOfferings
+from ..cloud.fake import CloudInstance, FakeCloud, LaunchOverride, parse_instance_id
+from ..errors import NotFoundError, UnfulfillableCapacityError
+from ..events import Recorder
+from ..lattice.tensors import Lattice
+from ..ops.masks import compile_masks
+from ..utils.clock import Clock
+
+MAX_INSTANCE_TYPES = 60            # instance.go:50
+FLEXIBILITY_THRESHOLD = 5          # instance.go:52 (OD-fallback warning)
+
+
+def nodeclass_hash(nc: NodeClass) -> str:
+    """Static spec hash for drift detection (reference
+    pkg/apis/v1beta1/ec2nodeclass.go:338-344 Hash + drift.go:137-151)."""
+    payload = json.dumps({
+        "ami_family": nc.ami_family, "user_data": nc.user_data, "role": nc.role,
+        "instance_profile": nc.instance_profile, "tags": sorted(nc.tags.items()),
+        "metadata_options": vars(nc.metadata_options),
+        "block_device_mappings": nc.block_device_mappings,
+        "detailed_monitoring": nc.detailed_monitoring,
+        "associate_public_ip": nc.associate_public_ip,
+    }, sort_keys=True, default=str)
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+@dataclass
+class OfferingView:
+    zone: str
+    capacity_type: str
+    price: float
+    available: bool
+
+
+@dataclass
+class InstanceType:
+    """Per-type view the scheduler-facing API returns (reference
+    pkg/providers/instancetype/types.go:56-66 {Name, Requirements,
+    Offerings, Capacity, Overhead})."""
+
+    name: str
+    labels: Dict[str, str]
+    capacity: Dict[str, float]
+    allocatable: Dict[str, float]
+    offerings: List[OfferingView] = field(default_factory=list)
+
+
+def _resources_dict(vec: np.ndarray) -> Dict[str, float]:
+    return {name: float(vec[i]) for name, i in
+            ((n, axis(n)) for n in RESOURCE_AXES) if vec[i] > 0}
+
+
+class CloudProvider:
+    """The plugin seam; backed by the pluggable cloud (FakeCloud by default)."""
+
+    name = "tpu-sim"
+
+    def __init__(self, lattice: Lattice, cloud: FakeCloud,
+                 unavailable: UnavailableOfferings,
+                 recorder: Optional[Recorder] = None,
+                 clock: Optional[Clock] = None,
+                 node_classes: Optional[Dict[str, NodeClass]] = None,
+                 batch_options: Optional[BatcherOptions] = None):
+        self.lattice = lattice
+        self.cloud = cloud
+        self.unavailable = unavailable
+        self.recorder = recorder or Recorder(clock)
+        self.clock = clock or Clock()
+        self.node_classes: Dict[str, NodeClass] = node_classes or {"default": NodeClass(name="default")}
+        self._launch_batcher: Batcher = Batcher(
+            self._launch_batch, batch_options or BatcherOptions(idle_seconds=0.005))
+        self._terminate_batcher: Batcher = Batcher(
+            self._terminate_batch, batch_options or BatcherOptions(idle_seconds=0.005))
+        self._lock = threading.Lock()
+
+    # ---- Create ----------------------------------------------------------
+
+    def create(self, claim: NodeClaim) -> NodeClaim:
+        """Launch capacity satisfying the claim's requirements
+        (cloudprovider.go:80-109 → instance.go:84-244)."""
+        overrides = self._resolve_overrides(claim)
+        if not overrides:
+            raise UnfulfillableCapacityError(offerings=[])
+        if (overrides[0].capacity_type == wk.CAPACITY_TYPE_SPOT
+                and len({o.instance_type for o in overrides}) < FLEXIBILITY_THRESHOLD):
+            self.recorder.publish(
+                "Warning", "SpotFlexibilityLow", "NodeClaim", claim.name,
+                f"launching spot with {len({o.instance_type for o in overrides})} instance "
+                f"types; >= {FLEXIBILITY_THRESHOLD} recommended for reliable fallback")
+        try:
+            instance = self._launch_batcher.add(tuple(overrides))
+        except UnfulfillableCapacityError as e:
+            self.unavailable.mark_unavailable_for_error(e)
+            self.recorder.publish("Warning", "InsufficientCapacity", "NodeClaim",
+                                  claim.name, str(e))
+            raise
+        return self._instance_to_claim(instance, claim)
+
+    def _launch_batch(self, batch: List[Tuple[LaunchOverride, ...]]) -> List[object]:
+        """Coalesced launch: one locked pass over the fake fleet API
+        (reference coalesces N single-instance requests into one CreateFleet
+        with capacity N and splits results back, createfleet.go:67-130)."""
+        out: List[object] = []
+        for overrides in batch:
+            try:
+                out.append(self.cloud.create_fleet(list(overrides)))
+            except BaseException as e:
+                out.append(e)
+        return out
+
+    def _resolve_overrides(self, claim: NodeClaim) -> List[LaunchOverride]:
+        lat = self.lattice
+        reqs = claim.scheduling_requirements()
+        masks = compile_masks(reqs, lat, extra_labels=claim.labels)
+        offer = (lat.available
+                 & masks.type_mask[:, None, None]
+                 & masks.zone_mask[None, :, None]
+                 & masks.cap_mask[None, None, :]
+                 & self.unavailable.mask(lat))
+        if not offer.any():
+            return []
+        # capacity type: spot iff allowed and offered (instance.go:356-372)
+        spot_ci = lat.capacity_types.index(wk.CAPACITY_TYPE_SPOT) if wk.CAPACITY_TYPE_SPOT in lat.capacity_types else -1
+        od_ci = lat.capacity_types.index(wk.CAPACITY_TYPE_ON_DEMAND) if wk.CAPACITY_TYPE_ON_DEMAND in lat.capacity_types else -1
+        use_spot = spot_ci >= 0 and offer[:, :, spot_ci].any()
+        ci = spot_ci if use_spot else od_ci
+        if ci < 0:
+            return []
+        # price filter: spot overrides pricier than the cheapest on-demand
+        # offering are never worth launching (instance.go:413-437)
+        price_cap = np.inf
+        if use_spot and od_ci >= 0 and offer[:, :, od_ci].any():
+            price_cap = float(np.where(offer[:, :, od_ci], lat.price[:, :, od_ci], np.inf).min())
+        # exotic-type filter (instance.go:439-463): drop metal/gpu/accelerator
+        # types when a generic type fits and the claim doesn't require them,
+        # unless minValues forbids narrowing (instance.go:86-89)
+        tmask = offer[:, :, ci].any(axis=1)
+        has_min_values = any(r.min_values is not None for r in reqs.requirements)
+        if not has_min_values:
+            wants_gpu = any(claim.resource_requests.get(r, 0) > 0
+                            for r in ("nvidia.com/gpu", "aws.amazon.com/neuron"))
+            generic = np.array([
+                lat.specs[t].gpu_count == 0 and lat.specs[t].accelerator_count == 0
+                and lat.specs[t].size != "metal"
+                for t in range(lat.T)])
+            if not wants_gpu and (tmask & generic).any():
+                tmask = tmask & generic
+        overrides: List[LaunchOverride] = []
+        for t in np.nonzero(tmask)[0]:
+            for z in np.nonzero(offer[t, :, ci])[0]:
+                p = float(lat.price[t, z, ci])
+                if p > price_cap:
+                    continue
+                overrides.append(LaunchOverride(
+                    instance_type=lat.names[t], zone=lat.zones[z],
+                    capacity_type=lat.capacity_types[ci], price=p))
+        overrides.sort(key=lambda o: o.price)
+        # cap the *type* flexibility at 60 like CreateFleet (instance.go:50)
+        seen_types: Dict[str, None] = {}
+        capped: List[LaunchOverride] = []
+        for o in overrides:
+            if o.instance_type not in seen_types and len(seen_types) >= MAX_INSTANCE_TYPES:
+                continue
+            seen_types.setdefault(o.instance_type, None)
+            capped.append(o)
+        return capped
+
+    def _instance_to_claim(self, instance: CloudInstance, claim: NodeClaim) -> NodeClaim:
+        """instance → NodeClaim status (cloudprovider.go:282-325)."""
+        lat = self.lattice
+        ti = lat.name_to_idx[instance.instance_type]
+        claim.provider_id = instance.provider_id
+        claim.instance_type = instance.instance_type
+        claim.zone = instance.zone
+        claim.capacity_type = instance.capacity_type
+        claim.capacity = _resources_dict(lat.capacity[ti])
+        claim.allocatable = _resources_dict(lat.alloc[ti])
+        claim.labels = {
+            **lat.labels[ti],
+            **claim.labels,
+            wk.LABEL_INSTANCE_TYPE: instance.instance_type,
+            wk.LABEL_ZONE: instance.zone,
+            wk.LABEL_CAPACITY_TYPE: instance.capacity_type,
+            wk.LABEL_NODEPOOL: claim.node_pool,
+        }
+        nc = self.node_classes.get(claim.node_class_ref)
+        if nc is not None:
+            claim.annotations[wk.ANNOTATION_NODECLASS_HASH] = nodeclass_hash(nc)
+        claim.phase = NodeClaimPhase.LAUNCHED
+        claim.launched_at = self.clock.now()
+        return claim
+
+    # ---- Delete / Get / List --------------------------------------------
+
+    def delete(self, claim: NodeClaim) -> None:
+        if claim.provider_id is None:
+            raise NotFoundError(f"claim {claim.name} has no provider id")
+        iid = parse_instance_id(claim.provider_id)
+        self._terminate_batcher.add(iid)
+
+    def _terminate_batch(self, ids: List[str]) -> List[object]:
+        """Coalesced terminate (reference batcher/terminateinstances.go)."""
+        results: List[object] = []
+        known = {i.id for i in self.cloud.list_instances(include_terminated=True)}
+        present = [i for i in ids if i in known]
+        if present:
+            self.cloud.terminate_instances(present)
+        for i in ids:
+            results.append(None if i in known else NotFoundError(f"instance not found: {i}"))
+        return results
+
+    def get(self, provider_id: str) -> CloudInstance:
+        iid = parse_instance_id(provider_id)
+        found = self.cloud.describe_instances([iid])
+        if not found or found[0].state == "terminated":
+            raise NotFoundError(f"instance not found: {iid}")
+        return found[0]
+
+    def list_instances(self) -> List[CloudInstance]:
+        return self.cloud.list_instances()
+
+    # ---- GetInstanceTypes ------------------------------------------------
+
+    def get_instance_types(self, pool: NodePool) -> List[InstanceType]:
+        """The scheduler's lattice feed (cloudprovider.go:149-169), with
+        per-offering availability reflecting the ICE cache."""
+        lat = self.lattice
+        reqs = pool.scheduling_requirements()
+        masks = compile_masks(reqs, lat, extra_labels=pool.labels)
+        ice = self.unavailable.mask(lat)
+        out: List[InstanceType] = []
+        for t in np.nonzero(masks.type_mask)[0]:
+            offerings = []
+            for z in range(lat.Z):
+                for c in range(lat.C):
+                    if not lat.available[t, z, c]:
+                        continue
+                    offerings.append(OfferingView(
+                        zone=lat.zones[z], capacity_type=lat.capacity_types[c],
+                        price=float(lat.price[t, z, c]),
+                        available=bool(ice[t, z, c] and masks.zone_mask[z] and masks.cap_mask[c])))
+            out.append(InstanceType(
+                name=lat.names[t], labels=dict(lat.labels[t]),
+                capacity=_resources_dict(lat.capacity[t]),
+                allocatable=_resources_dict(lat.alloc[t]),
+                offerings=offerings))
+        return out
+
+    # ---- IsDrifted -------------------------------------------------------
+
+    def is_drifted(self, claim: NodeClaim) -> Optional[str]:
+        """Drift reasons (reference pkg/cloudprovider/drift.go:44-151):
+        NodeClassDrift on static-hash mismatch; InstanceDrift when the
+        backing instance disappeared."""
+        nc = self.node_classes.get(claim.node_class_ref)
+        if nc is not None:
+            want = nodeclass_hash(nc)
+            have = claim.annotations.get(wk.ANNOTATION_NODECLASS_HASH)
+            if have is not None and have != want:
+                return "NodeClassDrift"
+        if claim.provider_id is not None:
+            try:
+                self.get(claim.provider_id)
+            except NotFoundError:
+                return "InstanceDrift"
+        return None
+
+    def liveness_probe(self) -> bool:
+        try:
+            self.cloud.list_instances()
+            return True
+        except Exception:
+            return False

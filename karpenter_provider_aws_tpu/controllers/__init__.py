@@ -1,0 +1,7 @@
+from .provisioning import Provisioner
+from .lifecycle import LifecycleController
+from .garbagecollection import GarbageCollectionController
+from .termination import TerminationController
+
+__all__ = ["Provisioner", "LifecycleController", "GarbageCollectionController",
+           "TerminationController"]

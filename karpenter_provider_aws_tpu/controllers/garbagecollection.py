@@ -1,0 +1,64 @@
+"""Leaked-capacity garbage collection.
+
+Mirror of the reference's nodeclaim GC controller (reference
+pkg/controllers/nodeclaim/garbagecollection/controller.go:55-89): cloud
+instances older than 30 s with no matching NodeClaim are terminated
+(launch succeeded but the claim write was lost), and claims whose backing
+instance disappeared are removed so their pods reschedule.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..apis.objects import NodeClaimPhase
+from ..cloud.fake import parse_instance_id
+from ..cloudprovider.cloudprovider import CloudProvider
+from ..errors import NotFoundError
+from ..events import Recorder
+from ..state.cluster import ClusterState
+from ..utils.clock import Clock
+
+LEAK_GRACE_SECONDS = 30.0  # garbagecollection/controller.go:64
+
+
+class GarbageCollectionController:
+    def __init__(self, cluster: ClusterState, cloud_provider: CloudProvider,
+                 recorder: Optional[Recorder] = None, clock: Optional[Clock] = None):
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.clock = clock or Clock()
+        self.recorder = recorder or Recorder(self.clock)
+
+    def reconcile(self) -> None:
+        now = self.clock.now()
+        claimed_ids = set()
+        for claim in list(self.cluster.claims.values()):
+            if claim.provider_id is None:
+                continue
+            iid = parse_instance_id(claim.provider_id)
+            claimed_ids.add(iid)
+            # claim whose instance vanished out from under it -> delete the
+            # claim (+node) so its pods reschedule
+            try:
+                self.cloud_provider.get(claim.provider_id)
+            except NotFoundError:
+                self.recorder.publish("Warning", "InstanceDisappeared", "NodeClaim",
+                                      claim.name, f"instance {iid} is gone")
+                node = self.cluster.node_for_claim(claim.name)
+                if node is not None:
+                    self.cluster.unbind_pods_on(node.name)
+                    self.cluster.delete_node(node.name)
+                self.cluster.delete_claim(claim.name)
+        # leaked instances: running but unclaimed past the grace window
+        for inst in self.cloud_provider.list_instances():
+            if inst.id in claimed_ids or inst.state == "terminated":
+                continue
+            if now - inst.launch_time < LEAK_GRACE_SECONDS:
+                continue
+            self.recorder.publish("Warning", "LeakedInstance", "Instance", inst.id,
+                                  "terminating instance with no nodeclaim")
+            try:
+                self.cloud_provider.cloud.terminate_instances([inst.id])
+            except NotFoundError:
+                pass

@@ -1,0 +1,87 @@
+"""NodeClaim lifecycle: launch → register → initialize, with liveness GC.
+
+Mirror of the core nodeclaim lifecycle state machine (reference: NodeClaim
+CRD status conditions, metrics karpenter_nodeclaims_{launched,registered,
+initialized} per website reference/metrics.md:76-97). The simulated kubelet
+registers a Node a configurable delay after launch (stratum-2 "no real
+cluster" testing, like the reference's envtest + fake EC2); claims that
+never register within the liveness TTL are deleted and relaunched by the
+next provisioning pass (core's 15-minute registration liveness).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import List, Optional
+
+from ..apis.objects import Node, NodeClaim, NodeClaimPhase
+from ..cloudprovider.cloudprovider import CloudProvider
+from ..errors import NotFoundError
+from ..events import Recorder
+from ..state.cluster import ClusterState
+from ..utils.clock import Clock
+
+REGISTRATION_TTL = 15 * 60.0   # core liveness: claims must register in 15 min
+
+
+class LifecycleController:
+    def __init__(self, cluster: ClusterState, cloud_provider: CloudProvider,
+                 recorder: Optional[Recorder] = None, clock: Optional[Clock] = None,
+                 registration_delay: float = 5.0):
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.clock = clock or Clock()
+        self.recorder = recorder or Recorder(self.clock)
+        self.registration_delay = registration_delay
+
+    def reconcile(self) -> None:
+        now = self.clock.now()
+        for claim in list(self.cluster.claims.values()):
+            if claim.deletion_timestamp:
+                continue
+            if claim.phase == NodeClaimPhase.LAUNCHED:
+                if claim.launched_at is not None and now - claim.launched_at >= self.registration_delay:
+                    self._register(claim)
+                    self._initialize(claim)  # sim nodes are born Ready
+                elif now - claim.created_at > REGISTRATION_TTL:
+                    self._liveness_delete(claim, "registration deadline exceeded")
+            elif claim.phase == NodeClaimPhase.PENDING:
+                if now - claim.created_at > REGISTRATION_TTL:
+                    self._liveness_delete(claim, "launch deadline exceeded")
+            elif claim.phase == NodeClaimPhase.REGISTERED:
+                self._initialize(claim)
+
+    def _register(self, claim: NodeClaim) -> None:
+        """Simulated kubelet joins the node and binds nominated pods."""
+        node = Node(
+            name=claim.name, provider_id=claim.provider_id or "",
+            labels=dict(claim.labels), taints=list(claim.taints),
+            capacity=dict(claim.capacity), allocatable=dict(claim.allocatable),
+            ready=True, created_at=self.clock.now(),
+            node_pool=claim.node_pool, node_claim=claim.name)
+        self.cluster.add_node(node)
+        for pod in self.cluster.nominated_pods(claim.name):
+            self.cluster.bind_pod(pod.name, node.name)
+        claim.phase = NodeClaimPhase.REGISTERED
+        claim.registered_at = self.clock.now()
+        self.recorder.publish("Normal", "Registered", "NodeClaim", claim.name,
+                              f"node {node.name} joined")
+
+    def _initialize(self, claim: NodeClaim) -> None:
+        """Registered → Initialized once the node is Ready and startup
+        taints cleared (the sim node is born ready)."""
+        node = self.cluster.node_for_claim(claim.name)
+        if node is None or not node.ready:
+            return
+        claim.phase = NodeClaimPhase.INITIALIZED
+        claim.initialized_at = self.clock.now()
+        self.recorder.publish("Normal", "Initialized", "NodeClaim", claim.name, "")
+
+    def _liveness_delete(self, claim: NodeClaim, reason: str) -> None:
+        self.recorder.publish("Warning", "LivenessFailure", "NodeClaim", claim.name, reason)
+        if claim.provider_id is not None:
+            try:
+                self.cloud_provider.delete(claim)
+            except NotFoundError:
+                pass
+        self.cluster.delete_claim(claim.name)

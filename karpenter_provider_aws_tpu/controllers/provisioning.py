@@ -1,0 +1,224 @@
+"""Provisioning controller: pending pods → Solve() → NodeClaims → launches.
+
+Mirror of the core provisioner loop (reference: pending-pod watch → batch
+window 1 s idle / 10 s max → scheduler simulation → NodeClaim create →
+CloudProvider.Create; SURVEY.md §3.2, website reference/settings.md:17-18).
+The FFD simulation is replaced by the device solver: cluster state renders
+to tensors, the ICE cache masks the lattice, one Solve() packs the whole
+batch, and the decoded NodePlan becomes NodeClaims. NodePool resource
+limits are enforced host-side on the plan (nodepools.md limits), and
+launch failures feed back via UnavailableOfferings for the next pass.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..apis import wellknown as wk
+from ..apis.objects import NodeClaim, NodeClaimPhase, NodePool, Pod
+from ..apis.requirements import Operator, Requirement
+from ..apis.resources import R, resources_to_vec
+from ..cache.unavailable import UnavailableOfferings
+from ..cloudprovider.cloudprovider import CloudProvider
+from ..errors import UnfulfillableCapacityError
+from ..events import Recorder
+from ..lattice.tensors import Lattice, masked_view
+from ..solver.problem import build_problem
+from ..solver.solve import NodePlan, PlannedNode, Solver
+from ..state.cluster import ClusterState
+from ..utils.clock import Clock
+
+BATCH_IDLE_SECONDS = 1.0   # settings.md:17 batch-idle-duration
+BATCH_MAX_SECONDS = 10.0   # settings.md:18 batch-max-duration
+
+
+@dataclass
+class ProvisionResult:
+    plan: Optional[NodePlan]
+    created_claims: List[NodeClaim] = field(default_factory=list)
+    launched: int = 0
+    launch_failures: int = 0
+    pods_scheduled: int = 0
+    pods_unschedulable: int = 0
+
+
+class Provisioner:
+    def __init__(self, cluster: ClusterState, solver: Solver,
+                 node_pools: Dict[str, NodePool],
+                 cloud_provider: CloudProvider,
+                 unavailable: UnavailableOfferings,
+                 recorder: Optional[Recorder] = None,
+                 clock: Optional[Clock] = None):
+        self.cluster = cluster
+        self.solver = solver
+        self.node_pools = node_pools
+        self.cloud_provider = cloud_provider
+        self.unavailable = unavailable
+        self.clock = clock or Clock()
+        self.recorder = recorder or Recorder(self.clock)
+        self._claim_ids = itertools.count(1)
+        self._batch_start: Optional[float] = None
+        self._last_pod_seen: Optional[float] = None
+        self._known_pending: int = 0
+        self._lock = threading.Lock()
+
+    # ---- batch window (settings.md:17-18) --------------------------------
+
+    def batch_ready(self) -> bool:
+        """Has the pending-pod batch window closed? New arrivals reset the
+        idle timer; the max window bounds total latency."""
+        now = self.clock.now()
+        with self._lock:
+            n = len(self.cluster.pending_pods())
+            if n == 0:
+                self._batch_start = None
+                self._last_pod_seen = None
+                self._known_pending = 0
+                return False
+            if self._batch_start is None:
+                self._batch_start = now
+                self._last_pod_seen = now
+                self._known_pending = n
+                return False
+            if n != self._known_pending:
+                self._known_pending = n
+                self._last_pod_seen = now
+            idle_over = now - self._last_pod_seen >= BATCH_IDLE_SECONDS
+            max_over = now - self._batch_start >= BATCH_MAX_SECONDS
+            if idle_over or max_over:
+                self._batch_start = None
+                self._last_pod_seen = None
+                self._known_pending = 0
+                return True
+            return False
+
+    # ---- one scheduling pass --------------------------------------------
+
+    def provision_once(self) -> ProvisionResult:
+        pending = self.cluster.pending_pods()
+        if not pending:
+            return ProvisionResult(plan=None)
+        lattice = masked_view(self.solver.lattice, self.unavailable.mask(self.solver.lattice))
+        problem = build_problem(
+            pending, list(self.node_pools.values()), lattice,
+            existing=self.cluster.existing_bins(lattice),
+            daemonset_pods=self.cluster.daemonset_pods(),
+            bound_pods=self.cluster.bound_pods())
+        plan = self.solver.solve(problem)
+        result = ProvisionResult(plan=plan)
+
+        for name, reason in plan.unschedulable.items():
+            self.recorder.publish("Warning", "FailedScheduling", "Pod", name, reason)
+        result.pods_unschedulable = len(plan.unschedulable)
+
+        # pods that fit existing capacity bind (in the real control plane the
+        # kube-scheduler binds; the sim binds directly, reference stratum-2)
+        for node_name, pods in plan.existing_assignments.items():
+            target_is_claim = node_name in self.cluster.claims and node_name not in self.cluster.nodes
+            for p in pods:
+                if target_is_claim:
+                    self.cluster.nominate(p, node_name)
+                else:
+                    self.cluster.bind_pod(p, node_name)
+                result.pods_scheduled += 1
+
+        planned = self._enforce_limits(plan.new_nodes, result)
+        for node in planned:
+            claim = self._make_claim(node)
+            self.cluster.add_claim(claim)
+            result.created_claims.append(claim)
+            for p in node.pods:
+                self.cluster.nominate(p, claim.name)
+            try:
+                self.cloud_provider.create(claim)
+                result.launched += 1
+                result.pods_scheduled += len(node.pods)
+                self.recorder.publish("Normal", "Launched", "NodeClaim", claim.name,
+                                      f"{claim.instance_type}/{claim.zone}/{claim.capacity_type} "
+                                      f"for {len(node.pods)} pod(s)")
+            except UnfulfillableCapacityError:
+                # offerings already marked unavailable by the provider; the
+                # pods return to pending and the next pass re-solves with the
+                # tightened ICE mask (instance.go:348-354 feedback loop)
+                result.launch_failures += 1
+                self.cluster.delete_claim(claim.name)
+                result.created_claims.pop()
+        return result
+
+    def _enforce_limits(self, nodes: Sequence[PlannedNode],
+                        result: ProvisionResult) -> List[PlannedNode]:
+        """Enforce NodePool resource limits on the plan (CRD nodepools
+        limits). A violating node first tries to DOWNSIZE: every type in the
+        bin's feasible set can hold the bin's pods by construction, so the
+        cheapest one whose capacity fits the remaining budget substitutes;
+        only if none fits are the pods left pending."""
+        usage = self.cluster.pool_usage()
+        out: List[PlannedNode] = []
+        lat = self.solver.lattice
+        for node in nodes:
+            pool = self.node_pools.get(node.node_pool)
+            limit = pool.limits_vec() if pool is not None else None
+            if limit is None:
+                out.append(node)
+                continue
+            current = usage.get(node.node_pool, np.zeros((R,), np.float32))
+            limited = limit > 0
+            remaining = np.where(limited, limit - current, np.inf)
+
+            def fits(tname: str) -> bool:
+                return bool(np.all(lat.capacity[lat.name_to_idx[tname]][limited]
+                                   <= remaining[limited] + 1e-6))
+
+            candidates = node.feasible_types or [node.instance_type]
+            fitting = [t for t in candidates if fits(t)]
+            if not fitting:
+                for p in node.pods:
+                    self.recorder.publish("Warning", "FailedScheduling", "Pod", p,
+                                          f"nodepool {node.node_pool} limit exceeded")
+                result.pods_unschedulable += len(node.pods)
+                continue
+            # restrict the claim's launch flexibility to limit-fitting types
+            node.feasible_types = fitting
+            if node.instance_type not in fitting:
+                node.instance_type = fitting[0]  # cheapest-first order
+            usage[node.node_pool] = current + lat.capacity[lat.name_to_idx[node.instance_type]]
+            out.append(node)
+        return out
+
+    def _make_claim(self, node: PlannedNode) -> NodeClaim:
+        """NodePlan bin → NodeClaim launch contract. The claim carries the
+        bin's full feasible offering sets so the launch path has CreateFleet
+        flexibility without a re-solve."""
+        pool = self.node_pools[node.node_pool]
+        name = f"{node.node_pool}-{next(self._claim_ids):05d}"
+        reqs: List[Requirement] = list(pool.requirements)
+        if node.feasible_types:
+            reqs.append(Requirement(wk.LABEL_INSTANCE_TYPE, Operator.IN,
+                                    tuple(node.feasible_types)))
+        else:
+            reqs.append(Requirement(wk.LABEL_INSTANCE_TYPE, Operator.IN,
+                                    (node.instance_type,)))
+        reqs.append(Requirement(wk.LABEL_ZONE, Operator.IN,
+                                tuple(node.feasible_zones or [node.zone])))
+        reqs.append(Requirement(wk.LABEL_CAPACITY_TYPE, Operator.IN,
+                                tuple(node.feasible_capacity_types or [node.capacity_type])))
+        requests: Dict[str, float] = {}
+        total = np.zeros((R,), np.float32)
+        for p in node.pods:
+            pod = self.cluster.pods.get(p)
+            if pod is not None:
+                total += resources_to_vec(pod.requests, implicit_pod=True)
+        from ..apis.resources import vec_to_resources
+        requests = vec_to_resources(total)
+        claim = NodeClaim(
+            name=name, node_pool=node.node_pool,
+            requirements=reqs, resource_requests=requests,
+            labels=dict(pool.labels), annotations={},
+            taints=list(pool.taints), node_class_ref=pool.node_class_ref,
+            created_at=self.clock.now())
+        return claim

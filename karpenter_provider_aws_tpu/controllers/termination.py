@@ -1,0 +1,65 @@
+"""Termination controller: finalizer-style drain then instance delete.
+
+Mirror of the core termination flow (reference designs/termination.md;
+website concepts/disruption.md:29-36): a NodeClaim with a deletion
+timestamp gets its node tainted (cordon), pods evicted back to pending,
+then CloudProvider.Delete terminates the instance, and finally the claim
+and node objects are removed (finalizer cleared).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..apis.objects import NodeClaim, NodeClaimPhase, Taint, TaintEffect
+from ..apis import wellknown as wk
+from ..cloudprovider.cloudprovider import CloudProvider
+from ..errors import NotFoundError
+from ..events import Recorder
+from ..state.cluster import ClusterState
+from ..utils.clock import Clock
+
+DISRUPTION_TAINT = Taint(key=f"{wk.KARPENTER_PREFIX}/disruption", value="disrupting",
+                         effect=TaintEffect.NO_SCHEDULE)
+
+
+class TerminationController:
+    def __init__(self, cluster: ClusterState, cloud_provider: CloudProvider,
+                 recorder: Optional[Recorder] = None, clock: Optional[Clock] = None):
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.clock = clock or Clock()
+        self.recorder = recorder or Recorder(self.clock)
+
+    def delete_claim(self, claim_name: str) -> None:
+        """Mark for deletion (the k8s delete that starts the finalizer flow)."""
+        claim = self.cluster.claims.get(claim_name)
+        if claim is None:
+            return
+        if not claim.deletion_timestamp:
+            claim.deletion_timestamp = self.clock.now()
+            claim.phase = NodeClaimPhase.TERMINATING
+
+    def reconcile(self) -> None:
+        for claim in list(self.cluster.claims.values()):
+            if not claim.deletion_timestamp:
+                continue
+            node = self.cluster.node_for_claim(claim.name)
+            if node is not None:
+                # cordon + drain: pods return to pending for rescheduling
+                if all(t.key != DISRUPTION_TAINT.key for t in node.taints):
+                    node.taints.append(DISRUPTION_TAINT)
+                    self.recorder.publish("Normal", "Cordoned", "Node", node.name, "")
+                evicted = self.cluster.unbind_pods_on(node.name)
+                if evicted:
+                    self.recorder.publish("Normal", "Drained", "Node", node.name,
+                                          f"evicted {len(evicted)} pod(s)")
+                self.cluster.delete_node(node.name)
+            if claim.provider_id is not None:
+                try:
+                    self.cloud_provider.delete(claim)
+                except NotFoundError:
+                    pass
+            claim.phase = NodeClaimPhase.TERMINATED
+            self.cluster.delete_claim(claim.name)
+            self.recorder.publish("Normal", "Terminated", "NodeClaim", claim.name, "")

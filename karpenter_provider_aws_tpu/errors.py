@@ -1,0 +1,55 @@
+"""Cloud error taxonomy.
+
+Mirror of the reference's AWS error classification
+(reference pkg/errors/errors.go:29-37 region: not-found, already-exists,
+unfulfillable-capacity/ICE, launch-template-not-found) recast for the
+framework's pluggable cloud backend. The solver feedback loop hangs off
+``UnfulfillableCapacityError``: each (capacity_type, instance_type, zone)
+offering it names is masked out of the next solve via the
+UnavailableOfferings cache (reference pkg/providers/instance/instance.go:348-354).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Tuple
+
+Offering = Tuple[str, str, str]  # (capacity_type, instance_type, zone)
+
+
+class CloudError(Exception):
+    """Base class for cloud backend errors."""
+
+
+class NotFoundError(CloudError):
+    pass
+
+
+class AlreadyExistsError(CloudError):
+    pass
+
+
+@dataclass
+class UnfulfillableCapacityError(CloudError):
+    """Insufficient capacity for every offering attempted (the ICE case)."""
+
+    offerings: List[Offering]
+
+    def __post_init__(self):
+        super().__init__(f"insufficient capacity for {len(self.offerings)} offering(s)")
+
+
+class RateLimitedError(CloudError):
+    pass
+
+
+def is_not_found(err: BaseException) -> bool:
+    return isinstance(err, NotFoundError)
+
+
+def is_already_exists(err: BaseException) -> bool:
+    return isinstance(err, AlreadyExistsError)
+
+
+def is_unfulfillable_capacity(err: BaseException) -> bool:
+    return isinstance(err, UnfulfillableCapacityError)

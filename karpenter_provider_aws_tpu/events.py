@@ -1,0 +1,51 @@
+"""Event recorder.
+
+Mirror of the reference's k8s event recorder usage (reference
+pkg/controllers/interruption/events/events.go, pkg/cloudprovider/events):
+controllers publish typed events about API objects; tests and the ops
+surface read them back. Host-side, append-only, thread-safe.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass(frozen=True)
+class Event:
+    time: float
+    type: str          # Normal | Warning
+    reason: str
+    object_kind: str   # Pod | NodeClaim | Node | NodePool | ...
+    object_name: str
+    message: str
+
+
+class Recorder:
+    def __init__(self, clock=None):
+        from .utils.clock import Clock
+        self._clock = clock or Clock()
+        self._events: List[Event] = []
+        self._lock = threading.Lock()
+
+    def publish(self, type: str, reason: str, object_kind: str, object_name: str,
+                message: str) -> None:
+        ev = Event(self._clock.now(), type, reason, object_kind, object_name, message)
+        with self._lock:
+            self._events.append(ev)
+
+    def events(self, reason: Optional[str] = None,
+               object_name: Optional[str] = None) -> List[Event]:
+        with self._lock:
+            out = list(self._events)
+        if reason is not None:
+            out = [e for e in out if e.reason == reason]
+        if object_name is not None:
+            out = [e for e in out if e.object_name == object_name]
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()

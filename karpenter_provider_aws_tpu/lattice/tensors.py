@@ -120,6 +120,18 @@ class Lattice:
         return {k: sorted(v) for k, v in out.items()}
 
 
+def masked_view(lattice: Lattice, offering_mask: np.ndarray) -> Lattice:
+    """A shallow lattice copy with offerings masked out (ICE feedback: AND
+    the UnavailableOfferings mask into availability before a solve). All
+    other tensors are shared; shapes are unchanged so jitted kernels are
+    reused."""
+    from dataclasses import replace
+
+    available = lattice.available & offering_mask
+    price = np.where(available, lattice.price, np.inf).astype(np.float32)
+    return replace(lattice, available=available, price=price)
+
+
 def build_lattice(specs: Optional[Sequence[cat.InstanceTypeSpec]] = None,
                   kc: Optional[KubeletConfiguration] = None,
                   zones: Sequence[str] = cat.ZONES,

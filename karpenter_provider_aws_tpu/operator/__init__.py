@@ -1,0 +1,4 @@
+from .operator import Operator
+from .options import Options
+
+__all__ = ["Operator", "Options"]

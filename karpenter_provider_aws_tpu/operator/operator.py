@@ -1,0 +1,106 @@
+"""Operator: dependency wiring + the controller run loop.
+
+Mirror of the reference operator (reference pkg/operator/operator.go:92-186
+builds the session and all providers; cmd/controller/main.go:32-72 wires
+cloudprovider → core+provider controllers → manager). Here the "session"
+is the pluggable cloud backend, the providers are the lattice/ICE-cache/
+cloudprovider stack, and the manager is a deterministic `run_once()` /
+`run(duration)` loop over the controllers — clock-driven so the whole
+control plane is simulable in tests (the reference's envtest stratum).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from ..apis.objects import NodeClass, NodePool
+from ..cache.unavailable import UnavailableOfferings
+from ..cloud.fake import FakeCloud
+from ..cloudprovider.cloudprovider import CloudProvider
+from ..controllers.garbagecollection import GarbageCollectionController
+from ..controllers.lifecycle import LifecycleController
+from ..controllers.provisioning import Provisioner
+from ..controllers.termination import TerminationController
+from ..events import Recorder
+from ..lattice.tensors import Lattice, build_lattice
+from ..solver.solve import Solver
+from ..state.cluster import ClusterState
+from ..utils.clock import Clock, FakeClock
+from .options import Options
+
+
+class Operator:
+    def __init__(self, options: Optional[Options] = None,
+                 lattice: Optional[Lattice] = None,
+                 cloud: Optional[FakeCloud] = None,
+                 clock: Optional[Clock] = None,
+                 node_pools: Optional[Sequence[NodePool]] = None,
+                 node_classes: Optional[Dict[str, NodeClass]] = None):
+        self.options = options or Options()
+        self.options.validate()
+        self.clock = clock or Clock()
+        self.lattice = lattice if lattice is not None else build_lattice(
+            vm_memory_overhead_percent=self.options.vm_memory_overhead_percent,
+            reserved_enis=self.options.reserved_enis)
+        self.cloud = cloud or FakeCloud(self.clock)
+        # connectivity probe before anything else (operator.go:115-117)
+        self.cloud.list_instances()
+        self.recorder = Recorder(self.clock)
+        self.unavailable = UnavailableOfferings(self.clock)
+        self.cluster = ClusterState(self.clock)
+        self.node_pools: Dict[str, NodePool] = {p.name: p for p in (node_pools or [NodePool(name="default")])}
+        self.node_classes: Dict[str, NodeClass] = node_classes or {"default": NodeClass(name="default")}
+        self.cloud_provider = CloudProvider(
+            self.lattice, self.cloud, self.unavailable, self.recorder, self.clock,
+            node_classes=self.node_classes)
+        self.solver = Solver(self.lattice)
+        self.provisioner = Provisioner(
+            self.cluster, self.solver, self.node_pools, self.cloud_provider,
+            self.unavailable, self.recorder, self.clock)
+        self.lifecycle = LifecycleController(
+            self.cluster, self.cloud_provider, self.recorder, self.clock,
+            registration_delay=self.options.registration_delay)
+        self.termination = TerminationController(
+            self.cluster, self.cloud_provider, self.recorder, self.clock)
+        self.gc = GarbageCollectionController(
+            self.cluster, self.cloud_provider, self.recorder, self.clock)
+        self._last_cache_cleanup = 0.0
+
+    # ---- run loop --------------------------------------------------------
+
+    def run_once(self, force_provision: bool = False) -> None:
+        """One deterministic reconcile pass over every controller."""
+        if force_provision or self.provisioner.batch_ready():
+            self.provisioner.provision_once()
+        self.lifecycle.reconcile()
+        self.termination.reconcile()
+        self.gc.reconcile()
+        now = self.clock.now()
+        if now - self._last_cache_cleanup >= 10.0:  # ICE cleanup cadence (cache.go:39-42)
+            self.unavailable.cleanup()
+            self._last_cache_cleanup = now
+
+    def run(self, duration: float, step: float = 1.0) -> None:
+        """Drive the control plane for `duration` simulated (FakeClock) or
+        real seconds."""
+        end = self.clock.now() + duration
+        while self.clock.now() < end:
+            self.run_once()
+            if isinstance(self.clock, FakeClock):
+                self.clock.step(step)
+            else:
+                self.clock.sleep(step)
+
+    def settle(self, max_rounds: int = 50, step: float = 1.0) -> int:
+        """Run until no pending pods and no in-flight claims (or the round
+        budget runs out). Returns rounds used. FakeClock only."""
+        assert isinstance(self.clock, FakeClock)
+        for i in range(max_rounds):
+            self.run_once(force_provision=bool(self.cluster.pending_pods()))
+            if not self.cluster.pending_pods() and all(
+                    self.cluster.node_for_claim(c.name) is not None
+                    for c in self.cluster.claims.values() if not c.deletion_timestamp):
+                return i + 1
+            self.clock.step(step)
+        return max_rounds

@@ -1,0 +1,76 @@
+"""Operator configuration.
+
+Mirror of the reference's layered flag/env options (reference
+pkg/operator/options/options.go:35-57 + website reference/settings.md:13-47):
+cluster identity, memory-overhead model, batching windows, interruption
+queue, and feature gates. Resolution order: explicit kwargs > environment
+variables > defaults, like the reference's flag/env layering.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+def _env(name: str, default, cast):
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return cast(raw)
+    except (TypeError, ValueError):
+        raise ValueError(f"invalid value for {name}: {raw!r}")
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclass
+class Options:
+    cluster_name: str = "sim"
+    # VM memory the hypervisor eats before the OS sees it (options.go
+    # VM_MEMORY_OVERHEAD_PERCENT, default 0.075)
+    vm_memory_overhead_percent: float = 0.075
+    reserved_enis: int = 0
+    # pending-pod batch window (settings.md:17-18)
+    batch_idle_duration: float = 1.0
+    batch_max_duration: float = 10.0
+    # interruption queue name; empty disables the interruption controller
+    # (reference controllers.go:60-62)
+    interruption_queue: str = ""
+    # feature gates (settings.md:40-47)
+    drift_enabled: bool = True
+    spot_to_spot_consolidation: bool = False
+    # sim-only knob: seconds between launch and (fake) kubelet registration
+    registration_delay: float = 5.0
+
+    def validate(self) -> None:
+        if not self.cluster_name:
+            raise ValueError("cluster_name is required")
+        if not (0.0 <= self.vm_memory_overhead_percent < 1.0):
+            raise ValueError("vm_memory_overhead_percent must be in [0, 1)")
+        if self.batch_idle_duration < 0 or self.batch_max_duration < self.batch_idle_duration:
+            raise ValueError("batch windows: need 0 <= idle <= max")
+
+    @staticmethod
+    def from_env(**overrides) -> "Options":
+        opts = Options(
+            cluster_name=_env("CLUSTER_NAME", "sim", str),
+            vm_memory_overhead_percent=_env("VM_MEMORY_OVERHEAD_PERCENT", 0.075, float),
+            reserved_enis=_env("RESERVED_ENIS", 0, int),
+            batch_idle_duration=_env("BATCH_IDLE_DURATION", 1.0, float),
+            batch_max_duration=_env("BATCH_MAX_DURATION", 10.0, float),
+            interruption_queue=_env("INTERRUPTION_QUEUE", "", str),
+            drift_enabled=_env_bool("FEATURE_GATE_DRIFT", True),
+            spot_to_spot_consolidation=_env_bool("FEATURE_GATE_SPOT_TO_SPOT", False),
+        )
+        for k, v in overrides.items():
+            setattr(opts, k, v)
+        opts.validate()
+        return opts

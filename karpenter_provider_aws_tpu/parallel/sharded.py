@@ -36,11 +36,15 @@ from ..ops import binpack
 
 
 def split_counts(count: np.ndarray, n_devices: int,
-                 keep_whole: Optional[np.ndarray] = None) -> np.ndarray:
+                 keep_whole: Optional[np.ndarray] = None,
+                 pin_shard0: Optional[np.ndarray] = None) -> np.ndarray:
     """[G] pod counts -> [D,G] balanced split (device d gets ~count/D).
 
-    Groups flagged in ``keep_whole`` (co-location / presence-requiring
-    groups) are not split: each lands entirely on one shard, round-robin.
+    Groups flagged in ``keep_whole`` (co-location groups) are not split:
+    each lands entirely on one shard, round-robin. Groups flagged in
+    ``pin_shard0`` (presence-requiring ``need`` groups) go whole to shard 0,
+    the only shard holding existing bins and their bound-pod affinity
+    seeding (e_pm/e_po) — elsewhere their needs could never be met.
     """
     base = count // n_devices
     extra = count % n_devices
@@ -48,10 +52,17 @@ def split_counts(count: np.ndarray, n_devices: int,
     for d in range(n_devices):
         out[d] += (d < extra).astype(count.dtype)
     if keep_whole is not None and keep_whole.any():
-        whole = np.nonzero(keep_whole)[0]
+        whole = keep_whole.copy()
+        if pin_shard0 is not None:
+            whole &= ~pin_shard0
+        whole = np.nonzero(whole)[0]
         for i, g in enumerate(whole):
             out[:, g] = 0
             out[i % n_devices, g] = count[g]
+    if pin_shard0 is not None and pin_shard0.any():
+        for g in np.nonzero(pin_shard0)[0]:
+            out[:, g] = 0
+            out[0, g] = count[g]
     return out
 
 

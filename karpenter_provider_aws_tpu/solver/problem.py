@@ -146,10 +146,24 @@ def _custom_keys_ok(reqs: Requirements, pool_labels: Mapping[str, str]) -> bool:
     return True
 
 
-def _group_signature(pod: Pod) -> str:
+def _selector_keys(pods: Sequence[Pod], bound_pods: Sequence[BoundPod]) -> frozenset:
+    """Label keys referenced by ANY affinity/spread selector in the batch or
+    on bound pods. Only these keys affect scheduling semantics, so the group
+    signature projects labels onto them — per-pod-unique labels (StatefulSet
+    pod names, pod-index) never break deduplication."""
+    keys = set()
+    for p in list(pods) + [bp.pod for bp in bound_pods]:
+        for term in p.pod_affinity:
+            keys.update(k for k, _ in term.label_selector)
+        for c in p.topology_spread:
+            keys.update(k for k, _ in c.label_selector)
+    return frozenset(keys)
+
+
+def _group_signature(pod: Pod, relevant_keys: frozenset) -> str:
     reqs = pod.scheduling_requirements()
     parts = [repr(sorted(pod.requests.items()))]
-    parts.append(repr(sorted(pod.labels.items())))
+    parts.append(repr(sorted((k, v) for k, v in pod.labels.items() if k in relevant_keys)))
     parts.append(repr(reqs))
     parts.append(repr(sorted((t.key, t.operator, t.value, t.effect) for t in pod.tolerations)))
     parts.append(repr(sorted(
@@ -204,12 +218,13 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
     unschedulable: Dict[str, str] = {}
     raw_groups: Dict[str, Tuple[Pod, List[str]]] = {}
     order: List[str] = []
+    relevant_keys = _selector_keys(pods, bound_pods)
     for pod in pods:
         vec, unknown = resources_to_vec_checked(pod.requests, implicit_pod=True)
         if unknown:
             unschedulable[pod.name] = f"unknown resource(s): {', '.join(unknown)}"
             continue
-        sig = _group_signature(pod)
+        sig = _group_signature(pod, relevant_keys)
         if sig in raw_groups:
             raw_groups[sig][1].append(pod.name)
         else:
@@ -227,6 +242,7 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
                 registry.intern(tuple(term.label_selector))
     groups: List[PodGroup] = []
     pending_topo: List[Tuple[PodGroup, Pod, np.ndarray, np.ndarray]] = []  # group, rep, owner, need
+    pending_spread_counts: Dict = {}  # (selector, key) -> planned per-domain adds
     for sig in order:
         rep, names = raw_groups[sig]
         vec, _ = resources_to_vec_checked(rep.requests, implicit_pod=True)
@@ -254,7 +270,8 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
 
         splits, topo, cut = resolve_group_topology(
             rep, len(names), masks.zone_mask, masks.cap_mask,
-            lattice.zones, lattice.capacity_types, registry, bound_pods, warnings)
+            lattice.zones, lattice.capacity_types, registry, bound_pods, warnings,
+            pending_counts=pending_spread_counts)
         if cut > 0:
             for name in names[len(names) - cut:]:
                 unschedulable[name] = "zone anti-affinity: more replicas than eligible zones"
@@ -376,7 +393,7 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
         g_cap=g_cap, g_np=g_np, max_per_bin=max_per_bin, g_spread=g_spread,
         single_bin=single_bin,
         g_match=g_match, g_owner=g_owner, g_need=g_need, strict_custom=strict_custom,
-        warnings=warnings,
+        warnings=list(dict.fromkeys(warnings)),  # distinct notices once each
         np_type=np_type, np_zone=np_zone, np_cap=np_cap, ds_overhead=ds_overhead,
         e_used=e_used, e_alloc=e_alloc, e_type=e_type, e_zone=e_zone, e_cap=e_cap,
         e_np=e_np, e_pm=e_pm, e_po=e_po,

@@ -41,6 +41,17 @@ class PlannedNode:
     capacity_type: str
     price_per_hour: float
     pods: List[str] = field(default_factory=list)
+    # the bin's full feasible sets (every instance type that can hold the
+    # bin's contents, cheapest-first, capped at MAX_FLEXIBLE_TYPES): the
+    # launch path hands these to the cloud as CreateFleet overrides so an
+    # ICE on the chosen offering falls through to the next-cheapest without
+    # a re-solve (reference instance.go MaxInstanceTypes=60)
+    feasible_types: List[str] = field(default_factory=list)
+    feasible_zones: List[str] = field(default_factory=list)
+    feasible_capacity_types: List[str] = field(default_factory=list)
+
+
+MAX_FLEXIBLE_TYPES = 60  # reference pkg/providers/instance/instance.go:50
 
 
 @dataclass
@@ -77,6 +88,14 @@ class Solver:
         self._alloc = jnp.asarray(lattice.alloc)
         self._avail = jnp.asarray(lattice.available)
         self._price = jnp.asarray(lattice.price)
+
+    def _device_avail_price(self, problem: Problem):
+        """A problem built over a masked lattice view (ICE cache applied,
+        state/unavailable.py) brings its own availability; shapes match, so
+        the jitted kernel is reused without recompilation."""
+        if problem.lattice is self.lattice:
+            return self._avail, self._price
+        return jnp.asarray(problem.lattice.available), jnp.asarray(problem.lattice.price)
 
     # ---- padding ----
 
@@ -181,11 +200,12 @@ class Solver:
 
         groups = self._padded_groups(problem, G)
         pools = self._pool_params(problem)
+        avail, price = self._device_avail_price(problem)
 
         while True:
             init = self._init_state(problem, B)
             td = time.perf_counter()
-            result = binpack.pack(self._alloc, self._avail, self._price, groups, pools, init)
+            result = binpack.pack(self._alloc, avail, price, groups, pools, init)
             result.assign.block_until_ready()
             device_s = time.perf_counter() - td
             leftover = np.asarray(result.leftover)
@@ -216,6 +236,23 @@ class Solver:
         unschedulable = dict(problem.unschedulable)
         existing_assignments: Dict[str, List[str]] = {}
         new_bins: Dict[int, PlannedNode] = {}
+        tmask_all = np.asarray(result.state.tmask)
+        zmask_all = np.asarray(result.state.zmask)
+        cmask_all = np.asarray(result.state.cmask)
+        avail_np = problem.lattice.available
+        price_np = problem.lattice.price
+
+        def feasible_sets(b: int):
+            offer = (avail_np & tmask_all[b][:, None, None]
+                     & zmask_all[b][None, :, None] & cmask_all[b][None, None, :])
+            p = np.where(offer, price_np, np.inf)
+            best_per_type = p.min(axis=(1, 2))
+            order = np.argsort(best_per_type, kind="stable")
+            types = [lat.names[t] for t in order
+                     if np.isfinite(best_per_type[t])][:MAX_FLEXIBLE_TYPES]
+            zones = [lat.zones[z] for z in np.nonzero(offer.any(axis=(0, 2)))[0]]
+            caps = [lat.capacity_types[c] for c in np.nonzero(offer.any(axis=(0, 1)))[0]]
+            return types, zones, caps
 
         for gi, group in enumerate(problem.groups):
             names = group.pod_names
@@ -230,11 +267,14 @@ class Solver:
                     node = new_bins.get(int(b))
                     if node is None:
                         t, z, c = int(chosen_t[b]), int(chosen_z[b]), int(chosen_c[b])
+                        ftypes, fzones, fcaps = feasible_sets(int(b))
                         node = PlannedNode(
                             node_pool=problem.node_pools[int(np_id[b])].name,
                             instance_type=lat.names[t], zone=lat.zones[z],
                             capacity_type=lat.capacity_types[c],
                             price_per_hour=float(chosen_price[b]),
+                            feasible_types=ftypes, feasible_zones=fzones,
+                            feasible_capacity_types=fcaps,
                         )
                         new_bins[int(b)] = node
                     node.pods.extend(pod_slice)

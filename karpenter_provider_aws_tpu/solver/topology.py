@@ -163,12 +163,19 @@ def resolve_group_topology(
     registry: ClassRegistry,
     bound: Sequence[BoundPod],
     warnings: List[str],
+    pending_counts: Optional[Dict] = None,
 ) -> Tuple[List[_Split], GroupTopology, int]:
     """Resolve one pod group's topology constraints.
 
     Returns (splits, per-row topology attributes, pods_cut) where pods_cut
     is the number of pods made unschedulable by domain exhaustion (zone
     self-anti-affinity with more replicas than eligible zones).
+
+    ``pending_counts`` maps (selector, topology_key) → per-domain additions
+    already planned for earlier groups in this batch, so sibling groups
+    sharing a spread selector fill against the COMBINED counts (the skew
+    bound is per selector, not per group; the kernel's pm counters do the
+    same for hostname).
     """
     topo = GroupTopology()
     zmask = zone_mask.copy()
@@ -279,18 +286,33 @@ def resolve_group_topology(
     splits: List[_Split] = []
 
     def spread_counts(sel: Tuple[Tuple[str, str], ...], key: str) -> np.ndarray:
-        """Existing matching-pod counts per eligible domain."""
+        """Matching-pod counts per domain: bound pods + additions already
+        planned for earlier sibling groups in this batch."""
         if key == wk.LABEL_ZONE:
             out = np.zeros((len(zones),), dtype=np.int64)
             for bp in bound:
                 if _matches(sel, bp.pod.labels) and bp.zone in zone_index:
                     out[zone_index[bp.zone]] += 1
-            return out
-        out = np.zeros((len(capacity_types),), dtype=np.int64)
-        for bp in bound:
-            if _matches(sel, bp.pod.labels) and bp.capacity_type in cap_index:
-                out[cap_index[bp.capacity_type]] += 1
+        else:
+            out = np.zeros((len(capacity_types),), dtype=np.int64)
+            for bp in bound:
+                if _matches(sel, bp.pod.labels) and bp.capacity_type in cap_index:
+                    out[cap_index[bp.capacity_type]] += 1
+        if pending_counts is not None:
+            prior = pending_counts.get((_selector_key(sel), key))
+            if prior is not None:
+                out = out + prior
         return out
+
+    def record_adds(sel: Tuple[Tuple[str, str], ...], key: str,
+                    domain_indices, adds) -> None:
+        if pending_counts is None:
+            return
+        k = (_selector_key(sel), key)
+        size = len(zones) if key == wk.LABEL_ZONE else len(capacity_types)
+        acc = pending_counts.setdefault(k, np.zeros((size,), dtype=np.int64))
+        for di, n in zip(domain_indices, adds):
+            acc[di] += int(n)
 
     if zone_self_anti:
         elig = np.nonzero(zmask)[0]
@@ -305,8 +327,11 @@ def resolve_group_topology(
         if elig.size == 0:
             splits.append(_Split(count, zmask, cmask))
         else:
-            existing = spread_counts(tuple(zone_spread.label_selector), wk.LABEL_ZONE)[elig]
+            sel = tuple(zone_spread.label_selector)
+            existing = spread_counts(sel, wk.LABEL_ZONE)[elig]
             adds = _water_fill(existing, count)
+            if _matches(sel, pod.labels):
+                record_adds(sel, wk.LABEL_ZONE, elig, adds)
             for zi, n in zip(elig, adds):
                 if n <= 0:
                     continue
@@ -321,7 +346,8 @@ def resolve_group_topology(
         # the skew constraint is global across all zone splits: fold each
         # split's additions into the running domain counts so later splits
         # keep topping up the lowest capacity type
-        running = spread_counts(tuple(cap_spread.label_selector), wk.LABEL_CAPACITY_TYPE)
+        sel = tuple(cap_spread.label_selector)
+        running = spread_counts(sel, wk.LABEL_CAPACITY_TYPE)
         for s in splits:
             elig = np.nonzero(s.cap_mask)[0]
             if elig.size == 0:
@@ -329,6 +355,8 @@ def resolve_group_topology(
                 continue
             adds = _water_fill(running[elig], s.count)
             running[elig] += adds
+            if _matches(sel, pod.labels):
+                record_adds(sel, wk.LABEL_CAPACITY_TYPE, elig, adds)
             for ci, n in zip(elig, adds):
                 if n <= 0:
                     continue

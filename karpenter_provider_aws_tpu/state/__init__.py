@@ -1,0 +1,3 @@
+from .cluster import ClusterState
+
+__all__ = ["ClusterState"]

@@ -1,0 +1,226 @@
+"""In-memory cluster state.
+
+Mirror of the core's cluster-state component (reference
+cmd/controller/main.go:50 `state.NewCluster`; metrics
+karpenter_cluster_state_* per website reference/metrics.md:150-157): a
+thread-safe mirror of pods, nodes, and NodeClaims that is the solver's
+input-tensor source — it renders registered nodes and in-flight claims
+into ``ExistingBin`` rows and bound pods into ``BoundPod`` topology
+accounting for build_problem.
+
+Nominations track pods the provisioner has assigned to a not-yet-registered
+NodeClaim so the next scheduling pass neither double-schedules the pods nor
+double-counts the headroom (the core nominates pods to in-flight nodes the
+same way).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..apis import wellknown as wk
+from ..apis.objects import Node, NodeClaim, NodeClaimPhase, Pod
+from ..apis.resources import R, canonical_to_vec, resources_to_vec
+from ..lattice.tensors import Lattice
+from ..solver.problem import ExistingBin
+from ..solver.topology import BoundPod
+from ..utils.clock import Clock
+
+NOMINATION_TTL = 20.0  # core nominates pods to in-flight capacity ~20s
+
+
+@dataclass
+class _Nomination:
+    target: str            # NodeClaim name (or node name)
+    expires: float
+
+
+class ClusterState:
+    def __init__(self, clock: Optional[Clock] = None):
+        self._clock = clock or Clock()
+        self._lock = threading.RLock()
+        self.pods: Dict[str, Pod] = {}
+        self.nodes: Dict[str, Node] = {}
+        self.claims: Dict[str, NodeClaim] = {}
+        self._nominations: Dict[str, _Nomination] = {}   # pod -> claim
+
+    # ---- pods ------------------------------------------------------------
+
+    def add_pod(self, pod: Pod) -> None:
+        with self._lock:
+            self.pods[pod.name] = pod
+
+    def delete_pod(self, name: str) -> None:
+        with self._lock:
+            self.pods.pop(name, None)
+            self._nominations.pop(name, None)
+
+    def bind_pod(self, pod_name: str, node_name: str) -> None:
+        with self._lock:
+            pod = self.pods.get(pod_name)
+            if pod is not None:
+                pod.node_name = node_name
+            self._nominations.pop(pod_name, None)
+
+    def unbind_pods_on(self, node_name: str) -> List[Pod]:
+        """Eviction: pods on the node become pending again (termination drain)."""
+        with self._lock:
+            out = []
+            for pod in self.pods.values():
+                if pod.node_name == node_name:
+                    pod.node_name = None
+                    out.append(pod)
+            return out
+
+    def nominate(self, pod_name: str, target: str, ttl: float = NOMINATION_TTL) -> None:
+        with self._lock:
+            self._nominations[pod_name] = _Nomination(target, self._clock.now() + ttl)
+
+    def nominated_pods(self, target: str) -> List[Pod]:
+        now = self._clock.now()
+        with self._lock:
+            return [self.pods[p] for p, n in self._nominations.items()
+                    if n.target == target and n.expires > now and p in self.pods]
+
+    def pending_pods(self) -> List[Pod]:
+        """Unbound, un-nominated, non-daemonset pods awaiting capacity."""
+        now = self._clock.now()
+        with self._lock:
+            out = []
+            for pod in self.pods.values():
+                if pod.node_name is not None or pod.is_daemonset or pod.deletion_timestamp:
+                    continue
+                nom = self._nominations.get(pod.name)
+                if nom is not None and nom.expires > now:
+                    continue
+                out.append(pod)
+            return out
+
+    def daemonset_pods(self) -> List[Pod]:
+        with self._lock:
+            return [p for p in self.pods.values() if p.is_daemonset]
+
+    # ---- nodes / claims ---------------------------------------------------
+
+    def add_node(self, node: Node) -> None:
+        with self._lock:
+            self.nodes[node.name] = node
+
+    def delete_node(self, name: str) -> None:
+        with self._lock:
+            self.nodes.pop(name, None)
+
+    def add_claim(self, claim: NodeClaim) -> None:
+        with self._lock:
+            self.claims[claim.name] = claim
+
+    def delete_claim(self, name: str) -> None:
+        with self._lock:
+            self.claims.pop(name, None)
+            stale = [p for p, n in self._nominations.items() if n.target == name]
+            for p in stale:
+                del self._nominations[p]
+
+    def node_for_claim(self, claim_name: str) -> Optional[Node]:
+        with self._lock:
+            for node in self.nodes.values():
+                if node.node_claim == claim_name:
+                    return node
+            return None
+
+    # ---- solver inputs ----------------------------------------------------
+
+    def _pods_by_node(self) -> Dict[str, List[Pod]]:
+        by_node: Dict[str, List[Pod]] = {}
+        for pod in self.pods.values():
+            if pod.node_name is not None:
+                by_node.setdefault(pod.node_name, []).append(pod)
+        return by_node
+
+    def existing_bins(self, lattice: Lattice) -> List[ExistingBin]:
+        """Registered nodes + launched-but-unregistered claims as packer bins."""
+        with self._lock:
+            by_node = self._pods_by_node()
+            bins: List[ExistingBin] = []
+            for node in self.nodes.values():
+                itype = node.labels.get(wk.LABEL_INSTANCE_TYPE)
+                zone = node.labels.get(wk.LABEL_ZONE)
+                cap = node.labels.get(wk.LABEL_CAPACITY_TYPE, "on-demand")
+                if itype not in lattice.name_to_idx or zone not in lattice.zones:
+                    continue
+                used = np.zeros((R,), np.float32)
+                for pod in by_node.get(node.name, ()):
+                    used += resources_to_vec(pod.requests, implicit_pod=True)
+                alloc_override = None
+                if node.allocatable:
+                    # node status resources are canonical-unit floats
+                    alloc_override = canonical_to_vec(node.allocatable)
+                bins.append(ExistingBin(
+                    name=node.name, node_pool=node.node_pool or "",
+                    instance_type=itype, zone=zone, capacity_type=cap,
+                    used=used, alloc_override=alloc_override))
+            registered = {n.node_claim for n in self.nodes.values() if n.node_claim}
+            for claim in self.claims.values():
+                if claim.name in registered or claim.deletion_timestamp:
+                    continue
+                if claim.phase not in (NodeClaimPhase.LAUNCHED,):
+                    continue
+                if claim.instance_type not in lattice.name_to_idx:
+                    continue
+                used = np.zeros((R,), np.float32)
+                for pod in self.nominated_pods(claim.name):
+                    used += resources_to_vec(pod.requests, implicit_pod=True)
+                bins.append(ExistingBin(
+                    name=claim.name, node_pool=claim.node_pool,
+                    instance_type=claim.instance_type,
+                    zone=claim.zone or lattice.zones[0],
+                    capacity_type=claim.capacity_type or "on-demand",
+                    used=used))
+            return bins
+
+    def bound_pods(self) -> List[BoundPod]:
+        with self._lock:
+            out: List[BoundPod] = []
+            for pod in self.pods.values():
+                if pod.node_name is None:
+                    continue
+                node = self.nodes.get(pod.node_name)
+                zone = node.labels.get(wk.LABEL_ZONE, "") if node else ""
+                cap = node.labels.get(wk.LABEL_CAPACITY_TYPE, "on-demand") if node else "on-demand"
+                out.append(BoundPod(pod=pod, node_name=pod.node_name, zone=zone,
+                                    capacity_type=cap))
+            return out
+
+    def pool_usage(self) -> Dict[str, np.ndarray]:
+        """Per-NodePool committed capacity (registered nodes + in-flight
+        claims) for NodePool limits enforcement (nodepools.md limits)."""
+        with self._lock:
+            usage: Dict[str, np.ndarray] = {}
+            counted = set()
+            for node in self.nodes.values():
+                pool = node.node_pool
+                if not pool:
+                    continue
+                vec = canonical_to_vec(node.capacity) if node.capacity else np.zeros((R,), np.float32)
+                usage[pool] = usage.get(pool, np.zeros((R,), np.float32)) + vec
+                if node.node_claim:
+                    counted.add(node.node_claim)
+            for claim in self.claims.values():
+                if claim.name in counted or claim.deletion_timestamp:
+                    continue
+                if claim.phase in (NodeClaimPhase.TERMINATING, NodeClaimPhase.TERMINATED):
+                    continue
+                vec = canonical_to_vec(claim.capacity) if claim.capacity else np.zeros((R,), np.float32)
+                usage[claim.node_pool] = usage.get(claim.node_pool, np.zeros((R,), np.float32)) + vec
+            return usage
+
+    def reset(self) -> None:
+        with self._lock:
+            self.pods.clear()
+            self.nodes.clear()
+            self.claims.clear()
+            self._nominations.clear()

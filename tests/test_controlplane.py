@@ -1,0 +1,323 @@
+"""Control-plane shell tests: caches, batcher, fake cloud, cloudprovider
+boundary, and the full provision → launch → register → bind loop.
+
+Mirrors the reference's stratum 1-2 strategy (SURVEY.md §4): the real
+provisioner + solver run in-process over the fake cloud with a FakeClock,
+with strict state reset between tests (reference pkg/test/environment.go
+Reset / pkg/fake/ec2api.go Reset).
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.apis import NodePool, Operator as ReqOperator, Pod, Requirement
+from karpenter_provider_aws_tpu.apis import wellknown as wk
+from karpenter_provider_aws_tpu.apis.objects import NodeClaimPhase, NodeClass
+from karpenter_provider_aws_tpu.batcher import Batcher, BatcherOptions
+from karpenter_provider_aws_tpu.cache import TTLCache, UnavailableOfferings
+from karpenter_provider_aws_tpu.cloud import FakeCloud, LaunchOverride
+from karpenter_provider_aws_tpu.cloudprovider import nodeclass_hash
+from karpenter_provider_aws_tpu.errors import NotFoundError, UnfulfillableCapacityError
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+from karpenter_provider_aws_tpu.operator import Operator, Options
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+_FAMILIES = ("m5", "c5", "r5", "t3")
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    return build_lattice([s for s in build_catalog() if s.family in _FAMILIES])
+
+
+@pytest.fixture()
+def env(lattice):
+    clock = FakeClock()
+    op = Operator(options=Options(registration_delay=2.0), lattice=lattice,
+                  cloud=FakeCloud(clock), clock=clock)
+    return op
+
+
+def pods(n, cpu="500m", mem="1Gi", prefix="pod", **kw):
+    return [Pod(name=f"{prefix}-{i}", requests={"cpu": cpu, "memory": mem}, **kw)
+            for i in range(n)]
+
+
+class TestTTLCache:
+    def test_expiry_and_eviction_hook(self):
+        clock = FakeClock()
+        evicted = []
+        c = TTLCache(ttl=10.0, clock=clock, on_evict=lambda k, v: evicted.append(k))
+        c.set("a", 1)
+        assert c.get("a") == 1
+        clock.step(11)
+        assert c.get("a") is None
+        assert len(c) == 0
+
+    def test_cleanup_counts(self):
+        clock = FakeClock()
+        c = TTLCache(ttl=5.0, clock=clock)
+        c.set("a", 1)
+        c.set("b", 2, ttl=100.0)
+        clock.step(6)
+        assert c.cleanup() == 1
+        assert c.get("b") == 2
+
+
+class TestUnavailableOfferings:
+    def test_mask_and_ttl(self, lattice):
+        clock = FakeClock()
+        u = UnavailableOfferings(clock)
+        t = lattice.names[0]
+        z = lattice.zones[0]
+        seq0 = u.seq_num
+        u.mark_unavailable("ice", "on-demand", t, z)
+        assert u.is_unavailable("on-demand", t, z)
+        assert u.seq_num > seq0
+        m = u.mask(lattice)
+        ti, zi = lattice.name_to_idx[t], 0
+        ci = lattice.capacity_types.index("on-demand")
+        assert not m[ti, zi, ci]
+        assert m.sum() == m.size - 1
+        clock.step(200)  # 3-minute TTL expired
+        assert not u.is_unavailable("on-demand", t, z)
+        assert u.mask(lattice).all()
+
+
+class TestBatcher:
+    def test_coalesces_concurrent_requests(self):
+        import threading
+        calls = []
+
+        def batch_fn(reqs):
+            calls.append(list(reqs))
+            return [r * 2 for r in reqs]
+
+        b = Batcher(batch_fn, BatcherOptions(idle_seconds=0.05, max_seconds=1.0))
+        results = {}
+
+        def worker(i):
+            results[i] = b.add(i)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results == {i: i * 2 for i in range(8)}
+        assert len(calls) == 1, f"expected one fused call, got {calls}"
+
+    def test_per_request_errors(self):
+        def batch_fn(reqs):
+            return [ValueError("boom") if r == 1 else r for r in reqs]
+
+        b = Batcher(batch_fn, BatcherOptions(idle_seconds=0.01))
+        assert b.add(0) == 0
+        with pytest.raises(ValueError):
+            b.add(1)
+
+
+class TestFakeCloud:
+    def test_fleet_picks_cheapest_available(self):
+        cloud = FakeCloud(FakeClock())
+        o1 = LaunchOverride("m5.large", "us-west-2a", "on-demand", 0.10)
+        o2 = LaunchOverride("c5.large", "us-west-2a", "on-demand", 0.08)
+        inst = cloud.create_fleet([o1, o2])
+        assert inst.instance_type == "c5.large"
+
+    def test_ice_pool_exhaustion_and_release(self):
+        cloud = FakeCloud(FakeClock())
+        cloud.set_capacity("on-demand", "m5.large", "us-west-2a", 1)
+        o = LaunchOverride("m5.large", "us-west-2a", "on-demand", 0.10)
+        inst = cloud.create_fleet([o])
+        with pytest.raises(UnfulfillableCapacityError) as ei:
+            cloud.create_fleet([o])
+        assert ("on-demand", "m5.large", "us-west-2a") in ei.value.offerings
+        cloud.terminate_instances([inst.id])  # capacity returns
+        assert cloud.create_fleet([o]).instance_type == "m5.large"
+
+    def test_error_injection_fires_once(self):
+        cloud = FakeCloud(FakeClock())
+        cloud.inject_error(RuntimeError("api down"))
+        with pytest.raises(RuntimeError):
+            cloud.list_instances()
+        assert cloud.list_instances() == []
+
+
+class TestCloudProviderBoundary:
+    def test_create_populates_claim(self, env):
+        env.cluster.add_pod(pods(1)[0])
+        env.provisioner.provision_once()
+        (claim,) = env.cluster.claims.values()
+        assert claim.phase == NodeClaimPhase.LAUNCHED
+        assert claim.provider_id and claim.instance_type
+        assert claim.capacity["cpu"] > 0 and claim.allocatable["cpu"] > 0
+        assert claim.labels[wk.LABEL_INSTANCE_TYPE] == claim.instance_type
+        assert claim.labels[wk.LABEL_NODEPOOL] == "default"
+        assert wk.ANNOTATION_NODECLASS_HASH in claim.annotations
+
+    def test_spot_preferred_when_allowed(self, env, lattice):
+        pool = NodePool(name="spotty", requirements=[
+            Requirement(wk.LABEL_CAPACITY_TYPE, ReqOperator.IN, ("spot", "on-demand"))])
+        env.node_pools["spotty"] = pool
+        del env.node_pools["default"]
+        env.cluster.add_pod(pods(1)[0])
+        env.provisioner.provision_once()
+        (claim,) = env.cluster.claims.values()
+        assert claim.capacity_type == "spot"
+
+    def test_ice_feedback_relaunches_elsewhere(self, env, lattice):
+        """The launch ICE path: offering exhausted → marked unavailable →
+        the SAME claim launch falls through to the next-cheapest override."""
+        p = pods(1, cpu="1800m", mem="7Gi")[0]
+        env.cluster.add_pod(p)
+        # dry-run solve to find the would-be choice, then exhaust it
+        probe = env.provisioner.provision_once()
+        choice = probe.plan.new_nodes[0]
+        (claim,) = env.cluster.claims.values()
+        assert claim.instance_type == choice.instance_type
+        # now exhaust that pool and force a second pod through the same path
+        env.cloud.set_capacity(choice.capacity_type, choice.instance_type, choice.zone, 0)
+        p2 = pods(1, cpu="1800m", mem="7Gi", prefix="again")[0]
+        env.cluster.add_pod(p2)
+        r2 = env.provisioner.provision_once()
+        assert r2.launched == 1
+        claims = list(env.cluster.claims.values())
+        launched2 = [c for c in claims if c.name != claim.name]
+        assert launched2, "second claim should have launched on an alternative offering"
+        alt = launched2[0]
+        assert (alt.instance_type, alt.zone) != (choice.instance_type, choice.zone)
+
+    def test_is_drifted_on_nodeclass_change(self, env):
+        env.cluster.add_pod(pods(1)[0])
+        env.provisioner.provision_once()
+        (claim,) = env.cluster.claims.values()
+        assert env.cloud_provider.is_drifted(claim) is None
+        env.node_classes["default"].user_data = "#!/bin/bash echo changed"
+        assert env.cloud_provider.is_drifted(claim) == "NodeClassDrift"
+
+    def test_drift_on_missing_instance(self, env):
+        env.cluster.add_pod(pods(1)[0])
+        env.provisioner.provision_once()
+        (claim,) = env.cluster.claims.values()
+        from karpenter_provider_aws_tpu.cloud.fake import parse_instance_id
+        env.cloud.terminate_instances([parse_instance_id(claim.provider_id)])
+        assert env.cloud_provider.is_drifted(claim) == "InstanceDrift"
+
+    def test_exotic_types_filtered_for_generic_pods(self, lattice):
+        clock = FakeClock()
+        full = build_lattice([s for s in build_catalog()
+                              if s.family in ("m5", "g5", "p4d")])
+        op = Operator(lattice=full, cloud=FakeCloud(clock), clock=clock)
+        op.cluster.add_pod(pods(1)[0])
+        op.provisioner.provision_once()
+        (claim,) = op.cluster.claims.values()
+        spec = full.specs[full.name_to_idx[claim.instance_type]]
+        assert spec.gpu_count == 0
+
+
+class TestEndToEnd:
+    def test_provision_register_bind(self, env):
+        for p in pods(20):
+            env.cluster.add_pod(p)
+        rounds = env.settle()
+        assert rounds < 50
+        assert not env.cluster.pending_pods()
+        bound = [p for p in env.cluster.pods.values() if p.node_name]
+        assert len(bound) == 20
+        assert all(c.phase == NodeClaimPhase.INITIALIZED
+                   for c in env.cluster.claims.values())
+        # every node's instance exists in the cloud
+        for node in env.cluster.nodes.values():
+            assert env.cloud_provider.get(node.provider_id)
+
+    def test_batch_window_idle_then_fire(self, env):
+        env.cluster.add_pod(pods(1)[0])
+        assert not env.provisioner.batch_ready()  # window opens
+        env.clock.step(0.5)
+        env.cluster.add_pod(pods(1, prefix="late")[0])
+        assert not env.provisioner.batch_ready()  # arrival resets idle
+        env.clock.step(1.1)
+        assert env.provisioner.batch_ready()
+
+    def test_nodepool_limits_downsize_then_block(self, env, lattice):
+        from karpenter_provider_aws_tpu.apis.resources import axis
+        env.node_pools["default"].limits = {"cpu": "8"}
+        # 3 x 2cpu fits an 8-cpu type: the plan downsizes into the limit
+        for p in pods(3, cpu="2", mem="1Gi"):
+            env.cluster.add_pod(p)
+        r1 = env.provisioner.provision_once()
+        assert r1.launched == 1
+        (claim,) = env.cluster.claims.values()
+        assert claim.capacity["cpu"] <= 8000.0
+        # the budget is now exhausted: the next batch cannot launch
+        for p in pods(3, cpu="2", mem="1Gi", prefix="over"):
+            env.cluster.add_pod(p)
+        r2 = env.provisioner.provision_once()
+        assert r2.launched == 0
+        assert r2.pods_unschedulable == 3
+        usage = env.cluster.pool_usage()["default"]
+        assert usage[axis("cpu")] <= 8000.0 + 1e-3
+
+    def test_gc_terminates_leaked_instance(self, env):
+        inst = env.cloud.create_fleet([LaunchOverride("m5.large", "us-west-2a",
+                                                      "on-demand", 0.1)])
+        env.clock.step(31)
+        env.gc.reconcile()
+        assert env.cloud.instances[inst.id].state == "terminated"
+
+    def test_gc_removes_claim_for_vanished_instance(self, env):
+        env.cluster.add_pod(pods(1)[0])
+        env.provisioner.provision_once()
+        env.settle()
+        (claim,) = env.cluster.claims.values()
+        from karpenter_provider_aws_tpu.cloud.fake import parse_instance_id
+        env.cloud.terminate_instances([parse_instance_id(claim.provider_id)])
+        env.gc.reconcile()
+        assert not env.cluster.claims
+        assert not env.cluster.nodes
+        assert env.cluster.pending_pods(), "pods should be pending again"
+
+    def test_termination_drains_and_deletes(self, env):
+        for p in pods(3):
+            env.cluster.add_pod(p)
+        env.settle()
+        (claim,) = env.cluster.claims.values()
+        env.termination.delete_claim(claim.name)
+        env.termination.reconcile()
+        assert not env.cluster.claims and not env.cluster.nodes
+        assert len(env.cluster.pending_pods()) == 3
+        assert all(i.state == "terminated" for i in env.cloud.instances.values())
+
+    def test_relaunch_after_interruption_like_delete(self, env):
+        for p in pods(3):
+            env.cluster.add_pod(p)
+        env.settle()
+        (claim,) = env.cluster.claims.values()
+        env.termination.delete_claim(claim.name)
+        rounds = env.settle()
+        assert rounds < 50
+        claims = list(env.cluster.claims.values())
+        assert len(claims) == 1 and claims[0].name != claim.name
+        assert not env.cluster.pending_pods()
+
+
+class TestOptions:
+    def test_env_layering(self, monkeypatch):
+        monkeypatch.setenv("CLUSTER_NAME", "prod")
+        monkeypatch.setenv("BATCH_IDLE_DURATION", "0.5")
+        o = Options.from_env(batch_max_duration=5.0)
+        assert o.cluster_name == "prod"
+        assert o.batch_idle_duration == 0.5
+        assert o.batch_max_duration == 5.0
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            Options(batch_idle_duration=5.0, batch_max_duration=1.0).validate()
+
+    def test_nodeclass_hash_stable(self):
+        a = NodeClass(name="x", user_data="a")
+        b = NodeClass(name="y", user_data="a")
+        c = NodeClass(name="x", user_data="b")
+        assert nodeclass_hash(a) == nodeclass_hash(b)
+        assert nodeclass_hash(a) != nodeclass_hash(c)

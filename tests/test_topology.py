@@ -362,3 +362,45 @@ class TestReviewRegressions:
         caps = Counter(n.capacity_type for n in plan.new_nodes for _ in n.pods)
         assert sum(caps.values()) == 9
         assert max(caps.values()) - min(caps.values()) <= 1
+
+    def test_zone_spread_shared_selector_across_sibling_groups(self, solver, lattice):
+        """Two deployments sharing labels/selector but different requests
+        must satisfy the skew bound COMBINED, not per group."""
+        labels = {"app": "web"}
+        a = spread_pods(4, labels=labels, prefix="za")
+        b = [Pod(name=f"zb-{i}", labels=dict(labels),
+                 requests={"cpu": "250m", "memory": "512Mi"},
+                 topology_spread=[TopologySpreadConstraint(
+                     max_skew=1, topology_key=wk.LABEL_ZONE,
+                     label_selector=tuple(labels.items()))]) for i in range(4)]
+        problem = build_problem(a + b, [NodePool(name="default")], lattice)
+        plan = solver.solve(problem)
+        zones = Counter(zone_of_pod(plan).values())
+        assert sum(zones.values()) == 8
+        assert max(zones.values()) - min(zones.values()) <= 1
+
+    def test_irrelevant_labels_do_not_break_dedup(self, lattice):
+        """StatefulSet-style per-pod-unique labels must not explode the
+        group count (they appear in no selector)."""
+        from karpenter_provider_aws_tpu.solver import build_problem as bp
+        pods = [Pod(name=f"ss-{i}", labels={"app": "db", "pod-name": f"ss-{i}"},
+                    requests={"cpu": "500m", "memory": "1Gi"}) for i in range(100)]
+        problem = bp(pods, [NodePool(name="default")], lattice)
+        assert problem.G == 1
+
+    def test_warnings_deduplicated(self, solver, lattice):
+        pods = [Pod(name=f"p{i}", requests={"cpu": "1"}, topology_spread=[
+            TopologySpreadConstraint(max_skew=1, topology_key="example.com/rack")])
+            for i in range(10)]
+        problem = build_problem(pods, [NodePool(name="default")], lattice)
+        assert len(problem.warnings) == 1
+
+    def test_split_counts_pins_need_groups_to_shard0(self):
+        from karpenter_provider_aws_tpu.parallel import split_counts
+        count = np.array([8, 8, 8], dtype=np.int32)
+        keep = np.array([False, True, True])
+        pin = np.array([False, False, True])
+        out = split_counts(count, 4, keep_whole=keep, pin_shard0=pin)
+        assert out.sum(axis=0).tolist() == [8, 8, 8]
+        assert (out[:, 1] > 0).sum() == 1          # whole on one shard
+        assert out[0, 2] == 8 and out[1:, 2].sum() == 0  # pinned to shard 0

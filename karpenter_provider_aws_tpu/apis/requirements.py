@@ -211,6 +211,25 @@ class Requirements:
                     return False
         return True
 
+    def compatible_with(self, other: "Requirements", *,
+                        allow_undefined_well_known: bool = True) -> bool:
+        """DIRECTIONAL Compatible (reference cloudprovider.go:248 semantics):
+        these requirements, evaluated against a node/pool described by
+        ``other``. Shared keys must overlap; a key only WE constrain with an
+        existence-requiring operator fails unless well-known (the lattice
+        always defines well-known keys). Keys only ``other`` defines (e.g.
+        NodePool template labels) are values the node will carry — they are
+        never demands on us, which is what the symmetric ``intersects``
+        would wrongly make them."""
+        for key, c in self._constraints.items():
+            if key in other._constraints:
+                if not c.intersects(other._constraints[key]):
+                    return False
+            elif not c.allows_absent:
+                if not (allow_undefined_well_known and key in wellknown.WELL_KNOWN_KEYS):
+                    return False
+        return True
+
     def intersects(self, other: "Requirements", *, allow_undefined_well_known: bool = True) -> bool:
         for key in set(self._constraints) & set(other._constraints):
             if not self._constraints[key].intersects(other._constraints[key]):

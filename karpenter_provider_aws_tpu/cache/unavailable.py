@@ -66,7 +66,15 @@ class UnavailableOfferings:
             self._seq += 1
 
     def cleanup(self) -> int:
-        return self._cache.cleanup()
+        """Expire stale entries. Expiry CHANGES the offering set (capacity is
+        back on the market), so it bumps seq_num like marking does —
+        downstream fingerprints (e.g. the disruption controller's failed-
+        search cache) must invalidate when offerings return."""
+        n = self._cache.cleanup()
+        if n:
+            with self._lock:
+                self._seq += 1
+        return n
 
     def entries(self) -> Iterable[Offering]:
         for key, _ in self._cache.items():

@@ -35,7 +35,7 @@ import numpy as np
 from ..apis import wellknown as wk
 from ..apis.objects import NodeClaim, NodeClaimPhase, NodeClass, NodePool
 from ..apis.requirements import Requirements
-from ..apis.resources import RESOURCE_AXES, axis
+from ..apis.resources import vec_to_resources
 from ..batcher import Batcher, BatcherOptions
 from ..cache.unavailable import UnavailableOfferings
 from ..cloud.fake import CloudInstance, FakeCloud, LaunchOverride, parse_instance_id
@@ -82,11 +82,6 @@ class InstanceType:
     capacity: Dict[str, float]
     allocatable: Dict[str, float]
     offerings: List[OfferingView] = field(default_factory=list)
-
-
-def _resources_dict(vec: np.ndarray) -> Dict[str, float]:
-    return {name: float(vec[i]) for name, i in
-            ((n, axis(n)) for n in RESOURCE_AXES) if vec[i] > 0}
 
 
 class CloudProvider:
@@ -212,8 +207,8 @@ class CloudProvider:
         claim.instance_type = instance.instance_type
         claim.zone = instance.zone
         claim.capacity_type = instance.capacity_type
-        claim.capacity = _resources_dict(lat.capacity[ti])
-        claim.allocatable = _resources_dict(lat.alloc[ti])
+        claim.capacity = vec_to_resources(lat.capacity[ti])
+        claim.allocatable = vec_to_resources(lat.alloc[ti])
         claim.labels = {
             **lat.labels[ti],
             **claim.labels,
@@ -280,8 +275,8 @@ class CloudProvider:
                         available=bool(ice[t, z, c] and masks.zone_mask[z] and masks.cap_mask[c])))
             out.append(InstanceType(
                 name=lat.names[t], labels=dict(lat.labels[t]),
-                capacity=_resources_dict(lat.capacity[t]),
-                allocatable=_resources_dict(lat.alloc[t]),
+                capacity=vec_to_resources(lat.capacity[t]),
+                allocatable=vec_to_resources(lat.alloc[t]),
                 offerings=offerings))
         return out
 

@@ -2,6 +2,7 @@ from .provisioning import Provisioner
 from .lifecycle import LifecycleController
 from .garbagecollection import GarbageCollectionController
 from .termination import TerminationController
+from .disruption import DisruptionController
 
 __all__ = ["Provisioner", "LifecycleController", "GarbageCollectionController",
-           "TerminationController"]
+           "TerminationController", "DisruptionController"]

@@ -1,0 +1,391 @@
+"""Disruption controller: expiration → drift → emptiness → consolidation.
+
+Mirror of the core disruption orchestration (reference website
+concepts/disruption.md:16-27 method order; designs/consolidation.md
+deletion-vs-replacement and cost rules; budgets math disruption.md:193-222
++ CRD karpenter.sh_nodepools.yaml:55-100). The consolidation simulation —
+"remove candidate set S: do its pods fit on the remaining nodes plus at
+most one new, cheaper node?" — is exactly a what-if Solve() on the device:
+candidate bins drop out of the existing-bin table, their pods re-enter as
+pending, and the same grouped-FFD kernel answers feasibility and the
+replacement's price in one pass (SURVEY.md §2.2: the second workload the
+north star moves on-device).
+
+Method semantics:
+- expiration: claims older than the pool's expire_after are replaced.
+- drift: CloudProvider.IsDrifted or a NodePool template-hash mismatch
+  (feature-gated, settings.md:40-47).
+- emptiness: nodes with no non-daemonset pods after consolidate_after are
+  deleted in parallel (disruption.md:93 "empty nodes first").
+- consolidation (WhenUnderutilized): multi-node first — the largest
+  candidate prefix (sorted by disruption cost) whose pods repack onto the
+  remaining capacity + ≤1 cheaper node — then single-node scan
+  (disruption.md:93-98). Spot→spot replacement requires ≥15-type
+  flexibility and its feature gate (disruption.md:129).
+
+Replacement safety: replacements launch FIRST; originals are drained only
+after every replacement's node registers (disruption.md:23-25).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..apis import wellknown as wk
+from ..apis.objects import NodeClaim, NodeClaimPhase, NodePool, Pod
+from ..cache.unavailable import UnavailableOfferings
+from ..cloudprovider.cloudprovider import CloudProvider
+from ..errors import UnfulfillableCapacityError
+from ..events import Recorder
+from ..lattice.tensors import masked_view
+from ..solver.problem import build_problem
+from ..solver.solve import NodePlan, Solver
+from ..state.cluster import ClusterState
+from ..utils.clock import Clock
+from .provisioning import Provisioner, nodepool_hash
+from .termination import TerminationController
+
+SPOT_TO_SPOT_MIN_TYPES = 15   # disruption.md:129
+CONSOLIDATION_SAVINGS_EPS = 1e-4
+
+
+@dataclass
+class DisruptionAction:
+    reason: str                       # Expired | Drifted | Empty | Underutilized
+    claims: List[str]                 # originals to remove
+    replacements: List[str] = field(default_factory=list)  # claim names launched
+    def __post_init__(self):
+        self.claims = list(self.claims)
+
+
+class DisruptionController:
+    def __init__(self, cluster: ClusterState, solver: Solver,
+                 node_pools: Dict[str, NodePool],
+                 cloud_provider: CloudProvider,
+                 provisioner: Provisioner,
+                 termination: TerminationController,
+                 unavailable: UnavailableOfferings,
+                 recorder: Optional[Recorder] = None,
+                 clock: Optional[Clock] = None,
+                 drift_enabled: bool = True,
+                 spot_to_spot_consolidation: bool = False):
+        self.cluster = cluster
+        self.solver = solver
+        self.node_pools = node_pools
+        self.cloud_provider = cloud_provider
+        self.provisioner = provisioner
+        self.termination = termination
+        self.unavailable = unavailable
+        self.clock = clock or Clock()
+        self.recorder = recorder or Recorder(self.clock)
+        self.drift_enabled = drift_enabled
+        self.spot_to_spot_consolidation = spot_to_spot_consolidation
+        self._in_flight: List[DisruptionAction] = []
+        # per-pass what-if budget (the reference bounds each disruption loop
+        # with a timeout; we bound by solve count) + a state fingerprint so
+        # an unchanged cluster never re-runs a failed consolidation search
+        self.max_whatif_per_pass = 16
+        self._whatif_used = 0
+        self._last_failed_fingerprint = None
+
+    # ---- budgets (disruption.md:193-222) ---------------------------------
+
+    def _allowed_disruptions(self, pool: NodePool, reason: str) -> int:
+        total = sum(1 for c in self.cluster.claims.values()
+                    if c.node_pool == pool.name and not c.deletion_timestamp)
+        disrupting = sum(1 for a in self._in_flight for n in a.claims
+                         if n in self.cluster.claims
+                         and self.cluster.claims[n].node_pool == pool.name)
+        allowed = total
+        for budget in pool.disruption.budgets:
+            if budget.reasons and reason not in budget.reasons:
+                continue
+            spec = str(budget.nodes)
+            if spec.endswith("%"):
+                # percentages round UP (disruption.md: "4 disruptions ...
+                # rounding up from 19 * .2 = 3.8")
+                val = int(np.ceil(total * float(spec[:-1]) / 100.0))
+            else:
+                val = int(spec)
+            allowed = min(allowed, val)
+        return max(allowed - disrupting, 0)
+
+    # ---- candidate discovery --------------------------------------------
+
+    def _candidates(self) -> List[NodeClaim]:
+        """Initialized, healthy, not-already-disrupting claims with a
+        registered node."""
+        in_flight = {n for a in self._in_flight for n in a.claims}
+        out = []
+        for claim in self.cluster.claims.values():
+            if claim.deletion_timestamp or claim.name in in_flight:
+                continue
+            if claim.phase != NodeClaimPhase.INITIALIZED:
+                continue
+            if self.cluster.node_for_claim(claim.name) is None:
+                continue
+            if claim.node_pool not in self.node_pools:
+                continue
+            out.append(claim)
+        return out
+
+    def _pods_on(self, claim: NodeClaim) -> List[Pod]:
+        node = self.cluster.node_for_claim(claim.name)
+        if node is None:
+            return []
+        return [p for p in self.cluster.pods.values()
+                if p.node_name == node.name and not p.is_daemonset]
+
+    def _disruption_cost(self, claim: NodeClaim) -> float:
+        """Cheapest-to-disrupt first (consolidation.md disruption-cost
+        scoring: fewer/lower-priority pods = cheaper to move)."""
+        return float(sum(1 + p.priority for p in self._pods_on(claim)))
+
+    # ---- what-if solve (the on-device consolidation query) ---------------
+
+    def _what_if(self, removed: Sequence[NodeClaim]) -> Tuple[NodePlan, float]:
+        """Solve the cluster with `removed` gone; returns (plan, removed $/hr)."""
+        self._whatif_used += 1
+        lattice = masked_view(self.solver.lattice,
+                              self.unavailable.mask(self.solver.lattice))
+        removed_nodes = {self.cluster.node_for_claim(c.name).name for c in removed}
+        pods = [p for c in removed for p in self._pods_on(c)]
+        existing = [b for b in self.cluster.existing_bins(lattice)
+                    if b.name not in removed_nodes
+                    and b.name not in {c.name for c in removed}]
+        bound = [bp for bp in self.cluster.bound_pods()
+                 if bp.node_name not in removed_nodes]
+        problem = build_problem(
+            pods, list(self.node_pools.values()), lattice,
+            existing=existing, daemonset_pods=self.cluster.daemonset_pods(),
+            bound_pods=bound)
+        plan = self.solver.solve(problem)
+        removed_price = 0.0
+        for c in removed:
+            ti = lattice.name_to_idx.get(c.instance_type)
+            if ti is None:
+                continue
+            zi = lattice.zones.index(c.zone) if c.zone in lattice.zones else 0
+            ci = (lattice.capacity_types.index(c.capacity_type)
+                  if c.capacity_type in lattice.capacity_types else 0)
+            p = self.solver.lattice.price[ti, zi, ci]
+            removed_price += float(p) if np.isfinite(p) else 0.0
+        return plan, removed_price
+
+    def _spot_guard_ok(self, removed: Sequence[NodeClaim], plan: NodePlan) -> bool:
+        """Spot→spot single-node replacement needs ≥15-type flexibility and
+        the feature gate (disruption.md:129)."""
+        if not plan.new_nodes:
+            return True
+        if not any(c.capacity_type == wk.CAPACITY_TYPE_SPOT for c in removed):
+            return True
+        if not any(n.capacity_type == wk.CAPACITY_TYPE_SPOT for n in plan.new_nodes):
+            return True
+        if not self.spot_to_spot_consolidation:
+            return False
+        return all(len(n.feasible_types) >= SPOT_TO_SPOT_MIN_TYPES
+                   for n in plan.new_nodes
+                   if n.capacity_type == wk.CAPACITY_TYPE_SPOT)
+
+    # ---- reconcile --------------------------------------------------------
+
+    def _fingerprint(self):
+        return (
+            tuple(sorted((p.name, p.node_name or "") for p in self.cluster.pods.values())),
+            tuple(sorted(self.cluster.claims)),
+            self.unavailable.seq_num,
+            len(self._in_flight),
+        )
+
+    def reconcile(self) -> None:
+        self._advance_in_flight()
+        self._whatif_used = 0
+        # one new disruption decision per pass, in method order (the core
+        # serializes voluntary disruption the same way)
+        if self._reconcile_expiration():
+            self._last_failed_fingerprint = None
+            return
+        if self.drift_enabled and self._reconcile_drift():
+            self._last_failed_fingerprint = None
+            return
+        if self._reconcile_emptiness():
+            self._last_failed_fingerprint = None
+            return
+        fp = self._fingerprint()
+        if fp == self._last_failed_fingerprint:
+            return  # nothing changed since the search last came up empty
+        if self._reconcile_consolidation():
+            self._last_failed_fingerprint = None
+        else:
+            self._last_failed_fingerprint = fp
+
+    def _advance_in_flight(self) -> None:
+        """Drain originals whose replacements have all registered."""
+        done: List[DisruptionAction] = []
+        for action in self._in_flight:
+            ready = all(self.cluster.node_for_claim(r) is not None
+                        for r in action.replacements
+                        if r in self.cluster.claims)
+            lost = [r for r in action.replacements if r not in self.cluster.claims]
+            if lost:
+                # replacement failed (ICE/liveness): abandon the action
+                self.recorder.publish("Warning", "DisruptionAborted", "NodeClaim",
+                                      action.claims[0] if action.claims else "",
+                                      f"replacement(s) {lost} lost")
+                done.append(action)
+                continue
+            if ready:
+                for name in action.claims:
+                    self.termination.delete_claim(name)
+                    self.recorder.publish("Normal", "Disrupted", "NodeClaim", name,
+                                          action.reason)
+                done.append(action)
+        for a in done:
+            self._in_flight.remove(a)
+
+    def _begin(self, reason: str, removed: Sequence[NodeClaim],
+               plan: NodePlan) -> bool:
+        """Launch replacements (if any) then queue the drain."""
+        pool_budgets: Dict[str, int] = {}
+        for c in removed:
+            pool = self.node_pools[c.node_pool]
+            pool_budgets.setdefault(c.node_pool, self._allowed_disruptions(pool, reason))
+            if pool_budgets[c.node_pool] <= 0:
+                return False
+            pool_budgets[c.node_pool] -= 1
+        action = DisruptionAction(reason=reason, claims=[c.name for c in removed])
+        for node in plan.new_nodes:
+            claim = self.provisioner._make_claim(node)
+            self.cluster.add_claim(claim)
+            try:
+                self.cloud_provider.create(claim)
+            except UnfulfillableCapacityError:
+                # roll back: never drain without standing replacement capacity
+                for r in action.replacements:
+                    self.termination.delete_claim(r)
+                self.cluster.delete_claim(claim.name)
+                return False
+            action.replacements.append(claim.name)
+        self._in_flight.append(action)
+        return True
+
+    # ---- methods ----------------------------------------------------------
+
+    def _reconcile_expiration(self) -> bool:
+        now = self.clock.now()
+        for claim in self._candidates():
+            pool = self.node_pools[claim.node_pool]
+            expire = pool.disruption.expire_after
+            if expire is None or now - claim.created_at < expire:
+                continue
+            plan, _ = self._what_if([claim])
+            if plan.unschedulable:
+                continue
+            if self._begin("Expired", [claim], plan):
+                return True
+        return False
+
+    def _reconcile_drift(self) -> bool:
+        for claim in self._candidates():
+            pool = self.node_pools[claim.node_pool]
+            reason = self.cloud_provider.is_drifted(claim)
+            if reason is None:
+                have = claim.annotations.get(wk.ANNOTATION_NODEPOOL_HASH)
+                if have is not None and have != nodepool_hash(pool):
+                    reason = "NodePoolDrift"
+            if reason is None:
+                continue
+            plan, _ = self._what_if([claim])
+            if plan.unschedulable:
+                continue
+            if self._begin("Drifted", [claim], plan):
+                return True
+        return False
+
+    def _reconcile_emptiness(self) -> bool:
+        now = self.clock.now()
+        empties: List[NodeClaim] = []
+        for claim in self._candidates():
+            pool = self.node_pools[claim.node_pool]
+            after = pool.disruption.consolidate_after
+            if after is None:
+                continue
+            if self._pods_on(claim):
+                continue
+            ref = claim.initialized_at or claim.created_at
+            if now - ref < after:
+                continue
+            empties.append(claim)
+        if not empties:
+            return False
+        # parallel empty-node delete, budget-capped per pool
+        started = False
+        by_pool: Dict[str, List[NodeClaim]] = {}
+        for c in empties:
+            by_pool.setdefault(c.node_pool, []).append(c)
+        for pool_name, claims in by_pool.items():
+            budget = self._allowed_disruptions(self.node_pools[pool_name], "Empty")
+            batch = claims[:budget]
+            if not batch:
+                continue
+            if self._begin("Empty", batch, NodePlan([], {}, {}, 0.0, 0.0, 0.0)):
+                started = True
+        return started
+
+    def _reconcile_consolidation(self) -> bool:
+        now = self.clock.now()
+        candidates = []
+        for claim in self._candidates():
+            pool = self.node_pools[claim.node_pool]
+            if pool.disruption.consolidation_policy != "WhenUnderutilized":
+                continue
+            after = pool.disruption.consolidate_after
+            if after is not None:
+                ref = claim.initialized_at or claim.created_at
+                if now - ref < after:
+                    continue
+            candidates.append(claim)
+        if not candidates:
+            return False
+        candidates.sort(key=self._disruption_cost)
+
+        # multi-node: largest prefix that repacks onto remaining + <=1 new node
+        # (disruption.md:93-98 heuristic prefix search)
+        lo, hi, best = 1, len(candidates), None
+        while lo <= hi:
+            k = (lo + hi) // 2
+            removed = candidates[:k]
+            plan, removed_price = self._what_if(removed)
+            ok = (not plan.unschedulable and len(plan.new_nodes) <= 1
+                  and plan.new_node_cost < removed_price - CONSOLIDATION_SAVINGS_EPS
+                  and self._spot_guard_ok(removed, plan))
+            if ok:
+                best = (removed, plan)
+                lo = k + 1
+            else:
+                hi = k - 1
+        if best is not None:
+            removed, plan = best
+            if self._begin("Underutilized", removed, plan):
+                return True
+
+        # single-node scan: each candidate alone, allowing a cheaper
+        # replacement; bounded by the pass's remaining what-if budget (the
+        # next pass resumes only after the cluster changes)
+        for claim in candidates:
+            if self._whatif_used >= self.max_whatif_per_pass:
+                break
+            plan, removed_price = self._what_if([claim])
+            if plan.unschedulable or len(plan.new_nodes) > 1:
+                continue
+            if plan.new_node_cost >= removed_price - CONSOLIDATION_SAVINGS_EPS:
+                continue
+            if not self._spot_guard_ok([claim], plan):
+                continue
+            if self._begin("Underutilized", [claim], plan):
+                return True
+        return False

@@ -33,8 +33,22 @@ from ..solver.solve import NodePlan, PlannedNode, Solver
 from ..state.cluster import ClusterState
 from ..utils.clock import Clock
 
-BATCH_IDLE_SECONDS = 1.0   # settings.md:17 batch-idle-duration
-BATCH_MAX_SECONDS = 10.0   # settings.md:18 batch-max-duration
+BATCH_IDLE_SECONDS = 1.0   # settings.md:17 batch-idle-duration (default)
+BATCH_MAX_SECONDS = 10.0   # settings.md:18 batch-max-duration (default)
+
+
+def nodepool_hash(pool: NodePool) -> str:
+    """Template hash for NodePool drift detection (the core's
+    karpenter.sh/nodepool-hash annotation; CRD nodepools drift semantics)."""
+    import hashlib
+    import json
+    payload = json.dumps({
+        "labels": sorted(pool.labels.items()),
+        "taints": [(t.key, t.value, t.effect) for t in pool.taints],
+        "requirements": [(r.key, r.operator.value, r.values) for r in pool.requirements],
+        "node_class_ref": pool.node_class_ref,
+    }, sort_keys=True, default=str)
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
 
 
 @dataclass
@@ -53,7 +67,9 @@ class Provisioner:
                  cloud_provider: CloudProvider,
                  unavailable: UnavailableOfferings,
                  recorder: Optional[Recorder] = None,
-                 clock: Optional[Clock] = None):
+                 clock: Optional[Clock] = None,
+                 batch_idle_seconds: float = BATCH_IDLE_SECONDS,
+                 batch_max_seconds: float = BATCH_MAX_SECONDS):
         self.cluster = cluster
         self.solver = solver
         self.node_pools = node_pools
@@ -61,6 +77,8 @@ class Provisioner:
         self.unavailable = unavailable
         self.clock = clock or Clock()
         self.recorder = recorder or Recorder(self.clock)
+        self.batch_idle_seconds = batch_idle_seconds
+        self.batch_max_seconds = batch_max_seconds
         self._claim_ids = itertools.count(1)
         self._batch_start: Optional[float] = None
         self._last_pod_seen: Optional[float] = None
@@ -88,8 +106,8 @@ class Provisioner:
             if n != self._known_pending:
                 self._known_pending = n
                 self._last_pod_seen = now
-            idle_over = now - self._last_pod_seen >= BATCH_IDLE_SECONDS
-            max_over = now - self._batch_start >= BATCH_MAX_SECONDS
+            idle_over = now - self._last_pod_seen >= self.batch_idle_seconds
+            max_over = now - self._batch_start >= self.batch_max_seconds
             if idle_over or max_over:
                 self._batch_start = None
                 self._last_pod_seen = None
@@ -167,7 +185,15 @@ class Provisioner:
                 out.append(node)
                 continue
             current = usage.get(node.node_pool, np.zeros((R,), np.float32))
-            limited = limit > 0
+            # an axis is limited iff the pool names it — an explicit 0 is the
+            # standard "pause this pool" pattern and must block, not bypass
+            from ..apis.resources import axis as res_axis
+            limited = np.zeros_like(limit, dtype=bool)
+            for key in pool.limits:
+                try:
+                    limited[res_axis(key)] = True
+                except KeyError:
+                    pass
             remaining = np.where(limited, limit - current, np.inf)
 
             def fits(tname: str) -> bool:
@@ -218,7 +244,8 @@ class Provisioner:
         claim = NodeClaim(
             name=name, node_pool=node.node_pool,
             requirements=reqs, resource_requests=requests,
-            labels=dict(pool.labels), annotations={},
+            labels=dict(pool.labels),
+            annotations={wk.ANNOTATION_NODEPOOL_HASH: nodepool_hash(pool)},
             taints=list(pool.taints), node_class_ref=pool.node_class_ref,
             created_at=self.clock.now())
         return claim

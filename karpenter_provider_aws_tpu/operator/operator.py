@@ -18,6 +18,7 @@ from ..apis.objects import NodeClass, NodePool
 from ..cache.unavailable import UnavailableOfferings
 from ..cloud.fake import FakeCloud
 from ..cloudprovider.cloudprovider import CloudProvider
+from ..controllers.disruption import DisruptionController
 from ..controllers.garbagecollection import GarbageCollectionController
 from ..controllers.lifecycle import LifecycleController
 from ..controllers.provisioning import Provisioner
@@ -57,7 +58,9 @@ class Operator:
         self.solver = Solver(self.lattice)
         self.provisioner = Provisioner(
             self.cluster, self.solver, self.node_pools, self.cloud_provider,
-            self.unavailable, self.recorder, self.clock)
+            self.unavailable, self.recorder, self.clock,
+            batch_idle_seconds=self.options.batch_idle_duration,
+            batch_max_seconds=self.options.batch_max_duration)
         self.lifecycle = LifecycleController(
             self.cluster, self.cloud_provider, self.recorder, self.clock,
             registration_delay=self.options.registration_delay)
@@ -65,6 +68,11 @@ class Operator:
             self.cluster, self.cloud_provider, self.recorder, self.clock)
         self.gc = GarbageCollectionController(
             self.cluster, self.cloud_provider, self.recorder, self.clock)
+        self.disruption = DisruptionController(
+            self.cluster, self.solver, self.node_pools, self.cloud_provider,
+            self.provisioner, self.termination, self.unavailable, self.recorder,
+            self.clock, drift_enabled=self.options.drift_enabled,
+            spot_to_spot_consolidation=self.options.spot_to_spot_consolidation)
         self._last_cache_cleanup = 0.0
 
     # ---- run loop --------------------------------------------------------
@@ -74,6 +82,7 @@ class Operator:
         if force_provision or self.provisioner.batch_ready():
             self.provisioner.provision_once()
         self.lifecycle.reconcile()
+        self.disruption.reconcile()
         self.termination.reconcile()
         self.gc.reconcile()
         now = self.clock.now()

@@ -205,7 +205,7 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
             if not tolerates_all(ds.tolerations, pool.taints + pool.startup_taints):
                 continue
             ds_reqs = ds.scheduling_requirements()
-            if not ds_reqs.intersects(reqs):
+            if not ds_reqs.compatible_with(reqs):
                 continue
             if not _custom_keys_ok(ds_reqs, pool.labels):
                 continue
@@ -251,7 +251,8 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
         masks = compile_masks(reqs, lattice, skip_unresolved_custom=True)
         np_ok = np.zeros((NP,), dtype=bool)
         for pi, pool in enumerate(pools):
-            if not reqs.intersects(pool_reqs[pi]):
+            # directional: pod requirements vs the pool's node template
+            if not reqs.compatible_with(pool_reqs[pi]):
                 continue
             if not tolerates_all(rep.tolerations, pool.taints + pool.startup_taints):
                 continue

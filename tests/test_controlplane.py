@@ -321,3 +321,45 @@ class TestOptions:
         c = NodeClass(name="x", user_data="b")
         assert nodeclass_hash(a) == nodeclass_hash(b)
         assert nodeclass_hash(a) != nodeclass_hash(c)
+
+
+class TestReviewRegressions:
+    def test_zero_limit_pauses_pool(self, env):
+        """limits={'cpu': 0} is the standard pause-the-pool pattern."""
+        env.node_pools["default"].limits = {"cpu": 0}
+        env.cluster.add_pod(pods(1)[0])
+        r = env.provisioner.provision_once()
+        assert r.launched == 0 and r.pods_unschedulable == 1
+
+    def test_batch_windows_wired_from_options(self, lattice):
+        clock = FakeClock()
+        op = Operator(options=Options(batch_idle_duration=0.2, batch_max_duration=5.0),
+                      lattice=lattice, cloud=FakeCloud(clock), clock=clock)
+        op.cluster.add_pod(pods(1)[0])
+        assert not op.provisioner.batch_ready()
+        clock.step(0.3)   # past the custom idle window, well under default 1s
+        assert op.provisioner.batch_ready()
+
+    def test_ice_expiry_bumps_seq(self, lattice):
+        clock = FakeClock()
+        u = UnavailableOfferings(clock)
+        u.mark_unavailable("ice", "on-demand", lattice.names[0], lattice.zones[0])
+        seq = u.seq_num
+        clock.step(200)
+        u.cleanup()
+        assert u.seq_num > seq
+
+    def test_nodepool_hash_annotation_set_and_drift(self, env):
+        from karpenter_provider_aws_tpu.apis import wellknown as wk2
+        env.cluster.add_pod(pods(1)[0])
+        env.provisioner.provision_once()
+        (claim,) = env.cluster.claims.values()
+        assert wk2.ANNOTATION_NODEPOOL_HASH in claim.annotations
+        env.settle()
+        env.node_pools["default"].labels["team"] = "new"
+        for _ in range(20):
+            env.run_once()
+            env.clock.step(2)
+        claims = list(env.cluster.claims.values())
+        assert claims and all(c.name != claim.name for c in claims), \
+            "NodePool template change must drift-replace the claim"

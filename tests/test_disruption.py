@@ -1,0 +1,287 @@
+"""Disruption tests: emptiness, consolidation (multi/single node), drift,
+expiration, budgets, spot-to-spot guard (BASELINE config 4 behavior).
+
+Behavioral spec: reference website concepts/disruption.md:16-27,87-129,
+193-222 and designs/consolidation.md. The what-if repack queries run on the
+device solver; these tests drive the full controller loop on a FakeClock.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.apis import NodePool, Operator as ReqOp, Pod, Requirement
+from karpenter_provider_aws_tpu.apis import wellknown as wk
+from karpenter_provider_aws_tpu.apis.objects import (
+    DisruptionBudget, NodeClaimPhase, NodePoolDisruption,
+)
+from karpenter_provider_aws_tpu.cloud import FakeCloud
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+from karpenter_provider_aws_tpu.operator import Operator, Options
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+_FAMILIES = ("m5", "c5", "r5", "t3")
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    return build_lattice([s for s in build_catalog() if s.family in _FAMILIES])
+
+
+def make_env(lattice, **pool_disruption):
+    clock = FakeClock()
+    disruption = NodePoolDisruption(**pool_disruption) if pool_disruption else NodePoolDisruption()
+    # on-demand pool: spot capacity would (correctly) gate replacement
+    # consolidation behind SpotToSpotConsolidation — tested separately
+    pool = NodePool(name="default", disruption=disruption, requirements=[
+        Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN, ("on-demand",))])
+    return Operator(options=Options(registration_delay=1.0), lattice=lattice,
+                    cloud=FakeCloud(clock), clock=clock, node_pools=[pool])
+
+
+def pods(n, cpu="500m", mem="1Gi", prefix="pod", **kw):
+    return [Pod(name=f"{prefix}-{i}", requests={"cpu": cpu, "memory": mem}, **kw)
+            for i in range(n)]
+
+
+class TestEmptiness:
+    def test_empty_node_deleted_after_consolidate_after(self, lattice):
+        env = make_env(lattice, consolidate_after=30.0)
+        for p in pods(4):
+            env.cluster.add_pod(p)
+        env.settle()
+        assert len(env.cluster.claims) >= 1
+        # drain the pods away (deployment scaled to zero)
+        for p in list(env.cluster.pods):
+            env.cluster.delete_pod(p)
+        env.clock.step(31)
+        env.run_once()   # disruption decides
+        env.run_once()   # termination executes
+        assert not env.cluster.claims
+        assert all(i.state == "terminated" for i in env.cloud.instances.values())
+
+    def test_empty_node_kept_before_window(self, lattice):
+        env = make_env(lattice, consolidate_after=300.0)
+        for p in pods(2):
+            env.cluster.add_pod(p)
+        env.settle()
+        for p in list(env.cluster.pods):
+            env.cluster.delete_pod(p)
+        env.clock.step(30)
+        env.run_once()
+        env.run_once()
+        assert env.cluster.claims, "node deleted before consolidate_after elapsed"
+
+
+class TestConsolidation:
+    def test_multi_node_repack(self, lattice):
+        """Config-4 shape (scaled): many under-utilized nodes repack onto
+        fewer when most pods disappear."""
+        env = make_env(lattice, consolidate_after=10.0)
+        # force one pod per node via hostname self-anti-affinity
+        from karpenter_provider_aws_tpu.apis.objects import PodAffinityTerm
+        anti = [PodAffinityTerm(topology_key=wk.LABEL_HOSTNAME,
+                                label_selector=(("app", "spread"),), anti=True)]
+        big = [Pod(name=f"b{i}", labels={"app": "spread"},
+                   requests={"cpu": "3", "memory": "6Gi"}, pod_affinity=list(anti))
+               for i in range(6)]
+        for p in big:
+            env.cluster.add_pod(p)
+        env.settle()
+        nodes_before = len(env.cluster.nodes)
+        assert nodes_before == 6
+        cost_before = sum(i.price for i in env.cloud.instances.values()
+                          if i.state == "running")
+        # replace the fleet's pods with tiny ones that could share one node
+        for p in list(env.cluster.pods):
+            env.cluster.delete_pod(p)
+        for p in pods(6, cpu="250m", mem="256Mi", prefix="tiny"):
+            env.cluster.add_pod(p)
+        env.settle()
+        env.clock.step(11)
+        for _ in range(40):          # let disruption converge
+            env.run_once()
+            env.clock.step(2)
+        running = [i for i in env.cloud.instances.values() if i.state == "running"]
+        assert len(env.cluster.nodes) < nodes_before
+        cost_after = sum(i.price for i in running)
+        assert cost_after < cost_before
+        # every tiny pod still bound
+        assert all(p.node_name for p in env.cluster.pods.values())
+
+    def test_single_node_cheaper_replacement(self, lattice):
+        """A lone pod on an oversized node is moved to a cheaper node."""
+        env = make_env(lattice, consolidate_after=10.0)
+        # land a big+small pod pair, then remove the big one
+        ps = pods(1, cpu="14", mem="24Gi", prefix="big") + pods(1, cpu="250m", mem="256Mi", prefix="small")
+        for p in ps:
+            env.cluster.add_pod(p)
+        env.settle()
+        assert len(env.cluster.nodes) == 1
+        big_type = next(iter(env.cluster.claims.values())).instance_type
+        env.cluster.delete_pod("big-0")
+        env.clock.step(11)
+        for _ in range(30):
+            env.run_once()
+            env.clock.step(2)
+        assert all(p.node_name for p in env.cluster.pods.values())
+        (claim,) = env.cluster.claims.values()
+        new_price = env.solver.lattice.price[
+            env.solver.lattice.name_to_idx[claim.instance_type]].min()
+        old_price = env.solver.lattice.price[
+            env.solver.lattice.name_to_idx[big_type]].min()
+        assert new_price < old_price
+
+    def test_replacement_launches_before_drain(self, lattice):
+        """Mid-disruption there is never a moment with pods unbound AND no
+        standing replacement capacity."""
+        env = make_env(lattice, consolidate_after=5.0)
+        ps = pods(1, cpu="14", mem="24Gi", prefix="big") + pods(1, cpu="250m", mem="256Mi", prefix="small")
+        for p in ps:
+            env.cluster.add_pod(p)
+        env.settle()
+        env.cluster.delete_pod("big-0")
+        env.clock.step(6)
+        env.disruption.reconcile()   # launches replacement, must NOT drain yet
+        assert len(env.cluster.claims) == 2, "replacement should coexist with original"
+        assert env.cluster.pods["small-0"].node_name is not None
+
+    def test_consolidation_never_when_policy_empty(self, lattice):
+        env = make_env(lattice, consolidate_after=5.0,
+                       consolidation_policy="WhenEmpty")
+        ps = pods(1, cpu="14", mem="24Gi", prefix="big") + pods(1, cpu="250m", mem="256Mi", prefix="small")
+        for p in ps:
+            env.cluster.add_pod(p)
+        env.settle()
+        env.cluster.delete_pod("big-0")
+        env.clock.step(60)
+        for _ in range(10):
+            env.run_once()
+            env.clock.step(2)
+        (claim,) = env.cluster.claims.values()
+        assert claim.phase == NodeClaimPhase.INITIALIZED
+
+
+class TestSpotGuard:
+    def _spot_env(self, lattice, gate: bool):
+        clock = FakeClock()
+        pool = NodePool(name="default",
+                        requirements=[Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN, ("spot",))],
+                        disruption=NodePoolDisruption(consolidate_after=5.0))
+        return Operator(options=Options(registration_delay=1.0,
+                                        spot_to_spot_consolidation=gate),
+                        lattice=lattice, cloud=FakeCloud(clock), clock=clock,
+                        node_pools=[pool])
+
+    def test_spot_to_spot_blocked_without_gate(self, lattice):
+        env = self._spot_env(lattice, gate=False)
+        ps = pods(1, cpu="14", mem="24Gi", prefix="big") + pods(1, cpu="250m", mem="256Mi", prefix="small")
+        for p in ps:
+            env.cluster.add_pod(p)
+        env.settle()
+        big_claim = next(iter(env.cluster.claims.values()))
+        env.cluster.delete_pod("big-0")
+        env.clock.step(6)
+        for _ in range(10):
+            env.run_once()
+            env.clock.step(2)
+        # replacement consolidation did NOT happen (still the big node)
+        assert big_claim.name in env.cluster.claims
+
+    def test_spot_to_spot_allowed_with_gate_and_flexibility(self, lattice):
+        env = self._spot_env(lattice, gate=True)
+        ps = pods(1, cpu="14", mem="24Gi", prefix="big") + pods(1, cpu="250m", mem="256Mi", prefix="small")
+        for p in ps:
+            env.cluster.add_pod(p)
+        env.settle()
+        big_claim = next(iter(env.cluster.claims.values()))
+        env.cluster.delete_pod("big-0")
+        env.clock.step(6)
+        for _ in range(30):
+            env.run_once()
+            env.clock.step(2)
+        assert big_claim.name not in env.cluster.claims
+
+
+class TestDriftAndExpiration:
+    def test_drifted_claim_replaced(self, lattice):
+        env = make_env(lattice)
+        for p in pods(2):
+            env.cluster.add_pod(p)
+        env.settle()
+        (claim,) = env.cluster.claims.values()
+        env.node_classes["default"].user_data = "#!/bin/bash new"
+        for _ in range(20):
+            env.run_once()
+            env.clock.step(2)
+        claims = list(env.cluster.claims.values())
+        assert claims and all(c.name != claim.name for c in claims)
+        assert all(p.node_name for p in env.cluster.pods.values())
+
+    def test_drift_disabled_gate(self, lattice):
+        clock = FakeClock()
+        env = Operator(options=Options(registration_delay=1.0, drift_enabled=False),
+                       lattice=lattice, cloud=FakeCloud(clock), clock=clock)
+        for p in pods(2):
+            env.cluster.add_pod(p)
+        env.settle()
+        (claim,) = env.cluster.claims.values()
+        env.node_classes["default"].user_data = "#!/bin/bash new"
+        for _ in range(10):
+            env.run_once()
+            env.clock.step(2)
+        assert claim.name in env.cluster.claims
+
+    def test_expiration_replaces_old_nodes(self, lattice):
+        env = make_env(lattice, expire_after=100.0)
+        for p in pods(2):
+            env.cluster.add_pod(p)
+        env.settle()
+        (claim,) = env.cluster.claims.values()
+        env.clock.step(101)
+        for _ in range(20):
+            env.run_once()
+            env.clock.step(2)
+        claims = list(env.cluster.claims.values())
+        assert claims and all(c.name != claim.name for c in claims)
+        assert all(p.node_name for p in env.cluster.pods.values())
+
+
+class TestBudgets:
+    def test_budget_caps_parallel_empty_deletes(self, lattice):
+        clock = FakeClock()
+        pool = NodePool(name="default", disruption=NodePoolDisruption(
+            consolidate_after=5.0,
+            budgets=[DisruptionBudget(nodes="1")]))
+        env = Operator(options=Options(registration_delay=1.0), lattice=lattice,
+                       cloud=FakeCloud(clock), clock=clock, node_pools=[pool])
+        from karpenter_provider_aws_tpu.apis.objects import PodAffinityTerm
+        anti = [PodAffinityTerm(topology_key=wk.LABEL_HOSTNAME,
+                                label_selector=(("app", "a"),), anti=True)]
+        for p in pods(3, cpu="2", mem="4Gi", labels={"app": "a"}, pod_affinity=anti):
+            env.cluster.add_pod(p)
+        env.settle()
+        assert len(env.cluster.claims) == 3
+        for p in list(env.cluster.pods):
+            env.cluster.delete_pod(p)
+        env.clock.step(6)
+        env.disruption.reconcile()
+        terminating = [c for c in env.cluster.claims.values() if c.deletion_timestamp]
+        queued = sum(len(a.claims) for a in env.disruption._in_flight)
+        assert queued <= 1, "budget of 1 must cap parallel disruption"
+
+    def test_zero_budget_blocks_all(self, lattice):
+        clock = FakeClock()
+        pool = NodePool(name="default", disruption=NodePoolDisruption(
+            consolidate_after=5.0, budgets=[DisruptionBudget(nodes="0")]))
+        env = Operator(options=Options(registration_delay=1.0), lattice=lattice,
+                       cloud=FakeCloud(clock), clock=clock, node_pools=[pool])
+        for p in pods(2):
+            env.cluster.add_pod(p)
+        env.settle()
+        for p in list(env.cluster.pods):
+            env.cluster.delete_pod(p)
+        env.clock.step(10)
+        for _ in range(5):
+            env.run_once()
+            env.clock.step(2)
+        assert env.cluster.claims, "0% budget must block disruption entirely"

@@ -169,3 +169,25 @@ def test_resources_to_vec_checked_unknown():
     vec, unknown = resources_to_vec_checked({"cpu": "1", "hugepages-2Mi": "1Gi"}, implicit_pod=True)
     assert unknown == ("hugepages-2Mi",)
     assert vec[0] == 1000.0
+
+
+class TestDirectionalCompatible:
+    def test_pool_custom_label_is_not_a_demand_on_pods(self):
+        from karpenter_provider_aws_tpu.apis.requirements import Requirements
+        pod = Requirements.from_node_selector({})
+        pool = Requirements.from_labels({"team": "infra"})
+        assert pod.compatible_with(pool)
+        # but a pod selecting a DIFFERENT team value is incompatible
+        pod2 = Requirements.from_node_selector({"team": "web"})
+        assert not pod2.compatible_with(pool)
+        # and a matching selector is compatible
+        pod3 = Requirements.from_node_selector({"team": "infra"})
+        assert pod3.compatible_with(pool)
+
+    def test_existence_on_unknown_custom_key_fails_directionally(self):
+        from karpenter_provider_aws_tpu.apis.requirements import (
+            Operator, Requirement, Requirements,
+        )
+        pod = Requirements([Requirement("example.com/special", Operator.EXISTS)])
+        pool = Requirements.from_labels({})
+        assert not pod.compatible_with(pool)

@@ -199,6 +199,7 @@ class NodeClass:
     metadata_options: MetadataOptions = field(default_factory=MetadataOptions)
     detailed_monitoring: bool = False
     associate_public_ip: Optional[bool] = None
+    annotations: Dict[str, str] = field(default_factory=dict)
     # status (hydrated by the nodeclass controller, reference nodeclass/controller.go:150-233)
     status_subnets: List[Dict] = field(default_factory=list)
     status_security_groups: List[Dict] = field(default_factory=list)

@@ -65,7 +65,9 @@ class FakeCloud:
     instance count (absent = unlimited; 0 = ICE), mirroring
     InsufficientCapacityPools (ec2api.go:40-44, 112-190)."""
 
-    def __init__(self, clock: Optional[Clock] = None):
+    def __init__(self, clock: Optional[Clock] = None,
+                 cluster_name: str = "sim", k8s_version: str = "1.29"):
+        from .network import FakeNetwork
         self.clock = clock or Clock()
         self._lock = threading.RLock()
         self._ids = itertools.count(1)
@@ -73,6 +75,8 @@ class FakeCloud:
         self.capacity_pools: Dict[Offering, int] = {}
         self.next_error: Optional[BaseException] = None
         self.calls: List[Tuple[str, object]] = []
+        # the VPC/IAM/image surface (subnets, SGs, AMIs+SSM, profiles, LTs)
+        self.network = FakeNetwork(cluster_name=cluster_name, k8s_version=k8s_version)
 
     # ---- fault injection -------------------------------------------------
 
@@ -168,3 +172,4 @@ class FakeCloud:
             self.capacity_pools.clear()
             self.next_error = None
             self.calls.clear()
+            self.network.reset()

@@ -94,13 +94,20 @@ class CloudProvider:
                  recorder: Optional[Recorder] = None,
                  clock: Optional[Clock] = None,
                  node_classes: Optional[Dict[str, NodeClass]] = None,
-                 batch_options: Optional[BatcherOptions] = None):
+                 batch_options: Optional[BatcherOptions] = None,
+                 subnets=None, launch_templates=None, version=None):
         self.lattice = lattice
         self.cloud = cloud
         self.unavailable = unavailable
         self.recorder = recorder or Recorder(clock)
         self.clock = clock or Clock()
-        self.node_classes: Dict[str, NodeClass] = node_classes or {"default": NodeClass(name="default")}
+        self.node_classes: Dict[str, NodeClass] = node_classes or {
+            "default": NodeClass(name="default", role="KarpenterNodeRole-sim")}
+        # optional domain providers (reference pkg/providers/*); absent in
+        # bare-solver setups, wired by the operator
+        self.subnets = subnets
+        self.launch_templates = launch_templates
+        self.version = version
         self._launch_batcher: Batcher = Batcher(
             self._launch_batch, batch_options or BatcherOptions(idle_seconds=0.005))
         self._terminate_batcher: Batcher = Batcher(
@@ -111,8 +118,25 @@ class CloudProvider:
 
     def create(self, claim: NodeClaim) -> NodeClaim:
         """Launch capacity satisfying the claim's requirements
-        (cloudprovider.go:80-109 → instance.go:84-244)."""
+        (cloudprovider.go:80-109 → instance.go:84-244): resolve the
+        NodeClass, ensure launch templates, cross overrides with zonal
+        subnets, launch, book in-flight IPs."""
+        nc = self.node_classes.get(claim.node_class_ref)
+        lts_by_arch = {}
+        if self.launch_templates is not None and nc is not None:
+            k8s_version = self.version.get() if self.version is not None else "1.29"
+            for lt in self.launch_templates.ensure_all(nc, k8s_version):
+                img = self.cloud.network.images.get(lt.image_id)
+                if img is not None:
+                    lts_by_arch[img.arch] = lt
+        zonal_subnets = None
+        if self.subnets is not None and nc is not None:
+            zonal_subnets = self.subnets.zonal_subnets_for_launch(nc)
         overrides = self._resolve_overrides(claim)
+        if zonal_subnets is not None:
+            # zones with no resolvable subnet cannot host a launch
+            # (instance.go:306-346 overrides x zonal subnets cross-product)
+            overrides = [o for o in overrides if o.zone in zonal_subnets]
         if not overrides:
             raise UnfulfillableCapacityError(offerings=[])
         if (overrides[0].capacity_type == wk.CAPACITY_TYPE_SPOT
@@ -128,6 +152,16 @@ class CloudProvider:
             self.recorder.publish("Warning", "InsufficientCapacity", "NodeClaim",
                                   claim.name, str(e))
             raise
+        if zonal_subnets is not None and instance.zone in zonal_subnets:
+            subnet = zonal_subnets[instance.zone]
+            self.subnets.update_inflight_ips(subnet.id)
+            instance.tags["subnet-id"] = subnet.id
+        arch = self.lattice.labels[self.lattice.name_to_idx[instance.instance_type]].get(
+            wk.LABEL_ARCH, "amd64")
+        lt = lts_by_arch.get(arch)
+        if lt is not None:
+            instance.tags["launch-template"] = lt.name
+            claim.image_id = lt.image_id
         return self._instance_to_claim(instance, claim)
 
     def _launch_batch(self, batch: List[Tuple[LaunchOverride, ...]]) -> List[object]:

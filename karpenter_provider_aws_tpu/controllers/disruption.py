@@ -42,6 +42,7 @@ from ..cloudprovider.cloudprovider import CloudProvider
 from ..errors import UnfulfillableCapacityError
 from ..events import Recorder
 from ..lattice.tensors import masked_view
+from ..metrics import Registry, wire_core_metrics
 from ..solver.problem import build_problem
 from ..solver.solve import NodePlan, Solver
 from ..state.cluster import ClusterState
@@ -72,7 +73,8 @@ class DisruptionController:
                  recorder: Optional[Recorder] = None,
                  clock: Optional[Clock] = None,
                  drift_enabled: bool = True,
-                 spot_to_spot_consolidation: bool = False):
+                 spot_to_spot_consolidation: bool = False,
+                 metrics: Optional[Registry] = None):
         self.cluster = cluster
         self.solver = solver
         self.node_pools = node_pools
@@ -84,6 +86,8 @@ class DisruptionController:
         self.recorder = recorder or Recorder(self.clock)
         self.drift_enabled = drift_enabled
         self.spot_to_spot_consolidation = spot_to_spot_consolidation
+        m = wire_core_metrics(metrics or Registry())
+        self._m_disrupted = m["nodeclaims_disrupted"]
         self._in_flight: List[DisruptionAction] = []
         # per-pass what-if budget (the reference bounds each disruption loop
         # with a timeout; we bound by solve count) + a state fingerprint so
@@ -240,6 +244,10 @@ class DisruptionController:
                 continue
             if ready:
                 for name in action.claims:
+                    claim = self.cluster.claims.get(name)
+                    if claim is not None:
+                        self._m_disrupted.inc(nodepool=claim.node_pool,
+                                              reason=action.reason)
                     self.termination.delete_claim(name)
                     self.recorder.publish("Normal", "Disrupted", "NodeClaim", name,
                                           action.reason)
@@ -263,8 +271,9 @@ class DisruptionController:
             self.cluster.add_claim(claim)
             try:
                 self.cloud_provider.create(claim)
-            except UnfulfillableCapacityError:
-                # roll back: never drain without standing replacement capacity
+            except Exception:
+                # ICE or any launch failure: roll back — never drain without
+                # standing replacement capacity
                 for r in action.replacements:
                     self.termination.delete_claim(r)
                 self.cluster.delete_claim(claim.name)

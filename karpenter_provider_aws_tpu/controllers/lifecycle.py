@@ -18,6 +18,7 @@ from ..apis.objects import Node, NodeClaim, NodeClaimPhase
 from ..cloudprovider.cloudprovider import CloudProvider
 from ..errors import NotFoundError
 from ..events import Recorder
+from ..metrics import Registry, wire_core_metrics
 from ..state.cluster import ClusterState
 from ..utils.clock import Clock
 
@@ -27,12 +28,16 @@ REGISTRATION_TTL = 15 * 60.0   # core liveness: claims must register in 15 min
 class LifecycleController:
     def __init__(self, cluster: ClusterState, cloud_provider: CloudProvider,
                  recorder: Optional[Recorder] = None, clock: Optional[Clock] = None,
-                 registration_delay: float = 5.0):
+                 registration_delay: float = 5.0,
+                 metrics: Optional[Registry] = None):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.clock = clock or Clock()
         self.recorder = recorder or Recorder(self.clock)
         self.registration_delay = registration_delay
+        m = wire_core_metrics(metrics or Registry())
+        self._m_registered = m["nodeclaims_registered"]
+        self._m_initialized = m["nodeclaims_initialized"]
 
     def reconcile(self) -> None:
         now = self.clock.now()
@@ -64,6 +69,7 @@ class LifecycleController:
             self.cluster.bind_pod(pod.name, node.name)
         claim.phase = NodeClaimPhase.REGISTERED
         claim.registered_at = self.clock.now()
+        self._m_registered.inc(nodepool=claim.node_pool)
         self.recorder.publish("Normal", "Registered", "NodeClaim", claim.name,
                               f"node {node.name} joined")
 
@@ -75,6 +81,7 @@ class LifecycleController:
             return
         claim.phase = NodeClaimPhase.INITIALIZED
         claim.initialized_at = self.clock.now()
+        self._m_initialized.inc(nodepool=claim.node_pool)
         self.recorder.publish("Normal", "Initialized", "NodeClaim", claim.name, "")
 
     def _liveness_delete(self, claim: NodeClaim, reason: str) -> None:

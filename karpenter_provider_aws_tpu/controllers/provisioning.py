@@ -28,6 +28,7 @@ from ..cloudprovider.cloudprovider import CloudProvider
 from ..errors import UnfulfillableCapacityError
 from ..events import Recorder
 from ..lattice.tensors import Lattice, masked_view
+from ..metrics import Registry, wire_core_metrics
 from ..solver.problem import build_problem
 from ..solver.solve import NodePlan, PlannedNode, Solver
 from ..state.cluster import ClusterState
@@ -69,7 +70,8 @@ class Provisioner:
                  recorder: Optional[Recorder] = None,
                  clock: Optional[Clock] = None,
                  batch_idle_seconds: float = BATCH_IDLE_SECONDS,
-                 batch_max_seconds: float = BATCH_MAX_SECONDS):
+                 batch_max_seconds: float = BATCH_MAX_SECONDS,
+                 metrics: Optional[Registry] = None):
         self.cluster = cluster
         self.solver = solver
         self.node_pools = node_pools
@@ -79,6 +81,14 @@ class Provisioner:
         self.recorder = recorder or Recorder(self.clock)
         self.batch_idle_seconds = batch_idle_seconds
         self.batch_max_seconds = batch_max_seconds
+        m = wire_core_metrics(metrics or Registry())  # single source of truth
+        self._m_sched = m["scheduling_duration"]
+        self._m_sim = m["scheduling_simulation_duration"]
+        self._m_batch = m["batch_size"]
+        self._m_sched_pods = m["pods_scheduled"]
+        self._m_unsched_pods = m["pods_unschedulable"]
+        self._m_created = m["nodeclaims_created"]
+        self._m_launched = m["nodeclaims_launched"]
         self._claim_ids = itertools.count(1)
         self._batch_start: Optional[float] = None
         self._last_pod_seen: Optional[float] = None
@@ -128,6 +138,9 @@ class Provisioner:
             daemonset_pods=self.cluster.daemonset_pods(),
             bound_pods=self.cluster.bound_pods())
         plan = self.solver.solve(problem)
+        self._m_batch.observe(len(pending))
+        self._m_sched.observe(plan.solve_seconds)
+        self._m_sim.observe(plan.device_seconds)
         result = ProvisionResult(plan=plan)
 
         for name, reason in plan.unschedulable.items():
@@ -149,11 +162,13 @@ class Provisioner:
         for node in planned:
             claim = self._make_claim(node)
             self.cluster.add_claim(claim)
+            self._m_created.inc(nodepool=claim.node_pool)
             result.created_claims.append(claim)
             for p in node.pods:
                 self.cluster.nominate(p, claim.name)
             try:
                 self.cloud_provider.create(claim)
+                self._m_launched.inc(nodepool=claim.node_pool)
                 result.launched += 1
                 result.pods_scheduled += len(node.pods)
                 self.recorder.publish("Normal", "Launched", "NodeClaim", claim.name,
@@ -166,6 +181,17 @@ class Provisioner:
                 result.launch_failures += 1
                 self.cluster.delete_claim(claim.name)
                 result.created_claims.pop()
+            except Exception as e:
+                # a reconcile loop must survive any launch failure
+                # (misconfigured NodeClass, transient API error): roll the
+                # claim back, surface the cause, keep launching the rest
+                result.launch_failures += 1
+                self.recorder.publish("Warning", "LaunchFailed", "NodeClaim",
+                                      claim.name, f"{type(e).__name__}: {e}")
+                self.cluster.delete_claim(claim.name)
+                result.created_claims.pop()
+        self._m_sched_pods.inc(result.pods_scheduled)
+        self._m_unsched_pods.set(result.pods_unschedulable)
         return result
 
     def _enforce_limits(self, nodes: Sequence[PlannedNode],

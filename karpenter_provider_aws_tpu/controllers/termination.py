@@ -16,6 +16,7 @@ from ..apis import wellknown as wk
 from ..cloudprovider.cloudprovider import CloudProvider
 from ..errors import NotFoundError
 from ..events import Recorder
+from ..metrics import Registry, wire_core_metrics
 from ..state.cluster import ClusterState
 from ..utils.clock import Clock
 
@@ -25,11 +26,14 @@ DISRUPTION_TAINT = Taint(key=f"{wk.KARPENTER_PREFIX}/disruption", value="disrupt
 
 class TerminationController:
     def __init__(self, cluster: ClusterState, cloud_provider: CloudProvider,
-                 recorder: Optional[Recorder] = None, clock: Optional[Clock] = None):
+                 recorder: Optional[Recorder] = None, clock: Optional[Clock] = None,
+                 metrics: Optional[Registry] = None):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.clock = clock or Clock()
         self.recorder = recorder or Recorder(self.clock)
+        m = wire_core_metrics(metrics or Registry())
+        self._m_terminated = m["nodeclaims_terminated"]
 
     def delete_claim(self, claim_name: str) -> None:
         """Mark for deletion (the k8s delete that starts the finalizer flow)."""
@@ -61,5 +65,6 @@ class TerminationController:
                 except NotFoundError:
                     pass
             claim.phase = NodeClaimPhase.TERMINATED
+            self._m_terminated.inc(nodepool=claim.node_pool)
             self.cluster.delete_claim(claim.name)
             self.recorder.publish("Normal", "Terminated", "NodeClaim", claim.name, "")

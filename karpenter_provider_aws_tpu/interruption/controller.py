@@ -1,0 +1,100 @@
+"""Interruption controller: queue events → ICE mask + cordon-and-drain.
+
+Mirror of the reference controller (reference
+pkg/controllers/interruption/controller.go:83-223): receive queue messages,
+parse via the registry, map instance-id → NodeClaim, then
+
+- spot interruption → mark the offering unavailable in the ICE cache
+  (controller.go:194-200) AND cordon-and-drain,
+- scheduled change / actionable state change → cordon-and-drain,
+- rebalance recommendation → events/metrics only (NoAction,
+  controller.go:291-296),
+
+and delete the message. Draining deletes the NodeClaim, which the
+termination controller turns into evict + instance terminate; the evicted
+pods re-enter the next scheduling batch, whose solve already excludes the
+ICE'd offering — proactive replacement before the 2-minute reclaim.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..apis import wellknown as wk
+from ..apis.objects import NodeClaim
+from ..cache.unavailable import UnavailableOfferings
+from ..cloud.fake import parse_instance_id
+from ..events import Recorder
+from ..metrics import Registry, wire_core_metrics
+from ..state.cluster import ClusterState
+from ..utils.clock import Clock
+from .messages import InterruptionMessage, MessageKind, parse_message
+from .queue import FakeQueue
+
+_ACTIONABLE = {MessageKind.SPOT_INTERRUPTION, MessageKind.SCHEDULED_CHANGE,
+               MessageKind.STATE_CHANGE}
+
+
+class InterruptionController:
+    def __init__(self, queue: FakeQueue, cluster: ClusterState,
+                 termination, unavailable: UnavailableOfferings,
+                 recorder: Optional[Recorder] = None,
+                 clock: Optional[Clock] = None,
+                 metrics: Optional[Registry] = None):
+        self.queue = queue
+        self.cluster = cluster
+        self.termination = termination
+        self.unavailable = unavailable
+        self.clock = clock or Clock()
+        self.recorder = recorder or Recorder(self.clock)
+        m = wire_core_metrics(metrics or Registry())
+        self._m_received = m["interruption_received"]
+        self._m_deleted = m["interruption_deleted"]
+        self._m_actions = m["interruption_actions"]
+
+    def _claims_by_instance_id(self) -> Dict[str, NodeClaim]:
+        out: Dict[str, NodeClaim] = {}
+        for claim in self.cluster.claims.values():
+            if claim.provider_id:
+                out[parse_instance_id(claim.provider_id)] = claim
+        return out
+
+    def reconcile(self) -> int:
+        """One receive→handle→delete pass. Returns messages handled.
+        (The reference fans 10-way parallel, controller.go:104; the sim
+        handles the batch serially under the same at-least-once contract.)"""
+        msgs = self.queue.receive()
+        if not msgs:
+            return 0
+        claims_by_id = self._claims_by_instance_id()
+        handled = 0
+        for qm in msgs:
+            msg = parse_message(qm.body)
+            self._m_received.inc(message_type=msg.kind.value)
+            if msg.kind != MessageKind.NOOP:
+                self._handle(msg, claims_by_id)
+            self.queue.delete(qm.receipt_handle)
+            self._m_deleted.inc()
+            handled += 1
+        return handled
+
+    def _handle(self, msg: InterruptionMessage, claims_by_id: Dict[str, NodeClaim]) -> None:
+        for iid in msg.instance_ids:
+            claim = claims_by_id.get(iid)
+            if claim is None:
+                # event for an instance we don't manage — ignore (the
+                # reference logs and drops, controller.go:249-289)
+                continue
+            if msg.kind == MessageKind.SPOT_INTERRUPTION:
+                # remember the reclaimed pool so the replacement solve
+                # avoids it (controller.go:194-200)
+                if claim.instance_type and claim.zone:
+                    self.unavailable.mark_unavailable(
+                        msg.kind.value, wk.CAPACITY_TYPE_SPOT,
+                        claim.instance_type, claim.zone)
+            self.recorder.publish(
+                "Warning", msg.kind.value, "NodeClaim", claim.name,
+                f"interruption event for instance {iid}")
+            if msg.kind in _ACTIONABLE:
+                self.termination.delete_claim(claim.name)
+                self._m_actions.inc(action="CordonAndDrain")

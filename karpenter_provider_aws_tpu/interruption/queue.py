@@ -1,0 +1,65 @@
+"""Interruption event queue.
+
+Mirror of the reference's SQS provider (reference pkg/providers/sqs/sqs.go:
+52-72: 20 s long-poll receive, max 10 messages, delete on handled). The
+fake is the default backend of the simulation environment; a real
+deployment implements the same three-method surface over its message bus.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+MAX_MESSAGES = 10        # sqs.go MaxNumberOfMessages
+WAIT_TIME_SECONDS = 20   # sqs.go WaitTimeSeconds (long poll)
+
+
+@dataclass
+class QueueMessage:
+    id: str
+    body: Dict
+    receipt_handle: str
+
+
+class FakeQueue:
+    """In-memory queue with SQS receive/delete semantics (at-least-once:
+    received messages stay until deleted)."""
+
+    def __init__(self, name: str = "interruption-queue"):
+        self.name = name
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._messages: Dict[str, QueueMessage] = {}
+        self._order: List[str] = []
+
+    def send(self, body: Dict) -> str:
+        with self._lock:
+            mid = f"m-{next(self._ids):06d}"
+            self._messages[mid] = QueueMessage(id=mid, body=body, receipt_handle=mid)
+            self._order.append(mid)
+            return mid
+
+    def receive(self, max_messages: int = MAX_MESSAGES) -> List[QueueMessage]:
+        """Non-blocking receive (the sim loop polls; a live deployment
+        long-polls for WAIT_TIME_SECONDS)."""
+        with self._lock:
+            return [self._messages[m] for m in self._order[:max_messages]
+                    if m in self._messages]
+
+    def delete(self, receipt_handle: str) -> None:
+        with self._lock:
+            self._messages.pop(receipt_handle, None)
+            if receipt_handle in self._order:
+                self._order.remove(receipt_handle)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._messages)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._messages.clear()
+            self._order.clear()

@@ -98,6 +98,9 @@ class Lattice:
     cat_ids: np.ndarray                    # [K_cat,T] int32
     num_vals: np.ndarray                   # [K_num,T] float32, NaN undefined
     name_to_idx: Dict[str, int] = field(default_factory=dict)
+    # bumped whenever price is rewritten in place (pricing refresh) so
+    # device-resident copies know to re-upload
+    price_version: int = 0
 
     @property
     def T(self) -> int:

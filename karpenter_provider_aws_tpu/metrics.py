@@ -1,0 +1,254 @@
+"""Prometheus-style metrics registry.
+
+Mirror of the reference's metric surface (reference website
+reference/metrics.md catalog; pkg/providers/instancetype/metrics.go;
+batcher metrics): counters, gauges, and histograms with label sets,
+rendered in the Prometheus text exposition format. Series names follow the
+reference catalog (karpenter_*) so dashboards port over.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                   2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(f"{self.name}: labels {sorted(labels)} != declared {sorted(self.labelnames)}")
+        return tuple(str(labels[k]) for k in self.labelnames)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help, labelnames=()):
+        super().__init__(name, help, labelnames)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        k = self._key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def _render(self) -> List[str]:
+        with self._lock:
+            return [f"{self.name}{_fmt(self.labelnames, k)} {v}"
+                    for k, v in sorted(self._values.items())]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help, labelnames=()):
+        super().__init__(name, help, labelnames)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        k = self._key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._values.clear()
+
+    def _render(self) -> List[str]:
+        with self._lock:
+            return [f"{self.name}{_fmt(self.labelnames, k)} {v}"
+                    for k, v in sorted(self._values.items())]
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help, labelnames=(), buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help, labelnames)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[Tuple[str, ...], List[int]] = {}
+        self._sums: Dict[Tuple[str, ...], float] = {}
+        self._totals: Dict[Tuple[str, ...], int] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        k = self._key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(k, [0] * len(self.buckets))
+            # cumulative buckets: every upper bound >= value increments
+            for j in range(bisect_left(self.buckets, value), len(self.buckets)):
+                counts[j] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            self._totals[k] = self._totals.get(k, 0) + 1
+
+    def count(self, **labels) -> int:
+        with self._lock:
+            return self._totals.get(self._key(labels), 0)
+
+    def sum(self, **labels) -> float:
+        with self._lock:
+            return self._sums.get(self._key(labels), 0.0)
+
+    def percentile(self, q: float, **labels) -> float:
+        """Approximate percentile from bucket counts (upper-bound estimate)."""
+        k = self._key(labels)
+        with self._lock:
+            total = self._totals.get(k, 0)
+            counts = self._counts.get(k, [0] * len(self.buckets))
+        if total == 0:
+            return 0.0
+        target = q * total
+        for j, b in enumerate(self.buckets):
+            if counts[j] >= target:
+                return b
+        return self.buckets[-1]
+
+    def _render(self) -> List[str]:
+        out = []
+        with self._lock:
+            for k in sorted(self._totals):
+                for j, b in enumerate(self.buckets):
+                    lbl = _fmt(self.labelnames + ("le",), k + (repr(b),))
+                    out.append(f"{self.name}_bucket{lbl} {self._counts[k][j]}")
+                lbl = _fmt(self.labelnames + ("le",), k + ("+Inf",))
+                out.append(f"{self.name}_bucket{lbl} {self._totals[k]}")
+                out.append(f"{self.name}_sum{_fmt(self.labelnames, k)} {self._sums[k]}")
+                out.append(f"{self.name}_count{_fmt(self.labelnames, k)} {self._totals[k]}")
+        return out
+
+
+def _fmt(names: Sequence[str], values: Sequence[str]) -> str:
+    if not names:
+        return ""
+    inner = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    return "{" + inner + "}"
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help: str = "", labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_make(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "", labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get_or_make(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "", labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Histogram(name, help, labelnames, buckets)
+                self._metrics[name] = m
+            elif not isinstance(m, Histogram):
+                raise ValueError(f"{name} already registered as {m.kind}")
+            return m
+
+    def _get_or_make(self, cls, name, help, labelnames):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, labelnames)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise ValueError(f"{name} already registered as {m.kind}")
+            return m
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        lines: List[str] = []
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        for m in metrics:
+            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            lines.extend(m._render())
+        return "\n".join(lines) + "\n"
+
+
+# The well-known series (reference website reference/metrics.md) — created
+# on a registry by wire_core_metrics so every deployment exposes the same
+# names the reference's dashboards scrape.
+def wire_core_metrics(reg: Registry) -> Dict[str, _Metric]:
+    return {
+        "cloudprovider_duration": reg.histogram(
+            "karpenter_cloudprovider_duration_seconds",
+            "Duration of cloud provider method calls.", ("controller", "method")),
+        "cloudprovider_errors": reg.counter(
+            "karpenter_cloudprovider_errors_total",
+            "Total number of errors returned from CloudProvider calls.",
+            ("controller", "method", "error")),
+        "scheduling_duration": reg.histogram(
+            "karpenter_provisioner_scheduling_duration_seconds",
+            "Duration of one scheduling pass (Solve).", ()),
+        "scheduling_simulation_duration": reg.histogram(
+            "karpenter_provisioner_scheduling_simulation_duration_seconds",
+            "Device solve time inside a scheduling pass.", ()),
+        "batch_size": reg.histogram(
+            "karpenter_provisioner_batch_size",
+            "Pending pods per scheduling batch.", (),
+            buckets=(1, 5, 10, 50, 100, 500, 1000, 5000, 10000, 50000)),
+        "pods_scheduled": reg.counter(
+            "karpenter_pods_scheduled_total", "Pods placed by the provisioner.", ()),
+        "pods_unschedulable": reg.gauge(
+            "karpenter_pods_unschedulable",
+            "Pods the last scheduling pass could not place.", ()),
+        "nodeclaims_created": reg.counter(
+            "karpenter_nodeclaims_created_total", "NodeClaims created.", ("nodepool",)),
+        "nodeclaims_launched": reg.counter(
+            "karpenter_nodeclaims_launched_total", "NodeClaims launched.", ("nodepool",)),
+        "nodeclaims_registered": reg.counter(
+            "karpenter_nodeclaims_registered_total", "NodeClaims registered.", ("nodepool",)),
+        "nodeclaims_initialized": reg.counter(
+            "karpenter_nodeclaims_initialized_total", "NodeClaims initialized.", ("nodepool",)),
+        "nodeclaims_terminated": reg.counter(
+            "karpenter_nodeclaims_terminated_total", "NodeClaims terminated.", ("nodepool",)),
+        "nodeclaims_disrupted": reg.counter(
+            "karpenter_nodeclaims_disrupted_total", "NodeClaims voluntarily disrupted.",
+            ("nodepool", "reason")),
+        "interruption_received": reg.counter(
+            "karpenter_interruption_received_messages_total",
+            "Interruption queue messages received.", ("message_type",)),
+        "interruption_deleted": reg.counter(
+            "karpenter_interruption_deleted_messages_total",
+            "Interruption queue messages deleted.", ()),
+        "interruption_actions": reg.counter(
+            "karpenter_interruption_actions_performed_total",
+            "Node drain actions taken for interruption messages.", ("action",)),
+        "cluster_state_node_count": reg.gauge(
+            "karpenter_cluster_state_node_count", "Nodes tracked by cluster state.", ()),
+        "cluster_state_pod_count": reg.gauge(
+            "karpenter_cluster_state_pod_count", "Pods tracked by cluster state.", ()),
+        "ice_cache_size": reg.gauge(
+            "karpenter_ice_cache_size", "Offerings currently marked unavailable.", ()),
+    }

@@ -24,7 +24,16 @@ from ..controllers.lifecycle import LifecycleController
 from ..controllers.provisioning import Provisioner
 from ..controllers.termination import TerminationController
 from ..events import Recorder
+from ..interruption.controller import InterruptionController
+from ..interruption.queue import FakeQueue
 from ..lattice.tensors import Lattice, build_lattice
+from ..controllers.nodeclass import NodeClassController
+from ..metrics import Registry, wire_core_metrics
+from ..providers import (
+    AMIProvider, InstanceProfileProvider, LaunchTemplateProvider,
+    PricingProvider, SecurityGroupProvider, SubnetProvider, VersionProvider,
+)
+from ..providers.pricing import PricingController
 from ..solver.solve import Solver
 from ..state.cluster import ClusterState
 from ..utils.clock import Clock, FakeClock
@@ -37,42 +46,84 @@ class Operator:
                  cloud: Optional[FakeCloud] = None,
                  clock: Optional[Clock] = None,
                  node_pools: Optional[Sequence[NodePool]] = None,
-                 node_classes: Optional[Dict[str, NodeClass]] = None):
+                 node_classes: Optional[Dict[str, NodeClass]] = None,
+                 interruption_queue: Optional[FakeQueue] = None):
         self.options = options or Options()
         self.options.validate()
         self.clock = clock or Clock()
         self.lattice = lattice if lattice is not None else build_lattice(
             vm_memory_overhead_percent=self.options.vm_memory_overhead_percent,
             reserved_enis=self.options.reserved_enis)
-        self.cloud = cloud or FakeCloud(self.clock)
+        self.cloud = cloud or FakeCloud(self.clock, cluster_name=self.options.cluster_name)
         # connectivity probe before anything else (operator.go:115-117)
         self.cloud.list_instances()
         self.recorder = Recorder(self.clock)
+        self.metrics = Registry()
+        wire_core_metrics(self.metrics)
         self.unavailable = UnavailableOfferings(self.clock)
         self.cluster = ClusterState(self.clock)
         self.node_pools: Dict[str, NodePool] = {p.name: p for p in (node_pools or [NodePool(name="default")])}
-        self.node_classes: Dict[str, NodeClass] = node_classes or {"default": NodeClass(name="default")}
-        self.cloud_provider = CloudProvider(
+        self.node_classes: Dict[str, NodeClass] = node_classes or {
+            "default": NodeClass(name="default",
+                                 role=f"KarpenterNodeRole-{self.options.cluster_name}")}
+        # domain providers (reference operator.go:135-178 builds all 11)
+        self.subnet_provider = SubnetProvider(self.cloud, self.clock,
+            cluster_name=self.options.cluster_name)
+        self.security_group_provider = SecurityGroupProvider(self.cloud, self.clock,
+            cluster_name=self.options.cluster_name)
+        self.instance_profile_provider = InstanceProfileProvider(self.cloud, self.clock)
+        self.ami_provider = AMIProvider(self.cloud, self.clock,
+                                        cluster_name=self.options.cluster_name)
+        self.launch_template_provider = LaunchTemplateProvider(
+            self.cloud, self.security_group_provider, self.instance_profile_provider,
+            self.ami_provider, self.clock, cluster_name=self.options.cluster_name)
+        self.version_provider = VersionProvider(self.cloud, self.clock)
+        self.pricing_provider = PricingProvider(self.lattice, self.clock)
+        from ..cloudprovider.decorator import decorate
+        self.cloud_provider = decorate(CloudProvider(
             self.lattice, self.cloud, self.unavailable, self.recorder, self.clock,
-            node_classes=self.node_classes)
+            node_classes=self.node_classes,
+            subnets=self.subnet_provider,
+            launch_templates=self.launch_template_provider,
+            version=self.version_provider), self.metrics)
         self.solver = Solver(self.lattice)
         self.provisioner = Provisioner(
             self.cluster, self.solver, self.node_pools, self.cloud_provider,
             self.unavailable, self.recorder, self.clock,
             batch_idle_seconds=self.options.batch_idle_duration,
-            batch_max_seconds=self.options.batch_max_duration)
+            batch_max_seconds=self.options.batch_max_duration,
+            metrics=self.metrics)
         self.lifecycle = LifecycleController(
             self.cluster, self.cloud_provider, self.recorder, self.clock,
-            registration_delay=self.options.registration_delay)
+            registration_delay=self.options.registration_delay,
+            metrics=self.metrics)
         self.termination = TerminationController(
-            self.cluster, self.cloud_provider, self.recorder, self.clock)
+            self.cluster, self.cloud_provider, self.recorder, self.clock,
+            metrics=self.metrics)
         self.gc = GarbageCollectionController(
             self.cluster, self.cloud_provider, self.recorder, self.clock)
         self.disruption = DisruptionController(
             self.cluster, self.solver, self.node_pools, self.cloud_provider,
             self.provisioner, self.termination, self.unavailable, self.recorder,
             self.clock, drift_enabled=self.options.drift_enabled,
-            spot_to_spot_consolidation=self.options.spot_to_spot_consolidation)
+            spot_to_spot_consolidation=self.options.spot_to_spot_consolidation,
+            metrics=self.metrics)
+        self.nodeclass_controller = NodeClassController(
+            self.node_classes, self.cluster, self.subnet_provider,
+            self.security_group_provider, self.ami_provider,
+            self.instance_profile_provider, self.launch_template_provider,
+            self.version_provider, self.recorder, self.clock)
+        self.pricing_controller = PricingController(self.pricing_provider, self.clock)
+        # interruption controller runs iff a queue is configured
+        # (reference controllers.go:60-62)
+        self.interruption_queue = interruption_queue
+        if interruption_queue is None and self.options.interruption_queue:
+            self.interruption_queue = FakeQueue(self.options.interruption_queue)
+        self.interruption = None
+        if self.interruption_queue is not None:
+            self.interruption = InterruptionController(
+                self.interruption_queue, self.cluster, self.termination,
+                self.unavailable, self.recorder, self.clock, self.metrics)
         self._last_cache_cleanup = 0.0
 
     # ---- run loop --------------------------------------------------------
@@ -81,10 +132,18 @@ class Operator:
         """One deterministic reconcile pass over every controller."""
         if force_provision or self.provisioner.batch_ready():
             self.provisioner.provision_once()
+        self.nodeclass_controller.reconcile()
+        self.pricing_controller.reconcile()
         self.lifecycle.reconcile()
+        if self.interruption is not None:
+            self.interruption.reconcile()
         self.disruption.reconcile()
         self.termination.reconcile()
         self.gc.reconcile()
+        self.metrics.gauge("karpenter_cluster_state_node_count").set(len(self.cluster.nodes))
+        self.metrics.gauge("karpenter_cluster_state_pod_count").set(len(self.cluster.pods))
+        self.metrics.gauge("karpenter_ice_cache_size").set(
+            sum(1 for _ in self.unavailable.entries()))
         now = self.clock.now()
         if now - self._last_cache_cleanup >= 10.0:  # ICE cleanup cadence (cache.go:39-42)
             self.unavailable.cleanup()

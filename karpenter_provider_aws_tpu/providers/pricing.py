@@ -1,0 +1,127 @@
+"""Pricing provider.
+
+Mirror of reference pkg/providers/pricing/pricing.go: on-demand prices
+(parallel standard+metal fetch, :150-217), per-zone spot prices
+(:348-391), and compiled-in static fallback for air-gapped operation
+(:43, :411-423 — here the catalog's generated prices ARE the static
+table). Dynamic updates overlay the static base and rebuild the lattice's
+price tensor so the device solver prices with live data.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..lattice.tensors import Lattice
+from ..utils.clock import Clock
+
+PRICING_REFRESH_SECONDS = 12 * 3600.0  # 12h loop (pricing controller.go:56)
+
+
+class PricingProvider:
+    def __init__(self, lattice: Lattice, clock: Optional[Clock] = None):
+        self.lattice = lattice
+        self.clock = clock or Clock()
+        self._lock = threading.Lock()
+        # static fallback = the catalog prices compiled into the lattice
+        self._static = lattice.price.copy()
+        self._od_overrides: Dict[str, float] = {}                  # type -> $/hr
+        self._spot_overrides: Dict[Tuple[str, str], float] = {}    # (type, zone) -> $/hr
+        self.last_update: Optional[float] = None
+
+    def on_demand_price(self, instance_type: str) -> float:
+        with self._lock:
+            if instance_type in self._od_overrides:
+                return self._od_overrides[instance_type]
+        lat = self.lattice
+        ti = lat.name_to_idx.get(instance_type)
+        if ti is None:
+            return float("inf")
+        ci = lat.capacity_types.index("on-demand")
+        return float(np.min(self._static[ti, :, ci]))
+
+    def spot_price(self, instance_type: str, zone: str) -> float:
+        with self._lock:
+            if (instance_type, zone) in self._spot_overrides:
+                return self._spot_overrides[(instance_type, zone)]
+        lat = self.lattice
+        ti = lat.name_to_idx.get(instance_type)
+        if ti is None or zone not in lat.zones:
+            return float("inf")
+        zi = lat.zones.index(zone)
+        ci = lat.capacity_types.index("spot")
+        return float(self._static[ti, zi, ci])
+
+    def update_on_demand_pricing(self, prices: Dict[str, float]) -> int:
+        """Overlay live OD prices (the 12h Pricing-API fetch)."""
+        with self._lock:
+            self._od_overrides.update(prices)
+            self.last_update = self.clock.now()
+        self._rebuild()
+        return len(prices)
+
+    def update_spot_pricing(self, prices: Dict[Tuple[str, str], float]) -> int:
+        """Overlay live per-zone spot prices (DescribeSpotPriceHistory)."""
+        with self._lock:
+            self._spot_overrides.update(prices)
+            self.last_update = self.clock.now()
+        self._rebuild()
+        return len(prices)
+
+    def _rebuild(self) -> None:
+        """Write the overlaid prices back into the lattice tensor in place,
+        so every on-device solve (which holds a reference to lattice.price)
+        prices with current data; unavailable offerings stay +inf."""
+        lat = self.lattice
+        with self._lock:
+            price = self._static.copy()
+            if "on-demand" in lat.capacity_types:
+                ci = lat.capacity_types.index("on-demand")
+                for t, p in self._od_overrides.items():
+                    ti = lat.name_to_idx.get(t)
+                    if ti is not None:
+                        price[ti, :, ci] = np.where(lat.available[ti, :, ci], p, np.inf)
+            if "spot" in lat.capacity_types:
+                ci = lat.capacity_types.index("spot")
+                for (t, z), p in self._spot_overrides.items():
+                    ti = lat.name_to_idx.get(t)
+                    if ti is not None and z in lat.zones:
+                        zi = lat.zones.index(z)
+                        if lat.available[ti, zi, ci]:
+                            price[ti, zi, ci] = p
+            lat.price[...] = price
+            lat.price_version += 1
+
+    def liveness_ok(self) -> bool:
+        return True
+
+    def reset(self) -> None:
+        with self._lock:
+            self._od_overrides.clear()
+            self._spot_overrides.clear()
+            self.last_update = None
+        self.lattice.price[...] = self._static
+        self.lattice.price_version += 1
+
+
+class PricingController:
+    """Singleton 12h refresh loop (reference
+    pkg/controllers/pricing/controller.go:42-57). The fake market has no
+    live feed, so a refresh re-applies overlays; a real backend plugs its
+    fetchers into the two update hooks."""
+
+    def __init__(self, provider: PricingProvider, clock: Optional[Clock] = None):
+        self.provider = provider
+        self.clock = clock or Clock()
+        self._last = 0.0
+
+    def reconcile(self) -> bool:
+        now = self.clock.now()
+        if now - self._last < PRICING_REFRESH_SECONDS:
+            return False
+        self._last = now
+        self.provider._rebuild()
+        return True

@@ -88,12 +88,17 @@ class Solver:
         self._alloc = jnp.asarray(lattice.alloc)
         self._avail = jnp.asarray(lattice.available)
         self._price = jnp.asarray(lattice.price)
+        self._price_version = lattice.price_version
 
     def _device_avail_price(self, problem: Problem):
         """A problem built over a masked lattice view (ICE cache applied,
         state/unavailable.py) brings its own availability; shapes match, so
         the jitted kernel is reused without recompilation."""
         if problem.lattice is self.lattice:
+            if self.lattice.price_version != self._price_version:
+                # pricing refresh rewrote the tensor in place: re-upload
+                self._price = jnp.asarray(self.lattice.price)
+                self._price_version = self.lattice.price_version
             return self._avail, self._price
         return jnp.asarray(problem.lattice.available), jnp.asarray(problem.lattice.price)
 

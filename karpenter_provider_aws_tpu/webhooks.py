@@ -1,0 +1,111 @@
+"""Admission webhooks: defaulting + validation.
+
+Mirror of the reference's knative-style admission controllers (reference
+pkg/webhooks/webhooks.go over pkg/apis/v1beta1 CEL rules + core NodePool
+validation). Invalid objects are rejected before they enter the control
+plane; defaulting fills the canonical optional fields.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .apis import wellknown as wk
+from .apis.objects import NodeClass, NodePool
+from .apis.requirements import Operator, Requirement
+from .apis.resources import RESOURCE_AXES, resources_to_vec
+from .providers.amifamily import AMI_FAMILIES
+
+# keys users may not constrain (reference restricted label domains)
+RESTRICTED_LABEL_DOMAINS = ("kubernetes.io/hostname",)
+
+
+class AdmissionError(ValueError):
+    pass
+
+
+def default_node_pool(pool: NodePool) -> NodePool:
+    """Defaulting admission: canonical capacity-type + arch + os
+    requirements when unset (core NodePool defaults)."""
+    keys = {r.key for r in pool.requirements}
+    if wk.LABEL_CAPACITY_TYPE not in keys:
+        pool.requirements.append(Requirement(
+            wk.LABEL_CAPACITY_TYPE, Operator.IN, (wk.CAPACITY_TYPE_ON_DEMAND,)))
+    if wk.LABEL_ARCH not in keys:
+        pool.requirements.append(Requirement(wk.LABEL_ARCH, Operator.IN, ("amd64",)))
+    if wk.LABEL_OS not in keys:
+        pool.requirements.append(Requirement(wk.LABEL_OS, Operator.IN, ("linux",)))
+    return pool
+
+
+def validate_node_pool(pool: NodePool) -> List[str]:
+    """Validation admission; returns error strings (empty = admitted)."""
+    errs: List[str] = []
+    if not pool.name:
+        errs.append("name is required")
+    for r in pool.requirements:
+        if r.key in RESTRICTED_LABEL_DOMAINS:
+            errs.append(f"requirement on restricted key {r.key!r}")
+        if r.min_values is not None and r.min_values < 1:
+            errs.append(f"minValues must be >= 1 (key {r.key})")
+    for key in pool.limits:
+        if key not in RESOURCE_AXES:
+            errs.append(f"unknown limit resource {key!r}")
+        else:
+            try:
+                resources_to_vec({key: pool.limits[key]})
+            except Exception as e:
+                errs.append(f"bad limit quantity for {key}: {e}")
+    d = pool.disruption
+    if d.consolidation_policy not in ("WhenUnderutilized", "WhenEmpty"):
+        errs.append(f"unknown consolidationPolicy {d.consolidation_policy!r}")
+    if d.consolidation_policy == "WhenEmpty" and d.consolidate_after is None:
+        errs.append("consolidateAfter is required with WhenEmpty")
+    for b in d.budgets:
+        spec = str(b.nodes)
+        try:
+            float(spec[:-1]) if spec.endswith("%") else int(spec)
+        except ValueError:
+            errs.append(f"bad budget nodes value {b.nodes!r}")
+    if pool.weight < 0 or pool.weight > 100:
+        errs.append("weight must be in [0, 100]")
+    return errs
+
+
+def validate_node_class(nc: NodeClass) -> List[str]:
+    """EC2NodeClass-analog validation (pkg/apis/v1beta1 CEL rules)."""
+    errs: List[str] = []
+    if not nc.name:
+        errs.append("name is required")
+    if nc.ami_family not in AMI_FAMILIES:
+        errs.append(f"unknown amiFamily {nc.ami_family!r}")
+    if nc.ami_family == "Custom" and not nc.ami_selector_terms:
+        errs.append("amiSelectorTerms required with the Custom amiFamily")
+    if nc.role and nc.instance_profile:
+        errs.append("role and instanceProfile are mutually exclusive")
+    if not nc.role and not nc.instance_profile:
+        errs.append("one of role or instanceProfile is required")
+    for t in nc.subnet_selector_terms + nc.security_group_selector_terms + nc.ami_selector_terms:
+        if not t.tags and not t.id and not t.name:
+            errs.append("selector term needs tags, id, or name")
+    mo = nc.metadata_options
+    if mo.http_tokens not in ("required", "optional"):
+        errs.append(f"httpTokens must be required|optional, got {mo.http_tokens!r}")
+    if mo.http_endpoint not in ("enabled", "disabled"):
+        errs.append(f"httpEndpoint must be enabled|disabled, got {mo.http_endpoint!r}")
+    return errs
+
+
+def admit_node_pool(pool: NodePool) -> NodePool:
+    pool = default_node_pool(pool)
+    errs = validate_node_pool(pool)
+    if errs:
+        raise AdmissionError(f"NodePool/{pool.name}: " + "; ".join(errs))
+    return pool
+
+
+def admit_node_class(nc: NodeClass) -> NodeClass:
+    errs = validate_node_class(nc)
+    if errs:
+        raise AdmissionError(f"NodeClass/{nc.name}: " + "; ".join(errs))
+    return nc

@@ -1,0 +1,180 @@
+"""Interruption handling + metrics surface tests.
+
+Behavioral spec: reference pkg/controllers/interruption (4 message schemas,
+parser registry, CordonAndDrain for spot/scheduled/state-change, NoAction
+for rebalance, spot ICE marking) and website reference/metrics.md series.
+"""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import NodePool, Operator as ReqOp, Pod, Requirement
+from karpenter_provider_aws_tpu.apis import wellknown as wk
+from karpenter_provider_aws_tpu.cloud import FakeCloud
+from karpenter_provider_aws_tpu.cloud.fake import parse_instance_id
+from karpenter_provider_aws_tpu.interruption import (
+    FakeQueue, MessageKind, parse_message, rebalance_recommendation,
+    scheduled_change, spot_interruption, state_change,
+)
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+from karpenter_provider_aws_tpu.metrics import Registry
+from karpenter_provider_aws_tpu.operator import Operator, Options
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+_FAMILIES = ("m5", "c5", "t3")
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    return build_lattice([s for s in build_catalog() if s.family in _FAMILIES])
+
+
+@pytest.fixture()
+def env(lattice):
+    clock = FakeClock()
+    queue = FakeQueue("interruptions")
+    pool = NodePool(name="default", requirements=[
+        Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN, ("spot", "on-demand"))])
+    return Operator(options=Options(registration_delay=1.0), lattice=lattice,
+                    cloud=FakeCloud(clock), clock=clock, node_pools=[pool],
+                    interruption_queue=queue)
+
+
+def add_pods(env, n=3):
+    for i in range(n):
+        env.cluster.add_pod(Pod(name=f"p{i}", requests={"cpu": "500m", "memory": "1Gi"}))
+
+
+class TestParsers:
+    def test_spot(self):
+        m = parse_message(spot_interruption("i-abc"))
+        assert m.kind == MessageKind.SPOT_INTERRUPTION and m.instance_ids == ("i-abc",)
+
+    def test_rebalance(self):
+        m = parse_message(rebalance_recommendation("i-abc"))
+        assert m.kind == MessageKind.REBALANCE_RECOMMENDATION
+
+    def test_scheduled_change_multi_entity(self):
+        m = parse_message(scheduled_change("i-1", "i-2"))
+        assert m.kind == MessageKind.SCHEDULED_CHANGE and m.instance_ids == ("i-1", "i-2")
+
+    def test_scheduled_change_non_ec2_is_noop(self):
+        body = scheduled_change("i-1")
+        body["detail"]["service"] = "S3"
+        assert parse_message(body).kind == MessageKind.NOOP
+
+    def test_state_change_actionable_vs_not(self):
+        assert parse_message(state_change("i-1", "stopping")).kind == MessageKind.STATE_CHANGE
+        assert parse_message(state_change("i-1", "running")).kind == MessageKind.NOOP
+
+    def test_unknown_detail_type_is_noop(self):
+        assert parse_message({"source": "x", "detail-type": "y"}).kind == MessageKind.NOOP
+
+
+class TestInterruptionController:
+    def test_spot_interruption_drains_and_marks_ice(self, env):
+        add_pods(env)
+        env.settle()
+        (claim,) = env.cluster.claims.values()
+        assert claim.capacity_type == "spot"
+        iid = parse_instance_id(claim.provider_id)
+        env.interruption_queue.send(spot_interruption(iid))
+        env.interruption.reconcile()
+        assert env.unavailable.is_unavailable("spot", claim.instance_type, claim.zone)
+        assert env.cluster.claims[claim.name].deletion_timestamp
+        assert len(env.interruption_queue) == 0
+        # drive to steady state: replacement avoids the interrupted offering
+        rounds = env.settle(max_rounds=60)
+        assert rounds < 60
+        replacement = next(iter(env.cluster.claims.values()))
+        assert (replacement.instance_type, replacement.zone) != (claim.instance_type, claim.zone)
+        assert all(p.node_name for p in env.cluster.pods.values())
+
+    def test_rebalance_recommendation_no_action(self, env):
+        add_pods(env)
+        env.settle()
+        (claim,) = env.cluster.claims.values()
+        env.interruption_queue.send(
+            rebalance_recommendation(parse_instance_id(claim.provider_id)))
+        env.interruption.reconcile()
+        assert not env.cluster.claims[claim.name].deletion_timestamp
+        assert len(env.interruption_queue) == 0
+        assert env.recorder.events(reason=MessageKind.REBALANCE_RECOMMENDATION.value)
+
+    def test_scheduled_change_drains(self, env):
+        add_pods(env)
+        env.settle()
+        (claim,) = env.cluster.claims.values()
+        env.interruption_queue.send(
+            scheduled_change(parse_instance_id(claim.provider_id)))
+        env.interruption.reconcile()
+        assert env.cluster.claims[claim.name].deletion_timestamp
+
+    def test_unmanaged_instance_ignored(self, env):
+        env.interruption_queue.send(spot_interruption("i-ffffffff"))
+        handled = env.interruption.reconcile()
+        assert handled == 1 and len(env.interruption_queue) == 0
+
+    def test_message_metrics(self, env):
+        add_pods(env)
+        env.settle()
+        (claim,) = env.cluster.claims.values()
+        env.interruption_queue.send(spot_interruption(parse_instance_id(claim.provider_id)))
+        env.interruption.reconcile()
+        received = env.metrics.get("karpenter_interruption_received_messages_total")
+        assert received.value(message_type=MessageKind.SPOT_INTERRUPTION.value) == 1
+        deleted = env.metrics.get("karpenter_interruption_deleted_messages_total")
+        assert deleted.value() == 1
+
+
+class TestMetricsSurface:
+    def test_core_series_populated(self, env):
+        add_pods(env, 5)
+        env.settle()
+        text = env.metrics.render()
+        assert "karpenter_pods_scheduled_total 5.0" in text
+        assert 'karpenter_nodeclaims_launched_total{nodepool="default"} 1.0' in text
+        assert 'karpenter_nodeclaims_registered_total{nodepool="default"} 1.0' in text
+        assert 'karpenter_nodeclaims_initialized_total{nodepool="default"} 1.0' in text
+        assert "karpenter_cluster_state_node_count 1.0" in text
+        assert "karpenter_cluster_state_pod_count 5.0" in text
+        sched = env.metrics.get("karpenter_provisioner_scheduling_duration_seconds")
+        assert sched.count() >= 1
+
+    def test_cloudprovider_decoration(self, env):
+        add_pods(env, 1)
+        env.settle()
+        dur = env.metrics.get("karpenter_cloudprovider_duration_seconds")
+        assert dur.count(controller="operator", method="create") >= 1
+        # error path increments the error counter
+        from karpenter_provider_aws_tpu.errors import NotFoundError
+        with pytest.raises(NotFoundError):
+            env.cloud_provider.get("fake:///zone/i-doesnotexist")
+        errs = env.metrics.get("karpenter_cloudprovider_errors_total")
+        assert errs.value(controller="operator", method="get", error="NotFoundError") == 1
+
+    def test_terminated_and_disrupted_counters(self, lattice):
+        from karpenter_provider_aws_tpu.apis.objects import NodePoolDisruption
+        clock = FakeClock()
+        pool = NodePool(name="default",
+                        requirements=[Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN, ("on-demand",))],
+                        disruption=NodePoolDisruption(consolidate_after=5.0))
+        env = Operator(options=Options(registration_delay=1.0), lattice=lattice,
+                       cloud=FakeCloud(clock), clock=clock, node_pools=[pool])
+        add_pods(env, 2)
+        env.settle()
+        for p in list(env.cluster.pods):
+            env.cluster.delete_pod(p)
+        clock.step(6)
+        for _ in range(5):
+            env.run_once()
+            clock.step(2)
+        disrupted = env.metrics.get("karpenter_nodeclaims_disrupted_total")
+        assert disrupted.value(nodepool="default", reason="Empty") == 1
+        terminated = env.metrics.get("karpenter_nodeclaims_terminated_total")
+        assert terminated.value(nodepool="default") == 1
+
+    def test_render_is_prometheus_text(self, env):
+        text = env.metrics.render()
+        assert "# TYPE karpenter_provisioner_batch_size histogram" in text
+        assert "# TYPE karpenter_pods_scheduled_total counter" in text
+        assert "# TYPE karpenter_cluster_state_node_count gauge" in text

@@ -1,0 +1,350 @@
+"""Provider-layer tests: subnet/SG/instance-profile/AMI/launch-template/
+pricing/version providers, NodeClass controller, admission webhooks.
+
+Behavioral spec: reference pkg/providers/* and pkg/controllers/nodeclass
+(see each provider's docstring for file:line cites).
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.apis import NodePool, Pod
+from karpenter_provider_aws_tpu.apis.objects import (
+    MetadataOptions, NodeClass, NodeClassSelectorTerm, NodePoolDisruption,
+)
+from karpenter_provider_aws_tpu.cloud import FakeCloud
+from karpenter_provider_aws_tpu.cloud.network import Image
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+from karpenter_provider_aws_tpu.operator import Operator, Options
+from karpenter_provider_aws_tpu.providers import (
+    AMIProvider, InstanceProfileProvider, LaunchTemplateProvider,
+    PricingProvider, SecurityGroupProvider, SubnetProvider, VersionProvider,
+)
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+from karpenter_provider_aws_tpu.webhooks import (
+    AdmissionError, admit_node_class, admit_node_pool,
+)
+
+_FAMILIES = ("m5", "t3")
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    return build_lattice([s for s in build_catalog() if s.family in _FAMILIES])
+
+
+@pytest.fixture()
+def cloud():
+    return FakeCloud(FakeClock())
+
+
+def nodeclass(**kw):
+    kw.setdefault("name", "default")
+    kw.setdefault("role", "KarpenterNodeRole-sim")
+    return NodeClass(**kw)
+
+
+class TestSubnetProvider:
+    def test_discovery_by_cluster_tag(self, cloud):
+        p = SubnetProvider(cloud, cloud.clock)
+        subs = p.list(nodeclass())
+        assert len(subs) == 4
+        assert {s.zone for s in subs} == set(z for z in
+                                             ("us-west-2a", "us-west-2b", "us-west-2c", "us-west-2d"))
+
+    def test_discovery_by_id(self, cloud):
+        p = SubnetProvider(cloud, cloud.clock)
+        nc = nodeclass(subnet_selector_terms=[NodeClassSelectorTerm(id="subnet-0001")])
+        assert [s.id for s in p.list(nc)] == ["subnet-0001"]
+
+    def test_zonal_choice_prefers_free_ips_with_inflight(self, cloud):
+        p = SubnetProvider(cloud, cloud.clock)
+        # add a second subnet in zone a with more free IPs
+        from karpenter_provider_aws_tpu.cloud.network import Subnet
+        cloud.network.subnets["subnet-9999"] = Subnet(
+            id="subnet-9999", zone="us-west-2a", cidr="10.9.0.0/24",
+            available_ips=500, tags={"kubernetes.io/cluster/sim": "owned"})
+        zs = p.zonal_subnets_for_launch(nodeclass())
+        assert zs["us-west-2a"].id == "subnet-9999"
+        # book 300 in-flight IPs: the original subnet becomes the best
+        p.update_inflight_ips("subnet-9999", 300)
+        zs = p.zonal_subnets_for_launch(nodeclass())
+        assert zs["us-west-2a"].id == "subnet-0001"
+        # bookings decay after the describe-cache window re-baselines
+        p._clock.step(61)
+        zs = p.zonal_subnets_for_launch(nodeclass())
+        assert zs["us-west-2a"].id == "subnet-9999"
+
+
+class TestSecurityGroupAndProfile:
+    def test_sg_discovery_by_name(self, cloud):
+        p = SecurityGroupProvider(cloud, cloud.clock)
+        nc = nodeclass(security_group_selector_terms=[NodeClassSelectorTerm(name="nodes")])
+        assert [g.name for g in p.list(nc)] == ["nodes"]
+
+    def test_profile_create_is_deterministic_and_idempotent(self, cloud):
+        p = InstanceProfileProvider(cloud, cloud.clock)
+        n1 = p.create(nodeclass())
+        n2 = p.create(nodeclass())
+        assert n1 == n2 and n1.startswith("karpenter_")
+        assert cloud.network.get_instance_profile(n1).role == "KarpenterNodeRole-sim"
+
+    def test_profile_role_change_reconciles(self, cloud):
+        p = InstanceProfileProvider(cloud, cloud.clock)
+        name = p.create(nodeclass())
+        p._cache.flush()
+        p.create(nodeclass(role="OtherRole"))
+        assert cloud.network.get_instance_profile(name).role == "OtherRole"
+
+    def test_user_managed_profile_never_deleted(self, cloud):
+        p = InstanceProfileProvider(cloud, cloud.clock)
+        nc = nodeclass(role=None, instance_profile="my-profile")
+        assert p.create(nc) == "my-profile"
+        p.delete(nc)  # no-op, no exception
+
+
+class TestAMIProvider:
+    def test_ssm_default_discovery_per_arch(self, cloud):
+        p = AMIProvider(cloud, cloud.clock)
+        amis = p.list(nodeclass(ami_family="AL2023"), "1.29")
+        assert {a.arch for a in amis} == {"amd64", "arm64"}
+        assert all(a.id.startswith("ami-al2023") for a in amis)
+
+    def test_selector_terms_override_defaults(self, cloud):
+        p = AMIProvider(cloud, cloud.clock)
+        nc = nodeclass(ami_family="Custom",
+                       ami_selector_terms=[NodeClassSelectorTerm(name="al2-amd64-v1.29")])
+        amis = p.list(nc, "1.29")
+        assert [a.id for a in amis] == ["ami-al2-amd64"]
+
+    def test_newest_per_arch_wins(self, cloud):
+        cloud.network.images["ami-newer"] = Image(
+            id="ami-newer", name="custom", arch="amd64", creation_date=9_999.0,
+            tags={"team": "ml"})
+        cloud.network.images["ami-older"] = Image(
+            id="ami-older", name="custom", arch="amd64", creation_date=1.0,
+            tags={"team": "ml"})
+        p = AMIProvider(cloud, cloud.clock)
+        nc = nodeclass(ami_family="Custom",
+                       ami_selector_terms=[NodeClassSelectorTerm(tags=(("team", "ml"),))])
+        amis = p.list(nc, "1.29")
+        assert [a.id for a in amis] == ["ami-newer"]
+
+    def test_user_data_per_family(self, cloud):
+        p = AMIProvider(cloud, cloud.clock)
+        al2023 = p.resolve_launch_parameters(nodeclass(ami_family="AL2023"), "1.29")
+        assert any("NodeConfig" in lp.user_data for lp in al2023)
+        br = p.resolve_launch_parameters(nodeclass(ami_family="Bottlerocket"), "1.29")
+        assert any("[settings.kubernetes]" in lp.user_data for lp in br)
+
+
+class TestLaunchTemplateProvider:
+    def _provider(self, cloud):
+        sg = SecurityGroupProvider(cloud, cloud.clock)
+        ip = InstanceProfileProvider(cloud, cloud.clock)
+        ami = AMIProvider(cloud, cloud.clock)
+        return LaunchTemplateProvider(cloud, sg, ip, ami, cloud.clock)
+
+    def test_ensure_all_creates_per_arch_and_is_idempotent(self, cloud):
+        p = self._provider(cloud)
+        lts = p.ensure_all(nodeclass(), "1.29")
+        assert len(lts) == 2  # amd64 + arm64 AMIs
+        n_before = len(cloud.network.launch_templates)
+        lts2 = p.ensure_all(nodeclass(), "1.29")
+        assert len(cloud.network.launch_templates) == n_before
+        assert {l.name for l in lts} == {l.name for l in lts2}
+
+    def test_content_change_creates_new_template(self, cloud):
+        p = self._provider(cloud)
+        p.ensure_all(nodeclass(), "1.29")
+        n1 = len(cloud.network.launch_templates)
+        p.ensure_all(nodeclass(user_data="echo hi"), "1.29")
+        assert len(cloud.network.launch_templates) == n1 + 2
+
+    def test_cache_eviction_gcs_cloud_template(self, cloud):
+        clock = cloud.clock
+        p = self._provider(cloud)
+        p.ensure_all(nodeclass(), "1.29")
+        assert len(cloud.network.launch_templates) == 2
+        clock.step(400)  # past the 5-min LT cache TTL
+        p.cleanup()
+        assert len(cloud.network.launch_templates) == 0
+
+    def test_delete_all_for_nodeclass(self, cloud):
+        p = self._provider(cloud)
+        p.ensure_all(nodeclass(), "1.29")
+        assert p.delete_all(nodeclass()) == 2
+        assert len(cloud.network.launch_templates) == 0
+
+
+class TestPricing:
+    def test_static_fallback_prices(self, lattice):
+        p = PricingProvider(lattice)
+        od = p.on_demand_price("m5.large")
+        assert 0 < od < 1
+        sp = p.spot_price("m5.large", lattice.zones[0])
+        assert 0 < sp < od
+
+    def test_dynamic_override_reaches_solver(self, lattice):
+        import copy
+        lat = copy.deepcopy(lattice)
+        from karpenter_provider_aws_tpu.solver import Solver, build_problem
+        solver = Solver(lat)
+        p = PricingProvider(lat)
+        # make one cheap type absurdly expensive: the solver must avoid it
+        problem = build_problem([Pod(name="x", requests={"cpu": "1", "memory": "1Gi"})],
+                                [NodePool(name="default")], lat)
+        plan0 = solver.solve(problem)
+        chosen = plan0.new_nodes[0].instance_type
+        p.update_on_demand_pricing({chosen: 10_000.0})
+        p.update_spot_pricing({(chosen, z): 10_000.0 for z in lat.zones})
+        problem = build_problem([Pod(name="y", requests={"cpu": "1", "memory": "1Gi"})],
+                                [NodePool(name="default")], lat)
+        plan1 = solver.solve(problem)
+        assert plan1.new_nodes[0].instance_type != chosen
+
+    def test_version_provider(self, cloud):
+        v = VersionProvider(cloud, cloud.clock)
+        assert v.get() == "1.29"
+
+
+class TestNodeClassController:
+    def test_status_hydration(self, lattice):
+        clock = FakeClock()
+        op = Operator(options=Options(registration_delay=1.0), lattice=lattice,
+                      cloud=FakeCloud(clock), clock=clock)
+        op.run_once()
+        nc = op.node_classes["default"]
+        assert len(nc.status_subnets) == 4
+        assert len(nc.status_security_groups) == 2
+        assert len(nc.status_amis) == 2
+        assert nc.status_instance_profile
+        assert nc.status_conditions["Ready"]
+
+    def test_finalizer_blocks_until_claims_gone(self, lattice):
+        clock = FakeClock()
+        op = Operator(options=Options(registration_delay=1.0), lattice=lattice,
+                      cloud=FakeCloud(clock), clock=clock)
+        op.cluster.add_pod(Pod(name="p", requests={"cpu": "500m", "memory": "1Gi"}))
+        op.settle()
+        op.nodeclass_controller.delete("default")
+        op.run_once()
+        assert "default" in op.node_classes, "delete must block while claims exist"
+        op.cluster.delete_pod("p")
+        (claim,) = op.cluster.claims.values()
+        op.termination.delete_claim(claim.name)
+        op.settle(max_rounds=10)
+        op.run_once()
+        assert "default" not in op.node_classes
+        assert len(op.cloud.network.launch_templates) == 0
+        assert not op.cloud.network.instance_profiles
+
+
+class TestWebhooks:
+    def test_nodepool_defaulting(self):
+        from karpenter_provider_aws_tpu.apis import wellknown as wk
+        pool = admit_node_pool(NodePool(name="p"))
+        keys = {r.key for r in pool.requirements}
+        assert wk.LABEL_CAPACITY_TYPE in keys and wk.LABEL_ARCH in keys
+
+    def test_nodepool_validation_rejects_bad_budget(self):
+        from karpenter_provider_aws_tpu.apis.objects import DisruptionBudget
+        pool = NodePool(name="p", disruption=NodePoolDisruption(
+            budgets=[DisruptionBudget(nodes="lots")]))
+        with pytest.raises(AdmissionError):
+            admit_node_pool(pool)
+
+    def test_nodepool_rejects_restricted_key(self):
+        from karpenter_provider_aws_tpu.apis import Operator as ReqOp, Requirement
+        pool = NodePool(name="p", requirements=[
+            Requirement("kubernetes.io/hostname", ReqOp.IN, ("n1",))])
+        with pytest.raises(AdmissionError):
+            admit_node_pool(pool)
+
+    def test_nodeclass_role_xor_profile(self):
+        with pytest.raises(AdmissionError):
+            admit_node_class(NodeClass(name="x", role="r", instance_profile="p"))
+        with pytest.raises(AdmissionError):
+            admit_node_class(NodeClass(name="x"))
+        admit_node_class(NodeClass(name="x", role="r"))
+
+    def test_nodeclass_custom_family_needs_selectors(self):
+        with pytest.raises(AdmissionError):
+            admit_node_class(NodeClass(name="x", role="r", ami_family="Custom"))
+
+    def test_nodeclass_metadata_options(self):
+        nc = NodeClass(name="x", role="r",
+                       metadata_options=MetadataOptions(http_tokens="sometimes"))
+        with pytest.raises(AdmissionError):
+            admit_node_class(nc)
+
+
+class TestLaunchPathIntegration:
+    def test_launch_attaches_template_subnet_and_image(self, lattice):
+        clock = FakeClock()
+        op = Operator(options=Options(registration_delay=1.0), lattice=lattice,
+                      cloud=FakeCloud(clock), clock=clock)
+        op.cluster.add_pod(Pod(name="p", requests={"cpu": "500m", "memory": "1Gi"}))
+        op.settle()
+        (claim,) = op.cluster.claims.values()
+        inst = op.cloud.instances[
+            claim.provider_id.rsplit("/", 1)[1]]
+        assert inst.tags.get("launch-template", "").startswith("karpenter.sim/")
+        assert inst.tags.get("subnet-id", "").startswith("subnet-")
+        assert claim.image_id and claim.image_id.startswith("ami-")
+        # the chosen subnet's in-flight IPs were booked
+        assert op.subnet_provider._inflight
+
+
+class TestOpsReviewRegressions:
+    def test_misconfigured_nodeclass_does_not_crash_reconcile(self, lattice):
+        clock = FakeClock()
+        op = Operator(options=Options(registration_delay=1.0), lattice=lattice,
+                      cloud=FakeCloud(clock), clock=clock,
+                      node_classes={"default": NodeClass(name="default")})  # no role
+        op.cluster.add_pod(Pod(name="p", requests={"cpu": "500m", "memory": "1Gi"}))
+        r = op.provisioner.provision_once()   # must not raise
+        assert r.launch_failures == 1
+        assert not op.cluster.claims, "failed launch must roll the claim back"
+        assert op.recorder.events(reason="LaunchFailed")
+        op.run_once()  # whole loop stays alive
+
+    def test_malformed_queue_message_does_not_poison(self, lattice):
+        clock = FakeClock()
+        from karpenter_provider_aws_tpu.interruption import FakeQueue
+        q = FakeQueue("x")
+        op = Operator(options=Options(registration_delay=1.0), lattice=lattice,
+                      cloud=FakeCloud(clock), clock=clock, interruption_queue=q)
+        q.send({"source": "aws.ec2",
+                "detail-type": "EC2 Spot Instance Interruption Warning"})  # no detail
+        assert op.interruption.reconcile() == 1
+        assert len(q) == 0
+
+    def test_cluster_name_threads_to_discovery(self, lattice):
+        clock = FakeClock()
+        op = Operator(options=Options(registration_delay=1.0, cluster_name="prod"),
+                      lattice=lattice, clock=clock)
+        op.cluster.add_pod(Pod(name="p", requests={"cpu": "500m", "memory": "1Gi"}))
+        rounds = op.settle()
+        assert rounds < 50 and len(op.cluster.nodes) == 1
+
+    def test_nodeclass_hash_annotation_stamped(self, lattice):
+        clock = FakeClock()
+        op = Operator(options=Options(registration_delay=1.0), lattice=lattice,
+                      cloud=FakeCloud(clock), clock=clock)
+        op.run_once()
+        from karpenter_provider_aws_tpu.apis import wellknown as wk
+        assert wk.ANNOTATION_NODECLASS_HASH in op.node_classes["default"].annotations
+
+    def test_active_launch_template_survives_ttl(self, cloud):
+        sg = SecurityGroupProvider(cloud, cloud.clock)
+        ip = InstanceProfileProvider(cloud, cloud.clock)
+        ami = AMIProvider(cloud, cloud.clock)
+        p = LaunchTemplateProvider(cloud, sg, ip, ami, cloud.clock)
+        p.ensure_all(nodeclass(), "1.29")
+        for _ in range(3):   # steady use across several TTL windows
+            cloud.clock.step(200)
+            p.ensure_all(nodeclass(), "1.29")
+            p.cleanup()
+        assert len(cloud.network.launch_templates) == 2, \
+            "actively-used templates must not be GC'd"

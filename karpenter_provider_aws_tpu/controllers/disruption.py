@@ -115,7 +115,7 @@ class DisruptionController:
                 val = int(np.ceil(total * float(spec[:-1]) / 100.0))
             else:
                 val = int(spec)
-            allowed = min(allowed, val)
+            allowed = min(allowed, max(val, 0))
         return max(allowed - disrupting, 0)
 
     # ---- candidate discovery --------------------------------------------
@@ -271,9 +271,13 @@ class DisruptionController:
             self.cluster.add_claim(claim)
             try:
                 self.cloud_provider.create(claim)
-            except Exception:
+            except Exception as e:
                 # ICE or any launch failure: roll back — never drain without
                 # standing replacement capacity
+                self.recorder.publish("Warning", "ReplacementLaunchFailed",
+                                      "NodeClaim", claim.name,
+                                      f"{reason} disruption aborted: "
+                                      f"{type(e).__name__}: {e}")
                 for r in action.replacements:
                     self.termination.delete_claim(r)
                 self.cluster.delete_claim(claim.name)

@@ -71,8 +71,15 @@ class NodeClassController:
         nc.status_security_groups = [{"id": g.id, "name": g.name}
                                      for g in self.security_groups.list(nc)]
         v = self.version.get()
-        nc.status_amis = [{"id": a.id, "name": a.name, "arch": a.arch}
-                          for a in self.amis.list(nc, v)]
+        try:
+            nc.status_amis = [{"id": a.id, "name": a.name, "arch": a.arch}
+                              for a in self.amis.list(nc, v)]
+        except ValueError as e:
+            # e.g. unknown AMI family: degrade the class to NotReady (the
+            # reference sets status conditions; it never crashes the manager)
+            nc.status_amis = []
+            self.recorder.publish("Warning", "NodeClassResolveFailed", "NodeClass",
+                                  nc.name, str(e))
         try:
             nc.status_instance_profile = self.instance_profiles.create(nc)
         except ValueError:

@@ -1,0 +1,4 @@
+from .build import ensure_built, native_available
+from .oracle import native_ffd_pack
+
+__all__ = ["ensure_built", "native_available", "native_ffd_pack"]

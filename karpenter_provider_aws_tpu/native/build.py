@@ -1,0 +1,53 @@
+"""On-demand build + load of the native (C++) components.
+
+The reference ships no native code (100% Go); this framework keeps its
+host-side hot paths native where Python would bottleneck the benchmarks
+(SURVEY.md §2: the runtime around the device compute path). No pybind11 in
+the image, so the ABI is plain extern "C" + ctypes. The shared object is
+compiled once per checkout with g++ and cached next to the source.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+_SRC = _REPO_ROOT / "native" / "ffd.cc"
+_LIB = _REPO_ROOT / "native" / "libffd.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def ensure_built() -> Optional[ctypes.CDLL]:
+    """Compile (if stale) and load the native library; None if no toolchain."""
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        try:
+            if (not _LIB.exists()
+                    or _LIB.stat().st_mtime < _SRC.stat().st_mtime):
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                     "-o", str(_LIB), str(_SRC)],
+                    check=True, capture_output=True, timeout=120)
+            lib = ctypes.CDLL(str(_LIB))
+        except (OSError, subprocess.SubprocessError):
+            _build_failed = True
+            return None
+        lib.ffd_pack.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return ensure_built() is not None

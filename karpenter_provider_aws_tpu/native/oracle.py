@@ -1,0 +1,105 @@
+"""ctypes wrapper for the native FFD referee (native/ffd.cc).
+
+Same per-pod sequential semantics as solver/oracle.py (the reference's Go
+scheduler loop) over the new-node packing scope; runs the 50k-pod x
+700-type benchmark configs in about a second, so full-scale cost parity
+(BASELINE.md <=2% envelope) is asserted on every bench run instead of only
+on small regression fixtures.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import ctypes
+import numpy as np
+
+from ..solver.problem import Problem
+from .build import ensure_built
+
+
+@dataclass
+class NativeOraclePlan:
+    num_new_nodes: int
+    new_node_cost: float
+    leftover: int
+    chosen: List[Tuple[int, int, int]]   # (type, zone, captype) per bin
+
+
+def _c(a: np.ndarray, dtype):
+    a = np.ascontiguousarray(a, dtype=dtype)
+    return a, a.ctypes.data_as(ctypes.c_void_p)
+
+
+def native_ffd_pack(problem: Problem, max_bins: int = 200_000) -> Optional[NativeOraclePlan]:
+    """Run the native referee; None if the toolchain/library is unavailable
+    or the problem uses features outside the native scope (existing bins,
+    hostname affinity classes) — callers fall back to the Python oracle."""
+    lib = ensure_built()
+    if lib is None:
+        return None
+    if problem.E > 0:
+        return None
+    if problem.A and (problem.g_owner.any() or problem.g_need.any()
+                      or problem.single_bin.any()):
+        # hostname (anti-)affinity classes / co-location need the Python
+        # referee; per-row spread caps are in native scope
+        return None
+    if problem.A:
+        # the native cap counts only the row's own placements; if any OTHER
+        # group matches a row's spread class, the skew budget is shared
+        # cross-group and only the Python referee counts it correctly
+        for gi in range(problem.G):
+            a = int(problem.g_spread[gi])
+            if a < 0:
+                continue
+            for gj in range(problem.G):
+                if gj != gi and problem.g_match[gj, a]:
+                    return None
+    lat = problem.lattice
+    G = problem.G
+    from ..apis.resources import R
+
+    holders = []
+
+    def arr(a, dtype):
+        h, p = _c(a, dtype)
+        holders.append(h)
+        return p
+
+    out_cost = ctypes.c_float(0.0)
+    out_leftover = ctypes.c_int64(0)
+    chosen_t = np.zeros((max_bins,), np.int32)
+    chosen_z = np.zeros((max_bins,), np.int32)
+    chosen_c = np.zeros((max_bins,), np.int32)
+
+    n = lib.ffd_pack(
+        lat.T, lat.Z, lat.C, R, G, max(problem.NP, 1),
+        arr(lat.alloc, np.float32),
+        arr(lat.available, np.uint8),
+        arr(np.nan_to_num(lat.price, posinf=3.4e38), np.float32),
+        arr(problem.req, np.float32),
+        arr(problem.count, np.int32),
+        arr(problem.g_type, np.uint8),
+        arr(problem.g_zone, np.uint8),
+        arr(problem.g_cap, np.uint8),
+        arr(problem.g_np, np.uint8),
+        arr(problem.max_per_bin, np.int32),
+        arr(problem.np_type, np.uint8),
+        arr(problem.np_zone, np.uint8),
+        arr(problem.np_cap, np.uint8),
+        arr(problem.ds_overhead, np.float32),
+        ctypes.c_int(max_bins),
+        ctypes.byref(out_cost),
+        ctypes.byref(out_leftover),
+        arr(chosen_t, np.int32),
+        arr(chosen_z, np.int32),
+        arr(chosen_c, np.int32),
+    )
+    if n < 0:
+        return None
+    chosen = [(int(chosen_t[i]), int(chosen_z[i]), int(chosen_c[i]))
+              for i in range(min(n, max_bins))]
+    return NativeOraclePlan(num_new_nodes=n, new_node_cost=float(out_cost.value),
+                            leftover=int(out_leftover.value), chosen=chosen)

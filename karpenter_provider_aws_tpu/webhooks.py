@@ -64,7 +64,9 @@ def validate_node_pool(pool: NodePool) -> List[str]:
     for b in d.budgets:
         spec = str(b.nodes)
         try:
-            float(spec[:-1]) if spec.endswith("%") else int(spec)
+            val = float(spec[:-1]) if spec.endswith("%") else int(spec)
+            if val < 0:
+                errs.append(f"budget nodes must be >= 0, got {b.nodes!r}")
         except ValueError:
             errs.append(f"bad budget nodes value {b.nodes!r}")
     if pool.weight < 0 or pool.weight > 100:

@@ -348,3 +348,20 @@ class TestOpsReviewRegressions:
             p.cleanup()
         assert len(cloud.network.launch_templates) == 2, \
             "actively-used templates must not be GC'd"
+
+    def test_unknown_ami_family_degrades_not_crashes(self, lattice):
+        clock = FakeClock()
+        op = Operator(options=Options(registration_delay=1.0), lattice=lattice,
+                      cloud=FakeCloud(clock), clock=clock,
+                      node_classes={"bad": NodeClass(name="bad", role="r",
+                                                     ami_family="Al2023")})
+        op.run_once()   # must not raise
+        assert not op.node_classes["bad"].status_conditions["Ready"]
+        assert op.recorder.events(reason="NodeClassResolveFailed")
+
+    def test_negative_budget_rejected(self):
+        from karpenter_provider_aws_tpu.apis.objects import DisruptionBudget
+        pool = NodePool(name="p", disruption=NodePoolDisruption(
+            budgets=[DisruptionBudget(nodes="-10%")]))
+        with pytest.raises(AdmissionError):
+            admit_node_pool(pool)

@@ -315,3 +315,77 @@ class TestReviewRegressions:
             TopologySpreadConstraint(max_skew=1, topology_key="example.com/rack")])]
         plan = solver.solve(build_problem(pods, [default_pool()], lattice))
         assert any("not supported" in w for w in plan.warnings)
+
+
+class TestNativeReferee:
+    """Parity between the native C++ FFD referee and the Python oracle."""
+
+    def test_native_matches_python_oracle(self, solver, lattice):
+        from karpenter_provider_aws_tpu.native import native_available, native_ffd_pack
+        if not native_available():
+            import pytest as _pytest
+            _pytest.skip("no C++ toolchain")
+        pods = generic_pods(120)
+        pods += [Pod(name=f"c{i}", requests={"cpu": "2", "memory": "2Gi"},
+                     node_selector={wk.LABEL_INSTANCE_CATEGORY: "c"}) for i in range(30)]
+        pods += [Pod(name=f"g{i}", requests={"cpu": "2", "nvidia.com/gpu": 1})
+                 for i in range(5)]
+        problem = build_problem(
+            pods, [default_pool(),
+                   NodePool(name="od", weight=3, requirements=[
+                       Requirement(wk.LABEL_CAPACITY_TYPE, Operator.IN, ("on-demand",))])],
+            lattice)
+        py = ffd_oracle(problem)
+        nat = native_ffd_pack(problem)
+        assert nat is not None
+        assert nat.num_new_nodes == py.num_new_nodes
+        assert abs(nat.new_node_cost - py.new_node_cost) < 1e-2
+        assert nat.leftover == len(py.unschedulable) - len(problem.unschedulable)
+
+    def test_native_respects_per_bin_cap(self, solver, lattice):
+        from karpenter_provider_aws_tpu.native import native_available, native_ffd_pack
+        if not native_available():
+            import pytest as _pytest
+            _pytest.skip("no C++ toolchain")
+        from karpenter_provider_aws_tpu.apis.objects import TopologySpreadConstraint
+        pods = [Pod(name=f"p{i}", labels={"app": "a"},
+                    requests={"cpu": "250m", "memory": "256Mi"},
+                    topology_spread=[TopologySpreadConstraint(
+                        max_skew=2, topology_key=wk.LABEL_HOSTNAME,
+                        label_selector=(("app", "a"),))]) for i in range(10)]
+        problem = build_problem(pods, [default_pool()], lattice)
+        nat = native_ffd_pack(problem)
+        assert nat is not None and nat.num_new_nodes >= 5  # <=2 pods per node
+
+    def test_native_declines_out_of_scope_problems(self, solver, lattice):
+        from karpenter_provider_aws_tpu.native import native_available, native_ffd_pack
+        if not native_available():
+            import pytest as _pytest
+            _pytest.skip("no C++ toolchain")
+        from karpenter_provider_aws_tpu.solver import ExistingBin
+        existing = [ExistingBin(name="n", node_pool="default",
+                                instance_type="m5.large", zone="us-west-2a",
+                                capacity_type="on-demand",
+                                used=np.zeros(8, np.float32))]
+        problem = build_problem(generic_pods(2), [default_pool()], lattice,
+                                existing=existing)
+        assert native_ffd_pack(problem) is None
+
+    def test_native_declines_shared_spread_class(self, solver, lattice):
+        """Two groups sharing one spread selector: the native per-row cap
+        would undercount, so the wrapper must fall back to Python."""
+        from karpenter_provider_aws_tpu.native import native_available, native_ffd_pack
+        if not native_available():
+            import pytest as _pytest
+            _pytest.skip("no C++ toolchain")
+        from karpenter_provider_aws_tpu.apis.objects import TopologySpreadConstraint
+        spread = [TopologySpreadConstraint(max_skew=1, topology_key=wk.LABEL_HOSTNAME,
+                                           label_selector=(("app", "a"),))]
+        pods = [Pod(name=f"x{i}", labels={"app": "a"},
+                    requests={"cpu": "250m", "memory": "256Mi"},
+                    topology_spread=list(spread)) for i in range(4)]
+        pods += [Pod(name=f"y{i}", labels={"app": "a"},
+                     requests={"cpu": "500m", "memory": "512Mi"},
+                     topology_spread=list(spread)) for i in range(4)]
+        problem = build_problem(pods, [default_pool()], lattice)
+        assert native_ffd_pack(problem) is None

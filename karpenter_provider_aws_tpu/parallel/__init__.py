@@ -1,4 +1,4 @@
 from .mesh import solver_mesh
-from .sharded import sharded_pack, split_counts
+from .sharded import ShardedPack, sharded_pack, split_counts
 
-__all__ = ["solver_mesh", "sharded_pack", "split_counts"]
+__all__ = ["ShardedPack", "solver_mesh", "sharded_pack", "split_counts"]

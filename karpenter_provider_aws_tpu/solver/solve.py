@@ -163,6 +163,16 @@ def _unpack_decode_set(buf: np.ndarray, G: int, T: int, Z: int, C: int,
     )
 
 
+def decode_sharded_pack(sp, G: int, T: int, Z: int, C: int,
+                        A: int) -> List[_DecodeSet]:
+    """Decode a ShardedPack's fused [D, B+n_trailer, W] buffer into one
+    host-side _DecodeSet per shard (one device→host transfer for all
+    shards; each shard's rows use the exact single-device layout)."""
+    packed = np.asarray(sp.packed)
+    return [_unpack_decode_set(packed[d], G, T, Z, C, A)
+            for d in range(packed.shape[0])]
+
+
 class Solver:
     """Holds the lattice resident on device; solves padded problems."""
 
@@ -489,15 +499,21 @@ class Solver:
         keep |= pin
         count_split = split_counts(count_pad, D, keep_whole=keep, pin_shard0=pin)
 
+        lat = self.lattice
+        A = max(problem.A, 1)
         while True:
             init = self._init_state(problem, B)
             td = time.perf_counter()
             sp = sharded_pack(mesh, self._alloc, avail, price, groups, pools, init,
                               count_split)
-            sp.result.assign.block_until_ready()
+            # one fused [D,B+n,W] buffer = one device→host transfer for all
+            # shards (sync included); host-side unpack stays off the device clock
+            packed = np.asarray(sp.packed)
             device_s = time.perf_counter() - td
-            leftover = np.asarray(sp.result.leftover)                     # [D,G]
-            next_open = np.asarray(sp.result.state.next_open).reshape(-1)  # [D]
+            decs = [_unpack_decode_set(packed[d], G, lat.T, lat.Z, lat.C, A)
+                    for d in range(packed.shape[0])]
+            leftover = np.stack([dec.leftover for dec in decs])           # [D,G]
+            next_open = np.array([dec.next_open for dec in decs])          # [D]
             overflowed = bool(((leftover.sum(axis=1) > 0) & (next_open >= B)).any())
             if overflowed:
                 B, grew = _grow_bucket(B)
@@ -505,25 +521,32 @@ class Solver:
                     continue
             break
 
-        plan = self._decode_sharded(problem, sp, count_split, device_s)
+        plan = self._decode_sharded(problem, sp, decs, count_split, device_s)
         plan.solve_seconds = time.perf_counter() - t0
         plan.warnings = list(problem.warnings)
         return plan
 
-    def _decode_sharded(self, problem: Problem, sp, count_split: np.ndarray,
-                        device_s: float) -> NodePlan:
+    def _stacked_masks(self, decs: List[_DecodeSet], items: List[Tuple[int, int]]):
+        """Unpack the (shard, bin) rows in ``items`` into stacked [L,T]/[L,Z]/
+        [L,C] boolean masks — one unpackbits per shard, not per bin."""
+        lat = self.lattice
+        by_shard: Dict[int, List[int]] = {}
+        for i, (d, _b) in enumerate(items):
+            by_shard.setdefault(d, []).append(i)
+        tm = np.zeros((len(items), lat.T), bool)
+        zm = np.zeros((len(items), lat.Z), bool)
+        cm = np.zeros((len(items), lat.C), bool)
+        for d, idxs in by_shard.items():
+            rows = np.array([items[i][1] for i in idxs])
+            tm[idxs] = decs[d].tmask(rows, lat.T)
+            zm[idxs] = decs[d].zmask(rows, lat.Z)
+            cm[idxs] = decs[d].cmask(rows, lat.C)
+        return tm, zm, cm
+
+    def _decode_sharded(self, problem: Problem, sp, decs: List[_DecodeSet],
+                        count_split: np.ndarray, device_s: float) -> NodePlan:
         lat = self.lattice
         D = count_split.shape[0]
-        res = sp.result
-        assign = np.asarray(res.assign)          # [D,G,B]
-        leftover = np.asarray(res.leftover)      # [D,G]
-        st = res.state
-        fixed = np.asarray(st.fixed)             # [D,B]
-        cum = np.asarray(st.cum)                 # [D,B,R]
-        chosen_t = np.asarray(res.chosen_t)
-        chosen_z = np.asarray(res.chosen_z)
-        chosen_c = np.asarray(res.chosen_c)
-        chosen_price = np.asarray(res.chosen_price)
 
         # -- walk each group's contiguous per-shard name slices through the
         # per-shard bin tables (same cursor decode as single-device)
@@ -539,14 +562,14 @@ class Solver:
                 shard_names = names[start: start + share]
                 start += share
                 cursor = 0
-                for b in np.nonzero(assign[d, gi])[0]:
-                    n = int(assign[d, gi, b])
+                for b in np.nonzero(decs[d].assign[gi])[0]:
+                    n = int(decs[d].assign[gi, b])
                     bins_content.setdefault((d, int(b)), []).append(
                         (gi, shard_names[cursor: cursor + n]))
                     cursor += n
                 # a shard's leftover gets a second chance in the merge solve
                 # (other shards' bins / existing capacity may still hold it)
-                spill = shard_names[cursor: cursor + int(leftover[d, gi])]
+                spill = shard_names[cursor: cursor + int(decs[d].leftover[gi])]
                 if spill:
                     spill_names.setdefault(gi, []).extend(spill)
 
@@ -554,14 +577,14 @@ class Solver:
         kept: List[Tuple[int, int, List[Tuple[int, List[str]]]]] = []
         tail_names: Dict[int, List[str]] = {gi: list(v) for gi, v in spill_names.items()}
         for (d, b), content in sorted(bins_content.items()):
-            if fixed[d, b]:
+            if decs[d].fixed[b]:
                 name = problem.existing[b].name
                 for _, pod_names in content:
                     existing_assignments.setdefault(name, []).extend(pod_names)
                 continue
-            alloc_t = lat.alloc[int(chosen_t[d, b])]
+            alloc_t = lat.alloc[int(decs[d].chosen_t[b])]
             with np.errstate(divide="ignore", invalid="ignore"):
-                frac = np.where(alloc_t > 0, cum[d, b] / alloc_t, 0.0)
+                frac = np.where(alloc_t > 0, decs[d].cum[b] / alloc_t, 0.0)
             if float(np.max(frac, initial=0.0)) < self.MERGE_FILL_THRESHOLD:
                 for gi, pod_names in content:
                     tail_names.setdefault(gi, []).extend(pod_names)
@@ -574,21 +597,18 @@ class Solver:
             nodes: List[PlannedNode] = []
             assigns = {k: list(v) for k, v in existing_assignments.items()}
             unsched = dict(unschedulable)
-            tmask = np.asarray(st.tmask)
-            zmask = np.asarray(st.zmask)
-            cmask = np.asarray(st.cmask)
-            np_id = np.asarray(st.np_id)
-            for (d, b), content in sorted(bins_content.items()):
-                if fixed[d, b]:
-                    continue
-                ftypes, fzones, fcaps = self._feasible_sets(
-                    problem, tmask[d, b], zmask[d, b], cmask[d, b])
+            new_entries = [(db, content) for db, content in sorted(bins_content.items())
+                           if not decs[db[0]].fixed[db[1]]]
+            tm, zm, cm = self._stacked_masks(decs, [db for db, _ in new_entries])
+            feasible = self._feasible_sets_batch(problem, tm, zm, cm)
+            for ((d, b), content), (ftypes, fzones, fcaps) in zip(new_entries, feasible):
+                dec = decs[d]
                 node = PlannedNode(
-                    node_pool=problem.node_pools[int(np_id[d, b])].name,
-                    instance_type=lat.names[int(chosen_t[d, b])],
-                    zone=lat.zones[int(chosen_z[d, b])],
-                    capacity_type=lat.capacity_types[int(chosen_c[d, b])],
-                    price_per_hour=float(chosen_price[d, b]),
+                    node_pool=problem.node_pools[int(dec.np_id[b])].name,
+                    instance_type=lat.names[int(dec.chosen_t[b])],
+                    zone=lat.zones[int(dec.chosen_z[b])],
+                    capacity_type=lat.capacity_types[int(dec.chosen_c[b])],
+                    price_per_hour=float(dec.chosen_price[b]),
                     feasible_types=ftypes, feasible_zones=fzones,
                     feasible_capacity_types=fcaps,
                 )
@@ -606,7 +626,7 @@ class Solver:
         if not tail_names:
             return raw_plan()
 
-        merged = self._merge_solve(problem, sp, kept, tail_names,
+        merged = self._merge_solve(problem, decs, kept, tail_names,
                                    existing_assignments, unschedulable, device_s)
         # the merge is a refinement: take it when it schedules at least as
         # many pods and does not raise cost; otherwise keep the raw packing.
@@ -621,24 +641,13 @@ class Solver:
             return merged
         return raw_plan()
 
-    def _merge_solve(self, problem: Problem, sp, kept, tail_names,
-                     existing_assignments: Dict[str, List[str]],
+    def _merge_solve(self, problem: Problem, decs: List[_DecodeSet], kept,
+                     tail_names, existing_assignments: Dict[str, List[str]],
                      unschedulable: Dict[str, str], device_s: float):
         """Re-pack dissolved tail bins + spilled pods in one single-device
         refinement solve seeded with existing bins (fixed) and kept bins
         (open, re-priced at finalization for maximum offering flexibility)."""
         lat = self.lattice
-        st = sp.result.state
-        cum = np.asarray(st.cum)
-        tmask = np.asarray(st.tmask)
-        zmask = np.asarray(st.zmask)
-        cmask = np.asarray(st.cmask)
-        np_id = np.asarray(st.np_id)
-        npods = np.asarray(st.npods)
-        alloc_cap = np.asarray(st.alloc_cap)
-        pm = np.asarray(st.pm)
-        po = np.asarray(st.po)
-
         E = problem.E
         K = len(kept)
         G = _bucket(problem.G, _G_BUCKETS)
@@ -660,6 +669,7 @@ class Solver:
             count=jnp.asarray(merge_count))
         pools = self._pool_params(problem)
         avail, price = self._device_avail_price(problem)
+        k_tm, k_zm, k_cm = self._stacked_masks(decs, [(d, b) for d, b, _ in kept])
 
         while True:
             s_cum = np.zeros((B2, R), np.float32)
@@ -675,29 +685,32 @@ class Solver:
             s_po = np.zeros((B2, A), bool)
             # rows [0,E): existing bins, post-pack shard-0 state (fixed)
             if E:
-                s_cum[:E] = cum[0, :E]
-                s_tm[:E] = tmask[0, :E]
-                s_zm[:E] = zmask[0, :E]
-                s_cm[:E] = cmask[0, :E]
-                s_np[:E] = np_id[0, :E]
-                s_npods[:E] = npods[0, :E]
+                d0 = decs[0]
+                e_rows = np.arange(E)
+                s_cum[:E] = d0.cum[:E]
+                s_tm[:E] = d0.tmask(e_rows, lat.T)
+                s_zm[:E] = d0.zmask(e_rows, lat.Z)
+                s_cm[:E] = d0.cmask(e_rows, lat.C)
+                s_np[:E] = d0.np_id[:E]
+                s_npods[:E] = d0.npods[:E]
                 s_open[:E] = True
                 s_fixed[:E] = True
-                s_alloc[:E] = alloc_cap[0, :E]
-                s_pm[:E] = pm[0, :E]
-                s_po[:E] = po[0, :E]
+                s_alloc[:E] = d0.alloc_cap[:E]
+                s_pm[:E] = d0.pm[:E]
+                s_po[:E] = d0.po[:E]
             # rows [E,E+K): kept new bins from all shards (open, re-priced)
             for i, (d, b, _content) in enumerate(kept):
                 r = E + i
-                s_cum[r] = cum[d, b]
-                s_tm[r] = tmask[d, b]
-                s_zm[r] = zmask[d, b]
-                s_cm[r] = cmask[d, b]
-                s_np[r] = np_id[d, b]
-                s_npods[r] = npods[d, b]
+                dec = decs[d]
+                s_cum[r] = dec.cum[b]
+                s_tm[r] = k_tm[i]
+                s_zm[r] = k_zm[i]
+                s_cm[r] = k_cm[i]
+                s_np[r] = dec.np_id[b]
+                s_npods[r] = dec.npods[b]
                 s_open[r] = True
-                s_pm[r] = pm[d, b]
-                s_po[r] = po[d, b]
+                s_pm[r] = dec.pm[b]
+                s_po[r] = dec.po[b]
             init = binpack.BinState(
                 cum=jnp.asarray(s_cum), tmask=jnp.asarray(s_tm),
                 zmask=jnp.asarray(s_zm), cmask=jnp.asarray(s_cm),

@@ -16,6 +16,7 @@ from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
 from karpenter_provider_aws_tpu.parallel import sharded_pack, solver_mesh, split_counts
 from karpenter_provider_aws_tpu.solver import Solver, build_problem
 from karpenter_provider_aws_tpu.solver.problem import ExistingBin
+from karpenter_provider_aws_tpu.solver.solve import decode_sharded_pack
 
 
 @pytest.fixture(scope="module")
@@ -56,7 +57,8 @@ class TestShardedPack:
         count_split = split_counts(np.asarray(groups.count), 8)
         sp = sharded_pack(mesh, solver._alloc, solver._avail, solver._price,
                           groups, pool_params, init, count_split)
-        assign = np.asarray(sp.result.assign)          # [D,G,B]
+        decs = decode_sharded_pack(sp, G, lattice.T, lattice.Z, lattice.C, 1)
+        assign = np.stack([d.assign for d in decs])    # [D,G,B]
         assert assign.shape == (8, G, B)
         total = int(np.asarray(groups.count).sum())
         placed = int(assign.sum())
@@ -64,10 +66,9 @@ class TestShardedPack:
         assert placed + int(sp.total_leftover) == total
         assert int(sp.total_leftover) == 0
         # the psum'd collectives agree with a host-side reduction
-        st = sp.result.state
-        live = (np.asarray(st.open) & ~np.asarray(st.fixed)
-                & (np.asarray(st.npods) > 0))
-        host_cost = float(np.where(live, np.asarray(sp.result.chosen_price), 0.0).sum())
+        live = np.stack([d.open & ~d.fixed & (d.npods > 0) for d in decs])
+        prices = np.stack([d.chosen_price for d in decs])
+        host_cost = float(np.where(live, prices, 0.0).sum())
         assert float(sp.total_cost) == pytest.approx(host_cost, rel=1e-5)
         assert int(sp.total_nodes) == int(live.sum())
 
@@ -85,7 +86,8 @@ class TestShardedPack:
         sp = sharded_pack(mesh, solver._alloc, solver._avail, solver._price,
                           groups, solver._pool_params(problem),
                           solver._init_state(problem, 512), count_split)
-        per_shard = np.asarray(sp.result.assign).sum(axis=(1, 2))
+        decs = decode_sharded_pack(sp, 16, lattice.T, lattice.Z, lattice.C, 1)
+        per_shard = np.array([int(d.assign.sum()) for d in decs])
         np.testing.assert_array_equal(per_shard, count_split.sum(axis=1))
 
 

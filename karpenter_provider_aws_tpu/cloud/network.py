@@ -100,20 +100,21 @@ class FakeNetwork:
             self.security_groups[gid] = SecurityGroup(id=gid, name=name,
                                                       tags=dict(discovery))
         # default AMIs per family x arch, exposed via SSM alias parameters
-        # (reference amifamily/ami.go:136-181 SSM default-AMI discovery)
+        # (reference amifamily/ami.go:136-181 SSM default-AMI discovery).
+        # Keys come from each family strategy's own
+        # default_ami_ssm_parameters() so the fake and the resolver can
+        # never drift on the parameter paths. Deferred import: amifamily
+        # imports this module for the Image type.
+        from ..providers.amifamily import AMI_FAMILIES
         t = 1_000.0
-        for fam, ssm_fmt in (
-            ("al2023", "/aws/service/eks/optimized-ami/{v}/amazon-linux-2023/{arch}/standard/recommended/image_id"),
-            ("al2", "/aws/service/eks/optimized-ami/{v}/amazon-linux-2/{arch}/recommended/image_id"),
-            ("bottlerocket", "/aws/service/bottlerocket/aws-k8s-{v}/{arch}/latest/image_id"),
-            ("ubuntu", "/aws/service/canonical/ubuntu/eks/22.04/{v}/stable/current/{arch}/hvm/ebs-gp2/ami-id"),
-        ):
-            for arch in ("amd64", "arm64"):
-                iid = f"ami-{fam}-{arch}"
-                self.images[iid] = Image(id=iid, name=f"{fam}-{arch}-v{k8s_version}",
-                                         arch=arch, creation_date=t)
-                arch_alias = "x86_64" if arch == "amd64" else arch
-                self.ssm_parameters[ssm_fmt.format(v=k8s_version, arch=arch_alias)] = iid
+        for fam_name, fam in AMI_FAMILIES.items():
+            for arch, path in fam.default_ami_ssm_parameters(k8s_version).items():
+                slug = fam_name.lower()
+                iid = f"ami-{slug}-{arch}"
+                if iid not in self.images:
+                    self.images[iid] = Image(id=iid, name=f"{slug}-{arch}-v{k8s_version}",
+                                             arch=arch, creation_date=t)
+                self.ssm_parameters[path] = iid
 
     # ---- describe APIs ---------------------------------------------------
 

@@ -47,7 +47,7 @@ from ..solver.problem import build_problem
 from ..solver.solve import NodePlan, Solver
 from ..state.cluster import ClusterState
 from ..utils.clock import Clock
-from .provisioning import Provisioner, nodepool_hash
+from .provisioning import Provisioner, ProvisionResult, nodepool_hash
 from .termination import TerminationController
 
 SPOT_TO_SPOT_MIN_TYPES = 15   # disruption.md:129
@@ -202,6 +202,9 @@ class DisruptionController:
             tuple(sorted((p.name, p.node_name or "") for p in self.cluster.pods.values())),
             tuple(sorted(self.cluster.claims)),
             self.unavailable.seq_num,
+            # a pricing refresh can turn a previously-unprofitable
+            # consolidation profitable: re-search after one
+            self.solver.lattice.price_version,
             len(self._in_flight),
         )
 
@@ -256,8 +259,12 @@ class DisruptionController:
             self._in_flight.remove(a)
 
     def _begin(self, reason: str, removed: Sequence[NodeClaim],
-               plan: NodePlan) -> bool:
-        """Launch replacements (if any) then queue the drain."""
+               plan: NodePlan,
+               max_replacement_cost: Optional[float] = None) -> bool:
+        """Launch replacements (if any) then queue the drain.
+        ``max_replacement_cost`` re-guards consolidation profitability after
+        limit-driven instance-type substitution (a downsized-into-the-limit
+        replacement is pricier than the solver's choice by construction)."""
         pool_budgets: Dict[str, int] = {}
         for c in removed:
             pool = self.node_pools[c.node_pool]
@@ -265,8 +272,35 @@ class DisruptionController:
             if pool_budgets[c.node_pool] <= 0:
                 return False
             pool_budgets[c.node_pool] -= 1
+        # NodePool resource limits bind replacements exactly like fresh
+        # provisioning (nodepools.md limits). Launch-before-drain means the
+        # originals still count toward usage here — correct, both exist
+        # during the transition. If any replacement cannot fit the limits
+        # (even downsized), abort: never drain without standing capacity.
+        probe = ProvisionResult(plan=plan)
+        planned = self.provisioner._enforce_limits(list(plan.new_nodes), probe,
+                                                   warn=False)
+        if len(planned) != len(plan.new_nodes):
+            self.recorder.publish("Warning", "DisruptionBlocked", "NodeClaim",
+                                  removed[0].name if removed else "",
+                                  f"{reason} replacement exceeds nodepool limits")
+            return False
+        if max_replacement_cost is not None:
+            new_cost = sum(n.price_per_hour for n in planned)
+            if new_cost >= max_replacement_cost:
+                self.recorder.publish(
+                    "Warning", "DisruptionBlocked", "NodeClaim",
+                    removed[0].name if removed else "",
+                    f"{reason} no longer profitable after limit substitution")
+                return False
+        # limit substitution may also have narrowed launch flexibility below
+        # the spot-to-spot guard's floor — re-check on the final plan
+        # (consolidation only: the guard does not apply to drift/expiration
+        # replacements, disruption.md:129)
+        if reason == "Underutilized" and not self._spot_guard_ok(removed, plan):
+            return False
         action = DisruptionAction(reason=reason, claims=[c.name for c in removed])
-        for node in plan.new_nodes:
+        for node in planned:
             claim = self.provisioner._make_claim(node)
             self.cluster.add_claim(claim)
             try:
@@ -377,13 +411,15 @@ class DisruptionController:
                   and plan.new_node_cost < removed_price - CONSOLIDATION_SAVINGS_EPS
                   and self._spot_guard_ok(removed, plan))
             if ok:
-                best = (removed, plan)
+                best = (removed, plan, removed_price)
                 lo = k + 1
             else:
                 hi = k - 1
         if best is not None:
-            removed, plan = best
-            if self._begin("Underutilized", removed, plan):
+            removed, plan, removed_price = best
+            if self._begin("Underutilized", removed, plan,
+                           max_replacement_cost=removed_price
+                           - CONSOLIDATION_SAVINGS_EPS):
                 return True
 
         # single-node scan: each candidate alone, allowing a cheaper
@@ -399,6 +435,8 @@ class DisruptionController:
                 continue
             if not self._spot_guard_ok([claim], plan):
                 continue
-            if self._begin("Underutilized", [claim], plan):
+            if self._begin("Underutilized", [claim], plan,
+                           max_replacement_cost=removed_price
+                           - CONSOLIDATION_SAVINGS_EPS):
                 return True
         return False

@@ -92,36 +92,38 @@ class Provisioner:
         self._claim_ids = itertools.count(1)
         self._batch_start: Optional[float] = None
         self._last_pod_seen: Optional[float] = None
-        self._known_pending: int = 0
+        self._known_pending: frozenset = frozenset()
         self._lock = threading.Lock()
 
     # ---- batch window (settings.md:17-18) --------------------------------
 
     def batch_ready(self) -> bool:
         """Has the pending-pod batch window closed? New arrivals reset the
-        idle timer; the max window bounds total latency."""
+        idle timer; the max window bounds total latency. Arrival detection
+        compares the pending-pod NAME set, not its size — one pod binding
+        while another arrives in the same window is still an arrival."""
         now = self.clock.now()
         with self._lock:
-            n = len(self.cluster.pending_pods())
-            if n == 0:
+            names = frozenset(p.name for p in self.cluster.pending_pods())
+            if not names:
                 self._batch_start = None
                 self._last_pod_seen = None
-                self._known_pending = 0
+                self._known_pending = frozenset()
                 return False
             if self._batch_start is None:
                 self._batch_start = now
                 self._last_pod_seen = now
-                self._known_pending = n
+                self._known_pending = names
                 return False
-            if n != self._known_pending:
-                self._known_pending = n
+            if names - self._known_pending:
                 self._last_pod_seen = now
+            self._known_pending = names
             idle_over = now - self._last_pod_seen >= self.batch_idle_seconds
             max_over = now - self._batch_start >= self.batch_max_seconds
             if idle_over or max_over:
                 self._batch_start = None
                 self._last_pod_seen = None
-                self._known_pending = 0
+                self._known_pending = frozenset()
                 return True
             return False
 
@@ -194,13 +196,34 @@ class Provisioner:
         self._m_unsched_pods.set(result.pods_unschedulable)
         return result
 
+    def _offering_price(self, node: PlannedNode) -> float:
+        """Cheapest available offering price for the node's instance type
+        within its feasible zone/capacity-type sets."""
+        lat = self.solver.lattice
+        ti = lat.name_to_idx.get(node.instance_type)
+        if ti is None:
+            return float("inf")
+        zs = [lat.zones.index(z) for z in (node.feasible_zones or lat.zones)
+              if z in lat.zones]
+        cs = [lat.capacity_types.index(c)
+              for c in (node.feasible_capacity_types or lat.capacity_types)
+              if c in lat.capacity_types]
+        if not zs or not cs:
+            return float("inf")
+        sub = np.where(lat.available[np.ix_([ti], zs, cs)],
+                       lat.price[np.ix_([ti], zs, cs)], np.inf)
+        return float(sub.min())
+
     def _enforce_limits(self, nodes: Sequence[PlannedNode],
-                        result: ProvisionResult) -> List[PlannedNode]:
+                        result: ProvisionResult,
+                        warn: bool = True) -> List[PlannedNode]:
         """Enforce NodePool resource limits on the plan (CRD nodepools
         limits). A violating node first tries to DOWNSIZE: every type in the
         bin's feasible set can hold the bin's pods by construction, so the
         cheapest one whose capacity fits the remaining budget substitutes;
-        only if none fits are the pods left pending."""
+        only if none fits are the pods left pending. ``warn=False`` runs it
+        as a pure probe (disruption replacement gating) without publishing
+        FailedScheduling events for pods that are not actually pending."""
         usage = self.cluster.pool_usage()
         out: List[PlannedNode] = []
         lat = self.solver.lattice
@@ -229,15 +252,17 @@ class Provisioner:
             candidates = node.feasible_types or [node.instance_type]
             fitting = [t for t in candidates if fits(t)]
             if not fitting:
-                for p in node.pods:
-                    self.recorder.publish("Warning", "FailedScheduling", "Pod", p,
-                                          f"nodepool {node.node_pool} limit exceeded")
+                if warn:
+                    for p in node.pods:
+                        self.recorder.publish("Warning", "FailedScheduling", "Pod", p,
+                                              f"nodepool {node.node_pool} limit exceeded")
                 result.pods_unschedulable += len(node.pods)
                 continue
             # restrict the claim's launch flexibility to limit-fitting types
             node.feasible_types = fitting
             if node.instance_type not in fitting:
                 node.instance_type = fitting[0]  # cheapest-first order
+                node.price_per_hour = self._offering_price(node)
             usage[node.node_pool] = current + lat.capacity[lat.name_to_idx[node.instance_type]]
             out.append(node)
         return out

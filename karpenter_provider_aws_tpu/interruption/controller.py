@@ -87,8 +87,11 @@ class InterruptionController:
                 continue
             if msg.kind == MessageKind.SPOT_INTERRUPTION:
                 # remember the reclaimed pool so the replacement solve
-                # avoids it (controller.go:194-200)
-                if claim.instance_type and claim.zone:
+                # avoids it (controller.go:194-200) — only when the claim
+                # really is spot: a mislabeled event for an on-demand node
+                # must not poison the spot pool for that type/zone
+                if (claim.capacity_type == wk.CAPACITY_TYPE_SPOT
+                        and claim.instance_type and claim.zone):
                     self.unavailable.mark_unavailable(
                         msg.kind.value, wk.CAPACITY_TYPE_SPOT,
                         claim.instance_type, claim.zone)

@@ -182,23 +182,39 @@ class Solver:
         self._avail = jnp.asarray(lattice.available)
         self._price = jnp.asarray(lattice.price)
         self._price_version = lattice.price_version
-        # settled bin-bucket per group-bucket: after an overflow retry the
-        # next same-shaped solve starts at the size that worked (each retry
-        # costs a full device round trip)
-        self._b_hint: Dict[int, int] = {}
+        # per group-bucket: (fresh-estimate bucket, bucket actually needed)
+        # of the last solve. A same-or-larger fresh estimate starts at the
+        # size that worked (each overflow retry costs a full device round
+        # trip); a smaller estimate ignores the hint, so one big wave never
+        # pins later small solves to a huge padded bin table.
+        self._b_hint: Dict[int, Tuple[int, int]] = {}
 
     def _estimate_bins(self, problem: Problem) -> int:
         """Lower-bound estimate of bins the pack will open: each group needs
         at least count / (best-case per-node fit) bins, and never packs more
-        than max_per_bin per node (hostname spread / anti-affinity)."""
+        than max_per_bin per node (hostname spread / anti-affinity).
+
+        Fit is the joint vector fit of the best type the group's type mask
+        actually allows (not per-resource maxima across different types,
+        which systematically underestimates B for constrained workloads and
+        forces a guaranteed overflow retry — one extra device round trip).
+        The retry stays as the backstop."""
         if problem.G == 0:
             return 0
-        amax = self.lattice.alloc.max(axis=0)                       # [R]
-        req = problem.req
-        req_safe = np.where(req > 0, req, 1.0)
-        fit = np.where(req > 0, amax[None, :] / req_safe, np.inf).min(axis=1)
-        fit = np.maximum(np.floor(np.nan_to_num(fit, posinf=1e9)), 1.0)
-        caps = np.minimum(fit, problem.max_per_bin.astype(np.float64))
+        alloc = self.lattice.alloc.astype(np.float64)               # [T,R]
+        req = problem.req.astype(np.float64)                        # [G,R]
+        caps = np.zeros((problem.G,), np.float64)
+        CH = 256  # bound the [g,T,R] temp
+        for s in range(0, problem.G, CH):
+            r = req[s: s + CH]                                      # [g,R]
+            m = problem.g_type[s: s + CH]                           # [g,T]
+            pos = r[:, None, :] > 0
+            ratio = np.where(pos, alloc[None, :, :]
+                             / np.where(pos, r[:, None, :], 1.0), np.inf)
+            fit_t = np.floor(np.nan_to_num(ratio.min(axis=2), posinf=1e9))
+            caps[s: s + CH] = np.where(m, fit_t, 0.0).max(axis=1, initial=0.0)
+        caps = np.minimum(np.maximum(caps, 1.0),
+                          problem.max_per_bin.astype(np.float64))
         return int(np.ceil(problem.count / np.maximum(caps, 1.0)).sum())
 
     def _device_avail_price(self, problem: Problem):
@@ -315,8 +331,14 @@ class Solver:
         G = _bucket(problem.G, _G_BUCKETS)
         total_pods = int(problem.count.sum())
         b_needed = problem.E + min(total_pods, self._estimate_bins(problem) + 64)
-        B = _bucket(max(b_needed, problem.E + 1, self._b_hint.get(G, 0)),
-                    _B_BUCKETS, clamp=True)
+        fresh = _bucket(max(b_needed, problem.E + 1), _B_BUCKETS, clamp=True)
+        prev = self._b_hint.get(G)
+        if prev is not None and fresh >= prev[0]:
+            # a same-or-larger problem shape than the one that last forced a
+            # retry: start directly at the size that worked
+            B = max(fresh, prev[1])
+        else:
+            B = fresh
 
         groups = self._padded_groups(problem, G)
         pools = self._pool_params(problem)
@@ -339,7 +361,11 @@ class Solver:
                     continue
             break
 
-        self._b_hint[G] = max(self._b_hint.get(G, 0), B)
+        # record what this estimate bucket actually consumed (dec.next_open
+        # rows), so the hint decays as soon as a smaller wave passes through
+        needed = _bucket(max(dec.next_open, problem.E + 1, 1), _B_BUCKETS,
+                         clamp=True)
+        self._b_hint[G] = (fresh, needed)
         plan = self._decode(problem, dec, device_s)
         plan.solve_seconds = time.perf_counter() - t0
         plan.warnings = list(problem.warnings)

@@ -240,6 +240,24 @@ class TestEndToEnd:
         env.clock.step(1.1)
         assert env.provisioner.batch_ready()
 
+    def test_batch_swap_same_count_is_arrival(self, env):
+        """Regression (round-1 ADVICE): one pod leaving while another
+        arrives in the same window keeps the pending COUNT constant; the
+        name-set comparison must still see the arrival and reset the idle
+        timer."""
+        env.cluster.add_pod(pods(1, prefix="a")[0])
+        assert not env.provisioner.batch_ready()  # window opens at t=0
+        env.clock.step(0.6)
+        env.cluster.delete_pod("a-0")
+        env.cluster.add_pod(pods(1, prefix="b")[0])
+        assert not env.provisioner.batch_ready()  # swap = arrival
+        env.clock.step(0.6)
+        # t=1.2: idle since b's arrival is only 0.6 s — a count-based
+        # tracker would have fired here
+        assert not env.provisioner.batch_ready()
+        env.clock.step(0.6)
+        assert env.provisioner.batch_ready()
+
     def test_nodepool_limits_downsize_then_block(self, env, lattice):
         from karpenter_provider_aws_tpu.apis.resources import axis
         env.node_pools["default"].limits = {"cpu": "8"}

@@ -269,6 +269,42 @@ class TestBudgets:
         queued = sum(len(a.claims) for a in env.disruption._in_flight)
         assert queued <= 1, "budget of 1 must cap parallel disruption"
 
+    def test_pricing_refresh_invalidates_failed_fingerprint(self, lattice):
+        """Regression (round-1 ADVICE): a pricing refresh can turn a
+        previously-unprofitable consolidation profitable, so the cached
+        failed-search fingerprint must change with lattice.price_version."""
+        env = make_env(lattice, consolidate_after=5.0)
+        fp1 = env.disruption._fingerprint()
+        env.solver.lattice.price_version += 1
+        assert env.disruption._fingerprint() != fp1
+
+    def test_replacement_respects_pool_limits(self, lattice):
+        """Regression (round-1 ADVICE): disruption replacements must pass
+        through the same NodePool-limits gate as fresh provisioning. A pool
+        capped at its current usage cannot launch a replacement (launch-
+        before-drain counts both), so consolidation is blocked."""
+        env = make_env(lattice, consolidate_after=5.0)
+        ps = pods(1, cpu="14", mem="24Gi", prefix="big") + \
+            pods(1, cpu="250m", mem="256Mi", prefix="small")
+        for p in ps:
+            env.cluster.add_pod(p)
+        env.settle()
+        assert len(env.cluster.nodes) == 1
+        (claim,) = env.cluster.claims.values()
+        # cap the pool at exactly the current node's cpu: no headroom for a
+        # replacement while the original still runs
+        env.node_pools["default"].limits = {
+            "cpu": str(int(claim.capacity["cpu"] / 1000.0))}
+        env.cluster.delete_pod("big-0")
+        env.clock.step(6)
+        for _ in range(10):
+            env.run_once()
+            env.clock.step(2)
+        # the oversized node survives: replacement would exceed the limit
+        assert claim.name in env.cluster.claims
+        assert not env.disruption._in_flight
+        assert all(p.node_name for p in env.cluster.pods.values())
+
     def test_zero_budget_blocks_all(self, lattice):
         clock = FakeClock()
         pool = NodePool(name="default", disruption=NodePoolDisruption(

@@ -114,6 +114,29 @@ class TestInterruptionController:
         handled = env.interruption.reconcile()
         assert handled == 1 and len(env.interruption_queue) == 0
 
+    def test_spot_event_on_od_claim_does_not_mark_ice(self, lattice):
+        """Regression (round-1 ADVICE): a spot-interruption event for an
+        on-demand claim must not poison the spot ICE cache for that
+        type/zone (reference controller.go:194-200 guards on capacity
+        type). The drain itself still proceeds — the event says the
+        instance is going away."""
+        clock = FakeClock()
+        queue = FakeQueue("interruptions")
+        pool = NodePool(name="default", requirements=[
+            Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN, ("on-demand",))])
+        env = Operator(options=Options(registration_delay=1.0), lattice=lattice,
+                       cloud=FakeCloud(clock), clock=clock, node_pools=[pool],
+                       interruption_queue=queue)
+        add_pods(env)
+        env.settle()
+        (claim,) = env.cluster.claims.values()
+        assert claim.capacity_type == "on-demand"
+        queue.send(spot_interruption(parse_instance_id(claim.provider_id)))
+        env.interruption.reconcile()
+        assert not env.unavailable.is_unavailable("spot", claim.instance_type,
+                                                  claim.zone)
+        assert env.cluster.claims[claim.name].deletion_timestamp
+
     def test_message_metrics(self, env):
         add_pods(env)
         env.settle()

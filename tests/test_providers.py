@@ -110,6 +110,21 @@ class TestAMIProvider:
         assert {a.arch for a in amis} == {"amd64", "arm64"}
         assert all(a.id.startswith("ami-al2023") for a in amis)
 
+    def test_every_family_default_resolves(self, cloud):
+        """Regression (round-1 ADVICE): the fake seeded AL2 SSM keys under a
+        path the AL2 strategy never queries, so AL2 NodeClasses resolved
+        zero AMIs and stayed NotReady forever. The fake now derives its keys
+        from each strategy's default_ami_ssm_parameters(); every non-Custom
+        family must resolve its defaults."""
+        from karpenter_provider_aws_tpu.providers.amifamily import AMI_FAMILIES
+        p = AMIProvider(cloud, cloud.clock)
+        for name, fam in AMI_FAMILIES.items():
+            expected = fam.default_ami_ssm_parameters("1.29")
+            if not expected:   # Custom: selector terms required, no defaults
+                continue
+            amis = p.list(nodeclass(name=f"nc-{name.lower()}", ami_family=name), "1.29")
+            assert {a.arch for a in amis} == set(expected), name
+
     def test_selector_terms_override_defaults(self, cloud):
         p = AMIProvider(cloud, cloud.clock)
         nc = nodeclass(ami_family="Custom",

@@ -99,6 +99,38 @@ class TestBasicPacking:
         assert plan.new_node_cost == pytest.approx(oracle.new_node_cost, rel=1e-5)
 
 
+class TestBinBudget:
+    def test_b_hint_decays_after_large_wave(self, lattice):
+        """Regression (round-2 ADVICE): one huge wave must not pin every
+        later small solve in the same G-bucket to the big bin-table size;
+        the hint's influence is capped near the fresh estimate and tracks
+        the size that actually worked."""
+        s = Solver(lattice)
+        # one-pod-per-bin via max_per_bin-driving anti-affinity would be
+        # heavyweight; a big flat wave is enough to push B to a high bucket
+        big = build_problem(generic_pods(3000, cpu="2", mem="4Gi"),
+                            [default_pool()], lattice)
+        s.solve(big)
+        hint_after_big = s._b_hint[16]
+        small = build_problem(generic_pods(4), [default_pool()], lattice)
+        s.solve(small)
+        fresh, needed = s._b_hint[16]
+        assert needed <= 128, (hint_after_big, s._b_hint[16])
+
+    def test_estimate_respects_type_mask(self, lattice):
+        """Regression (round-2 ADVICE): a group restricted to small types
+        must not have its bin estimate computed against the biggest type in
+        the whole lattice (that underestimates B and forces a retry)."""
+        s = Solver(lattice)
+        pods = generic_pods(64, cpu="1", mem="2Gi",
+                            node_selector={wk.LABEL_INSTANCE_TYPE: "t3.medium"})
+        problem = build_problem(pods, [default_pool()], lattice)
+        est = s._estimate_bins(problem)
+        # t3.small holds ~1 one-cpu pod after overhead: the estimate must be
+        # in the dozens, not the handful a 96-vCPU-based estimate gives
+        assert est >= 32, est
+
+
 class TestConstraints:
     def test_node_selector_family(self, solver, lattice):
         pods = generic_pods(10, node_selector={wk.LABEL_INSTANCE_FAMILY: "c5"})

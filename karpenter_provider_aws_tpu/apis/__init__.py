@@ -7,6 +7,9 @@ from .objects import (
     tolerates_all,
     TopologySpreadConstraint,
     PodAffinityTerm,
+    PreferredRequirement,
+    relax_pod,
+    relaxation_depth,
     Pod,
     NodePoolDisruption,
     DisruptionBudget,
@@ -21,7 +24,8 @@ __all__ = [
     "RESOURCE_AXES", "R", "resources_to_vec", "resources_to_vec_checked", "vec_to_resources",
     "Operator", "Requirement", "Requirements",
     "Taint", "TaintEffect", "Toleration", "tolerates_all",
-    "TopologySpreadConstraint", "PodAffinityTerm", "Pod",
+    "TopologySpreadConstraint", "PodAffinityTerm", "PreferredRequirement",
+    "relax_pod", "relaxation_depth", "Pod",
     "NodePoolDisruption", "DisruptionBudget", "NodePool",
     "NodeClassSelectorTerm", "NodeClass", "NodeClaim", "Node",
 ]

@@ -89,6 +89,17 @@ class PodAffinityTerm:
     anti: bool = False
 
 
+@dataclass(frozen=True)
+class PreferredRequirement:
+    """preferredDuringSchedulingIgnoredDuringExecution node-affinity term
+    (reference scheduling.md:203-206): a soft rule. The scheduler treats it
+    as required while possible and relaxes it — lowest weight first — when
+    the pod cannot otherwise schedule (the core's preference relaxation)."""
+
+    requirement: Requirement
+    weight: int = 1                        # k8s weight 1-100
+
+
 @dataclass
 class Pod:
     name: str
@@ -97,6 +108,7 @@ class Pod:
     requests: Dict[str, "str | int | float"] = field(default_factory=dict)
     node_selector: Dict[str, str] = field(default_factory=dict)
     required_affinity: List[Requirement] = field(default_factory=list)  # nodeAffinity required terms
+    preferred_affinity: List[PreferredRequirement] = field(default_factory=list)
     tolerations: List[Toleration] = field(default_factory=list)
     topology_spread: List[TopologySpreadConstraint] = field(default_factory=list)
     pod_affinity: List[PodAffinityTerm] = field(default_factory=list)
@@ -106,14 +118,57 @@ class Pod:
     priority: int = 0
     deletion_timestamp: Optional[float] = None
 
-    def scheduling_requirements(self) -> Requirements:
+    def hard_scheduling_requirements(self) -> Requirements:
+        """Required rules only — what can never be relaxed away."""
         reqs = Requirements.from_node_selector(self.node_selector)
         for r in self.required_affinity:
             reqs.add(r)
         return reqs
 
+    def scheduling_requirements(self) -> Requirements:
+        reqs = self.hard_scheduling_requirements()
+        # preferences are treated as required while possible; the relaxation
+        # loop (Solver.solve_relaxed) hands in relaxed Pod copies with the
+        # weakest ones removed when the pod cannot otherwise schedule
+        for p in self.preferred_affinity:
+            reqs.add(p.requirement)
+        return reqs
+
     def request_vec(self) -> np.ndarray:
         return resources_to_vec(self.requests, implicit_pod=True)
+
+
+def _relax_sequence(pod: "Pod") -> List[Tuple[str, int]]:
+    """Droppable soft constraints in drop order: preferred node-affinity
+    terms lowest-weight-first (scheduling.md:203-206), then ScheduleAnyway
+    topology spreads (advisory skew, scheduling.md:322-334)."""
+    prefs = sorted(range(len(pod.preferred_affinity)),
+                   key=lambda i: (pod.preferred_affinity[i].weight, i))
+    seq: List[Tuple[str, int]] = [("pref", i) for i in prefs]
+    seq += [("spread", i) for i, c in enumerate(pod.topology_spread)
+            if c.when_unsatisfiable == "ScheduleAnyway"]
+    return seq
+
+
+def relaxation_depth(pod: Pod) -> int:
+    """How many relaxation steps this pod supports (0 = nothing soft)."""
+    return len(_relax_sequence(pod))
+
+
+def relax_pod(pod: Pod, level: int) -> Pod:
+    """Pod copy with its ``level`` weakest soft constraints removed."""
+    if level <= 0:
+        return pod
+    import dataclasses
+    dropped = _relax_sequence(pod)[:level]
+    dp = {i for kind, i in dropped if kind == "pref"}
+    ds = {i for kind, i in dropped if kind == "spread"}
+    return dataclasses.replace(
+        pod,
+        preferred_affinity=[p for i, p in enumerate(pod.preferred_affinity)
+                            if i not in dp],
+        topology_spread=[c for i, c in enumerate(pod.topology_spread)
+                         if i not in ds])
 
 
 # ---------------------------------------------------------------------------

@@ -43,7 +43,6 @@ from ..errors import UnfulfillableCapacityError
 from ..events import Recorder
 from ..lattice.tensors import masked_view
 from ..metrics import Registry, wire_core_metrics
-from ..solver.problem import build_problem
 from ..solver.solve import NodePlan, Solver
 from ..state.cluster import ClusterState
 from ..utils.clock import Clock
@@ -163,11 +162,10 @@ class DisruptionController:
                     and b.name not in {c.name for c in removed}]
         bound = [bp for bp in self.cluster.bound_pods()
                  if bp.node_name not in removed_nodes]
-        problem = build_problem(
+        plan = self.solver.solve_relaxed(
             pods, list(self.node_pools.values()), lattice,
             existing=existing, daemonset_pods=self.cluster.daemonset_pods(),
             bound_pods=bound)
-        plan = self.solver.solve(problem)
         removed_price = 0.0
         for c in removed:
             ti = lattice.name_to_idx.get(c.instance_type)

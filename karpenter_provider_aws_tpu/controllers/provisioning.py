@@ -29,7 +29,6 @@ from ..errors import UnfulfillableCapacityError
 from ..events import Recorder
 from ..lattice.tensors import Lattice, masked_view
 from ..metrics import Registry, wire_core_metrics
-from ..solver.problem import build_problem
 from ..solver.solve import NodePlan, PlannedNode, Solver
 from ..state.cluster import ClusterState
 from ..utils.clock import Clock
@@ -134,12 +133,11 @@ class Provisioner:
         if not pending:
             return ProvisionResult(plan=None)
         lattice = masked_view(self.solver.lattice, self.unavailable.mask(self.solver.lattice))
-        problem = build_problem(
+        plan = self.solver.solve_relaxed(
             pending, list(self.node_pools.values()), lattice,
             existing=self.cluster.existing_bins(lattice),
             daemonset_pods=self.cluster.daemonset_pods(),
             bound_pods=self.cluster.bound_pods())
-        plan = self.solver.solve(problem)
         self._m_batch.observe(len(pending))
         self._m_sched.observe(plan.solve_seconds)
         self._m_sim.observe(plan.device_seconds)

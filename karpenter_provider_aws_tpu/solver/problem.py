@@ -195,6 +195,7 @@ def _group_key(pod: Pod, relevant_keys: frozenset, memo: dict) -> tuple:
         lab,
         t(pod.node_selector),
         t(pod.required_affinity),
+        t(pod.preferred_affinity),
         t(pod.tolerations),
         t(pod.topology_spread),
         t(pod.pod_affinity),
@@ -228,7 +229,10 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
             # resolves daemonset overhead per simulated node the same way)
             if not tolerates_all(ds.tolerations, pool.taints + pool.startup_taints):
                 continue
-            ds_reqs = ds.scheduling_requirements()
+            # hard rules only: a daemonset's zone/node PREFERENCE must not
+            # drop its overhead from nodes it would still run on (in real
+            # k8s the DS schedules there regardless; sizing must include it)
+            ds_reqs = ds.hard_scheduling_requirements()
             if not ds_reqs.compatible_with(reqs):
                 continue
             if not _custom_keys_ok(ds_reqs, pool.labels):
@@ -257,6 +261,7 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
         ck = (id(pod.requests) if pod.requests else 0,
               id(pod.node_selector) if pod.node_selector else 0,
               id(pod.required_affinity) if pod.required_affinity else 0,
+              id(pod.preferred_affinity) if pod.preferred_affinity else 0,
               id(pod.tolerations) if pod.tolerations else 0,
               id(pod.topology_spread) if pod.topology_spread else 0,
               id(pod.pod_affinity) if pod.pod_affinity else 0,
@@ -268,6 +273,7 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
                     and (not pod.requests or rep.requests is pod.requests)
                     and (not pod.node_selector or rep.node_selector is pod.node_selector)
                     and (not pod.required_affinity or rep.required_affinity is pod.required_affinity)
+                    and (not pod.preferred_affinity or rep.preferred_affinity is pod.preferred_affinity)
                     and (not pod.tolerations or rep.tolerations is pod.tolerations)
                     and (not pod.topology_spread or rep.topology_spread is pod.topology_spread)
                     and (not pod.pod_affinity or rep.pod_affinity is pod.pod_affinity)

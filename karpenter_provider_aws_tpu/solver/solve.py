@@ -314,6 +314,60 @@ class Solver:
 
     # ---- solve ----
 
+    def solve_relaxed(self, pods, node_pools, lattice=None, existing=(),
+                      daemonset_pods=(), bound_pods=(), mesh=None) -> NodePlan:
+        """Solve with preferred-rule relaxation (reference
+        scheduling.md:203-206, 322-334).
+
+        Round 0 treats every soft constraint — preferred node affinity,
+        ScheduleAnyway topology spread — as hard. Pods that come back
+        unschedulable and still have soft constraints get them relaxed one
+        tier at a time (lowest-weight preference first, then advisory
+        spreads) and only those pods' groups re-enter the next solve round.
+        A pod whose only obstacle is a preference or an advisory skew can
+        therefore never end unschedulable; hard-constrained pods fail
+        exactly as before. Bounded by the deepest pod's soft-constraint
+        count; workloads without soft constraints pay zero extra rounds."""
+        from ..apis.objects import relax_pod, relaxation_depth
+        from .problem import build_problem
+
+        lattice = lattice if lattice is not None else self.lattice
+        depth = {p.name: relaxation_depth(p) for p in pods}
+        relax: Dict[str, int] = {}
+        # every round increments at least one pod's level, so sum-of-depths
+        # bounds termination; relaxing one pod can cascade a sibling into an
+        # infeasible spread domain, which is why max-depth alone is not
+        # enough. Capped to keep a pathological wave's solve count sane.
+        max_rounds = min(1 + sum(depth.values()), 64)
+        best = None
+        total_solve = total_device = 0.0
+        for _ in range(max_rounds):
+            eff = [p if relax.get(p.name, 0) == 0 else relax_pod(p, relax[p.name])
+                   for p in pods]
+            problem = build_problem(eff, node_pools, lattice, existing=existing,
+                                    daemonset_pods=daemonset_pods,
+                                    bound_pods=bound_pods)
+            plan = self.solve(problem, mesh=mesh)
+            total_solve += plan.solve_seconds
+            total_device += plan.device_seconds
+            # a relaxation round re-packs globally and may regress a pod
+            # relaxation cannot help — keep the best plan seen, not the last
+            if best is None or ((len(plan.unschedulable), plan.new_node_cost)
+                                < (len(best.unschedulable), best.new_node_cost)):
+                best = plan
+            improvable = [n for n, reason in plan.unschedulable.items()
+                          if relax.get(n, 0) < depth.get(n, 0)
+                          # pre-solve failures (unknown resource names) are
+                          # not fixable by dropping preferences — no rounds
+                          and not reason.startswith("unknown resource")]
+            if not improvable:
+                break
+            for n in improvable:
+                relax[n] = relax.get(n, 0) + 1
+        best.solve_seconds = total_solve
+        best.device_seconds = total_device
+        return best
+
     def solve(self, problem: Problem, mesh=None) -> NodePlan:
         """Solve a problem into a NodePlan.
 

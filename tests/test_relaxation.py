@@ -1,0 +1,173 @@
+"""Preferred-rule relaxation + ScheduleAnyway semantics.
+
+Behavioral spec: reference website concepts/scheduling.md:203-206
+(preferredDuringScheduling treated as required, relaxed when the pod cannot
+otherwise schedule) and :322-334 (whenUnsatisfiable: ScheduleAnyway is
+advisory — skew must never leave a pod pending).
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.apis import (
+    NodePool, Operator as ReqOp, Pod, PreferredRequirement, Requirement,
+    TopologySpreadConstraint, relax_pod, relaxation_depth,
+)
+from karpenter_provider_aws_tpu.apis import wellknown as wk
+from karpenter_provider_aws_tpu.cloud import FakeCloud
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+from karpenter_provider_aws_tpu.operator import Operator as Op, Options
+from karpenter_provider_aws_tpu.solver import Solver
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+_FAMILIES = ("m5", "c5", "m6g", "t3")
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    return build_lattice([s for s in build_catalog() if s.family in _FAMILIES])
+
+
+@pytest.fixture(scope="module")
+def solver(lattice):
+    return Solver(lattice)
+
+
+def pref(key, *values, weight=1):
+    return PreferredRequirement(Requirement(key, ReqOp.IN, tuple(values)),
+                                weight=weight)
+
+
+class TestRelaxationPrimitives:
+    def test_depth_counts_prefs_and_anyway_spreads(self):
+        pod = Pod(name="p", preferred_affinity=[pref(wk.LABEL_ZONE, "us-west-2a")],
+                  topology_spread=[
+                      TopologySpreadConstraint(1, wk.LABEL_ZONE,
+                                               when_unsatisfiable="ScheduleAnyway"),
+                      TopologySpreadConstraint(1, wk.LABEL_HOSTNAME)])
+        # 1 preference + 1 ScheduleAnyway; the DoNotSchedule spread is hard
+        assert relaxation_depth(pod) == 2
+
+    def test_relax_drops_lowest_weight_first(self):
+        pod = Pod(name="p", preferred_affinity=[
+            pref(wk.LABEL_INSTANCE_CATEGORY, "c", weight=10),
+            pref(wk.LABEL_ZONE, "us-west-2a", weight=1)])
+        r1 = relax_pod(pod, 1)
+        assert [p.weight for p in r1.preferred_affinity] == [10]
+        r2 = relax_pod(pod, 2)
+        assert r2.preferred_affinity == []
+        assert relax_pod(pod, 0) is pod
+
+    def test_relax_keeps_hard_spreads(self):
+        pod = Pod(name="p", topology_spread=[
+            TopologySpreadConstraint(1, wk.LABEL_ZONE),
+            TopologySpreadConstraint(1, wk.LABEL_ZONE,
+                                     when_unsatisfiable="ScheduleAnyway")])
+        r = relax_pod(pod, 1)
+        assert len(r.topology_spread) == 1
+        assert r.topology_spread[0].when_unsatisfiable == "DoNotSchedule"
+
+
+class TestPreferredAffinity:
+    def test_preference_honored_when_feasible(self, solver, lattice):
+        pods = [Pod(name=f"p{i}", requests={"cpu": "1", "memory": "2Gi"},
+                    preferred_affinity=[pref(wk.LABEL_ZONE, "us-west-2b")])
+                for i in range(8)]
+        plan = solver.solve_relaxed(pods, [NodePool(name="default")])
+        assert not plan.unschedulable
+        assert all(n.zone == "us-west-2b" for n in plan.new_nodes)
+
+    def test_schedules_only_after_relaxation(self, solver, lattice):
+        """The pool forbids the preferred zone: strict round fails, the
+        relaxed round schedules — the preference must never leave the pod
+        pending (scheduling.md:203-206)."""
+        pool = NodePool(name="default", requirements=[
+            Requirement(wk.LABEL_ZONE, ReqOp.NOT_IN, ("us-west-2b",))])
+        pods = [Pod(name=f"p{i}", requests={"cpu": "1", "memory": "2Gi"},
+                    preferred_affinity=[pref(wk.LABEL_ZONE, "us-west-2b")])
+                for i in range(4)]
+        plan = solver.solve_relaxed(pods, [pool])
+        assert not plan.unschedulable
+        assert all(n.zone != "us-west-2b" for n in plan.new_nodes)
+
+    def test_lowest_weight_dropped_first(self, solver, lattice):
+        """Two preferences, one impossible: the high-weight satisfiable one
+        survives relaxation of the low-weight impossible one."""
+        pool = NodePool(name="default", requirements=[
+            Requirement(wk.LABEL_ZONE, ReqOp.NOT_IN, ("us-west-2b",))])
+        pods = [Pod(name=f"p{i}", requests={"cpu": "1", "memory": "2Gi"},
+                    preferred_affinity=[
+                        pref(wk.LABEL_INSTANCE_CATEGORY, "c", weight=50),
+                        pref(wk.LABEL_ZONE, "us-west-2b", weight=1)])
+                for i in range(4)]
+        plan = solver.solve_relaxed(pods, [pool])
+        assert not plan.unschedulable
+        assert all(n.instance_type.startswith("c") for n in plan.new_nodes)
+        assert all(n.zone != "us-west-2b" for n in plan.new_nodes)
+
+    def test_required_rules_never_relaxed(self, solver, lattice):
+        pods = [Pod(name="p0", requests={"cpu": "1", "memory": "2Gi"},
+                    required_affinity=[
+                        Requirement(wk.LABEL_INSTANCE_CATEGORY, ReqOp.IN, ("x",))],
+                    preferred_affinity=[pref(wk.LABEL_ZONE, "us-west-2b")])]
+        plan = solver.solve_relaxed(pods, [NodePool(name="default")])
+        assert "p0" in plan.unschedulable
+
+
+class TestScheduleAnyway:
+    def test_anyway_skew_never_unschedulable(self, solver, lattice):
+        """Pool limited to one zone; a 4-zone ScheduleAnyway spread must
+        collapse into that zone instead of leaving pods pending."""
+        pool = NodePool(name="default", requirements=[
+            Requirement(wk.LABEL_ZONE, ReqOp.IN, ("us-west-2a",))])
+        pods = [Pod(name=f"p{i}", requests={"cpu": "1", "memory": "2Gi"},
+                    labels={"app": "web"},
+                    topology_spread=[TopologySpreadConstraint(
+                        1, wk.LABEL_ZONE, when_unsatisfiable="ScheduleAnyway",
+                        label_selector=(("app", "web"),))])
+                for i in range(8)]
+        plan = solver.solve_relaxed(pods, [pool])
+        assert not plan.unschedulable
+        assert all(n.zone == "us-west-2a" for n in plan.new_nodes)
+
+    def test_do_not_schedule_still_hard(self, solver, lattice):
+        """Same shape with DoNotSchedule: pods assigned to out-of-pool zones
+        stay pending — the hard spread is not silently weakened."""
+        pool = NodePool(name="default", requirements=[
+            Requirement(wk.LABEL_ZONE, ReqOp.IN, ("us-west-2a",))])
+        pods = [Pod(name=f"p{i}", requests={"cpu": "1", "memory": "2Gi"},
+                    labels={"app": "web"},
+                    topology_spread=[TopologySpreadConstraint(
+                        1, wk.LABEL_ZONE, label_selector=(("app", "web"),))])
+                for i in range(8)]
+        plan = solver.solve_relaxed(pods, [pool])
+        assert plan.unschedulable, "DoNotSchedule skew must stay hard"
+
+    def test_anyway_spread_honored_when_feasible(self, solver, lattice):
+        """With all zones open, the advisory spread still spreads."""
+        pods = [Pod(name=f"p{i}", requests={"cpu": "1", "memory": "2Gi"},
+                    labels={"app": "web"},
+                    topology_spread=[TopologySpreadConstraint(
+                        1, wk.LABEL_ZONE, when_unsatisfiable="ScheduleAnyway",
+                        label_selector=(("app", "web"),))])
+                for i in range(8)]
+        plan = solver.solve_relaxed(pods, [NodePool(name="default")])
+        assert not plan.unschedulable
+        zones = {n.zone for n in plan.new_nodes}
+        assert len(zones) >= 2, "advisory spread ignored despite feasibility"
+
+
+class TestEndToEnd:
+    def test_provisioner_relaxes_preferences(self, lattice):
+        clock = FakeClock()
+        pool = NodePool(name="default", requirements=[
+            Requirement(wk.LABEL_ZONE, ReqOp.NOT_IN, ("us-west-2b",))])
+        env = Op(options=Options(registration_delay=1.0), lattice=lattice,
+                 cloud=FakeCloud(clock), clock=clock, node_pools=[pool])
+        env.cluster.add_pod(Pod(
+            name="soft", requests={"cpu": "1", "memory": "2Gi"},
+            preferred_affinity=[pref(wk.LABEL_ZONE, "us-west-2b")]))
+        env.settle()
+        assert env.cluster.pods["soft"].node_name
+        (claim,) = env.cluster.claims.values()
+        assert claim.zone != "us-west-2b"

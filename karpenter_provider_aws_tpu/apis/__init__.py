@@ -15,6 +15,8 @@ from .objects import (
     DisruptionBudget,
     NodePool,
     NodeClassSelectorTerm,
+    PersistentVolumeClaim,
+    StorageClass,
     NodeClass,
     NodeClaim,
     Node,
@@ -28,4 +30,5 @@ __all__ = [
     "relax_pod", "relaxation_depth", "Pod",
     "NodePoolDisruption", "DisruptionBudget", "NodePool",
     "NodeClassSelectorTerm", "NodeClass", "NodeClaim", "Node",
+    "PersistentVolumeClaim", "StorageClass",
 ]

@@ -89,6 +89,28 @@ class PodAffinityTerm:
     anti: bool = False
 
 
+@dataclass
+class StorageClass:
+    """Storage class with zonal allowedTopologies (reference
+    scheduling.md:389-398 'Persistent Volume Topology')."""
+
+    name: str
+    zones: Tuple[str, ...] = ()            # allowedTopologies; () = any zone
+    binding_mode: str = "WaitForFirstConsumer"   # or Immediate
+
+
+@dataclass
+class PersistentVolumeClaim:
+    """A pod's storage claim. ``bound_zone`` is set once a PersistentVolume
+    exists (the CSI driver gives it a zonal node-affinity rule); an unbound
+    WaitForFirstConsumer claim restricts scheduling to its StorageClass's
+    allowed topologies and binds to the zone the pod lands in."""
+
+    name: str
+    storage_class: str = ""
+    bound_zone: Optional[str] = None
+
+
 @dataclass(frozen=True)
 class PreferredRequirement:
     """preferredDuringSchedulingIgnoredDuringExecution node-affinity term
@@ -112,6 +134,7 @@ class Pod:
     tolerations: List[Toleration] = field(default_factory=list)
     topology_spread: List[TopologySpreadConstraint] = field(default_factory=list)
     pod_affinity: List[PodAffinityTerm] = field(default_factory=list)
+    volume_claims: List[str] = field(default_factory=list)  # PVC names
     node_name: Optional[str] = None        # bound node (None = pending)
     owner: Optional[str] = None            # controller owner (daemonset detection etc.)
     is_daemonset: bool = False

@@ -162,10 +162,11 @@ class DisruptionController:
                     and b.name not in {c.name for c in removed}]
         bound = [bp for bp in self.cluster.bound_pods()
                  if bp.node_name not in removed_nodes]
+        pvcs, storage_classes = self.cluster.volume_state()
         plan = self.solver.solve_relaxed(
             pods, list(self.node_pools.values()), lattice,
             existing=existing, daemonset_pods=self.cluster.daemonset_pods(),
-            bound_pods=bound)
+            bound_pods=bound, pvcs=pvcs, storage_classes=storage_classes)
         removed_price = 0.0
         for c in removed:
             ti = lattice.name_to_idx.get(c.instance_type)

@@ -133,11 +133,13 @@ class Provisioner:
         if not pending:
             return ProvisionResult(plan=None)
         lattice = masked_view(self.solver.lattice, self.unavailable.mask(self.solver.lattice))
+        pvcs, storage_classes = self.cluster.volume_state()
         plan = self.solver.solve_relaxed(
             pending, list(self.node_pools.values()), lattice,
             existing=self.cluster.existing_bins(lattice),
             daemonset_pods=self.cluster.daemonset_pods(),
-            bound_pods=self.cluster.bound_pods())
+            bound_pods=self.cluster.bound_pods(),
+            pvcs=pvcs, storage_classes=storage_classes)
         self._m_batch.observe(len(pending))
         self._m_sched.observe(plan.solve_seconds)
         self._m_sim.observe(plan.device_seconds)

@@ -146,6 +146,54 @@ def _custom_keys_ok(reqs: Requirements, pool_labels: Mapping[str, str]) -> bool:
     return True
 
 
+def _volume_zone_mask(pod: Pod, pvcs: Mapping, storage_classes: Mapping,
+                      zones: Sequence[str], warnings: List[str],
+                      shared_claims: frozenset = frozenset()) -> np.ndarray:
+    """Zone restriction from the pod's PVC references (reference
+    scheduling.md:389-398): a bound PV pins its exact zone; an unbound claim
+    restricts to its StorageClass's allowedTopologies (if any).
+
+    ``shared_claims`` names unbound claims referenced by more than one pod
+    in this batch: those pin to ONE eligible zone up front (the reference
+    'randomly selects' a zone for WaitForFirstConsumer claims) so same-batch
+    consumers can never diverge across zones and then fight over the bind."""
+    mask = np.ones((len(zones),), dtype=bool)
+    zone_index = {z: i for i, z in enumerate(zones)}
+    for cname in pod.volume_claims:
+        pvc = pvcs.get(cname)
+        if pvc is None:
+            warnings.append(f"pod references unknown PVC {cname!r}")
+            continue
+        if pvc.bound_zone is not None:
+            m = np.zeros((len(zones),), dtype=bool)
+            zi = zone_index.get(pvc.bound_zone)
+            if zi is not None:
+                m[zi] = True
+            mask &= m
+            continue
+        sc = storage_classes.get(pvc.storage_class)
+        if sc is None:
+            if pvc.storage_class:
+                warnings.append(
+                    f"PVC {cname!r} references unknown StorageClass "
+                    f"{pvc.storage_class!r}")
+            continue
+        if sc.zones:
+            m = np.zeros((len(zones),), dtype=bool)
+            for z in sc.zones:
+                zi = zone_index.get(z)
+                if zi is not None:
+                    m[zi] = True
+            mask &= m
+        if cname in shared_claims:
+            elig = np.nonzero(mask)[0]
+            if elig.size:
+                pin = np.zeros((len(zones),), dtype=bool)
+                pin[elig[0]] = True
+                mask &= pin
+    return mask
+
+
 def _selector_keys(pods: Sequence[Pod], bound_pods: Sequence[BoundPod]) -> frozenset:
     """Label keys referenced by ANY affinity/spread selector in the batch or
     on bound pods. Only these keys affect scheduling semantics, so the group
@@ -199,13 +247,16 @@ def _group_key(pod: Pod, relevant_keys: frozenset, memo: dict) -> tuple:
         t(pod.tolerations),
         t(pod.topology_spread),
         t(pod.pod_affinity),
+        t(pod.volume_claims),
     )
 
 
 def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: Lattice,
                   existing: Sequence[ExistingBin] = (),
                   daemonset_pods: Sequence[Pod] = (),
-                  bound_pods: Sequence[BoundPod] = ()) -> Problem:
+                  bound_pods: Sequence[BoundPod] = (),
+                  pvcs: Optional[Mapping] = None,
+                  storage_classes: Optional[Mapping] = None) -> Problem:
     pools = sorted(node_pools, key=lambda p: (-p.weight, p.name))
     NP = len(pools)
     T, Z, C = lattice.T, lattice.Z, lattice.C
@@ -265,6 +316,7 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
               id(pod.tolerations) if pod.tolerations else 0,
               id(pod.topology_spread) if pod.topology_spread else 0,
               id(pod.pod_affinity) if pod.pod_affinity else 0,
+              id(pod.volume_claims) if pod.volume_claims else 0,
               id(pod.labels) if (lab_rel and pod.labels) else 0)
         hit = coarse.get(ck)
         if hit is not None:
@@ -277,6 +329,7 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
                     and (not pod.tolerations or rep.tolerations is pod.tolerations)
                     and (not pod.topology_spread or rep.topology_spread is pod.topology_spread)
                     and (not pod.pod_affinity or rep.pod_affinity is pod.pod_affinity)
+                    and (not pod.volume_claims or rep.volume_claims is pod.volume_claims)
                     and (not (lab_rel and pod.labels) or rep.labels is pod.labels)):
                 names.append(pod.name)
                 continue
@@ -302,6 +355,13 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
         order.append(sig)
         if hit is None:
             coarse[ck] = (pod, names)
+
+    # unbound claims with multiple same-batch consumers pin to one zone
+    claim_refs: Dict[str, int] = {}
+    for pod in pods:
+        for c in pod.volume_claims:
+            claim_refs[c] = claim_refs.get(c, 0) + 1
+    shared_claims = frozenset(c for c, n in claim_refs.items() if n > 1)
 
     # --- per raw group: masks, pool compatibility, topology resolution
     registry = ClassRegistry()
@@ -341,8 +401,13 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
             for key in reqs.keys()
         )
 
+        zone_mask_eff = masks.zone_mask
+        if rep.volume_claims:
+            zone_mask_eff = zone_mask_eff & _volume_zone_mask(
+                rep, pvcs or {}, storage_classes or {}, lattice.zones, warnings,
+                shared_claims=shared_claims)
         splits, topo, cut = resolve_group_topology(
-            rep, len(names), masks.zone_mask, masks.cap_mask,
+            rep, len(names), zone_mask_eff, masks.cap_mask,
             lattice.zones, lattice.capacity_types, registry, bound_pods, warnings,
             pending_counts=pending_spread_counts)
         if cut > 0:

@@ -315,7 +315,8 @@ class Solver:
     # ---- solve ----
 
     def solve_relaxed(self, pods, node_pools, lattice=None, existing=(),
-                      daemonset_pods=(), bound_pods=(), mesh=None) -> NodePlan:
+                      daemonset_pods=(), bound_pods=(), pvcs=None,
+                      storage_classes=None, mesh=None) -> NodePlan:
         """Solve with preferred-rule relaxation (reference
         scheduling.md:203-206, 322-334).
 
@@ -346,7 +347,8 @@ class Solver:
                    for p in pods]
             problem = build_problem(eff, node_pools, lattice, existing=existing,
                                     daemonset_pods=daemonset_pods,
-                                    bound_pods=bound_pods)
+                                    bound_pods=bound_pods, pvcs=pvcs,
+                                    storage_classes=storage_classes)
             plan = self.solve(problem, mesh=mesh)
             total_solve += plan.solve_seconds
             total_device += plan.device_seconds

@@ -46,6 +46,8 @@ class ClusterState:
         self.pods: Dict[str, Pod] = {}
         self.nodes: Dict[str, Node] = {}
         self.claims: Dict[str, NodeClaim] = {}
+        self.pvcs: Dict[str, "PersistentVolumeClaim"] = {}
+        self.storage_classes: Dict[str, "StorageClass"] = {}
         self._nominations: Dict[str, _Nomination] = {}   # pod -> claim
 
     # ---- pods ------------------------------------------------------------
@@ -64,7 +66,43 @@ class ClusterState:
             pod = self.pods.get(pod_name)
             if pod is not None:
                 pod.node_name = node_name
+                # WaitForFirstConsumer: the CSI driver creates the PV in the
+                # zone the pod lands in; later consumers of the claim are
+                # pinned there (reference scheduling.md:389-398)
+                if pod.volume_claims:
+                    node = self.nodes.get(node_name)
+                    zone = node.labels.get(wk.LABEL_ZONE) if node else None
+                    if zone:
+                        for c in pod.volume_claims:
+                            pvc = self.pvcs.get(c)
+                            if pvc is not None and pvc.bound_zone is None:
+                                pvc.bound_zone = zone
             self._nominations.pop(pod_name, None)
+
+    # ---- volumes ---------------------------------------------------------
+
+    def add_storage_class(self, sc) -> None:
+        with self._lock:
+            self.storage_classes[sc.name] = sc
+
+    def add_pvc(self, pvc) -> None:
+        with self._lock:
+            if pvc.bound_zone is None:
+                sc = self.storage_classes.get(pvc.storage_class)
+                if sc is not None and sc.binding_mode == "Immediate" and sc.zones:
+                    # Immediate binding provisions the PV before any pod
+                    # exists: the claim pins a zone now and consumers follow
+                    # it (the inverse of WaitForFirstConsumer)
+                    pvc.bound_zone = sc.zones[0]
+            self.pvcs[pvc.name] = pvc
+
+    def volume_state(self):
+        """Locked snapshot of (pvcs, storage_classes) for one solve: the
+        solver must not observe bind_pod mutating bound_zone mid-round."""
+        import dataclasses
+        with self._lock:
+            return ({k: dataclasses.replace(v) for k, v in self.pvcs.items()},
+                    dict(self.storage_classes))
 
     def unbind_pods_on(self, node_name: str) -> List[Pod]:
         """Eviction: pods on the node become pending again (termination drain)."""
@@ -223,4 +261,6 @@ class ClusterState:
             self.pods.clear()
             self.nodes.clear()
             self.claims.clear()
+            self.pvcs.clear()
+            self.storage_classes.clear()
             self._nominations.clear()

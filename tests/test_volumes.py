@@ -1,0 +1,155 @@
+"""Persistent Volume zonal topology.
+
+Behavioral spec: reference website concepts/scheduling.md:389-398 — the
+scheduler follows Pod → PVC → StorageClass, restricts new nodes to the
+class's allowedTopologies for unbound WaitForFirstConsumer claims, pins to
+the PV's zone once one exists, and later consumers of the claim follow it.
+"""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import (
+    NodePool, Operator as ReqOp, PersistentVolumeClaim, Pod, Requirement,
+    StorageClass,
+)
+from karpenter_provider_aws_tpu.apis import wellknown as wk
+from karpenter_provider_aws_tpu.cloud import FakeCloud
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+from karpenter_provider_aws_tpu.operator import Operator, Options
+from karpenter_provider_aws_tpu.solver import Solver, build_problem
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+_FAMILIES = ("m5", "c5", "t3")
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    return build_lattice([s for s in build_catalog() if s.family in _FAMILIES])
+
+
+@pytest.fixture(scope="module")
+def solver(lattice):
+    return Solver(lattice)
+
+
+def vol_pod(name, claims):
+    return Pod(name=name, requests={"cpu": "1", "memory": "2Gi"},
+               volume_claims=list(claims))
+
+
+class TestVolumeTopologySolve:
+    def test_unbound_wffc_restricts_to_allowed_topologies(self, solver, lattice):
+        scs = {"ebs": StorageClass(name="ebs",
+                                   zones=("us-west-2a", "us-west-2b"))}
+        pvcs = {"data": PersistentVolumeClaim(name="data", storage_class="ebs")}
+        problem = build_problem([vol_pod("p0", ["data"])],
+                                [NodePool(name="default")], lattice,
+                                pvcs=pvcs, storage_classes=scs)
+        plan = solver.solve(problem)
+        assert not plan.unschedulable
+        assert all(n.zone in ("us-west-2a", "us-west-2b") for n in plan.new_nodes)
+        assert all(z in ("us-west-2a", "us-west-2b")
+                   for n in plan.new_nodes for z in n.feasible_zones)
+
+    def test_bound_pv_pins_exact_zone(self, solver, lattice):
+        pvcs = {"data": PersistentVolumeClaim(name="data", storage_class="ebs",
+                                              bound_zone="us-west-2c")}
+        problem = build_problem([vol_pod("p0", ["data"])],
+                                [NodePool(name="default")], lattice, pvcs=pvcs)
+        plan = solver.solve(problem)
+        assert not plan.unschedulable
+        assert [n.zone for n in plan.new_nodes] == ["us-west-2c"]
+
+    def test_bound_pv_outside_pool_zones_is_unschedulable(self, solver, lattice):
+        pool = NodePool(name="default", requirements=[
+            Requirement(wk.LABEL_ZONE, ReqOp.IN, ("us-west-2a",))])
+        pvcs = {"data": PersistentVolumeClaim(name="data",
+                                              bound_zone="us-west-2c")}
+        problem = build_problem([vol_pod("p0", ["data"])], [pool], lattice,
+                                pvcs=pvcs)
+        plan = solver.solve(problem)
+        assert "p0" in plan.unschedulable
+
+    def test_distinct_claims_distinct_groups(self, solver, lattice):
+        pvcs = {"a": PersistentVolumeClaim(name="a", bound_zone="us-west-2a"),
+                "b": PersistentVolumeClaim(name="b", bound_zone="us-west-2b")}
+        problem = build_problem([vol_pod("pa", ["a"]), vol_pod("pb", ["b"])],
+                                [NodePool(name="default")], lattice, pvcs=pvcs)
+        plan = solver.solve(problem)
+        assert not plan.unschedulable
+        zone_of = {p: n.zone for n in plan.new_nodes for p in n.pods}
+        assert zone_of["pa"] == "us-west-2a" and zone_of["pb"] == "us-west-2b"
+
+    def test_unknown_pvc_warns_but_schedules(self, solver, lattice):
+        problem = build_problem([vol_pod("p0", ["ghost"])],
+                                [NodePool(name="default")], lattice)
+        plan = solver.solve(problem)
+        assert not plan.unschedulable
+        assert any("unknown PVC" in w for w in plan.warnings)
+
+    def test_unknown_storage_class_warns(self, solver, lattice):
+        pvcs = {"data": PersistentVolumeClaim(name="data",
+                                              storage_class="missing")}
+        problem = build_problem([vol_pod("p0", ["data"])],
+                                [NodePool(name="default")], lattice, pvcs=pvcs)
+        plan = solver.solve(problem)
+        assert not plan.unschedulable
+        assert any("unknown StorageClass" in w for w in plan.warnings)
+
+    def test_shared_unbound_claim_pins_one_zone(self, solver, lattice):
+        """Same-batch consumers of one unbound WFFC claim must land in ONE
+        zone — the bind would otherwise strand the losers."""
+        scs = {"ebs": StorageClass(name="ebs",
+                                   zones=("us-west-2a", "us-west-2b"))}
+        pvcs = {"data": PersistentVolumeClaim(name="data", storage_class="ebs")}
+        pods = [vol_pod(f"p{i}", ["data"]) for i in range(6)]
+        problem = build_problem(pods, [NodePool(name="default")], lattice,
+                                pvcs=pvcs, storage_classes=scs)
+        plan = solver.solve(problem)
+        assert not plan.unschedulable
+        zones = {n.zone for n in plan.new_nodes}
+        assert len(zones) == 1 and zones <= {"us-west-2a", "us-west-2b"}
+
+
+class TestVolumeBindingLifecycle:
+    def test_wffc_binds_on_landing_and_pins_successor(self, lattice):
+        """First consumer lands somewhere in the allowed zones; the PV binds
+        to that zone; a later pod using the same claim follows it."""
+        clock = FakeClock()
+        env = Operator(options=Options(registration_delay=1.0), lattice=lattice,
+                       cloud=FakeCloud(clock), clock=clock,
+                       node_pools=[NodePool(name="default")])
+        env.cluster.add_storage_class(
+            StorageClass(name="ebs", zones=("us-west-2a", "us-west-2b")))
+        env.cluster.add_pvc(PersistentVolumeClaim(name="data", storage_class="ebs"))
+        env.cluster.add_pod(vol_pod("first", ["data"]))
+        env.settle()
+        pod = env.cluster.pods["first"]
+        assert pod.node_name
+        zone = env.cluster.nodes[pod.node_name].labels[wk.LABEL_ZONE]
+        assert zone in ("us-west-2a", "us-west-2b")
+        assert env.cluster.pvcs["data"].bound_zone == zone
+        # the first consumer goes away; a successor reuses the claim
+        env.cluster.delete_pod("first")
+        env.cluster.add_pod(vol_pod("second", ["data"]))
+        env.settle()
+        pod2 = env.cluster.pods["second"]
+        assert pod2.node_name
+        assert env.cluster.nodes[pod2.node_name].labels[wk.LABEL_ZONE] == zone
+
+    def test_immediate_binding_pins_before_any_pod(self, lattice):
+        """Immediate StorageClass: the PV exists before the first consumer;
+        the pod follows the claim's zone."""
+        clock = FakeClock()
+        env = Operator(options=Options(registration_delay=1.0), lattice=lattice,
+                       cloud=FakeCloud(clock), clock=clock,
+                       node_pools=[NodePool(name="default")])
+        env.cluster.add_storage_class(StorageClass(
+            name="io2", zones=("us-west-2c",), binding_mode="Immediate"))
+        env.cluster.add_pvc(PersistentVolumeClaim(name="fast", storage_class="io2"))
+        assert env.cluster.pvcs["fast"].bound_zone == "us-west-2c"
+        env.cluster.add_pod(vol_pod("p0", ["fast"]))
+        env.settle()
+        pod = env.cluster.pods["p0"]
+        assert pod.node_name
+        assert env.cluster.nodes[pod.node_name].labels[wk.LABEL_ZONE] == "us-west-2c"

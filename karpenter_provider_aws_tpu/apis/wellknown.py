@@ -60,6 +60,9 @@ ANNOTATION_NODECLASS_HASH = f"{PROVIDER_PREFIX}/nodeclass-hash"
 ANNOTATION_NODECLASS_HASH_VERSION = f"{PROVIDER_PREFIX}/nodeclass-hash-version"
 ANNOTATION_NODEPOOL_HASH = f"{KARPENTER_PREFIX}/nodepool-hash"
 ANNOTATION_NODEPOOL_HASH_VERSION = f"{KARPENTER_PREFIX}/nodepool-hash-version"
+ANNOTATION_INSTANCE_TAGGED = f"{KARPENTER_PREFIX}/instance-tagged"
+TAG_NAME = "Name"
+TAG_NODECLAIM = f"{KARPENTER_PREFIX}/nodeclaim"
 
 # Well-known label keys. Requirements.intersects mirrors the reference's
 # `Compatible(..., AllowUndefinedWellKnownLabels)` (cloudprovider.go:248):

@@ -45,6 +45,11 @@ class CloudInstance:
     launch_time: float = 0.0
     price: float = 0.0
     tags: Dict[str, str] = field(default_factory=dict)
+    # launch materialization, consulted by live drift detection
+    # (reference drift.go:44-135 compares these against the NodeClass)
+    image_id: Optional[str] = None
+    subnet_id: Optional[str] = None
+    security_group_ids: Tuple[str, ...] = ()
 
     @property
     def provider_id(self) -> str:
@@ -135,6 +140,17 @@ class FakeCloud:
             self._maybe_raise()
             return [i for i in self.instances.values()
                     if include_terminated or i.state not in ("terminated",)]
+
+    def create_tags(self, instance_id: str, tags: Dict[str, str]) -> None:
+        """Merge tags onto a live instance (EC2 CreateTags analog; consumed
+        by the post-registration tagging controller)."""
+        with self._lock:
+            self.calls.append(("create_tags", (instance_id, tuple(sorted(tags.items())))))
+            self._maybe_raise()
+            inst = self.instances.get(instance_id)
+            if inst is None or inst.state == "terminated":
+                raise NotFoundError(f"instance not found: {instance_id}")
+            inst.tags.update(tags)
 
     def terminate_instances(self, ids: Sequence[str]) -> List[str]:
         """Terminate; unknown ids raise NotFoundError (callers treat it as

@@ -156,11 +156,14 @@ class CloudProvider:
             subnet = zonal_subnets[instance.zone]
             self.subnets.update_inflight_ips(subnet.id)
             instance.tags["subnet-id"] = subnet.id
+            instance.subnet_id = subnet.id
         arch = self.lattice.labels[self.lattice.name_to_idx[instance.instance_type]].get(
             wk.LABEL_ARCH, "amd64")
         lt = lts_by_arch.get(arch)
         if lt is not None:
             instance.tags["launch-template"] = lt.name
+            instance.image_id = lt.image_id
+            instance.security_group_ids = tuple(lt.security_group_ids)
             claim.image_id = lt.image_id
         return self._instance_to_claim(instance, claim)
 
@@ -318,8 +321,13 @@ class CloudProvider:
 
     def is_drifted(self, claim: NodeClaim) -> Optional[str]:
         """Drift reasons (reference pkg/cloudprovider/drift.go:44-151):
-        NodeClassDrift on static-hash mismatch; InstanceDrift when the
-        backing instance disappeared."""
+        NodeClassDrift on static-hash mismatch (checked first to save the
+        live lookups), InstanceDrift when the backing instance disappeared,
+        then live AMI/subnet/SG comparison of the instance's actual launch
+        materialization against the NodeClass's currently-resolved status
+        (drift.go:73-135). Each live check is skipped when either side is
+        unknown — the reference treats undiscovered state as an error, not
+        as drift."""
         nc = self.node_classes.get(claim.node_class_ref)
         if nc is not None:
             want = nodeclass_hash(nc)
@@ -328,9 +336,28 @@ class CloudProvider:
                 return "NodeClassDrift"
         if claim.provider_id is not None:
             try:
-                self.get(claim.provider_id)
+                inst = self.get(claim.provider_id)
             except NotFoundError:
                 return "InstanceDrift"
+            if nc is not None:
+                if inst.image_id and nc.status_amis:
+                    # AMIs map to instance types by arch (drift.go:91-96):
+                    # an amd64 node must not drift because the arm64
+                    # default AMI rolled
+                    arch = self.lattice.labels[
+                        self.lattice.name_to_idx[inst.instance_type]].get(
+                        wk.LABEL_ARCH, "amd64")
+                    allowed = {a["id"] for a in nc.status_amis
+                               if a.get("arch") in (None, arch)}
+                    if allowed and inst.image_id not in allowed:
+                        return "AMIDrift"
+                if inst.subnet_id and nc.status_subnets:
+                    if inst.subnet_id not in {s["id"] for s in nc.status_subnets}:
+                        return "SubnetDrift"
+                if inst.security_group_ids and nc.status_security_groups:
+                    if (set(inst.security_group_ids)
+                            != {g["id"] for g in nc.status_security_groups}):
+                        return "SecurityGroupDrift"
         return None
 
     def liveness_probe(self) -> bool:

@@ -173,6 +173,11 @@ class Provisioner:
                 self._m_launched.inc(nodepool=claim.node_pool)
                 result.launched += 1
                 result.pods_scheduled += len(node.pods)
+                # the launch fixed the zone: bind nominated pods' unbound
+                # claims NOW so a cross-batch consumer arriving before the
+                # node registers already sees the pinned zone
+                for p in node.pods:
+                    self.cluster.bind_volumes(p, claim.zone)
                 self.recorder.publish("Normal", "Launched", "NodeClaim", claim.name,
                                       f"{claim.instance_type}/{claim.zone}/{claim.capacity_type} "
                                       f"for {len(node.pods)} pod(s)")

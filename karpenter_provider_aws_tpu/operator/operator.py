@@ -22,6 +22,7 @@ from ..controllers.disruption import DisruptionController
 from ..controllers.garbagecollection import GarbageCollectionController
 from ..controllers.lifecycle import LifecycleController
 from ..controllers.provisioning import Provisioner
+from ..controllers.tagging import TaggingController
 from ..controllers.termination import TerminationController
 from ..events import Recorder
 from ..interruption.controller import InterruptionController
@@ -102,6 +103,8 @@ class Operator:
             metrics=self.metrics)
         self.gc = GarbageCollectionController(
             self.cluster, self.cloud_provider, self.recorder, self.clock)
+        self.tagging = TaggingController(
+            self.cluster, self.cloud, self.recorder, self.clock)
         self.disruption = DisruptionController(
             self.cluster, self.solver, self.node_pools, self.cloud_provider,
             self.provisioner, self.termination, self.unavailable, self.recorder,
@@ -135,6 +138,7 @@ class Operator:
         self.nodeclass_controller.reconcile()
         self.pricing_controller.reconcile()
         self.lifecycle.reconcile()
+        self.tagging.reconcile()
         if self.interruption is not None:
             self.interruption.reconcile()
         self.disruption.reconcile()

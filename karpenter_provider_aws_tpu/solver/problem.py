@@ -148,15 +148,16 @@ def _custom_keys_ok(reqs: Requirements, pool_labels: Mapping[str, str]) -> bool:
 
 def _volume_zone_mask(pod: Pod, pvcs: Mapping, storage_classes: Mapping,
                       zones: Sequence[str], warnings: List[str],
-                      shared_claims: frozenset = frozenset()) -> np.ndarray:
+                      shared_pins: Optional[Mapping] = None) -> np.ndarray:
     """Zone restriction from the pod's PVC references (reference
     scheduling.md:389-398): a bound PV pins its exact zone; an unbound claim
     restricts to its StorageClass's allowedTopologies (if any).
 
-    ``shared_claims`` names unbound claims referenced by more than one pod
-    in this batch: those pin to ONE eligible zone up front (the reference
-    'randomly selects' a zone for WaitForFirstConsumer claims) so same-batch
-    consumers can never diverge across zones and then fight over the bind."""
+    ``shared_pins`` maps unbound claims with multiple same-batch consumers
+    to ONE pre-chosen zone index (the reference 'randomly selects' a zone
+    for WaitForFirstConsumer claims) so consumers can never diverge across
+    zones and then fight over the bind. The pin is chosen globally in
+    build_problem from the intersection of every consumer's allowed zones."""
     mask = np.ones((len(zones),), dtype=bool)
     zone_index = {z: i for i, z in enumerate(zones)}
     for cname in pod.volume_claims:
@@ -185,11 +186,11 @@ def _volume_zone_mask(pod: Pod, pvcs: Mapping, storage_classes: Mapping,
                 if zi is not None:
                     m[zi] = True
             mask &= m
-        if cname in shared_claims:
-            elig = np.nonzero(mask)[0]
-            if elig.size:
+        if shared_pins is not None and cname in shared_pins:
+            pin_zi = shared_pins[cname]
+            if pin_zi is not None:
                 pin = np.zeros((len(zones),), dtype=bool)
-                pin[elig[0]] = True
+                pin[pin_zi] = True
                 mask &= pin
     return mask
 
@@ -356,12 +357,40 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
         if hit is None:
             coarse[ck] = (pod, names)
 
-    # unbound claims with multiple same-batch consumers pin to one zone
+    # unbound claims with multiple same-batch consumers pin to one zone,
+    # chosen from the intersection of EVERY consumer's allowed zones (its
+    # node-selector/affinity zone constraints plus its other claims' bound
+    # zones) — a per-consumer first-eligible pick would diverge or falsely
+    # exclude consumers whose own constraints forbid the picked zone
     claim_refs: Dict[str, int] = {}
     for pod in pods:
         for c in pod.volume_claims:
             claim_refs[c] = claim_refs.get(c, 0) + 1
-    shared_claims = frozenset(c for c, n in claim_refs.items() if n > 1)
+    shared_pins: Dict[str, Optional[int]] = {}
+    shared = [c for c, n in claim_refs.items() if n > 1
+              and pvcs and c in pvcs and pvcs[c].bound_zone is None]
+    if shared:
+        inter: Dict[str, np.ndarray] = {}
+        scratch: List[str] = []
+        for pod in pods:
+            touches = [c for c in pod.volume_claims if c in shared]
+            if not touches:
+                continue
+            m = compile_masks(pod.scheduling_requirements(), lattice,
+                              skip_unresolved_custom=True).zone_mask
+            m = m & _volume_zone_mask(pod, pvcs or {}, storage_classes or {},
+                                      lattice.zones, scratch)
+            for c in touches:
+                inter[c] = m if c not in inter else (inter[c] & m)
+        for c, m in inter.items():
+            elig = np.nonzero(m)[0]
+            if elig.size:
+                shared_pins[c] = int(elig[0])
+            else:
+                shared_pins[c] = None
+                warnings.append(
+                    f"consumers of shared unbound PVC {c!r} have no common "
+                    f"eligible zone; the volume can only bind for some of them")
 
     # --- per raw group: masks, pool compatibility, topology resolution
     registry = ClassRegistry()
@@ -405,7 +434,7 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
         if rep.volume_claims:
             zone_mask_eff = zone_mask_eff & _volume_zone_mask(
                 rep, pvcs or {}, storage_classes or {}, lattice.zones, warnings,
-                shared_claims=shared_claims)
+                shared_pins=shared_pins)
         splits, topo, cut = resolve_group_topology(
             rep, len(names), zone_mask_eff, masks.cap_mask,
             lattice.zones, lattice.capacity_types, registry, bound_pods, warnings,

@@ -81,6 +81,23 @@ class ClusterState:
 
     # ---- volumes ---------------------------------------------------------
 
+    def bind_volumes(self, pod_name: str, zone: Optional[str]) -> None:
+        """Bind the pod's unbound claims to ``zone``. Called as soon as the
+        pod's target zone is knowable — at launch success for nominated
+        pods, at bind for pods landing on registered nodes — so a claim
+        shared across batches converges on one zone even while the first
+        consumer's node is still registering."""
+        if not zone:
+            return
+        with self._lock:
+            pod = self.pods.get(pod_name)
+            if pod is None:
+                return
+            for c in pod.volume_claims:
+                pvc = self.pvcs.get(c)
+                if pvc is not None and pvc.bound_zone is None:
+                    pvc.bound_zone = zone
+
     def add_storage_class(self, sc) -> None:
         with self._lock:
             self.storage_classes[sc.name] = sc

@@ -196,6 +196,47 @@ class TestCloudProviderBoundary:
         env.node_classes["default"].user_data = "#!/bin/bash echo changed"
         assert env.cloud_provider.is_drifted(claim) == "NodeClassDrift"
 
+    def test_ami_drift_when_default_ami_rolls(self, env):
+        """Live drift (reference drift.go:73-96): the SSM default AMI moves
+        to a new image; after the NodeClass re-resolves, nodes launched from
+        the old image report AMIDrift."""
+        env.cluster.add_pod(pods(1)[0])
+        env.settle()
+        (claim,) = env.cluster.claims.values()
+        assert claim.image_id, "launch should record the AMI"
+        assert env.cloud_provider.is_drifted(claim) is None
+        # roll every SSM alias to a fresh image (new default AMI release)
+        net = env.cloud.network
+        for path, iid in list(net.ssm_parameters.items()):
+            img = net.images[iid]
+            nid = f"{iid}-v2"
+            from karpenter_provider_aws_tpu.cloud.network import Image
+            net.images[nid] = Image(id=nid, name=img.name + "-v2", arch=img.arch,
+                                    creation_date=img.creation_date + 1)
+            net.ssm_parameters[path] = nid
+        env.ami_provider._cache.flush()
+        env.clock.step(400)
+        env.nodeclass_controller.reconcile()
+        assert env.cloud_provider.is_drifted(claim) == "AMIDrift"
+
+    def test_subnet_drift(self, env):
+        env.cluster.add_pod(pods(1)[0])
+        env.settle()
+        (claim,) = env.cluster.claims.values()
+        nc = env.node_classes[claim.node_class_ref]
+        assert env.cloud_provider.is_drifted(claim) is None
+        nc.status_subnets = [{"id": "subnet-9999", "zone": "us-west-2a"}]
+        assert env.cloud_provider.is_drifted(claim) == "SubnetDrift"
+
+    def test_security_group_drift(self, env):
+        env.cluster.add_pod(pods(1)[0])
+        env.settle()
+        (claim,) = env.cluster.claims.values()
+        nc = env.node_classes[claim.node_class_ref]
+        assert env.cloud_provider.is_drifted(claim) is None
+        nc.status_security_groups = [{"id": "sg-9999", "name": "other"}]
+        assert env.cloud_provider.is_drifted(claim) == "SecurityGroupDrift"
+
     def test_drift_on_missing_instance(self, env):
         env.cluster.add_pod(pods(1)[0])
         env.provisioner.provision_once()
@@ -276,6 +317,42 @@ class TestEndToEnd:
         assert r2.pods_unschedulable == 3
         usage = env.cluster.pool_usage()["default"]
         assert usage[axis("cpu")] <= 8000.0 + 1e-3
+
+    def test_tagging_after_registration(self, env):
+        """Post-registration tagging (reference tagging/controller.go:57-110):
+        instance gets Name + nodeclaim tags once, never re-tagged."""
+        from karpenter_provider_aws_tpu.cloud.fake import parse_instance_id
+        env.cluster.add_pod(pods(1)[0])
+        env.settle()
+        (claim,) = env.cluster.claims.values()
+        assert claim.annotations.get(wk.ANNOTATION_INSTANCE_TAGGED) == "true"
+        inst = env.cloud.instances[parse_instance_id(claim.provider_id)]
+        node = env.cluster.node_for_claim(claim.name)
+        assert inst.tags[wk.TAG_NAME] == node.name
+        assert inst.tags[wk.TAG_NODECLAIM] == claim.name
+        # idempotent: a second pass issues no further CreateTags calls
+        n_calls = sum(1 for c in env.cloud.calls if c[0] == "create_tags")
+        env.tagging.reconcile()
+        assert sum(1 for c in env.cloud.calls if c[0] == "create_tags") == n_calls
+
+    def test_tagging_preserves_existing_tags(self, env):
+        from karpenter_provider_aws_tpu.cloud.fake import parse_instance_id
+        env.cluster.add_pod(pods(1)[0])
+        env.provisioner.provision_once()
+        (claim,) = env.cluster.claims.values()
+        iid = parse_instance_id(claim.provider_id)
+        env.cloud.instances[iid].tags[wk.TAG_NAME] = "user-set-name"
+        env.settle()
+        assert env.cloud.instances[iid].tags[wk.TAG_NAME] == "user-set-name"
+        assert env.cloud.instances[iid].tags[wk.TAG_NODECLAIM] == claim.name
+
+    def test_tagging_waits_for_registration(self, env):
+        env.cluster.add_pod(pods(1)[0])
+        env.provisioner.provision_once()
+        (claim,) = env.cluster.claims.values()
+        assert claim.registered_at is None
+        env.tagging.reconcile()
+        assert wk.ANNOTATION_INSTANCE_TAGGED not in claim.annotations
 
     def test_gc_terminates_leaked_instance(self, env):
         inst = env.cloud.create_fleet([LaunchOverride("m5.large", "us-west-2a",

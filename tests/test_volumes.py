@@ -96,6 +96,38 @@ class TestVolumeTopologySolve:
         assert not plan.unschedulable
         assert any("unknown StorageClass" in w for w in plan.warnings)
 
+    def test_shared_claim_pin_respects_consumer_constraints(self, solver, lattice):
+        """The shared-claim pin must come from the INTERSECTION of consumer
+        zone constraints: two pods requiring us-west-2b sharing a claim
+        allowed in 2a/2b must land in 2b, not be rejected by a naive
+        first-eligible 2a pin."""
+        scs = {"ebs": StorageClass(name="ebs",
+                                   zones=("us-west-2a", "us-west-2b"))}
+        pvcs = {"data": PersistentVolumeClaim(name="data", storage_class="ebs")}
+        pods = [Pod(name=f"p{i}", requests={"cpu": "1", "memory": "2Gi"},
+                    node_selector={wk.LABEL_ZONE: "us-west-2b"},
+                    volume_claims=["data"]) for i in range(2)]
+        problem = build_problem(pods, [NodePool(name="default")], lattice,
+                                pvcs=pvcs, storage_classes=scs)
+        plan = solver.solve(problem)
+        assert not plan.unschedulable
+        assert {n.zone for n in plan.new_nodes} == {"us-west-2b"}
+
+    def test_shared_claim_pin_follows_sibling_bound_claim(self, solver, lattice):
+        """A consumer whose OTHER claim is bound to 2b drags the shared
+        unbound claim's pin to 2b for every consumer."""
+        scs = {"ebs": StorageClass(name="ebs",
+                                   zones=("us-west-2a", "us-west-2b"))}
+        pvcs = {"data": PersistentVolumeClaim(name="data", storage_class="ebs"),
+                "pinB": PersistentVolumeClaim(name="pinB",
+                                              bound_zone="us-west-2b")}
+        pods = [vol_pod("pa", ["pinB", "data"]), vol_pod("pb", ["data"])]
+        problem = build_problem(pods, [NodePool(name="default")], lattice,
+                                pvcs=pvcs, storage_classes=scs)
+        plan = solver.solve(problem)
+        assert not plan.unschedulable
+        assert {n.zone for n in plan.new_nodes} == {"us-west-2b"}
+
     def test_shared_unbound_claim_pins_one_zone(self, solver, lattice):
         """Same-batch consumers of one unbound WFFC claim must land in ONE
         zone — the bind would otherwise strand the losers."""
@@ -136,6 +168,30 @@ class TestVolumeBindingLifecycle:
         pod2 = env.cluster.pods["second"]
         assert pod2.node_name
         assert env.cluster.nodes[pod2.node_name].labels[wk.LABEL_ZONE] == zone
+
+    def test_cross_batch_consumer_converges_before_registration(self, lattice):
+        """A consumer arriving while the first consumer's node is still
+        registering must see the claim already pinned (bound at launch
+        success, not at node registration)."""
+        clock = FakeClock()
+        env = Operator(options=Options(registration_delay=30.0), lattice=lattice,
+                       cloud=FakeCloud(clock), clock=clock,
+                       node_pools=[NodePool(name="default")])
+        env.cluster.add_storage_class(
+            StorageClass(name="ebs", zones=("us-west-2a", "us-west-2b")))
+        env.cluster.add_pvc(PersistentVolumeClaim(name="data", storage_class="ebs"))
+        env.cluster.add_pod(vol_pod("first", ["data"]))
+        env.provisioner.provision_once()          # launch; node NOT registered
+        (claim,) = env.cluster.claims.values()
+        assert claim.zone is not None
+        assert env.cluster.pvcs["data"].bound_zone == claim.zone
+        env.cluster.add_pod(vol_pod("second", ["data"]))
+        env.settle()
+        for name in ("first", "second"):
+            pod = env.cluster.pods[name]
+            assert pod.node_name
+            assert (env.cluster.nodes[pod.node_name].labels[wk.LABEL_ZONE]
+                    == env.cluster.pvcs["data"].bound_zone)
 
     def test_immediate_binding_pins_before_any_pod(self, lattice):
         """Immediate StorageClass: the PV exists before the first consumer;

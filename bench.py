@@ -174,6 +174,37 @@ def build_bench_problem():
     return lattice, build_problem(pods, pools, lattice, existing=existing), len(pods)
 
 
+def _retained_cost(problem, used_names):
+    """$/hr of the existing nodes still holding pods after a repack."""
+    lat = problem.lattice
+    total = 0.0
+    for b in problem.existing:
+        if b.name not in used_names:
+            continue
+        ti = lat.name_to_idx[b.instance_type]
+        zi = lat.zones.index(b.zone)
+        ci = lat.capacity_types.index(b.capacity_type)
+        p = float(lat.price[ti, zi, ci])
+        if np.isfinite(p):
+            total += p
+    return total
+
+
+def _repack_parity(problem, plan):
+    """Non-vacuous cfg4 referee: total cost of the repacked cluster
+    (retained existing nodes + any new nodes), plan vs the Python FFD
+    oracle run on the SAME repack problem."""
+    from karpenter_provider_aws_tpu.solver.oracle import ffd_oracle
+    oracle = ffd_oracle(problem)
+    oracle_used = {problem.existing[b.existing_idx].name
+                   for b in oracle.bins if b.is_existing and b.pods}
+    plan_cost = plan.new_node_cost + _retained_cost(
+        problem, set(plan.existing_assignments))
+    oracle_cost = oracle.new_node_cost + _retained_cost(problem, oracle_used)
+    ratio = plan_cost / oracle_cost if oracle_cost > 0 else 1.0
+    return round(ratio, 4), len(oracle_used), round(plan_cost, 2), round(oracle_cost, 2)
+
+
 def _referee_cost(problem, plan):
     """FFD referee cost: native C++ where in scope, else the Python oracle."""
     try:
@@ -233,6 +264,9 @@ def run_config(key, make, lattice, solver):
     if existing:
         detail["nodes_still_used"] = len(plan.existing_assignments)
         detail["nodes_emptied"] = problem.E - len(plan.existing_assignments)
+        (detail["repack_cost_vs_oracle"], detail["oracle_nodes_retained"],
+         detail["repack_cost_per_hour"],
+         detail["oracle_repack_cost_per_hour"]) = _repack_parity(problem, plan)
     return e2e_p50, detail
 
 

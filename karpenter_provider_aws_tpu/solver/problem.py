@@ -309,7 +309,45 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
     # so a recycled id() can never mis-group)
     coarse: Dict[tuple, tuple] = {}   # identity key -> (rep pod, names or None)
     lab_rel = bool(relevant_keys)
+    # per-pod signature cache: cluster state hands the SAME Pod objects to
+    # every scheduling pass (and every relaxation round), so the content
+    # key is computed once per pod lifetime. Validity is checked by field
+    # object identity — replacing any scheduling field invalidates it (pod
+    # specs are immutable in k8s; in-place mutation of a field's dict is
+    # out of contract).
+    _SIG = "_kpat_sig"
     for pod in pods:
+        pd = pod.__dict__
+        cache = pd.get(_SIG)
+        if (cache is not None
+                and cache[0] is pod.requests
+                and cache[1] is pod.node_selector
+                and cache[2] is pod.required_affinity
+                and cache[3] is pod.preferred_affinity
+                and cache[4] is pod.tolerations
+                and cache[5] is pod.topology_spread
+                and cache[6] is pod.pod_affinity
+                and cache[7] is pod.volume_claims
+                and cache[8] is pod.labels
+                and cache[9] == relevant_keys):
+            sig = cache[10]
+            entry = raw_groups.get(sig)
+            if entry is not None:
+                entry[1].append(pod.name)
+                continue
+            reason = bad_resources.get(sig)
+            if reason is not None:
+                unschedulable[pod.name] = reason
+                continue
+            _, unknown = resources_to_vec_checked(pod.requests, implicit_pod=True)
+            if unknown:
+                reason = f"unknown resource(s): {', '.join(unknown)}"
+                bad_resources[sig] = reason
+                unschedulable[pod.name] = reason
+                continue
+            raw_groups[sig] = (pod, [pod.name])
+            order.append(sig)
+            continue
         ck = (id(pod.requests) if pod.requests else 0,
               id(pod.node_selector) if pod.node_selector else 0,
               id(pod.required_affinity) if pod.required_affinity else 0,
@@ -335,6 +373,10 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
                 names.append(pod.name)
                 continue
         sig = _group_key(pod, relevant_keys, memo)
+        pd[_SIG] = (pod.requests, pod.node_selector, pod.required_affinity,
+                    pod.preferred_affinity, pod.tolerations, pod.topology_spread,
+                    pod.pod_affinity, pod.volume_claims, pod.labels,
+                    relevant_keys, sig)
         entry = raw_groups.get(sig)
         if entry is not None:
             entry[1].append(pod.name)

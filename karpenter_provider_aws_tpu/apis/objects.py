@@ -141,6 +141,20 @@ class Pod:
     priority: int = 0
     deletion_timestamp: Optional[float] = None
 
+    # fields that feed the scheduling-signature cache the solver stores on
+    # the pod (solver/problem.py); reassigning any of them drops the cache.
+    # In-place mutation of a field's container (pod.requests["cpu"] = ...)
+    # is out of contract, as in k8s where pod specs are immutable.
+    _SIG_FIELDS = frozenset({
+        "labels", "requests", "node_selector", "required_affinity",
+        "preferred_affinity", "tolerations", "topology_spread",
+        "pod_affinity", "volume_claims"})
+
+    def __setattr__(self, name, value):
+        if name in Pod._SIG_FIELDS:
+            self.__dict__.pop("_kpat_sig", None)
+        object.__setattr__(self, name, value)
+
     def hard_scheduling_requirements(self) -> Requirements:
         """Required rules only — what can never be relaxed away."""
         reqs = Requirements.from_node_selector(self.node_selector)

@@ -350,3 +350,51 @@ def pack_packed(alloc: jnp.ndarray, avail: jnp.ndarray, price: jnp.ndarray,
                 groups: GroupBatch, pools: PoolParams, init: BinState) -> jnp.ndarray:
     """pack() + single-buffer result encoding (see _encode_decode_set)."""
     return _encode_decode_set(pack(alloc, avail, price, groups, pools, init))
+
+
+class ProbeSummary(NamedTuple):
+    """Per-probe aggregates of a batched what-if pack (all [K])."""
+
+    leftover: jnp.ndarray   # i32 pods that fit nowhere
+    n_new: jnp.ndarray      # i32 new bins opened
+    new_cost: jnp.ndarray   # f32 $/hr summed over new bins
+    cap_c: jnp.ndarray      # i32 capacity-type index of the single new bin
+                            # (valid when n_new == 1; -1 when none)
+    flex: jnp.ndarray       # i32 feasible-type count of that bin (offering
+                            # flexibility, the spot→spot ≥15-type guard input)
+    overflow: jnp.ndarray   # bool bin table exhausted (host retries bigger B)
+
+
+@jax.jit
+def pack_probe(alloc: jnp.ndarray, avail: jnp.ndarray, price: jnp.ndarray,
+               groups: GroupBatch, pools: PoolParams, init: BinState) -> ProbeSummary:
+    """K consolidation what-ifs in ONE device call.
+
+    ``groups``/``pools``/``init`` carry a leading probe axis K — each probe
+    is a fully-built padded problem ("remove candidate set S: do its pods
+    repack onto the remaining capacity + ≤1 cheaper node?", reference
+    designs/consolidation.md:9-21). The disruption controller's prefix
+    ladder and single-node scan become one vmapped kernel launch returning
+    only tiny per-probe aggregates — the full NodePlan is decoded later by
+    a single exact solve of the chosen probe (SURVEY.md §2.2:
+    "embarrassingly batchable on device")."""
+
+    avail_f = avail.astype(jnp.float32)
+
+    def one(g: GroupBatch, pl: PoolParams, st: BinState) -> ProbeSummary:
+        res = pack(alloc, avail, price, g, pl, st)
+        B = res.state.open.shape[0]
+        live = res.state.open & ~res.state.fixed & (res.state.npods > 0)
+        n_new = live.sum().astype(jnp.int32)
+        cost = jnp.where(live, res.chosen_price, 0.0).sum()
+        leftover = res.leftover.sum()
+        b = jnp.argmax(live)
+        reach = _offer_reachable(avail_f, res.state.zmask[b], res.state.cmask[b])
+        flex = (res.state.tmask[b] & reach).sum().astype(jnp.int32)
+        cap_c = jnp.where(n_new > 0, res.chosen_c[b], -1)
+        overflow = (leftover > 0) & (res.state.next_open >= B)
+        return ProbeSummary(leftover=leftover, n_new=n_new, new_cost=cost,
+                            cap_c=cap_c, flex=jnp.where(n_new > 0, flex, 0),
+                            overflow=overflow)
+
+    return jax.vmap(one)(groups, pools, init)

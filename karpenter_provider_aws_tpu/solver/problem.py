@@ -199,13 +199,31 @@ def _selector_keys(pods: Sequence[Pod], bound_pods: Sequence[BoundPod]) -> froze
     """Label keys referenced by ANY affinity/spread selector in the batch or
     on bound pods. Only these keys affect scheduling semantics, so the group
     signature projects labels onto them — per-pod-unique labels (StatefulSet
-    pod names, pod-index) never break deduplication."""
-    keys = set()
-    for p in list(pods) + [bp.pod for bp in bound_pods]:
-        for term in p.pod_affinity:
-            keys.update(k for k, _ in term.label_selector)
-        for c in p.topology_spread:
-            keys.update(k for k, _ in c.label_selector)
+    pod names, pod-index) never break deduplication.
+
+    Pods stamped out by one controller share the same affinity/spread
+    container objects, so an id() memo keeps this a 2-load pass per pod."""
+    keys: set = set()
+    seen: set = set()
+    def collect(p: Pod) -> None:
+        pa = p.pod_affinity
+        if pa:
+            i = id(pa)
+            if i not in seen:
+                seen.add(i)
+                for term in pa:
+                    keys.update(k for k, _ in term.label_selector)
+        ts = p.topology_spread
+        if ts:
+            i = id(ts)
+            if i not in seen:
+                seen.add(i)
+                for c in ts:
+                    keys.update(k for k, _ in c.label_selector)
+    for p in pods:
+        collect(p)
+    for bp in bound_pods:
+        collect(bp.pod)
     return frozenset(keys)
 
 
@@ -252,6 +270,19 @@ def _group_key(pod: Pod, relevant_keys: frozenset, memo: dict) -> tuple:
     )
 
 
+# Global signature interning. A pod's full scheduling signature (the nested
+# tuple _group_key builds) maps to a small int once per process; the per-pod
+# cache stores (relevant_keys, sig_id) so repeated scheduling passes over the
+# same pods cost one dict hit + one pointer compare per pod — int-keyed group
+# lookup instead of re-hashing nested tuples. Both registries are bounded by
+# the number of DISTINCT pod shapes seen, not pod count.
+_RK_INTERN: Dict[frozenset, frozenset] = {}
+_SIG_IDS: Dict[tuple, int] = {}
+_SIG_TUPLES: List[tuple] = []        # sig_id -> sig (for the id->key map)
+_BAD_SIDS: Dict[int, str] = {}       # sig_id -> unknown-resource reason
+                                     # (depends only on the sig's requests)
+
+
 def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: Lattice,
                   existing: Sequence[ExistingBin] = (),
                   daemonset_pods: Sequence[Pod] = (),
@@ -295,58 +326,46 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
             ds_overhead[pi] += vec
 
     # --- group pods by scheduling signature (one expensive compile per
-    # distinct key; the per-pod loop is a tuple build + dict hit)
+    # distinct key; the per-pod loop is one dict hit + one pointer compare)
     unschedulable: Dict[str, str] = {}
-    raw_groups: Dict[tuple, Tuple[Pod, List[str]]] = {}
-    bad_resources: Dict[tuple, str] = {}   # key -> unknown-resource reason
-    order: List[tuple] = []
+    raw_groups: Dict[int, Tuple[Pod, List[str]]] = {}   # sig_id -> (rep, names)
+    bad_claims: Dict[str, int] = {}   # PVC refs of unknown-resource pods
+    order: List[int] = []
     relevant_keys = _selector_keys(pods, bound_pods)
+    relevant_keys = _RK_INTERN.setdefault(relevant_keys, relevant_keys)
     memo: dict = {}
-    # two-level grouping: pods stamped out from one controller template
-    # share the same field container OBJECTS, so an identity-tuple usually
-    # resolves the group with no content hashing at all; the content key is
-    # the correctness fallback (identity is verified with `is` before use,
-    # so a recycled id() can never mis-group)
+    # three-level grouping, fastest first:
+    # 1. the per-pod cache (rk, sig_id) stored on the Pod — cluster state
+    #    hands the SAME Pod objects to every scheduling pass (and every
+    #    relaxation round), so after the first pass each pod costs one dict
+    #    get and one pointer compare. Pod.__setattr__ drops the cache when
+    #    any scheduling field is reassigned; relevant-keys changes miss on
+    #    the interned rk pointer.
+    # 2. an identity tuple over the field containers — pods stamped out from
+    #    one controller template share the same requests/selector OBJECTS,
+    #    so first-pass grouping needs no content hashing (identity is
+    #    verified with `is` before use, so a recycled id() can never
+    #    mis-group).
+    # 3. the full content key (_group_key), interned to a small int.
     coarse: Dict[tuple, tuple] = {}   # identity key -> (rep pod, names or None)
     lab_rel = bool(relevant_keys)
-    # per-pod signature cache: cluster state hands the SAME Pod objects to
-    # every scheduling pass (and every relaxation round), so the content
-    # key is computed once per pod lifetime. Validity is checked by field
-    # object identity — replacing any scheduling field invalidates it (pod
-    # specs are immutable in k8s; in-place mutation of a field's dict is
-    # out of contract).
     _SIG = "_kpat_sig"
     for pod in pods:
-        pd = pod.__dict__
-        cache = pd.get(_SIG)
-        if (cache is not None
-                and cache[0] is pod.requests
-                and cache[1] is pod.node_selector
-                and cache[2] is pod.required_affinity
-                and cache[3] is pod.preferred_affinity
-                and cache[4] is pod.tolerations
-                and cache[5] is pod.topology_spread
-                and cache[6] is pod.pod_affinity
-                and cache[7] is pod.volume_claims
-                and cache[8] is pod.labels
-                and cache[9] == relevant_keys):
-            sig = cache[10]
-            entry = raw_groups.get(sig)
+        cache = pod.__dict__.get(_SIG)
+        if cache is not None and cache[0] is relevant_keys:
+            sid = cache[1]
+            entry = raw_groups.get(sid)
             if entry is not None:
                 entry[1].append(pod.name)
                 continue
-            reason = bad_resources.get(sig)
+            reason = _BAD_SIDS.get(sid)
             if reason is not None:
                 unschedulable[pod.name] = reason
+                for c in pod.volume_claims:
+                    bad_claims[c] = bad_claims.get(c, 0) + 1
                 continue
-            _, unknown = resources_to_vec_checked(pod.requests, implicit_pod=True)
-            if unknown:
-                reason = f"unknown resource(s): {', '.join(unknown)}"
-                bad_resources[sig] = reason
-                unschedulable[pod.name] = reason
-                continue
-            raw_groups[sig] = (pod, [pod.name])
-            order.append(sig)
+            raw_groups[sid] = (pod, [pod.name])
+            order.append(sid)
             continue
         ck = (id(pod.requests) if pod.requests else 0,
               id(pod.node_selector) if pod.node_selector else 0,
@@ -371,31 +390,35 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
                     and (not pod.volume_claims or rep.volume_claims is pod.volume_claims)
                     and (not (lab_rel and pod.labels) or rep.labels is pod.labels)):
                 names.append(pod.name)
+                rc = rep.__dict__.get(_SIG)
+                if rc is not None and rc[0] is relevant_keys:
+                    pod.__dict__[_SIG] = rc
                 continue
         sig = _group_key(pod, relevant_keys, memo)
-        pd[_SIG] = (pod.requests, pod.node_selector, pod.required_affinity,
-                    pod.preferred_affinity, pod.tolerations, pod.topology_spread,
-                    pod.pod_affinity, pod.volume_claims, pod.labels,
-                    relevant_keys, sig)
-        entry = raw_groups.get(sig)
+        sid = _SIG_IDS.get(sig)
+        if sid is None:
+            sid = len(_SIG_TUPLES)
+            _SIG_IDS[sig] = sid
+            _SIG_TUPLES.append(sig)
+            _, unknown = resources_to_vec_checked(pod.requests, implicit_pod=True)
+            if unknown:
+                _BAD_SIDS[sid] = f"unknown resource(s): {', '.join(unknown)}"
+        pod.__dict__[_SIG] = (relevant_keys, sid)
+        entry = raw_groups.get(sid)
         if entry is not None:
             entry[1].append(pod.name)
             if hit is None:
                 coarse[ck] = (pod, entry[1])
             continue
-        reason = bad_resources.get(sig)
+        reason = _BAD_SIDS.get(sid)
         if reason is not None:
             unschedulable[pod.name] = reason
-            continue
-        _, unknown = resources_to_vec_checked(pod.requests, implicit_pod=True)
-        if unknown:
-            reason = f"unknown resource(s): {', '.join(unknown)}"
-            bad_resources[sig] = reason
-            unschedulable[pod.name] = reason
+            for c in pod.volume_claims:
+                bad_claims[c] = bad_claims.get(c, 0) + 1
             continue
         names = [pod.name]
-        raw_groups[sig] = (pod, names)
-        order.append(sig)
+        raw_groups[sid] = (pod, names)
+        order.append(sid)
         if hit is None:
             coarse[ck] = (pod, names)
 
@@ -404,23 +427,28 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
     # node-selector/affinity zone constraints plus its other claims' bound
     # zones) — a per-consumer first-eligible pick would diverge or falsely
     # exclude consumers whose own constraints forbid the picked zone
-    claim_refs: Dict[str, int] = {}
-    for pod in pods:
-        for c in pod.volume_claims:
-            claim_refs[c] = claim_refs.get(c, 0) + 1
+    # consumer counts come from the groups (all pods of a group share the
+    # same claims list — it is part of the signature) plus the rare
+    # unknown-resource pods tallied during the scan
+    claim_refs: Dict[str, int] = dict(bad_claims)
+    for sid in order:
+        rep, names = raw_groups[sid]
+        for c in rep.volume_claims:
+            claim_refs[c] = claim_refs.get(c, 0) + len(names)
     shared_pins: Dict[str, Optional[int]] = {}
     shared = [c for c, n in claim_refs.items() if n > 1
               and pvcs and c in pvcs and pvcs[c].bound_zone is None]
     if shared:
         inter: Dict[str, np.ndarray] = {}
         scratch: List[str] = []
-        for pod in pods:
-            touches = [c for c in pod.volume_claims if c in shared]
+        for sid in order:
+            rep, _names = raw_groups[sid]
+            touches = [c for c in rep.volume_claims if c in shared]
             if not touches:
                 continue
-            m = compile_masks(pod.scheduling_requirements(), lattice,
+            m = compile_masks(rep.scheduling_requirements(), lattice,
                               skip_unresolved_custom=True).zone_mask
-            m = m & _volume_zone_mask(pod, pvcs or {}, storage_classes or {},
+            m = m & _volume_zone_mask(rep, pvcs or {}, storage_classes or {},
                                       lattice.zones, scratch)
             for c in touches:
                 inter[c] = m if c not in inter else (inter[c] & m)
@@ -446,8 +474,9 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
     groups: List[PodGroup] = []
     pending_topo: List[Tuple[PodGroup, Pod, np.ndarray, np.ndarray]] = []  # group, rep, owner, need
     pending_spread_counts: Dict = {}  # (selector, key) -> planned per-domain adds
-    for sig in order:
-        rep, names = raw_groups[sig]
+    for sid in order:
+        rep, names = raw_groups[sid]
+        sig = _SIG_TUPLES[sid]
         vec, _ = resources_to_vec_checked(rep.requests, implicit_pod=True)
         reqs = rep.scheduling_requirements()
         # custom-key constraints resolve exactly per-pool in np_ok below

@@ -231,9 +231,10 @@ class Solver:
 
     # ---- padding ----
 
-    def _padded_groups(self, problem: Problem, G: int) -> binpack.GroupBatch:
+    def _padded_groups(self, problem: Problem, G: int,
+                       A: Optional[int] = None) -> binpack.GroupBatch:
         lat = self.lattice
-        A = max(problem.A, 1)
+        A = max(problem.A, 1) if A is None else A
 
         def pad(a: np.ndarray, shape, dtype, fill=0):
             out = np.full(shape, fill, dtype)
@@ -275,10 +276,11 @@ class Solver:
             ds=fit(problem.ds_overhead, (NP, R), np.float32),
         )
 
-    def _init_state(self, problem: Problem, B: int) -> binpack.BinState:
+    def _init_state(self, problem: Problem, B: int,
+                    A: Optional[int] = None) -> binpack.BinState:
         lat = self.lattice
         E = problem.E
-        A = max(problem.A, 1)
+        A = max(problem.A, 1) if A is None else A
         state = binpack.empty_state(B, lat.T, lat.Z, lat.C, R, A)
         if E == 0:
             return state

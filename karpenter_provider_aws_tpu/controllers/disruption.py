@@ -95,6 +95,11 @@ class DisruptionController:
         self._whatif_used = 0
         self._last_failed_fingerprint = None
 
+    # one batched probe covers the prefix ladder + single-node scan; caps
+    # bound the padded K bucket (solver.Solver._K_BUCKETS)
+    MAX_PREFIX_PROBES = 16
+    MAX_SINGLE_PROBES = 16
+
     # ---- budgets (disruption.md:193-222) ---------------------------------
 
     def _allowed_disruptions(self, pool: NodePool, reason: str) -> int:
@@ -123,13 +128,14 @@ class DisruptionController:
         """Initialized, healthy, not-already-disrupting claims with a
         registered node."""
         in_flight = {n for a in self._in_flight for n in a.claims}
+        node_by_claim = self.cluster.nodes_by_claim()
         out = []
         for claim in self.cluster.claims.values():
             if claim.deletion_timestamp or claim.name in in_flight:
                 continue
             if claim.phase != NodeClaimPhase.INITIALIZED:
                 continue
-            if self.cluster.node_for_claim(claim.name) is None:
+            if claim.name not in node_by_claim:
                 continue
             if claim.node_pool not in self.node_pools:
                 continue
@@ -150,6 +156,19 @@ class DisruptionController:
 
     # ---- what-if solve (the on-device consolidation query) ---------------
 
+    def _removed_price(self, lattice, removed: Sequence[NodeClaim]) -> float:
+        total = 0.0
+        for c in removed:
+            ti = lattice.name_to_idx.get(c.instance_type)
+            if ti is None:
+                continue
+            zi = lattice.zones.index(c.zone) if c.zone in lattice.zones else 0
+            ci = (lattice.capacity_types.index(c.capacity_type)
+                  if c.capacity_type in lattice.capacity_types else 0)
+            p = self.solver.lattice.price[ti, zi, ci]
+            total += float(p) if np.isfinite(p) else 0.0
+        return total
+
     def _what_if(self, removed: Sequence[NodeClaim]) -> Tuple[NodePlan, float]:
         """Solve the cluster with `removed` gone; returns (plan, removed $/hr)."""
         self._whatif_used += 1
@@ -167,17 +186,85 @@ class DisruptionController:
             pods, list(self.node_pools.values()), lattice,
             existing=existing, daemonset_pods=self.cluster.daemonset_pods(),
             bound_pods=bound, pvcs=pvcs, storage_classes=storage_classes)
-        removed_price = 0.0
+        return plan, self._removed_price(lattice, removed)
+
+    def _probe_whatifs(self, removed_sets: Sequence[Sequence[NodeClaim]]):
+        """All of a pass's what-ifs as ONE batched device call.
+
+        Builds one padded problem per candidate set and rides the vmapped
+        probe kernel (solver.probe_batch / ops/binpack.pack_probe). Pods are
+        probed with their soft constraints fully relaxed — the loosest state
+        solve_relaxed can reach — so a probe's infeasible verdict is
+        trustworthy while a feasible one is optimistic; the winning probe is
+        re-verified by one exact _what_if before any node is touched.
+        Returns [(ProbeResult, removed $/hr)] aligned with removed_sets."""
+        from ..apis.objects import relax_pod, relaxation_depth
+        from ..solver.problem import build_problem
+
+        lattice = masked_view(self.solver.lattice,
+                              self.unavailable.mask(self.solver.lattice))
+        all_bins = self.cluster.existing_bins(lattice)
+        bound_all = self.cluster.bound_pods()
+        pvcs, storage_classes = self.cluster.volume_state()
+        ds = self.cluster.daemonset_pods()
+        pools = list(self.node_pools.values())
+        # index once per pass: the probe sets are prefixes/singles of one
+        # candidate list, so per-set _pods_on/node_for_claim scans would be
+        # O(sets × cluster) of pure host work
+        claim_names = {c.name for rs in removed_sets for c in rs}
+        node_by_claim = self.cluster.nodes_by_claim()
+        node_of = {n: node_by_claim[n].name for n in claim_names}
+        by_node = self.cluster.pods_by_node(include_daemonsets=False)
+        relaxed: Dict[str, Pod] = {}
+        for n in claim_names:
+            for p in by_node.get(node_of[n], ()):
+                if p.name not in relaxed:
+                    relaxed[p.name] = relax_pod(p, relaxation_depth(p))
+        problems, prices = [], []
+        for removed in removed_sets:
+            removed_nodes = {node_of[c.name] for c in removed}
+            removed_names = {c.name for c in removed}
+            pods = [relaxed[p.name] for c in removed
+                    for p in by_node.get(node_of[c.name], ())]
+            existing = [b for b in all_bins
+                        if b.name not in removed_nodes
+                        and b.name not in removed_names]
+            bound = [bp for bp in bound_all
+                     if bp.node_name not in removed_nodes]
+            problems.append(build_problem(
+                pods, pools, lattice, existing=existing, daemonset_pods=ds,
+                bound_pods=bound, pvcs=pvcs, storage_classes=storage_classes))
+            prices.append(self._removed_price(lattice, removed))
+        return list(zip(self.solver.probe_batch(problems), prices))
+
+    def _within_budgets(self, removed: Sequence[NodeClaim],
+                        reason: str) -> bool:
+        """Cheap host-side mirror of _begin's per-pool budget gate, so the
+        search never pays an exact device solve for a candidate set the
+        budget is guaranteed to reject."""
+        counts: Dict[str, int] = {}
         for c in removed:
-            ti = lattice.name_to_idx.get(c.instance_type)
-            if ti is None:
-                continue
-            zi = lattice.zones.index(c.zone) if c.zone in lattice.zones else 0
-            ci = (lattice.capacity_types.index(c.capacity_type)
-                  if c.capacity_type in lattice.capacity_types else 0)
-            p = self.solver.lattice.price[ti, zi, ci]
-            removed_price += float(p) if np.isfinite(p) else 0.0
-        return plan, removed_price
+            counts[c.node_pool] = counts.get(c.node_pool, 0) + 1
+        return all(
+            self._allowed_disruptions(self.node_pools[p], reason) >= n
+            for p, n in counts.items())
+
+    def _probe_ok(self, removed: Sequence[NodeClaim], pr,
+                  removed_price: float) -> bool:
+        """The consolidation criterion on probe aggregates (mirrors the
+        exact-plan checks in _reconcile_consolidation)."""
+        if not pr.feasible or pr.n_new > 1:
+            return False
+        if pr.new_cost >= removed_price - CONSOLIDATION_SAVINGS_EPS:
+            return False
+        if (pr.n_new == 1 and pr.new_cap_type == wk.CAPACITY_TYPE_SPOT
+                and any(c.capacity_type == wk.CAPACITY_TYPE_SPOT
+                        for c in removed)):
+            if not self.spot_to_spot_consolidation:
+                return False
+            if pr.flex < SPOT_TO_SPOT_MIN_TYPES:
+                return False
+        return True
 
     def _spot_guard_ok(self, removed: Sequence[NodeClaim], plan: NodePlan) -> bool:
         """Spot→spot single-node replacement needs ≥15-type flexibility and
@@ -196,7 +283,26 @@ class DisruptionController:
 
     # ---- reconcile --------------------------------------------------------
 
-    def _fingerprint(self):
+    def _consolidatable(self) -> List[NodeClaim]:
+        """Candidates whose pool policy + consolidate_after window currently
+        allow consolidation."""
+        now = self.clock.now()
+        out = []
+        for claim in self._candidates():
+            pool = self.node_pools[claim.node_pool]
+            if pool.disruption.consolidation_policy != "WhenUnderutilized":
+                continue
+            after = pool.disruption.consolidate_after
+            if after is not None:
+                ref = claim.initialized_at or claim.created_at
+                if now - ref < after:
+                    continue
+            out.append(claim)
+        return out
+
+    def _fingerprint(self, consolidatable: Optional[Sequence[NodeClaim]] = None):
+        if consolidatable is None:
+            consolidatable = self._consolidatable()
         return (
             tuple(sorted((p.name, p.node_name or "") for p in self.cluster.pods.values())),
             tuple(sorted(self.cluster.claims)),
@@ -205,6 +311,10 @@ class DisruptionController:
             # consolidation profitable: re-search after one
             self.solver.lattice.price_version,
             len(self._in_flight),
+            # the negative cache must expire when a consolidate_after window
+            # elapses: pure time passage changes which candidates are
+            # eligible even though no pod/claim moved
+            tuple(sorted(c.name for c in consolidatable)),
         )
 
     def reconcile(self) -> None:
@@ -221,10 +331,11 @@ class DisruptionController:
         if self._reconcile_emptiness():
             self._last_failed_fingerprint = None
             return
-        fp = self._fingerprint()
+        consolidatable = self._consolidatable()
+        fp = self._fingerprint(consolidatable)
         if fp == self._last_failed_fingerprint:
             return  # nothing changed since the search last came up empty
-        if self._reconcile_consolidation():
+        if self._reconcile_consolidation(consolidatable):
             self._last_failed_fingerprint = None
         else:
             self._last_failed_fingerprint = fp
@@ -382,49 +493,76 @@ class DisruptionController:
                 started = True
         return started
 
-    def _reconcile_consolidation(self) -> bool:
-        now = self.clock.now()
-        candidates = []
-        for claim in self._candidates():
-            pool = self.node_pools[claim.node_pool]
-            if pool.disruption.consolidation_policy != "WhenUnderutilized":
-                continue
-            after = pool.disruption.consolidate_after
-            if after is not None:
-                ref = claim.initialized_at or claim.created_at
-                if now - ref < after:
-                    continue
-            candidates.append(claim)
+    def _reconcile_consolidation(
+            self, candidates: Optional[List[NodeClaim]] = None) -> bool:
+        if candidates is None:
+            candidates = self._consolidatable()
         if not candidates:
             return False
-        candidates.sort(key=self._disruption_cost)
+        # cheapest-to-disrupt first (consolidation.md scoring) off one
+        # locked snapshot instead of an O(pods) scan per candidate
+        by_node = self.cluster.pods_by_node(include_daemonsets=False)
+        node_by_claim = self.cluster.nodes_by_claim()
+        cost = {c.name: float(sum(
+            1 + p.priority
+            for p in by_node.get(node_by_claim[c.name].name, ())))
+            for c in candidates if c.name in node_by_claim}
+        candidates = [c for c in candidates if c.name in node_by_claim]
+        candidates.sort(key=lambda c: cost[c.name])
+        K = len(candidates)
 
-        # multi-node: largest prefix that repacks onto remaining + <=1 new node
-        # (disruption.md:93-98 heuristic prefix search)
-        lo, hi, best = 1, len(candidates), None
-        while lo <= hi:
-            k = (lo + hi) // 2
-            removed = candidates[:k]
+        # the whole pass's search — every prefix of the cheapest-first
+        # ladder (disruption.md:93-98) AND the single-node scan — is ONE
+        # batched device probe (SURVEY §2.2 "embarrassingly batchable");
+        # only the winning candidate set pays an exact decode solve, so a
+        # pass costs ≤2 device calls instead of O(log n + budget) round
+        # trips. Probing each prefix independently also beats the old
+        # binary search when feasibility is not monotone in the prefix.
+        if K > 1:
+            ks = sorted({int(round(k)) for k in
+                         np.linspace(2, K, min(K - 1, self.MAX_PREFIX_PROBES))})
+        else:
+            ks = []
+        singles = candidates[: self.MAX_SINGLE_PROBES]
+        probe_sets = [candidates[:k] for k in ks] + [[c] for c in singles]
+        probes = self._probe_whatifs(probe_sets)
+        n_prefix = len(ks)
+
+        # multi-node: largest probe-feasible prefix, verified by one exact
+        # solve (the probe is optimistic — soft constraints fully relaxed)
+        for i in range(n_prefix - 1, -1, -1):
+            removed = probe_sets[i]
+            pr, probe_price = probes[i]
+            if not self._probe_ok(removed, pr, probe_price):
+                continue
+            if not self._within_budgets(removed, "Underutilized"):
+                continue  # budget can admit a smaller prefix — keep walking
+            if self._whatif_used >= self.max_whatif_per_pass:
+                break
             plan, removed_price = self._what_if(removed)
             ok = (not plan.unschedulable and len(plan.new_nodes) <= 1
                   and plan.new_node_cost < removed_price - CONSOLIDATION_SAVINGS_EPS
                   and self._spot_guard_ok(removed, plan))
             if ok:
-                best = (removed, plan, removed_price)
-                lo = k + 1
-            else:
-                hi = k - 1
-        if best is not None:
-            removed, plan, removed_price = best
-            if self._begin("Underutilized", removed, plan,
-                           max_replacement_cost=removed_price
-                           - CONSOLIDATION_SAVINGS_EPS):
-                return True
+                if self._begin("Underutilized", removed, plan,
+                               max_replacement_cost=removed_price
+                               - CONSOLIDATION_SAVINGS_EPS):
+                    return True
+                # _begin rejections surviving the budget pre-check (pool
+                # limits, launch failure) are pass-invariant: stop paying
+                # exact solves for smaller prefixes, leave budget for the
+                # single-node scan
+                break
 
-        # single-node scan: each candidate alone, allowing a cheaper
-        # replacement; bounded by the pass's remaining what-if budget (the
-        # next pass resumes only after the cluster changes)
-        for claim in candidates:
+        # single-node scan: only probe-positive candidates pay an exact
+        # solve; bounded by the pass's remaining what-if budget (the next
+        # pass resumes only after the cluster changes)
+        for j, claim in enumerate(singles):
+            pr, probe_price = probes[n_prefix + j]
+            if not self._probe_ok([claim], pr, probe_price):
+                continue
+            if not self._within_budgets([claim], "Underutilized"):
+                continue
             if self._whatif_used >= self.max_whatif_per_pass:
                 break
             plan, removed_price = self._what_if([claim])

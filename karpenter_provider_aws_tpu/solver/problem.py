@@ -27,6 +27,7 @@ Everything is plain numpy here; solve.py pads and ships to device.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
@@ -275,7 +276,14 @@ def _group_key(pod: Pod, relevant_keys: frozenset, memo: dict) -> tuple:
 # cache stores (relevant_keys, sig_id) so repeated scheduling passes over the
 # same pods cost one dict hit + one pointer compare per pod — int-keyed group
 # lookup instead of re-hashing nested tuples. Both registries are bounded by
-# the number of DISTINCT pod shapes seen, not pod count.
+# the number of DISTINCT pod shapes seen, not pod count; shapes can still
+# churn over a long-lived controller (rollout-hash-style labels), so the
+# registries reset at _INTERN_MAX. build_problem serializes on _INTERN_LOCK:
+# two concurrent misses must not mint one sig_id for two signatures, and a
+# reset must not yank sig_ids out from under a mid-flight grouping pass
+# (stale per-pod caches miss via the interned relevant_keys pointer).
+_INTERN_LOCK = threading.Lock()
+_INTERN_MAX = 1 << 20
 _RK_INTERN: Dict[frozenset, frozenset] = {}
 _SIG_IDS: Dict[tuple, int] = {}
 _SIG_TUPLES: List[tuple] = []        # sig_id -> sig (for the id->key map)
@@ -289,6 +297,23 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
                   bound_pods: Sequence[BoundPod] = (),
                   pvcs: Optional[Mapping] = None,
                   storage_classes: Optional[Mapping] = None) -> Problem:
+    with _INTERN_LOCK:
+        if len(_SIG_TUPLES) >= _INTERN_MAX:
+            _RK_INTERN.clear()
+            _SIG_IDS.clear()
+            _SIG_TUPLES.clear()
+            _BAD_SIDS.clear()
+        return _build_problem(pods, node_pools, lattice, existing,
+                              daemonset_pods, bound_pods, pvcs,
+                              storage_classes)
+
+
+def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: Lattice,
+                   existing: Sequence[ExistingBin] = (),
+                   daemonset_pods: Sequence[Pod] = (),
+                   bound_pods: Sequence[BoundPod] = (),
+                   pvcs: Optional[Mapping] = None,
+                   storage_classes: Optional[Mapping] = None) -> Problem:
     pools = sorted(node_pools, key=lambda p: (-p.weight, p.name))
     NP = len(pools)
     T, Z, C = lattice.T, lattice.Z, lattice.C

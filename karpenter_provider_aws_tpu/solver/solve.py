@@ -21,6 +21,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -67,6 +68,21 @@ class NodePlan:
     @property
     def num_new_nodes(self) -> int:
         return len(self.new_nodes)
+
+
+@dataclass
+class ProbeResult:
+    """Host-side aggregates of one batched what-if probe (ops/binpack.py
+    pack_probe). Enough to answer the consolidation criterion — "do the
+    pods fit on the remaining capacity + ≤1 cheaper node?" (reference
+    designs/consolidation.md) — without decoding a full NodePlan."""
+
+    feasible: bool            # every pod placed (no leftover, no overflow)
+    n_new: int                # new bins opened
+    new_cost: float           # $/hr over new bins
+    new_cap_type: Optional[str]  # capacity type of the single new bin
+    flex: int                 # feasible-type count of that bin (spot guard)
+    device_seconds: float = 0.0
 
 
 def _bucket(n: int, buckets: Sequence[int], clamp: bool = False) -> int:
@@ -232,9 +248,11 @@ class Solver:
     # ---- padding ----
 
     def _padded_groups(self, problem: Problem, G: int,
-                       A: Optional[int] = None) -> binpack.GroupBatch:
+                       A: Optional[int] = None,
+                       NP: Optional[int] = None) -> binpack.GroupBatch:
         lat = self.lattice
         A = max(problem.A, 1) if A is None else A
+        NP = max(problem.NP, 1) if NP is None else NP
 
         def pad(a: np.ndarray, shape, dtype, fill=0):
             out = np.full(shape, fill, dtype)
@@ -249,7 +267,7 @@ class Solver:
             g_type=pad(g.g_type, (G, lat.T), bool),
             g_zone=pad(g.g_zone, (G, lat.Z), bool),
             g_cap=pad(g.g_cap, (G, lat.C), bool),
-            g_np=pad(g.g_np, (G, max(g.NP, 1)), bool),
+            g_np=pad(g.g_np, (G, NP), bool),
             max_per_bin=pad(g.max_per_bin, (G,), np.int32),
             spread_class=pad(g.g_spread, (G,), np.int32, fill=-1),
             single_bin=pad(g.single_bin, (G,), bool),
@@ -259,8 +277,9 @@ class Solver:
             strict_custom=pad(g.strict_custom, (G,), bool),
         )
 
-    def _pool_params(self, problem: Problem) -> binpack.PoolParams:
-        NP = max(problem.NP, 1)
+    def _pool_params(self, problem: Problem,
+                     NP: Optional[int] = None) -> binpack.PoolParams:
+        NP = max(problem.NP, 1) if NP is None else NP
         lat = self.lattice
 
         def fit(a, shape, dtype):
@@ -313,6 +332,71 @@ class Solver:
             pm=jnp.asarray(pm), po=jnp.asarray(po),
             next_open=jnp.array(E, jnp.int32),
         )
+
+    # ---- batched what-if probes ----
+
+    _K_BUCKETS = (4, 8, 16, 32)
+
+    def probe_batch(self, problems: Sequence[Problem]) -> List[ProbeResult]:
+        """K consolidation what-ifs in ONE device call.
+
+        Every problem is padded to a shared (K, G, B) bucket, stacked along
+        a leading probe axis, and handed to the vmapped kernel
+        (ops/binpack.pack_probe); only tiny per-probe aggregates come back.
+        The disruption controller's prefix ladder + single-node scan ride
+        this instead of O(log n + budget) serial Solve() round trips
+        (SURVEY.md §2.2 "embarrassingly batchable"); the chosen probe is
+        then re-solved exactly once for its real NodePlan."""
+        assert problems
+        lat = self.lattice
+        assert all(p.lattice is problems[0].lattice for p in problems), \
+            "probe batch must share one lattice view"
+        K = len(problems)
+        assert K <= self._K_BUCKETS[-1], f"probe batch {K} exceeds max"
+        G = _bucket(max(p.G for p in problems), _G_BUCKETS)
+        A = max(max((p.A for p in problems), default=0), 1)
+        NP = max(max((p.NP for p in problems), default=0), 1)
+        b_needed = max(p.E + min(int(p.count.sum()),
+                                 self._estimate_bins(p) + 64)
+                       for p in problems)
+        B = _bucket(max(b_needed, max(p.E for p in problems) + 1),
+                    _B_BUCKETS, clamp=True)
+        avail, price = self._device_avail_price(problems[0])
+        # pad K with repeats of problem 0 so jit shapes stay bucketed
+        Kp = _bucket(K, self._K_BUCKETS, clamp=True)
+        idx = list(range(K)) + [0] * (Kp - K)
+        gs = [self._padded_groups(problems[i], G, A, NP) for i in idx]
+        ps = [self._pool_params(problems[i], NP) for i in idx]
+        stack = lambda *xs: jnp.stack(xs)
+        groups = jax.tree.map(stack, *gs)
+        pools = jax.tree.map(stack, *ps)
+        while True:
+            init = jax.tree.map(
+                stack, *[self._init_state(problems[i], B, A) for i in idx])
+            td = time.perf_counter()
+            summ = jax.tree.map(np.asarray, binpack.pack_probe(
+                self._alloc, avail, price, groups, pools, init))
+            device_s = time.perf_counter() - td
+            if bool(summ.overflow[:K].any()):
+                B, grew = _grow_bucket(B)
+                if grew:
+                    continue
+            break
+        out: List[ProbeResult] = []
+        for k in range(K):
+            n_new = int(summ.n_new[k])
+            cc = int(summ.cap_c[k])
+            out.append(ProbeResult(
+                feasible=(int(summ.leftover[k]) == 0
+                          and not bool(summ.overflow[k])
+                          and not problems[k].unschedulable),
+                n_new=n_new,
+                new_cost=float(summ.new_cost[k]),
+                new_cap_type=(lat.capacity_types[cc]
+                              if n_new > 0 and 0 <= cc < lat.C else None),
+                flex=int(summ.flex[k]),
+                device_seconds=device_s))
+        return out
 
     # ---- solve ----
 

@@ -187,6 +187,22 @@ class ClusterState:
                     return node
             return None
 
+    def nodes_by_claim(self) -> Dict[str, Node]:
+        """Snapshot index claim name -> node (one pass instead of an
+        O(nodes) node_for_claim scan per claim)."""
+        with self._lock:
+            return {n.node_claim: n for n in self.nodes.values()
+                    if n.node_claim}
+
+    def pods_by_node(self, include_daemonsets: bool = True) -> Dict[str, List[Pod]]:
+        """Locked snapshot of the node -> bound pods index."""
+        with self._lock:
+            by_node = self._pods_by_node()
+            if include_daemonsets:
+                return by_node
+            return {n: [p for p in ps if not p.is_daemonset]
+                    for n, ps in by_node.items()}
+
     # ---- solver inputs ----------------------------------------------------
 
     def _pods_by_node(self) -> Dict[str, List[Pod]]:

@@ -321,3 +321,78 @@ class TestBudgets:
             env.run_once()
             env.clock.step(2)
         assert env.cluster.claims, "0% budget must block disruption entirely"
+
+
+class TestBatchedWhatIfs:
+    def test_consolidation_pass_is_one_probe_plus_one_exact_solve(self, lattice):
+        """The prefix ladder + single-node scan ride ONE batched probe
+        kernel launch; only the winning candidate set pays an exact solve
+        (SURVEY §2.2 "embarrassingly batchable" — was O(log n + budget)
+        serial Solve() round trips)."""
+        env = make_env(lattice, consolidate_after=10.0)
+        from karpenter_provider_aws_tpu.apis.objects import PodAffinityTerm
+        anti = [PodAffinityTerm(topology_key=wk.LABEL_HOSTNAME,
+                                label_selector=(("app", "spread"),), anti=True)]
+        big = [Pod(name=f"b{i}", labels={"app": "spread"},
+                   requests={"cpu": "3", "memory": "6Gi"}, pod_affinity=list(anti))
+               for i in range(6)]
+        for p in big:
+            env.cluster.add_pod(p)
+        env.settle()
+        assert len(env.cluster.nodes) == 6
+        for p in list(env.cluster.pods):
+            env.cluster.delete_pod(p)
+        # one tiny anti-affine pod per (oversized) node: no node is ever
+        # empty, so the decision must come from the consolidation search
+        tiny = [Pod(name=f"t{i}", labels={"app": "spread"},
+                    requests={"cpu": "250m", "memory": "256Mi"},
+                    pod_affinity=list(anti))
+                for i in range(6)]
+        for p in tiny:
+            env.cluster.add_pod(p)
+        env.settle()
+        assert all(self_pods for self_pods in
+                   [[q for q in env.cluster.pods.values() if q.node_name == n]
+                    for n in env.cluster.nodes]), "expected one pod per node"
+        env.clock.step(11)
+
+        calls = {"probe": 0, "solve": 0}
+        orig_probe, orig_solve = env.solver.probe_batch, env.solver.solve
+
+        def probe(problems):
+            calls["probe"] += 1
+            return orig_probe(problems)
+
+        def solve(problem, mesh=None):
+            calls["solve"] += 1
+            return orig_solve(problem, mesh=mesh)
+
+        env.solver.probe_batch, env.solver.solve = probe, solve
+        try:
+            env.disruption.reconcile()
+        finally:
+            env.solver.probe_batch, env.solver.solve = orig_probe, orig_solve
+        # the decision landed (replacement launched, originals queued)
+        assert env.disruption._in_flight, "consolidation should have begun"
+        assert calls["probe"] == 1
+        assert calls["solve"] <= 2, calls
+
+    def test_failed_search_cache_expires_with_consolidate_after_window(self, lattice):
+        """A failed consolidation search must not be cached across pure
+        time passage: candidates become eligible when their
+        consolidate_after window elapses even though no pod or claim moved."""
+        env = make_env(lattice, consolidate_after=10.0)
+        ps = pods(1, cpu="14", mem="24Gi", prefix="big") + \
+            pods(1, cpu="250m", mem="256Mi", prefix="small")
+        for p in ps:
+            env.cluster.add_pod(p)
+        env.settle()
+        env.cluster.delete_pod("big-0")
+        # search inside the window: fails, negative cache set
+        env.disruption.reconcile()
+        assert not env.disruption._in_flight
+        # window elapses with NO cluster change: the cache must expire
+        env.clock.step(11)
+        env.disruption.reconcile()
+        assert env.disruption._in_flight, \
+            "consolidation blocked by a stale negative cache"

@@ -421,3 +421,52 @@ class TestNativeReferee:
                      topology_spread=list(spread)) for i in range(4)]
         problem = build_problem(pods, [default_pool()], lattice)
         assert native_ffd_pack(problem) is None
+
+
+class TestProbeBatch:
+    """Batched what-if probes (ops/binpack.pack_probe via Solver.probe_batch):
+    one device call must agree with the exact per-problem solves on
+    feasibility, new-node count, and cost (SURVEY §2.2 consolidation
+    what-ifs; reference designs/consolidation.md criterion)."""
+
+    def test_probe_agrees_with_exact_solve(self, solver, lattice):
+        pool = default_pool()
+        problems = [
+            build_problem(generic_pods(4), [pool], lattice),
+            build_problem(generic_pods(12, cpu="2", mem="4Gi", prefix="big"),
+                          [pool], lattice),
+            # infeasible: no type satisfies a 10k-cpu pod
+            build_problem([Pod(name="huge", requests={"cpu": "10000"})],
+                          [pool], lattice),
+        ]
+        probes = solver.probe_batch(problems)
+        for pr, problem in zip(probes, problems):
+            plan = solver.solve(problem)
+            exact_feasible = not plan.unschedulable
+            assert pr.feasible == exact_feasible
+            if exact_feasible:
+                assert pr.n_new == len(plan.new_nodes)
+                assert pr.new_cost == pytest.approx(plan.new_node_cost, rel=1e-5)
+
+    def test_probe_with_existing_bins(self, solver, lattice):
+        """A probe problem whose pods fit entirely on existing capacity
+        opens zero new bins."""
+        existing = [ExistingBin(name="n0", node_pool="default",
+                                instance_type="m5.4xlarge", zone="us-west-2a",
+                                capacity_type="on-demand",
+                                used=np.zeros(8, np.float32))]
+        problem = build_problem(generic_pods(4), [default_pool()], lattice,
+                                existing=existing)
+        (pr,) = solver.probe_batch([problem])
+        assert pr.feasible and pr.n_new == 0 and pr.new_cost == 0.0
+
+    def test_probe_reports_single_bin_capacity_type_and_flex(self, solver, lattice):
+        """n_new == 1 probes expose the new bin's capacity type and type
+        flexibility — the spot→spot ≥15-type guard inputs (disruption.md:129)."""
+        pool = default_pool(requirements=[
+            Requirement(wk.LABEL_CAPACITY_TYPE, Operator.IN, ("spot",))])
+        problem = build_problem(generic_pods(2), [pool], lattice)
+        (pr,) = solver.probe_batch([problem])
+        assert pr.feasible and pr.n_new == 1
+        assert pr.new_cap_type == "spot"
+        assert pr.flex > 0

@@ -1,0 +1,181 @@
+"""Standalone controller entrypoint.
+
+The analog of the reference's `cmd/controller/main.go:32` (operator
+construction + controller registration + serving) with the flag surface of
+`pkg/operator/options/options.go:46-60`: every flag falls back to its env
+var (CLUSTER_NAME, VM_MEMORY_OVERHEAD_PERCENT, INTERRUPTION_QUEUE, ...)
+the way the reference's `env.WithDefault*` wiring does, and feature gates
+take the reference's `--feature-gates Drift=true,...` form
+(settings.md:40-47).
+
+While the reconcile loop runs, the process serves:
+- ``/metrics``  — the Prometheus text exposition of the registry
+  (including the per-offering lattice gauge surface),
+- ``/healthz`` and ``/readyz`` — liveness/readiness, mirroring the
+  operator's AddHealthzCheck wiring (main.go:44).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Sequence
+
+from .operator import Operator, Options
+
+_GATES = {
+    "Drift": "drift_enabled",
+    "SpotToSpotConsolidation": "spot_to_spot_consolidation",
+}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="karpenter-tpu-controller",
+        description="TPU-native karpenter controller (device-solved "
+                    "scheduling over an instance-type lattice).")
+    p.add_argument("--cluster-name", default=None,
+                   help="The cluster name for resource discovery "
+                        "(env CLUSTER_NAME).")
+    p.add_argument("--vm-memory-overhead-percent", type=float, default=None,
+                   help="VM memory overhead subtracted from every instance "
+                        "type's memory (env VM_MEMORY_OVERHEAD_PERCENT, "
+                        "default 0.075).")
+    p.add_argument("--reserved-enis", type=int, default=None,
+                   help="ENIs excluded from max-pods math "
+                        "(env RESERVED_ENIS).")
+    p.add_argument("--batch-idle-duration", type=float, default=None,
+                   help="Seconds of pod-arrival quiet before a scheduling "
+                        "pass (env BATCH_IDLE_DURATION, default 1).")
+    p.add_argument("--batch-max-duration", type=float, default=None,
+                   help="Max seconds a scheduling batch may wait "
+                        "(env BATCH_MAX_DURATION, default 10).")
+    p.add_argument("--interruption-queue", default=None,
+                   help="Interruption queue name; interruption handling is "
+                        "disabled if not specified "
+                        "(env INTERRUPTION_QUEUE).")
+    p.add_argument("--feature-gates", default=None,
+                   help="Comma-separated gates, e.g. "
+                        "'Drift=true,SpotToSpotConsolidation=false'.")
+    p.add_argument("--metrics-port", type=int, default=8000,
+                   help="Port serving /metrics, /healthz, /readyz "
+                        "(0 disables).")
+    p.add_argument("--profile-dir", default=None,
+                   help="Write a JAX profiler (xprof) trace of every device "
+                        "solve under this directory.")
+    p.add_argument("--duration", type=float, default=0.0,
+                   help="Run for this many seconds then exit "
+                        "(0 = run until SIGINT/SIGTERM).")
+    p.add_argument("--step", type=float, default=1.0,
+                   help="Seconds between reconcile passes.")
+    return p
+
+
+def options_from_args(args: argparse.Namespace) -> Options:
+    overrides = {}
+    if args.cluster_name is not None:
+        overrides["cluster_name"] = args.cluster_name
+    if args.vm_memory_overhead_percent is not None:
+        overrides["vm_memory_overhead_percent"] = args.vm_memory_overhead_percent
+    if args.reserved_enis is not None:
+        overrides["reserved_enis"] = args.reserved_enis
+    if args.batch_idle_duration is not None:
+        overrides["batch_idle_duration"] = args.batch_idle_duration
+    if args.batch_max_duration is not None:
+        overrides["batch_max_duration"] = args.batch_max_duration
+    if args.interruption_queue is not None:
+        overrides["interruption_queue"] = args.interruption_queue
+    for gate in (args.feature_gates or "").split(","):
+        gate = gate.strip()
+        if not gate:
+            continue
+        name, _, val = gate.partition("=")
+        field = _GATES.get(name.strip())
+        if field is None:
+            raise SystemExit(
+                f"unknown feature gate {name!r} (known: {sorted(_GATES)})")
+        val = val.strip().lower()
+        if val in ("true", "1", "yes"):
+            overrides[field] = True
+        elif val in ("false", "0", "no"):
+            overrides[field] = False
+        else:
+            raise SystemExit(
+                f"feature gate {name.strip()}: value {val!r} is not true/false")
+    return Options.from_env(**overrides)
+
+
+def start_server(op: Operator, port: int) -> ThreadingHTTPServer:
+    """Serve /metrics, /healthz, /readyz on a daemon thread. Port 0 binds
+    an ephemeral port (server.server_address reports it)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path == "/metrics":
+                body = op.metrics.render().encode()
+                ctype = "text/plain; version=0.0.4"
+            elif self.path in ("/healthz", "/readyz"):
+                # the reference's liveness probe is the cloud connectivity
+                # check (main.go:44 cloud-provider healthz)
+                try:
+                    op.cloud.list_instances()
+                    body, ctype = b"ok", "text/plain"
+                except Exception as e:
+                    self.send_error(503, str(e))
+                    return
+            else:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # quiet by default
+            pass
+
+    server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    opts = options_from_args(args)
+    op = Operator(options=opts)
+
+    stop = threading.Event()
+
+    def _stop(signum, frame):
+        stop.set()
+
+    try:
+        signal.signal(signal.SIGINT, _stop)
+        signal.signal(signal.SIGTERM, _stop)
+    except ValueError:
+        pass  # not the main thread (tests drive main() directly)
+
+    server = start_server(op, args.metrics_port) if args.metrics_port else None
+    if args.profile_dir:
+        op.solver.start_profiling(args.profile_dir)
+    deadline = (time.monotonic() + args.duration) if args.duration > 0 else None
+    try:
+        while not stop.is_set():
+            op.run_once()
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            stop.wait(args.step)
+    finally:
+        if args.profile_dir:
+            op.solver.stop_profiling()
+        if server is not None:
+            server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

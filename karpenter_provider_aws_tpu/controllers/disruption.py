@@ -94,6 +94,10 @@ class DisruptionController:
         self.max_whatif_per_pass = 16
         self._whatif_used = 0
         self._last_failed_fingerprint = None
+        # where the next pass's single-node scan resumes after a
+        # budget-truncated pass (so repeat passes verify NEW candidates
+        # instead of deterministically repeating the same window)
+        self._scan_cursor = 0
 
     # one batched probe covers the prefix ladder + single-node scan; caps
     # bound the padded K bucket (solver.Solver._K_BUCKETS)
@@ -188,7 +192,8 @@ class DisruptionController:
             bound_pods=bound, pvcs=pvcs, storage_classes=storage_classes)
         return plan, self._removed_price(lattice, removed)
 
-    def _probe_whatifs(self, removed_sets: Sequence[Sequence[NodeClaim]]):
+    def _probe_whatifs(self, removed_sets: Sequence[Sequence[NodeClaim]],
+                       node_by_claim=None, by_node=None):
         """All of a pass's what-ifs as ONE batched device call.
 
         Builds one padded problem per candidate set and rides the vmapped
@@ -210,11 +215,18 @@ class DisruptionController:
         pools = list(self.node_pools.values())
         # index once per pass: the probe sets are prefixes/singles of one
         # candidate list, so per-set _pods_on/node_for_claim scans would be
-        # O(sets × cluster) of pure host work
+        # O(sets × cluster) of pure host work. The caller threads in its own
+        # snapshots so the candidate filter and this map agree (a node
+        # deregistering between two snapshots must not KeyError the pass).
         claim_names = {c.name for rs in removed_sets for c in rs}
-        node_by_claim = self.cluster.nodes_by_claim()
-        node_of = {n: node_by_claim[n].name for n in claim_names}
-        by_node = self.cluster.pods_by_node(include_daemonsets=False)
+        if node_by_claim is None:
+            node_by_claim = self.cluster.nodes_by_claim()
+        if by_node is None:
+            by_node = self.cluster.pods_by_node(include_daemonsets=False)
+        node_of = {n: node_by_claim[n].name for n in claim_names
+                   if n in node_by_claim}
+        removed_sets = [[c for c in rs if c.name in node_of]
+                        for rs in removed_sets]
         relaxed: Dict[str, Pod] = {}
         for n in claim_names:
             for p in by_node.get(node_of[n], ()):
@@ -337,8 +349,11 @@ class DisruptionController:
             return  # nothing changed since the search last came up empty
         if self._reconcile_consolidation(consolidatable):
             self._last_failed_fingerprint = None
-        else:
+        elif self._whatif_used < self.max_whatif_per_pass:
             self._last_failed_fingerprint = fp
+        # a pass truncated by the what-if budget proved nothing about the
+        # remaining candidates — never negative-cache it; the next pass
+        # resumes the search with a fresh budget
 
     def _advance_in_flight(self) -> None:
         """Drain originals whose replacements have all registered."""
@@ -523,10 +538,18 @@ class DisruptionController:
                          np.linspace(2, K, min(K - 1, self.MAX_PREFIX_PROBES))})
         else:
             ks = []
-        singles = candidates[: self.MAX_SINGLE_PROBES]
+        start = self._scan_cursor % K
+        rotated = candidates[start:] + candidates[:start]
+        singles = rotated[: self.MAX_SINGLE_PROBES]
         probe_sets = [candidates[:k] for k in ks] + [[c] for c in singles]
-        probes = self._probe_whatifs(probe_sets)
+        probes = self._probe_whatifs(probe_sets, node_by_claim=node_by_claim,
+                                     by_node=by_node)
         n_prefix = len(ks)
+        # the prefix ladder may only spend half the pass's exact-solve
+        # budget: optimistic probes (soft constraints fully relaxed) can all
+        # fail exact verification, and the single-node scan must still get
+        # its turn before the pass is negative-cached
+        prefix_budget = max(self.max_whatif_per_pass // 2, 1)
 
         # multi-node: largest probe-feasible prefix, verified by one exact
         # solve (the probe is optimistic — soft constraints fully relaxed)
@@ -537,7 +560,7 @@ class DisruptionController:
                 continue
             if not self._within_budgets(removed, "Underutilized"):
                 continue  # budget can admit a smaller prefix — keep walking
-            if self._whatif_used >= self.max_whatif_per_pass:
+            if self._whatif_used >= prefix_budget:
                 break
             plan, removed_price = self._what_if(removed)
             ok = (not plan.unschedulable and len(plan.new_nodes) <= 1
@@ -555,8 +578,7 @@ class DisruptionController:
                 break
 
         # single-node scan: only probe-positive candidates pay an exact
-        # solve; bounded by the pass's remaining what-if budget (the next
-        # pass resumes only after the cluster changes)
+        # solve; bounded by the pass's remaining what-if budget
         for j, claim in enumerate(singles):
             pr, probe_price = probes[n_prefix + j]
             if not self._probe_ok([claim], pr, probe_price):
@@ -576,4 +598,10 @@ class DisruptionController:
                            max_replacement_cost=removed_price
                            - CONSOLIDATION_SAVINGS_EPS):
                 return True
+        if self._whatif_used >= self.max_whatif_per_pass:
+            # budget-truncated: resume the scan at a new window next pass
+            # (reconcile() also skips the negative cache in this case)
+            self._scan_cursor = (start + len(singles)) % K
+        else:
+            self._scan_cursor = 0
         return False

@@ -79,6 +79,20 @@ class Gauge(_Metric):
         with self._lock:
             self._values.clear()
 
+    def replace(self, values: Dict[Tuple[str, ...], float]) -> None:
+        """Atomically swap the whole series set. For bulk snapshot surfaces
+        (the lattice offering gauges) where per-cell set() calls would pay
+        label validation ~10k times per refresh."""
+        n = len(self.labelnames)
+        for k in values:
+            if len(k) != n:
+                raise ValueError(
+                    f"{self.name}: key {k!r} has {len(k)} labels, "
+                    f"declared {n}")
+        with self._lock:
+            self._values = {tuple(map(str, k)): float(v)
+                            for k, v in values.items()}
+
     def _render(self) -> List[str]:
         with self._lock:
             return [f"{self.name}{_fmt(self.labelnames, k)} {v}"
@@ -252,3 +266,56 @@ def wire_core_metrics(reg: Registry) -> Dict[str, _Metric]:
         "ice_cache_size": reg.gauge(
             "karpenter_ice_cache_size", "Offerings currently marked unavailable.", ()),
     }
+
+
+# The per-instance-type / per-offering gauge surface (reference
+# pkg/providers/instancetype/metrics.go:32-79): hardware shape per type,
+# availability + price estimate per type×capacity-type×zone offering.
+def wire_lattice_metrics(reg: Registry) -> Dict[str, Gauge]:
+    return {
+        "instance_type_cpu": reg.gauge(
+            "karpenter_cloudprovider_instance_type_cpu_cores",
+            "VCPUs cores for a given instance type.", ("instance_type",)),
+        "instance_type_memory": reg.gauge(
+            "karpenter_cloudprovider_instance_type_memory_bytes",
+            "Memory, in bytes, for a given instance type.", ("instance_type",)),
+        "offering_available": reg.gauge(
+            "karpenter_cloudprovider_instance_type_offering_available",
+            "Instance type offering availability, based on instance type, "
+            "capacity type, and zone.",
+            ("instance_type", "capacity_type", "zone")),
+        "offering_price": reg.gauge(
+            "karpenter_cloudprovider_instance_type_offering_price_estimate",
+            "Instance type offering estimated hourly price, based on "
+            "instance type, capacity type, and zone.",
+            ("instance_type", "capacity_type", "zone")),
+    }
+
+
+def emit_lattice_gauges(gauges: Dict[str, Gauge], lattice,
+                        ice_mask=None) -> None:
+    """Bulk-refresh the offering gauge surface straight from the lattice
+    tensors (price/available are already [T,Z,C] arrays — the whole surface
+    is four dict builds, no per-offering provider calls). ``ice_mask`` is
+    the UnavailableOfferings mask; ICE'd offerings report available=0 the
+    same way the reference folds its unavailableOfferings cache into
+    createOfferings (instancetype.go:175-201)."""
+    import numpy as np
+
+    gauges["instance_type_cpu"].replace(
+        {(s.name,): s.vcpus for s in lattice.specs})
+    gauges["instance_type_memory"].replace(
+        {(s.name,): s.memory_mib * 1024 * 1024 for s in lattice.specs})
+    avail = lattice.available
+    if ice_mask is not None:
+        avail = avail & ice_mask
+    offered = np.argwhere(np.isfinite(lattice.price))
+    av: Dict[Tuple[str, ...], float] = {}
+    pr: Dict[Tuple[str, ...], float] = {}
+    names, zones, caps = lattice.names, lattice.zones, lattice.capacity_types
+    for ti, zi, ci in offered:
+        key = (names[ti], caps[ci], zones[zi])
+        av[key] = 1.0 if avail[ti, zi, ci] else 0.0
+        pr[key] = float(lattice.price[ti, zi, ci])
+    gauges["offering_available"].replace(av)
+    gauges["offering_price"].replace(pr)

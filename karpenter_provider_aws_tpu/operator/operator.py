@@ -29,7 +29,8 @@ from ..interruption.controller import InterruptionController
 from ..interruption.queue import FakeQueue
 from ..lattice.tensors import Lattice, build_lattice
 from ..controllers.nodeclass import NodeClassController
-from ..metrics import Registry, wire_core_metrics
+from ..metrics import (Registry, emit_lattice_gauges, wire_core_metrics,
+                       wire_lattice_metrics)
 from ..providers import (
     AMIProvider, InstanceProfileProvider, LaunchTemplateProvider,
     PricingProvider, SecurityGroupProvider, SubnetProvider, VersionProvider,
@@ -61,6 +62,8 @@ class Operator:
         self.recorder = Recorder(self.clock)
         self.metrics = Registry()
         wire_core_metrics(self.metrics)
+        self._lattice_gauges = wire_lattice_metrics(self.metrics)
+        self._lattice_gauge_state = None
         self.unavailable = UnavailableOfferings(self.clock)
         self.cluster = ClusterState(self.clock)
         self.node_pools: Dict[str, NodePool] = {p.name: p for p in (node_pools or [NodePool(name="default")])}
@@ -148,6 +151,13 @@ class Operator:
         self.metrics.gauge("karpenter_cluster_state_pod_count").set(len(self.cluster.pods))
         self.metrics.gauge("karpenter_ice_cache_size").set(
             sum(1 for _ in self.unavailable.entries()))
+        # offering gauge surface: re-emit only when pricing or the ICE set
+        # actually changed (both are versioned)
+        gstate = (self.lattice.price_version, self.unavailable.seq_num)
+        if gstate != self._lattice_gauge_state:
+            emit_lattice_gauges(self._lattice_gauges, self.lattice,
+                                self.unavailable.mask(self.lattice))
+            self._lattice_gauge_state = gstate
         now = self.clock.now()
         if now - self._last_cache_cleanup >= 10.0:  # ICE cleanup cadence (cache.go:39-42)
             self.unavailable.cleanup()

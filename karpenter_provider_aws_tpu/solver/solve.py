@@ -198,6 +198,8 @@ class Solver:
         self._avail = jnp.asarray(lattice.available)
         self._price = jnp.asarray(lattice.price)
         self._price_version = lattice.price_version
+        self._tracing = False
+        self._trace_step = 0
         # per group-bucket: (fresh-estimate bucket, bucket actually needed)
         # of the last solve. A same-or-larger fresh estimate starts at the
         # size that worked (each overflow retry costs a full device round
@@ -333,6 +335,31 @@ class Solver:
             next_open=jnp.array(E, jnp.int32),
         )
 
+    # ---- profiling (xprof hook) ----
+
+    def start_profiling(self, log_dir: str) -> None:
+        """Open a JAX profiler trace session; every device pack call is then
+        wrapped in a StepTraceAnnotation so Solve() hotspots (kernel time vs
+        transfer vs host decode) show up in xprof/tensorboard under named
+        steps. The reference side-channel is Go pprof on the controller
+        (SURVEY §5 tracing); the TPU-native analog is the XLA profiler."""
+        import jax.profiler
+        jax.profiler.start_trace(log_dir)
+        self._tracing = True
+
+    def stop_profiling(self) -> None:
+        import jax.profiler
+        self._tracing = False
+        jax.profiler.stop_trace()
+
+    def _trace_span(self, name: str):
+        if not self._tracing:
+            import contextlib
+            return contextlib.nullcontext()
+        import jax.profiler
+        self._trace_step += 1
+        return jax.profiler.StepTraceAnnotation(name, step_num=self._trace_step)
+
     # ---- batched what-if probes ----
 
     _K_BUCKETS = (4, 8, 16, 32)
@@ -374,8 +401,9 @@ class Solver:
             init = jax.tree.map(
                 stack, *[self._init_state(problems[i], B, A) for i in idx])
             td = time.perf_counter()
-            summ = jax.tree.map(np.asarray, binpack.pack_probe(
-                self._alloc, avail, price, groups, pools, init))
+            with self._trace_span("solver.pack_probe"):
+                summ = jax.tree.map(np.asarray, binpack.pack_probe(
+                    self._alloc, avail, price, groups, pools, init))
             device_s = time.perf_counter() - td
             if bool(summ.overflow[:K].any()):
                 B, grew = _grow_bucket(B)
@@ -491,8 +519,9 @@ class Solver:
             init = self._init_state(problem, B)
             td = time.perf_counter()
             # one fused buffer = one device→host transfer (sync included)
-            buf = np.asarray(binpack.pack_packed(
-                self._alloc, avail, price, groups, pools, init))
+            with self._trace_span("solver.pack"):
+                buf = np.asarray(binpack.pack_packed(
+                    self._alloc, avail, price, groups, pools, init))
             device_s = time.perf_counter() - td
             dec = _unpack_decode_set(buf, G, lat.T, lat.Z, lat.C,
                                      max(problem.A, 1))
@@ -672,11 +701,13 @@ class Solver:
         while True:
             init = self._init_state(problem, B)
             td = time.perf_counter()
-            sp = sharded_pack(mesh, self._alloc, avail, price, groups, pools, init,
-                              count_split)
-            # one fused [D,B+n,W] buffer = one device→host transfer for all
-            # shards (sync included); host-side unpack stays off the device clock
-            packed = np.asarray(sp.packed)
+            with self._trace_span("solver.pack_sharded"):
+                sp = sharded_pack(mesh, self._alloc, avail, price, groups,
+                                  pools, init, count_split)
+                # one fused [D,B+n,W] buffer = one device→host transfer for
+                # all shards (sync included); host-side unpack stays off the
+                # device clock
+                packed = np.asarray(sp.packed)
             device_s = time.perf_counter() - td
             decs = [_unpack_decode_set(packed[d], G, lat.T, lat.Z, lat.C, A)
                     for d in range(packed.shape[0])]

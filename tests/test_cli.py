@@ -1,0 +1,108 @@
+"""CLI entrypoint tests: flag parsing → Options (reference
+pkg/operator/options/options.go:46-60), the serving surface
+(/metrics /healthz, reference cmd/controller/main.go:44), the run loop,
+and the xprof profiling hook."""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from karpenter_provider_aws_tpu.cli import (
+    build_parser, main, options_from_args, start_server,
+)
+from karpenter_provider_aws_tpu.cloud import FakeCloud
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+from karpenter_provider_aws_tpu.operator import Operator, Options
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    return build_lattice([s for s in build_catalog()
+                          if s.family in ("m5", "t3")])
+
+
+class TestFlags:
+    def test_flags_override_env(self, monkeypatch):
+        monkeypatch.setenv("CLUSTER_NAME", "from-env")
+        monkeypatch.setenv("BATCH_IDLE_DURATION", "3.0")
+        args = build_parser().parse_args(
+            ["--cluster-name", "from-flag", "--reserved-enis", "2"])
+        opts = options_from_args(args)
+        assert opts.cluster_name == "from-flag"      # flag wins
+        assert opts.batch_idle_duration == 3.0       # env fallback
+        assert opts.reserved_enis == 2
+
+    def test_feature_gates(self):
+        args = build_parser().parse_args(
+            ["--feature-gates", "Drift=false,SpotToSpotConsolidation=true"])
+        opts = options_from_args(args)
+        assert opts.drift_enabled is False
+        assert opts.spot_to_spot_consolidation is True
+
+    def test_unknown_gate_rejected(self):
+        args = build_parser().parse_args(["--feature-gates", "Bogus=true"])
+        with pytest.raises(SystemExit):
+            options_from_args(args)
+
+    def test_invalid_options_rejected(self):
+        args = build_parser().parse_args(
+            ["--batch-idle-duration", "5", "--batch-max-duration", "1"])
+        with pytest.raises(ValueError):
+            options_from_args(args)
+
+
+class TestServing:
+    def test_metrics_and_health_endpoints(self, lattice):
+        clock = FakeClock()
+        op = Operator(options=Options(), lattice=lattice,
+                      cloud=FakeCloud(clock), clock=clock)
+        op.run_once()
+        server = start_server(op, 0)
+        try:
+            port = server.server_address[1]
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+            assert "karpenter_cluster_state_node_count" in body
+            assert "karpenter_cloudprovider_instance_type_offering_price_estimate" in body
+            ok = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5).read()
+            assert ok == b"ok"
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/nope", timeout=5)
+        finally:
+            server.shutdown()
+
+
+class TestMainLoop:
+    def test_main_runs_for_duration_and_exits(self):
+        rc = main(["--duration", "0.2", "--step", "0.05",
+                   "--metrics-port", "0"])
+        assert rc == 0
+
+
+class TestProfilingHook:
+    def test_solver_trace_writes_xprof_artifacts(self, lattice, tmp_path):
+        """start_profiling wraps device solves in a JAX trace session;
+        artifacts land under <dir>/plugins/profile/* (xprof layout)."""
+        from karpenter_provider_aws_tpu.apis import NodePool, Pod
+        from karpenter_provider_aws_tpu.solver import Solver, build_problem
+
+        solver = Solver(lattice)
+        solver.start_profiling(str(tmp_path))
+        try:
+            pods = [Pod(name=f"p{i}",
+                        requests={"cpu": "500m", "memory": "1Gi"})
+                    for i in range(4)]
+            plan = solver.solve(build_problem(pods, [NodePool(name="d")],
+                                              lattice))
+            assert not plan.unschedulable
+        finally:
+            solver.stop_profiling()
+        profile_root = tmp_path / "plugins" / "profile"
+        assert profile_root.is_dir()
+        runs = list(profile_root.iterdir())
+        assert runs and any(run.iterdir() for run in runs)

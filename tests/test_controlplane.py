@@ -458,3 +458,43 @@ class TestReviewRegressions:
         claims = list(env.cluster.claims.values())
         assert claims and all(c.name != claim.name for c in claims), \
             "NodePool template change must drift-replace the claim"
+
+
+class TestLatticeGauges:
+    """The per-type / per-offering gauge surface (reference
+    pkg/providers/instancetype/metrics.go:32-79), emitted in bulk from the
+    lattice tensors and refreshed when pricing or the ICE set changes."""
+
+    def test_offering_gauges_emitted(self, env, lattice):
+        env.run_once()
+        g = env.metrics.get("karpenter_cloudprovider_instance_type_offering_price_estimate")
+        name = lattice.names[0]
+        zone, cap = lattice.zones[0], lattice.capacity_types[0]
+        if not np.isfinite(lattice.price[0, 0, 0]):
+            pytest.skip("first offering not priced in this catalog slice")
+        assert g.value(instance_type=name, capacity_type=cap, zone=zone) == \
+            pytest.approx(float(lattice.price[0, 0, 0]))
+        cpu = env.metrics.get("karpenter_cloudprovider_instance_type_cpu_cores")
+        assert cpu.value(instance_type=name) == lattice.specs[0].vcpus
+        mem = env.metrics.get("karpenter_cloudprovider_instance_type_memory_bytes")
+        assert mem.value(instance_type=name) == lattice.specs[0].memory_mib * 1024 * 1024
+        # the full offered surface is present in the rendered exposition
+        rendered = env.metrics.render()
+        assert "karpenter_cloudprovider_instance_type_offering_available" in rendered
+
+    def test_ice_flips_offering_available(self, env, lattice):
+        env.run_once()
+        g = env.metrics.get("karpenter_cloudprovider_instance_type_offering_available")
+        ti = lattice.name_to_idx["m5.large"]
+        zi = next(i for i in range(lattice.Z)
+                  if np.isfinite(lattice.price[ti, i, 0]))
+        zone, cap = lattice.zones[zi], lattice.capacity_types[0]
+        assert g.value(instance_type="m5.large", capacity_type=cap, zone=zone) == 1.0
+        env.unavailable.mark_unavailable("test-ice", cap, "m5.large", zone)
+        env.run_once()   # seq_num changed -> surface re-emitted
+        assert g.value(instance_type="m5.large", capacity_type=cap, zone=zone) == 0.0
+        # TTL expiry brings it back
+        env.clock.step(181)
+        env.unavailable.cleanup()
+        env.run_once()
+        assert g.value(instance_type="m5.large", capacity_type=cap, zone=zone) == 1.0

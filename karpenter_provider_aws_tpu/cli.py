@@ -121,7 +121,7 @@ def start_server(op: Operator, port: int) -> ThreadingHTTPServer:
                 # the reference's liveness probe is the cloud connectivity
                 # check (main.go:44 cloud-provider healthz)
                 try:
-                    op.cloud.list_instances()
+                    op.cloud.liveness_probe()
                     body, ctype = b"ok", "text/plain"
                 except Exception as e:
                     self.send_error(503, str(e))

@@ -12,6 +12,7 @@ real cloud by implementing the same surface.
 
 from __future__ import annotations
 
+import collections
 import itertools
 import threading
 from dataclasses import dataclass, field
@@ -56,6 +57,16 @@ class CloudInstance:
         return f"fake:///{self.zone}/{self.id}"
 
 
+@dataclass
+class FleetResult:
+    """CreateFleet outcome: the launched instance plus the exhausted
+    offerings skipped by the lowest-price walk (the analog of
+    CreateFleetOutput.Instances + .Errors)."""
+
+    instance: CloudInstance
+    ice: List[Offering] = field(default_factory=list)
+
+
 def parse_instance_id(provider_id: str) -> str:
     """Mirror of utils.ParseInstanceID over 'fake:///zone/i-…' provider IDs
     (reference pkg/utils/utils.go)."""
@@ -79,7 +90,9 @@ class FakeCloud:
         self.instances: Dict[str, CloudInstance] = {}
         self.capacity_pools: Dict[Offering, int] = {}
         self.next_error: Optional[BaseException] = None
-        self.calls: List[Tuple[str, object]] = []
+        # bounded: a long-running daemon polls list/describe every pass
+        self.calls: "collections.deque[Tuple[str, object]]" = \
+            collections.deque(maxlen=10000)
         # the VPC/IAM/image surface (subnets, SGs, AMIs+SSM, profiles, LTs)
         self.network = FakeNetwork(cluster_name=cluster_name, k8s_version=k8s_version)
 
@@ -102,12 +115,16 @@ class FakeCloud:
     # ---- APIs ------------------------------------------------------------
 
     def create_fleet(self, overrides: Sequence[LaunchOverride],
-                     tags: Optional[Dict[str, str]] = None) -> CloudInstance:
+                     tags: Optional[Dict[str, str]] = None) -> "FleetResult":
         """Launch ONE instance from the cheapest available override.
 
+        Returns the instance TOGETHER with every exhausted offering the
+        lowest-price walk skipped on the way — real CreateFleet reports
+        per-override errors even on success, and the provider feeds them
+        into the UnavailableOfferings cache (reference instance.go:348-354
+        updateUnavailableOfferingsCache on createFleetOutput.Errors).
         Raises UnfulfillableCapacityError naming every exhausted offering
-        tried when no override has capacity — the caller feeds those into
-        the UnavailableOfferings cache (reference instance.go:348-354).
+        when no override has capacity.
         """
         with self._lock:
             self.calls.append(("create_fleet", tuple(o.offering for o in overrides)))
@@ -125,7 +142,7 @@ class FakeCloud:
                     zone=o.zone, capacity_type=o.capacity_type,
                     launch_time=self.clock.now(), price=o.price, tags=dict(tags or {}))
                 self.instances[inst.id] = inst
-                return inst
+                return FleetResult(instance=inst, ice=ice)
             raise UnfulfillableCapacityError(offerings=ice or [o.offering for o in overrides])
 
     def describe_instances(self, ids: Sequence[str]) -> List[CloudInstance]:
@@ -140,6 +157,13 @@ class FakeCloud:
             self._maybe_raise()
             return [i for i in self.instances.values()
                     if include_terminated or i.state not in ("terminated",)]
+
+    def liveness_probe(self) -> None:
+        """Side-effect-free connectivity check for health endpoints: no
+        call recording, no injected-error consumption (a /healthz poll must
+        never race a controller for a test-injected fault)."""
+        with self._lock:
+            pass
 
     def create_tags(self, instance_id: str, tags: Dict[str, str]) -> None:
         """Merge tags onto a live instance (EC2 CreateTags analog; consumed

@@ -146,12 +146,18 @@ class CloudProvider:
                 f"launching spot with {len({o.instance_type for o in overrides})} instance "
                 f"types; >= {FLEXIBILITY_THRESHOLD} recommended for reliable fallback")
         try:
-            instance = self._launch_batcher.add(tuple(overrides))
+            fleet = self._launch_batcher.add(tuple(overrides))
         except UnfulfillableCapacityError as e:
             self.unavailable.mark_unavailable_for_error(e)
             self.recorder.publish("Warning", "InsufficientCapacity", "NodeClaim",
                                   claim.name, str(e))
             raise
+        instance = fleet.instance
+        # a successful fleet still reports the exhausted offerings its
+        # lowest-price walk skipped; cache them so the next solve masks
+        # them out (reference instance.go:348-354)
+        for ct, it, zone in fleet.ice:
+            self.unavailable.mark_unavailable("fleet-error", ct, it, zone)
         if zonal_subnets is not None and instance.zone in zonal_subnets:
             subnet = zonal_subnets[instance.zone]
             self.subnets.update_inflight_ips(subnet.id)

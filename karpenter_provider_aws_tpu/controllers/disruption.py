@@ -43,7 +43,7 @@ from ..errors import UnfulfillableCapacityError
 from ..events import Recorder
 from ..lattice.tensors import masked_view
 from ..metrics import Registry, wire_core_metrics
-from ..solver.solve import NodePlan, Solver
+from ..solver.solve import NodePlan, ProbeResult, Solver
 from ..state.cluster import ClusterState
 from ..utils.clock import Clock
 from .provisioning import Provisioner, ProvisionResult, nodepool_hash
@@ -216,24 +216,29 @@ class DisruptionController:
         # index once per pass: the probe sets are prefixes/singles of one
         # candidate list, so per-set _pods_on/node_for_claim scans would be
         # O(sets × cluster) of pure host work. The caller threads in its own
-        # snapshots so the candidate filter and this map agree (a node
-        # deregistering between two snapshots must not KeyError the pass).
-        claim_names = {c.name for rs in removed_sets for c in rs}
+        # snapshots so the candidate filter and this map agree; a set whose
+        # claim lost its node anyway (snapshot drift) is reported INFEASIBLE
+        # rather than silently shrunk — results must stay aligned with the
+        # caller's sets, and the caller must never disrupt a claim the
+        # probe did not actually evaluate.
         if node_by_claim is None:
             node_by_claim = self.cluster.nodes_by_claim()
         if by_node is None:
             by_node = self.cluster.pods_by_node(include_daemonsets=False)
-        node_of = {n: node_by_claim[n].name for n in claim_names
-                   if n in node_by_claim}
-        removed_sets = [[c for c in rs if c.name in node_of]
-                        for rs in removed_sets]
+        valid = [bool(rs) and all(c.name in node_by_claim for c in rs)
+                 for rs in removed_sets]
+        claim_names = {c.name for rs, ok in zip(removed_sets, valid) if ok
+                       for c in rs}
+        node_of = {n: node_by_claim[n].name for n in claim_names}
         relaxed: Dict[str, Pod] = {}
         for n in claim_names:
             for p in by_node.get(node_of[n], ()):
                 if p.name not in relaxed:
                     relaxed[p.name] = relax_pod(p, relaxation_depth(p))
         problems, prices = [], []
-        for removed in removed_sets:
+        for removed, ok in zip(removed_sets, valid):
+            if not ok:
+                continue
             removed_nodes = {node_of[c.name] for c in removed}
             removed_names = {c.name for c in removed}
             pods = [relaxed[p.name] for c in removed
@@ -247,7 +252,17 @@ class DisruptionController:
                 pods, pools, lattice, existing=existing, daemonset_pods=ds,
                 bound_pods=bound, pvcs=pvcs, storage_classes=storage_classes))
             prices.append(self._removed_price(lattice, removed))
-        return list(zip(self.solver.probe_batch(problems), prices))
+        probed = self.solver.probe_batch(problems) if problems else []
+        dead = ProbeResult(feasible=False, n_new=0, new_cost=0.0,
+                           new_cap_type=None, flex=0)
+        out, vi = [], 0
+        for ok in valid:
+            if ok:
+                out.append((probed[vi], prices[vi]))
+                vi += 1
+            else:
+                out.append((dead, 0.0))
+        return out
 
     def _within_budgets(self, removed: Sequence[NodeClaim],
                         reason: str) -> bool:
@@ -523,6 +538,8 @@ class DisruptionController:
             for p in by_node.get(node_by_claim[c.name].name, ())))
             for c in candidates if c.name in node_by_claim}
         candidates = [c for c in candidates if c.name in node_by_claim]
+        if not candidates:
+            return False  # snapshot drift removed every candidate's node
         candidates.sort(key=lambda c: cost[c.name])
         K = len(candidates)
 
@@ -579,6 +596,7 @@ class DisruptionController:
 
         # single-node scan: only probe-positive candidates pay an exact
         # solve; bounded by the pass's remaining what-if budget
+        truncated_at = None
         for j, claim in enumerate(singles):
             pr, probe_price = probes[n_prefix + j]
             if not self._probe_ok([claim], pr, probe_price):
@@ -586,6 +604,7 @@ class DisruptionController:
             if not self._within_budgets([claim], "Underutilized"):
                 continue
             if self._whatif_used >= self.max_whatif_per_pass:
+                truncated_at = j
                 break
             plan, removed_price = self._what_if([claim])
             if plan.unschedulable or len(plan.new_nodes) > 1:
@@ -598,10 +617,15 @@ class DisruptionController:
                            max_replacement_cost=removed_price
                            - CONSOLIDATION_SAVINGS_EPS):
                 return True
-        if self._whatif_used >= self.max_whatif_per_pass:
-            # budget-truncated: resume the scan at a new window next pass
-            # (reconcile() also skips the negative cache in this case)
-            self._scan_cursor = (start + len(singles)) % K
+        if truncated_at is not None:
+            # budget-truncated mid-window: resume exactly where the scan
+            # stopped next pass (reconcile() skips the negative cache), and
+            # always advance by >=1 so a deterministic repeat can't starve
+            # the tail
+            self._scan_cursor = (start + max(truncated_at, 1)) % K
+        elif self._whatif_used >= self.max_whatif_per_pass:
+            # exhausted exactly at the window's end: next window
+            self._scan_cursor = (start + max(len(singles), 1)) % K
         else:
             self._scan_cursor = 0
         return False

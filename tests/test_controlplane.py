@@ -122,19 +122,19 @@ class TestFakeCloud:
         cloud = FakeCloud(FakeClock())
         o1 = LaunchOverride("m5.large", "us-west-2a", "on-demand", 0.10)
         o2 = LaunchOverride("c5.large", "us-west-2a", "on-demand", 0.08)
-        inst = cloud.create_fleet([o1, o2])
+        inst = cloud.create_fleet([o1, o2]).instance
         assert inst.instance_type == "c5.large"
 
     def test_ice_pool_exhaustion_and_release(self):
         cloud = FakeCloud(FakeClock())
         cloud.set_capacity("on-demand", "m5.large", "us-west-2a", 1)
         o = LaunchOverride("m5.large", "us-west-2a", "on-demand", 0.10)
-        inst = cloud.create_fleet([o])
+        inst = cloud.create_fleet([o]).instance
         with pytest.raises(UnfulfillableCapacityError) as ei:
             cloud.create_fleet([o])
         assert ("on-demand", "m5.large", "us-west-2a") in ei.value.offerings
         cloud.terminate_instances([inst.id])  # capacity returns
-        assert cloud.create_fleet([o]).instance_type == "m5.large"
+        assert cloud.create_fleet([o]).instance.instance_type == "m5.large"
 
     def test_error_injection_fires_once(self):
         cloud = FakeCloud(FakeClock())
@@ -356,7 +356,7 @@ class TestEndToEnd:
 
     def test_gc_terminates_leaked_instance(self, env):
         inst = env.cloud.create_fleet([LaunchOverride("m5.large", "us-west-2a",
-                                                      "on-demand", 0.1)])
+                                                      "on-demand", 0.1)]).instance
         env.clock.step(31)
         env.gc.reconcile()
         assert env.cloud.instances[inst.id].state == "terminated"

@@ -1,0 +1,299 @@
+"""Scale / soak / chaos harness (the reference's stratum-4 analog).
+
+FakeClock-driven ports of the reference scale suite:
+- node-dense 500-node scale-up (1 pod/node via hostname anti-affinity),
+  ref test/suites/scale/provisioning_test.go:72-118
+- pod-dense scale-up (110 pods/node via kubelet maxPods, .large sizes),
+  ref provisioning_test.go:119-157
+- the deprovisioning matrix — consolidation, emptiness, expiration and
+  drift running simultaneously across four NodePools, plus interruption —
+  ref deprovisioning_test.go:113-120,327-681
+- ICE chaos during scale-up (capacity restored mid-flight)
+
+Every scenario asserts convergence AND the leak invariants: all pods
+bound, every running cloud instance belongs to a live claim, every claim
+has a registered node, nothing orphaned.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.apis import NodePool, Operator as ReqOp, Pod, Requirement
+from karpenter_provider_aws_tpu.apis import wellknown as wk
+from karpenter_provider_aws_tpu.apis.objects import NodePoolDisruption, PodAffinityTerm
+from karpenter_provider_aws_tpu.cloud import FakeCloud
+from karpenter_provider_aws_tpu.cloud.fake import parse_instance_id
+from karpenter_provider_aws_tpu.interruption import FakeQueue, spot_interruption
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+from karpenter_provider_aws_tpu.lattice.overhead import KubeletConfiguration
+from karpenter_provider_aws_tpu.operator import Operator, Options
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+_FAMILIES = ("m5", "c5", "r5", "t3")
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    return build_lattice([s for s in build_catalog() if s.family in _FAMILIES])
+
+
+def assert_no_leaks(env):
+    """Zero leaked instances / claims / nodes (the scale suite's core
+    post-condition: EventuallyExpect...Count equalities + cleanup)."""
+    running = {i.id: i for i in env.cloud.instances.values()
+               if i.state == "running"}
+    live_claims = {c.name: c for c in env.cluster.claims.values()
+                   if not c.deletion_timestamp}
+    # every live claim's instance is running
+    for claim in live_claims.values():
+        assert claim.provider_id, f"claim {claim.name} never launched"
+        iid = parse_instance_id(claim.provider_id)
+        assert iid in running, f"claim {claim.name} instance {iid} not running"
+    # every running instance belongs to a live claim (no leaked instances)
+    claim_iids = {parse_instance_id(c.provider_id)
+                  for c in live_claims.values() if c.provider_id}
+    for iid in running:
+        assert iid in claim_iids, f"instance {iid} leaked (no claim)"
+    # every live claim has a registered node
+    for claim in live_claims.values():
+        assert env.cluster.node_for_claim(claim.name) is not None, \
+            f"claim {claim.name} has no node"
+
+
+def assert_all_bound(env):
+    unbound = [p.name for p in env.cluster.pods.values()
+               if not p.is_daemonset and p.node_name is None]
+    assert not unbound, f"{len(unbound)} pods unbound: {unbound[:5]}"
+
+
+def converge(env, rounds, step=2.0):
+    """Drive the full controller loop; stop early once quiescent (no
+    pending pods, no in-flight claims, no in-flight disruptions)."""
+    for _ in range(rounds):
+        env.run_once()
+        env.clock.step(step)
+        if (not env.cluster.pending_pods()
+                and not env.disruption._in_flight
+                and all(env.cluster.node_for_claim(c.name) is not None
+                        for c in env.cluster.claims.values()
+                        if not c.deletion_timestamp)):
+            # one extra pass so terminations finalize
+            env.run_once()
+            return
+
+
+class TestNodeDenseScaleUp:
+    def test_500_nodes_one_pod_each(self, lattice):
+        """provisioning_test.go:82-118: 500 replicas with hostname
+        anti-affinity -> exactly 500 nodes, every pod bound."""
+        clock = FakeClock()
+        env = Operator(options=Options(registration_delay=1.0),
+                       lattice=lattice, cloud=FakeCloud(clock), clock=clock,
+                       node_pools=[NodePool(name="default")])
+        anti = [PodAffinityTerm(topology_key=wk.LABEL_HOSTNAME,
+                                label_selector=(("app", "dense"),), anti=True)]
+        for i in range(500):
+            env.cluster.add_pod(Pod(
+                name=f"d-{i}", labels={"app": "dense"},
+                requests={"cpu": "250m", "memory": "256Mi"},
+                pod_affinity=list(anti)))
+        env.settle(max_rounds=30)
+        assert len(env.cluster.claims) == 500
+        assert len(env.cluster.nodes) == 500
+        assert_all_bound(env)
+        assert_no_leaks(env)
+        # one pod per node (the anti-affinity contract held at scale)
+        per_node = {}
+        for p in env.cluster.pods.values():
+            per_node[p.node_name] = per_node.get(p.node_name, 0) + 1
+        assert max(per_node.values()) == 1
+
+    def test_pod_dense_110_per_node(self, lattice):
+        """provisioning_test.go:119-157: 6600 pods at 110/node density on
+        .large sizes -> 60 nodes."""
+        replicas_per_node, node_count = 110, 60
+        kc = KubeletConfiguration(max_pods=replicas_per_node)
+        dense_lattice = build_lattice(
+            [s for s in build_catalog() if s.family in _FAMILIES], kc=kc)
+        clock = FakeClock()
+        pool = NodePool(name="default", requirements=[
+            Requirement(wk.LABEL_INSTANCE_SIZE, ReqOp.IN, ("large",)),
+            Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN, ("on-demand",))])
+        env = Operator(options=Options(registration_delay=1.0),
+                       lattice=dense_lattice, cloud=FakeCloud(clock),
+                       clock=clock, node_pools=[pool])
+        for i in range(replicas_per_node * node_count):
+            env.cluster.add_pod(Pod(name=f"p-{i}",
+                                    requests={"cpu": "10m", "memory": "50Mi"}))
+        env.settle(max_rounds=30)
+        assert_all_bound(env)
+        assert_no_leaks(env)
+        assert len(env.cluster.nodes) == node_count
+        # density held: no node exceeds maxPods
+        per_node = {}
+        for p in env.cluster.pods.values():
+            per_node[p.node_name] = per_node.get(p.node_name, 0) + 1
+        assert max(per_node.values()) <= replicas_per_node
+
+
+class TestDeprovisioningMatrix:
+    """deprovisioning_test.go:113-120: consolidation, emptiness,
+    expiration, and drift run SIMULTANEOUSLY across four NodePools."""
+
+    METHODS = ("consolidation", "emptiness", "expiration", "drift")
+
+    def _matrix_env(self, lattice, nodes_per_pool=5, pods_per_node=4):
+        clock = FakeClock()
+        pools = []
+        for m in self.METHODS:
+            pools.append(NodePool(
+                name=m, labels={"testing/deprovisioning-type": m},
+                requirements=[Requirement(wk.LABEL_CAPACITY_TYPE,
+                                          ReqOp.IN, ("on-demand",))],
+                disruption=NodePoolDisruption(
+                    consolidate_after=30.0,
+                    expire_after=100000.0 if m == "expiration" else None)))
+        env = Operator(options=Options(registration_delay=1.0),
+                       lattice=lattice, cloud=FakeCloud(clock), clock=clock,
+                       node_pools=pools)
+        # pods pinned to their pool via nodeSelector; hostname
+        # anti-affinity within a group caps one GROUP pod per node, sized
+        # so pods_per_node groups fill a node
+        for m in self.METHODS:
+            for g in range(pods_per_node):
+                anti = [PodAffinityTerm(
+                    topology_key=wk.LABEL_HOSTNAME,
+                    label_selector=(("grp", f"{m}-{g}"),), anti=True)]
+                for i in range(nodes_per_pool):
+                    env.cluster.add_pod(Pod(
+                        name=f"{m}-{g}-{i}", labels={"grp": f"{m}-{g}"},
+                        node_selector={"testing/deprovisioning-type": m},
+                        requests={"cpu": "800m", "memory": "1536Mi"},
+                        pod_affinity=list(anti)))
+        env.settle(max_rounds=40)
+        return env
+
+    def test_all_methods_simultaneously(self, lattice):
+        nodes_per_pool = 5
+        env = self._matrix_env(lattice, nodes_per_pool=nodes_per_pool)
+        assert_all_bound(env)
+        assert_no_leaks(env)
+        by_pool_before = {m: [c for c in env.cluster.claims.values()
+                              if c.node_pool == m] for m in self.METHODS}
+        for m in self.METHODS:
+            assert len(by_pool_before[m]) >= nodes_per_pool - 1
+
+        # fire every method at once:
+        # consolidation: shrink its pods so they repack onto fewer nodes
+        for p in [p for p in list(env.cluster.pods.values())
+                  if p.name.startswith("consolidation-")]:
+            env.cluster.delete_pod(p.name)
+        for i in range(3):
+            env.cluster.add_pod(Pod(
+                name=f"consolidation-tiny-{i}",
+                node_selector={"testing/deprovisioning-type": "consolidation"},
+                requests={"cpu": "100m", "memory": "128Mi"}))
+        # emptiness: drain every pod from its pool
+        for p in [p for p in list(env.cluster.pods.values())
+                  if p.name.startswith("emptiness-")]:
+            env.cluster.delete_pod(p.name)
+        # expiration: jump the clock past expire_after (100000s)
+        env.clock.step(100001)
+        # drift: mutate the pool template so the stamped hash mismatches
+        env.node_pools["drift"].labels["drift-marker"] = "v2"
+
+        converge(env, rounds=300, step=5.0)
+        assert_all_bound(env)
+        assert_no_leaks(env)
+
+        # emptiness pool fully deprovisioned
+        assert not [c for c in env.cluster.claims.values()
+                    if c.node_pool == "emptiness"]
+        # consolidation pool shrank
+        cons = [c for c in env.cluster.claims.values()
+                if c.node_pool == "consolidation"]
+        assert 1 <= len(cons) < nodes_per_pool
+        # expiration pool: every original claim replaced
+        old = {c.name for c in by_pool_before["expiration"]}
+        now = {c.name for c in env.cluster.claims.values()
+               if c.node_pool == "expiration"}
+        assert not (old & now), "expired claims still alive"
+        assert now, "expiration pool has no replacement capacity"
+        # drift pool: every claim stamped with the NEW template hash
+        from karpenter_provider_aws_tpu.controllers.provisioning import nodepool_hash
+        want = nodepool_hash(env.node_pools["drift"])
+        for c in env.cluster.claims.values():
+            if c.node_pool == "drift":
+                assert c.annotations.get(wk.ANNOTATION_NODEPOOL_HASH) == want
+
+    def test_interruption_storm(self, lattice):
+        """deprovisioning_test.go:681+ scaled: spot-interrupt EVERY node at
+        once; all are drained, replaced, and pods rebind."""
+        clock = FakeClock()
+        queue = FakeQueue("interruptions")
+        pool = NodePool(name="default", requirements=[
+            Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN, ("spot",))])
+        env = Operator(options=Options(registration_delay=1.0),
+                       lattice=lattice, cloud=FakeCloud(clock), clock=clock,
+                       node_pools=[pool], interruption_queue=queue)
+        anti = [PodAffinityTerm(topology_key=wk.LABEL_HOSTNAME,
+                                label_selector=(("app", "storm"),), anti=True)]
+        for i in range(10):
+            env.cluster.add_pod(Pod(
+                name=f"s-{i}", labels={"app": "storm"},
+                requests={"cpu": "500m", "memory": "1Gi"},
+                pod_affinity=list(anti)))
+        env.settle(max_rounds=30)
+        assert len(env.cluster.claims) == 10
+        interrupted = {parse_instance_id(c.provider_id)
+                       for c in env.cluster.claims.values()}
+        for iid in interrupted:
+            queue.send(spot_interruption(iid))
+        converge(env, rounds=120, step=3.0)
+        assert_all_bound(env)
+        assert_no_leaks(env)
+        # every interrupted instance is gone; capacity was replaced
+        for c in env.cluster.claims.values():
+            assert parse_instance_id(c.provider_id) not in interrupted
+        assert len(env.cluster.claims) == 10
+
+
+class TestIceChaos:
+    def test_scale_up_through_ice(self, lattice):
+        """Chaos: the cheapest offerings are ICE'd mid-scale-up; the
+        launch path falls through its flexible-type overrides, the ICE
+        cache masks the dead offerings, and the wave still lands."""
+        clock = FakeClock()
+        cloud = FakeCloud(clock)
+        pool = NodePool(name="default", requirements=[
+            Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN, ("on-demand",))])
+        env = Operator(options=Options(registration_delay=1.0),
+                       lattice=lattice, cloud=cloud, clock=clock,
+                       node_pools=[pool])
+        # pre-compute what an unconstrained solve would choose, then ICE it
+        probe = Operator(options=Options(registration_delay=1.0),
+                         lattice=lattice, cloud=FakeCloud(FakeClock()),
+                         clock=FakeClock(), node_pools=[
+                             NodePool(name="default", requirements=[
+                                 Requirement(wk.LABEL_CAPACITY_TYPE,
+                                             ReqOp.IN, ("on-demand",))])])
+        for i in range(40):
+            probe.cluster.add_pod(Pod(name=f"x-{i}",
+                                      requests={"cpu": "1", "memory": "2Gi"}))
+        probe.settle(max_rounds=20)
+        first_choice = {(c.instance_type, c.zone)
+                        for c in probe.cluster.claims.values()}
+        for itype, zone in first_choice:
+            cloud.set_capacity("on-demand", itype, zone, 0)
+
+        for i in range(40):
+            env.cluster.add_pod(Pod(name=f"x-{i}",
+                                    requests={"cpu": "1", "memory": "2Gi"}))
+        env.settle(max_rounds=40)
+        assert_all_bound(env)
+        assert_no_leaks(env)
+        # nothing landed on a dead offering
+        for c in env.cluster.claims.values():
+            assert cloud.capacity_pools.get(("on-demand", c.instance_type, c.zone)) != 0
+        # the ICE cache remembers at least one dead offering
+        assert any(True for _ in env.unavailable.entries())

@@ -1,0 +1,268 @@
+"""Wire (de)serialization for the API objects.
+
+The solver sidecar (parallel/sidecar.py) ships Pods/NodePools/cluster
+state across a process boundary the way the reference's controller ships
+kube objects over the API server watch stream (SURVEY §2.3 communication
+backend). JSON keeps the wire format language-neutral: a non-Python
+controller can build these payloads directly.
+
+Round-trip contract: ``pod_from_dict(pod_to_dict(p))`` produces a Pod that
+schedules identically (same scheduling signature), and likewise for every
+other object here.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Mapping, Optional
+
+import numpy as np
+
+from .objects import (
+    DisruptionBudget, NodePool, NodePoolDisruption, PersistentVolumeClaim,
+    Pod, PodAffinityTerm, PreferredRequirement, StorageClass, Taint,
+    TaintEffect, Toleration, TopologySpreadConstraint,
+)
+from .requirements import Operator, Requirement
+
+# ---- requirements ----------------------------------------------------------
+
+
+def requirement_to_dict(r: Requirement) -> Dict:
+    out = {"key": r.key, "operator": r.operator.value,
+           "values": list(r.values)}
+    if r.min_values is not None:
+        out["minValues"] = r.min_values
+    return out
+
+
+def requirement_from_dict(d: Mapping) -> Requirement:
+    return Requirement(key=d["key"], operator=Operator(d["operator"]),
+                       values=tuple(d.get("values", ())),
+                       min_values=d.get("minValues"))
+
+
+# ---- pod -------------------------------------------------------------------
+
+
+def pod_to_dict(p: Pod) -> Dict:
+    return {
+        "name": p.name,
+        "namespace": p.namespace,
+        "labels": dict(p.labels),
+        "requests": {k: str(v) for k, v in p.requests.items()},
+        "nodeSelector": dict(p.node_selector),
+        "requiredAffinity": [requirement_to_dict(r) for r in p.required_affinity],
+        "preferredAffinity": [
+            {"requirement": requirement_to_dict(pr.requirement),
+             "weight": pr.weight} for pr in p.preferred_affinity],
+        "tolerations": [
+            {"key": t.key, "operator": t.operator, "value": t.value,
+             "effect": t.effect.value if t.effect is not None else None}
+            for t in p.tolerations],
+        "topologySpread": [
+            {"maxSkew": c.max_skew, "topologyKey": c.topology_key,
+             "whenUnsatisfiable": c.when_unsatisfiable,
+             "labelSelector": [list(kv) for kv in c.label_selector]}
+            for c in p.topology_spread],
+        "podAffinity": [
+            {"topologyKey": t.topology_key,
+             "labelSelector": [list(kv) for kv in t.label_selector],
+             "anti": t.anti} for t in p.pod_affinity],
+        "volumeClaims": list(p.volume_claims),
+        "nodeName": p.node_name,
+        "owner": p.owner,
+        "isDaemonset": p.is_daemonset,
+        "priority": p.priority,
+    }
+
+
+def pod_from_dict(d: Mapping) -> Pod:
+    return Pod(
+        name=d["name"],
+        namespace=d.get("namespace", "default"),
+        labels=dict(d.get("labels", {})),
+        requests=dict(d.get("requests", {})),
+        node_selector=dict(d.get("nodeSelector", {})),
+        required_affinity=[requirement_from_dict(r)
+                           for r in d.get("requiredAffinity", ())],
+        preferred_affinity=[
+            PreferredRequirement(
+                requirement=requirement_from_dict(pr["requirement"]),
+                weight=pr.get("weight", 1))
+            for pr in d.get("preferredAffinity", ())],
+        tolerations=[
+            Toleration(key=t.get("key", ""), operator=t.get("operator", "Equal"),
+                       value=t.get("value", ""),
+                       effect=(TaintEffect(t["effect"])
+                               if t.get("effect") else None))
+            for t in d.get("tolerations", ())],
+        topology_spread=[
+            TopologySpreadConstraint(
+                max_skew=c["maxSkew"], topology_key=c["topologyKey"],
+                when_unsatisfiable=c.get("whenUnsatisfiable", "DoNotSchedule"),
+                label_selector=tuple(tuple(kv) for kv in c.get("labelSelector", ())))
+            for c in d.get("topologySpread", ())],
+        pod_affinity=[
+            PodAffinityTerm(
+                topology_key=t["topologyKey"],
+                label_selector=tuple(tuple(kv) for kv in t.get("labelSelector", ())),
+                anti=t.get("anti", False))
+            for t in d.get("podAffinity", ())],
+        volume_claims=list(d.get("volumeClaims", ())),
+        node_name=d.get("nodeName"),
+        owner=d.get("owner"),
+        is_daemonset=d.get("isDaemonset", False),
+        priority=d.get("priority", 0),
+    )
+
+
+# ---- nodepool --------------------------------------------------------------
+
+
+def nodepool_to_dict(p: NodePool) -> Dict:
+    return {
+        "name": p.name,
+        "weight": p.weight,
+        "labels": dict(p.labels),
+        "annotations": dict(p.annotations),
+        "requirements": [requirement_to_dict(r) for r in p.requirements],
+        "taints": [{"key": t.key, "value": t.value, "effect": t.effect.value}
+                   for t in p.taints],
+        "startupTaints": [{"key": t.key, "value": t.value,
+                           "effect": t.effect.value}
+                          for t in p.startup_taints],
+        "limits": {k: str(v) for k, v in p.limits.items()},
+        "disruption": {
+            "consolidationPolicy": p.disruption.consolidation_policy,
+            "consolidateAfter": p.disruption.consolidate_after,
+            "expireAfter": p.disruption.expire_after,
+            "budgets": [
+                {"nodes": b.nodes, "schedule": b.schedule,
+                 "duration": b.duration, "reasons": list(b.reasons)}
+                for b in p.disruption.budgets],
+        },
+        "nodeClassRef": p.node_class_ref,
+    }
+
+
+def nodepool_from_dict(d: Mapping) -> NodePool:
+    dis = d.get("disruption", {})
+    return NodePool(
+        name=d["name"],
+        weight=d.get("weight", 0),
+        labels=dict(d.get("labels", {})),
+        annotations=dict(d.get("annotations", {})),
+        requirements=[requirement_from_dict(r)
+                      for r in d.get("requirements", ())],
+        taints=[Taint(key=t["key"], value=t.get("value", ""),
+                      effect=TaintEffect(t.get("effect", "NoSchedule")))
+                for t in d.get("taints", ())],
+        startup_taints=[Taint(key=t["key"], value=t.get("value", ""),
+                              effect=TaintEffect(t.get("effect", "NoSchedule")))
+                        for t in d.get("startupTaints", ())],
+        limits=dict(d.get("limits", {})),
+        disruption=NodePoolDisruption(
+            consolidation_policy=dis.get("consolidationPolicy",
+                                         "WhenUnderutilized"),
+            consolidate_after=dis.get("consolidateAfter"),
+            expire_after=dis.get("expireAfter"),
+            budgets=[DisruptionBudget(
+                nodes=b.get("nodes", "10%"), schedule=b.get("schedule"),
+                duration=b.get("duration"),
+                reasons=tuple(b.get("reasons", ())))
+                for b in dis.get("budgets", [{}])]),
+        node_class_ref=d.get("nodeClassRef", "default"),
+    )
+
+
+# ---- volumes ---------------------------------------------------------------
+
+
+def pvc_to_dict(c: PersistentVolumeClaim) -> Dict:
+    return {"name": c.name, "storageClass": c.storage_class,
+            "boundZone": c.bound_zone}
+
+
+def pvc_from_dict(d: Mapping) -> PersistentVolumeClaim:
+    return PersistentVolumeClaim(name=d["name"],
+                                 storage_class=d.get("storageClass", ""),
+                                 bound_zone=d.get("boundZone"))
+
+
+def storage_class_to_dict(s: StorageClass) -> Dict:
+    return {"name": s.name, "zones": list(s.zones),
+            "bindingMode": s.binding_mode}
+
+
+def storage_class_from_dict(d: Mapping) -> StorageClass:
+    return StorageClass(name=d["name"], zones=tuple(d.get("zones", ())),
+                        binding_mode=d.get("bindingMode",
+                                           "WaitForFirstConsumer"))
+
+
+# ---- solver-side objects ---------------------------------------------------
+
+
+def existing_bin_to_dict(b) -> Dict:
+    return {
+        "name": b.name, "nodePool": b.node_pool,
+        "instanceType": b.instance_type, "zone": b.zone,
+        "capacityType": b.capacity_type,
+        "used": np.asarray(b.used, dtype=float).tolist(),
+        "allocOverride": (np.asarray(b.alloc_override, dtype=float).tolist()
+                          if b.alloc_override is not None else None),
+    }
+
+
+def existing_bin_from_dict(d: Mapping):
+    from ..solver.problem import ExistingBin
+    return ExistingBin(
+        name=d["name"], node_pool=d["nodePool"],
+        instance_type=d["instanceType"], zone=d["zone"],
+        capacity_type=d["capacityType"],
+        used=np.asarray(d["used"], dtype=np.float32),
+        alloc_override=(np.asarray(d["allocOverride"], dtype=np.float32)
+                        if d.get("allocOverride") is not None else None),
+    )
+
+
+def plan_to_dict(plan) -> Dict:
+    return {
+        "newNodes": [
+            {"nodePool": n.node_pool, "instanceType": n.instance_type,
+             "zone": n.zone, "capacityType": n.capacity_type,
+             "pricePerHour": n.price_per_hour, "pods": list(n.pods),
+             "feasibleTypes": list(n.feasible_types),
+             "feasibleZones": list(n.feasible_zones),
+             "feasibleCapacityTypes": list(n.feasible_capacity_types)}
+            for n in plan.new_nodes],
+        "existingAssignments": {k: list(v)
+                                for k, v in plan.existing_assignments.items()},
+        "unschedulable": dict(plan.unschedulable),
+        "newNodeCost": plan.new_node_cost,
+        "solveSeconds": plan.solve_seconds,
+        "deviceSeconds": plan.device_seconds,
+        "warnings": list(plan.warnings),
+    }
+
+
+def plan_from_dict(d: Mapping):
+    from ..solver.solve import NodePlan, PlannedNode
+    return NodePlan(
+        new_nodes=[
+            PlannedNode(
+                node_pool=n["nodePool"], instance_type=n["instanceType"],
+                zone=n["zone"], capacity_type=n["capacityType"],
+                price_per_hour=n["pricePerHour"], pods=list(n["pods"]),
+                feasible_types=list(n.get("feasibleTypes", ())),
+                feasible_zones=list(n.get("feasibleZones", ())),
+                feasible_capacity_types=list(n.get("feasibleCapacityTypes", ())))
+            for n in d.get("newNodes", ())],
+        existing_assignments={k: list(v) for k, v in
+                              d.get("existingAssignments", {}).items()},
+        unschedulable=dict(d.get("unschedulable", {})),
+        new_node_cost=d.get("newNodeCost", 0.0),
+        solve_seconds=d.get("solveSeconds", 0.0),
+        device_seconds=d.get("deviceSeconds", 0.0),
+        warnings=list(d.get("warnings", ())),
+    )

@@ -66,11 +66,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir", default=None,
                    help="Write a JAX profiler (xprof) trace of every device "
                         "solve under this directory.")
+    p.add_argument("--sidecar-address", default=None,
+                   help="Also serve the solver as a gRPC sidecar on this "
+                        "address (e.g. unix:/run/karpenter/solver.sock or "
+                        ":50051) so external controllers can Solve() "
+                        "against the resident lattice.")
     p.add_argument("--duration", type=float, default=0.0,
                    help="Run for this many seconds then exit "
                         "(0 = run until SIGINT/SIGTERM).")
     p.add_argument("--step", type=float, default=1.0,
-                   help="Seconds between reconcile passes.")
+                   help="Seconds between reconcile passes "
+                        "(single-threaded loop only).")
+    p.add_argument("--async-runtime", action="store_true",
+                   help="Run each controller on its own cadence in its own "
+                        "thread (the controller-runtime analog with "
+                        "MaxConcurrentReconciles-style concurrency) instead "
+                        "of the deterministic single-threaded loop.")
     return p
 
 
@@ -160,18 +171,35 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         pass  # not the main thread (tests drive main() directly)
 
     server = start_server(op, args.metrics_port) if args.metrics_port else None
+    sidecar = None
+    if args.sidecar_address:
+        from .parallel.sidecar import serve as serve_sidecar
+        sidecar = serve_sidecar(op.solver, args.sidecar_address)
     if args.profile_dir:
         op.solver.start_profiling(args.profile_dir)
     deadline = (time.monotonic() + args.duration) if args.duration > 0 else None
+    runtime = None
     try:
-        while not stop.is_set():
-            op.run_once()
-            if deadline is not None and time.monotonic() >= deadline:
-                break
-            stop.wait(args.step)
+        if args.async_runtime:
+            from .operator.runtime import ControllerRuntime, operator_specs
+            runtime = ControllerRuntime(operator_specs(op)).start()
+            while not stop.is_set():
+                if deadline is not None and time.monotonic() >= deadline:
+                    break
+                stop.wait(0.2)
+        else:
+            while not stop.is_set():
+                op.run_once()
+                if deadline is not None and time.monotonic() >= deadline:
+                    break
+                stop.wait(args.step)
     finally:
+        if runtime is not None:
+            runtime.stop()
         if args.profile_dir:
             op.solver.stop_profiling()
+        if sidecar is not None:
+            sidecar.stop(grace=None)
         if server is not None:
             server.shutdown()
     return 0

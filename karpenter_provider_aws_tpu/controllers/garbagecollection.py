@@ -30,26 +30,40 @@ class GarbageCollectionController:
         self.clock = clock or Clock()
         self.recorder = recorder or Recorder(self.clock)
 
+    # reference garbagecollection/controller.go:78 checks 100-way parallel
+    EXISTENCE_WORKERS = 100
+
     def reconcile(self) -> None:
+        from ..utils.fanout import parallelize
+
         now = self.clock.now()
-        claimed_ids = set()
-        for claim in list(self.cluster.claims.values()):
-            if claim.provider_id is None:
-                continue
-            iid = parse_instance_id(claim.provider_id)
-            claimed_ids.add(iid)
-            # claim whose instance vanished out from under it -> delete the
-            # claim (+node) so its pods reschedule
+        claims = [c for c in list(self.cluster.claims.values())
+                  if c.provider_id is not None]
+        claimed_ids = {parse_instance_id(c.provider_id) for c in claims}
+
+        # existence checks fan out (the cloud round trip is the slow part);
+        # state mutation happens serially afterwards under one thread
+        def exists(claim) -> bool:
             try:
                 self.cloud_provider.get(claim.provider_id)
+                return True
             except NotFoundError:
-                self.recorder.publish("Warning", "InstanceDisappeared", "NodeClaim",
-                                      claim.name, f"instance {iid} is gone")
-                node = self.cluster.node_for_claim(claim.name)
-                if node is not None:
-                    self.cluster.unbind_pods_on(node.name)
-                    self.cluster.delete_node(node.name)
-                self.cluster.delete_claim(claim.name)
+                return False
+
+        alive = parallelize(self.EXISTENCE_WORKERS, claims, exists)
+        for claim, ok in zip(claims, alive):
+            if ok:
+                continue
+            # claim whose instance vanished out from under it -> delete the
+            # claim (+node) so its pods reschedule
+            iid = parse_instance_id(claim.provider_id)
+            self.recorder.publish("Warning", "InstanceDisappeared", "NodeClaim",
+                                  claim.name, f"instance {iid} is gone")
+            node = self.cluster.node_for_claim(claim.name)
+            if node is not None:
+                self.cluster.unbind_pods_on(node.name)
+                self.cluster.delete_node(node.name)
+            self.cluster.delete_claim(claim.name)
         # leaked instances: running but unclaimed past the grace window
         for inst in self.cloud_provider.list_instances():
             if inst.id in claimed_ids or inst.state == "terminated":

@@ -59,24 +59,31 @@ class InterruptionController:
                 out[parse_instance_id(claim.provider_id)] = claim
         return out
 
+    # reference controller.go:104 fans message handling 10-way
+    MESSAGE_WORKERS = 10
+
     def reconcile(self) -> int:
-        """One receive→handle→delete pass. Returns messages handled.
-        (The reference fans 10-way parallel, controller.go:104; the sim
-        handles the batch serially under the same at-least-once contract.)"""
+        """One receive→handle→delete pass (10-way parallel like
+        workqueue.ParallelizeUntil, controller.go:104). Returns messages
+        handled; the at-least-once contract holds — a message is deleted
+        only after its handler ran."""
+        from ..utils.fanout import parallelize
+
         msgs = self.queue.receive()
         if not msgs:
             return 0
         claims_by_id = self._claims_by_instance_id()
-        handled = 0
-        for qm in msgs:
+
+        def one(qm) -> int:
             msg = parse_message(qm.body)
             self._m_received.inc(message_type=msg.kind.value)
             if msg.kind != MessageKind.NOOP:
                 self._handle(msg, claims_by_id)
             self.queue.delete(qm.receipt_handle)
             self._m_deleted.inc()
-            handled += 1
-        return handled
+            return 1
+
+        return sum(parallelize(self.MESSAGE_WORKERS, msgs, one))
 
     def _handle(self, msg: InterruptionMessage, claims_by_id: Dict[str, NodeClaim]) -> None:
         for iid in msg.instance_ids:

@@ -147,6 +147,15 @@ class Operator:
         self.disruption.reconcile()
         self.termination.reconcile()
         self.gc.reconcile()
+        self.emit_gauges()
+        now = self.clock.now()
+        if now - self._last_cache_cleanup >= 10.0:  # ICE cleanup cadence (cache.go:39-42)
+            self.unavailable.cleanup()
+            self._last_cache_cleanup = now
+
+    def emit_gauges(self) -> None:
+        """Refresh the state + offering gauge surfaces (run_once calls this
+        every pass; the async runtime registers it as its own controller)."""
         self.metrics.gauge("karpenter_cluster_state_node_count").set(len(self.cluster.nodes))
         self.metrics.gauge("karpenter_cluster_state_pod_count").set(len(self.cluster.pods))
         self.metrics.gauge("karpenter_ice_cache_size").set(
@@ -158,10 +167,6 @@ class Operator:
             emit_lattice_gauges(self._lattice_gauges, self.lattice,
                                 self.unavailable.mask(self.lattice))
             self._lattice_gauge_state = gstate
-        now = self.clock.now()
-        if now - self._last_cache_cleanup >= 10.0:  # ICE cleanup cadence (cache.go:39-42)
-            self.unavailable.cleanup()
-            self._last_cache_cleanup = now
 
     def run(self, duration: float, step: float = 1.0) -> None:
         """Drive the control plane for `duration` simulated (FakeClock) or

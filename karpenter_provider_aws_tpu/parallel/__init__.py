@@ -1,4 +1,18 @@
 from .mesh import solver_mesh
 from .sharded import ShardedPack, sharded_pack, split_counts
 
-__all__ = ["ShardedPack", "solver_mesh", "sharded_pack", "split_counts"]
+__all__ = ["ShardedPack", "SolverClient", "SolverService", "serve_sidecar",
+           "solver_mesh", "sharded_pack", "split_counts"]
+
+_SIDECAR = {"SolverClient": "SolverClient", "SolverService": "SolverService",
+            "serve_sidecar": "serve"}
+
+
+def __getattr__(name):
+    # lazy: the sidecar pulls in grpc, which must stay optional for the
+    # sharded-solve path (solver/solve.py imports this package on every
+    # multi-chip solve)
+    if name in _SIDECAR:
+        from . import sidecar
+        return getattr(sidecar, _SIDECAR[name])
+    raise AttributeError(name)

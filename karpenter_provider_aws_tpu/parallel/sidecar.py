@@ -1,0 +1,140 @@
+"""Solver sidecar: the host↔solver gRPC transport.
+
+SURVEY §2.3 ("communication backend") and §7 ("calls the solver — gRPC
+sidecar in-process first"): the device solver runs as a service so a
+controller in another process — or another language; the wire format is
+plain JSON (apis/serde.py) over unary gRPC — can ship cluster state in
+and get NodePlans back. The reference's equivalent transport is the kube
+API watch stream + SQS long-poll (pkg/providers/sqs/sqs.go:52-72); here
+the hot path is the Solve RPC, and the lattice stays RESIDENT in the
+sidecar process (SURVEY §7 hard part (d): ship only pod deltas, never the
+700-type lattice).
+
+Methods (all unary, raw-bytes payloads so no protoc codegen is needed):
+- /karpenter.solver.v1.Solver/Solve   — pods+pools+state → NodePlan
+- /karpenter.solver.v1.Solver/Health  — lattice shape + price version
+
+Transport: any gRPC address. ``unix:`` sockets for the local sidecar
+(no TCP hop), ``host:port`` when the solver pool lives across DCN.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+import grpc
+
+from ..apis import serde
+from ..solver.solve import NodePlan, Solver
+
+_SOLVE = "/karpenter.solver.v1.Solver/Solve"
+_HEALTH = "/karpenter.solver.v1.Solver/Health"
+
+
+class SolverService:
+    """Server-side request handling around a resident Solver."""
+
+    def __init__(self, solver: Solver):
+        # Solver is thread-safe (its public entry points serialize on an
+        # internal RLock), so RPCs and in-process controller solves on the
+        # same instance interleave safely
+        self.solver = solver
+
+    def solve(self, payload: bytes) -> bytes:
+        from ..solver.topology import BoundPod
+
+        req = json.loads(payload.decode())
+        pods = [serde.pod_from_dict(p) for p in req.get("pods", ())]
+        pools = [serde.nodepool_from_dict(p)
+                 for p in req.get("nodePools", ())]
+        existing = [serde.existing_bin_from_dict(b)
+                    for b in req.get("existing", ())]
+        ds = [serde.pod_from_dict(p) for p in req.get("daemonsetPods", ())]
+        bound = [BoundPod(pod=serde.pod_from_dict(b["pod"]),
+                          node_name=b["nodeName"], zone=b.get("zone", ""),
+                          capacity_type=b.get("capacityType", "on-demand"))
+                 for b in req.get("boundPods", ())]
+        pvcs = {c["name"]: serde.pvc_from_dict(c)
+                for c in req.get("pvcs", ())} or None
+        scs = {s["name"]: serde.storage_class_from_dict(s)
+               for s in req.get("storageClasses", ())} or None
+        plan = self.solver.solve_relaxed(
+            pods, pools, existing=existing, daemonset_pods=ds,
+            bound_pods=bound, pvcs=pvcs, storage_classes=scs)
+        return json.dumps(serde.plan_to_dict(plan)).encode()
+
+    def health(self, payload: bytes) -> bytes:
+        lat = self.solver.lattice
+        return json.dumps({
+            "ok": True,
+            "types": lat.T, "zones": lat.Z, "capacityTypes": lat.C,
+            "priceVersion": lat.price_version,
+        }).encode()
+
+
+class _Handler(grpc.GenericRpcHandler):
+    def __init__(self, service: SolverService):
+        self._service = service
+
+    def service(self, handler_call_details):
+        if handler_call_details.method == _SOLVE:
+            return grpc.unary_unary_rpc_method_handler(
+                lambda req, ctx: self._service.solve(req))
+        if handler_call_details.method == _HEALTH:
+            return grpc.unary_unary_rpc_method_handler(
+                lambda req, ctx: self._service.health(req))
+        return None
+
+
+def serve(solver: Solver, address: str = "unix:/tmp/karpenter-solver.sock",
+          max_workers: int = 4) -> grpc.Server:
+    """Start the sidecar on ``address``; returns the running server."""
+    from concurrent.futures import ThreadPoolExecutor
+    server = grpc.server(ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((_Handler(SolverService(solver)),))
+    # add_insecure_port signals bind failure by returning 0, not raising
+    # (unix: sockets return 1 on success)
+    if server.add_insecure_port(address) == 0:
+        raise RuntimeError(f"sidecar failed to bind {address!r}")
+    server.start()
+    return server
+
+
+class SolverClient:
+    """Thin client. ``solve()`` mirrors Solver.solve_relaxed's signature
+    and returns a real NodePlan (decoded from the wire)."""
+
+    def __init__(self, address: str = "unix:/tmp/karpenter-solver.sock",
+                 timeout: float = 60.0):
+        self._channel = grpc.insecure_channel(address)
+        self._solve = self._channel.unary_unary(_SOLVE)
+        self._health = self._channel.unary_unary(_HEALTH)
+        self.timeout = timeout
+
+    def solve(self, pods: Sequence, node_pools: Sequence,
+              existing: Sequence = (), daemonset_pods: Sequence = (),
+              bound_pods: Sequence = (), pvcs: Optional[Dict] = None,
+              storage_classes: Optional[Dict] = None) -> NodePlan:
+        req = {
+            "pods": [serde.pod_to_dict(p) for p in pods],
+            "nodePools": [serde.nodepool_to_dict(p) for p in node_pools],
+            "existing": [serde.existing_bin_to_dict(b) for b in existing],
+            "daemonsetPods": [serde.pod_to_dict(p) for p in daemonset_pods],
+            "boundPods": [
+                {"pod": serde.pod_to_dict(b.pod), "nodeName": b.node_name,
+                 "zone": b.zone, "capacityType": b.capacity_type}
+                for b in bound_pods],
+            "pvcs": [serde.pvc_to_dict(c)
+                     for c in (pvcs or {}).values()],
+            "storageClasses": [serde.storage_class_to_dict(s)
+                               for s in (storage_classes or {}).values()],
+        }
+        resp = self._solve(json.dumps(req).encode(), timeout=self.timeout)
+        return serde.plan_from_dict(json.loads(resp.decode()))
+
+    def health(self) -> Dict:
+        return json.loads(self._health(b"{}", timeout=self.timeout).decode())
+
+    def close(self) -> None:
+        self._channel.close()

@@ -21,6 +21,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import threading
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -30,7 +32,7 @@ from ..lattice.tensors import Lattice
 from ..ops import binpack
 from .problem import Problem
 
-_G_BUCKETS = (16, 32, 64, 256, 1024, 4096)
+_G_BUCKETS = (16, 32, 64, 96, 128, 192, 256, 512, 1024, 4096)
 _B_BUCKETS = (32, 128, 512, 1024, 2048, 8192)
 
 
@@ -189,8 +191,23 @@ def decode_sharded_pack(sp, G: int, T: int, Z: int, C: int,
             for d in range(packed.shape[0])]
 
 
+def _locked(fn):
+    """Serialize a Solver entry point on the instance's solve lock
+    (re-entrant: solve_relaxed → solve nests fine)."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._solve_lock:
+            return fn(self, *args, **kwargs)
+    return wrapper
+
+
 class Solver:
-    """Holds the lattice resident on device; solves padded problems."""
+    """Holds the lattice resident on device; solves padded problems.
+
+    Thread-safe: every public solve/probe entry point serializes on an
+    internal RLock (see __init__)."""
 
     def __init__(self, lattice: Lattice):
         self.lattice = lattice
@@ -200,6 +217,11 @@ class Solver:
         self._price_version = lattice.price_version
         self._tracing = False
         self._trace_step = 0
+        # one device pipeline, many callers: the async runtime, the gRPC
+        # sidecar, and in-process controllers can all reach this Solver
+        # concurrently, and solve/probe mutate shared caches (_b_hint, the
+        # price-version re-upload). Serialize every public entry point.
+        self._solve_lock = threading.RLock()
         # per group-bucket: (fresh-estimate bucket, bucket actually needed)
         # of the last solve. A same-or-larger fresh estimate starts at the
         # size that worked (each overflow retry costs a full device round
@@ -364,6 +386,7 @@ class Solver:
 
     _K_BUCKETS = (4, 8, 16, 32)
 
+    @_locked
     def probe_batch(self, problems: Sequence[Problem]) -> List[ProbeResult]:
         """K consolidation what-ifs in ONE device call.
 
@@ -428,6 +451,7 @@ class Solver:
 
     # ---- solve ----
 
+    @_locked
     def solve_relaxed(self, pods, node_pools, lattice=None, existing=(),
                       daemonset_pods=(), bound_pods=(), pvcs=None,
                       storage_classes=None, mesh=None) -> NodePlan:
@@ -484,6 +508,7 @@ class Solver:
         best.device_seconds = total_device
         return best
 
+    @_locked
     def solve(self, problem: Problem, mesh=None) -> NodePlan:
         """Solve a problem into a NodePlan.
 

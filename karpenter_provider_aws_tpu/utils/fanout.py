@@ -1,0 +1,31 @@
+"""Bounded parallel fan-out — the workqueue.ParallelizeUntil analog.
+
+The reference fans interruption messages 10-way
+(pkg/controllers/interruption/controller.go:104) and garbage-collection
+existence checks 100-way
+(pkg/controllers/nodeclaim/garbagecollection/controller.go:78). Host-side
+work here is I/O-shaped (cloud API calls), so threads are the right
+primitive; device work never goes through this path.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Iterable, List, Sequence, TypeVar
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+
+def parallelize(workers: int, items: Sequence[T],
+                fn: Callable[[T], R]) -> List[R]:
+    """Apply ``fn`` to every item with at most ``workers`` concurrent
+    calls; results keep item order. Exceptions propagate after all
+    submitted work drains (first one wins), matching ParallelizeUntil's
+    fail-late behavior for a finite work list."""
+    if not items:
+        return []
+    if workers <= 1 or len(items) == 1:
+        return [fn(i) for i in items]
+    with ThreadPoolExecutor(max_workers=min(workers, len(items))) as pool:
+        return list(pool.map(fn, items))

@@ -1,0 +1,125 @@
+"""Solver sidecar tests: serde round-trips and the gRPC transport
+(SURVEY §2.3 communication backend; §7 "gRPC sidecar in-process first")."""
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.apis import NodePool, Operator as ReqOp, Pod, Requirement
+from karpenter_provider_aws_tpu.apis import serde
+from karpenter_provider_aws_tpu.apis import wellknown as wk
+from karpenter_provider_aws_tpu.apis.objects import (
+    PodAffinityTerm, PreferredRequirement, Taint, Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+from karpenter_provider_aws_tpu.solver import ExistingBin, Solver, build_problem
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    return build_lattice([s for s in build_catalog()
+                          if s.family in ("m5", "c5", "t3")])
+
+
+def rich_pod():
+    return Pod(
+        name="rich", labels={"app": "x"},
+        requests={"cpu": "500m", "memory": "1Gi"},
+        node_selector={wk.LABEL_ARCH: "amd64"},
+        required_affinity=[Requirement(wk.LABEL_INSTANCE_CATEGORY,
+                                       ReqOp.IN, ("m", "c"))],
+        preferred_affinity=[PreferredRequirement(
+            Requirement(wk.LABEL_ZONE, ReqOp.IN, ("us-west-2a",)), weight=5)],
+        tolerations=[Toleration(key="dedicated", operator="Equal",
+                                value="batch")],
+        topology_spread=[TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.LABEL_ZONE,
+            when_unsatisfiable="ScheduleAnyway",
+            label_selector=(("app", "x"),))],
+        pod_affinity=[PodAffinityTerm(topology_key=wk.LABEL_HOSTNAME,
+                                      label_selector=(("app", "x"),),
+                                      anti=True)],
+        volume_claims=["data-0"], priority=3)
+
+
+class TestSerde:
+    def test_pod_round_trip_preserves_scheduling_signature(self):
+        from karpenter_provider_aws_tpu.solver.problem import _group_key
+        p = rich_pod()
+        q = serde.pod_from_dict(serde.pod_to_dict(p))
+        rk = frozenset({"app"})
+        assert _group_key(p, rk, {}) == _group_key(q, rk, {})
+        assert q.priority == 3 and q.volume_claims == ["data-0"]
+
+    def test_nodepool_round_trip(self):
+        from karpenter_provider_aws_tpu.controllers.provisioning import nodepool_hash
+        pool = NodePool(
+            name="batch", weight=10, labels={"team": "batch"},
+            taints=[Taint(key="dedicated", value="batch")],
+            requirements=[Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN,
+                                      ("spot",), min_values=2)],
+            limits={"cpu": "100"})
+        q = serde.nodepool_from_dict(serde.nodepool_to_dict(pool))
+        assert nodepool_hash(pool) == nodepool_hash(q)
+        assert q.requirements[0].min_values == 2
+        assert q.limits == {"cpu": "100"}
+
+    def test_existing_bin_round_trip(self):
+        b = ExistingBin(name="n0", node_pool="default",
+                        instance_type="m5.large", zone="us-west-2a",
+                        capacity_type="on-demand",
+                        used=np.arange(8, dtype=np.float32))
+        q = serde.existing_bin_from_dict(serde.existing_bin_to_dict(b))
+        assert q.name == b.name and q.instance_type == b.instance_type
+        np.testing.assert_allclose(q.used, b.used)
+
+
+class TestSidecarTransport:
+    def test_solve_and_health_over_unix_socket(self, lattice, tmp_path):
+        from karpenter_provider_aws_tpu.parallel.sidecar import (
+            SolverClient, serve,
+        )
+        addr = f"unix:{tmp_path}/solver.sock"
+        server = serve(Solver(lattice), addr)
+        try:
+            client = SolverClient(addr)
+            h = client.health()
+            assert h["ok"] and h["types"] == lattice.T
+            pods = [Pod(name=f"p{i}",
+                        requests={"cpu": "500m", "memory": "1Gi"})
+                    for i in range(6)]
+            plan = client.solve(pods, [NodePool(name="default")])
+            assert not plan.unschedulable
+            placed = sum(len(n.pods) for n in plan.new_nodes)
+            assert placed == 6
+            assert plan.new_node_cost > 0
+            # parity with an in-process solve
+            local = Solver(lattice).solve(
+                build_problem(pods, [NodePool(name="default")], lattice))
+            assert plan.new_node_cost == pytest.approx(local.new_node_cost)
+            client.close()
+        finally:
+            server.stop(grace=None)
+
+    def test_sidecar_carries_existing_bins_and_constraints(self, lattice, tmp_path):
+        from karpenter_provider_aws_tpu.parallel.sidecar import (
+            SolverClient, serve,
+        )
+        addr = f"unix:{tmp_path}/solver2.sock"
+        server = serve(Solver(lattice), addr)
+        try:
+            client = SolverClient(addr)
+            existing = [ExistingBin(
+                name="n0", node_pool="default", instance_type="m5.4xlarge",
+                zone="us-west-2a", capacity_type="on-demand",
+                used=np.zeros(8, np.float32))]
+            pods = [rich_pod()]
+            plan = client.solve(pods, [NodePool(name="default")],
+                                existing=existing)
+            assert not plan.unschedulable
+            # the rich pod fits the idle existing node (affinity allows it)
+            assert plan.existing_assignments.get("n0") == ["rich"] or \
+                plan.new_nodes
+            client.close()
+        finally:
+            server.stop(grace=None)

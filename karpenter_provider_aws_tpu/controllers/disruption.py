@@ -107,7 +107,7 @@ class DisruptionController:
     # ---- budgets (disruption.md:193-222) ---------------------------------
 
     def _allowed_disruptions(self, pool: NodePool, reason: str) -> int:
-        total = sum(1 for c in self.cluster.claims.values()
+        total = sum(1 for c in self.cluster.snapshot_claims()
                     if c.node_pool == pool.name and not c.deletion_timestamp)
         disrupting = sum(1 for a in self._in_flight for n in a.claims
                          if n in self.cluster.claims
@@ -134,7 +134,7 @@ class DisruptionController:
         in_flight = {n for a in self._in_flight for n in a.claims}
         node_by_claim = self.cluster.nodes_by_claim()
         out = []
-        for claim in self.cluster.claims.values():
+        for claim in self.cluster.snapshot_claims():
             if claim.deletion_timestamp or claim.name in in_flight:
                 continue
             if claim.phase != NodeClaimPhase.INITIALIZED:
@@ -150,7 +150,7 @@ class DisruptionController:
         node = self.cluster.node_for_claim(claim.name)
         if node is None:
             return []
-        return [p for p in self.cluster.pods.values()
+        return [p for p in self.cluster.snapshot_pods()
                 if p.node_name == node.name and not p.is_daemonset]
 
     def _disruption_cost(self, claim: NodeClaim) -> float:
@@ -331,7 +331,7 @@ class DisruptionController:
         if consolidatable is None:
             consolidatable = self._consolidatable()
         return (
-            tuple(sorted((p.name, p.node_name or "") for p in self.cluster.pods.values())),
+            tuple(sorted((p.name, p.node_name or "") for p in self.cluster.snapshot_pods())),
             tuple(sorted(self.cluster.claims)),
             self.unavailable.seq_num,
             # a pricing refresh can turn a previously-unprofitable

@@ -25,17 +25,17 @@ LEAK_GRACE_SECONDS = 30.0  # garbagecollection/controller.go:64
 class GarbageCollectionController:
     def __init__(self, cluster: ClusterState, cloud_provider: CloudProvider,
                  recorder: Optional[Recorder] = None, clock: Optional[Clock] = None):
+        from ..utils.fanout import LazyPool
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.clock = clock or Clock()
         self.recorder = recorder or Recorder(self.clock)
+        self._pool = LazyPool(self.EXISTENCE_WORKERS, "gc-exists")
 
     # reference garbagecollection/controller.go:78 checks 100-way parallel
     EXISTENCE_WORKERS = 100
 
     def reconcile(self) -> None:
-        from ..utils.fanout import parallelize
-
         now = self.clock.now()
         claims = [c for c in list(self.cluster.claims.values())
                   if c.provider_id is not None]
@@ -50,7 +50,7 @@ class GarbageCollectionController:
             except NotFoundError:
                 return False
 
-        alive = parallelize(self.EXISTENCE_WORKERS, claims, exists)
+        alive = self._pool.run(claims, exists)
         for claim, ok in zip(claims, alive):
             if ok:
                 continue

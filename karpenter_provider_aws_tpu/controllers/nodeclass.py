@@ -105,7 +105,7 @@ class NodeClassController:
     def _finalize(self, nc: NodeClass) -> None:
         """Block until no claims reference the class, then clean the cloud
         side (controller.go:120-148)."""
-        in_use = any(c.node_class_ref == nc.name for c in self.cluster.claims.values())
+        in_use = any(c.node_class_ref == nc.name for c in self.cluster.snapshot_claims())
         if in_use:
             self.recorder.publish("Warning", "NodeClassDeleteBlocked", "NodeClass",
                                   nc.name, "nodeclaims still reference this class")

@@ -51,10 +51,12 @@ class InterruptionController:
         self._m_received = m["interruption_received"]
         self._m_deleted = m["interruption_deleted"]
         self._m_actions = m["interruption_actions"]
+        from ..utils.fanout import LazyPool
+        self._pool = LazyPool(self.MESSAGE_WORKERS, "interruption-msg")
 
     def _claims_by_instance_id(self) -> Dict[str, NodeClaim]:
         out: Dict[str, NodeClaim] = {}
-        for claim in self.cluster.claims.values():
+        for claim in self.cluster.snapshot_claims():
             if claim.provider_id:
                 out[parse_instance_id(claim.provider_id)] = claim
         return out
@@ -67,8 +69,6 @@ class InterruptionController:
         workqueue.ParallelizeUntil, controller.go:104). Returns messages
         handled; the at-least-once contract holds — a message is deleted
         only after its handler ran."""
-        from ..utils.fanout import parallelize
-
         msgs = self.queue.receive()
         if not msgs:
             return 0
@@ -83,7 +83,7 @@ class InterruptionController:
             self._m_deleted.inc()
             return 1
 
-        return sum(parallelize(self.MESSAGE_WORKERS, msgs, one))
+        return sum(self._pool.run(msgs, one))
 
     def _handle(self, msg: InterruptionMessage, claims_by_id: Dict[str, NodeClaim]) -> None:
         for iid in msg.instance_ids:

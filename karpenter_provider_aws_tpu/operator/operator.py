@@ -187,7 +187,7 @@ class Operator:
             self.run_once(force_provision=bool(self.cluster.pending_pods()))
             if not self.cluster.pending_pods() and all(
                     self.cluster.node_for_claim(c.name) is not None
-                    for c in self.cluster.claims.values() if not c.deletion_timestamp):
+                    for c in self.cluster.snapshot_claims() if not c.deletion_timestamp):
                 return i + 1
             self.clock.step(step)
         return max_rounds

@@ -64,11 +64,16 @@ class ControllerRuntime:
             t.start()
         return self
 
-    def stop(self, timeout: float = 5.0) -> None:
+    def stop(self, timeout: float = 5.0) -> bool:
+        """Signal every controller and join. Returns True when all threads
+        exited; a thread still blocked (e.g. mid device solve) past the
+        timeout stays tracked, so ``running`` keeps reporting True and a
+        caller can stop() again rather than proceed over live mutation."""
         self._stop.set()
         for t in self._threads:
             t.join(timeout)
-        self._threads = []
+        self._threads = [t for t in self._threads if t.is_alive()]
+        return not self._threads
 
     @property
     def running(self) -> bool:

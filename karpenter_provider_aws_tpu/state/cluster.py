@@ -187,6 +187,16 @@ class ClusterState:
                     return node
             return None
 
+    def snapshot_claims(self) -> List[NodeClaim]:
+        """Locked list copy — Python-level iteration over the raw dict can
+        raise mid-loop if a concurrent controller mutates it."""
+        with self._lock:
+            return list(self.claims.values())
+
+    def snapshot_pods(self) -> List[Pod]:
+        with self._lock:
+            return list(self.pods.values())
+
     def nodes_by_claim(self) -> Dict[str, Node]:
         """Snapshot index claim name -> node (one pass instead of an
         O(nodes) node_for_claim scan per claim)."""

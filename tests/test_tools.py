@@ -1,0 +1,56 @@
+"""Tools tests: the hack/docs + allocatable-diff analogs keep working
+(reference tools/allocatable-diff/main.go; hack/docs/*_gen_docs.go)."""
+
+import csv
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+
+class TestGenDocs:
+    def test_generates_all_reference_pages(self, tmp_path):
+        import gen_docs
+        rc = gen_docs.main(["--out-dir", str(tmp_path)])
+        assert rc == 0
+        types = (tmp_path / "instance-types.md").read_text()
+        assert "m5.large" in types and "Allocatable" in types
+        metrics = (tmp_path / "metrics.md").read_text()
+        assert "karpenter_nodeclaims_disrupted_total" in metrics
+        assert "karpenter_cloudprovider_instance_type_offering_available" in metrics
+        settings = (tmp_path / "settings.md").read_text()
+        assert "--cluster-name" in settings and "CLUSTER_NAME" in settings
+
+    def test_checked_in_docs_are_current(self):
+        """docs/reference/ must match what the generator produces (the
+        reference CI regenerates docs the same way)."""
+        import gen_docs
+        import tempfile
+        repo = Path(__file__).resolve().parent.parent
+        with tempfile.TemporaryDirectory() as td:
+            gen_docs.main(["--out-dir", td])
+            for page in ("instance-types.md", "metrics.md", "settings.md"):
+                fresh = (Path(td) / page).read_text()
+                checked_in = (repo / "docs" / "reference" / page).read_text()
+                assert fresh == checked_in, \
+                    f"docs/reference/{page} is stale — run tools/gen_docs.py"
+
+
+class TestAllocatableDiff:
+    def test_writes_csv_and_diffs_reported(self, tmp_path):
+        import allocatable_diff
+        reported = tmp_path / "reported.csv"
+        with open(reported, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["instance_type", "cpu_m", "memory_mib"])
+            w.writerow(["m5.large", "1930", "7000"])
+        out = tmp_path / "diff.csv"
+        rc = allocatable_diff.main(["--out-file", str(out),
+                                    "--reported", str(reported)])
+        assert rc == 0
+        rows = {r["instance_type"]: r for r in csv.DictReader(open(out))}
+        assert len(rows) > 700
+        m5 = rows["m5.large"]
+        assert "memory_diff_mib" in m5 and m5["reported_cpu_m"] == "1930"
+        # capacity >= allocatable always
+        assert float(m5["capacity_memory_mib"]) > float(m5["allocatable_memory_mib"])

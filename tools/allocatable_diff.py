@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""allocatable-diff: predicted vs reported node allocatable.
+
+Analog of reference tools/allocatable-diff/main.go — walks every instance
+type the lattice models, computes the framework's predicted capacity /
+allocatable (lattice/overhead.py math: VM overhead, kube+system reserved,
+eviction threshold, ENI-limited pods), and diffs against reported values
+when given (a CSV of node-status allocatable, or live nodes in a cluster
+state). The reference uses the diff to validate VM_MEMORY_OVERHEAD_PERCENT
+against real EC2 nodes; this does the same for the lattice formulas.
+
+Usage:
+  python tools/allocatable_diff.py --out-file allocatable-diff.csv \
+      [--overhead-percent 0.075] [--reported reported.csv]
+
+reported.csv columns: instance_type,cpu_m,memory_mib
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out-file", default="allocatable-diff.csv")
+    p.add_argument("--overhead-percent", type=float, default=0.075,
+                   help="VM memory overhead used for the prediction")
+    p.add_argument("--reported", default=None,
+                   help="CSV of reported allocatable "
+                        "(instance_type,cpu_m,memory_mib)")
+    args = p.parse_args(argv)
+
+    from karpenter_provider_aws_tpu.apis.resources import RESOURCE_AXES
+    from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+
+    lattice = build_lattice(
+        build_catalog(), vm_memory_overhead_percent=args.overhead_percent)
+    cpu_ax = RESOURCE_AXES.index("cpu")
+    mem_ax = RESOURCE_AXES.index("memory")
+    pods_ax = RESOURCE_AXES.index("pods")
+
+    reported = {}
+    if args.reported:
+        with open(args.reported) as f:
+            for row in csv.DictReader(f):
+                reported[row["instance_type"]] = (
+                    float(row["cpu_m"]), float(row["memory_mib"]))
+
+    rows = []
+    for i, name in enumerate(lattice.names):
+        cap, alloc = lattice.capacity[i], lattice.alloc[i]
+        row = {
+            "instance_type": name,
+            "capacity_cpu_m": f"{cap[cpu_ax]:.0f}",
+            "capacity_memory_mib": f"{cap[mem_ax]:.0f}",
+            "allocatable_cpu_m": f"{alloc[cpu_ax]:.0f}",
+            "allocatable_memory_mib": f"{alloc[mem_ax]:.0f}",
+            "max_pods": f"{alloc[pods_ax]:.0f}",
+        }
+        if name in reported:
+            rcpu, rmem = reported[name]
+            row["reported_cpu_m"] = f"{rcpu:.0f}"
+            row["reported_memory_mib"] = f"{rmem:.0f}"
+            row["cpu_diff_m"] = f"{alloc[cpu_ax] - rcpu:.0f}"
+            row["memory_diff_mib"] = f"{alloc[mem_ax] - rmem:.0f}"
+        rows.append(row)
+
+    fields = list(rows[0]) if not reported else list(
+        max(rows, key=len))
+    with open(args.out_file, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=fields)
+        w.writeheader()
+        w.writerows(rows)
+    print(f"wrote {len(rows)} instance types to {args.out_file}")
+    if reported:
+        import numpy as np
+        diffs = [float(r["memory_diff_mib"]) for r in rows
+                 if "memory_diff_mib" in r]
+        if diffs:
+            print(f"memory diff MiB: mean {np.mean(diffs):.1f} "
+                  f"max |{np.max(np.abs(diffs)):.1f}|")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

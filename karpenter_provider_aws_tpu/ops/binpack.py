@@ -36,6 +36,41 @@ import jax.numpy as jnp
 
 EPS = 1e-3
 
+# Finalization backend: the Pallas streaming kernel (ops/offering_argmin.py)
+# avoids the [B,T,Z,C] masked-price intermediate the XLA form materializes
+# (~185 MB at the 8192-bin bucket). Solver.__init__ probes the backend and
+# flips this before the first trace; pack() reads it at trace time.
+_PALLAS_ARGMIN = {"enabled": False, "interpret": False}
+
+
+def _clear_pack_caches() -> None:
+    # the flag binds at trace time; a toggle must invalidate every jitted
+    # entry point that read it, or same-shape calls keep the old trace
+    pack.clear_cache()
+    pack_packed.clear_cache()
+    pack_probe.clear_cache()
+
+
+def enable_pallas_argmin(interpret: bool = False) -> bool:
+    """Turn on the Pallas finalization if it lowers on this backend (or
+    unconditionally in interpreter mode, for tests). Returns enabled."""
+    from . import offering_argmin
+    if interpret or offering_argmin.probe():
+        if not _PALLAS_ARGMIN["enabled"] or \
+                _PALLAS_ARGMIN["interpret"] != interpret:
+            _clear_pack_caches()
+        _PALLAS_ARGMIN["enabled"] = True
+        _PALLAS_ARGMIN["interpret"] = interpret
+        return True
+    return False
+
+
+def disable_pallas_argmin() -> None:
+    if _PALLAS_ARGMIN["enabled"]:
+        _clear_pack_caches()
+    _PALLAS_ARGMIN["enabled"] = False
+    _PALLAS_ARGMIN["interpret"] = False
+
 
 class BinState(NamedTuple):
     """Scan carry: the open-bin table."""
@@ -274,19 +309,44 @@ def pack(alloc: jnp.ndarray, avail: jnp.ndarray, price: jnp.ndarray,
 
     # ---- finalization: cheapest available offering per new bin ----
     B = state.cum.shape[0]
-    p = jnp.where(avail, price, jnp.inf)                          # [T,Z,C]
-    p_bin = jnp.where(state.tmask[:, :, None, None]
-                      & state.zmask[:, None, :, None]
-                      & state.cmask[:, None, None, :],
-                      p[None, :, :, :], jnp.inf)                  # [B,T,Z,C]
-    flat = p_bin.reshape(B, -1)
-    best = jnp.argmin(flat, axis=1)
-    TZC = p.shape
-    chosen_t = (best // (TZC[1] * TZC[2])).astype(jnp.int32)
-    chosen_z = ((best // TZC[2]) % TZC[1]).astype(jnp.int32)
-    chosen_c = (best % TZC[2]).astype(jnp.int32)
     live = state.open & ~state.fixed & (state.npods > 0)
-    chosen_price = jnp.where(live, flat[jnp.arange(B), best], jnp.inf)
+    T, Z, C = price.shape
+    from .offering_argmin import _ZCP
+    # lattices with more than one lane tile of zone×captype combinations
+    # exceed the kernel's padded zc axis — use the XLA form there (the
+    # probe can't see this; it runs fixed small shapes)
+    if _PALLAS_ARGMIN["enabled"] and Z * C <= _ZCP:
+        from .offering_argmin import cheapest_offering_pallas
+        Tp = -(-T // 128) * 128
+        Bp = -(-B // 128) * 128
+        p2 = jnp.full((Tp, _ZCP), jnp.inf, jnp.float32)
+        p2 = p2.at[:T, : Z * C].set(
+            jnp.where(avail, price, jnp.inf).reshape(T, Z * C))
+        tm = jnp.zeros((Bp, Tp), jnp.float32)
+        tm = tm.at[:B, :T].set(state.tmask.astype(jnp.float32))
+        zc2 = (state.zmask[:, :, None] & state.cmask[:, None, :]
+               ).reshape(B, Z * C).astype(jnp.float32)
+        zc = jnp.zeros((Bp, _ZCP), jnp.float32).at[:B, : Z * C].set(zc2)
+        best_v, best_i = cheapest_offering_pallas(
+            tm, zc, p2, interpret=_PALLAS_ARGMIN["interpret"])
+        best_v, best_i = best_v[:B], best_i[:B]
+        chosen_t = (best_i // _ZCP).astype(jnp.int32)
+        rem = best_i % _ZCP
+        chosen_z = (rem // C).astype(jnp.int32)
+        chosen_c = (rem % C).astype(jnp.int32)
+        chosen_price = jnp.where(live, best_v, jnp.inf)
+    else:
+        p = jnp.where(avail, price, jnp.inf)                      # [T,Z,C]
+        p_bin = jnp.where(state.tmask[:, :, None, None]
+                          & state.zmask[:, None, :, None]
+                          & state.cmask[:, None, None, :],
+                          p[None, :, :, :], jnp.inf)              # [B,T,Z,C]
+        flat = p_bin.reshape(B, -1)
+        best = jnp.argmin(flat, axis=1)
+        chosen_t = (best // (Z * C)).astype(jnp.int32)
+        chosen_z = ((best // C) % Z).astype(jnp.int32)
+        chosen_c = (best % C).astype(jnp.int32)
+        chosen_price = jnp.where(live, flat[jnp.arange(B), best], jnp.inf)
 
     return PackResult(assign=assign, leftover=leftover, state=state,
                       chosen_t=chosen_t, chosen_z=chosen_z, chosen_c=chosen_c,

@@ -1,0 +1,147 @@
+"""Cheapest-offering finalization as a Pallas TPU kernel.
+
+The pack finalization answers, per bin: over every (type, zone,
+capacity-type) offering the bin's masks still allow, which is cheapest?
+The straightforward XLA form materializes a ``[B, T, Z, C]`` masked price
+tensor before the argmin — at the 8192-bin bucket over the full ~700-type
+lattice that is a ~185 MB HBM intermediate whose bandwidth dwarfs the
+actual reduction. This kernel streams it instead:
+
+- grid over 128-bin blocks; each block holds its ``[128, Tp]`` type mask,
+  its ``[128, 128]`` zone×capacity mask, and the shared ``[Tp, 128]``
+  price panel in VMEM,
+- a ``fori_loop`` over 128-type chunks builds only a ``[128, 128, 128]``
+  (8 MB) masked window per step on the VPU, folding a running
+  (min, argmin) carry — HBM traffic is exactly the inputs once,
+- ties resolve to the lowest flat index, matching ``jnp.argmin``.
+
+The price panel is pre-masked host-side: unavailable / non-offered /
+padded lanes carry ``+inf``. Flat index layout: ``t * 128 + z * C + c``
+(the zc axis is padded to the 128-lane tile).
+
+``interpret=True`` runs the same kernel on CPU (tests); ``probe()``
+compiles a tiny instance to decide availability on the current backend,
+so the solver can fall back to the XLA form anywhere Pallas cannot lower
+(see ops/binpack.py enable_pallas_argmin).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+_BB = 128     # bins per grid block
+_TC = 128     # types per reduction chunk (lane-aligned: Mosaic
+              # requires dynamic lane-dim offsets % 128 == 0)
+_ZCP = 128    # zone×captype axis padded to one lane tile
+
+
+def _kernel(tmask_ref, zcmask_ref, price_ref, best_v_ref, best_i_ref):
+    import jax.lax as lax
+    from jax.experimental import pallas as pl
+
+    zc = zcmask_ref[:]         # [BB, ZCP] f32 (0/1)
+    Tp = price_ref.shape[0]
+    inf = jnp.float32(jnp.inf)
+
+    def chunk(tc, carry):
+        best_v, best_i = carry                         # [BB], [BB] f32/i32
+        # slice the REFS per chunk (Mosaic lowers pl.ds ref reads; a
+        # dynamic_slice on a loaded value does not lower)
+        p = price_ref[pl.ds(tc * _TC, _TC), :]         # [TC, ZCP]
+        m = tmask_ref[:, pl.ds(tc * _TC, _TC)]         # [BB, TC]
+        cost = jnp.where((m[:, :, None] > 0) & (zc[:, None, :] > 0),
+                         p[None, :, :], inf)           # [BB,TC,ZCP]
+        flat = cost.reshape(_BB, _TC * _ZCP)
+        v = jnp.min(flat, axis=1)                      # [BB]
+        # explicit lowest-index tie-break: Mosaic's argmin lowering breaks
+        # ties high, jnp.argmin breaks low — pick the first match by hand
+        iota = lax.broadcasted_iota(jnp.int32, flat.shape, 1)
+        i = jnp.min(jnp.where(flat == v[:, None], iota,
+                              jnp.int32(2**31 - 1)), axis=1)
+        gi = tc * _TC * _ZCP + i
+        better = v < best_v                            # strict: first chunk
+        return (jnp.where(better, v, best_v),          # wins ties, matching
+                jnp.where(better, gi, best_i))         # jnp.argmin
+    n_chunks = Tp // _TC
+    v0 = jnp.full((_BB,), inf, jnp.float32)
+    i0 = jnp.zeros((_BB,), jnp.int32)
+    best_v, best_i = lax.fori_loop(0, n_chunks, chunk, (v0, i0))
+    g = pl.program_id(0)
+    best_v_ref[0, pl.ds(g * _BB, _BB)] = best_v
+    best_i_ref[0, pl.ds(g * _BB, _BB)] = best_i
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def cheapest_offering_pallas(tmask: jnp.ndarray, zcmask: jnp.ndarray,
+                             price: jnp.ndarray,
+                             interpret: bool = False):
+    """(best_price [B] f32, best_flat_idx [B] i32) per bin.
+
+    tmask  [B, Tp] f32 0/1 (Tp a multiple of 128)
+    zcmask [B, 128] f32 0/1 (zc = z*C + c in the first Z*C lanes)
+    price  [Tp, 128] f32, +inf where unavailable/padded
+    B must be a multiple of 128 (callers pad; see binpack.pack).
+    """
+    from jax.experimental import pallas as pl
+
+    B, Tp = tmask.shape
+    grid = (B // _BB,)
+    v2, i2 = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_BB, Tp), lambda i: (i, 0)),
+            pl.BlockSpec((_BB, _ZCP), lambda i: (i, 0)),
+            pl.BlockSpec((Tp, _ZCP), lambda i: (0, 0)),
+        ],
+        # outputs are one full-width [1, B] block shared by every grid
+        # step; each step writes its 128-lane slice (a flat [B] output's
+        # XLA layout tiles at T(1024) for large B, which a 128 block
+        # rejects, and a (1, 128) block violates the (8, 128) tile floor)
+        out_specs=[
+            pl.BlockSpec((1, B), lambda i: (0, 0)),
+            pl.BlockSpec((1, B), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, B), jnp.float32),
+            jax.ShapeDtypeStruct((1, B), jnp.int32),
+        ],
+        interpret=interpret,
+    )(tmask, zcmask, price)
+    return v2.reshape(B), i2.reshape(B)
+
+
+def cheapest_offering_xla(tmask, zcmask, price):
+    """Reference XLA form over the same padded layout (fallback + test
+    oracle). Materializes the [B, Tp, 128] intermediate."""
+    cost = jnp.where((tmask[:, :, None] > 0) & (zcmask[:, None, :] > 0),
+                     price[None, :, :], jnp.inf)
+    flat = cost.reshape(tmask.shape[0], -1)
+    best = jnp.argmin(flat, axis=1).astype(jnp.int32)
+    return jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0], best
+
+
+_PROBED: dict = {}
+
+
+def probe() -> bool:
+    """Can Pallas lower on the current default backend? Cached per
+    process. Never raises."""
+    backend = jax.default_backend()
+    if backend in _PROBED:
+        return _PROBED[backend]
+    try:
+        tm = jnp.ones((_BB, _TC * 2), jnp.float32)
+        zc = jnp.ones((_BB, _ZCP), jnp.float32)
+        pr = jnp.ones((_TC * 2, _ZCP), jnp.float32)
+        pr = pr.at[_TC + 1, 3].set(0.5)  # unique minimum in chunk 1
+        v, i = cheapest_offering_pallas(tm, zc, pr)
+        ok = (float(v[0]) == 0.5
+              and int(i[0]) == (_TC + 1) * _ZCP + 3)
+    except Exception:
+        ok = False
+    _PROBED[backend] = ok
+    return ok

@@ -211,6 +211,11 @@ class Solver:
 
     def __init__(self, lattice: Lattice):
         self.lattice = lattice
+        # probe-gated Pallas finalization: on a TPU backend the streaming
+        # cheapest-offering kernel replaces the [B,T,Z,C] XLA intermediate
+        # (ops/offering_argmin.py); anywhere it cannot lower, the probe
+        # fails once (cached) and the XLA form stays
+        binpack.enable_pallas_argmin()
         self._alloc = jnp.asarray(lattice.alloc)
         self._avail = jnp.asarray(lattice.available)
         self._price = jnp.asarray(lattice.price)

@@ -42,6 +42,10 @@ EPS = 1e-3
 # flips this before the first trace; pack() reads it at trace time.
 _PALLAS_ARGMIN = {"enabled": False, "interpret": False}
 
+# Bin-table floor below which the Pallas finalization is not worth its
+# compile time (see pack() finalization comment).
+_PALLAS_MIN_B = 4096
+
 
 def _clear_pack_caches() -> None:
     # the flag binds at trace time; a toggle must invalidate every jitted
@@ -314,8 +318,13 @@ def pack(alloc: jnp.ndarray, avail: jnp.ndarray, price: jnp.ndarray,
     from .offering_argmin import _ZCP
     # lattices with more than one lane tile of zone×captype combinations
     # exceed the kernel's padded zc axis — use the XLA form there (the
-    # probe can't see this; it runs fixed small shapes)
-    if _PALLAS_ARGMIN["enabled"] and Z * C <= _ZCP:
+    # probe can't see this; it runs fixed small shapes). Below
+    # _PALLAS_MIN_B bins the XLA intermediate is small enough that the two
+    # forms run identically (measured equal at B=1024) while the Mosaic
+    # trace adds ~20 s of compile per shape bucket — the kernel only pays
+    # at the large buckets (interpret mode bypasses the floor: tests).
+    if _PALLAS_ARGMIN["enabled"] and Z * C <= _ZCP and \
+            (_PALLAS_ARGMIN["interpret"] or B >= _PALLAS_MIN_B):
         from .offering_argmin import cheapest_offering_pallas
         Tp = -(-T // 128) * 128
         Bp = -(-B // 128) * 128
@@ -353,7 +362,7 @@ def pack(alloc: jnp.ndarray, avail: jnp.ndarray, price: jnp.ndarray,
                       chosen_price=chosen_price)
 
 
-def _encode_decode_set(res: PackResult) -> jnp.ndarray:
+def _encode_decode_set(res: PackResult, lean: bool = False) -> jnp.ndarray:
     """Fuse everything the host decode needs into ONE uint8 buffer.
 
     The host↔device link pays a ~fixed latency per transfer (measured
@@ -362,13 +371,21 @@ def _encode_decode_set(res: PackResult) -> jnp.ndarray:
     per-bin decode set into a [B+n_trailer, W] uint8 array so the host pays
     exactly one device→host round trip.
 
-    Row layout (per bin): npods i32 | np_id i32 | chosen_t i32 | chosen_z
-    i32 | chosen_c i32 | chosen_price f32 | open u8 | fixed u8 | packed
-    tmask | packed zmask | packed cmask | assign-column int16[G] | cum
-    f32[R] | alloc_cap f32[R] | pm int16[A] | packed po. Trailer rows:
+    Full row layout (per bin): npods i32 | np_id i32 | chosen_t i32 |
+    chosen_z i32 | chosen_c i32 | chosen_price f32 | open u8 | fixed u8 |
+    packed tmask | packed zmask | packed cmask | assign-column int16[G] |
+    cum f32[R] | alloc_cap f32[R] | pm int16[A] | packed po. Trailer rows:
     leftover int32[G] + next_open i32, zero-padded. Assignment counts and
     pm class counts fit int16: every pod consumes 1 of the node's bounded
     pod capacity, so per-bin counts stay well under 2^15.
+
+    ``lean`` keeps only what the single-device plan decode reads and
+    narrows the index dtypes — np_id i16 | chosen_t i16 | chosen_z u8 |
+    chosen_c u8 | chosen_price f32 | flags u8 (bit0 open, bit1 fixed) |
+    packed tmask | packed zmask | packed cmask | assign int16[G] — a ~33%
+    smaller transfer over the latency-bound link. The sharded tail-bin
+    merge needs cum/alloc_cap/pm/po to rebuild bin state and stays on the
+    full layout.
     """
     st = res.state
     B, _T = st.tmask.shape
@@ -377,24 +394,47 @@ def _encode_decode_set(res: PackResult) -> jnp.ndarray:
     def i32_rows(x):
         return jax.lax.bitcast_convert_type(x, jnp.uint8).reshape(B, -1)
 
-    rows = jnp.concatenate([
-        i32_rows(st.npods.astype(jnp.int32)),
-        i32_rows(st.np_id.astype(jnp.int32)),
-        i32_rows(res.chosen_t), i32_rows(res.chosen_z), i32_rows(res.chosen_c),
-        i32_rows(res.chosen_price),
-        st.open.astype(jnp.uint8)[:, None],
-        st.fixed.astype(jnp.uint8)[:, None],
-        jnp.packbits(st.tmask, axis=1),
-        jnp.packbits(st.zmask, axis=1),
-        jnp.packbits(st.cmask, axis=1),
-        jax.lax.bitcast_convert_type(
-            res.assign.astype(jnp.int16).T, jnp.uint8).reshape(B, -1),
-        i32_rows(st.cum),
-        i32_rows(st.alloc_cap),
-        jax.lax.bitcast_convert_type(
-            st.pm.astype(jnp.int16), jnp.uint8).reshape(B, -1),
-        jnp.packbits(st.po, axis=1),
-    ], axis=1)
+    def i16_rows(x):
+        return jax.lax.bitcast_convert_type(
+            x.astype(jnp.int16), jnp.uint8).reshape(B, -1)
+
+    if lean:
+        # narrow dtypes hold: T < 2^15 types, Z/C < 2^8 zones/captypes
+        assert _T < 2 ** 15 and st.zmask.shape[1] < 256 \
+            and st.cmask.shape[1] < 256
+        rows = jnp.concatenate([
+            i16_rows(st.np_id),
+            i16_rows(res.chosen_t),
+            res.chosen_z.astype(jnp.uint8)[:, None],
+            res.chosen_c.astype(jnp.uint8)[:, None],
+            i32_rows(res.chosen_price),
+            (st.open.astype(jnp.uint8)
+             | (st.fixed.astype(jnp.uint8) << 1))[:, None],
+            jnp.packbits(st.tmask, axis=1),
+            jnp.packbits(st.zmask, axis=1),
+            jnp.packbits(st.cmask, axis=1),
+            jax.lax.bitcast_convert_type(
+                res.assign.astype(jnp.int16).T, jnp.uint8).reshape(B, -1),
+        ], axis=1)
+    else:
+        rows = jnp.concatenate([
+            i32_rows(st.npods.astype(jnp.int32)),
+            i32_rows(st.np_id.astype(jnp.int32)),
+            i32_rows(res.chosen_t), i32_rows(res.chosen_z), i32_rows(res.chosen_c),
+            i32_rows(res.chosen_price),
+            st.open.astype(jnp.uint8)[:, None],
+            st.fixed.astype(jnp.uint8)[:, None],
+            jnp.packbits(st.tmask, axis=1),
+            jnp.packbits(st.zmask, axis=1),
+            jnp.packbits(st.cmask, axis=1),
+            jax.lax.bitcast_convert_type(
+                res.assign.astype(jnp.int16).T, jnp.uint8).reshape(B, -1),
+            i32_rows(st.cum),
+            i32_rows(st.alloc_cap),
+            jax.lax.bitcast_convert_type(
+                st.pm.astype(jnp.int16), jnp.uint8).reshape(B, -1),
+            jnp.packbits(st.po, axis=1),
+        ], axis=1)
     W = rows.shape[1]
     tail = jnp.concatenate([
         jax.lax.bitcast_convert_type(res.leftover.astype(jnp.int32), jnp.uint8).reshape(-1),
@@ -405,11 +445,16 @@ def _encode_decode_set(res: PackResult) -> jnp.ndarray:
     return jnp.concatenate([rows, flat.reshape(n_trailer, W)], axis=0)
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("lean",))
 def pack_packed(alloc: jnp.ndarray, avail: jnp.ndarray, price: jnp.ndarray,
-                groups: GroupBatch, pools: PoolParams, init: BinState) -> jnp.ndarray:
+                groups: GroupBatch, pools: PoolParams, init: BinState,
+                lean: bool = False) -> jnp.ndarray:
     """pack() + single-buffer result encoding (see _encode_decode_set)."""
-    return _encode_decode_set(pack(alloc, avail, price, groups, pools, init))
+    # lean narrows np_id to i16; the pool axis must fit (T/Z/C bounds are
+    # asserted inside the encoder, where their shapes are visible)
+    assert not lean or pools.np_type.shape[0] < 2 ** 15
+    return _encode_decode_set(pack(alloc, avail, price, groups, pools, init),
+                              lean=lean)
 
 
 class ProbeSummary(NamedTuple):

@@ -114,7 +114,6 @@ class _DecodeSet:
 
     assign: np.ndarray        # [G,B] i32
     leftover: np.ndarray      # [G] i32
-    npods: np.ndarray         # [B] i32
     np_id: np.ndarray         # [B] i32
     open: np.ndarray          # [B] bool
     fixed: np.ndarray         # [B] bool
@@ -125,11 +124,14 @@ class _DecodeSet:
     tmask_p: np.ndarray       # [B,ceil(T/8)] u8 packed
     zmask_p: np.ndarray       # [B,ceil(Z/8)] u8 packed
     cmask_p: np.ndarray       # [B,ceil(C/8)] u8 packed
-    cum: np.ndarray           # [B,R] f32
-    alloc_cap: np.ndarray     # [B,R] f32
-    pm: np.ndarray            # [B,A] i32
-    po: np.ndarray            # [B,A] bool
     next_open: int
+    # full-layout-only fields (the sharded tail-bin merge rebuilds bin
+    # state from these; the lean single-device decode never reads them)
+    npods: Optional[np.ndarray] = None      # [B] i32
+    cum: Optional[np.ndarray] = None        # [B,R] f32
+    alloc_cap: Optional[np.ndarray] = None  # [B,R] f32
+    pm: Optional[np.ndarray] = None         # [B,A] i32
+    po: Optional[np.ndarray] = None         # [B,A] bool
 
     def tmask(self, rows, T: int) -> np.ndarray:
         return np.unpackbits(self.tmask_p[rows], axis=1)[:, :T].astype(bool)
@@ -142,8 +144,8 @@ class _DecodeSet:
 
 
 def _unpack_decode_set(buf: np.ndarray, G: int, T: int, Z: int, C: int,
-                       A: int) -> _DecodeSet:
-    """Inverse of ops/binpack.py _encode_decode_set (row layout there)."""
+                       A: int, lean: bool = False) -> _DecodeSet:
+    """Inverse of ops/binpack.py _encode_decode_set (row layouts there)."""
     Tp, Zp, Cp, Ap = (T + 7) // 8, (Z + 7) // 8, (C + 7) // 8, (A + 7) // 8
     W = buf.shape[1]
     n_trailer = -(-(4 * G + 4) // W)
@@ -153,16 +155,39 @@ def _unpack_decode_set(buf: np.ndarray, G: int, T: int, Z: int, C: int,
     def col_i32(off: int) -> np.ndarray:
         return np.ascontiguousarray(rows[:, off: off + 4]).view(np.int32).ravel()
 
+    def col_i16(off: int) -> np.ndarray:
+        return (np.ascontiguousarray(rows[:, off: off + 2])
+                .view(np.int16).ravel().astype(np.int32))
+
     def block_f32(off: int, n: int) -> np.ndarray:
         return np.ascontiguousarray(rows[:, off: off + 4 * n]).view(np.float32)
+
+    trailer = np.ascontiguousarray(buf[B:]).reshape(-1)
+    leftover = np.ascontiguousarray(trailer[: 4 * G]).view(np.int32).copy()
+    next_open = int(np.ascontiguousarray(trailer[4 * G: 4 * G + 4]).view(np.int32)[0])
+
+    if lean:
+        o = 11 + Tp + Zp + Cp
+        flags = rows[:, 10]
+        return _DecodeSet(
+            assign=(np.ascontiguousarray(rows[:, o: o + 2 * G])
+                    .view(np.int16).astype(np.int32).T),
+            leftover=leftover,
+            np_id=col_i16(0), chosen_t=col_i16(2),
+            chosen_z=rows[:, 4].astype(np.int32),
+            chosen_c=rows[:, 5].astype(np.int32),
+            chosen_price=np.ascontiguousarray(rows[:, 6:10]).view(np.float32).ravel(),
+            open=(flags & 1).astype(bool), fixed=(flags & 2).astype(bool),
+            tmask_p=rows[:, 11: 11 + Tp],
+            zmask_p=rows[:, 11 + Tp: 11 + Tp + Zp],
+            cmask_p=rows[:, 11 + Tp + Zp: o],
+            next_open=next_open,
+        )
 
     o = 26 + Tp + Zp + Cp
     assign = (np.ascontiguousarray(rows[:, o: o + 2 * G])
               .view(np.int16).astype(np.int32).T)            # [G,B]
     oc = o + 2 * G
-    trailer = np.ascontiguousarray(buf[B:]).reshape(-1)
-    leftover = np.ascontiguousarray(trailer[: 4 * G]).view(np.int32).copy()
-    next_open = int(np.ascontiguousarray(trailer[4 * G: 4 * G + 4]).view(np.int32)[0])
     return _DecodeSet(
         assign=assign, leftover=leftover,
         npods=col_i32(0), np_id=col_i32(4),
@@ -548,13 +573,15 @@ class Solver:
         while True:
             init = self._init_state(problem, B)
             td = time.perf_counter()
-            # one fused buffer = one device→host transfer (sync included)
+            # one fused buffer = one device→host transfer (sync included);
+            # lean layout: the plan decode never reads cum/alloc_cap/pm/po
             with self._trace_span("solver.pack"):
                 buf = np.asarray(binpack.pack_packed(
-                    self._alloc, avail, price, groups, pools, init))
+                    self._alloc, avail, price, groups, pools, init,
+                    lean=True))
             device_s = time.perf_counter() - td
             dec = _unpack_decode_set(buf, G, lat.T, lat.Z, lat.C,
-                                     max(problem.A, 1))
+                                     max(problem.A, 1), lean=True)
             overflowed = (dec.leftover.sum() > 0) and dec.next_open >= B
             if overflowed:
                 B, grew = _grow_bucket(B)

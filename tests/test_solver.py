@@ -349,6 +349,39 @@ class TestReviewRegressions:
         assert any("not supported" in w for w in plan.warnings)
 
 
+class TestLeanDecodeBuffer:
+    def test_lean_layout_matches_full(self, solver, lattice):
+        """The lean single-device result buffer (ops/binpack.py
+        _encode_decode_set lean=True) decodes to exactly the fields the
+        full layout carries, at ~2/3 the transfer size."""
+        from karpenter_provider_aws_tpu.ops import binpack
+        from karpenter_provider_aws_tpu.solver import solve as sm
+
+        pods = generic_pods(40) + [
+            Pod(name=f"c-{i}", requests={"cpu": "2", "memory": "4Gi"},
+                node_selector={wk.LABEL_INSTANCE_CATEGORY: "c"})
+            for i in range(10)]
+        problem = build_problem(pods, [default_pool()], lattice)
+        G = sm._bucket(problem.G, sm._G_BUCKETS)
+        groups = solver._padded_groups(problem, G)
+        pools = solver._pool_params(problem)
+        init = solver._init_state(problem, 128)
+        avail, price = solver._device_avail_price(problem)
+        args = (solver._alloc, avail, price, groups, pools, init)
+        full = np.asarray(binpack.pack_packed(*args))
+        lean = np.asarray(binpack.pack_packed(*args, lean=True))
+        df = sm._unpack_decode_set(full, G, lattice.T, lattice.Z, lattice.C, 1)
+        dl = sm._unpack_decode_set(lean, G, lattice.T, lattice.Z, lattice.C, 1,
+                                   lean=True)
+        for f in ("assign", "leftover", "np_id", "chosen_t", "chosen_z",
+                  "chosen_c", "chosen_price", "tmask_p", "zmask_p",
+                  "cmask_p", "open", "fixed"):
+            np.testing.assert_array_equal(getattr(df, f), getattr(dl, f), f)
+        assert dl.next_open == df.next_open
+        assert dl.cum is None and dl.alloc_cap is None and dl.pm is None
+        assert lean.nbytes < 0.75 * full.nbytes
+
+
 class TestNativeReferee:
     """Parity between the native C++ FFD referee and the Python oracle."""
 

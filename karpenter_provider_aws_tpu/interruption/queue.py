@@ -26,34 +26,33 @@ class QueueMessage:
 
 class FakeQueue:
     """In-memory queue with SQS receive/delete semantics (at-least-once:
-    received messages stay until deleted)."""
+    received messages stay until deleted). Backed by one insertion-ordered
+    dict so receive (oldest first) and delete are O(batch)/O(1) — a
+    15k-message drain (the reference's interruption benchmark depth,
+    interruption_benchmark_test.go:61-75) must not go quadratic on the
+    queue itself."""
 
     def __init__(self, name: str = "interruption-queue"):
         self.name = name
         self._lock = threading.Lock()
         self._ids = itertools.count(1)
         self._messages: Dict[str, QueueMessage] = {}
-        self._order: List[str] = []
 
     def send(self, body: Dict) -> str:
         with self._lock:
             mid = f"m-{next(self._ids):06d}"
             self._messages[mid] = QueueMessage(id=mid, body=body, receipt_handle=mid)
-            self._order.append(mid)
             return mid
 
     def receive(self, max_messages: int = MAX_MESSAGES) -> List[QueueMessage]:
         """Non-blocking receive (the sim loop polls; a live deployment
         long-polls for WAIT_TIME_SECONDS)."""
         with self._lock:
-            return [self._messages[m] for m in self._order[:max_messages]
-                    if m in self._messages]
+            return list(itertools.islice(self._messages.values(), max_messages))
 
     def delete(self, receipt_handle: str) -> None:
         with self._lock:
             self._messages.pop(receipt_handle, None)
-            if receipt_handle in self._order:
-                self._order.remove(receipt_handle)
 
     def __len__(self) -> int:
         with self._lock:
@@ -62,4 +61,3 @@ class FakeQueue:
     def reset(self) -> None:
         with self._lock:
             self._messages.clear()
-            self._order.clear()

@@ -398,6 +398,14 @@ def _encode_decode_set(res: PackResult, lean: bool = False) -> jnp.ndarray:
         return jax.lax.bitcast_convert_type(
             x.astype(jnp.int16), jnp.uint8).reshape(B, -1)
 
+    # segment shared by both layouts (and by both sides of the decoder)
+    masks_assign = [
+        jnp.packbits(st.tmask, axis=1),
+        jnp.packbits(st.zmask, axis=1),
+        jnp.packbits(st.cmask, axis=1),
+        jax.lax.bitcast_convert_type(
+            res.assign.astype(jnp.int16).T, jnp.uint8).reshape(B, -1),
+    ]
     if lean:
         # narrow dtypes hold: T < 2^15 types, Z/C < 2^8 zones/captypes
         assert _T < 2 ** 15 and st.zmask.shape[1] < 256 \
@@ -410,12 +418,7 @@ def _encode_decode_set(res: PackResult, lean: bool = False) -> jnp.ndarray:
             i32_rows(res.chosen_price),
             (st.open.astype(jnp.uint8)
              | (st.fixed.astype(jnp.uint8) << 1))[:, None],
-            jnp.packbits(st.tmask, axis=1),
-            jnp.packbits(st.zmask, axis=1),
-            jnp.packbits(st.cmask, axis=1),
-            jax.lax.bitcast_convert_type(
-                res.assign.astype(jnp.int16).T, jnp.uint8).reshape(B, -1),
-        ], axis=1)
+        ] + masks_assign, axis=1)
     else:
         rows = jnp.concatenate([
             i32_rows(st.npods.astype(jnp.int32)),
@@ -424,11 +427,7 @@ def _encode_decode_set(res: PackResult, lean: bool = False) -> jnp.ndarray:
             i32_rows(res.chosen_price),
             st.open.astype(jnp.uint8)[:, None],
             st.fixed.astype(jnp.uint8)[:, None],
-            jnp.packbits(st.tmask, axis=1),
-            jnp.packbits(st.zmask, axis=1),
-            jnp.packbits(st.cmask, axis=1),
-            jax.lax.bitcast_convert_type(
-                res.assign.astype(jnp.int16).T, jnp.uint8).reshape(B, -1),
+        ] + masks_assign + [
             i32_rows(st.cum),
             i32_rows(st.alloc_cap),
             jax.lax.bitcast_convert_type(

@@ -201,3 +201,35 @@ class TestMetricsSurface:
         assert "# TYPE karpenter_provisioner_batch_size histogram" in text
         assert "# TYPE karpenter_pods_scheduled_total counter" in text
         assert "# TYPE karpenter_cluster_state_node_count gauge" in text
+
+
+class TestThroughputHarness:
+    """The reference benches its interruption path at 100/1k/5k/15k queue
+    depths (interruption_benchmark_test.go:61-75); tools/bench_interruption.py
+    is that harness. This exercises it at depth 2000 and guards against the
+    queue or controller going quadratic on deep drains."""
+
+    def test_drain_2000_messages(self):
+        import sys
+        from pathlib import Path
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+        from tools.bench_interruption import build_env, drain, seed_messages
+        from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+
+        lattice = build_lattice([s for s in build_catalog()
+                                 if s.family in ("m5", "c5")])
+        env = build_env(lattice)
+        seed_messages(env, 2000)
+        import time
+        t0 = time.perf_counter()
+        handled = drain(env)
+        wall = time.perf_counter() - t0
+        assert handled == 2000
+        assert len(env.interruption_queue) == 0
+        # every spot interruption for a spot claim marked the pool ICE
+        assert sum(1 for _ in env.unavailable.entries()) > 0
+        # all received+deleted accounted in the metric surface
+        assert env.metrics.get(
+            "karpenter_interruption_deleted_messages_total").value() == 2000
+        # quadratic drains land in the tens of seconds; a healthy one is <2s
+        assert wall < 10.0, f"drain took {wall:.1f}s"

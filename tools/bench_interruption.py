@@ -1,0 +1,120 @@
+"""Interruption-throughput benchmark.
+
+Analog of the reference's interruption benchmark (reference
+pkg/controllers/interruption/interruption_benchmark_test.go:61-75: drain
+100 / 1k / 5k / 15k SQS messages through the controller, measuring
+messages/sec). Here the queue is the in-memory FakeQueue with the same
+receive-10 / delete-on-handled semantics, the claims are registered spot
+capacity, and the message mix exercises all four parsed schemas (spot
+interruption, rebalance recommendation, scheduled change, instance
+state-change).
+
+Usage: python tools/bench_interruption.py [depths...]
+Prints one JSON line per depth: messages/sec through a full
+receive→parse→handle→delete drain, plus handled/ICE'd counts.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from karpenter_provider_aws_tpu.apis import NodePool  # noqa: E402
+from karpenter_provider_aws_tpu.apis.objects import NodeClaim, NodeClaimPhase  # noqa: E402
+from karpenter_provider_aws_tpu.cloud import FakeCloud  # noqa: E402
+from karpenter_provider_aws_tpu.interruption import (  # noqa: E402
+    FakeQueue, rebalance_recommendation, scheduled_change, spot_interruption,
+    state_change,
+)
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice  # noqa: E402
+from karpenter_provider_aws_tpu.operator import Operator, Options  # noqa: E402
+from karpenter_provider_aws_tpu.utils.clock import FakeClock  # noqa: E402
+
+DEPTHS = (100, 1_000, 5_000, 15_000)
+N_CLAIMS = 200
+
+
+def build_env(lattice):
+    clock = FakeClock()
+    queue = FakeQueue("bench-interruptions")
+    env = Operator(options=Options(), lattice=lattice, cloud=FakeCloud(clock),
+                   clock=clock, node_pools=[NodePool(name="default")],
+                   interruption_queue=queue)
+    zones = lattice.zones
+    for i in range(N_CLAIMS):
+        env.cluster.add_claim(NodeClaim(
+            name=f"claim-{i}", node_pool="default",
+            phase=NodeClaimPhase.INITIALIZED,
+            provider_id=f"fake:///{zones[i % len(zones)]}/i-{i:08x}",
+            instance_type="m5.xlarge", zone=zones[i % len(zones)],
+            capacity_type="spot"))
+    return env
+
+
+def seed_messages(env, depth: int) -> None:
+    """Round-robin message mix over the claim fleet: 70% spot interruption,
+    10% each rebalance / scheduled change / state change (the reference's
+    four EventBridge schemas)."""
+    for i in range(depth):
+        iid = f"i-{i % N_CLAIMS:08x}"
+        r = i % 10
+        if r < 7:
+            body = spot_interruption(iid)
+        elif r == 7:
+            body = rebalance_recommendation(iid)
+        elif r == 8:
+            body = scheduled_change(iid)
+        else:
+            body = state_change(iid, "stopping")
+        env.interruption_queue.send(body)
+
+
+def drain(env) -> int:
+    """reconcile() until the queue is empty; returns messages handled."""
+    handled = 0
+    while len(env.interruption_queue):
+        n = env.interruption.reconcile()
+        if n == 0:
+            break
+        handled += n
+    return handled
+
+
+def run(depth: int, lattice) -> dict:
+    env = build_env(lattice)
+    seed_messages(env, depth)
+    t0 = time.perf_counter()
+    handled = drain(env)
+    wall = time.perf_counter() - t0
+    ice = sum(1 for _ in env.unavailable.entries())
+    return {
+        "metric": f"interruption_throughput_{depth}",
+        "value": round(handled / wall, 1),
+        "unit": "msgs/sec",
+        "detail": {
+            "messages": depth,
+            "handled": handled,
+            "remaining": len(env.interruption_queue),
+            "wall_ms": round(wall * 1000.0, 1),
+            "ice_entries": ice,
+            "claims_drained": sum(
+                1 for c in env.cluster.snapshot_claims()
+                if c.deletion_timestamp is not None),
+        },
+    }
+
+
+def main() -> None:
+    depths = [int(a) for a in sys.argv[1:]] or list(DEPTHS)
+    lattice = build_lattice([s for s in build_catalog()
+                             if s.family in ("m5", "c5", "r5")])
+    for depth in depths:
+        print(json.dumps(run(depth, lattice)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

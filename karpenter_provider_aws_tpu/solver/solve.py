@@ -976,11 +976,15 @@ class Solver:
                 po=jnp.asarray(s_po), next_open=jnp.array(E + K, jnp.int32),
             )
             td = time.perf_counter()
-            result = binpack.pack(self._alloc, avail, price, groups, pools, init)
-            result.assign.block_until_ready()
+            # same single fused transfer as the primary solve (the merge
+            # runs on the same latency-bound link as the sharded pack)
+            buf = np.asarray(binpack.pack_packed(
+                self._alloc, avail, price, groups, pools, init, lean=True))
             device_s += time.perf_counter() - td
-            leftover2 = np.asarray(result.leftover)
-            overflowed = (leftover2.sum() > 0) and int(result.state.next_open) >= B2
+            mdec = _unpack_decode_set(buf, G, lat.T, lat.Z, lat.C, A,
+                                      lean=True)
+            leftover2 = mdec.leftover
+            overflowed = (leftover2.sum() > 0) and mdec.next_open >= B2
             if overflowed:
                 B2, grew = _grow_bucket(B2)
                 if grew:
@@ -988,18 +992,18 @@ class Solver:
             break
 
         # -- decode the merged table
-        assign2 = np.asarray(result.assign)
-        m_np_id = np.asarray(result.state.np_id)
-        m_tm = np.asarray(result.state.tmask)
-        m_zm = np.asarray(result.state.zmask)
-        m_cm = np.asarray(result.state.cmask)
-        m_ct = np.asarray(result.chosen_t)
-        m_cz = np.asarray(result.chosen_z)
-        m_cc = np.asarray(result.chosen_c)
-        m_cp = np.asarray(result.chosen_price)
-        m_open = np.asarray(result.state.open)
-        m_fixed = np.asarray(result.state.fixed)
-        m_npods = np.asarray(result.state.npods)
+        all_rows = np.arange(B2)
+        assign2 = mdec.assign
+        m_np_id = mdec.np_id
+        m_tm = mdec.tmask(all_rows, lat.T)
+        m_zm = mdec.zmask(all_rows, lat.Z)
+        m_cm = mdec.cmask(all_rows, lat.C)
+        m_ct = mdec.chosen_t
+        m_cz = mdec.chosen_z
+        m_cc = mdec.chosen_c
+        m_cp = mdec.chosen_price
+        m_open = mdec.open
+        m_fixed = mdec.fixed
 
         assigns = {k: list(v) for k, v in existing_assignments.items()}
         unsched = dict(unschedulable)
@@ -1044,7 +1048,11 @@ class Solver:
             for name in pool[cursor: cursor + int(leftover2[gi])]:
                 unsched[name] = "does not fit any existing node or new-node shape"
 
-        live_rows = np.nonzero(m_open & ~m_fixed & (m_npods > 0))[0]
+        # any remaining open new bin that took merge pods (kept bins already
+        # materialized above; the lean buffer has no npods, but merge-added
+        # pods are exactly the assign2 columns)
+        live_rows = np.nonzero(m_open & ~m_fixed
+                               & (assign2[: problem.G].sum(axis=0) > 0))[0]
         for row in live_rows:
             node_at(int(row))
         new_nodes = [node_for_row[r] for r in sorted(node_for_row)

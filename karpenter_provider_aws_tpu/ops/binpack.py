@@ -383,9 +383,10 @@ def _encode_decode_set(res: PackResult, lean: bool = False) -> jnp.ndarray:
     narrows the index dtypes — np_id i16 | chosen_t i16 | chosen_z u8 |
     chosen_c u8 | chosen_price f32 | flags u8 (bit0 open, bit1 fixed) |
     packed tmask | packed zmask | packed cmask | assign int16[G] — a ~33%
-    smaller transfer over the latency-bound link. The sharded tail-bin
-    merge needs cum/alloc_cap/pm/po to rebuild bin state and stays on the
-    full layout.
+    smaller transfer over the latency-bound link. Only the per-shard
+    decode of a sharded pack (decode_sharded_pack) still needs the full
+    layout: its tail-bin merge rebuilds bin state from cum/alloc_cap/pm/po
+    of the SHARD results (the merge's own result is lean again).
     """
     st = res.state
     B, _T = st.tmask.shape

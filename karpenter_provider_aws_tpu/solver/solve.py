@@ -992,12 +992,8 @@ class Solver:
             break
 
         # -- decode the merged table
-        all_rows = np.arange(B2)
         assign2 = mdec.assign
         m_np_id = mdec.np_id
-        m_tm = mdec.tmask(all_rows, lat.T)
-        m_zm = mdec.zmask(all_rows, lat.Z)
-        m_cm = mdec.cmask(all_rows, lat.C)
         m_ct = mdec.chosen_t
         m_cz = mdec.chosen_z
         m_cc = mdec.chosen_c
@@ -1012,8 +1008,12 @@ class Solver:
         def node_at(row: int) -> PlannedNode:
             node = node_for_row.get(row)
             if node is None:
+                # masks unpack per materialized node only — B2 can be
+                # thousands of rows with a handful of live merge bins
+                rows1 = np.array([row])
                 ftypes, fzones, fcaps = self._feasible_sets(
-                    problem, m_tm[row], m_zm[row], m_cm[row])
+                    problem, mdec.tmask(rows1, lat.T)[0],
+                    mdec.zmask(rows1, lat.Z)[0], mdec.cmask(rows1, lat.C)[0])
                 node = PlannedNode(
                     node_pool=problem.node_pools[int(m_np_id[row])].name,
                     instance_type=lat.names[int(m_ct[row])],

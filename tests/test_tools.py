@@ -29,7 +29,8 @@ class TestGenDocs:
         repo = Path(__file__).resolve().parent.parent
         with tempfile.TemporaryDirectory() as td:
             gen_docs.main(["--out-dir", td])
-            for page in ("instance-types.md", "metrics.md", "settings.md"):
+            for page in ("instance-types.md", "metrics.md", "settings.md",
+                         "compatibility.md"):
                 fresh = (Path(td) / page).read_text()
                 checked_in = (repo / "docs" / "reference" / page).read_text()
                 assert fresh == checked_in, \
@@ -54,3 +55,47 @@ class TestAllocatableDiff:
         assert "memory_diff_mib" in m5 and m5["reported_cpu_m"] == "1930"
         # capacity >= allocatable always
         assert float(m5["capacity_memory_mib"]) > float(m5["allocatable_memory_mib"])
+
+
+class TestKompat:
+    """tools/kompat.py — the reference tools/kompat analog: matrix render,
+    validation lints, and the app↔k8s compatibility check."""
+
+    def test_render_and_validate_shipped_matrix(self):
+        import kompat
+        name, rows = kompat.load_matrix()
+        assert name == "karpenter-tpu" and rows
+        assert kompat.validate(rows) == []
+        md = kompat.render(name, rows)
+        assert "KARPENTER-TPU" in md and "Kubernetes" in md
+        assert f"{rows[0].min_k8s[0]}.{rows[0].min_k8s[1]}" in md
+
+    def test_check_inside_and_outside_range(self):
+        import kompat
+        _, rows = kompat.load_matrix()
+        lo, hi = rows[0].min_k8s, rows[0].max_k8s
+        assert kompat.check(rows, "0.1.0", f"{lo[0]}.{lo[1]}") is not None
+        assert kompat.check(rows, "0.1.0", f"{hi[0]}.{hi[1] + 1}") is None
+        # wildcard pattern matching: 0.1.x covers any 0.1.* but not 0.2.*
+        assert kompat.check(rows, "0.1.7", f"{lo[0]}.{lo[1]}") is not None
+        assert kompat.check(rows, "0.2.0", f"{lo[0]}.{lo[1]}") is None
+
+    def test_validate_flags_bad_ranges(self):
+        import kompat
+        bad = [kompat.Row("0.1.x", (1, 28), (1, 26))]
+        assert kompat.validate(bad)
+        regress = [kompat.Row("0.1.x", (1, 24), (1, 28)),
+                   kompat.Row("0.2.x", (1, 24), (1, 27))]
+        assert any("regressed" in e for e in kompat.validate(regress))
+
+    def test_version_provider_pairs_with_matrix(self):
+        """The live control-plane version the version provider discovers
+        must be accepted by the shipped matrix (the operator's pre-flight
+        check an operator would run)."""
+        import kompat
+        from karpenter_provider_aws_tpu.cloud import FakeCloud
+        from karpenter_provider_aws_tpu.providers.version import VersionProvider
+        from karpenter_provider_aws_tpu.utils.clock import FakeClock
+        v = VersionProvider(FakeCloud(FakeClock())).get()
+        _, rows = kompat.load_matrix()
+        assert kompat.check(rows, "0.1.0", v) is not None, v

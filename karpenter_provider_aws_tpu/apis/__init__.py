@@ -17,6 +17,7 @@ from .objects import (
     NodeClassSelectorTerm,
     PersistentVolumeClaim,
     StorageClass,
+    PodDisruptionBudget,
     NodeClass,
     NodeClaim,
     Node,
@@ -30,5 +31,5 @@ __all__ = [
     "relax_pod", "relaxation_depth", "Pod",
     "NodePoolDisruption", "DisruptionBudget", "NodePool",
     "NodeClassSelectorTerm", "NodeClass", "NodeClaim", "Node",
-    "PersistentVolumeClaim", "StorageClass",
+    "PersistentVolumeClaim", "StorageClass", "PodDisruptionBudget",
 ]

@@ -123,10 +123,33 @@ class PreferredRequirement:
 
 
 @dataclass
+class PodDisruptionBudget:
+    """Voluntary-eviction budget over a labelled pod set (the Kubernetes
+    policy/v1 object the reference's drain respects — reference
+    concepts/disruption.md:33 "evicting the pods ... to respect PDBs"
+    and :112, the `pdb ... prevents pod evictions` Unconsolidatable
+    event). Exactly one of max_unavailable / min_available should be set
+    (as in Kubernetes); when both are, the tighter rule wins."""
+
+    name: str
+    label_selector: Dict[str, str] = field(default_factory=dict)
+    max_unavailable: Optional[int] = None
+    min_available: Optional[int] = None
+    namespace: str = "default"
+
+    def matches(self, pod: "Pod") -> bool:
+        if pod.namespace != self.namespace:
+            return False
+        return all(pod.labels.get(k) == v
+                   for k, v in self.label_selector.items())
+
+
+@dataclass
 class Pod:
     name: str
     namespace: str = "default"
     labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
     requests: Dict[str, "str | int | float"] = field(default_factory=dict)
     node_selector: Dict[str, str] = field(default_factory=dict)
     required_affinity: List[Requirement] = field(default_factory=list)  # nodeAffinity required terms

@@ -49,6 +49,7 @@ def pod_to_dict(p: Pod) -> Dict:
         "name": p.name,
         "namespace": p.namespace,
         "labels": dict(p.labels),
+        "annotations": dict(p.annotations),
         "requests": {k: str(v) for k, v in p.requests.items()},
         "nodeSelector": dict(p.node_selector),
         "requiredAffinity": [requirement_to_dict(r) for r in p.required_affinity],
@@ -81,6 +82,7 @@ def pod_from_dict(d: Mapping) -> Pod:
         name=d["name"],
         namespace=d.get("namespace", "default"),
         labels=dict(d.get("labels", {})),
+        annotations=dict(d.get("annotations", {})),
         requests=dict(d.get("requests", {})),
         node_selector=dict(d.get("nodeSelector", {})),
         required_affinity=[requirement_from_dict(r)

@@ -61,6 +61,9 @@ ANNOTATION_NODECLASS_HASH_VERSION = f"{PROVIDER_PREFIX}/nodeclass-hash-version"
 ANNOTATION_NODEPOOL_HASH = f"{KARPENTER_PREFIX}/nodepool-hash"
 ANNOTATION_NODEPOOL_HASH_VERSION = f"{KARPENTER_PREFIX}/nodepool-hash-version"
 ANNOTATION_INSTANCE_TAGGED = f"{KARPENTER_PREFIX}/instance-tagged"
+# pod/node/NodePool opt-out from voluntary disruption (reference
+# website concepts/disruption.md:253,282,294)
+ANNOTATION_DO_NOT_DISRUPT = f"{KARPENTER_PREFIX}/do-not-disrupt"
 TAG_NAME = "Name"
 TAG_NODECLAIM = f"{KARPENTER_PREFIX}/nodeclaim"
 

@@ -98,6 +98,9 @@ class DisruptionController:
         # budget-truncated pass (so repeat passes verify NEW candidates
         # instead of deterministically repeating the same window)
         self._scan_cursor = 0
+        # (node, pdb) pairs whose Unconsolidatable event already published
+        # for the current blockage episode (see _candidates)
+        self._pdb_blocked_logged: set = set()
 
     # one batched probe covers the prefix ladder + single-node scan; caps
     # bound the padded K bucket (solver.Solver._K_BUCKETS)
@@ -130,9 +133,20 @@ class DisruptionController:
 
     def _candidates(self) -> List[NodeClaim]:
         """Initialized, healthy, not-already-disrupting claims with a
-        registered node."""
+        registered node. Voluntary-disruption opt-outs are respected here:
+        a `karpenter.sh/do-not-disrupt` annotation on the claim (NodePool
+        template annotations land there), on the node, or on any of its
+        pods removes the node from candidacy (reference
+        disruption.md:253,282,294), and so does a pod whose
+        PodDisruptionBudgets currently allow zero evictions (the
+        `pdb ... prevents pod evictions` Unconsolidatable condition,
+        disruption.md:112)."""
         in_flight = {n for a in self._in_flight for n in a.claims}
         node_by_claim = self.cluster.nodes_by_claim()
+        pods_by_node = self.cluster.pods_by_node(include_daemonsets=False)
+        # allowance is node-independent: one sweep for the whole pass
+        zero_pdbs = self.cluster.zero_allowance_pdbs()
+        blocked_now: set = set()
         out = []
         for claim in self.cluster.snapshot_claims():
             if claim.deletion_timestamp or claim.name in in_flight:
@@ -143,7 +157,31 @@ class DisruptionController:
                 continue
             if claim.node_pool not in self.node_pools:
                 continue
+            node = node_by_claim[claim.name]
+            if (claim.annotations.get(wk.ANNOTATION_DO_NOT_DISRUPT) == "true"
+                    or node.annotations.get(wk.ANNOTATION_DO_NOT_DISRUPT) == "true"):
+                continue
+            pods = pods_by_node.get(node.name, [])
+            if any(p.annotations.get(wk.ANNOTATION_DO_NOT_DISRUPT) == "true"
+                   for p in pods):
+                continue
+            blocked = self.cluster.pdb_blockers(pods, zero_pdbs=zero_pdbs)
+            if blocked:
+                pod, pdb = next(iter(blocked.items()))
+                # publish once per (node, pdb) blockage episode, not per
+                # pass — _candidates runs from every disruption method
+                # every reconcile and the recorder must not flood
+                key = (node.name, pdb)
+                blocked_now.add(key)
+                if key not in self._pdb_blocked_logged:
+                    self._pdb_blocked_logged.add(key)
+                    self.recorder.publish(
+                        "Normal", "Unconsolidatable", "Node", node.name,
+                        f"pdb {pdb} prevents pod evictions (pod {pod})")
+                continue
             out.append(claim)
+        # unblocked pairs may re-publish if they block again later
+        self._pdb_blocked_logged &= blocked_now
         return out
 
     def _pods_on(self, claim: NodeClaim) -> List[Pod]:

@@ -44,6 +44,7 @@ def nodepool_hash(pool: NodePool) -> str:
     import json
     payload = json.dumps({
         "labels": sorted(pool.labels.items()),
+        "annotations": sorted(pool.annotations.items()),
         "taints": [(t.key, t.value, t.effect) for t in pool.taints],
         "requirements": [(r.key, r.operator.value, r.values) for r in pool.requirements],
         "node_class_ref": pool.node_class_ref,
@@ -301,7 +302,10 @@ class Provisioner:
             name=name, node_pool=node.node_pool,
             requirements=reqs, resource_requests=requests,
             labels=dict(pool.labels),
-            annotations={wk.ANNOTATION_NODEPOOL_HASH: nodepool_hash(pool)},
+            # template annotations propagate (disruption.md:294 — a
+            # do-not-disrupt NodePool shields every node it launches)
+            annotations={**pool.annotations,
+                         wk.ANNOTATION_NODEPOOL_HASH: nodepool_hash(pool)},
             taints=list(pool.taints), node_class_ref=pool.node_class_ref,
             created_at=self.clock.now())
         return claim

@@ -50,14 +50,33 @@ class TerminationController:
                 continue
             node = self.cluster.node_for_claim(claim.name)
             if node is not None:
-                # cordon + drain: pods return to pending for rescheduling
+                # cordon, then PDB-respecting drain: the node is deleted
+                # only once fully drained (reference disruption.md:33 —
+                # evict via the Eviction API to respect PDBs, wait for the
+                # node to be fully drained before terminating)
                 if all(t.key != DISRUPTION_TAINT.key for t in node.taints):
                     node.taints.append(DISRUPTION_TAINT)
                     self.recorder.publish("Normal", "Cordoned", "Node", node.name, "")
-                evicted = self.cluster.unbind_pods_on(node.name)
+                evicted, blocked = self.cluster.drain_node(node.name)
                 if evicted:
                     self.recorder.publish("Normal", "Drained", "Node", node.name,
                                           f"evicted {len(evicted)} pod(s)")
+                if blocked:
+                    # retry next pass: rescheduled pods going healthy
+                    # elsewhere restore the budgets' allowance
+                    pdb = self.cluster.pdb_blockers(blocked)
+                    self.recorder.publish(
+                        "Warning", "DrainBlocked", "Node", node.name,
+                        f"{len(blocked)} pod(s) await disruption budget "
+                        f"({', '.join(sorted(set(pdb.values())) or ['-'])})")
+                    continue
+                # fully drained: daemonset pods are DELETED with the node
+                # (their controller stamps a fresh one onto the next node;
+                # merely unbinding would leave phantom pods inflating the
+                # daemonset overhead of every future node sizing)
+                for pod in self.cluster.unbind_pods_on(node.name):
+                    if pod.is_daemonset:
+                        self.cluster.delete_pod(pod.name)
                 self.cluster.delete_node(node.name)
             if claim.provider_id is not None:
                 try:

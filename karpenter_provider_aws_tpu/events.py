@@ -3,14 +3,20 @@
 Mirror of the reference's k8s event recorder usage (reference
 pkg/controllers/interruption/events/events.go, pkg/cloudprovider/events):
 controllers publish typed events about API objects; tests and the ops
-surface read them back. Host-side, append-only, thread-safe.
+surface read them back. Host-side, thread-safe, and BOUNDED: a ring
+buffer keeps the newest MAX_EVENTS (a real apiserver ages events out the
+same way; an append-only list would leak in a long-running controller
+whose reconcile loops publish steadily).
 """
 
 from __future__ import annotations
 
 import threading
+from collections import deque
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Deque, List, Optional
+
+MAX_EVENTS = 10_000
 
 
 @dataclass(frozen=True)
@@ -27,7 +33,7 @@ class Recorder:
     def __init__(self, clock=None):
         from .utils.clock import Clock
         self._clock = clock or Clock()
-        self._events: List[Event] = []
+        self._events: Deque[Event] = deque(maxlen=MAX_EVENTS)
         self._lock = threading.Lock()
 
     def publish(self, type: str, reason: str, object_kind: str, object_name: str,

@@ -48,6 +48,7 @@ class ClusterState:
         self.claims: Dict[str, NodeClaim] = {}
         self.pvcs: Dict[str, "PersistentVolumeClaim"] = {}
         self.storage_classes: Dict[str, "StorageClass"] = {}
+        self.pdbs: Dict[str, "PodDisruptionBudget"] = {}
         self._nominations: Dict[str, _Nomination] = {}   # pod -> claim
 
     # ---- pods ------------------------------------------------------------
@@ -130,6 +131,86 @@ class ClusterState:
                     pod.node_name = None
                     out.append(pod)
             return out
+
+    # ---- PodDisruptionBudgets ---------------------------------------------
+
+    def add_pdb(self, pdb) -> None:
+        with self._lock:
+            self.pdbs[pdb.name] = pdb
+
+    def delete_pdb(self, name: str) -> None:
+        with self._lock:
+            self.pdbs.pop(name, None)
+
+    def _pdb_allowance(self, pdb) -> int:
+        """Voluntary evictions the budget currently permits (the
+        disruptions-allowed math of policy/v1): healthy = bound matching
+        pods; desired = all matching pods (our controller-replica
+        analog). Caller holds the lock."""
+        matching = [p for p in self.pods.values()
+                    if not p.is_daemonset and pdb.matches(p)]
+        healthy = sum(1 for p in matching
+                      if p.node_name is not None and not p.deletion_timestamp)
+        allowed = len(matching)
+        if pdb.min_available is not None:
+            allowed = min(allowed, healthy - int(pdb.min_available))
+        if pdb.max_unavailable is not None:
+            unavailable = len(matching) - healthy
+            allowed = min(allowed,
+                          int(pdb.max_unavailable) - unavailable)
+        return max(allowed, 0)
+
+    def zero_allowance_pdbs(self) -> List["PodDisruptionBudget"]:
+        """The budgets that currently permit no eviction. Allowance is
+        node-independent, so candidate scans compute this ONCE per pass
+        (one O(pdbs × pods) sweep) and match per-node pods against only
+        this set."""
+        with self._lock:
+            return [pdb for pdb in self.pdbs.values()
+                    if self._pdb_allowance(pdb) <= 0]
+
+    def pdb_blockers(self, pods: List[Pod],
+                     zero_pdbs: Optional[List["PodDisruptionBudget"]] = None,
+                     ) -> Dict[str, str]:
+        """pod name → name of a matching PDB with zero allowance right now
+        (the reference's `pdb ... prevents pod evictions` condition,
+        disruption.md:112). Pass ``zero_pdbs`` (from zero_allowance_pdbs)
+        when checking many nodes in one pass."""
+        if zero_pdbs is None:
+            zero_pdbs = self.zero_allowance_pdbs()
+        blocked: Dict[str, str] = {}
+        for pdb in zero_pdbs:
+            for p in pods:
+                if not p.is_daemonset and pdb.matches(p):
+                    blocked.setdefault(p.name, pdb.name)
+        return blocked
+
+    def drain_node(self, node_name: str) -> Tuple[List[Pod], List[Pod]]:
+        """PDB-respecting eviction pass over a cordoned node (reference
+        disruption.md:33: evict via the Eviction API, wait for the node to
+        fully drain before terminating). Returns (evicted, still_blocked);
+        daemonset pods are ignored — they leave with the node. Each
+        eviction decrements its budgets' live allowance, so one pass
+        evicts at most what every matching budget permits and the rest
+        waits for rescheduled pods to report healthy again."""
+        with self._lock:
+            allowance = {name: self._pdb_allowance(pdb)
+                         for name, pdb in self.pdbs.items()}
+            evicted: List[Pod] = []
+            blocked: List[Pod] = []
+            for pod in self.pods.values():
+                if pod.node_name != node_name or pod.is_daemonset:
+                    continue
+                holders = [n for n, pdb in self.pdbs.items()
+                           if pdb.matches(pod)]
+                if all(allowance[n] > 0 for n in holders):
+                    for n in holders:
+                        allowance[n] -= 1
+                    pod.node_name = None
+                    evicted.append(pod)
+                else:
+                    blocked.append(pod)
+            return evicted, blocked
 
     def nominate(self, pod_name: str, target: str, ttl: float = NOMINATION_TTL) -> None:
         with self._lock:
@@ -233,6 +314,14 @@ class ClusterState:
                 cap = node.labels.get(wk.LABEL_CAPACITY_TYPE, "on-demand")
                 if itype not in lattice.name_to_idx or zone not in lattice.zones:
                     continue
+                # a cordoned (disruption-tainted) or terminating node is
+                # not schedulable capacity: offering it would bounce
+                # drained pods straight back to the node being emptied
+                if any(t.key == wk.DISRUPTION_TAINT_KEY for t in node.taints):
+                    continue
+                claim = self.claims.get(node.node_claim) if node.node_claim else None
+                if claim is not None and claim.deletion_timestamp:
+                    continue
                 used = np.zeros((R,), np.float32)
                 for pod in by_node.get(node.name, ()):
                     used += resources_to_vec(pod.requests, implicit_pod=True)
@@ -306,4 +395,5 @@ class ClusterState:
             self.claims.clear()
             self.pvcs.clear()
             self.storage_classes.clear()
+            self.pdbs.clear()
             self._nominations.clear()

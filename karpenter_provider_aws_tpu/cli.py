@@ -57,6 +57,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Interruption queue name; interruption handling is "
                         "disabled if not specified "
                         "(env INTERRUPTION_QUEUE).")
+    p.add_argument("--termination-grace-period", type=float, default=None,
+                   help="Seconds after which a terminating node force-drains "
+                        "even PDB-blocked pods; unset waits forever "
+                        "(env TERMINATION_GRACE_PERIOD).")
     p.add_argument("--feature-gates", default=None,
                    help="Comma-separated gates, e.g. "
                         "'Drift=true,SpotToSpotConsolidation=false'.")
@@ -82,6 +86,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "thread (the controller-runtime analog with "
                         "MaxConcurrentReconciles-style concurrency) instead "
                         "of the deterministic single-threaded loop.")
+    p.add_argument("--leader-elect-lease-file", default=None,
+                   help="Enable lease-based leader election over this "
+                        "shared file (async runtime only): standby "
+                        "replicas idle until the lease is won, mirroring "
+                        "the reference's 2-replica client-go election.")
     return p
 
 
@@ -99,6 +108,8 @@ def options_from_args(args: argparse.Namespace) -> Options:
         overrides["batch_max_duration"] = args.batch_max_duration
     if args.interruption_queue is not None:
         overrides["interruption_queue"] = args.interruption_queue
+    if args.termination_grace_period is not None:
+        overrides["termination_grace_period"] = args.termination_grace_period
     for gate in (args.feature_gates or "").split(","):
         gate = gate.strip()
         if not gate:
@@ -182,7 +193,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     try:
         if args.async_runtime:
             from .operator.runtime import ControllerRuntime, operator_specs
-            runtime = ControllerRuntime(operator_specs(op)).start()
+            elector = None
+            if args.leader_elect_lease_file:
+                import os
+                from .operator.leaderelection import FileLeaseStore, LeaderElector
+                elector = LeaderElector(
+                    FileLeaseStore(args.leader_elect_lease_file),
+                    identity=f"{os.uname().nodename}-{os.getpid()}")
+            runtime = ControllerRuntime(operator_specs(op),
+                                        elector=elector).start()
             while not stop.is_set():
                 if deadline is not None and time.monotonic() >= deadline:
                     break

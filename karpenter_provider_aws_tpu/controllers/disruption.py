@@ -143,7 +143,9 @@ class DisruptionController:
         disruption.md:112)."""
         in_flight = {n for a in self._in_flight for n in a.claims}
         node_by_claim = self.cluster.nodes_by_claim()
-        pods_by_node = self.cluster.pods_by_node(include_daemonsets=False)
+        # unfiltered: a do-not-disrupt DAEMONSET pod pins its node too;
+        # pdb_blockers applies its own daemonset exemption
+        pods_by_node = self.cluster.pods_by_node()
         # allowance is node-independent: one sweep for the whole pass
         zero_pdbs = self.cluster.zero_allowance_pdbs()
         blocked_now: set = set()

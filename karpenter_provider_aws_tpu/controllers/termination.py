@@ -27,11 +27,18 @@ DISRUPTION_TAINT = Taint(key=f"{wk.KARPENTER_PREFIX}/disruption", value="disrupt
 class TerminationController:
     def __init__(self, cluster: ClusterState, cloud_provider: CloudProvider,
                  recorder: Optional[Recorder] = None, clock: Optional[Clock] = None,
-                 metrics: Optional[Registry] = None):
+                 metrics: Optional[Registry] = None,
+                 termination_grace_period: Optional[float] = None):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.clock = clock or Clock()
         self.recorder = recorder or Recorder(self.clock)
+        # None = a PDB-blocked drain waits forever (the pinned reference
+        # release); a float force-drains claims terminating longer than
+        # this, so a zero-allowance budget cannot bill an instance forever
+        self.termination_grace_period = termination_grace_period
+        # claims whose DrainBlocked event already published this episode
+        self._drain_blocked_logged: set = set()
         m = wire_core_metrics(metrics or Registry())
         self._m_terminated = m["nodeclaims_terminated"]
 
@@ -61,15 +68,32 @@ class TerminationController:
                 if evicted:
                     self.recorder.publish("Normal", "Drained", "Node", node.name,
                                           f"evicted {len(evicted)} pod(s)")
+                grace_expired = (
+                    self.termination_grace_period is not None
+                    and self.clock.now() - claim.deletion_timestamp
+                    >= self.termination_grace_period)
+                if blocked and grace_expired:
+                    # force-drain backstop: the budget lost its veto
+                    self.cluster.unbind_pods_on(node.name)
+                    self.recorder.publish(
+                        "Warning", "ForceDrained", "Node", node.name,
+                        f"termination grace period expired; evicted "
+                        f"{len(blocked)} budget-blocked pod(s)")
+                    blocked = []
                 if blocked:
                     # retry next pass: rescheduled pods going healthy
-                    # elsewhere restore the budgets' allowance
-                    pdb = self.cluster.pdb_blockers(blocked)
-                    self.recorder.publish(
-                        "Warning", "DrainBlocked", "Node", node.name,
-                        f"{len(blocked)} pod(s) await disruption budget "
-                        f"({', '.join(sorted(set(pdb.values())) or ['-'])})")
+                    # elsewhere restore the budgets' allowance. One event
+                    # per blockage episode — this runs every second in
+                    # the async runtime and must not flood the recorder
+                    if claim.name not in self._drain_blocked_logged:
+                        self._drain_blocked_logged.add(claim.name)
+                        pdb = self.cluster.pdb_blockers(blocked)
+                        self.recorder.publish(
+                            "Warning", "DrainBlocked", "Node", node.name,
+                            f"{len(blocked)} pod(s) await disruption budget "
+                            f"({', '.join(sorted(set(pdb.values())) or ['-'])})")
                     continue
+                self._drain_blocked_logged.discard(claim.name)
                 # fully drained: daemonset pods are DELETED with the node
                 # (their controller stamps a fresh one onto the next node;
                 # merely unbinding would leave phantom pods inflating the
@@ -85,5 +109,6 @@ class TerminationController:
                     pass
             claim.phase = NodeClaimPhase.TERMINATED
             self._m_terminated.inc(nodepool=claim.node_pool)
+            self._drain_blocked_logged.discard(claim.name)
             self.cluster.delete_claim(claim.name)
             self.recorder.publish("Normal", "Terminated", "NodeClaim", claim.name, "")

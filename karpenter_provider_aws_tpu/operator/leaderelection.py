@@ -1,0 +1,144 @@
+"""Leader election — the controller-HA half of the operator runtime.
+
+The reference deploys 2 controller replicas behind Kubernetes
+coordination/v1 lease-based leader election (client-go leaderelection;
+the helm chart's PDB keeps one alive through node maintenance) and gates
+side-effectful startup work on winning the lease (reference
+pkg/providers/launchtemplate/launchtemplate.go:100-108 hydrates its cache
+"after leader election"). This is the same algorithm over a pluggable
+lease store:
+
+- acquire when the lease is unheld or its renew time is older than the
+  lease duration (the previous holder died),
+- renew while holding; a holder that cannot renew within the lease
+  duration loses leadership and must stop acting,
+- release on clean shutdown so a standby takes over immediately.
+
+Stores: :class:`MemoryLeaseStore` for simulation/tests (the FakeCloud
+analog of the coordination API) and :class:`FileLeaseStore` for real
+multi-process deployments on a shared filesystem (atomic rename swap).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Optional
+
+from ..utils.clock import Clock
+
+LEASE_DURATION = 15.0   # client-go defaults: 15s lease
+RETRY_PERIOD = 2.0      # acquire/renew cadence
+
+
+@dataclass
+class Lease:
+    holder: str
+    renew_time: float
+
+
+class MemoryLeaseStore:
+    """In-memory lease record with compare-and-swap semantics."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._lease: Optional[Lease] = None
+
+    def get(self) -> Optional[Lease]:
+        with self._lock:
+            return self._lease
+
+    def swap(self, expect_holder: Optional[str], lease: Optional[Lease]) -> bool:
+        """Write ``lease`` iff the current holder is ``expect_holder``
+        (None = unheld/expired takeover is validated by the caller)."""
+        with self._lock:
+            current = self._lease.holder if self._lease else None
+            if current != expect_holder:
+                return False
+            self._lease = lease
+            return True
+
+
+class FileLeaseStore:
+    """Lease in a JSON file, swapped atomically via rename. Suitable for
+    replicas sharing a filesystem; last-writer-wins races are narrowed by
+    re-reading after write (good enough for the sim/single-host story —
+    a real cluster deployment uses the coordination API)."""
+
+    def __init__(self, path: str):
+        self.path = Path(path)
+
+    def get(self) -> Optional[Lease]:
+        try:
+            d = json.loads(self.path.read_text())
+            return Lease(holder=d["holder"], renew_time=float(d["renewTime"]))
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def swap(self, expect_holder: Optional[str], lease: Optional[Lease]) -> bool:
+        current = self.get()
+        if (current.holder if current else None) != expect_holder:
+            return False
+        if lease is None:
+            try:
+                self.path.unlink()
+            except OSError:
+                pass
+            return True
+        fd, tmp = tempfile.mkstemp(dir=str(self.path.parent))
+        with os.fdopen(fd, "w") as f:
+            json.dump({"holder": lease.holder, "renewTime": lease.renew_time}, f)
+        os.replace(tmp, self.path)
+        after = self.get()
+        return after is not None and after.holder == lease.holder \
+            and after.renew_time == lease.renew_time
+
+
+class LeaderElector:
+    def __init__(self, store, identity: str,
+                 lease_duration: float = LEASE_DURATION,
+                 clock: Optional[Clock] = None):
+        self.store = store
+        self.identity = identity
+        self.lease_duration = lease_duration
+        self.clock = clock or Clock()
+        self._leading = False
+        self.transitions = 0   # leadership changes observed (metrics hook)
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leading
+
+    def try_acquire_or_renew(self) -> bool:
+        """One election tick; returns current leadership. Call every
+        RETRY_PERIOD (the runtime registers this as its own controller)."""
+        now = self.clock.now()
+        lease = self.store.get()
+        if lease is not None and lease.holder == self.identity:
+            ok = self.store.swap(self.identity,
+                                 Lease(self.identity, now))
+            self._set(ok)
+            return self._leading
+        if lease is None or now - lease.renew_time >= self.lease_duration:
+            # unheld, or the holder stopped renewing: take over
+            expect = lease.holder if lease is not None else None
+            ok = self.store.swap(expect, Lease(self.identity, now))
+            self._set(ok and self.store.get().holder == self.identity)
+            return self._leading
+        self._set(False)
+        return False
+
+    def release(self) -> None:
+        """Clean shutdown: drop the lease so a standby wins immediately."""
+        if self._leading:
+            self.store.swap(self.identity, None)
+            self._set(False)
+
+    def _set(self, leading: bool) -> None:
+        if leading != self._leading:
+            self.transitions += 1
+        self._leading = leading

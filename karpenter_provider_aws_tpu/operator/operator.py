@@ -103,7 +103,8 @@ class Operator:
             metrics=self.metrics)
         self.termination = TerminationController(
             self.cluster, self.cloud_provider, self.recorder, self.clock,
-            metrics=self.metrics)
+            metrics=self.metrics,
+            termination_grace_period=self.options.termination_grace_period)
         self.gc = GarbageCollectionController(
             self.cluster, self.cloud_provider, self.recorder, self.clock)
         self.tagging = TaggingController(

@@ -47,6 +47,12 @@ class Options:
     # feature gates (settings.md:40-47)
     drift_enabled: bool = True
     spot_to_spot_consolidation: bool = False
+    # force-drain backstop: a terminating claim older than this many
+    # seconds evicts even PDB-blocked pods so the instance is never
+    # billed forever behind a zero-allowance budget. None = wait forever
+    # (the pinned reference release's behavior; later releases added the
+    # same escape as NodeClaim spec.terminationGracePeriod)
+    termination_grace_period: Optional[float] = None
     # sim-only knob: seconds between launch and (fake) kubelet registration
     registration_delay: float = 5.0
 
@@ -69,6 +75,7 @@ class Options:
             interruption_queue=_env("INTERRUPTION_QUEUE", "", str),
             drift_enabled=_env_bool("FEATURE_GATE_DRIFT", True),
             spot_to_spot_consolidation=_env_bool("FEATURE_GATE_SPOT_TO_SPOT", False),
+            termination_grace_period=_env("TERMINATION_GRACE_PERIOD", None, float),
         )
         for k, v in overrides.items():
             setattr(opts, k, v)

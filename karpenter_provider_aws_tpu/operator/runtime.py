@@ -32,8 +32,20 @@ class ControllerSpec:
 
 class ControllerRuntime:
     def __init__(self, specs: Sequence[ControllerSpec],
-                 on_error: Optional[Callable[[str, BaseException], None]] = None):
+                 on_error: Optional[Callable[[str, BaseException], None]] = None,
+                 elector=None):
+        """``elector`` (operator/leaderelection.LeaderElector) gates every
+        reconcile on holding the lease — the standby replica's controllers
+        idle until it wins (the reference's client-go leader election
+        around its manager). The election tick itself runs as one more
+        controller thread registered here."""
         self.specs = list(specs)
+        self.elector = elector
+        if elector is not None:
+            from .leaderelection import RETRY_PERIOD
+            self.specs.append(ControllerSpec(
+                "leader-election", elector.try_acquire_or_renew,
+                interval=RETRY_PERIOD))
         self._on_error = on_error
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -43,7 +55,9 @@ class ControllerRuntime:
     def _run(self, spec: ControllerSpec) -> None:
         while not self._stop.is_set():
             try:
-                spec.reconcile()
+                if (self.elector is None or spec.name == "leader-election"
+                        or self.elector.is_leader):
+                    spec.reconcile()
             except BaseException as e:  # a controller crash must not kill
                 with self._lock:       # its siblings (controller-runtime
                     self.error_counts[spec.name] = \
@@ -68,11 +82,18 @@ class ControllerRuntime:
         """Signal every controller and join. Returns True when all threads
         exited; a thread still blocked (e.g. mid device solve) past the
         timeout stays tracked, so ``running`` keeps reporting True and a
-        caller can stop() again rather than proceed over live mutation."""
+        caller can stop() again rather than proceed over live mutation.
+        A held lease is released so a standby takes over immediately."""
         self._stop.set()
         for t in self._threads:
             t.join(timeout)
         self._threads = [t for t in self._threads if t.is_alive()]
+        # release only AFTER the election thread joined — releasing first
+        # races its in-flight tick, which would re-acquire the lease and
+        # orphan it on a dead process (standby then waits out the full
+        # lease duration instead of taking over immediately)
+        if self.elector is not None:
+            self.elector.release()
         return not self._threads
 
     @property

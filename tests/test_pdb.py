@@ -220,3 +220,74 @@ class TestDoNotDisrupt:
         before = set(env.cluster.claims)
         self._run_disruption(env, rounds=20)
         assert set(env.cluster.claims) != before
+
+
+class TestForceDrainBackstop:
+    def test_grace_period_unblocks_stuck_termination(self, lattice):
+        """A zero-allowance budget cannot bill an instance forever when
+        termination_grace_period is set: after the grace the drain
+        forces through and the claim terminates."""
+        clock = FakeClock()
+        env = Operator(options=Options(registration_delay=1.0,
+                                       termination_grace_period=60.0),
+                       lattice=lattice, cloud=FakeCloud(clock), clock=clock,
+                       node_pools=[NodePool(
+                           name="default",
+                           requirements=[Requirement(wk.LABEL_CAPACITY_TYPE,
+                                                     ReqOp.IN, ("on-demand",))])])
+        for i in range(2):
+            env.cluster.add_pod(Pod(name=f"p-{i}", labels={"app": "stuck"},
+                                    requests={"cpu": "500m", "memory": "1Gi"}))
+        env.settle()
+        env.cluster.add_pdb(PodDisruptionBudget(
+            name="frozen", label_selector={"app": "stuck"}, max_unavailable=0))
+        victim = next(iter(env.cluster.claims.values()))
+        env.termination.delete_claim(victim.name)
+        env.termination.reconcile()
+        assert victim.name in env.cluster.claims  # blocked, still alive
+        clock.step(61)
+        env.termination.reconcile()
+        assert victim.name not in env.cluster.claims
+        assert env.recorder.events(reason="ForceDrained")
+
+    def test_drain_blocked_event_published_once_per_episode(self, lattice):
+        env = make_env(lattice)
+        for i in range(2):
+            env.cluster.add_pod(Pod(name=f"p-{i}", labels={"app": "stuck"},
+                                    requests={"cpu": "500m", "memory": "1Gi"}))
+        env.settle()
+        env.cluster.add_pdb(PodDisruptionBudget(
+            name="frozen", label_selector={"app": "stuck"}, max_unavailable=0))
+        victim = next(iter(env.cluster.claims.values()))
+        env.termination.delete_claim(victim.name)
+        for _ in range(20):
+            env.termination.reconcile()
+        assert len(env.recorder.events(reason="DrainBlocked")) == 1
+
+    def test_daemonset_do_not_disrupt_pins_node(self, lattice):
+        """A do-not-disrupt DAEMONSET pod blocks candidacy too (the
+        candidate check must see the unfiltered pod list)."""
+        pools = [NodePool(
+            name="default",
+            requirements=[Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN,
+                                      ("on-demand",))],
+            disruption=NodePoolDisruption(consolidate_after=5.0))]
+        env = make_env(lattice, pools=pools)
+        for i in range(4):
+            env.cluster.add_pod(Pod(name=f"tiny-{i}", labels={"grp": "tiny"},
+                                    requests={"cpu": "800m", "memory": "1536Mi"}))
+        env.settle()
+        assert len(env.cluster.claims) == 1
+        node = next(iter(env.cluster.nodes))
+        env.cluster.add_pod(Pod(
+            name="ds-pinned", is_daemonset=True, node_name=node,
+            annotations={wk.ANNOTATION_DO_NOT_DISRUPT: "true"},
+            requests={"cpu": "100m"}))
+        for i in range(1, 4):
+            env.cluster.delete_pod(f"tiny-{i}")
+        before = set(env.cluster.claims)
+        env.clock.step(6)
+        for _ in range(10):
+            env.run_once(force_provision=bool(env.cluster.pending_pods()))
+            env.clock.step(3)
+        assert set(env.cluster.claims) == before

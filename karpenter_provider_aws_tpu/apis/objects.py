@@ -265,12 +265,21 @@ class NodePool:
     node_class_ref: str = "default"
     limits: Dict[str, "str | int | float"] = field(default_factory=dict)  # cpu/memory ceilings
     disruption: NodePoolDisruption = field(default_factory=NodePoolDisruption)
+    # set only on VIRTUAL pools the problem builder materializes for
+    # custom-key label assignments (reference scheduling.md:536-556, the
+    # Exists-operator workload-segregation technique): ``base_name`` is
+    # the real pool (limits/budgets/hash roll up there) and
+    # ``custom_labels`` the label values this variant's nodes carry.
+    base_name: Optional[str] = None
+    custom_labels: Dict[str, str] = field(default_factory=dict)
 
     def scheduling_requirements(self) -> Requirements:
         reqs = Requirements.from_labels(self.labels)
         for r in self.requirements:
             reqs.add(r)
-        reqs.add(Requirement(wellknown.LABEL_NODEPOOL, Operator.IN, (self.name,)))
+        # a virtual variant's nodes still carry the REAL pool's name label
+        reqs.add(Requirement(wellknown.LABEL_NODEPOOL, Operator.IN,
+                             (self.base_name or self.name,)))
         return reqs
 
     def limits_vec(self) -> Optional[np.ndarray]:

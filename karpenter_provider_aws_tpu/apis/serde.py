@@ -213,6 +213,7 @@ def existing_bin_to_dict(b) -> Dict:
         "used": np.asarray(b.used, dtype=float).tolist(),
         "allocOverride": (np.asarray(b.alloc_override, dtype=float).tolist()
                           if b.alloc_override is not None else None),
+        "labels": dict(b.labels),
     }
 
 
@@ -225,6 +226,7 @@ def existing_bin_from_dict(d: Mapping):
         used=np.asarray(d["used"], dtype=np.float32),
         alloc_override=(np.asarray(d["allocOverride"], dtype=np.float32)
                         if d.get("allocOverride") is not None else None),
+        labels=dict(d.get("labels", {})),
     )
 
 
@@ -236,7 +238,8 @@ def plan_to_dict(plan) -> Dict:
              "pricePerHour": n.price_per_hour, "pods": list(n.pods),
              "feasibleTypes": list(n.feasible_types),
              "feasibleZones": list(n.feasible_zones),
-             "feasibleCapacityTypes": list(n.feasible_capacity_types)}
+             "feasibleCapacityTypes": list(n.feasible_capacity_types),
+             "extraLabels": dict(n.extra_labels)}
             for n in plan.new_nodes],
         "existingAssignments": {k: list(v)
                                 for k, v in plan.existing_assignments.items()},
@@ -258,7 +261,8 @@ def plan_from_dict(d: Mapping):
                 price_per_hour=n["pricePerHour"], pods=list(n["pods"]),
                 feasible_types=list(n.get("feasibleTypes", ())),
                 feasible_zones=list(n.get("feasibleZones", ())),
-                feasible_capacity_types=list(n.get("feasibleCapacityTypes", ())))
+                feasible_capacity_types=list(n.get("feasibleCapacityTypes", ())),
+                extra_labels=dict(n.get("extraLabels", {})))
             for n in d.get("newNodes", ())],
         existing_assignments={k: list(v) for k, v in
                               d.get("existingAssignments", {}).items()},

@@ -298,10 +298,23 @@ class Provisioner:
                 total += resources_to_vec(pod.requests, implicit_pod=True)
         from ..apis.resources import vec_to_resources
         requests = vec_to_resources(total)
+        labels = {**pool.labels, **node.extra_labels}
+        # a value-free template requirement on a custom key (Exists, or In
+        # over several values) means the node must still CARRY the label
+        # even when no workload named one — generate/pick it
+        # (scheduling.md:554 "Karpenter will generate a random label")
+        from ..solver.problem import _is_custom_key
+        for r in pool.requirements:
+            if not _is_custom_key(r.key) or r.key in labels:
+                continue
+            if r.operator == Operator.EXISTS:
+                labels[r.key] = f"kpat-{name}"
+            elif r.operator == Operator.IN and r.values:
+                labels[r.key] = sorted(r.values)[0]
         claim = NodeClaim(
             name=name, node_pool=node.node_pool,
             requirements=reqs, resource_requests=requests,
-            labels=dict(pool.labels),
+            labels=labels,
             # template annotations propagate (disruption.md:294 — a
             # do-not-disrupt NodePool shields every node it launches)
             annotations={**pool.annotations,

@@ -53,7 +53,8 @@ class SolverService:
         ds = [serde.pod_from_dict(p) for p in req.get("daemonsetPods", ())]
         bound = [BoundPod(pod=serde.pod_from_dict(b["pod"]),
                           node_name=b["nodeName"], zone=b.get("zone", ""),
-                          capacity_type=b.get("capacityType", "on-demand"))
+                          capacity_type=b.get("capacityType", "on-demand"),
+                          node_labels=dict(b.get("nodeLabels", {})))
                  for b in req.get("boundPods", ())]
         pvcs = {c["name"]: serde.pvc_from_dict(c)
                 for c in req.get("pvcs", ())} or None
@@ -123,7 +124,8 @@ class SolverClient:
             "daemonsetPods": [serde.pod_to_dict(p) for p in daemonset_pods],
             "boundPods": [
                 {"pod": serde.pod_to_dict(b.pod), "nodeName": b.node_name,
-                 "zone": b.zone, "capacityType": b.capacity_type}
+                 "zone": b.zone, "capacityType": b.capacity_type,
+                 "nodeLabels": dict(b.node_labels)}
                 for b in bound_pods],
             "pvcs": [serde.pvc_to_dict(c)
                      for c in (pvcs or {}).values()],

@@ -55,6 +55,7 @@ class ExistingBin:
     capacity_type: str
     used: np.ndarray                      # [R] resources already committed
     alloc_override: Optional[np.ndarray] = None  # [R] if real node alloc differs from lattice
+    labels: Dict[str, str] = field(default_factory=dict)  # node labels (custom-key matching)
 
 
 @dataclass
@@ -131,12 +132,62 @@ class Problem:
         return self.g_match.shape[1] if self.g_match.ndim == 2 else 0
 
 
+def _is_custom_key(key: str) -> bool:
+    """A label key the lattice does not model (user-defined)."""
+    return (key not in _AXIS_KEYS and key not in _CAT_KEY_INDEX
+            and key not in _NUM_KEY_INDEX and key != wk.LABEL_REGION)
+
+
+def _resolve_custom_sigma(reqs, pool: NodePool, preqs,
+                          gen: str) -> Optional[Dict[str, str]]:
+    """Custom-key labels a node of ``pool`` must carry to host this group,
+    for keys the pool leaves FREE via a template requirement (Exists, or
+    In over several values — reference scheduling.md:536-556). Returns
+    None when no labeling can satisfy the group on this pool, {} when
+    nothing needs pinning (template labels or absence already resolve
+    every key), else the value assignment. ``gen`` is the generated value
+    used when the group demands existence without naming one."""
+    offered = set(preqs.keys())
+    sigma: Dict[str, str] = {}
+    for key in reqs.keys():
+        if not _is_custom_key(key):
+            continue
+        c = reqs.get(key)
+        if key in pool.labels:
+            if not c.matches(pool.labels[key]):
+                return None
+            continue
+        if key not in offered:
+            if not c.allows_absent:
+                return None
+            continue
+        if c.allows_absent and c.include is None:
+            # e.g. NotIn: satisfied without the key; no pin needed
+            continue
+        both = c.intersect(preqs.get(key))
+        if both.include is not None:
+            picks = sorted(v for v in both.include if both.matches(v))
+            if not picks:
+                return None
+            sigma[key] = picks[0]
+        elif both.gt is not None or both.lt is not None:
+            n = int(both.gt) + 1 if both.gt is not None else int(both.lt) - 1
+            if not both.matches(str(n)):
+                return None
+            sigma[key] = str(n)
+        else:
+            if not both.matches(gen):
+                return None
+            sigma[key] = gen
+    return sigma
+
+
 def _custom_keys_ok(reqs: Requirements, pool_labels: Mapping[str, str]) -> bool:
     """Exact host-side check of constraints on keys the lattice does not
     model: they must be satisfied by the pool's template labels (or tolerate
     absence)."""
     for key in reqs.keys():
-        if key in _AXIS_KEYS or key in _CAT_KEY_INDEX or key in _NUM_KEY_INDEX or key == wk.LABEL_REGION:
+        if not _is_custom_key(key):
             continue
         c = reqs.get(key)
         if key in pool_labels:
@@ -314,41 +365,13 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
                    bound_pods: Sequence[BoundPod] = (),
                    pvcs: Optional[Mapping] = None,
                    storage_classes: Optional[Mapping] = None) -> Problem:
-    pools = sorted(node_pools, key=lambda p: (-p.weight, p.name))
-    NP = len(pools)
+    real_pools = sorted(node_pools, key=lambda p: (-p.weight, p.name))
     T, Z, C = lattice.T, lattice.Z, lattice.C
     key_values = lattice.key_values_present()
     warnings: List[str] = []
-
-    # --- NodePool masks + daemonset overhead
-    np_type = np.ones((NP, T), dtype=bool)
-    np_zone = np.ones((NP, Z), dtype=bool)
-    np_cap = np.ones((NP, C), dtype=bool)
-    ds_overhead = np.zeros((NP, R), dtype=np.float32)
-    pool_reqs: List[Requirements] = []
-    for pi, pool in enumerate(pools):
-        reqs = pool.scheduling_requirements()
-        pool_reqs.append(reqs)
-        m = compile_masks(reqs, lattice, extra_labels=pool.labels)
-        np_type[pi], np_zone[pi], np_cap[pi] = m.type_mask, m.zone_mask, m.cap_mask
-        for ds in daemonset_pods:
-            # a daemonset lands on the pool's nodes iff it tolerates the pool
-            # taints and its node selectors are compatible (reference
-            # resolves daemonset overhead per simulated node the same way)
-            if not tolerates_all(ds.tolerations, pool.taints + pool.startup_taints):
-                continue
-            # hard rules only: a daemonset's zone/node PREFERENCE must not
-            # drop its overhead from nodes it would still run on (in real
-            # k8s the DS schedules there regardless; sizing must include it)
-            ds_reqs = ds.hard_scheduling_requirements()
-            if not ds_reqs.compatible_with(reqs):
-                continue
-            if not _custom_keys_ok(ds_reqs, pool.labels):
-                continue
-            vec, unknown = resources_to_vec_checked(ds.requests, implicit_pod=True)
-            if unknown:
-                continue
-            ds_overhead[pi] += vec
+    # pool masks build AFTER grouping: groups' custom-key demands against
+    # pool-requirement-offered keys (Exists / In with free values) expand
+    # the pool list with virtual labeled variants first (see below)
 
     # --- group pods by scheduling signature (one expensive compile per
     # distinct key; the per-pod loop is one dict hit + one pointer compare)
@@ -487,6 +510,134 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
                     f"consumers of shared unbound PVC {c!r} have no common "
                     f"eligible zone; the volume can only bind for some of them")
 
+    # --- virtual-pool expansion for custom-key label assignment
+    # (reference scheduling.md:536-556, the Exists-operator workload
+    # segregation): a pool whose TEMPLATE REQUIREMENT covers a custom key
+    # (Exists, or In with several values) leaves the node's label value
+    # free; a group demanding a concrete value gets a virtual variant of
+    # that pool whose merged labels pin it. Bins then separate by value
+    # through ordinary pool identity — conflicting groups can never share
+    # a node — and everything downstream (np masks, weight order, claim
+    # labels) treats the variant as just another pool. Limits, budgets,
+    # and the drift hash roll up to ``base_name``.
+    pool_reqs_real = [p.scheduling_requirements() for p in real_pools]
+
+    # custom-key spread domains: every value a NodePool names for the key
+    # (In-requirement values or a template label) — the reference
+    # discovers spread domains from its NodePools the same way
+    # (scheduling.md:312-446, :558-614 'virtual domains'). Values found
+    # only on live nodes do NOT become split domains: no pool can launch
+    # into them, so pinning a slice there would strand it (existing
+    # matching pods still COUNT into the water-fill via bound_pods).
+    custom_domains: Dict[str, List[str]] = {}
+
+    def _add_domain(key: str, val: str) -> None:
+        if _is_custom_key(key):
+            vals = custom_domains.setdefault(key, [])
+            if val not in vals:
+                vals.append(val)
+    for pool, preqs in zip(real_pools, pool_reqs_real):
+        for key in preqs.keys():
+            if _is_custom_key(key):
+                c = preqs.get(key)
+                if c.include:
+                    for v in sorted(c.include):
+                        _add_domain(key, v)
+        for k, v in pool.labels.items():
+            _add_domain(k, v)
+
+    virtual: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], NodePool] = {}
+
+    def _ensure_virtual(pool: NodePool, sigma: Dict[str, str]) -> None:
+        vkey = (pool.name, tuple(sorted(sigma.items())))
+        if vkey not in virtual:
+            import dataclasses
+            virtual[vkey] = dataclasses.replace(
+                pool,
+                name=pool.name + "@" + ",".join(
+                    f"{k}={v}" for k, v in sorted(sigma.items())),
+                labels={**pool.labels, **sigma},
+                base_name=pool.base_name or pool.name,
+                custom_labels=dict(sigma))
+
+    for sid in order:
+        rep, _names = raw_groups[sid]
+        reqs = rep.scheduling_requirements()
+        # generated value for existence-only demands: stable across passes
+        # (content-derived, NOT the volatile group ordinal — otherwise a
+        # later batch pins a different value and can never rejoin the node
+        # the first batch labeled); the reference stamps a random label
+        import hashlib
+        gen = "kpat-" + hashlib.sha1(
+            repr(_SIG_TUPLES[sid]).encode()).hexdigest()[:8]
+        base_sigmas: Dict[str, Dict[str, str]] = {}
+        if any(_is_custom_key(k) for k in reqs.keys()):
+            for pool, preqs in zip(real_pools, pool_reqs_real):
+                sigma = _resolve_custom_sigma(reqs, pool, preqs, gen)
+                if sigma:
+                    _ensure_virtual(pool, sigma)
+                if sigma is not None:
+                    base_sigmas[pool.name] = sigma
+        # a DoNotSchedule spread over a custom key pins each slice to one
+        # domain value: pre-materialize the per-domain pool variants,
+        # COMPOSED with the group's own demand sigma (a group can pin
+        # team=a and spread over rack at the same time)
+        for c in rep.topology_spread:
+            key = c.topology_key
+            if not _is_custom_key(key) or c.when_unsatisfiable == "ScheduleAnyway":
+                continue
+            for d in custom_domains.get(key, ()):
+                for pool, preqs in zip(real_pools, pool_reqs_real):
+                    if key in pool.labels:
+                        continue  # fixed-label pool serves its own domain
+                    if key in set(preqs.keys()) and preqs.get(key).matches(d):
+                        base = base_sigmas.get(pool.name, {})
+                        if key in base:
+                            continue  # demand already pins this key
+                        _ensure_virtual(pool, {**base, key: d})
+    # '@' sorts before alphanumerics, so on equal weight the REAL pool
+    # still precedes its variants... actually '@'(0x40) < 'a', but the
+    # real name is a strict prefix and strings compare prefix-first, so
+    # "default" < "default@k=v": unconstrained groups keep preferring the
+    # unlabeled base pool
+    pools = sorted(list(real_pools) + list(virtual.values()),
+                   key=lambda p: (-p.weight, p.name))
+    NP = len(pools)
+
+    # --- NodePool masks + daemonset overhead
+    np_type = np.ones((NP, T), dtype=bool)
+    np_zone = np.ones((NP, Z), dtype=bool)
+    np_cap = np.ones((NP, C), dtype=bool)
+    ds_overhead = np.zeros((NP, R), dtype=np.float32)
+    pool_reqs: List[Requirements] = []
+    for pi, pool in enumerate(pools):
+        reqs = pool.scheduling_requirements()
+        pool_reqs.append(reqs)
+        # a pool's OWN value-free custom-key requirements (Exists / In on
+        # user keys) are label templates its nodes will carry — never
+        # lattice constraints; they must not zero the pool's masks
+        m = compile_masks(reqs, lattice, extra_labels=pool.labels,
+                          skip_unresolved_custom=True)
+        np_type[pi], np_zone[pi], np_cap[pi] = m.type_mask, m.zone_mask, m.cap_mask
+        for ds in daemonset_pods:
+            # a daemonset lands on the pool's nodes iff it tolerates the pool
+            # taints and its node selectors are compatible (reference
+            # resolves daemonset overhead per simulated node the same way)
+            if not tolerates_all(ds.tolerations, pool.taints + pool.startup_taints):
+                continue
+            # hard rules only: a daemonset's zone/node PREFERENCE must not
+            # drop its overhead from nodes it would still run on (in real
+            # k8s the DS schedules there regardless; sizing must include it)
+            ds_reqs = ds.hard_scheduling_requirements()
+            if not ds_reqs.compatible_with(reqs):
+                continue
+            if not _custom_keys_ok(ds_reqs, pool.labels):
+                continue
+            vec, unknown = resources_to_vec_checked(ds.requests, implicit_pod=True)
+            if unknown:
+                continue
+            ds_overhead[pi] += vec
+
     # --- per raw group: masks, pool compatibility, topology resolution
     registry = ClassRegistry()
     # bound pods' hostname anti-affinity terms must be classes too — the k8s
@@ -520,9 +671,7 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
                 continue
             np_ok[pi] = True
         strict = any(
-            key not in _AXIS_KEYS and key not in _CAT_KEY_INDEX
-            and key not in _NUM_KEY_INDEX and key != wk.LABEL_REGION
-            and not reqs.get(key).allows_absent
+            _is_custom_key(key) and not reqs.get(key).allows_absent
             for key in reqs.keys()
         )
 
@@ -534,7 +683,8 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
         splits, topo, cut = resolve_group_topology(
             rep, len(names), zone_mask_eff, masks.cap_mask,
             lattice.zones, lattice.capacity_types, registry, bound_pods, warnings,
-            pending_counts=pending_spread_counts)
+            pending_counts=pending_spread_counts,
+            custom_domains=custom_domains)
         if cut > 0:
             for name in names[len(names) - cut:]:
                 unschedulable[name] = "zone anti-affinity: more replicas than eligible zones"
@@ -545,10 +695,17 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
             cursor += s.count
             if not sub_names:
                 continue
+            np_ok_s = np_ok
+            if s.custom:
+                # custom-spread slice: only pools whose merged labels
+                # carry exactly this slice's domain values may host it
+                np_ok_s = np_ok & np.array(
+                    [all(p.labels.get(k) == v for k, v in s.custom.items())
+                     for p in pools], dtype=bool)
             g = PodGroup(
                 signature=repr(sig), pod_names=sub_names, req=vec,
                 type_mask=masks.type_mask, zone_mask=s.zone_mask, cap_mask=s.cap_mask,
-                np_ok=np_ok, requirements=reqs,
+                np_ok=np_ok_s, requirements=reqs,
                 max_per_bin=topo.max_per_bin, spread_class=topo.spread_class,
                 single_bin=topo.single_bin,
                 strict_custom=strict,
@@ -624,9 +781,26 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
     e_pm = np.zeros((E, A), np.int32)
     e_po = np.zeros((E, A), bool)
     pool_index = {p.name: i for i, p in enumerate(pools)}
+    by_base: Dict[str, List[int]] = {}
+    for pi, p in enumerate(pools):
+        by_base.setdefault(p.base_name or p.name, []).append(pi)
     zone_index = {z: i for i, z in enumerate(lattice.zones)}
     cap_index = {c: i for i, c in enumerate(lattice.capacity_types)}
     bin_index = {b.name: i for i, b in enumerate(existing)}
+
+    def bin_pool(b: ExistingBin) -> int:
+        """The most specific pool variant a bin's node labels realize —
+        a node labeled team=a belongs to the team=a virtual variant, so
+        groups demanding that value can join it and conflicting groups
+        cannot."""
+        best, score = pool_index.get(b.node_pool, -1), -1
+        for pi in by_base.get(b.node_pool, ()):
+            sigma = pools[pi].custom_labels
+            if all(b.labels.get(k) == v for k, v in sigma.items()) \
+                    and len(sigma) > score:
+                best, score = pi, len(sigma)
+        return best
+
     for ei, b in enumerate(existing):
         ti = lattice.name_to_idx[b.instance_type]
         e_used[ei] = b.used
@@ -634,7 +808,7 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
         e_type[ei] = ti
         e_zone[ei] = zone_index[b.zone]
         e_cap[ei] = cap_index[b.capacity_type]
-        e_np[ei] = pool_index.get(b.node_pool, -1)
+        e_np[ei] = bin_pool(b)
     # seed affinity-class presence on existing bins from bound pods
     if A:
         for bp in bound_pods:

@@ -52,6 +52,15 @@ class PlannedNode:
     feasible_types: List[str] = field(default_factory=list)
     feasible_zones: List[str] = field(default_factory=list)
     feasible_capacity_types: List[str] = field(default_factory=list)
+    # custom labels a virtual-pool bin pins on its node (the Exists-
+    # operator workload segregation, solver/problem.py expansion);
+    # node_pool is always the REAL pool name
+    extra_labels: Dict[str, str] = field(default_factory=dict)
+
+
+def _pool_out(pool) -> Tuple[str, Dict[str, str]]:
+    """(real pool name, custom labels) for a possibly-virtual pool."""
+    return (pool.base_name or pool.name, dict(pool.custom_labels))
 
 
 MAX_FLEXIBLE_TYPES = 60  # reference pkg/providers/instance/instance.go:50
@@ -634,8 +643,9 @@ class Solver:
                     node = new_bins.get(int(b))
                     if node is None:
                         ftypes, fzones, fcaps = feasible_for[int(b)]
+                        pname, extra = _pool_out(problem.node_pools[int(np_id[b])])
                         node = PlannedNode(
-                            node_pool=problem.node_pools[int(np_id[b])].name,
+                            node_pool=pname, extra_labels=extra,
                             instance_type=lat.names[int(dec.chosen_t[b])],
                             zone=lat.zones[int(dec.chosen_z[b])],
                             capacity_type=lat.capacity_types[int(dec.chosen_c[b])],
@@ -859,8 +869,9 @@ class Solver:
             feasible = self._feasible_sets_batch(problem, tm, zm, cm)
             for ((d, b), content), (ftypes, fzones, fcaps) in zip(new_entries, feasible):
                 dec = decs[d]
+                pname, extra = _pool_out(problem.node_pools[int(dec.np_id[b])])
                 node = PlannedNode(
-                    node_pool=problem.node_pools[int(dec.np_id[b])].name,
+                    node_pool=pname, extra_labels=extra,
                     instance_type=lat.names[int(dec.chosen_t[b])],
                     zone=lat.zones[int(dec.chosen_z[b])],
                     capacity_type=lat.capacity_types[int(dec.chosen_c[b])],
@@ -1014,8 +1025,9 @@ class Solver:
                 ftypes, fzones, fcaps = self._feasible_sets(
                     problem, mdec.tmask(rows1, lat.T)[0],
                     mdec.zmask(rows1, lat.Z)[0], mdec.cmask(rows1, lat.C)[0])
+                pname, extra = _pool_out(problem.node_pools[int(m_np_id[row])])
                 node = PlannedNode(
-                    node_pool=problem.node_pools[int(m_np_id[row])].name,
+                    node_pool=pname, extra_labels=extra,
                     instance_type=lat.names[int(m_ct[row])],
                     zone=lat.zones[int(m_cz[row])],
                     capacity_type=lat.capacity_types[int(m_cc[row])],

@@ -62,6 +62,7 @@ class BoundPod:
     node_name: str
     zone: str
     capacity_type: str = wk.CAPACITY_TYPE_ON_DEMAND
+    node_labels: Mapping[str, str] = field(default_factory=dict)  # custom-key spread domains
 
 
 def _selector_key(sel: Tuple[Tuple[str, str], ...]) -> Tuple[Tuple[str, str], ...]:
@@ -151,6 +152,11 @@ class _Split:
     count: int
     zone_mask: np.ndarray
     cap_mask: np.ndarray
+    # custom-key label values this slice pins (spread over user-defined
+    # labels — the reference's 'virtual domains' ratio-split technique,
+    # scheduling.md:558-614); build_problem routes the slice to pools
+    # carrying/offering exactly these values
+    custom: Dict[str, str] = field(default_factory=dict)
 
 
 def resolve_group_topology(
@@ -164,6 +170,7 @@ def resolve_group_topology(
     bound: Sequence[BoundPod],
     warnings: List[str],
     pending_counts: Optional[Dict] = None,
+    custom_domains: Optional[Mapping[str, Sequence[str]]] = None,
 ) -> Tuple[List[_Split], GroupTopology, int]:
     """Resolve one pod group's topology constraints.
 
@@ -252,6 +259,7 @@ def resolve_group_topology(
     # ---- topology spread ------------------------------------------------
     zone_spread: Optional[TopologySpreadConstraint] = None
     cap_spread: Optional[TopologySpreadConstraint] = None
+    custom_spreads: List[TopologySpreadConstraint] = []
     for c in pod.topology_spread:
         if c.topology_key == wk.LABEL_ZONE:
             if zone_spread is not None:
@@ -273,8 +281,17 @@ def resolve_group_topology(
                 warnings.append("multiple capacity-type topologySpreadConstraints; first wins")
             else:
                 cap_spread = c
+        elif c.when_unsatisfiable == "ScheduleAnyway":
+            # advisory skew on a custom key: never a split/unschedulable
+            # cause (matches the zone/captype ScheduleAnyway treatment)
+            pass
+        elif custom_domains is not None and custom_domains.get(c.topology_key):
+            custom_spreads.append(c)
         else:
-            warnings.append(f"topologySpreadConstraint on key {c.topology_key!r} is not supported")
+            warnings.append(
+                f"topologySpreadConstraint on key {c.topology_key!r} has no "
+                f"discoverable domains (no NodePool offers the key, no node "
+                f"carries it)")
 
     # finalize class rows at full registry width later (build_problem pads);
     # here record the sparse rows
@@ -362,7 +379,61 @@ def resolve_group_topology(
                     continue
                 m = np.zeros_like(s.cap_mask)
                 m[ci] = True
-                out.append(_Split(int(n), s.zone_mask.copy(), m))
+                out.append(_Split(int(n), s.zone_mask.copy(), m,
+                                  custom=dict(s.custom)))
         splits = out
+
+    # ---- custom-key spread: the 'virtual domains' split -----------------
+    # (reference scheduling.md:558-614: spreading across a user-defined
+    # label whose values come from NodePool requirements — e.g. the
+    # capacity-spread on-demand/spot ratio technique). Domains are
+    # discovered by build_problem; counting works exactly like
+    # zone/captype: existing matching pods per node-label value + pending
+    # sibling additions, then exact water-fill.
+    for c in custom_spreads:
+        key = c.topology_key
+        # counts and pending records index the CANONICAL domain list (the
+        # full discovered set) so sibling groups with different per-group
+        # eligibility still accumulate into the same axis
+        domains = list(custom_domains[key])
+        own = pod.hard_scheduling_requirements()
+        elig = np.array([key not in set(own.keys()) or own.get(key).matches(d)
+                         for d in domains], dtype=bool)
+        if not elig.any():
+            continue
+        sel = tuple(c.label_selector)
+        running = np.zeros((len(domains),), dtype=np.int64)
+        dom_index = {d: i for i, d in enumerate(domains)}
+        for bp in bound:
+            if _matches(sel, bp.pod.labels):
+                di = dom_index.get(bp.node_labels.get(key))
+                if di is not None:
+                    running[di] += 1
+        pk = (_selector_key(sel), key)
+        prior = None
+        if pending_counts is not None:
+            prior = pending_counts.get(pk)
+            if prior is not None and len(prior) == len(domains):
+                running = running + prior
+            else:
+                prior = None
+        adds_total = np.zeros((len(domains),), dtype=np.int64)
+        elig_idx = np.nonzero(elig)[0]
+        out2: List[_Split] = []
+        for s in splits:
+            adds = _water_fill(running[elig_idx], s.count)
+            running[elig_idx] += adds
+            adds_total[elig_idx] += adds
+            for di, n in zip(elig_idx, adds):
+                if n <= 0:
+                    continue
+                out2.append(_Split(int(n), s.zone_mask.copy(),
+                                   s.cap_mask.copy(),
+                                   custom={**s.custom, key: domains[di]}))
+        if pending_counts is not None and _matches(sel, pod.labels):
+            # record ADDS only (bound pods recount for every group)
+            pending_counts[pk] = (prior if prior is not None
+                                  else np.zeros((len(domains),), np.int64)) + adds_total
+        splits = out2
 
     return splits, topo, cut

@@ -332,7 +332,8 @@ class ClusterState:
                 bins.append(ExistingBin(
                     name=node.name, node_pool=node.node_pool or "",
                     instance_type=itype, zone=zone, capacity_type=cap,
-                    used=used, alloc_override=alloc_override))
+                    used=used, alloc_override=alloc_override,
+                    labels=dict(node.labels)))
             registered = {n.node_claim for n in self.nodes.values() if n.node_claim}
             for claim in self.claims.values():
                 if claim.name in registered or claim.deletion_timestamp:
@@ -349,7 +350,7 @@ class ClusterState:
                     instance_type=claim.instance_type,
                     zone=claim.zone or lattice.zones[0],
                     capacity_type=claim.capacity_type or "on-demand",
-                    used=used))
+                    used=used, labels=dict(claim.labels)))
             return bins
 
     def bound_pods(self) -> List[BoundPod]:
@@ -362,7 +363,8 @@ class ClusterState:
                 zone = node.labels.get(wk.LABEL_ZONE, "") if node else ""
                 cap = node.labels.get(wk.LABEL_CAPACITY_TYPE, "on-demand") if node else "on-demand"
                 out.append(BoundPod(pod=pod, node_name=pod.node_name, zone=zone,
-                                    capacity_type=cap))
+                                    capacity_type=cap,
+                                    node_labels=dict(node.labels) if node else {}))
             return out
 
     def pool_usage(self) -> Dict[str, np.ndarray]:

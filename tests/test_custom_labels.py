@@ -1,0 +1,311 @@
+"""Custom-key label assignment (workload segregation) semantics.
+
+Behavioral spec: reference website concepts/scheduling.md:534-556 — a
+NodePool requirement on a user-defined key with the `Exists` operator (or
+`In` over several values) leaves the node's label value free; workloads
+pin it via nodeSelector, Karpenter labels the launched nodes accordingly
+(separating conflicting workloads), and generates a random label when a
+matching workload names none.
+"""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import (
+    NodePool, Operator as ReqOp, Pod, Requirement,
+)
+from karpenter_provider_aws_tpu.apis import wellknown as wk
+from karpenter_provider_aws_tpu.cloud import FakeCloud
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+from karpenter_provider_aws_tpu.operator import Operator, Options
+from karpenter_provider_aws_tpu.solver import Solver, build_problem
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+TEAM = "company.com/team"
+_FAMILIES = ("m5", "c5", "t3")
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    return build_lattice([s for s in build_catalog() if s.family in _FAMILIES])
+
+
+@pytest.fixture(scope="module")
+def solver(lattice):
+    return Solver(lattice)
+
+
+def team_pool(**kw):
+    return NodePool(name=kw.pop("name", "default"), requirements=[
+        Requirement(TEAM, ReqOp.EXISTS, ()),
+        Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN, ("on-demand",))], **kw)
+
+
+def team_pods(team, n=3, prefix=None):
+    prefix = prefix or team
+    return [Pod(name=f"{prefix}-{i}", requests={"cpu": "500m", "memory": "1Gi"},
+                node_selector={TEAM: team}) for i in range(n)]
+
+
+class TestExistsSegregation:
+    def test_conflicting_teams_never_share_a_node(self, solver, lattice):
+        problem = build_problem(team_pods("team-a") + team_pods("team-b"),
+                                [team_pool()], lattice)
+        plan = solver.solve(problem)
+        assert not plan.unschedulable
+        for n in plan.new_nodes:
+            teams = {p.split("-")[1] for p in n.pods}
+            assert len(teams) == 1
+            assert n.extra_labels[TEAM] == f"team-{teams.pop()}"
+            assert n.node_pool == "default"
+
+    def test_multi_value_selector_matches_either(self, solver, lattice):
+        pod = Pod(name="flex", requests={"cpu": "500m"},
+                  required_affinity=[Requirement(TEAM, ReqOp.IN,
+                                                 ("team-a", "team-b"))])
+        plan = solver.solve(build_problem([pod], [team_pool()], lattice))
+        assert not plan.unschedulable
+        (n,) = plan.new_nodes
+        assert n.extra_labels[TEAM] in ("team-a", "team-b")
+
+    def test_unconstrained_pods_prefer_the_base_pool(self, solver, lattice):
+        plan = solver.solve(build_problem(
+            [Pod(name="plain", requests={"cpu": "500m"})],
+            [team_pool()], lattice))
+        (n,) = plan.new_nodes
+        assert n.extra_labels == {}  # base pool; label generated at claim time
+
+    def test_exists_only_demand_gets_generated_value(self, solver, lattice):
+        pod = Pod(name="anyteam", requests={"cpu": "500m"},
+                  required_affinity=[Requirement(TEAM, ReqOp.EXISTS, ())])
+        plan = solver.solve(build_problem([pod], [team_pool()], lattice))
+        assert not plan.unschedulable
+        (n,) = plan.new_nodes
+        assert n.extra_labels[TEAM].startswith("kpat-")
+
+    def test_in_valued_offer_restricts_values(self, solver, lattice):
+        pool = NodePool(name="spread", requirements=[
+            Requirement("capacity-spread", ReqOp.IN, ("1", "2"))])
+        ok = Pod(name="ok", requests={"cpu": "500m"},
+                 node_selector={"capacity-spread": "2"})
+        bad = Pod(name="bad", requests={"cpu": "500m"},
+                  node_selector={"capacity-spread": "9"})
+        plan = solver.solve(build_problem([ok, bad], [pool], lattice))
+        assert "bad" in plan.unschedulable and "ok" not in plan.unschedulable
+        (n,) = [n for n in plan.new_nodes if n.pods]
+        assert n.extra_labels == {"capacity-spread": "2"}
+
+    def test_template_label_still_binds_exactly(self, solver, lattice):
+        """A pool with a fixed template LABEL is not value-free."""
+        pool = NodePool(name="fixed", labels={TEAM: "team-x"})
+        plan = solver.solve(build_problem(
+            team_pods("team-a", n=1) + team_pods("team-x", n=1),
+            [pool], lattice))
+        assert "team-a-0" in plan.unschedulable
+        (n,) = [n for n in plan.new_nodes if n.pods]
+        assert n.pods == ["team-x-0"] and n.extra_labels == {}
+
+
+class TestEndToEnd:
+    def _env(self, lattice):
+        clock = FakeClock()
+        return Operator(options=Options(registration_delay=1.0),
+                        lattice=lattice, cloud=FakeCloud(clock), clock=clock,
+                        node_pools=[team_pool()])
+
+    def test_claims_and_nodes_carry_the_label(self, lattice):
+        env = self._env(lattice)
+        for p in team_pods("team-a", 2) + team_pods("team-b", 2):
+            env.cluster.add_pod(p)
+        env.settle()
+        assert all(p.node_name for p in env.cluster.pods.values())
+        by_team = {}
+        for claim in env.cluster.claims.values():
+            assert claim.node_pool == "default"  # budgets/limits roll up
+            team = claim.labels.get(TEAM)
+            assert team in ("team-a", "team-b")
+            by_team.setdefault(team, []).append(claim)
+            node = env.cluster.node_for_claim(claim.name)
+            assert node is not None and node.labels.get(TEAM) == team
+        assert set(by_team) == {"team-a", "team-b"}
+
+    def test_second_wave_joins_matching_existing_node_only(self, lattice):
+        env = self._env(lattice)
+        for p in team_pods("team-a", 1):
+            env.cluster.add_pod(p)
+        env.settle()
+        assert len(env.cluster.nodes) == 1
+        # wave 2: one more team-a pod (tiny) must join the existing team-a
+        # node; a team-b pod must get a NEW node
+        env.cluster.add_pod(Pod(name="team-a-more", requests={"cpu": "100m"},
+                                node_selector={TEAM: "team-a"}))
+        env.cluster.add_pod(Pod(name="team-b-new", requests={"cpu": "100m"},
+                                node_selector={TEAM: "team-b"}))
+        env.settle()
+        pods_by_node = env.cluster.pods_by_node()
+        assert len(env.cluster.nodes) == 2
+        for node_name, pods in pods_by_node.items():
+            teams = {env.cluster.nodes[node_name].labels.get(TEAM)}
+            for p in pods:
+                assert p.node_selector.get(TEAM) in teams
+
+    def test_unconstrained_pod_node_gets_random_label(self, lattice):
+        """scheduling.md:554: a workload that matches the pool without
+        naming a value still yields a labeled node."""
+        env = self._env(lattice)
+        env.cluster.add_pod(Pod(name="plain", requests={"cpu": "500m"}))
+        env.settle()
+        (claim,) = env.cluster.claims.values()
+        assert claim.labels.get(TEAM, "").startswith("kpat-")
+
+
+class TestCustomKeySpread:
+    """Topology spread over user-defined labels — the reference's 'virtual
+    domains' technique (scheduling.md:558-614): domains discovered from
+    NodePool requirement values, spread balanced by water-fill, each slice
+    pinned to its domain's labeled pool variant."""
+
+    def _ratio_pools(self):
+        return [
+            NodePool(name="spot", requirements=[
+                Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN, ("spot",)),
+                Requirement("capacity-spread", ReqOp.IN, ("2", "3", "4", "5"))]),
+            NodePool(name="on-demand", requirements=[
+                Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN, ("on-demand",)),
+                Requirement("capacity-spread", ReqOp.IN, ("1",))]),
+        ]
+
+    def _spread_pods(self, n, anyway=False):
+        from karpenter_provider_aws_tpu.apis.objects import TopologySpreadConstraint
+        return [Pod(name=f"w{i}", labels={"app": "web"},
+                    requests={"cpu": "1", "memory": "2Gi"},
+                    topology_spread=[TopologySpreadConstraint(
+                        max_skew=1, topology_key="capacity-spread",
+                        when_unsatisfiable=("ScheduleAnyway" if anyway
+                                            else "DoNotSchedule"),
+                        label_selector=(("app", "web"),))])
+                for i in range(n)]
+
+    def test_four_to_one_spot_ratio(self, solver, lattice):
+        plan = solver.solve(build_problem(self._spread_pods(10),
+                                          self._ratio_pools(), lattice))
+        assert not plan.unschedulable
+        per_cap = {"spot": 0, "on-demand": 0}
+        per_domain = {}
+        for n in plan.new_nodes:
+            d = n.extra_labels["capacity-spread"]
+            per_domain[d] = per_domain.get(d, 0) + len(n.pods)
+            per_cap[n.capacity_type] += len(n.pods)
+        assert per_cap == {"spot": 8, "on-demand": 2}
+        assert all(v == 2 for v in per_domain.values())
+
+    def test_schedule_anyway_spread_is_advisory(self, solver, lattice):
+        plan = solver.solve(build_problem(self._spread_pods(10, anyway=True),
+                                          self._ratio_pools(), lattice))
+        assert not plan.unschedulable
+        assert not any("capacity-spread" in w for w in plan.warnings)
+
+    def test_undiscoverable_domains_warn(self, solver, lattice):
+        plan = solver.solve(build_problem(
+            self._spread_pods(4), [NodePool(name="plain")], lattice))
+        assert any("no discoverable domains" in w for w in plan.warnings)
+
+    def test_bound_pods_count_into_domains(self, lattice):
+        """Existing matching pods on labeled nodes shift the water-fill:
+        a domain already holding pods receives fewer new ones."""
+        clock = FakeClock()
+        env = Operator(options=Options(registration_delay=1.0),
+                       lattice=lattice, cloud=FakeCloud(clock), clock=clock,
+                       node_pools=self._ratio_pools())
+        for p in self._spread_pods(5):
+            env.cluster.add_pod(p)
+        env.settle()
+        by_domain = {}
+        for node_name, pods in env.cluster.pods_by_node().items():
+            d = env.cluster.nodes[node_name].labels.get("capacity-spread")
+            by_domain[d] = by_domain.get(d, 0) + len(pods)
+        assert set(by_domain) == {"1", "2", "3", "4", "5"}
+        # second wave of 5: counts must stay balanced at exactly 2 each
+        for p in self._spread_pods(5, anyway=False):
+            env.cluster.add_pod(Pod(
+                name=f"w2-{p.name}", labels=p.labels, requests=p.requests,
+                topology_spread=list(p.topology_spread)))
+        env.settle()
+        by_domain = {}
+        for node_name, pods in env.cluster.pods_by_node().items():
+            d = env.cluster.nodes[node_name].labels.get("capacity-spread")
+            by_domain[d] = by_domain.get(d, 0) + len(pods)
+        assert all(v == 2 for v in by_domain.values()), by_domain
+
+
+class TestReviewRegressions:
+    def test_demand_plus_spread_composes(self, solver, lattice):
+        """A group pinning one custom key AND spreading over another gets
+        composed pool variants (team=a x rack=r1/r2), not unschedulable."""
+        from karpenter_provider_aws_tpu.apis.objects import TopologySpreadConstraint
+        pool = NodePool(name="default", requirements=[
+            Requirement(TEAM, ReqOp.EXISTS, ()),
+            Requirement("rack", ReqOp.IN, ("r1", "r2"))])
+        pods = [Pod(name=f"p{i}", labels={"app": "db"},
+                    requests={"cpu": "1", "memory": "2Gi"},
+                    node_selector={TEAM: "team-a"},
+                    topology_spread=[TopologySpreadConstraint(
+                        max_skew=1, topology_key="rack",
+                        label_selector=(("app", "db"),))])
+                for i in range(4)]
+        plan = solver.solve(build_problem(pods, [pool], lattice))
+        assert not plan.unschedulable, plan.unschedulable
+        racks = {}
+        for n in plan.new_nodes:
+            assert n.extra_labels[TEAM] == "team-a"
+            racks[n.extra_labels["rack"]] = racks.get(n.extra_labels["rack"], 0) + len(n.pods)
+        assert racks == {"r1": 2, "r2": 2}
+
+    def test_generated_value_is_stable_across_passes(self, solver, lattice):
+        """Exists-only demands reuse the node the first pass labeled (the
+        generated value derives from the group content, not batch order)."""
+        def demand(name):
+            return Pod(name=name, requests={"cpu": "100m"},
+                       required_affinity=[Requirement(TEAM, ReqOp.EXISTS, ())])
+        p1 = solver.solve(build_problem([demand("w1")], [team_pool()], lattice))
+        # a different batch composition around the same workload shape
+        p2 = solver.solve(build_problem(
+            [Pod(name="other", requests={"cpu": "2"}), demand("w2")],
+            [team_pool()], lattice))
+        v1 = p1.new_nodes[0].extra_labels[TEAM]
+        (n2,) = [n for n in p2.new_nodes if "w2" in n.pods]
+        assert v1 == n2.extra_labels[TEAM]
+
+    def test_in_valued_pool_labels_unconstrained_claims(self, lattice):
+        """scheduling.md template contract: a node of a pool requiring
+        team In (a,b) always carries one of those values."""
+        clock = FakeClock()
+        env = Operator(options=Options(registration_delay=1.0),
+                       lattice=lattice, cloud=FakeCloud(clock), clock=clock,
+                       node_pools=[NodePool(name="default", requirements=[
+                           Requirement(TEAM, ReqOp.IN, ("team-a", "team-b"))])])
+        env.cluster.add_pod(Pod(name="plain", requests={"cpu": "500m"}))
+        env.settle()
+        (claim,) = env.cluster.claims.values()
+        assert claim.labels.get(TEAM) in ("team-a", "team-b")
+
+    def test_sidecar_preserves_custom_label_state(self, lattice):
+        """ExistingBin.labels and BoundPod.node_labels survive the wire:
+        a remote solve joins the labeled existing node instead of
+        launching a duplicate."""
+        import numpy as np
+        from karpenter_provider_aws_tpu.apis import serde
+        from karpenter_provider_aws_tpu.solver.problem import ExistingBin
+        ti = lattice.name_to_idx["m5.xlarge"]
+        b = ExistingBin(name="n1", node_pool="default",
+                        instance_type="m5.xlarge", zone=lattice.zones[0],
+                        capacity_type="on-demand",
+                        used=np.zeros_like(lattice.alloc[ti]),
+                        labels={TEAM: "team-a"})
+        rt = serde.existing_bin_from_dict(serde.existing_bin_to_dict(b))
+        assert rt.labels == {TEAM: "team-a"}
+        problem = build_problem(team_pods("team-a", 1), [team_pool()],
+                                lattice, existing=[rt])
+        solver = Solver(lattice)
+        plan = solver.solve(problem)
+        assert plan.existing_assignments.get("n1") == ["team-a-0"]
+        assert not plan.new_nodes

@@ -341,12 +341,15 @@ class TestReviewRegressions:
         assert sum(len(v) for v in plan.existing_assignments.values()) == 3
         assert plan.new_nodes == []
 
-    def test_unsupported_topology_key_surfaces_warning(self, solver, lattice):
+    def test_undiscoverable_topology_key_surfaces_warning(self, solver, lattice):
+        """Custom-key spreads are supported when a NodePool offers the key
+        (tests/test_custom_labels.py); with no domain source anywhere the
+        constraint surfaces a warning instead of silently dropping."""
         from karpenter_provider_aws_tpu.apis import TopologySpreadConstraint
         pods = [Pod(name="p", requests={"cpu": "1"}, topology_spread=[
             TopologySpreadConstraint(max_skew=1, topology_key="example.com/rack")])]
         plan = solver.solve(build_problem(pods, [default_pool()], lattice))
-        assert any("not supported" in w for w in plan.warnings)
+        assert any("no discoverable domains" in w for w in plan.warnings)
 
 
 class TestLeanDecodeBuffer:

@@ -128,8 +128,10 @@ class PodDisruptionBudget:
     policy/v1 object the reference's drain respects — reference
     concepts/disruption.md:33 "evicting the pods ... to respect PDBs"
     and :112, the `pdb ... prevents pod evictions` Unconsolidatable
-    event). Exactly one of max_unavailable / min_available should be set
-    (as in Kubernetes); when both are, the tighter rule wins."""
+    event). Exactly one of max_unavailable / min_available must be set —
+    the admission webhook (webhooks.validate_pdb) rejects anything else,
+    as Kubernetes does; ClusterState still evaluates the tighter rule
+    defensively if an unvalidated object carries both."""
 
     name: str
     label_selector: Dict[str, str] = field(default_factory=dict)

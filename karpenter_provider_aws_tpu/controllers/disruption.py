@@ -46,7 +46,7 @@ from ..metrics import Registry, wire_core_metrics
 from ..solver.solve import NodePlan, ProbeResult, Solver
 from ..state.cluster import ClusterState
 from ..utils.clock import Clock
-from .provisioning import Provisioner, ProvisionResult, nodepool_hash
+from .provisioning import Provisioner, nodepool_hash
 from .termination import TerminationController
 
 SPOT_TO_SPOT_MIN_TYPES = 15   # disruption.md:129
@@ -457,10 +457,9 @@ class DisruptionController:
         # originals still count toward usage here — correct, both exist
         # during the transition. If any replacement cannot fit the limits
         # (even downsized), abort: never drain without standing capacity.
-        probe = ProvisionResult(plan=plan)
-        planned = self.provisioner._enforce_limits(list(plan.new_nodes), probe,
-                                                   warn=False)
-        if len(planned) != len(plan.new_nodes):
+        planned, over_limit = self.provisioner._enforce_limits(
+            list(plan.new_nodes))
+        if over_limit:
             self.recorder.publish("Warning", "DisruptionBlocked", "NodeClaim",
                                   removed[0].name if removed else "",
                                   f"{reason} replacement exceeds nodepool limits")

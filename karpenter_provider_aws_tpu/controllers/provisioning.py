@@ -15,7 +15,7 @@ from __future__ import annotations
 import itertools
 import threading
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -135,33 +135,81 @@ class Provisioner:
             return ProvisionResult(plan=None)
         lattice = masked_view(self.solver.lattice, self.unavailable.mask(self.solver.lattice))
         pvcs, storage_classes = self.cluster.volume_state()
+        # one usage snapshot serves the whole pass: the initial solve's
+        # headroom, every _enforce_limits round, and every retry's headroom
+        pass_usage = self.cluster.pool_usage()
         plan = self.solver.solve_relaxed(
             pending, list(self.node_pools.values()), lattice,
             existing=self.cluster.existing_bins(lattice),
             daemonset_pods=self.cluster.daemonset_pods(),
             bound_pods=self.cluster.bound_pods(),
-            pvcs=pvcs, storage_classes=storage_classes)
+            pvcs=pvcs, storage_classes=storage_classes,
+            pool_headroom=self._pool_headroom(pass_usage))
         self._m_batch.observe(len(pending))
         self._m_sched.observe(plan.solve_seconds)
         self._m_sim.observe(plan.device_seconds)
         result = ProvisionResult(plan=plan)
 
-        for name, reason in plan.unschedulable.items():
-            self.recorder.publish("Warning", "FailedScheduling", "Pod", name, reason)
-        result.pods_unschedulable = len(plan.unschedulable)
+        def surface_unschedulable(p: NodePlan) -> None:
+            for name, reason in p.unschedulable.items():
+                self.recorder.publish("Warning", "FailedScheduling", "Pod",
+                                      name, reason)
+            result.pods_unschedulable += len(p.unschedulable)
 
-        # pods that fit existing capacity bind (in the real control plane the
-        # kube-scheduler binds; the sim binds directly, reference stratum-2)
-        for node_name, pods in plan.existing_assignments.items():
-            target_is_claim = node_name in self.cluster.claims and node_name not in self.cluster.nodes
-            for p in pods:
-                if target_is_claim:
-                    self.cluster.nominate(p, node_name)
-                else:
-                    self.cluster.bind_pod(p, node_name)
-                result.pods_scheduled += 1
+        def bind_existing(p: NodePlan) -> None:
+            # pods that fit existing capacity bind (in the real control
+            # plane the kube-scheduler binds; the sim binds directly,
+            # reference stratum-2)
+            for node_name, pods in p.existing_assignments.items():
+                target_is_claim = (node_name in self.cluster.claims
+                                   and node_name not in self.cluster.nodes)
+                for pn in pods:
+                    if target_is_claim:
+                        self.cluster.nominate(pn, node_name)
+                    else:
+                        self.cluster.bind_pod(pn, node_name)
+                    result.pods_scheduled += 1
 
-        planned = self._enforce_limits(plan.new_nodes, result)
+        surface_unschedulable(plan)
+        bind_existing(plan)
+
+        # limits + fallback (scheduling.md:488): a node the pool's limits
+        # cannot hold re-solves its pods against the remaining pools —
+        # the reserved-capacity pattern (high-weight limited pool fills
+        # first, overflow lands on the generic pool). The loop terminates:
+        # each retry excludes at least one more saturated pool.
+        planned: List[PlannedNode] = []
+        current = plan
+        excluded: set = set()
+        for _ in range(len(self.node_pools) + 1):
+            fitting, dropped = self._enforce_limits(current.new_nodes,
+                                                    usage=pass_usage)
+            planned += fitting
+            if not dropped:
+                break
+            excluded |= {n.node_pool for n in dropped}
+            pools_left = [p for p in self.node_pools.values()
+                          if p.name not in excluded]
+            retry_pods = [self.cluster.pods[pn] for n in dropped
+                          for pn in n.pods if pn in self.cluster.pods]
+            if not pools_left or not retry_pods:
+                for n in dropped:
+                    live = [pn for pn in n.pods if pn in self.cluster.pods]
+                    for pn in live:
+                        self.recorder.publish(
+                            "Warning", "FailedScheduling", "Pod", pn,
+                            f"nodepool {n.node_pool} limit exceeded")
+                    result.pods_unschedulable += len(live)
+                break
+            current = self.solver.solve_relaxed(
+                retry_pods, pools_left, lattice,
+                existing=self.cluster.existing_bins(lattice),
+                daemonset_pods=self.cluster.daemonset_pods(),
+                bound_pods=self.cluster.bound_pods(),
+                pvcs=pvcs, storage_classes=storage_classes,
+                pool_headroom=self._pool_headroom(pass_usage))
+            surface_unschedulable(current)
+            bind_existing(current)
         for node in planned:
             claim = self._make_claim(node)
             self.cluster.add_claim(claim)
@@ -202,6 +250,30 @@ class Provisioner:
         self._m_unsched_pods.set(result.pods_unschedulable)
         return result
 
+    def _pool_headroom(self, usage: Dict[str, np.ndarray]
+                       ) -> Dict[str, np.ndarray]:
+        """Per limited pool: remaining capacity budget on its limited axes
+        (np.inf elsewhere). Fed into the solve so a fresh node's type
+        options shrink as the pool approaches spec.limits — the reference
+        caps its in-flight simulated nodes the same way, which is what
+        lets a limited pool fill partially instead of all-or-nothing."""
+        from ..apis.resources import axis as res_axis
+        out: Dict[str, np.ndarray] = {}
+        for name, pool in self.node_pools.items():
+            limit = pool.limits_vec()
+            if limit is None:
+                continue
+            current = usage.get(name, np.zeros((R,), np.float32))
+            rem = np.full((R,), np.inf, np.float32)
+            for key in pool.limits:
+                try:
+                    ax = res_axis(key)
+                except KeyError:
+                    continue
+                rem[ax] = max(limit[ax] - current[ax], 0.0)
+            out[name] = rem
+        return out
+
     def _offering_price(self, node: PlannedNode) -> float:
         """Cheapest available offering price for the node's instance type
         within its feasible zone/capacity-type sets."""
@@ -221,17 +293,25 @@ class Provisioner:
         return float(sub.min())
 
     def _enforce_limits(self, nodes: Sequence[PlannedNode],
-                        result: ProvisionResult,
-                        warn: bool = True) -> List[PlannedNode]:
+                        usage: Optional[Dict[str, np.ndarray]] = None,
+                        ) -> Tuple[List[PlannedNode], List[PlannedNode]]:
         """Enforce NodePool resource limits on the plan (CRD nodepools
         limits). A violating node first tries to DOWNSIZE: every type in the
         bin's feasible set can hold the bin's pods by construction, so the
-        cheapest one whose capacity fits the remaining budget substitutes;
-        only if none fits are the pods left pending. ``warn=False`` runs it
-        as a pure probe (disruption replacement gating) without publishing
-        FailedScheduling events for pods that are not actually pending."""
-        usage = self.cluster.pool_usage()
+        cheapest one whose capacity fits the remaining budget substitutes.
+        Returns (fitting nodes, dropped nodes) — the caller decides whether
+        dropped pods retry against other pools (the scheduling.md:488
+        Fallback pattern) or surface as unschedulable.
+
+        ``usage`` carries committed capacity ACROSS calls: the fallback
+        loop passes one dict for the whole pass so nodes accepted in an
+        earlier retry round keep counting against their pool's limit
+        (cluster state alone misses them — their claims are only created
+        after the loop)."""
+        if usage is None:
+            usage = self.cluster.pool_usage()
         out: List[PlannedNode] = []
+        dropped: List[PlannedNode] = []
         lat = self.solver.lattice
         for node in nodes:
             pool = self.node_pools.get(node.node_pool)
@@ -258,11 +338,7 @@ class Provisioner:
             candidates = node.feasible_types or [node.instance_type]
             fitting = [t for t in candidates if fits(t)]
             if not fitting:
-                if warn:
-                    for p in node.pods:
-                        self.recorder.publish("Warning", "FailedScheduling", "Pod", p,
-                                              f"nodepool {node.node_pool} limit exceeded")
-                result.pods_unschedulable += len(node.pods)
+                dropped.append(node)
                 continue
             # restrict the claim's launch flexibility to limit-fitting types
             node.feasible_types = fitting
@@ -271,7 +347,7 @@ class Provisioner:
                 node.price_per_hour = self._offering_price(node)
             usage[node.node_pool] = current + lat.capacity[lat.name_to_idx[node.instance_type]]
             out.append(node)
-        return out
+        return out, dropped
 
     def _make_claim(self, node: PlannedNode) -> NodeClaim:
         """NodePlan bin → NodeClaim launch contract. The claim carries the

@@ -347,7 +347,8 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
                   daemonset_pods: Sequence[Pod] = (),
                   bound_pods: Sequence[BoundPod] = (),
                   pvcs: Optional[Mapping] = None,
-                  storage_classes: Optional[Mapping] = None) -> Problem:
+                  storage_classes: Optional[Mapping] = None,
+                  pool_headroom: Optional[Mapping[str, np.ndarray]] = None) -> Problem:
     with _INTERN_LOCK:
         if len(_SIG_TUPLES) >= _INTERN_MAX:
             _RK_INTERN.clear()
@@ -356,7 +357,7 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
             _BAD_SIDS.clear()
         return _build_problem(pods, node_pools, lattice, existing,
                               daemonset_pods, bound_pods, pvcs,
-                              storage_classes)
+                              storage_classes, pool_headroom)
 
 
 def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: Lattice,
@@ -364,7 +365,8 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
                    daemonset_pods: Sequence[Pod] = (),
                    bound_pods: Sequence[BoundPod] = (),
                    pvcs: Optional[Mapping] = None,
-                   storage_classes: Optional[Mapping] = None) -> Problem:
+                   storage_classes: Optional[Mapping] = None,
+                   pool_headroom: Optional[Mapping[str, np.ndarray]] = None) -> Problem:
     real_pools = sorted(node_pools, key=lambda p: (-p.weight, p.name))
     T, Z, C = lattice.T, lattice.Z, lattice.C
     key_values = lattice.key_values_present()
@@ -619,6 +621,15 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
         m = compile_masks(reqs, lattice, extra_labels=pool.labels,
                           skip_unresolved_custom=True)
         np_type[pi], np_zone[pi], np_cap[pi] = m.type_mask, m.zone_mask, m.cap_mask
+        if pool_headroom is not None:
+            # remaining limit budget caps a NEW node's size at solve time
+            # (the reference narrows an in-flight node's instance-type
+            # options as the pool approaches spec.limits) — limits roll up
+            # to the base pool for virtual variants
+            rem = pool_headroom.get(pool.base_name or pool.name)
+            if rem is not None:
+                np_type[pi] &= np.all(lattice.capacity <= rem[None, :] + 1e-6,
+                                      axis=1)
         for ds in daemonset_pods:
             # a daemonset lands on the pool's nodes iff it tolerates the pool
             # taints and its node selectors are compatible (reference

@@ -493,7 +493,8 @@ class Solver:
     @_locked
     def solve_relaxed(self, pods, node_pools, lattice=None, existing=(),
                       daemonset_pods=(), bound_pods=(), pvcs=None,
-                      storage_classes=None, mesh=None) -> NodePlan:
+                      storage_classes=None, mesh=None,
+                      pool_headroom=None) -> NodePlan:
         """Solve with preferred-rule relaxation (reference
         scheduling.md:203-206, 322-334).
 
@@ -525,7 +526,8 @@ class Solver:
             problem = build_problem(eff, node_pools, lattice, existing=existing,
                                     daemonset_pods=daemonset_pods,
                                     bound_pods=bound_pods, pvcs=pvcs,
-                                    storage_classes=storage_classes)
+                                    storage_classes=storage_classes,
+                                    pool_headroom=pool_headroom)
             plan = self.solve(problem, mesh=mesh)
             total_solve += plan.solve_seconds
             total_device += plan.device_seconds

@@ -98,6 +98,30 @@ def validate_node_class(nc: NodeClass) -> List[str]:
     return errs
 
 
+def validate_pdb(pdb) -> List[str]:
+    """policy/v1 PodDisruptionBudget validation: exactly one of
+    maxUnavailable / minAvailable, both non-negative."""
+    errs: List[str] = []
+    if not pdb.name:
+        errs.append("name is required")
+    has_max = pdb.max_unavailable is not None
+    has_min = pdb.min_available is not None
+    if has_max == has_min:
+        errs.append("exactly one of maxUnavailable / minAvailable is required")
+    if has_max and int(pdb.max_unavailable) < 0:
+        errs.append("maxUnavailable must be >= 0")
+    if has_min and int(pdb.min_available) < 0:
+        errs.append("minAvailable must be >= 0")
+    return errs
+
+
+def admit_pdb(pdb):
+    errs = validate_pdb(pdb)
+    if errs:
+        raise AdmissionError(f"PodDisruptionBudget/{pdb.name}: " + "; ".join(errs))
+    return pdb
+
+
 def admit_node_pool(pool: NodePool) -> NodePool:
     pool = default_node_pool(pool)
     errs = validate_node_pool(pool)

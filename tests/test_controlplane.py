@@ -445,11 +445,10 @@ class TestReviewRegressions:
         assert u.seq_num > seq
 
     def test_nodepool_hash_annotation_set_and_drift(self, env):
-        from karpenter_provider_aws_tpu.apis import wellknown as wk2
         env.cluster.add_pod(pods(1)[0])
         env.provisioner.provision_once()
         (claim,) = env.cluster.claims.values()
-        assert wk2.ANNOTATION_NODEPOOL_HASH in claim.annotations
+        assert wk.ANNOTATION_NODEPOOL_HASH in claim.annotations
         env.settle()
         env.node_pools["default"].labels["team"] = "new"
         for _ in range(20):
@@ -498,3 +497,111 @@ class TestLatticeGauges:
         env.unavailable.cleanup()
         env.run_once()
         assert g.value(instance_type="m5.large", capacity_type=cap, zone=zone) == 1.0
+
+
+class TestReservedCapacityPriority:
+    """scheduling.md:450-533 (Savings Plans / Reserved Instances +
+    Fallback): a high-weight NodePool pinned to the reserved type and
+    capped by spec.limits fills FIRST; overflow falls back to the generic
+    default pool instead of going unschedulable."""
+
+    def test_reserved_pool_fills_then_falls_back(self, lattice):
+        clock = FakeClock()
+        pools = [
+            NodePool(name="reserved-instance", weight=50,
+                     limits={"cpu": "8"},   # one c5.2xlarge worth
+                     requirements=[
+                         Requirement(wk.LABEL_INSTANCE_TYPE, ReqOperator.IN,
+                                     ("c5.2xlarge",)),
+                         Requirement(wk.LABEL_CAPACITY_TYPE, ReqOperator.IN,
+                                     ("on-demand",))]),
+            NodePool(name="default",
+                     requirements=[
+                         Requirement(wk.LABEL_CAPACITY_TYPE, ReqOperator.IN,
+                                     ("on-demand",))]),
+        ]
+        env = Operator(options=Options(registration_delay=1.0),
+                       lattice=lattice, cloud=FakeCloud(clock), clock=clock,
+                       node_pools=pools)
+        # ~20 cpu of demand: far beyond the 8-cpu reserved limit
+        for i in range(10):
+            env.cluster.add_pod(Pod(name=f"p{i}",
+                                    requests={"cpu": "2", "memory": "2Gi"}))
+        env.settle()
+        assert all(p.node_name for p in env.cluster.pods.values())
+        by_pool = {}
+        for c in env.cluster.claims.values():
+            by_pool.setdefault(c.node_pool, []).append(c)
+        # reserved capacity engaged first and is capped by its limit
+        assert "reserved-instance" in by_pool
+        reserved_cpu = sum(
+            lattice.capacity[lattice.name_to_idx[c.instance_type]][0]
+            for c in by_pool["reserved-instance"])
+        assert reserved_cpu <= 8000  # millicores
+        assert all(c.instance_type == "c5.2xlarge"
+                   for c in by_pool["reserved-instance"])
+        # the overflow landed on the generic pool
+        assert by_pool.get("default"), by_pool
+
+    def test_fallback_rounds_share_one_limit_budget(self, lattice):
+        """A retry round must see capacity accepted earlier in the SAME
+        pass: pool B's limit cannot be spent once by round 1 and again by
+        the fallback round (claims only materialize after the loop)."""
+        clock = FakeClock()
+        pools = [
+            NodePool(name="paused", weight=50, limits={"cpu": "0"},
+                     requirements=[
+                         Requirement("tier", ReqOperator.IN, ("gold",)),
+                         Requirement(wk.LABEL_CAPACITY_TYPE, ReqOperator.IN,
+                                     ("on-demand",))]),
+            NodePool(name="default", limits={"cpu": "8"},
+                     requirements=[
+                         Requirement(wk.LABEL_CAPACITY_TYPE, ReqOperator.IN,
+                                     ("on-demand",))]),
+        ]
+        env = Operator(options=Options(registration_delay=1.0),
+                       lattice=lattice, cloud=FakeCloud(clock), clock=clock,
+                       node_pools=pools)
+        # generic demand that fills default's 8-cpu limit in round 1
+        for i in range(4):
+            env.cluster.add_pod(Pod(name=f"gen{i}",
+                                    requests={"cpu": "2", "memory": "2Gi"}))
+        # gold-tier pods whose round-1 pool (paused) drops them into the
+        # fallback retry against default
+        for i in range(2):
+            env.cluster.add_pod(Pod(name=f"gold{i}",
+                                    requests={"cpu": "2", "memory": "2Gi"},
+                                    node_selector={"tier": "gold"}))
+        env.settle(max_rounds=20)
+        launched_cpu = sum(
+            lattice.capacity[lattice.name_to_idx[c.instance_type]][0]
+            for c in env.cluster.claims.values() if c.node_pool == "default")
+        assert launched_cpu <= 8000, \
+            f"default pool limit double-spent: {launched_cpu}m launched"
+        assert not any(c.node_pool == "paused"
+                       for c in env.cluster.claims.values())
+
+    def test_limited_pool_fills_partially_to_its_cap(self, lattice):
+        """A limited pool takes what fits instead of all-or-nothing: the
+        solve caps fresh-node type options by the pool's remaining
+        headroom (the reference narrows in-flight node options the same
+        way as spec.limits approaches)."""
+        clock = FakeClock()
+        env = Operator(options=Options(registration_delay=1.0),
+                       lattice=lattice, cloud=FakeCloud(clock), clock=clock,
+                       node_pools=[NodePool(
+                           name="default", limits={"cpu": "8"},
+                           requirements=[Requirement(
+                               wk.LABEL_CAPACITY_TYPE, ReqOperator.IN,
+                               ("on-demand",))])])
+        for i in range(6):
+            env.cluster.add_pod(Pod(name=f"gen{i}",
+                                    requests={"cpu": "2", "memory": "2Gi"}))
+        env.settle(max_rounds=20)
+        launched_cpu = sum(
+            lattice.capacity[lattice.name_to_idx[c.instance_type]][0]
+            for c in env.cluster.claims.values())
+        bound = sum(1 for p in env.cluster.pods.values() if p.node_name)
+        assert 0 < launched_cpu <= 8000
+        assert bound >= 3           # partial fill, not zero
+        assert env.cluster.pending_pods()  # overflow correctly pending

@@ -99,3 +99,28 @@ class TestKompat:
         v = VersionProvider(FakeCloud(FakeClock())).get()
         _, rows = kompat.load_matrix()
         assert kompat.check(rows, "0.1.0", v) is not None, v
+
+
+class TestWebhookPdb:
+    def test_pdb_validation_one_of(self):
+        import pytest
+        from karpenter_provider_aws_tpu.apis import PodDisruptionBudget
+        from karpenter_provider_aws_tpu.webhooks import (
+            AdmissionError, admit_pdb, validate_pdb)
+        ok = PodDisruptionBudget(name="x", max_unavailable=1)
+        assert validate_pdb(ok) == [] and admit_pdb(ok) is ok
+        assert validate_pdb(PodDisruptionBudget(name="x"))           # neither
+        assert validate_pdb(PodDisruptionBudget(name="x", max_unavailable=1,
+                                                min_available=1))    # both
+        assert validate_pdb(PodDisruptionBudget(name="x", min_available=-1))
+        with pytest.raises(AdmissionError):
+            admit_pdb(PodDisruptionBudget(name="x"))
+
+
+class TestDeflake:
+    def test_deflake_runs_and_reports(self):
+        """One clean repetition over a tiny fast module proves the harness
+        loop, seed variation, and exit-code plumbing."""
+        import deflake
+        rc = deflake.main(["-n", "2", "tests/test_units.py"])
+        assert rc == 0

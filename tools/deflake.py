@@ -1,0 +1,66 @@
+"""deflake — repeat the test suite until it fails.
+
+Analog of the reference's flake hunter (`make deflake`, Makefile:66-73:
+ginkgo --race --until-it-fails over randomized spec order). Python has
+no -race, so the lever here is repetition under varied hash seeds and
+reversed file order, which shakes out ordering assumptions, shared-state
+leaks between tests, and timing-sensitive threading bugs.
+
+Usage: python tools/deflake.py [-n MAX_RUNS] [pytest args...]
+Exits non-zero on the first failing run, echoing its seed/order so the
+failure reproduces.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def run_once(i: int, pytest_args: list) -> int:
+    env = dict(os.environ)
+    # never seed 0: PYTHONHASHSEED=0 DISABLES hash randomization, the
+    # opposite of this tool's lever (valid seeds are 0..2^32-1)
+    env["PYTHONHASHSEED"] = str((i * 7919 + 1) % 4294967296)
+    order = ["-p", "no:cacheprovider"]
+    args = [sys.executable, "-m", "pytest", "-q", *order, *pytest_args]
+    if i % 2 == 1 and not any(a.startswith("-") for a in pytest_args):
+        # reversed file order every other run: spots inter-file state
+        # leaks. Only when the args are pure paths — an option's VALUE
+        # can itself be a path ('--ignore tests/x.py') and reordering
+        # around options silently changes what runs.
+        explicit = [a for a in pytest_args
+                    if (REPO / a).exists() or Path(a).exists()]
+        files = ([Path(a) for a in explicit] if explicit
+                 else sorted((REPO / "tests").glob("test_*.py")))
+        args = [a for a in args if a not in explicit]
+        args += [str(t) for t in sorted(files, reverse=True)]
+    print(f"--- run {i} (PYTHONHASHSEED={env['PYTHONHASHSEED']}, "
+          f"{'reversed' if i % 2 else 'default'} order)", flush=True)
+    return subprocess.call(args, cwd=str(REPO), env=env)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-n", "--max-runs", type=int, default=5)
+    args, pytest_args = p.parse_known_args(argv)
+    t0 = time.time()
+    for i in range(args.max_runs):
+        rc = run_once(i, pytest_args or ["tests/"])
+        if rc != 0:
+            print(f"deflake: FAILED on run {i} (rc={rc}) after "
+                  f"{time.time() - t0:.0f}s — reproduce with the seed/order "
+                  f"above", flush=True)
+            return rc
+    print(f"deflake: {args.max_runs} clean runs in {time.time() - t0:.0f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

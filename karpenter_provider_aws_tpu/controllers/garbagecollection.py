@@ -61,8 +61,9 @@ class GarbageCollectionController:
                                   claim.name, f"instance {iid} is gone")
             node = self.cluster.node_for_claim(claim.name)
             if node is not None:
-                self.cluster.unbind_pods_on(node.name)
-                self.cluster.delete_node(node.name)
+                # evict_node deletes daemonset pods with the node — no
+                # phantom overhead in future node sizing
+                self.cluster.evict_node(node.name)
             self.cluster.delete_claim(claim.name)
         # leaked instances: running but unclaimed past the grace window
         for inst in self.cloud_provider.list_instances():

@@ -250,28 +250,39 @@ class Provisioner:
         self._m_unsched_pods.set(result.pods_unschedulable)
         return result
 
+    @staticmethod
+    def _remaining(pool: NodePool, current: np.ndarray) -> Optional[np.ndarray]:
+        """The pool's remaining limit budget per axis: limit - current on
+        every axis the pool names (an explicit 0 is the standard
+        pause-this-pool pattern and must block), np.inf elsewhere. The
+        single source of the limited-axes semantics — both the solve-time
+        headroom mask and _enforce_limits consume it."""
+        from ..apis.resources import axis as res_axis
+        limit = pool.limits_vec()
+        if limit is None:
+            return None
+        rem = np.full((R,), np.inf, np.float32)
+        for key in pool.limits:
+            try:
+                ax = res_axis(key)
+            except KeyError:
+                continue
+            rem[ax] = max(limit[ax] - current[ax], 0.0)
+        return rem
+
     def _pool_headroom(self, usage: Dict[str, np.ndarray]
                        ) -> Dict[str, np.ndarray]:
-        """Per limited pool: remaining capacity budget on its limited axes
-        (np.inf elsewhere). Fed into the solve so a fresh node's type
-        options shrink as the pool approaches spec.limits — the reference
-        caps its in-flight simulated nodes the same way, which is what
-        lets a limited pool fill partially instead of all-or-nothing."""
-        from ..apis.resources import axis as res_axis
+        """Per limited pool: remaining capacity budget (see _remaining).
+        Fed into the solve so a fresh node's type options shrink as the
+        pool approaches spec.limits — the reference caps its in-flight
+        simulated nodes the same way, which is what lets a limited pool
+        fill partially instead of all-or-nothing."""
+        zeros = np.zeros((R,), np.float32)
         out: Dict[str, np.ndarray] = {}
         for name, pool in self.node_pools.items():
-            limit = pool.limits_vec()
-            if limit is None:
-                continue
-            current = usage.get(name, np.zeros((R,), np.float32))
-            rem = np.full((R,), np.inf, np.float32)
-            for key in pool.limits:
-                try:
-                    ax = res_axis(key)
-                except KeyError:
-                    continue
-                rem[ax] = max(limit[ax] - current[ax], 0.0)
-            out[name] = rem
+            rem = self._remaining(pool, usage.get(name, zeros))
+            if rem is not None:
+                out[name] = rem
         return out
 
     def _offering_price(self, node: PlannedNode) -> float:
@@ -320,20 +331,11 @@ class Provisioner:
                 out.append(node)
                 continue
             current = usage.get(node.node_pool, np.zeros((R,), np.float32))
-            # an axis is limited iff the pool names it — an explicit 0 is the
-            # standard "pause this pool" pattern and must block, not bypass
-            from ..apis.resources import axis as res_axis
-            limited = np.zeros_like(limit, dtype=bool)
-            for key in pool.limits:
-                try:
-                    limited[res_axis(key)] = True
-                except KeyError:
-                    pass
-            remaining = np.where(limited, limit - current, np.inf)
+            remaining = self._remaining(pool, current)
 
             def fits(tname: str) -> bool:
-                return bool(np.all(lat.capacity[lat.name_to_idx[tname]][limited]
-                                   <= remaining[limited] + 1e-6))
+                return bool(np.all(lat.capacity[lat.name_to_idx[tname]]
+                                   <= remaining + 1e-6))
 
             candidates = node.feasible_types or [node.instance_type]
             fitting = [t for t in candidates if fits(t)]

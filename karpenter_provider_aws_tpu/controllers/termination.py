@@ -73,11 +73,11 @@ class TerminationController:
                     and self.clock.now() - claim.deletion_timestamp
                     >= self.termination_grace_period)
                 if blocked and grace_expired:
-                    # force-drain backstop: the budget lost its veto
-                    self.cluster.unbind_pods_on(node.name)
+                    # force-drain backstop: the budget lost its veto; the
+                    # blocked pods evict in the final teardown below
                     self.recorder.publish(
                         "Warning", "ForceDrained", "Node", node.name,
-                        f"termination grace period expired; evicted "
+                        f"termination grace period expired; evicting "
                         f"{len(blocked)} budget-blocked pod(s)")
                     blocked = []
                 if blocked:
@@ -94,14 +94,9 @@ class TerminationController:
                             f"({', '.join(sorted(set(pdb.values())) or ['-'])})")
                     continue
                 self._drain_blocked_logged.discard(claim.name)
-                # fully drained: daemonset pods are DELETED with the node
-                # (their controller stamps a fresh one onto the next node;
-                # merely unbinding would leave phantom pods inflating the
-                # daemonset overhead of every future node sizing)
-                for pod in self.cluster.unbind_pods_on(node.name):
-                    if pod.is_daemonset:
-                        self.cluster.delete_pod(pod.name)
-                self.cluster.delete_node(node.name)
+                # fully drained (or force-drained): final teardown evicts
+                # any stragglers and deletes daemonset pods with the node
+                self.cluster.evict_node(node.name)
             if claim.provider_id is not None:
                 try:
                     self.cloud_provider.delete(claim)

@@ -185,6 +185,21 @@ class ClusterState:
                     blocked.setdefault(p.name, pdb.name)
         return blocked
 
+    def evict_node(self, node_name: str) -> List[Pod]:
+        """Final node teardown: every remaining pod unbinds, DAEMONSET
+        pods are deleted outright (their controller stamps a fresh one on
+        the next node; an unbound daemonset pod would live forever as
+        phantom overhead in every future node sizing), and the node object
+        goes. Returns the evicted non-daemonset pods."""
+        evicted = []
+        for pod in self.unbind_pods_on(node_name):
+            if pod.is_daemonset:
+                self.delete_pod(pod.name)
+            else:
+                evicted.append(pod)
+        self.delete_node(node_name)
+        return evicted
+
     def drain_node(self, node_name: str) -> Tuple[List[Pod], List[Pod]]:
         """PDB-respecting eviction pass over a cordoned node (reference
         disruption.md:33: evict via the Eviction API, wait for the node to

@@ -366,12 +366,17 @@ class TestEndToEnd:
         env.provisioner.provision_once()
         env.settle()
         (claim,) = env.cluster.claims.values()
+        node_name = env.cluster.node_for_claim(claim.name).name
+        env.cluster.add_pod(Pod(name="ds-on-victim", is_daemonset=True,
+                                node_name=node_name, requests={"cpu": "100m"}))
         from karpenter_provider_aws_tpu.cloud.fake import parse_instance_id
         env.cloud.terminate_instances([parse_instance_id(claim.provider_id)])
         env.gc.reconcile()
         assert not env.cluster.claims
         assert not env.cluster.nodes
         assert env.cluster.pending_pods(), "pods should be pending again"
+        # the daemonset pod died with its node — no phantom overhead
+        assert "ds-on-victim" not in env.cluster.pods
 
     def test_termination_drains_and_deletes(self, env):
         for p in pods(3):

@@ -242,6 +242,9 @@ class TestForceDrainBackstop:
         env.cluster.add_pdb(PodDisruptionBudget(
             name="frozen", label_selector={"app": "stuck"}, max_unavailable=0))
         victim = next(iter(env.cluster.claims.values()))
+        node = env.cluster.node_for_claim(victim.name).name
+        env.cluster.add_pod(Pod(name="ds-on-stuck", is_daemonset=True,
+                                node_name=node, requests={"cpu": "100m"}))
         env.termination.delete_claim(victim.name)
         env.termination.reconcile()
         assert victim.name in env.cluster.claims  # blocked, still alive
@@ -249,6 +252,8 @@ class TestForceDrainBackstop:
         env.termination.reconcile()
         assert victim.name not in env.cluster.claims
         assert env.recorder.events(reason="ForceDrained")
+        # the daemonset pod died with the force-drained node (no phantom)
+        assert "ds-on-stuck" not in env.cluster.pods
 
     def test_drain_blocked_event_published_once_per_episode(self, lattice):
         env = make_env(lattice)

@@ -30,19 +30,25 @@ def run_once(i: int, pytest_args: list) -> int:
     env["PYTHONHASHSEED"] = str((i * 7919 + 1) % 4294967296)
     order = ["-p", "no:cacheprovider"]
     args = [sys.executable, "-m", "pytest", "-q", *order, *pytest_args]
+    reversed_order = False
     if i % 2 == 1 and not any(a.startswith("-") for a in pytest_args):
         # reversed file order every other run: spots inter-file state
         # leaks. Only when the args are pure paths — an option's VALUE
         # can itself be a path ('--ignore tests/x.py') and reordering
-        # around options silently changes what runs.
+        # around options silently changes what runs. Directory args
+        # expand to their test files so the reversal has an effect.
         explicit = [a for a in pytest_args
                     if (REPO / a).exists() or Path(a).exists()]
-        files = ([Path(a) for a in explicit] if explicit
-                 else sorted((REPO / "tests").glob("test_*.py")))
-        args = [a for a in args if a not in explicit]
-        args += [str(t) for t in sorted(files, reverse=True)]
+        files: list = []
+        for a in explicit or ["tests"]:
+            p = (REPO / a) if (REPO / a).exists() else Path(a)
+            files += sorted(p.glob("test_*.py")) if p.is_dir() else [p]
+        if len(files) > 1:
+            args = [a for a in args if a not in explicit]
+            args += [str(t) for t in sorted(files, reverse=True)]
+            reversed_order = True
     print(f"--- run {i} (PYTHONHASHSEED={env['PYTHONHASHSEED']}, "
-          f"{'reversed' if i % 2 else 'default'} order)", flush=True)
+          f"{'reversed' if reversed_order else 'default'} order)", flush=True)
     return subprocess.call(args, cwd=str(REPO), env=env)
 
 

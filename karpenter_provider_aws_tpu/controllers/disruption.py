@@ -101,6 +101,8 @@ class DisruptionController:
         # (node, pdb) pairs whose Unconsolidatable event already published
         # for the current blockage episode (see _candidates)
         self._pdb_blocked_logged: set = set()
+        # parsed budget schedules (False = invalid), per controller
+        self._cron_cache: Dict[str, object] = {}
 
     # one batched probe covers the prefix ladder + single-node scan; caps
     # bound the padded K bucket (solver.Solver._K_BUCKETS)
@@ -119,6 +121,11 @@ class DisruptionController:
         for budget in pool.disruption.budgets:
             if budget.reasons and reason not in budget.reasons:
                 continue
+            if budget.schedule is not None and not self._budget_active(budget):
+                # a scheduled budget constrains only inside its window
+                # (disruption.md:193-222; CRD requires schedule+duration
+                # together — webhooks.validate_node_pool enforces that)
+                continue
             spec = str(budget.nodes)
             if spec.endswith("%"):
                 # percentages round UP (disruption.md: "4 disruptions ...
@@ -128,6 +135,33 @@ class DisruptionController:
                 val = int(spec)
             allowed = min(allowed, max(val, 0))
         return max(allowed - disrupting, 0)
+
+    def _budget_active(self, budget) -> bool:
+        """Is the budget's scheduled window open right now? (An invalid
+        schedule — rejected by admission anyway — never constrains.)"""
+        from ..utils.cron import Cron
+        cron = self._cron_cache.get(budget.schedule)
+        if cron is None:
+            try:
+                cron = Cron(budget.schedule)
+            except ValueError:
+                cron = False
+            self._cron_cache[budget.schedule] = cron
+        if cron is False:
+            return False
+        return cron.in_window(self.clock.now(), budget.duration or 0.0)
+
+    def _budget_window_state(self) -> Tuple:
+        """(pool, budget index, active) for every scheduled budget — part
+        of the consolidation fingerprint: a window opening or closing is
+        pure time passage that changes what disruption may do, so it must
+        re-arm a negative-cached search."""
+        out = []
+        for pool in self.node_pools.values():
+            for i, b in enumerate(pool.disruption.budgets):
+                if b.schedule is not None:
+                    out.append((pool.name, i, self._budget_active(b)))
+        return tuple(out)
 
     # ---- candidate discovery --------------------------------------------
 
@@ -382,6 +416,8 @@ class DisruptionController:
             # elapses: pure time passage changes which candidates are
             # eligible even though no pod/claim moved
             tuple(sorted(c.name for c in consolidatable)),
+            # ... and when a scheduled budget's window opens or closes
+            self._budget_window_state(),
         )
 
     def reconcile(self) -> None:

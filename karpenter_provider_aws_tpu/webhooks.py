@@ -69,6 +69,20 @@ def validate_node_pool(pool: NodePool) -> List[str]:
                 errs.append(f"budget nodes must be >= 0, got {b.nodes!r}")
         except ValueError:
             errs.append(f"bad budget nodes value {b.nodes!r}")
+        # CRD karpenter.sh_nodepools.yaml:111-112: 'schedule' must be set
+        # with 'duration' (and vice versa); the schedule must parse
+        if (b.schedule is None) != (b.duration is None):
+            errs.append("budget schedule and duration must be set together")
+        if b.duration is not None and b.duration <= 0:
+            # a non-positive duration would make the window unsatisfiable
+            # and the budget silently never apply
+            errs.append("budget duration must be > 0 seconds")
+        if b.schedule is not None:
+            from .utils.cron import Cron
+            try:
+                Cron(b.schedule)
+            except ValueError as e:
+                errs.append(f"bad budget schedule: {e}")
     if pool.weight < 0 or pool.weight > 100:
         errs.append("weight must be in [0, 100]")
     return errs

@@ -396,3 +396,120 @@ class TestBatchedWhatIfs:
         env.disruption.reconcile()
         assert env.disruption._in_flight, \
             "consolidation blocked by a stale negative cache"
+
+
+class TestScheduledBudgets:
+    """Budget schedule+duration windows (reference disruption.md:193-222;
+    CRD karpenter.sh_nodepools.yaml:97-112): a scheduled budget
+    constrains only while inside its cron-opened window."""
+
+    def test_cron_matching(self):
+        from karpenter_provider_aws_tpu.utils.cron import Cron
+        import calendar
+        # 1970-01-01 is a Thursday (dow 4); epoch 0 = 00:00 UTC
+        c = Cron("0 0 * * *")                      # daily at midnight
+        assert c.matches(0.0)
+        assert not c.matches(60.0)
+        assert Cron("*/15 * * * *").matches(15 * 60)
+        assert not Cron("*/15 * * * *").matches(16 * 60)
+        assert Cron("* * * * 4").matches(0.0)       # Thursday
+        assert not Cron("* * * * 5").matches(0.0)
+        # window: daily-midnight schedule, 1h duration
+        assert c.in_window(1800.0, 3600.0)          # 00:30 inside
+        assert not c.in_window(7200.0, 3600.0)      # 02:00 outside
+        import pytest
+        with pytest.raises(ValueError):
+            Cron("not a cron")
+        with pytest.raises(ValueError):
+            Cron("99 * * * *")
+
+    def test_budget_constrains_only_in_window(self, lattice):
+        from karpenter_provider_aws_tpu.apis.objects import (
+            DisruptionBudget, NodePoolDisruption)
+        clock = FakeClock(start=12 * 86400.0)  # a midnight UTC epoch
+        pool = NodePool(
+            name="default",
+            requirements=[Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN,
+                                      ("on-demand",))],
+            disruption=NodePoolDisruption(
+                consolidate_after=5.0,
+                budgets=[DisruptionBudget(nodes="0", schedule="0 0 * * *",
+                                          duration=3600.0)]))
+        env = Operator(options=Options(registration_delay=1.0),
+                       lattice=lattice, cloud=FakeCloud(clock), clock=clock,
+                       node_pools=[pool])
+        ctrl = env.disruption
+        # inside the maintenance freeze (00:00-01:00): zero allowed
+        assert ctrl._allowed_disruptions(pool, "Underutilized") == 0 or \
+            not env.cluster.claims  # no claims yet -> 0 anyway
+        for i in range(4):
+            env.cluster.add_pod(Pod(name=f"p{i}",
+                                    requests={"cpu": "800m", "memory": "1536Mi"}))
+        env.settle()
+        assert ctrl._allowed_disruptions(pool, "Underutilized") == 0
+        # step past the window: the budget no longer constrains
+        clock.step(2 * 3600)
+        assert ctrl._allowed_disruptions(pool, "Underutilized") > 0
+
+    def test_consolidation_resumes_after_window(self, lattice):
+        """The negative-cache fingerprint includes budget window state: a
+        failed-during-freeze search re-arms when the window closes."""
+        from karpenter_provider_aws_tpu.apis.objects import (
+            DisruptionBudget, NodePoolDisruption)
+        clock = FakeClock(start=12 * 86400.0)  # midnight UTC
+        pool = NodePool(
+            name="default",
+            requirements=[Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN,
+                                      ("on-demand",))],
+            disruption=NodePoolDisruption(
+                consolidate_after=5.0,
+                budgets=[DisruptionBudget(nodes="0", schedule="0 0 * * *",
+                                          duration=3600.0)]))
+        env = Operator(options=Options(registration_delay=1.0),
+                       lattice=lattice, cloud=FakeCloud(clock), clock=clock,
+                       node_pools=[pool])
+        for i in range(4):
+            env.cluster.add_pod(Pod(name=f"p{i}",
+                                    requests={"cpu": "800m", "memory": "1536Mi"}))
+        env.settle()
+        for i in range(1, 4):
+            env.cluster.delete_pod(f"p{i}")
+        before = set(env.cluster.claims)
+        clock.step(6)
+        for _ in range(10):
+            env.run_once()
+            clock.step(3)
+        assert set(env.cluster.claims) == before, "freeze window violated"
+        clock.step(2 * 3600)
+        for _ in range(20):
+            env.run_once(force_provision=bool(env.cluster.pending_pods()))
+            clock.step(3)
+        assert set(env.cluster.claims) != before, \
+            "search never re-armed after the budget window closed"
+
+    def test_webhook_requires_schedule_with_duration(self):
+        from karpenter_provider_aws_tpu.apis.objects import (
+            DisruptionBudget, NodePoolDisruption)
+        from karpenter_provider_aws_tpu.webhooks import validate_node_pool
+        pool = NodePool(name="x", disruption=NodePoolDisruption(
+            budgets=[DisruptionBudget(nodes="1", schedule="0 0 * * *")]))
+        assert any("set together" in e for e in validate_node_pool(pool))
+        pool2 = NodePool(name="x", disruption=NodePoolDisruption(
+            budgets=[DisruptionBudget(nodes="1", schedule="bad cron here",
+                                      duration=60.0)]))
+        assert any("bad budget schedule" in e for e in validate_node_pool(pool2))
+
+    def test_review_regressions(self):
+        """Stray-comma cron parts raise; zero duration rejected at
+        admission (it would make the window silently unsatisfiable)."""
+        import pytest
+        from karpenter_provider_aws_tpu.apis.objects import (
+            DisruptionBudget, NodePoolDisruption)
+        from karpenter_provider_aws_tpu.utils.cron import Cron
+        from karpenter_provider_aws_tpu.webhooks import validate_node_pool
+        with pytest.raises(ValueError):
+            Cron("0, 0 * * *")
+        pool = NodePool(name="x", disruption=NodePoolDisruption(
+            budgets=[DisruptionBudget(nodes="0", schedule="0 0 * * *",
+                                      duration=0.0)]))
+        assert any("duration must be > 0" in e for e in validate_node_pool(pool))

@@ -103,6 +103,11 @@ class DisruptionController:
         self._pdb_blocked_logged: set = set()
         # parsed budget schedules (False = invalid), per controller
         self._cron_cache: Dict[str, object] = {}
+        # (schedule, duration) -> (valid_until, active): windows open only
+        # at minute marks, so a closed verdict holds to the next minute;
+        # an open one re-verifies each minute (it may linger <=60s past a
+        # mid-minute close — the conservative, MORE-constrained direction)
+        self._window_cache: Dict[Tuple[str, float], Tuple[float, bool]] = {}
 
     # one batched probe covers the prefix ladder + single-node scan; caps
     # bound the padded K bucket (solver.Solver._K_BUCKETS)
@@ -138,7 +143,12 @@ class DisruptionController:
 
     def _budget_active(self, budget) -> bool:
         """Is the budget's scheduled window open right now? (An invalid
-        schedule — rejected by admission anyway — never constrains.)"""
+        schedule — rejected by admission anyway — never constrains.)
+
+        Results memoize per (schedule, duration): an open window stays
+        open until its close; a closed one cannot open before the next
+        whole minute — so the lookback scan runs at most once a minute
+        per budget instead of on every reconcile and fingerprint."""
         from ..utils.cron import Cron
         cron = self._cron_cache.get(budget.schedule)
         if cron is None:
@@ -149,7 +159,16 @@ class DisruptionController:
             self._cron_cache[budget.schedule] = cron
         if cron is False:
             return False
-        return cron.in_window(self.clock.now(), budget.duration or 0.0)
+        now = self.clock.now()
+        duration = budget.duration or 0.0
+        key = (budget.schedule, duration)
+        cached = self._window_cache.get(key)
+        if cached is not None and now < cached[0]:
+            return cached[1]
+        active = cron.in_window(now, duration)
+        valid_until = (now // 60 + 1) * 60 if not active else now + 60.0
+        self._window_cache[key] = (valid_until, active)
+        return active
 
     def _budget_window_state(self) -> Tuple:
         """(pool, budget index, active) for every scheduled budget — part

@@ -237,6 +237,18 @@ def wire_core_metrics(reg: Registry) -> Dict[str, _Metric]:
         "pods_unschedulable": reg.gauge(
             "karpenter_pods_unschedulable",
             "Pods the last scheduling pass could not place.", ()),
+        "pods_startup_time": reg.histogram(
+            "karpenter_pods_startup_time_seconds",
+            "Seconds from pod arrival to its first bind "
+            "(reference metrics.md:62).", ()),
+        "nodepool_usage": reg.gauge(
+            "karpenter_nodepool_usage",
+            "Capacity committed per NodePool (reference metrics.md:16).",
+            ("nodepool", "resource_type")),
+        "nodepool_limit": reg.gauge(
+            "karpenter_nodepool_limit",
+            "The NodePool's spec.limits ceiling (reference metrics.md:19).",
+            ("nodepool", "resource_type")),
         "nodeclaims_created": reg.counter(
             "karpenter_nodeclaims_created_total", "NodeClaims created.", ("nodepool",)),
         "nodeclaims_launched": reg.counter(

@@ -161,6 +161,30 @@ class Operator:
         self.metrics.gauge("karpenter_cluster_state_pod_count").set(len(self.cluster.pods))
         self.metrics.gauge("karpenter_ice_cache_size").set(
             sum(1 for _ in self.unavailable.entries()))
+        # pod startup latency samples observed since the last pass
+        startup = self.metrics.get("karpenter_pods_startup_time_seconds")
+        for s in self.cluster.drain_startup_samples():
+            startup.observe(s)
+        # per-pool committed usage + limits (reference metrics.md:16-22)
+        from ..apis.resources import RESOURCE_AXES
+        usage_g = self.metrics.get("karpenter_nodepool_usage")
+        limit_g = self.metrics.get("karpenter_nodepool_limit")
+        usage = self.cluster.pool_usage()
+        for name, pool in self.node_pools.items():
+            vec = usage.get(name)
+            limit = pool.limits_vec()
+            # usage covers the primary axes plus every LIMITED axis, so a
+            # usage/limit dashboard never shows a limit with no usage pair
+            axes = {"cpu", "memory", "pods"} | (
+                {k for k in pool.limits if k in RESOURCE_AXES}
+                if limit is not None else set())
+            for ax in sorted(axes):
+                ai = RESOURCE_AXES.index(ax)
+                usage_g.set(float(vec[ai]) if vec is not None else 0.0,
+                            nodepool=name, resource_type=ax)
+                if limit is not None and ax in pool.limits:
+                    limit_g.set(float(limit[ai]), nodepool=name,
+                                resource_type=ax)
         # offering gauge surface: re-emit only when pricing or the ICE set
         # actually changed (both are versioned)
         gstate = (self.lattice.price_version, self.unavailable.seq_num)

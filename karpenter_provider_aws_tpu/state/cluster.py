@@ -50,22 +50,43 @@ class ClusterState:
         self.storage_classes: Dict[str, "StorageClass"] = {}
         self.pdbs: Dict[str, "PodDisruptionBudget"] = {}
         self._nominations: Dict[str, _Nomination] = {}   # pod -> claim
+        self._pod_added: Dict[str, float] = {}           # pod -> arrival ts
+        self._startup_samples: List[float] = []          # unbilled durations
 
     # ---- pods ------------------------------------------------------------
 
     def add_pod(self, pod: Pod) -> None:
         with self._lock:
             self.pods[pod.name] = pod
+            # arrival stamp for the pods_startup_time metric (reference
+            # karpenter_pods_startup_time_seconds: created → scheduled)
+            self._pod_added.setdefault(pod.name, self._clock.now())
 
     def delete_pod(self, name: str) -> None:
         with self._lock:
             self.pods.pop(name, None)
             self._nominations.pop(name, None)
+            self._pod_added.pop(name, None)
+
+    def drain_startup_samples(self) -> List[float]:
+        """Newly-observed pod startup latencies (arrival → first bind)
+        since the last call; the metrics loop feeds them to the
+        karpenter_pods_startup_time_seconds histogram."""
+        with self._lock:
+            out, self._startup_samples = self._startup_samples, []
+            return out
 
     def bind_pod(self, pod_name: str, node_name: str) -> None:
         with self._lock:
             pod = self.pods.get(pod_name)
             if pod is not None:
+                if pod.node_name is None:
+                    added = self._pod_added.pop(pod_name, None)
+                    if added is not None:
+                        # first bind since arrival: startup latency sample
+                        # (re-binds after eviction are not pod startups)
+                        self._startup_samples.append(
+                            max(self._clock.now() - added, 0.0))
                 pod.node_name = node_name
                 # WaitForFirstConsumer: the CSI driver creates the PV in the
                 # zone the pod lands in; later consumers of the claim are
@@ -414,3 +435,5 @@ class ClusterState:
             self.storage_classes.clear()
             self.pdbs.clear()
             self._nominations.clear()
+            self._pod_added.clear()
+            self._startup_samples.clear()

@@ -36,6 +36,10 @@ def _parse_field(spec: str, lo: int, hi: int) -> Set[int]:
         elif "-" in part:
             a, b = part.split("-", 1)
             start, end = int(a), int(b)
+        elif step != 1:
+            # 'N/step' means N through max stepped (vixie/robfig
+            # semantics: '0/6' in the hour field = 0,6,12,18)
+            start, end = int(part), hi
         else:
             start = end = int(part)
         if not (lo <= start <= hi and lo <= end <= hi and start <= end):

@@ -513,3 +513,11 @@ class TestScheduledBudgets:
             budgets=[DisruptionBudget(nodes="0", schedule="0 0 * * *",
                                       duration=0.0)]))
         assert any("duration must be > 0" in e for e in validate_node_pool(pool))
+
+    def test_step_syntax_vixie_semantics(self):
+        """'0/6' in the hour field means 0,6,12,18 (vixie/robfig), not
+        just hour 0."""
+        from karpenter_provider_aws_tpu.utils.cron import Cron
+        c = Cron("0 0/6 * * *")
+        assert c.hour == {0, 6, 12, 18}
+        assert Cron("0/15 * * * *").minute == {0, 15, 30, 45}

@@ -233,3 +233,30 @@ class TestThroughputHarness:
             "karpenter_interruption_deleted_messages_total").value() == 2000
         # quadratic drains land in the tens of seconds; a healthy one is <2s
         assert wall < 10.0, f"drain took {wall:.1f}s"
+
+
+class TestReferenceMetricSurface:
+    """karpenter_nodepool_usage/limit + pods_startup_time_seconds
+    (reference metrics.md:16-22,62)."""
+
+    def test_pool_usage_limit_and_startup_series(self, lattice):
+        clock = FakeClock()
+        pool = NodePool(name="default", limits={"cpu": "100"},
+                        requirements=[Requirement(wk.LABEL_CAPACITY_TYPE,
+                                                  ReqOp.IN, ("on-demand",))])
+        env = Operator(options=Options(registration_delay=2.0),
+                       lattice=lattice, cloud=FakeCloud(clock), clock=clock,
+                       node_pools=[pool])
+        env.cluster.add_pod(Pod(name="w", requests={"cpu": "500m", "memory": "1Gi"}))
+        env.settle()
+        usage = env.metrics.get("karpenter_nodepool_usage")
+        assert usage.value(nodepool="default", resource_type="cpu") > 0
+        limit = env.metrics.get("karpenter_nodepool_limit")
+        assert limit.value(nodepool="default", resource_type="cpu") == 100_000
+        startup = env.metrics.get("karpenter_pods_startup_time_seconds")
+        assert startup.count() == 1
+        # startup = batch wait + launch + registration_delay >= 2s
+        assert startup.sum() >= 2.0
+        text = env.metrics.render()
+        assert "karpenter_pods_startup_time_seconds" in text
+        assert "karpenter_nodepool_usage" in text

@@ -218,6 +218,21 @@ def _referee_cost(problem, plan):
     return ffd_oracle(problem).new_node_cost, "python"
 
 
+def measure_link_rtt() -> float:
+    """p50 of a minimal device call + 1 KiB device→host transfer. On a
+    tunneled TPU this fixed per-call cost dominates small solves; the
+    detail field lets a reader split algorithm time from link weather."""
+    import jax.numpy as jnp
+    xs = []
+    buf = jnp.zeros((1024,), jnp.uint8)
+    np.asarray(buf + 1)  # warm the trace
+    for _ in range(7):
+        t0 = time.perf_counter()
+        np.asarray(buf + 1)
+        xs.append((time.perf_counter() - t0) * 1000.0)
+    return float(np.percentile(xs, 50))
+
+
 def run_config(key, make, lattice, solver):
     from karpenter_provider_aws_tpu.solver import build_problem
     pods, pools, existing = make()
@@ -276,6 +291,7 @@ def main():
 
     lattice = build_lattice()
     solver = Solver(lattice)
+    link_rtt = round(measure_link_rtt(), 3)
 
     configs = [
         ("cfg1_100pods_parity", config1_parity),
@@ -286,6 +302,7 @@ def main():
     ]
     for key, make in configs:
         e2e_p50, detail = run_config(key, make, lattice, solver)
+        detail["device_link_rtt_ms"] = link_rtt
         print(json.dumps({
             "metric": f"e2e_p50_latency_{key}",
             "value": round(e2e_p50, 3),

@@ -237,17 +237,21 @@ def wire_core_metrics(reg: Registry) -> Dict[str, _Metric]:
         "pods_unschedulable": reg.gauge(
             "karpenter_pods_unschedulable",
             "Pods the last scheduling pass could not place.", ()),
+        # reference metrics.md:62,16,19
         "pods_startup_time": reg.histogram(
             "karpenter_pods_startup_time_seconds",
-            "Seconds from pod arrival to its first bind "
-            "(reference metrics.md:62).", ()),
+            "Seconds from pod arrival to its first bind.", (),
+            # startup includes node launch + registration: minutes, not
+            # the sub-minute default buckets
+            buckets=(1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0,
+                     600.0, 1800.0)),
         "nodepool_usage": reg.gauge(
             "karpenter_nodepool_usage",
-            "Capacity committed per NodePool (reference metrics.md:16).",
+            "Capacity committed per NodePool.",
             ("nodepool", "resource_type")),
         "nodepool_limit": reg.gauge(
             "karpenter_nodepool_limit",
-            "The NodePool's spec.limits ceiling (reference metrics.md:19).",
+            "The NodePool's spec.limits ceiling.",
             ("nodepool", "resource_type")),
         "nodeclaims_created": reg.counter(
             "karpenter_nodeclaims_created_total", "NodeClaims created.", ("nodepool",)),

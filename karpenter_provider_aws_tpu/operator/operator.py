@@ -64,6 +64,7 @@ class Operator:
         wire_core_metrics(self.metrics)
         self._lattice_gauges = wire_lattice_metrics(self.metrics)
         self._lattice_gauge_state = None
+        self._pool_gauge_rev = -1
         self.unavailable = UnavailableOfferings(self.clock)
         self.cluster = ClusterState(self.clock)
         self.node_pools: Dict[str, NodePool] = {p.name: p for p in (node_pools or [NodePool(name="default")])}
@@ -165,26 +166,30 @@ class Operator:
         startup = self.metrics.get("karpenter_pods_startup_time_seconds")
         for s in self.cluster.drain_startup_samples():
             startup.observe(s)
-        # per-pool committed usage + limits (reference metrics.md:16-22)
-        from ..apis.resources import RESOURCE_AXES
-        usage_g = self.metrics.get("karpenter_nodepool_usage")
-        limit_g = self.metrics.get("karpenter_nodepool_limit")
-        usage = self.cluster.pool_usage()
-        for name, pool in self.node_pools.items():
-            vec = usage.get(name)
-            limit = pool.limits_vec()
-            # usage covers the primary axes plus every LIMITED axis, so a
-            # usage/limit dashboard never shows a limit with no usage pair
-            axes = {"cpu", "memory", "pods"} | (
-                {k for k in pool.limits if k in RESOURCE_AXES}
-                if limit is not None else set())
-            for ax in sorted(axes):
-                ai = RESOURCE_AXES.index(ax)
-                usage_g.set(float(vec[ai]) if vec is not None else 0.0,
-                            nodepool=name, resource_type=ax)
-                if limit is not None and ax in pool.limits:
-                    limit_g.set(float(limit[ai]), nodepool=name,
-                                resource_type=ax)
+        # per-pool committed usage + limits (reference metrics.md:16-22).
+        # pool_usage() depends only on the node/claim capacity set —
+        # re-render on its revision, not on every per-second pass
+        if self.cluster.capacity_rev != self._pool_gauge_rev:
+            self._pool_gauge_rev = self.cluster.capacity_rev
+            from ..apis.resources import RESOURCE_AXES
+            usage_g = self.metrics.get("karpenter_nodepool_usage")
+            limit_g = self.metrics.get("karpenter_nodepool_limit")
+            usage = self.cluster.pool_usage()
+            for name, pool in self.node_pools.items():
+                vec = usage.get(name)
+                limit = pool.limits_vec()
+                # usage covers the primary axes plus every LIMITED axis —
+                # a usage/limit dashboard never sees an unpaired limit
+                axes = {"cpu", "memory", "pods"} | (
+                    {k for k in pool.limits if k in RESOURCE_AXES}
+                    if limit is not None else set())
+                for ax in sorted(axes):
+                    ai = RESOURCE_AXES.index(ax)
+                    usage_g.set(float(vec[ai]) if vec is not None else 0.0,
+                                nodepool=name, resource_type=ax)
+                    if limit is not None and ax in pool.limits:
+                        limit_g.set(float(limit[ai]), nodepool=name,
+                                    resource_type=ax)
         # offering gauge surface: re-emit only when pricing or the ICE set
         # actually changed (both are versioned)
         gstate = (self.lattice.price_version, self.unavailable.seq_num)

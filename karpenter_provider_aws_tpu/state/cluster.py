@@ -52,6 +52,10 @@ class ClusterState:
         self._nominations: Dict[str, _Nomination] = {}   # pod -> claim
         self._pod_added: Dict[str, float] = {}           # pod -> arrival ts
         self._startup_samples: List[float] = []          # unbilled durations
+        # bumps on node/claim add/delete: pool_usage() depends only on
+        # this committed-capacity set, so gauge emitters re-render on a
+        # rev change instead of rebuilding vectors every pass
+        self.capacity_rev = 0
 
     # ---- pods ------------------------------------------------------------
 
@@ -59,8 +63,12 @@ class ClusterState:
         with self._lock:
             self.pods[pod.name] = pod
             # arrival stamp for the pods_startup_time metric (reference
-            # karpenter_pods_startup_time_seconds: created → scheduled)
-            self._pod_added.setdefault(pod.name, self._clock.now())
+            # karpenter_pods_startup_time_seconds: created → scheduled).
+            # Already-bound pods (operator resync) are NOT arrivals — a
+            # later evict+rebind of one must not emit a bogus multi-hour
+            # "startup" measured from sync time
+            if pod.node_name is None:
+                self._pod_added.setdefault(pod.name, self._clock.now())
 
     def delete_pod(self, name: str) -> None:
         with self._lock:
@@ -281,18 +289,22 @@ class ClusterState:
     def add_node(self, node: Node) -> None:
         with self._lock:
             self.nodes[node.name] = node
+            self.capacity_rev += 1
 
     def delete_node(self, name: str) -> None:
         with self._lock:
             self.nodes.pop(name, None)
+            self.capacity_rev += 1
 
     def add_claim(self, claim: NodeClaim) -> None:
         with self._lock:
             self.claims[claim.name] = claim
+            self.capacity_rev += 1
 
     def delete_claim(self, name: str) -> None:
         with self._lock:
             self.claims.pop(name, None)
+            self.capacity_rev += 1
             stale = [p for p, n in self._nominations.items() if n.target == name]
             for p in stale:
                 del self._nominations[p]

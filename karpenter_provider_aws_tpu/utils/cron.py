@@ -21,10 +21,11 @@ _FIELD_RANGES = ((0, 59), (0, 23), (1, 31), (1, 12), (0, 6))
 def _parse_field(spec: str, lo: int, hi: int) -> Set[int]:
     out: Set[int] = set()
     for part in spec.split(","):
-        step = 1
+        step, stepped = 1, False
         if "/" in part:
             part, step_s = part.split("/", 1)
             step = int(step_s)
+            stepped = True
             if step < 1:
                 raise ValueError(f"bad cron step {step_s!r}")
         if part == "*":
@@ -36,9 +37,10 @@ def _parse_field(spec: str, lo: int, hi: int) -> Set[int]:
         elif "-" in part:
             a, b = part.split("-", 1)
             start, end = int(a), int(b)
-        elif step != 1:
+        elif stepped:
             # 'N/step' means N through max stepped (vixie/robfig
-            # semantics: '0/6' in the hour field = 0,6,12,18)
+            # semantics: '0/6' in the hour field = 0,6,12,18 — and
+            # '0/1' every hour, NOT just hour 0)
             start, end = int(part), hi
         else:
             start = end = int(part)

@@ -50,6 +50,8 @@ class TerminationController:
         if not claim.deletion_timestamp:
             claim.deletion_timestamp = self.clock.now()
             claim.phase = NodeClaimPhase.TERMINATING
+            # the claim leaves pool_usage() immediately: re-render gauges
+            self.cluster.touch_capacity()
 
     def reconcile(self) -> None:
         for claim in list(self.cluster.claims.values()):

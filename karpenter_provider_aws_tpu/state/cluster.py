@@ -52,9 +52,10 @@ class ClusterState:
         self._nominations: Dict[str, _Nomination] = {}   # pod -> claim
         self._pod_added: Dict[str, float] = {}           # pod -> arrival ts
         self._startup_samples: List[float] = []          # unbilled durations
-        # bumps on node/claim add/delete: pool_usage() depends only on
-        # this committed-capacity set, so gauge emitters re-render on a
-        # rev change instead of rebuilding vectors every pass
+        # bumps on node/claim add/delete AND on in-place state flips that
+        # change committed capacity (touch_capacity — e.g. a claim marked
+        # TERMINATING leaves pool_usage immediately); gauge emitters
+        # re-render on a rev change instead of rebuilding vectors per pass
         self.capacity_rev = 0
 
     # ---- pods ------------------------------------------------------------
@@ -285,6 +286,13 @@ class ClusterState:
             return [p for p in self.pods.values() if p.is_daemonset]
 
     # ---- nodes / claims ---------------------------------------------------
+
+    def touch_capacity(self) -> None:
+        """Record an in-place mutation that changes pool_usage() without
+        an add/delete (a claim marked for deletion, a node cordon that
+        excludes it from capacity)."""
+        with self._lock:
+            self.capacity_rev += 1
 
     def add_node(self, node: Node) -> None:
         with self._lock:

@@ -297,3 +297,106 @@ class TestIceChaos:
             assert cloud.capacity_pools.get(("on-demand", c.instance_type, c.zone)) != 0
         # the ICE cache remembers at least one dead offering
         assert any(True for _ in env.unavailable.entries())
+
+
+class TestKitchenSink:
+    """Every major subsystem interacting at once: a reserved limited
+    pool, Exists-segregated teams, a custom-label ratio spread, PDBs,
+    a scheduled disruption freeze, spot interruptions, and ICE chaos —
+    converging with zero leaks and every invariant held."""
+
+    def test_everything_at_once(self, lattice):
+        from karpenter_provider_aws_tpu.apis import PodDisruptionBudget
+        from karpenter_provider_aws_tpu.apis.objects import (
+            DisruptionBudget, TopologySpreadConstraint)
+        clock = FakeClock(start=12 * 86400.0 + 1800.0)  # 00:30 UTC — the
+        # teams pool's nightly freeze window (00:00-01:00) is LIVE for
+        # the whole ~5-minute simulated timeline
+        queue = FakeQueue("interruptions")
+        pools = [
+            # reserved capacity first: pinned type, capped, weight 50
+            NodePool(name="reserved", weight=50, limits={"cpu": "8"},
+                     requirements=[
+                         Requirement(wk.LABEL_INSTANCE_TYPE, ReqOp.IN,
+                                     ("c5.2xlarge",)),
+                         Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN,
+                                     ("on-demand",))]),
+            # team segregation via Exists; nightly maintenance freeze
+            NodePool(name="teams",
+                     requirements=[
+                         Requirement("company.com/team", ReqOp.EXISTS, ()),
+                         Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN,
+                                     ("on-demand",))],
+                     disruption=NodePoolDisruption(
+                         consolidate_after=10.0,
+                         budgets=[DisruptionBudget(
+                             nodes="0", schedule="0 0 * * *",
+                             duration=3600.0)])),
+            # the 2:1 spot/od ratio split pair
+            NodePool(name="spot-spread", requirements=[
+                Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN, ("spot",)),
+                Requirement("cs", ReqOp.IN, ("2", "3"))]),
+            NodePool(name="od-spread", requirements=[
+                Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN, ("on-demand",)),
+                Requirement("cs", ReqOp.IN, ("1",))]),
+        ]
+        env = Operator(options=Options(registration_delay=1.0),
+                       lattice=lattice, cloud=FakeCloud(clock), clock=clock,
+                       node_pools=pools, interruption_queue=queue)
+        # workloads
+        for i in range(6):   # generic (no selector) -> reserved fills
+            env.cluster.add_pod(Pod(  # first, overflow spills elsewhere
+                name=f"gen{i}", requests={"cpu": "2", "memory": "2Gi"}))
+        for t in ("team-a", "team-b"):
+            for i in range(2):
+                env.cluster.add_pod(Pod(
+                    name=f"{t}-{i}", labels={"app": t},
+                    requests={"cpu": "500m", "memory": "1Gi"},
+                    node_selector={"company.com/team": t}))
+        for i in range(6):   # ratio-spread workload
+            env.cluster.add_pod(Pod(
+                name=f"web{i}", labels={"app": "web"},
+                requests={"cpu": "1", "memory": "2Gi"},
+                topology_spread=[TopologySpreadConstraint(
+                    max_skew=1, topology_key="cs",
+                    label_selector=(("app", "web"),))]))
+        env.cluster.add_pdb(PodDisruptionBudget(
+            name="web-pdb", label_selector={"app": "web"}, max_unavailable=1))
+        env.settle(max_rounds=60)
+        assert_all_bound(env)
+        assert_no_leaks(env)
+
+        # invariants
+        by_pool = {}
+        for c in env.cluster.claims.values():
+            by_pool.setdefault(c.node_pool, []).append(c)
+        assert by_pool.get("reserved"), "reserved pool never engaged"
+        reserved_cpu = sum(
+            lattice.capacity[lattice.name_to_idx[c.instance_type]][0]
+            for c in by_pool["reserved"])
+        assert 0 < reserved_cpu <= 8000
+        # the nightly freeze is LIVE: the teams pool admits zero
+        # voluntary disruptions right now
+        assert env.disruption._allowed_disruptions(
+            env.node_pools["teams"], "Underutilized") == 0
+        team_nodes = {}
+        for c in by_pool.get("teams", []):
+            team_nodes.setdefault(c.labels.get("company.com/team"), []).append(c)
+        assert set(team_nodes) == {"team-a", "team-b"}
+        web_by_domain = {}
+        for node_name, pods in env.cluster.pods_by_node().items():
+            d = env.cluster.nodes[node_name].labels.get("cs")
+            for p in pods:
+                if p.labels.get("app") == "web":
+                    web_by_domain[d] = web_by_domain.get(d, 0) + 1
+        assert set(web_by_domain) == {"1", "2", "3"}
+        assert max(web_by_domain.values()) - min(web_by_domain.values()) <= 1
+
+        # chaos: spot-interrupt every spot node; drains respect the web
+        # PDB (maxUnavailable=1) yet all pods converge back bound
+        for c in list(env.cluster.claims.values()):
+            if c.capacity_type == "spot":
+                queue.send(spot_interruption(parse_instance_id(c.provider_id)))
+        converge(env, rounds=80, step=2.0)
+        assert_all_bound(env)
+        assert_no_leaks(env)

@@ -256,6 +256,16 @@ class NodePoolDisruption:
 
 
 @dataclass
+class KubeletSpec:
+    """The NodePool kubelet block (reference nodepools CRD
+    spec.template.spec.kubelet): per-pool kubelet knobs that change node
+    allocatable. ``max_pods`` caps the pods axis below the ENI-derived
+    density (the reference's pod-dense scale test pins maxPods: 110)."""
+
+    max_pods: Optional[int] = None
+
+
+@dataclass
 class NodePool:
     name: str
     weight: int = 0                                   # higher tried first (nodepools.md:161-163)
@@ -267,6 +277,7 @@ class NodePool:
     node_class_ref: str = "default"
     limits: Dict[str, "str | int | float"] = field(default_factory=dict)  # cpu/memory ceilings
     disruption: NodePoolDisruption = field(default_factory=NodePoolDisruption)
+    kubelet: Optional[KubeletSpec] = None  # per-pool allocatable knobs
     # set only on VIRTUAL pools the problem builder materializes for
     # custom-key label assignments (reference scheduling.md:536-556, the
     # Exists-operator workload-segregation technique): ``base_name`` is
@@ -359,6 +370,10 @@ class NodeClaim:
     node_class_ref: str = "default"
     # status
     phase: NodeClaimPhase = NodeClaimPhase.PENDING
+    # kubelet maxPods from the owning pool's template: CloudProvider.create
+    # clamps the pods axis of capacity/allocatable at fill time, so no
+    # concurrent solve ever observes the unclamped ENI-derived density
+    max_pods: Optional[int] = None
     provider_id: Optional[str] = None
     instance_type: Optional[str] = None
     zone: Optional[str] = None

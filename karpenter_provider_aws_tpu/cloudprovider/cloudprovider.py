@@ -252,6 +252,13 @@ class CloudProvider:
         claim.capacity_type = instance.capacity_type
         claim.capacity = vec_to_resources(lat.capacity[ti])
         claim.allocatable = vec_to_resources(lat.alloc[ti])
+        if claim.max_pods is not None:
+            # the pool's kubelet maxPods caps pod density below the
+            # ENI-derived number — applied HERE so the claim never exists
+            # in a LAUNCHED state with the unclamped value visible
+            for res in (claim.capacity, claim.allocatable):
+                if "pods" in res:
+                    res["pods"] = min(res["pods"], float(claim.max_pods))
         claim.labels = {
             **lat.labels[ti],
             **claim.labels,

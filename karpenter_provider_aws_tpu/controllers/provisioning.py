@@ -333,9 +333,22 @@ class Provisioner:
             current = usage.get(node.node_pool, np.zeros((R,), np.float32))
             remaining = self._remaining(pool, current)
 
+            def node_capacity(tname: str) -> np.ndarray:
+                """What the launched node will actually charge against
+                the pool's limits — the kubelet maxPods clamp applies at
+                create, so limit accounting must see the clamped value
+                (pool_usage later charges exactly this)."""
+                cap = lat.capacity[lat.name_to_idx[tname]]
+                kub = pool.kubelet
+                if kub is not None and kub.max_pods is not None:
+                    from ..apis.resources import axis as res_axis
+                    cap = cap.copy()
+                    pi = res_axis("pods")
+                    cap[pi] = min(cap[pi], float(kub.max_pods))
+                return cap
+
             def fits(tname: str) -> bool:
-                return bool(np.all(lat.capacity[lat.name_to_idx[tname]]
-                                   <= remaining + 1e-6))
+                return bool(np.all(node_capacity(tname) <= remaining + 1e-6))
 
             candidates = node.feasible_types or [node.instance_type]
             fitting = [t for t in candidates if fits(t)]
@@ -347,7 +360,7 @@ class Provisioner:
             if node.instance_type not in fitting:
                 node.instance_type = fitting[0]  # cheapest-first order
                 node.price_per_hour = self._offering_price(node)
-            usage[node.node_pool] = current + lat.capacity[lat.name_to_idx[node.instance_type]]
+            usage[node.node_pool] = current + node_capacity(node.instance_type)
             out.append(node)
         return out, dropped
 
@@ -398,5 +411,7 @@ class Provisioner:
             annotations={**pool.annotations,
                          wk.ANNOTATION_NODEPOOL_HASH: nodepool_hash(pool)},
             taints=list(pool.taints), node_class_ref=pool.node_class_ref,
+            max_pods=(pool.kubelet.max_pods if pool.kubelet is not None
+                      else None),
             created_at=self.clock.now())
         return claim

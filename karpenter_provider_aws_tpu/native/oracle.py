@@ -41,6 +41,10 @@ def native_ffd_pack(problem: Problem, max_bins: int = 200_000) -> Optional[Nativ
         return None
     if problem.E > 0:
         return None
+    if np.isfinite(problem.np_alloc_cap).any():
+        # per-pool allocatable ceilings (kubelet maxPods) are outside the
+        # native referee's scope — the Python oracle applies them
+        return None
     if problem.A and (problem.g_owner.any() or problem.g_need.any()
                       or problem.single_bin.any()):
         # hostname (anti-)affinity classes / co-location need the Python

@@ -126,6 +126,9 @@ class PoolParams(NamedTuple):
     np_zone: jnp.ndarray  # [NP,Z] bool
     np_cap: jnp.ndarray   # [NP,C] bool
     ds: jnp.ndarray       # [NP,R] f32 daemonset overhead for a new node
+    cap: jnp.ndarray      # [NP,R] f32 per-pool allocatable ceiling for NEW
+                          # bins (+inf = lattice alloc rules alone; the
+                          # NodePool kubelet maxPods knob caps the pods axis)
 
 
 class PackResult(NamedTuple):
@@ -236,7 +239,10 @@ def _pack_step(alloc: jnp.ndarray, avail_f: jnp.ndarray, pools: PoolParams,
     zm_np = pools.np_zone & g.g_zone[None, :]                  # [NP,Z]
     cm_np = pools.np_cap & g.g_cap[None, :]                    # [NP,C]
     reach_np = _offer_reachable(avail_f, zm_np, cm_np)         # [NP,T]
-    head_np = alloc[None, :, :] - pools.ds[:, None, :]         # [NP,T,R]
+    # a pool's allocatable ceiling (kubelet maxPods etc.) caps fresh-node
+    # headroom alongside the per-type lattice allocatable
+    head_np = (jnp.minimum(alloc[None, :, :], pools.cap[:, None, :])
+               - pools.ds[:, None, :])                         # [NP,T,R]
     n_per_t = _fit_counts(head_np, g.req)                      # [NP,T]
     valid_np_t = tm_np & reach_np & g.g_np[:, None]
     n_per_np = jnp.max(jnp.where(valid_np_t, n_per_t, 0.0), axis=1).astype(jnp.int32)  # [NP]
@@ -268,7 +274,13 @@ def _pack_step(alloc: jnp.ndarray, avail_f: jnp.ndarray, pools: PoolParams,
                      cum1)
 
     # ---- shrink masks once, for updated + new bins together ----
-    still_fits = jnp.all(eff_alloc + EPS >= cum2[:, None, :], axis=-1)  # [B,T]
+    # new bins carry their pool's allocatable ceiling from birth; the
+    # fit check this step must already see it (later steps read it from
+    # the carried alloc_cap)
+    alloc_cap2 = jnp.where(is_new[:, None], pools.cap[np_star][None, :],
+                           state.alloc_cap)
+    eff_alloc2 = jnp.minimum(alloc[None, :, :], alloc_cap2[:, None, :])
+    still_fits = jnp.all(eff_alloc2 + EPS >= cum2[:, None, :], axis=-1)  # [B,T]
     tmask2 = jnp.where(is_new[:, None], tm_np[np_star][None, :] & reach_np[np_star][None, :],
                        jnp.where(updated[:, None], tm & reachable, state.tmask))
     tmask2 = tmask2 & jnp.where((is_new | updated)[:, None], still_fits, True)
@@ -288,7 +300,7 @@ def _pack_step(alloc: jnp.ndarray, avail_f: jnp.ndarray, pools: PoolParams,
         npods=state.npods + take + take_new,
         open=state.open | is_new,
         fixed=state.fixed,
-        alloc_cap=state.alloc_cap,
+        alloc_cap=alloc_cap2,
         pm=state.pm + n_placed[:, None] * g.match[None, :].astype(jnp.int32),
         po=state.po | (placed[:, None] & g.owner[None, :]),
         next_open=state.next_open + n_new,

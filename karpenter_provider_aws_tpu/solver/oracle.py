@@ -55,6 +55,10 @@ class OraclePlan:
 def ffd_oracle(problem: Problem) -> OraclePlan:
     lat = problem.lattice
     alloc, avail, price = lat.alloc, lat.available, lat.price
+    # per-pool allocatable ceiling (kubelet maxPods): a new bin of pool
+    # pi fits against min(lattice alloc, pool cap) exactly like the kernel
+    eff_alloc = np.minimum(alloc[None, :, :],
+                           problem.np_alloc_cap[:, None, :])  # [NP,T,R]
     unschedulable = dict(problem.unschedulable)
     A = problem.A
 
@@ -133,7 +137,7 @@ def ffd_oracle(problem: Problem) -> OraclePlan:
                 zm = b.zmask & group.zone_mask
                 cm = b.cmask & group.cap_mask
                 new_cum = b.cum + req
-                fits = tm & (alloc >= new_cum[None, :] - 1e-3).all(axis=1)
+                fits = tm & (eff_alloc[b.np_idx] >= new_cum[None, :] - 1e-3).all(axis=1)
                 fits = type_has_offering(fits, zm, cm)
                 if fits.any():
                     b.cum, b.tmask, b.zmask, b.cmask = new_cum, fits, zm, cm
@@ -162,7 +166,7 @@ def ffd_oracle(problem: Problem) -> OraclePlan:
                 tm = group.type_mask & problem.np_type[pi]
                 zm = group.zone_mask & problem.np_zone[pi]
                 cm = group.cap_mask & problem.np_cap[pi]
-                fits = tm & (alloc >= cum[None, :] - 1e-3).all(axis=1)
+                fits = tm & (eff_alloc[pi] >= cum[None, :] - 1e-3).all(axis=1)
                 fits = type_has_offering(fits, zm, cm)
                 if fits.any():
                     nb = OracleBin(np_idx=pi, cum=cum, tmask=fits, zmask=zm, cmask=cm,

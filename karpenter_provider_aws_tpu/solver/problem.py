@@ -36,7 +36,7 @@ import numpy as np
 from ..apis import wellknown as wk
 from ..apis.objects import NodePool, Pod, tolerates_all
 from ..apis.requirements import Requirements
-from ..apis.resources import R, resources_to_vec_checked
+from ..apis.resources import R, axis as res_axis, resources_to_vec_checked
 from ..lattice.tensors import Lattice
 from ..ops.masks import _AXIS_KEYS, _CAT_KEY_INDEX, _NUM_KEY_INDEX, compile_masks
 from .topology import _BIG, BoundPod, ClassRegistry, resolve_group_topology
@@ -104,6 +104,8 @@ class Problem:
     np_zone: np.ndarray            # [NP,Z] bool
     np_cap: np.ndarray             # [NP,C] bool
     ds_overhead: np.ndarray        # [NP,R] f32 daemonset overhead per new node
+    np_alloc_cap: np.ndarray       # [NP,R] f32 allocatable ceiling (+inf;
+                                   # kubelet maxPods caps the pods axis)
     # existing-bin arrays
     e_used: np.ndarray             # [E,R] f32
     e_alloc: np.ndarray            # [E,R] f32 (fixed node allocatable)
@@ -611,8 +613,14 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
     np_zone = np.ones((NP, Z), dtype=bool)
     np_cap = np.ones((NP, C), dtype=bool)
     ds_overhead = np.zeros((NP, R), dtype=np.float32)
+    np_alloc_cap = np.full((NP, R), np.inf, dtype=np.float32)
     pool_reqs: List[Requirements] = []
     for pi, pool in enumerate(pools):
+        if pool.kubelet is not None and pool.kubelet.max_pods is not None:
+            # kubelet maxPods caps the pods axis of every node the pool
+            # launches, below the ENI-derived density (reference
+            # nodepools CRD spec.template.spec.kubelet)
+            np_alloc_cap[pi, res_axis("pods")] = float(pool.kubelet.max_pods)
         reqs = pool.scheduling_requirements()
         pool_reqs.append(reqs)
         # a pool's OWN value-free custom-key requirements (Exists / In on
@@ -625,10 +633,14 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
             # remaining limit budget caps a NEW node's size at solve time
             # (the reference narrows an in-flight node's instance-type
             # options as the pool approaches spec.limits) — limits roll up
-            # to the base pool for virtual variants
+            # to the base pool for virtual variants. The charge a node
+            # makes against the limit is its CLAMPED capacity (kubelet
+            # maxPods lowers the pods axis), so compare the clamped value
             rem = pool_headroom.get(pool.base_name or pool.name)
             if rem is not None:
-                np_type[pi] &= np.all(lattice.capacity <= rem[None, :] + 1e-6,
+                eff_capacity = np.minimum(lattice.capacity,
+                                          np_alloc_cap[pi][None, :])
+                np_type[pi] &= np.all(eff_capacity <= rem[None, :] + 1e-6,
                                       axis=1)
         for ds in daemonset_pods:
             # a daemonset lands on the pool's nodes iff it tolerates the pool
@@ -843,6 +855,7 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
         g_match=g_match, g_owner=g_owner, g_need=g_need, strict_custom=strict_custom,
         warnings=list(dict.fromkeys(warnings)),  # distinct notices once each
         np_type=np_type, np_zone=np_zone, np_cap=np_cap, ds_overhead=ds_overhead,
+        np_alloc_cap=np_alloc_cap,
         e_used=e_used, e_alloc=e_alloc, e_type=e_type, e_zone=e_zone, e_cap=e_cap,
         e_np=e_np, e_pm=e_pm, e_po=e_po,
     )

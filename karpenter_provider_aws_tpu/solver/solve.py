@@ -345,8 +345,8 @@ class Solver:
         NP = max(problem.NP, 1) if NP is None else NP
         lat = self.lattice
 
-        def fit(a, shape, dtype):
-            out = np.zeros(shape, dtype)
+        def fit(a, shape, dtype, fill=0):
+            out = np.full(shape, fill, dtype)
             if a.size:
                 out[: a.shape[0]] = a
             return jnp.asarray(out)
@@ -356,6 +356,7 @@ class Solver:
             np_zone=fit(problem.np_zone, (NP, lat.Z), bool),
             np_cap=fit(problem.np_cap, (NP, lat.C), bool),
             ds=fit(problem.ds_overhead, (NP, R), np.float32),
+            cap=fit(problem.np_alloc_cap, (NP, R), np.float32, fill=np.inf),
         )
 
     def _init_state(self, problem: Problem, B: int,

@@ -406,7 +406,13 @@ class ClusterState:
                     instance_type=claim.instance_type,
                     zone=claim.zone or lattice.zones[0],
                     capacity_type=claim.capacity_type or "on-demand",
-                    used=used, labels=dict(claim.labels)))
+                    used=used, labels=dict(claim.labels),
+                    # an in-flight claim's allocatable (e.g. a kubelet
+                    # maxPods clamp) binds exactly like a registered
+                    # node's — omitting it let consolidation what-ifs
+                    # overpack unregistered claims and churn forever
+                    alloc_override=(canonical_to_vec(claim.allocatable)
+                                    if claim.allocatable else None)))
             return bins
 
     def bound_pods(self) -> List[BoundPod]:

@@ -610,3 +610,57 @@ class TestReservedCapacityPriority:
         assert 0 < launched_cpu <= 8000
         assert bound >= 3           # partial fill, not zero
         assert env.cluster.pending_pods()  # overflow correctly pending
+
+
+class TestKubeletMaxPods:
+    """NodePool spec.template.spec.kubelet.maxPods (reference nodepools
+    CRD; the pod-dense scale test pins maxPods: 110): the pool's nodes
+    accept at most N pods regardless of ENI-derived density, enforced at
+    solve time and persisted through the claim to the registered node."""
+
+    def test_max_pods_caps_density(self, lattice):
+        from karpenter_provider_aws_tpu.apis.objects import KubeletSpec
+        clock = FakeClock()
+        env = Operator(options=Options(registration_delay=1.0),
+                       lattice=lattice, cloud=FakeCloud(clock), clock=clock,
+                       node_pools=[NodePool(
+                           name="default", kubelet=KubeletSpec(max_pods=4),
+                           requirements=[Requirement(
+                               wk.LABEL_CAPACITY_TYPE, ReqOperator.IN,
+                               ("on-demand",))])])
+        # 10 tiny pods easily fit ONE node by resources; maxPods=4 forces
+        # at least 3 nodes
+        for p in pods(10, cpu="100m", mem="128Mi"):
+            env.cluster.add_pod(p)
+        env.settle()
+        assert all(p.node_name for p in env.cluster.pods.values())
+        per_node = {n: len(ps) for n, ps in env.cluster.pods_by_node().items()}
+        assert max(per_node.values()) <= 4, per_node
+        assert len(env.cluster.nodes) >= 3
+        # the clamp persisted into claim + node allocatable
+        for claim in env.cluster.claims.values():
+            assert claim.allocatable["pods"] <= 4
+            node = env.cluster.node_for_claim(claim.name)
+            assert node.allocatable["pods"] <= 4
+
+    def test_second_wave_respects_existing_node_cap(self, lattice):
+        from karpenter_provider_aws_tpu.apis.objects import KubeletSpec
+        clock = FakeClock()
+        env = Operator(options=Options(registration_delay=1.0),
+                       lattice=lattice, cloud=FakeCloud(clock), clock=clock,
+                       node_pools=[NodePool(
+                           name="default", kubelet=KubeletSpec(max_pods=3),
+                           requirements=[Requirement(
+                               wk.LABEL_CAPACITY_TYPE, ReqOperator.IN,
+                               ("on-demand",))])])
+        for p in pods(3, cpu="100m", mem="128Mi"):
+            env.cluster.add_pod(p)
+        env.settle()
+        assert len(env.cluster.nodes) == 1
+        # a second wave cannot squeeze onto the full node
+        for p in pods(2, cpu="100m", mem="128Mi", prefix="wave2"):
+            env.cluster.add_pod(p)
+        env.settle()
+        per_node = {n: len(ps) for n, ps in env.cluster.pods_by_node().items()}
+        assert max(per_node.values()) <= 3, per_node
+        assert len(env.cluster.nodes) == 2

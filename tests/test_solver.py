@@ -506,3 +506,27 @@ class TestProbeBatch:
         assert pr.feasible and pr.n_new == 1
         assert pr.new_cap_type == "spot"
         assert pr.flex > 0
+
+
+class TestKubeletCapParity:
+    def test_oracle_respects_pool_max_pods(self, lattice):
+        """The FFD oracle applies Problem.np_alloc_cap exactly like the
+        kernel, so cost parity is meaningful for maxPods pools; the
+        native referee declines such problems."""
+        from karpenter_provider_aws_tpu.apis.objects import KubeletSpec
+        from karpenter_provider_aws_tpu.native import native_ffd_pack
+        pool = NodePool(name="default", kubelet=KubeletSpec(max_pods=2),
+                        requirements=[Requirement(wk.LABEL_CAPACITY_TYPE,
+                                                  Operator.IN, ("on-demand",))])
+        pods = generic_pods(6, cpu="100m", mem="128Mi")
+        problem = build_problem(pods, [pool], lattice)
+        solver = Solver(lattice)
+        plan = solver.solve(problem)
+        oracle = ffd_oracle(problem)
+        assert not plan.unschedulable and not oracle.unschedulable
+        # both respect the 2-pod cap: >= 3 nodes each
+        assert len(plan.new_nodes) >= 3
+        assert sum(1 for b in oracle.bins if not b.is_existing and b.pods) >= 3
+        assert all(len(n.pods) <= 2 for n in plan.new_nodes)
+        assert plan.new_node_cost <= oracle.new_node_cost * 1.02 + 1e-6
+        assert native_ffd_pack(problem) is None  # out of native scope

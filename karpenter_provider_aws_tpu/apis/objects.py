@@ -260,9 +260,19 @@ class KubeletSpec:
     """The NodePool kubelet block (reference nodepools CRD
     spec.template.spec.kubelet): per-pool kubelet knobs that change node
     allocatable. ``max_pods`` caps the pods axis below the ENI-derived
-    density (the reference's pod-dense scale test pins maxPods: 110)."""
+    density (the reference's pod-dense scale test pins maxPods: 110).
+
+    ``clamp_pods`` is THE shared application point — the claim fill
+    (cloudprovider), limit accounting (provisioning), and solve tensors
+    (problem.np_alloc_cap) all reduce to capping the pods axis, and a new
+    knob here must extend every consumer in lockstep."""
 
     max_pods: Optional[int] = None
+
+    def clamp_pods(self, pods_value: float) -> float:
+        if self.max_pods is None:
+            return pods_value
+        return min(pods_value, float(self.max_pods))
 
 
 @dataclass

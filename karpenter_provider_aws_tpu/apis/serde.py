@@ -18,9 +18,9 @@ from typing import Dict, List, Mapping, Optional
 import numpy as np
 
 from .objects import (
-    DisruptionBudget, NodePool, NodePoolDisruption, PersistentVolumeClaim,
-    Pod, PodAffinityTerm, PreferredRequirement, StorageClass, Taint,
-    TaintEffect, Toleration, TopologySpreadConstraint,
+    DisruptionBudget, KubeletSpec, NodePool, NodePoolDisruption,
+    PersistentVolumeClaim, Pod, PodAffinityTerm, PreferredRequirement,
+    StorageClass, Taint, TaintEffect, Toleration, TopologySpreadConstraint,
 )
 from .requirements import Operator, Requirement
 
@@ -144,6 +144,8 @@ def nodepool_to_dict(p: NodePool) -> Dict:
                 for b in p.disruption.budgets],
         },
         "nodeClassRef": p.node_class_ref,
+        "kubelet": ({"maxPods": p.kubelet.max_pods}
+                    if p.kubelet is not None else None),
     }
 
 
@@ -174,6 +176,8 @@ def nodepool_from_dict(d: Mapping) -> NodePool:
                 reasons=tuple(b.get("reasons", ())))
                 for b in dis.get("budgets", [{}])]),
         node_class_ref=d.get("nodeClassRef", "default"),
+        kubelet=(KubeletSpec(max_pods=d["kubelet"].get("maxPods"))
+                 if d.get("kubelet") else None),
     )
 
 

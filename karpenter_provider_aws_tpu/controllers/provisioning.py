@@ -22,7 +22,7 @@ import numpy as np
 from ..apis import wellknown as wk
 from ..apis.objects import NodeClaim, NodeClaimPhase, NodePool, Pod
 from ..apis.requirements import Operator, Requirement
-from ..apis.resources import R, resources_to_vec
+from ..apis.resources import R, axis as res_axis, resources_to_vec
 from ..cache.unavailable import UnavailableOfferings
 from ..cloudprovider.cloudprovider import CloudProvider
 from ..errors import UnfulfillableCapacityError
@@ -35,6 +35,7 @@ from ..utils.clock import Clock
 
 BATCH_IDLE_SECONDS = 1.0   # settings.md:17 batch-idle-duration (default)
 BATCH_MAX_SECONDS = 10.0   # settings.md:18 batch-max-duration (default)
+_PODS_AXIS = res_axis("pods")
 
 
 def nodepool_hash(pool: NodePool) -> str:
@@ -45,6 +46,10 @@ def nodepool_hash(pool: NodePool) -> str:
     payload = json.dumps({
         "labels": sorted(pool.labels.items()),
         "annotations": sorted(pool.annotations.items()),
+        # kubelet knobs are template spec: changing maxPods must drift
+        # (and roll) nodes launched with the old density
+        "kubelet": (pool.kubelet.max_pods
+                    if pool.kubelet is not None else None),
         "taints": [(t.key, t.value, t.effect) for t in pool.taints],
         "requirements": [(r.key, r.operator.value, r.values) for r in pool.requirements],
         "node_class_ref": pool.node_class_ref,
@@ -257,7 +262,6 @@ class Provisioner:
         pause-this-pool pattern and must block), np.inf elsewhere. The
         single source of the limited-axes semantics — both the solve-time
         headroom mask and _enforce_limits consume it."""
-        from ..apis.resources import axis as res_axis
         limit = pool.limits_vec()
         if limit is None:
             return None
@@ -332,6 +336,7 @@ class Provisioner:
                 continue
             current = usage.get(node.node_pool, np.zeros((R,), np.float32))
             remaining = self._remaining(pool, current)
+            kub = pool.kubelet
 
             def node_capacity(tname: str) -> np.ndarray:
                 """What the launched node will actually charge against
@@ -339,12 +344,9 @@ class Provisioner:
                 create, so limit accounting must see the clamped value
                 (pool_usage later charges exactly this)."""
                 cap = lat.capacity[lat.name_to_idx[tname]]
-                kub = pool.kubelet
                 if kub is not None and kub.max_pods is not None:
-                    from ..apis.resources import axis as res_axis
                     cap = cap.copy()
-                    pi = res_axis("pods")
-                    cap[pi] = min(cap[pi], float(kub.max_pods))
+                    cap[_PODS_AXIS] = kub.clamp_pods(cap[_PODS_AXIS])
                 return cap
 
             def fits(tname: str) -> bool:

@@ -664,3 +664,13 @@ class TestKubeletMaxPods:
         per_node = {n: len(ps) for n, ps in env.cluster.pods_by_node().items()}
         assert max(per_node.values()) <= 3, per_node
         assert len(env.cluster.nodes) == 2
+
+    def test_max_pods_change_drifts_nodes(self, lattice):
+        """kubelet is template spec: lowering maxPods must roll existing
+        nodes (the hash covers the kubelet block)."""
+        from karpenter_provider_aws_tpu.apis.objects import KubeletSpec
+        from karpenter_provider_aws_tpu.controllers.provisioning import nodepool_hash
+        p1 = NodePool(name="x", kubelet=KubeletSpec(max_pods=110))
+        p2 = NodePool(name="x", kubelet=KubeletSpec(max_pods=50))
+        p3 = NodePool(name="x")
+        assert len({nodepool_hash(p1), nodepool_hash(p2), nodepool_hash(p3)}) == 3

@@ -123,3 +123,22 @@ class TestSidecarTransport:
             client.close()
         finally:
             server.stop(grace=None)
+
+
+class TestNodePoolWireCompleteness:
+    def test_kubelet_and_budget_windows_survive_the_wire(self):
+        from karpenter_provider_aws_tpu.apis import NodePool, serde
+        from karpenter_provider_aws_tpu.apis.objects import (
+            DisruptionBudget, KubeletSpec, NodePoolDisruption)
+        p = NodePool(name="x", kubelet=KubeletSpec(max_pods=110),
+                     annotations={"a": "b"},
+                     disruption=NodePoolDisruption(budgets=[
+                         DisruptionBudget(nodes="0", schedule="0 0 * * *",
+                                          duration=3600.0)]))
+        rt = serde.nodepool_from_dict(serde.nodepool_to_dict(p))
+        assert rt.kubelet is not None and rt.kubelet.max_pods == 110
+        assert rt.annotations == {"a": "b"}
+        b = rt.disruption.budgets[0]
+        assert (b.nodes, b.schedule, b.duration) == ("0", "0 0 * * *", 3600.0)
+        plain = serde.nodepool_from_dict(serde.nodepool_to_dict(NodePool(name="y")))
+        assert plain.kubelet is None

@@ -262,10 +262,10 @@ class KubeletSpec:
     allocatable. ``max_pods`` caps the pods axis below the ENI-derived
     density (the reference's pod-dense scale test pins maxPods: 110).
 
-    ``clamp_pods`` is THE shared application point — the claim fill
-    (cloudprovider), limit accounting (provisioning), and solve tensors
-    (problem.np_alloc_cap) all reduce to capping the pods axis, and a new
-    knob here must extend every consumer in lockstep."""
+    Three consumers apply the cap and must stay in lockstep when a knob
+    is added here: the solve tensors (problem.np_alloc_cap), limit
+    accounting (provisioning _enforce_limits via ``clamp_pods``), and
+    the claim fill (cloudprovider.create via NodeClaim.max_pods)."""
 
     max_pods: Optional[int] = None
 

@@ -576,7 +576,19 @@ class DisruptionController:
             reason = self.cloud_provider.is_drifted(claim)
             if reason is None:
                 have = claim.annotations.get(wk.ANNOTATION_NODEPOOL_HASH)
-                if have is not None and have != nodepool_hash(pool):
+                have_ver = claim.annotations.get(
+                    wk.ANNOTATION_NODEPOOL_HASH_VERSION)
+                from .provisioning import NODEPOOL_HASH_VERSION
+                if have is not None and have_ver != NODEPOOL_HASH_VERSION:
+                    # hash formula changed between controller versions:
+                    # RE-STAMP under the new formula instead of treating
+                    # the formula change itself as drift (which would
+                    # roll every pre-upgrade node fleet-wide)
+                    claim.annotations[wk.ANNOTATION_NODEPOOL_HASH] = \
+                        nodepool_hash(pool)
+                    claim.annotations[wk.ANNOTATION_NODEPOOL_HASH_VERSION] = \
+                        NODEPOOL_HASH_VERSION
+                elif have is not None and have != nodepool_hash(pool):
                     reason = "NodePoolDrift"
             if reason is None:
                 continue

@@ -37,6 +37,13 @@ BATCH_IDLE_SECONDS = 1.0   # settings.md:17 batch-idle-duration (default)
 BATCH_MAX_SECONDS = 10.0   # settings.md:18 batch-max-duration (default)
 _PODS_AXIS = res_axis("pods")
 
+# Bumped whenever the nodepool_hash PAYLOAD SHAPE changes (e.g. the
+# kubelet block joining it): claims stamped under an older version are
+# RE-STAMPED instead of drift-compared, so a controller upgrade never
+# rolls the whole fleet (the reference migrates its hash the same way —
+# wellknown ANNOTATION_NODEPOOL_HASH_VERSION).
+NODEPOOL_HASH_VERSION = "v2"
+
 
 def nodepool_hash(pool: NodePool) -> str:
     """Template hash for NodePool drift detection (the core's
@@ -411,7 +418,9 @@ class Provisioner:
             # template annotations propagate (disruption.md:294 — a
             # do-not-disrupt NodePool shields every node it launches)
             annotations={**pool.annotations,
-                         wk.ANNOTATION_NODEPOOL_HASH: nodepool_hash(pool)},
+                         wk.ANNOTATION_NODEPOOL_HASH: nodepool_hash(pool),
+                         wk.ANNOTATION_NODEPOOL_HASH_VERSION:
+                             NODEPOOL_HASH_VERSION},
             taints=list(pool.taints), node_class_ref=pool.node_class_ref,
             max_pods=(pool.kubelet.max_pods if pool.kubelet is not None
                       else None),

@@ -21,9 +21,11 @@ Transport: any gRPC address. ``unix:`` sockets for the local sidecar
 from __future__ import annotations
 
 import json
+import math
 from typing import Dict, List, Optional, Sequence
 
 import grpc
+import numpy as np
 
 from ..apis import serde
 from ..solver.solve import NodePlan, Solver
@@ -60,9 +62,15 @@ class SolverService:
                 for c in req.get("pvcs", ())} or None
         scs = {s["name"]: serde.storage_class_from_dict(s)
                for s in req.get("storageClasses", ())} or None
+        # null = unlimited axis (np.inf is not representable in strict
+        # RFC 8259 JSON, and the wire must stay cross-language)
+        headroom = {k: np.asarray([np.inf if x is None else x for x in v],
+                                  np.float32)
+                    for k, v in (req.get("poolHeadroom") or {}).items()} or None
         plan = self.solver.solve_relaxed(
             pods, pools, existing=existing, daemonset_pods=ds,
-            bound_pods=bound, pvcs=pvcs, storage_classes=scs)
+            bound_pods=bound, pvcs=pvcs, storage_classes=scs,
+            pool_headroom=headroom)
         return json.dumps(serde.plan_to_dict(plan)).encode()
 
     def health(self, payload: bytes) -> bytes:
@@ -116,7 +124,8 @@ class SolverClient:
     def solve(self, pods: Sequence, node_pools: Sequence,
               existing: Sequence = (), daemonset_pods: Sequence = (),
               bound_pods: Sequence = (), pvcs: Optional[Dict] = None,
-              storage_classes: Optional[Dict] = None) -> NodePlan:
+              storage_classes: Optional[Dict] = None,
+              pool_headroom: Optional[Dict] = None) -> NodePlan:
         req = {
             "pods": [serde.pod_to_dict(p) for p in pods],
             "nodePools": [serde.nodepool_to_dict(p) for p in node_pools],
@@ -131,6 +140,10 @@ class SolverClient:
                      for c in (pvcs or {}).values()],
             "storageClasses": [serde.storage_class_to_dict(s)
                                for s in (storage_classes or {}).values()],
+            "poolHeadroom": ({k: [None if not math.isfinite(float(x))
+                                  else float(x) for x in v]
+                              for k, v in pool_headroom.items()}
+                             if pool_headroom else None),
         }
         resp = self._solve(json.dumps(req).encode(), timeout=self.timeout)
         return serde.plan_from_dict(json.loads(resp.decode()))

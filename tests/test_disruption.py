@@ -521,3 +521,30 @@ class TestScheduledBudgets:
         c = Cron("0 0/6 * * *")
         assert c.hour == {0, 6, 12, 18}
         assert Cron("0/15 * * * *").minute == {0, 15, 30, 45}
+
+
+class TestHashVersionMigration:
+    def test_formula_change_restamps_instead_of_rolling(self, lattice):
+        """A claim stamped under an OLDER hash version is re-stamped on
+        the next drift pass, never drifted for the formula change itself
+        (a controller upgrade must not roll the fleet)."""
+        from karpenter_provider_aws_tpu.controllers.provisioning import (
+            NODEPOOL_HASH_VERSION, nodepool_hash)
+        env = make_env(lattice, consolidate_after=300.0)
+        for p in pods(2):
+            env.cluster.add_pod(p)
+        env.settle()
+        (claim,) = env.cluster.claims.values()
+        # simulate a pre-upgrade claim: stale formula, no/old version
+        claim.annotations[wk.ANNOTATION_NODEPOOL_HASH] = "old-formula-hash"
+        claim.annotations.pop(wk.ANNOTATION_NODEPOOL_HASH_VERSION, None)
+        env.disruption._reconcile_drift()
+        assert not claim.deletion_timestamp, "upgrade rolled the node"
+        assert claim.annotations[wk.ANNOTATION_NODEPOOL_HASH] == \
+            nodepool_hash(env.node_pools["default"])
+        assert claim.annotations[wk.ANNOTATION_NODEPOOL_HASH_VERSION] == \
+            NODEPOOL_HASH_VERSION
+        # a REAL template change under the current version still drifts
+        env.node_pools["default"].labels["rollme"] = "yes"
+        env.disruption._reconcile_drift()
+        assert any(a.reason == "Drifted" for a in env.disruption._in_flight)

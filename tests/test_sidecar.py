@@ -142,3 +142,37 @@ class TestNodePoolWireCompleteness:
         assert (b.nodes, b.schedule, b.duration) == ("0", "0 0 * * *", 3600.0)
         plain = serde.nodepool_from_dict(serde.nodepool_to_dict(NodePool(name="y")))
         assert plain.kubelet is None
+
+
+class TestHeadroomOverTheWire:
+    def test_remote_solve_respects_pool_headroom(self, tmp_path):
+        import numpy as np
+        from karpenter_provider_aws_tpu.apis import NodePool, Operator as ReqOp, Pod, Requirement
+        from karpenter_provider_aws_tpu.apis import wellknown as wk
+        from karpenter_provider_aws_tpu.apis.resources import R, axis
+        from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+        from karpenter_provider_aws_tpu.parallel.sidecar import SolverClient, serve
+        from karpenter_provider_aws_tpu.solver import Solver
+
+        lattice = build_lattice([s for s in build_catalog()
+                                 if s.family in ("m5", "c5", "t3")])
+        addr = f"unix:{tmp_path}/solver.sock"
+        server = serve(Solver(lattice), addr)
+        client = SolverClient(addr)
+        try:
+            pool = NodePool(name="default", requirements=[
+                Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN, ("on-demand",))])
+            pods = [Pod(name=f"p{i}", requests={"cpu": "2", "memory": "2Gi"})
+                    for i in range(4)]
+            rem = np.full((R,), np.inf, np.float32)
+            rem[axis("cpu")] = 8000.0  # one 8-cpu node's worth remains
+            plan = client.solve(pods, [pool],
+                                pool_headroom={"default": rem})
+            placed = sum(len(n.pods) for n in plan.new_nodes)
+            for n in plan.new_nodes:
+                ti = lattice.name_to_idx[n.instance_type]
+                assert lattice.capacity[ti][axis("cpu")] <= 8000.0
+            assert placed + len(plan.unschedulable) == 4
+        finally:
+            client.close()
+            server.stop(grace=None)

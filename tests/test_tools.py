@@ -76,9 +76,10 @@ class TestKompat:
         lo, hi = rows[0].min_k8s, rows[0].max_k8s
         assert kompat.check(rows, "0.1.0", f"{lo[0]}.{lo[1]}") is not None
         assert kompat.check(rows, "0.1.0", f"{hi[0]}.{hi[1] + 1}") is None
-        # wildcard pattern matching: 0.1.x covers any 0.1.* but not 0.2.*
+        # wildcard pattern matching: 0.1.x covers any 0.1.* but an app
+        # line absent from the matrix never matches
         assert kompat.check(rows, "0.1.7", f"{lo[0]}.{lo[1]}") is not None
-        assert kompat.check(rows, "0.2.0", f"{lo[0]}.{lo[1]}") is None
+        assert kompat.check(rows, "0.9.0", f"{lo[0]}.{lo[1]}") is None
 
     def test_validate_flags_bad_ranges(self):
         import kompat
@@ -96,9 +97,11 @@ class TestKompat:
         from karpenter_provider_aws_tpu.cloud import FakeCloud
         from karpenter_provider_aws_tpu.providers.version import VersionProvider
         from karpenter_provider_aws_tpu.utils.clock import FakeClock
+        import karpenter_provider_aws_tpu as pkg
         v = VersionProvider(FakeCloud(FakeClock())).get()
         _, rows = kompat.load_matrix()
-        assert kompat.check(rows, "0.1.0", v) is not None, v
+        # the SHIPPED version must be covered by the shipped matrix
+        assert kompat.check(rows, pkg.__version__, v) is not None, (pkg.__version__, v)
 
 
 class TestWebhookPdb:

@@ -646,7 +646,10 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
             # a daemonset lands on the pool's nodes iff it tolerates the pool
             # taints and its node selectors are compatible (reference
             # resolves daemonset overhead per simulated node the same way)
-            if not tolerates_all(ds.tolerations, pool.taints + pool.startup_taints):
+            # startupTaints clear before steady state: a daemonset still
+            # runs (and costs overhead) on the pool's nodes even without
+            # tolerating them (reference nodepools.md:484)
+            if not tolerates_all(ds.tolerations, pool.taints):
                 continue
             # hard rules only: a daemonset's zone/node PREFERENCE must not
             # drop its overhead from nodes it would still run on (in real
@@ -685,7 +688,11 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
             # directional: pod requirements vs the pool's node template
             if not reqs.compatible_with(pool_reqs[pi]):
                 continue
-            if not tolerates_all(rep.tolerations, pool.taints + pool.startup_taints):
+            # pods are NOT required to tolerate startupTaints — they are
+            # temporary and cleared by an init daemon before steady-state
+            # scheduling (reference nodepools.md:60-64,484: "pods aren't
+            # required to tolerate these taints to be considered")
+            if not tolerates_all(rep.tolerations, pool.taints):
                 continue
             if not _custom_keys_ok(reqs, pool.labels):
                 continue

@@ -530,3 +530,33 @@ class TestKubeletCapParity:
         assert all(len(n.pods) <= 2 for n in plan.new_nodes)
         assert plan.new_node_cost <= oracle.new_node_cost * 1.02 + 1e-6
         assert native_ffd_pack(problem) is None  # out of native scope
+
+
+class TestStartupTaints:
+    def test_pods_need_not_tolerate_startup_taints(self, solver, lattice):
+        """nodepools.md:484 (the Cilium pattern): startupTaints are
+        temporary; pods schedule onto the pool WITHOUT tolerating them,
+        while ordinary pool taints still require toleration."""
+        pool = default_pool(
+            startup_taints=[Taint("node.cilium.io/agent-not-ready", "true")])
+        plan = solver.solve(build_problem(generic_pods(3), [pool], lattice))
+        assert not plan.unschedulable
+        # a REGULAR taint still blocks intolerant pods
+        pool2 = default_pool(
+            taints=[Taint("dedicated", "x")],
+            startup_taints=[Taint("node.cilium.io/agent-not-ready", "true")])
+        plan2 = solver.solve(build_problem(generic_pods(3), [pool2], lattice))
+        assert len(plan2.unschedulable) == 3
+
+    def test_daemonset_overhead_counts_despite_startup_taints(self, solver, lattice):
+        """problem.py daemonset filter: a daemonset that does NOT tolerate
+        the pool's startupTaints still runs once they clear, so its
+        overhead must still size the pool's nodes."""
+        pool = default_pool(
+            startup_taints=[Taint("node.cilium.io/agent-not-ready", "true")])
+        ds = Pod(name="logging-agent", is_daemonset=True,
+                 requests={"cpu": "1", "memory": "1Gi"})
+        problem = build_problem(generic_pods(1), [pool], lattice,
+                                daemonset_pods=[ds])
+        (pi,) = range(problem.NP)
+        assert problem.ds_overhead[pi][0] >= 1000.0  # the agent's 1 cpu

@@ -22,7 +22,9 @@ import time
 import numpy as np
 
 TARGET_MS = 200.0
-ITERS = 7
+# the tunneled-TPU link's per-call latency swings tens of ms call-to-call;
+# a p50 over 15 samples is stable where 7 still wobbled
+ITERS = 15
 
 
 def _pools_default():

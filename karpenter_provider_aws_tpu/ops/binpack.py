@@ -33,6 +33,7 @@ from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 EPS = 1e-3
 
@@ -52,6 +53,7 @@ def _clear_pack_caches() -> None:
     # entry point that read it, or same-shape calls keep the old trace
     pack.clear_cache()
     pack_packed.clear_cache()
+    pack_packed_fused.clear_cache()
     pack_probe.clear_cache()
 
 
@@ -465,6 +467,97 @@ def pack_packed(alloc: jnp.ndarray, avail: jnp.ndarray, price: jnp.ndarray,
     # lean narrows np_id to i16; the pool axis must fit (T/Z/C bounds are
     # asserted inside the encoder, where their shapes are visible)
     assert not lean or pools.np_type.shape[0] < 2 ** 15
+    return _encode_decode_set(pack(alloc, avail, price, groups, pools, init),
+                              lean=lean)
+
+
+class FieldSpec(NamedTuple):
+    """One field of the staged solver input (see group_layout)."""
+
+    name: str       # GroupBatch / PoolParams field
+    offset: int     # byte offset in the fused buffer
+    dtype: object   # np.float32 | np.int32 | np.uint8 (uint8 = bool)
+    shape: tuple
+    src: str        # solver.problem.Problem attribute holding the data
+    fill: float     # pad value beyond the problem's true extent
+
+
+def group_layout(G: int, T: int, Z: int, C: int, NP: int, A: int,
+                 R: int) -> Tuple[Tuple[FieldSpec, ...], int]:
+    """Static spec of the staged solver input: byte layout of the fused
+    GroupBatch+PoolParams upload AND the single source of truth for which
+    Problem attribute feeds each field with which pad fill — both the
+    fused path (solve) and the per-array path (probe/sharded) derive
+    their staging from this table, so pad semantics cannot diverge.
+
+    The host↔device link charges a ~fixed latency per transfer; shipping
+    the 18 input leaves separately costs more than the bytes do (mirror of
+    the fused RESULT buffer, _encode_decode_set). All 4-byte fields lead so
+    every numpy .view() on the host stays aligned; bool fields trail as raw
+    uint8. Returns (FieldSpec, ...) and total byte size.
+    """
+    fields = [
+        # name, dtype, shape, Problem attr, pad fill
+        ("req", np.float32, (G, R), "req", 0),
+        ("count", np.int32, (G,), "count", 0),
+        ("max_per_bin", np.int32, (G,), "max_per_bin", 0),
+        ("spread_class", np.int32, (G,), "g_spread", -1),
+        ("ds", np.float32, (NP, R), "ds_overhead", 0),
+        ("cap", np.float32, (NP, R), "np_alloc_cap", np.inf),
+        ("g_type", np.uint8, (G, T), "g_type", 0),
+        ("g_zone", np.uint8, (G, Z), "g_zone", 0),
+        ("g_cap", np.uint8, (G, C), "g_cap", 0),
+        ("g_np", np.uint8, (G, NP), "g_np", 0),
+        ("single_bin", np.uint8, (G,), "single_bin", 0),
+        ("match", np.uint8, (G, A), "g_match", 0),
+        ("owner", np.uint8, (G, A), "g_owner", 0),
+        ("need", np.uint8, (G, A), "g_need", 0),
+        ("strict_custom", np.uint8, (G,), "strict_custom", 0),
+        ("np_type", np.uint8, (NP, T), "np_type", 0),
+        ("np_zone", np.uint8, (NP, Z), "np_zone", 0),
+        ("np_cap", np.uint8, (NP, C), "np_cap", 0),
+    ]
+    out, off = [], 0
+    for name, dt, shape, src, fill in fields:
+        out.append(FieldSpec(name, off, dt, shape, src, fill))
+        off += int(np.prod(shape)) * np.dtype(dt).itemsize
+    return tuple(out), off
+
+
+_GROUP_FIELD_NAMES = frozenset(GroupBatch._fields)
+
+
+def _unpack_inputs(buf: jnp.ndarray, G: int, T: int, Z: int, C: int,
+                   NP: int, A: int, R: int) -> Tuple[GroupBatch, PoolParams]:
+    """Slice the fused uint8 upload back into GroupBatch + PoolParams
+    inside the trace (static offsets; XLA fuses the bitcasts away)."""
+    layout, _total = group_layout(G, T, Z, C, NP, A, R)
+    vals = {}
+    for f in layout:
+        n = int(np.prod(f.shape))
+        if f.dtype is np.uint8:
+            vals[f.name] = buf[f.offset: f.offset + n].reshape(f.shape).astype(bool)
+        else:
+            tgt = jnp.float32 if f.dtype is np.float32 else jnp.int32
+            seg = jax.lax.bitcast_convert_type(
+                buf[f.offset: f.offset + 4 * n].reshape(n, 4), tgt)
+            vals[f.name] = seg.reshape(f.shape)
+    groups = GroupBatch(**{k: v for k, v in vals.items()
+                           if k in _GROUP_FIELD_NAMES})
+    pools = PoolParams(**{k: v for k, v in vals.items()
+                          if k not in _GROUP_FIELD_NAMES})
+    return groups, pools
+
+
+@partial(jax.jit, static_argnames=("G", "T", "Z", "C", "NP", "A", "lean"))
+def pack_packed_fused(alloc: jnp.ndarray, avail: jnp.ndarray,
+                      price: jnp.ndarray, buf: jnp.ndarray, init: BinState,
+                      G: int, T: int, Z: int, C: int, NP: int, A: int,
+                      lean: bool = False) -> jnp.ndarray:
+    """pack_packed over a single fused input upload: ONE host→device
+    transfer for all group/pool tensors + ONE device→host result buffer."""
+    assert not lean or NP < 2 ** 15
+    groups, pools = _unpack_inputs(buf, G, T, Z, C, NP, A, alloc.shape[1])
     return _encode_decode_set(pack(alloc, avail, price, groups, pools, init),
                               lean=lean)
 

@@ -17,6 +17,7 @@ NodeClaim → Create() flow, SURVEY.md §3.2).
 
 from __future__ import annotations
 
+import hashlib
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -267,8 +268,32 @@ class Solver:
         # trip); a smaller estimate ignores the hint, so one big wave never
         # pins later small solves to a huge padded bin table.
         self._b_hint: Dict[int, Tuple[int, int]] = {}
+        # content-keyed memo of _estimate_bins: steady-state clusters re-solve
+        # the same pending set every pass (bench: every iteration), and the
+        # [G,T,R] fit scan costs ~10 ms host time per 80-group problem
+        self._est_cache: Dict[bytes, int] = {}
+
+    _EST_CACHE_MAX = 128
 
     def _estimate_bins(self, problem: Problem) -> int:
+        key = None
+        if problem.G:
+            h = hashlib.blake2b(digest_size=16)
+            for a in (problem.req, problem.count, problem.g_type,
+                      problem.max_per_bin):
+                h.update(a.tobytes())
+            key = h.digest()
+            hit = self._est_cache.get(key)
+            if hit is not None:
+                return hit
+        est = self._estimate_bins_uncached(problem)
+        if key is not None:
+            if len(self._est_cache) >= self._EST_CACHE_MAX:
+                self._est_cache.clear()
+            self._est_cache[key] = est
+        return est
+
+    def _estimate_bins_uncached(self, problem: Problem) -> int:
         """Lower-bound estimate of bins the pack will open: each group needs
         at least count / (best-case per-node fit) bins, and never packs more
         than max_per_bin per node (hostname spread / anti-affinity).
@@ -310,54 +335,54 @@ class Solver:
 
     # ---- padding ----
 
-    def _padded_groups(self, problem: Problem, G: int,
-                       A: Optional[int] = None,
-                       NP: Optional[int] = None) -> binpack.GroupBatch:
+    def _layout(self, problem: Problem, G: int, A: Optional[int] = None,
+                NP: Optional[int] = None):
         lat = self.lattice
         A = max(problem.A, 1) if A is None else A
         NP = max(problem.NP, 1) if NP is None else NP
+        return binpack.group_layout(G, lat.T, lat.Z, lat.C, NP, A, R)
 
-        def pad(a: np.ndarray, shape, dtype, fill=0):
-            out = np.full(shape, fill, dtype)
-            if a.size:
-                out[tuple(slice(0, s) for s in a.shape)] = a
-            return jnp.asarray(out)
+    @staticmethod
+    def _pad_field(problem: Problem, f: binpack.FieldSpec) -> np.ndarray:
+        dt = bool if f.dtype is np.uint8 else f.dtype
+        out = np.full(f.shape, f.fill, dt)
+        a = getattr(problem, f.src)
+        if a.size:
+            out[tuple(slice(0, s) for s in a.shape)] = a
+        return out
 
-        g = problem
-        return binpack.GroupBatch(
-            req=pad(g.req, (G, R), np.float32),
-            count=pad(g.count, (G,), np.int32),
-            g_type=pad(g.g_type, (G, lat.T), bool),
-            g_zone=pad(g.g_zone, (G, lat.Z), bool),
-            g_cap=pad(g.g_cap, (G, lat.C), bool),
-            g_np=pad(g.g_np, (G, NP), bool),
-            max_per_bin=pad(g.max_per_bin, (G,), np.int32),
-            spread_class=pad(g.g_spread, (G,), np.int32, fill=-1),
-            single_bin=pad(g.single_bin, (G,), bool),
-            match=pad(g.g_match, (G, A), bool),
-            owner=pad(g.g_owner, (G, A), bool),
-            need=pad(g.g_need, (G, A), bool),
-            strict_custom=pad(g.strict_custom, (G,), bool),
-        )
+    def _padded_groups(self, problem: Problem, G: int,
+                       A: Optional[int] = None,
+                       NP: Optional[int] = None) -> binpack.GroupBatch:
+        layout, _ = self._layout(problem, G, A, NP)
+        return binpack.GroupBatch(**{
+            f.name: jnp.asarray(self._pad_field(problem, f))
+            for f in layout if f.name in binpack.GroupBatch._fields})
 
     def _pool_params(self, problem: Problem,
                      NP: Optional[int] = None) -> binpack.PoolParams:
-        NP = max(problem.NP, 1) if NP is None else NP
-        lat = self.lattice
+        layout, _ = self._layout(problem, 1, None, NP)
+        return binpack.PoolParams(**{
+            f.name: jnp.asarray(self._pad_field(problem, f))
+            for f in layout if f.name in binpack.PoolParams._fields})
 
-        def fit(a, shape, dtype, fill=0):
-            out = np.full(shape, fill, dtype)
+    def _fused_inputs(self, problem: Problem, G: int) -> jnp.ndarray:
+        """All group + pool tensors padded into ONE uint8 host buffer →
+        one host→device transfer. Staging 18 arrays separately pays the
+        tunneled link's per-transfer cost 18×; field order/fill semantics
+        are the shared spec in ops/binpack.group_layout, so this path and
+        _padded_groups/_pool_params (probe + sharded) cannot diverge."""
+        layout, total = self._layout(problem, G)
+        buf = np.zeros((total,), np.uint8)
+        for f in layout:
+            n = int(np.prod(f.shape)) * np.dtype(f.dtype).itemsize
+            view = buf[f.offset: f.offset + n].view(f.dtype).reshape(f.shape)
+            if f.fill != 0:
+                view.fill(f.fill)
+            a = getattr(problem, f.src)
             if a.size:
-                out[: a.shape[0]] = a
-            return jnp.asarray(out)
-
-        return binpack.PoolParams(
-            np_type=fit(problem.np_type, (NP, lat.T), bool),
-            np_zone=fit(problem.np_zone, (NP, lat.Z), bool),
-            np_cap=fit(problem.np_cap, (NP, lat.C), bool),
-            ds=fit(problem.ds_overhead, (NP, R), np.float32),
-            cap=fit(problem.np_alloc_cap, (NP, R), np.float32, fill=np.inf),
-        )
+                view[tuple(slice(0, s) for s in a.shape)] = a
+        return jnp.asarray(buf)
 
     def _init_state(self, problem: Problem, B: int,
                     A: Optional[int] = None) -> binpack.BinState:
@@ -577,20 +602,21 @@ class Solver:
         else:
             B = fresh
 
-        groups = self._padded_groups(problem, G)
-        pools = self._pool_params(problem)
+        fused = self._fused_inputs(problem, G)
         avail, price = self._device_avail_price(problem)
 
         lat = self.lattice
         while True:
             init = self._init_state(problem, B)
             td = time.perf_counter()
-            # one fused buffer = one device→host transfer (sync included);
-            # lean layout: the plan decode never reads cum/alloc_cap/pm/po
+            # one fused input upload + one fused result transfer (sync
+            # included); lean layout: the plan decode never reads
+            # cum/alloc_cap/pm/po
             with self._trace_span("solver.pack"):
-                buf = np.asarray(binpack.pack_packed(
-                    self._alloc, avail, price, groups, pools, init,
-                    lean=True))
+                buf = np.asarray(binpack.pack_packed_fused(
+                    self._alloc, avail, price, fused, init,
+                    G, lat.T, lat.Z, lat.C, max(problem.NP, 1),
+                    max(problem.A, 1), lean=True))
             device_s = time.perf_counter() - td
             dec = _unpack_decode_set(buf, G, lat.T, lat.Z, lat.C,
                                      max(problem.A, 1), lean=True)

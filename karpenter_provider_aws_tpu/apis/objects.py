@@ -97,6 +97,15 @@ class StorageClass:
     name: str
     zones: Tuple[str, ...] = ()            # allowedTopologies; () = any zone
     binding_mode: str = "WaitForFirstConsumer"   # or Immediate
+    # CSI driver name. Deprecated in-tree plugins (kubernetes.io/aws-ebs)
+    # publish no CSINode attach limits — the reference logs an error and
+    # cannot enforce volume limits for them (troubleshooting.md:290-294)
+    provisioner: str = "ebs.csi.aws.com"
+
+
+IN_TREE_PROVISIONERS = frozenset({
+    "kubernetes.io/aws-ebs", "kubernetes.io/gce-pd", "kubernetes.io/azure-disk",
+})
 
 
 @dataclass

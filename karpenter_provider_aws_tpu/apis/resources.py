@@ -28,6 +28,11 @@ RESOURCE_AXES = (
     "aws.amazon.com/neuron",     # count
     "vpc.amazonaws.com/efa",     # count
     "vpc.amazonaws.com/pod-eni", # count
+    "attachable-volumes",        # count: CSI volume attach slots (EBS shares
+                                 # the instance's attachment slots with ENIs;
+                                 # the reference discovers per-node limits
+                                 # from CSINode at runtime —
+                                 # website/…/troubleshooting.md:277-299)
 )
 R = len(RESOURCE_AXES)
 
@@ -80,11 +85,16 @@ def vec_to_resources(vec: np.ndarray) -> Dict[str, float]:
     return {name: float(vec[i]) for i, name in enumerate(RESOURCE_AXES) if vec[i] != 0}
 
 
-def canonical_to_vec(resources: Mapping[str, float]) -> np.ndarray:
+def canonical_to_vec(resources: Mapping[str, float],
+                     missing: float = 0.0) -> np.ndarray:
     """Canonical-unit map (cpu millicores, memory MiB — e.g. a NodeClaim's
     status.capacity round-tripped through vec_to_resources) → vector.
-    No quantity parsing: values are already in axis units."""
-    vec = np.zeros((R,), dtype=np.float32)
+    No quantity parsing: values are already in axis units. ``missing``
+    fills axes the map does not mention — pass NaN when the caller wants
+    to distinguish "not reported" from "zero" (a node's status rarely
+    reports every axis; e.g. attachable-volumes comes from CSINode, which
+    may not have registered yet)."""
+    vec = np.full((R,), missing, dtype=np.float32)
     for name, qty in resources.items():
         idx = _AXIS_INDEX.get(name)
         if idx is not None:

@@ -197,13 +197,14 @@ def pvc_from_dict(d: Mapping) -> PersistentVolumeClaim:
 
 def storage_class_to_dict(s: StorageClass) -> Dict:
     return {"name": s.name, "zones": list(s.zones),
-            "bindingMode": s.binding_mode}
+            "bindingMode": s.binding_mode, "provisioner": s.provisioner}
 
 
 def storage_class_from_dict(d: Mapping) -> StorageClass:
     return StorageClass(name=d["name"], zones=tuple(d.get("zones", ())),
                         binding_mode=d.get("bindingMode",
-                                           "WaitForFirstConsumer"))
+                                           "WaitForFirstConsumer"),
+                        provisioner=d.get("provisioner", "ebs.csi.aws.com"))
 
 
 # ---- solver-side objects ---------------------------------------------------
@@ -215,7 +216,11 @@ def existing_bin_to_dict(b) -> Dict:
         "instanceType": b.instance_type, "zone": b.zone,
         "capacityType": b.capacity_type,
         "used": np.asarray(b.used, dtype=float).tolist(),
-        "allocOverride": (np.asarray(b.alloc_override, dtype=float).tolist()
+        # per-element null = axis the node did not report (NaN sentinel);
+        # NaN itself is not representable in strict RFC 8259 JSON and the
+        # wire must stay cross-language
+        "allocOverride": ([None if np.isnan(x) else x
+                           for x in np.asarray(b.alloc_override, dtype=float)]
                           if b.alloc_override is not None else None),
         "labels": dict(b.labels),
     }
@@ -228,8 +233,10 @@ def existing_bin_from_dict(d: Mapping):
         instance_type=d["instanceType"], zone=d["zone"],
         capacity_type=d["capacityType"],
         used=np.asarray(d["used"], dtype=np.float32),
-        alloc_override=(np.asarray(d["allocOverride"], dtype=np.float32)
-                        if d.get("allocOverride") is not None else None),
+        alloc_override=(np.asarray(
+            [np.nan if x is None else x for x in d["allocOverride"]],
+            dtype=np.float32)
+            if d.get("allocOverride") is not None else None),
         labels=dict(d.get("labels", {})),
     )
 

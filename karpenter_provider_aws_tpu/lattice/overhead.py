@@ -61,6 +61,19 @@ def eni_limited_pods(enis: int, ipv4_per_eni: int, reserved_enis: int = 0) -> in
     return usable * (ipv4_per_eni - 1) + 2
 
 
+def ebs_attach_limit(hypervisor: str, enis: int) -> int:
+    """Schedulable EBS volume attachments per node — the lattice's
+    prediction of what the EBS CSI driver will report via CSINode once the
+    node registers (the reference discovers it only at runtime and can
+    over-schedule before CSINode exists, troubleshooting.md:277-299).
+    Nitro — including bare metal ('' in the catalog), which runs the same
+    nitro card — shares 28 attachment slots between ENIs, the root
+    volume, and data volumes; only Xen allows 40 minus the root."""
+    if hypervisor == "xen":
+        return 39
+    return max(28 - enis - 1, 1)
+
+
 def max_pods(enis: int, ipv4_per_eni: int, vcpus: int, kc: Optional[KubeletConfiguration] = None,
              eni_limited_density: bool = True, reserved_enis: int = 0) -> int:
     """Pod density (types.go:416-431)."""

@@ -26,7 +26,8 @@ import numpy as np
 from ..apis import wellknown as wk
 from ..apis.resources import RESOURCE_AXES, R, axis
 from . import catalog as cat
-from .overhead import KubeletConfiguration, allocatable, max_pods, vm_usable_memory_mib
+from .overhead import (KubeletConfiguration, allocatable, ebs_attach_limit,
+                       max_pods, vm_usable_memory_mib)
 
 
 def type_labels(spec: cat.InstanceTypeSpec) -> Dict[str, str]:
@@ -78,6 +79,7 @@ def capacity_vec(spec: cat.InstanceTypeSpec, kc: Optional[KubeletConfiguration] 
     vec[axis("aws.amazon.com/neuron")] = spec.accelerator_count if spec.accelerator_name in ("inferentia", "inferentia2", "trainium") else 0
     vec[axis("vpc.amazonaws.com/efa")] = spec.efa_count
     vec[axis("vpc.amazonaws.com/pod-eni")] = spec.pod_eni_count
+    vec[axis("attachable-volumes")] = ebs_attach_limit(spec.hypervisor, spec.enis)
     return vec, pods
 
 

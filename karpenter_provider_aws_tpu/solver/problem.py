@@ -34,7 +34,7 @@ from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 import numpy as np
 
 from ..apis import wellknown as wk
-from ..apis.objects import NodePool, Pod, tolerates_all
+from ..apis.objects import IN_TREE_PROVISIONERS, NodePool, Pod, tolerates_all
 from ..apis.requirements import Requirements
 from ..apis.resources import R, axis as res_axis, resources_to_vec_checked
 from ..lattice.tensors import Lattice
@@ -198,6 +198,44 @@ def _custom_keys_ok(reqs: Requirements, pool_labels: Mapping[str, str]) -> bool:
         elif not c.allows_absent:
             return False
     return True
+
+
+def csi_volume_count(pod: Pod, pvcs: Mapping, storage_classes: Mapping,
+                     warnings: Optional[List[str]] = None) -> int:
+    """CSI volume attach slots the pod consumes on its node. The core
+    scheduler counts a node's CSI volumes against the CSINode attach limit
+    (reference troubleshooting.md:277-288 'Pods using PVCs can hit volume
+    limits'); deprecated in-tree plugins publish no limits, so the
+    reference logs an error and cannot enforce them
+    (troubleshooting.md:290-294) — mirrored here as a warning + exclusion.
+    Unknown PVCs/StorageClasses count one slot each (almost certainly CSI;
+    over-counting is the safe direction for attach limits). Counting is
+    per pod-claim reference, not per unique volume per node — pods sharing
+    one RWO claim on a node are charged a slot each, a conservative
+    approximation (the resource-axis encoding cannot dedup across groups
+    inside the kernel; resident-pod accounting in cluster state DOES dedup,
+    state/cluster.py existing_bins)."""
+    return csi_claims_count(pod.volume_claims, pvcs, storage_classes, warnings)
+
+
+def csi_claims_count(claims, pvcs: Mapping, storage_classes: Mapping,
+                     warnings: Optional[List[str]] = None) -> int:
+    """Count the claims in ``claims`` that consume a CSI attach slot
+    (see csi_volume_count; pass a set for per-unique-volume accounting)."""
+    n = 0
+    for cname in claims:
+        pvc = pvcs.get(cname)
+        sc = (storage_classes.get(pvc.storage_class)
+              if pvc is not None and pvc.storage_class else None)
+        if sc is not None and sc.provisioner in IN_TREE_PROVISIONERS:
+            if warnings is not None:
+                warnings.append(
+                    f"PVC {cname!r} uses deprecated in-tree plugin "
+                    f"{sc.provisioner!r}: attach limits unknown and not "
+                    "enforced; use the CSI driver")
+            continue
+        n += 1
+    return n
 
 
 def _volume_zone_mask(pod: Pod, pvcs: Mapping, storage_classes: Mapping,
@@ -680,6 +718,9 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
         rep, names = raw_groups[sid]
         sig = _SIG_TUPLES[sid]
         vec, _ = resources_to_vec_checked(rep.requests, implicit_pod=True)
+        if rep.volume_claims:
+            vec[res_axis("attachable-volumes")] = csi_volume_count(
+                rep, pvcs or {}, storage_classes or {}, warnings)
         reqs = rep.scheduling_requirements()
         # custom-key constraints resolve exactly per-pool in np_ok below
         masks = compile_masks(reqs, lattice, skip_unresolved_custom=True)
@@ -834,7 +875,14 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
     for ei, b in enumerate(existing):
         ti = lattice.name_to_idx[b.instance_type]
         e_used[ei] = b.used
-        e_alloc[ei] = b.alloc_override if b.alloc_override is not None else lattice.alloc[ti]
+        if b.alloc_override is not None:
+            # NaN marks axes the node did not report (canonical_to_vec
+            # missing=nan): fall back to the lattice's prediction — e.g.
+            # attachable-volumes before the CSINode registers
+            ov = b.alloc_override
+            e_alloc[ei] = np.where(np.isnan(ov), lattice.alloc[ti], ov)
+        else:
+            e_alloc[ei] = lattice.alloc[ti]
         e_type[ei] = ti
         e_zone[ei] = zone_index[b.zone]
         e_cap[ei] = cap_index[b.capacity_type]

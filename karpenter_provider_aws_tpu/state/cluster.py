@@ -24,13 +24,15 @@ import numpy as np
 
 from ..apis import wellknown as wk
 from ..apis.objects import Node, NodeClaim, NodeClaimPhase, Pod
-from ..apis.resources import R, canonical_to_vec, resources_to_vec
+from ..apis.resources import R, axis, canonical_to_vec, resources_to_vec
 from ..lattice.tensors import Lattice
-from ..solver.problem import ExistingBin
+from ..solver.problem import ExistingBin, csi_claims_count
 from ..solver.topology import BoundPod
 from ..utils.clock import Clock
 
 NOMINATION_TTL = 20.0  # core nominates pods to in-flight capacity ~20s
+
+_VOL_AXIS = axis("attachable-volumes")
 
 
 @dataclass
@@ -379,12 +381,25 @@ class ClusterState:
                 if claim is not None and claim.deletion_timestamp:
                     continue
                 used = np.zeros((R,), np.float32)
+                vol_claims: set = set()
                 for pod in by_node.get(node.name, ()):
                     used += resources_to_vec(pod.requests, implicit_pod=True)
+                    vol_claims.update(pod.volume_claims)
+                if vol_claims:
+                    # resident CSI volumes hold attach slots against the
+                    # node's limit (reference troubleshooting.md:277-288);
+                    # the set dedups pods sharing one claim — a volume
+                    # attaches to the node once
+                    used[_VOL_AXIS] += csi_claims_count(
+                        vol_claims, self.pvcs, self.storage_classes)
                 alloc_override = None
                 if node.allocatable:
-                    # node status resources are canonical-unit floats
-                    alloc_override = canonical_to_vec(node.allocatable)
+                    # node status resources are canonical-unit floats; NaN
+                    # marks unreported axes so the solver falls back to the
+                    # lattice prediction there (e.g. attachable-volumes
+                    # before the CSINode registers)
+                    alloc_override = canonical_to_vec(node.allocatable,
+                                                      missing=np.nan)
                 bins.append(ExistingBin(
                     name=node.name, node_pool=node.node_pool or "",
                     instance_type=itype, zone=zone, capacity_type=cap,
@@ -399,8 +414,16 @@ class ClusterState:
                 if claim.instance_type not in lattice.name_to_idx:
                     continue
                 used = np.zeros((R,), np.float32)
+                vol_claims = set()
                 for pod in self.nominated_pods(claim.name):
                     used += resources_to_vec(pod.requests, implicit_pod=True)
+                    vol_claims.update(pod.volume_claims)
+                if vol_claims:
+                    # nominated volume pods hold attach slots on the
+                    # in-flight claim too, or a second pass before the
+                    # CSINode registers over-packs it
+                    used[_VOL_AXIS] += csi_claims_count(
+                        vol_claims, self.pvcs, self.storage_classes)
                 bins.append(ExistingBin(
                     name=claim.name, node_pool=claim.node_pool,
                     instance_type=claim.instance_type,

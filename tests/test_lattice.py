@@ -5,7 +5,7 @@ import pytest
 
 from karpenter_provider_aws_tpu.apis import Operator, Requirement, Requirements
 from karpenter_provider_aws_tpu.apis import wellknown as wk
-from karpenter_provider_aws_tpu.apis.resources import axis
+from karpenter_provider_aws_tpu.apis.resources import R, axis
 from karpenter_provider_aws_tpu.lattice import (
     build_catalog,
     build_lattice,
@@ -99,7 +99,7 @@ class TestLatticeTensors:
     def test_shapes(self, lattice):
         T, Z, C = lattice.T, lattice.Z, lattice.C
         assert T >= 700 and Z == 4 and C == 2
-        assert lattice.alloc.shape == (T, 8)
+        assert lattice.alloc.shape == (T, R)
         assert lattice.price.shape == (T, Z, C)
         assert lattice.available.shape == (T, Z, C)
 

@@ -5,6 +5,7 @@ import numpy as np
 import pytest
 
 from karpenter_provider_aws_tpu.apis import NodePool, Operator as ReqOp, Pod, Requirement
+from karpenter_provider_aws_tpu.apis.resources import R
 from karpenter_provider_aws_tpu.apis import serde
 from karpenter_provider_aws_tpu.apis import wellknown as wk
 from karpenter_provider_aws_tpu.apis.objects import (
@@ -112,7 +113,7 @@ class TestSidecarTransport:
             existing = [ExistingBin(
                 name="n0", node_pool="default", instance_type="m5.4xlarge",
                 zone="us-west-2a", capacity_type="on-demand",
-                used=np.zeros(8, np.float32))]
+                used=np.zeros(R, np.float32))]
             pods = [rich_pod()]
             plan = client.solve(pods, [NodePool(name="default")],
                                 existing=existing)

@@ -13,6 +13,7 @@ from karpenter_provider_aws_tpu.apis import (
 )
 from karpenter_provider_aws_tpu.apis.objects import PodAffinityTerm
 from karpenter_provider_aws_tpu.apis import wellknown as wk
+from karpenter_provider_aws_tpu.apis.resources import R
 from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
 from karpenter_provider_aws_tpu.solver import (
     ExistingBin, Solver, build_problem, ffd_oracle,
@@ -49,7 +50,7 @@ def assert_plan_valid(plan, problem):
             pod_req[name] = g.req
     for node in plan.new_nodes:
         ti = lat.name_to_idx[node.instance_type]
-        total = np.zeros(8, np.float32)
+        total = np.zeros(R, np.float32)
         for p in node.pods:
             total += pod_req[p]
         assert (total <= lat.alloc[ti] + 1e-2).all(), (
@@ -212,7 +213,7 @@ class TestExistingCapacity:
         existing = [ExistingBin(
             name="node-a", node_pool="default", instance_type="m5.4xlarge",
             zone="us-west-2a", capacity_type="on-demand",
-            used=np.zeros(8, np.float32))]
+            used=np.zeros(R, np.float32))]
         problem = build_problem(generic_pods(4), [default_pool()], lat, existing=existing)
         plan = solver.solve(problem)
         assert plan.new_nodes == []
@@ -222,7 +223,7 @@ class TestExistingCapacity:
         existing = [ExistingBin(
             name="node-a", node_pool="default", instance_type="m5.large",
             zone="us-west-2a", capacity_type="on-demand",
-            used=np.zeros(8, np.float32))]
+            used=np.zeros(R, np.float32))]
         # m5.large alloc ~1930m cpu -> 3 pods of 500m fit (with memory to spare)
         problem = build_problem(generic_pods(10), [default_pool()], lattice, existing=existing)
         plan = solver.solve(problem)
@@ -314,7 +315,7 @@ class TestReviewRegressions:
         existing = [ExistingBin(
             name="node-a", node_pool="default", instance_type="m5.4xlarge",
             zone="us-west-2a", capacity_type="on-demand",
-            used=np.zeros(8, np.float32), alloc_override=small)]
+            used=np.zeros(R, np.float32), alloc_override=small)]
         problem = build_problem(generic_pods(30, cpu="1"), [default_pool()], lattice,
                                 existing=existing)
         plan = solver.solve(problem)
@@ -335,7 +336,7 @@ class TestReviewRegressions:
         existing = [ExistingBin(
             name="node-a", node_pool="default", instance_type="m5.4xlarge",
             zone="us-west-2a", capacity_type="on-demand",
-            used=np.zeros(8, np.float32))]
+            used=np.zeros(R, np.float32))]
         problem = build_problem(generic_pods(3), [default_pool()], lat, existing=existing)
         plan = s.solve(problem)
         assert sum(len(v) for v in plan.existing_assignments.values()) == 3
@@ -434,7 +435,7 @@ class TestNativeReferee:
         existing = [ExistingBin(name="n", node_pool="default",
                                 instance_type="m5.large", zone="us-west-2a",
                                 capacity_type="on-demand",
-                                used=np.zeros(8, np.float32))]
+                                used=np.zeros(R, np.float32))]
         problem = build_problem(generic_pods(2), [default_pool()], lattice,
                                 existing=existing)
         assert native_ffd_pack(problem) is None
@@ -490,7 +491,7 @@ class TestProbeBatch:
         existing = [ExistingBin(name="n0", node_pool="default",
                                 instance_type="m5.4xlarge", zone="us-west-2a",
                                 capacity_type="on-demand",
-                                used=np.zeros(8, np.float32))]
+                                used=np.zeros(R, np.float32))]
         problem = build_problem(generic_pods(4), [default_pool()], lattice,
                                 existing=existing)
         (pr,) = solver.probe_batch([problem])

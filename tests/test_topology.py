@@ -13,6 +13,7 @@ import numpy as np
 import pytest
 
 from karpenter_provider_aws_tpu.apis import NodePool, Pod
+from karpenter_provider_aws_tpu.apis.resources import R
 from karpenter_provider_aws_tpu.apis.objects import PodAffinityTerm, TopologySpreadConstraint
 from karpenter_provider_aws_tpu.apis import wellknown as wk
 from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
@@ -237,7 +238,7 @@ class TestPodAffinity:
         existing = [ExistingBin(
             name="node-a", node_pool="default", instance_type="m5.2xlarge",
             zone=lattice.zones[0], capacity_type="on-demand",
-            used=np.zeros(8, np.float32))]
+            used=np.zeros(R, np.float32))]
         bound = [BoundPod(pod=cache_pod, node_name="node-a", zone=lattice.zones[0])]
         follower = [Pod(name="f0", labels={"app": "follower"},
                         requests={"cpu": "500m", "memory": "1Gi"},
@@ -300,7 +301,7 @@ class TestReviewRegressions:
         existing = [ExistingBin(
             name="node-a", node_pool="default", instance_type="m5.4xlarge",
             zone=lattice.zones[0], capacity_type="on-demand",
-            used=np.zeros(8, np.float32))]
+            used=np.zeros(R, np.float32))]
         bound = [BoundPod(pod=guard, node_name="node-a", zone=lattice.zones[0])]
         web = [Pod(name=f"w{i}", labels={"app": "web"},
                    requests={"cpu": "500m", "memory": "1Gi"}) for i in range(3)]
@@ -316,7 +317,7 @@ class TestReviewRegressions:
         existing = [ExistingBin(
             name="node-a", node_pool="default", instance_type="m5.4xlarge",
             zone=lattice.zones[0], capacity_type="on-demand",
-            used=np.zeros(8, np.float32))]
+            used=np.zeros(R, np.float32))]
         bound = [BoundPod(pod=Pod(name=f"b{i}", labels=dict(labels)),
                           node_name="node-a", zone=lattice.zones[0]) for i in range(2)]
         pods = spread_pods(4, key=wk.LABEL_HOSTNAME, max_skew=2, labels=labels)

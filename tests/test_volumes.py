@@ -209,3 +209,157 @@ class TestVolumeBindingLifecycle:
         pod = env.cluster.pods["p0"]
         assert pod.node_name
         assert env.cluster.nodes[pod.node_name].labels[wk.LABEL_ZONE] == "us-west-2c"
+
+
+class TestVolumeAttachLimits:
+    """Per-node CSI volume attach limits (reference
+    troubleshooting.md:277-299: the core scheduler counts CSI volumes
+    against the CSINode attach limit; in-tree plugins publish no limits)."""
+
+    def test_lattice_carries_attach_limits(self, lattice):
+        from karpenter_provider_aws_tpu.apis.resources import axis
+        from karpenter_provider_aws_tpu.lattice.overhead import ebs_attach_limit
+        vol = lattice.alloc[:, axis("attachable-volumes")]
+        assert (vol >= 1).all()
+        for i, s in enumerate(lattice.specs):
+            assert vol[i] == ebs_attach_limit(s.hypervisor, s.enis)
+
+    def _claim_heavy(self, n_pods, claims_each, sc="gp3"):
+        pvcs = {}
+        pods = []
+        for i in range(n_pods):
+            names = [f"c{i}-{j}" for j in range(claims_each)]
+            for c in names:
+                pvcs[c] = PersistentVolumeClaim(name=c, storage_class=sc)
+            pods.append(vol_pod(f"v{i}", names))
+        return pods, pvcs
+
+    def test_attach_limit_spreads_nodes(self, solver, lattice):
+        """8 pods x 5 distinct claims = 40 attachments: more than one
+        m5/c5-size node's slot budget, though cpu/memory alone would
+        happily co-locate them."""
+        from karpenter_provider_aws_tpu.apis.resources import axis
+        pods, pvcs = self._claim_heavy(8, 5)
+        scs = {"gp3": StorageClass(name="gp3")}
+        problem = build_problem(pods, [NodePool(name="default")], lattice,
+                                pvcs=pvcs, storage_classes=scs)
+        plan = solver.solve(problem)
+        assert not plan.unschedulable
+        vol_ax = axis("attachable-volumes")
+        for node in plan.new_nodes:
+            ti = lattice.name_to_idx[node.instance_type]
+            attached = sum(5 for p in node.pods)
+            assert attached <= lattice.alloc[ti, vol_ax]
+
+    def test_in_tree_provisioner_warns_and_skips(self, solver, lattice):
+        pods, pvcs = self._claim_heavy(2, 2, sc="gp2-intree")
+        scs = {"gp2-intree": StorageClass(
+            name="gp2-intree", provisioner="kubernetes.io/aws-ebs")}
+        problem = build_problem(pods, [NodePool(name="default")], lattice,
+                                pvcs=pvcs, storage_classes=scs)
+        from karpenter_provider_aws_tpu.apis.resources import axis
+        assert all(g.req[axis("attachable-volumes")] == 0
+                   for g in problem.groups)
+        assert any("in-tree" in w for w in problem.warnings)
+
+    def test_bound_pods_consume_attach_slots(self, lattice):
+        """Resident volume pods reduce an existing node's remaining slots."""
+        from karpenter_provider_aws_tpu.apis.objects import Node
+        from karpenter_provider_aws_tpu.apis.resources import axis
+        from karpenter_provider_aws_tpu.state.cluster import ClusterState
+        from karpenter_provider_aws_tpu.utils.clock import FakeClock
+        cluster = ClusterState(FakeClock())
+        itype = "m5.4xlarge"
+        node = Node(name="n0", provider_id="aws:///us-west-2a/i-0",
+                    labels={wk.LABEL_INSTANCE_TYPE: itype,
+                            wk.LABEL_ZONE: "us-west-2a",
+                            wk.LABEL_CAPACITY_TYPE: "on-demand"},
+                    ready=True)
+        cluster.add_node(node)
+        cluster.add_pvc(PersistentVolumeClaim(name="c0", storage_class="gp3",
+                                              bound_zone="us-west-2a"))
+        cluster.add_storage_class(StorageClass(name="gp3"))
+        bound = vol_pod("resident", ["c0"])
+        bound.node_name = "n0"
+        cluster.add_pod(bound)
+        bins = cluster.existing_bins(lattice)
+        assert len(bins) == 1
+        assert bins[0].used[axis("attachable-volumes")] == 1
+
+    def test_alloc_override_nan_falls_back_to_lattice(self, solver, lattice):
+        """A node reporting only cpu/memory keeps the lattice's attach
+        limit instead of a zero that would evict every volume pod."""
+        from karpenter_provider_aws_tpu.apis.resources import axis, canonical_to_vec
+        from karpenter_provider_aws_tpu.solver.problem import ExistingBin
+        import numpy as np
+        itype = "m5.4xlarge"
+        ti = lattice.name_to_idx[itype]
+        ov = canonical_to_vec({"cpu": 15000.0, "memory": 60000.0,
+                               "pods": 110.0}, missing=np.nan)
+        existing = [ExistingBin(
+            name="n0", node_pool="default", instance_type=itype,
+            zone="us-west-2a", capacity_type="on-demand",
+            used=np.zeros_like(lattice.alloc[ti]), alloc_override=ov)]
+        pvcs = {"c0": PersistentVolumeClaim(name="c0", storage_class="gp3",
+                                            bound_zone="us-west-2a")}
+        scs = {"gp3": StorageClass(name="gp3")}
+        problem = build_problem([vol_pod("v0", ["c0"])],
+                                [NodePool(name="default")], lattice,
+                                existing=existing, pvcs=pvcs,
+                                storage_classes=scs)
+        vol_ax = axis("attachable-volumes")
+        assert problem.e_alloc[0, vol_ax] == lattice.alloc[ti, vol_ax]
+        assert problem.e_alloc[0, axis("cpu")] == 15000.0
+        plan = solver.solve(problem)
+        assert not plan.unschedulable
+        assert plan.existing_assignments.get("n0") == ["v0"]
+
+    def test_shared_claim_dedups_on_node(self, lattice):
+        """Two resident pods sharing one RWO claim hold ONE attach slot."""
+        from karpenter_provider_aws_tpu.apis.objects import Node
+        from karpenter_provider_aws_tpu.apis.resources import axis
+        from karpenter_provider_aws_tpu.state.cluster import ClusterState
+        cluster = ClusterState(FakeClock())
+        cluster.add_node(Node(
+            name="n0", provider_id="aws:///us-west-2a/i-0",
+            labels={wk.LABEL_INSTANCE_TYPE: "m5.4xlarge",
+                    wk.LABEL_ZONE: "us-west-2a",
+                    wk.LABEL_CAPACITY_TYPE: "on-demand"}, ready=True))
+        cluster.add_pvc(PersistentVolumeClaim(name="shared", storage_class="gp3",
+                                              bound_zone="us-west-2a"))
+        cluster.add_storage_class(StorageClass(name="gp3"))
+        for i in range(2):
+            p = vol_pod(f"r{i}", ["shared"])
+            p.node_name = "n0"
+            cluster.add_pod(p)
+        bins = cluster.existing_bins(lattice)
+        assert bins[0].used[axis("attachable-volumes")] == 1
+
+    def test_serde_roundtrips_nan_override_and_provisioner(self, lattice):
+        """NaN override axes ride the JSON wire as nulls (RFC 8259: no NaN
+        token) and StorageClass.provisioner survives the round trip."""
+        import json
+        import numpy as np
+        from karpenter_provider_aws_tpu.apis import serde
+        from karpenter_provider_aws_tpu.apis.resources import R, canonical_to_vec
+        from karpenter_provider_aws_tpu.solver.problem import ExistingBin
+        ov = canonical_to_vec({"cpu": 1000.0}, missing=np.nan)
+        b = ExistingBin(name="n0", node_pool="p", instance_type="m5.xlarge",
+                        zone="us-west-2a", capacity_type="spot",
+                        used=np.zeros((R,), np.float32), alloc_override=ov)
+        wire = json.dumps(serde.existing_bin_to_dict(b))
+        assert "NaN" not in wire
+        back = serde.existing_bin_from_dict(json.loads(wire))
+        assert np.isnan(back.alloc_override).sum() == R - 1
+        assert back.alloc_override[0] == 1000.0
+
+        sc = StorageClass(name="gp2", provisioner="kubernetes.io/aws-ebs")
+        back_sc = serde.storage_class_from_dict(
+            json.loads(json.dumps(serde.storage_class_to_dict(sc))))
+        assert back_sc.provisioner == "kubernetes.io/aws-ebs"
+
+    def test_metal_counts_as_nitro(self):
+        from karpenter_provider_aws_tpu.lattice.overhead import ebs_attach_limit
+        assert ebs_attach_limit("", 15) == 28 - 15 - 1
+        assert ebs_attach_limit("xen", 8) == 39
+        assert ebs_attach_limit("nitro", 4) == 23

@@ -351,7 +351,12 @@ class NodeClass:
     role: Optional[str] = None
     instance_profile: Optional[str] = None
     tags: Dict[str, str] = field(default_factory=dict)
+    # BDM dicts: {"device_name": str, "root_volume": bool,
+    # "volume_size_mib": float} (reference ec2nodeclass.go BlockDeviceMapping)
     block_device_mappings: List[Dict] = field(default_factory=list)
+    # None (default: instance-store disks unused) | "RAID0" (local NVMe
+    # becomes node ephemeral-storage; reference ec2nodeclass.go:92-94)
+    instance_store_policy: Optional[str] = None
     metadata_options: MetadataOptions = field(default_factory=MetadataOptions)
     detailed_monitoring: bool = False
     associate_public_ip: Optional[bool] = None

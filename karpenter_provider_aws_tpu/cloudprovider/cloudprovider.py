@@ -49,6 +49,13 @@ MAX_INSTANCE_TYPES = 60            # instance.go:50
 FLEXIBILITY_THRESHOLD = 5          # instance.go:52 (OD-fallback warning)
 
 
+# bump when the hash FORMULA changes (fields added/removed), so pre-upgrade
+# claims are re-stamped instead of mass-drifting the fleet (same mechanism as
+# provisioning.NODEPOOL_HASH_VERSION; reference karpenter.k8s.aws/
+# ec2nodeclass-hash-version migration). v2: + instance_store_policy
+NODECLASS_HASH_VERSION = "v2"
+
+
 def nodeclass_hash(nc: NodeClass) -> str:
     """Static spec hash for drift detection (reference
     pkg/apis/v1beta1/ec2nodeclass.go:338-344 Hash + drift.go:137-151)."""
@@ -57,6 +64,7 @@ def nodeclass_hash(nc: NodeClass) -> str:
         "instance_profile": nc.instance_profile, "tags": sorted(nc.tags.items()),
         "metadata_options": vars(nc.metadata_options),
         "block_device_mappings": nc.block_device_mappings,
+        "instance_store_policy": nc.instance_store_policy,
         "detailed_monitoring": nc.detailed_monitoring,
         "associate_public_ip": nc.associate_public_ip,
     }, sort_keys=True, default=str)
@@ -270,6 +278,8 @@ class CloudProvider:
         nc = self.node_classes.get(claim.node_class_ref)
         if nc is not None:
             claim.annotations[wk.ANNOTATION_NODECLASS_HASH] = nodeclass_hash(nc)
+            claim.annotations[wk.ANNOTATION_NODECLASS_HASH_VERSION] = \
+                NODECLASS_HASH_VERSION
         claim.phase = NodeClaimPhase.LAUNCHED
         claim.launched_at = self.clock.now()
         return claim
@@ -343,9 +353,18 @@ class CloudProvider:
         as drift."""
         nc = self.node_classes.get(claim.node_class_ref)
         if nc is not None:
-            want = nodeclass_hash(nc)
             have = claim.annotations.get(wk.ANNOTATION_NODECLASS_HASH)
-            if have is not None and have != want:
+            have_ver = claim.annotations.get(
+                wk.ANNOTATION_NODECLASS_HASH_VERSION)
+            if have is not None and have_ver != NODECLASS_HASH_VERSION:
+                # the hash formula changed between controller versions:
+                # re-stamp under the new formula instead of treating the
+                # formula change as drift (it would roll the whole fleet)
+                claim.annotations[wk.ANNOTATION_NODECLASS_HASH] = \
+                    nodeclass_hash(nc)
+                claim.annotations[wk.ANNOTATION_NODECLASS_HASH_VERSION] = \
+                    NODECLASS_HASH_VERSION
+            elif have is not None and have != nodeclass_hash(nc):
                 return "NodeClassDrift"
         if claim.provider_id is not None:
             try:

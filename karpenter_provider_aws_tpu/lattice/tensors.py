@@ -64,17 +64,59 @@ def type_labels(spec: cat.InstanceTypeSpec) -> Dict[str, str]:
     return labels
 
 
+DEFAULT_EBS_ROOT_MIB = 20 * 1024.0  # amifamily.DefaultEBS.VolumeSize (20Gi)
+
+
+@dataclass(frozen=True)
+class StorageConfig:
+    """NodeClass storage knobs that shape per-type ephemeral capacity
+    (reference types.go:210-240 ephemeralStorage). One lattice carries one
+    storage config — the reference computes instance types per NodeClass;
+    an operator serving NodeClasses with different storage configs builds
+    a lattice per config."""
+
+    instance_store_policy: Optional[str] = None   # None | "RAID0"
+    block_device_mappings: Tuple[Mapping, ...] = ()
+    ephemeral_block_device: Optional[str] = None  # AMI family's root device
+    custom_ami_family: bool = False
+
+
+def ephemeral_storage_mib(spec: cat.InstanceTypeSpec,
+                          storage: Optional[StorageConfig] = None) -> float:
+    """Node ephemeral-storage capacity, the reference's resolution order
+    (types.go:210-240): RAID0 policy takes the combined local NVMe size;
+    else a root-volume BDM's size; else (Custom AMI) the last BDM's size;
+    else the BDM matching the family's ephemeral device; else 20Gi."""
+    s = storage or StorageConfig()
+    if s.instance_store_policy == "RAID0" and spec.local_nvme_gb:
+        return spec.local_nvme_gb * 1000.0 / 1.048576   # GB -> MiB
+    bdms = s.block_device_mappings
+    if bdms:
+        for b in bdms:
+            if b.get("root_volume") and b.get("volume_size_mib"):
+                return float(b["volume_size_mib"])
+        if s.custom_ami_family:
+            last = bdms[-1]
+            if last.get("volume_size_mib"):
+                return float(last["volume_size_mib"])
+        elif s.ephemeral_block_device:
+            for b in bdms:
+                if (b.get("device_name") == s.ephemeral_block_device
+                        and b.get("volume_size_mib")):
+                    return float(b["volume_size_mib"])
+    return DEFAULT_EBS_ROOT_MIB
+
+
 def capacity_vec(spec: cat.InstanceTypeSpec, kc: Optional[KubeletConfiguration] = None,
-                 vm_memory_overhead_percent: float = 0.075, reserved_enis: int = 0) -> Tuple[np.ndarray, int]:
+                 vm_memory_overhead_percent: float = 0.075, reserved_enis: int = 0,
+                 storage: Optional[StorageConfig] = None) -> Tuple[np.ndarray, int]:
     """Capacity vector + pod density (types.go:176-208 computeCapacity)."""
     vec = np.zeros((R,), dtype=np.float32)
     pods = max_pods(spec.enis, spec.ipv4_per_eni, spec.vcpus, kc, reserved_enis=reserved_enis)
     vec[axis("cpu")] = spec.vcpus * 1000.0
     vec[axis("memory")] = vm_usable_memory_mib(spec.memory_mib, spec.arch, vm_memory_overhead_percent)
     vec[axis("pods")] = pods
-    # default EBS root volume 20Gi unless local NVMe raid (simplified
-    # instance-store policy; reference ephemeralStorage())
-    vec[axis("ephemeral-storage")] = spec.local_nvme_gb * 1000.0 / 1.048576 if spec.local_nvme_gb else 20 * 1024.0
+    vec[axis("ephemeral-storage")] = ephemeral_storage_mib(spec, storage)
     vec[axis("nvidia.com/gpu")] = spec.gpu_count
     vec[axis("aws.amazon.com/neuron")] = spec.accelerator_count if spec.accelerator_name in ("inferentia", "inferentia2", "trainium") else 0
     vec[axis("vpc.amazonaws.com/efa")] = spec.efa_count
@@ -142,7 +184,8 @@ def build_lattice(specs: Optional[Sequence[cat.InstanceTypeSpec]] = None,
                   zones: Sequence[str] = cat.ZONES,
                   capacity_types: Sequence[str] = cat.CAPACITY_TYPES,
                   vm_memory_overhead_percent: float = 0.075,
-                  reserved_enis: int = 0) -> Lattice:
+                  reserved_enis: int = 0,
+                  storage: Optional[StorageConfig] = None) -> Lattice:
     specs = list(specs) if specs is not None else cat.build_catalog()
     T, Z, C = len(specs), len(zones), len(capacity_types)
 
@@ -150,7 +193,8 @@ def build_lattice(specs: Optional[Sequence[cat.InstanceTypeSpec]] = None,
     alloc = np.zeros((T, R), dtype=np.float32)
     labels = []
     for i, s in enumerate(specs):
-        vec, pods = capacity_vec(s, kc, vm_memory_overhead_percent, reserved_enis)
+        vec, pods = capacity_vec(s, kc, vm_memory_overhead_percent, reserved_enis,
+                                 storage)
         capacity[i] = vec
         alloc[i] = allocatable(vec, s.vcpus * 1000.0, pods,
                                vec[axis("memory")], vec[axis("ephemeral-storage")], kc)

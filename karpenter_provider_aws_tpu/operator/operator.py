@@ -35,6 +35,7 @@ from ..providers import (
     AMIProvider, InstanceProfileProvider, LaunchTemplateProvider,
     PricingProvider, SecurityGroupProvider, SubnetProvider, VersionProvider,
 )
+from ..providers.amifamily import storage_config
 from ..providers.pricing import PricingController
 from ..solver.solve import Solver
 from ..state.cluster import ClusterState
@@ -53,9 +54,22 @@ class Operator:
         self.options = options or Options()
         self.options.validate()
         self.clock = clock or Clock()
-        self.lattice = lattice if lattice is not None else build_lattice(
-            vm_memory_overhead_percent=self.options.vm_memory_overhead_percent,
-            reserved_enis=self.options.reserved_enis)
+        self.node_classes: Dict[str, NodeClass] = node_classes or {
+            "default": NodeClass(name="default",
+                                 role=f"KarpenterNodeRole-{self.options.cluster_name}")}
+        if lattice is not None:
+            self.lattice = lattice
+        else:
+            # the reference computes instance types per NodeClass
+            # (types.go:210-240 ephemeralStorage reads instanceStorePolicy +
+            # blockDeviceMappings); the lattice carries ONE storage config —
+            # the default NodeClass's
+            self.lattice = build_lattice(
+                vm_memory_overhead_percent=self.options.vm_memory_overhead_percent,
+                reserved_enis=self.options.reserved_enis,
+                storage=storage_config(
+                    self.node_classes.get("default")
+                    or next(iter(self.node_classes.values()))))
         self.cloud = cloud or FakeCloud(self.clock, cluster_name=self.options.cluster_name)
         # connectivity probe before anything else (operator.go:115-117)
         self.cloud.list_instances()
@@ -68,9 +82,6 @@ class Operator:
         self.unavailable = UnavailableOfferings(self.clock)
         self.cluster = ClusterState(self.clock)
         self.node_pools: Dict[str, NodePool] = {p.name: p for p in (node_pools or [NodePool(name="default")])}
-        self.node_classes: Dict[str, NodeClass] = node_classes or {
-            "default": NodeClass(name="default",
-                                 role=f"KarpenterNodeRole-{self.options.cluster_name}")}
         # domain providers (reference operator.go:135-178 builds all 11)
         self.subnet_provider = SubnetProvider(self.cloud, self.clock,
             cluster_name=self.options.cluster_name)

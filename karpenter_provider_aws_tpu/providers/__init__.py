@@ -1,7 +1,7 @@
 from .subnet import SubnetProvider
 from .securitygroup import SecurityGroupProvider
 from .instanceprofile import InstanceProfileProvider
-from .amifamily import AMI_FAMILIES, AMIProvider, resolve_ami_family
+from .amifamily import AMI_FAMILIES, AMIProvider, resolve_ami_family, storage_config
 from .launchtemplate import LaunchTemplateProvider
 from .pricing import PricingProvider
 from .version import VersionProvider

@@ -46,6 +46,9 @@ class LaunchParameters:
 class AMIFamily:
     name = "Custom"
     _arch_alias = {"amd64": "x86_64", "arm64": "arm64"}
+    # root/ephemeral device the family's AMIs mount (reference
+    # amifamily/<family>.go EphemeralBlockDevice); None = unknown (Custom)
+    ephemeral_block_device: Optional[str] = None
 
     def default_ami_ssm_parameters(self, k8s_version: str) -> Dict[str, str]:
         """arch -> SSM parameter path for the family's default AMI."""
@@ -58,6 +61,7 @@ class AMIFamily:
 
 class AL2(AMIFamily):
     name = "AL2"
+    ephemeral_block_device = "/dev/xvda"
 
     def default_ami_ssm_parameters(self, k8s_version):
         base = "/aws/service/eks/optimized-ami/{v}/amazon-linux-2{suffix}/recommended/image_id"
@@ -77,6 +81,7 @@ class AL2(AMIFamily):
 
 class AL2023(AMIFamily):
     name = "AL2023"
+    ephemeral_block_device = "/dev/xvda"
 
     def default_ami_ssm_parameters(self, k8s_version):
         base = "/aws/service/eks/optimized-ami/{v}/amazon-linux-2023/{arch}/standard/recommended/image_id"
@@ -94,6 +99,7 @@ class AL2023(AMIFamily):
 
 class Bottlerocket(AMIFamily):
     name = "Bottlerocket"
+    ephemeral_block_device = "/dev/xvdb"
 
     def default_ami_ssm_parameters(self, k8s_version):
         base = "/aws/service/bottlerocket/aws-k8s-{v}/{arch}/latest/image_id"
@@ -112,6 +118,7 @@ class Bottlerocket(AMIFamily):
 
 class Ubuntu(AMIFamily):
     name = "Ubuntu"
+    ephemeral_block_device = "/dev/sda1"
 
     def default_ami_ssm_parameters(self, k8s_version):
         base = "/aws/service/canonical/ubuntu/eks/22.04/{v}/stable/current/{arch}/hvm/ebs-gp2/ami-id"
@@ -124,6 +131,7 @@ class Ubuntu(AMIFamily):
 
 class Windows(AMIFamily):
     name = "Windows"
+    ephemeral_block_device = "/dev/sda1"
 
     def default_ami_ssm_parameters(self, k8s_version):
         return {"amd64":
@@ -150,6 +158,19 @@ def resolve_ami_family(name: str) -> AMIFamily:
     if fam is None:
         raise ValueError(f"unknown AMI family {name!r}; known: {sorted(AMI_FAMILIES)}")
     return fam
+
+
+def storage_config(node_class: NodeClass) -> "StorageConfig":
+    """NodeClass storage knobs + its AMI family's root device → the
+    lattice's per-type ephemeral-storage resolution inputs (reference
+    types.go:210-240 ephemeralStorage)."""
+    from ..lattice.tensors import StorageConfig
+    fam = resolve_ami_family(node_class.ami_family)
+    return StorageConfig(
+        instance_store_policy=node_class.instance_store_policy,
+        block_device_mappings=tuple(node_class.block_device_mappings),
+        ephemeral_block_device=fam.ephemeral_block_device,
+        custom_ami_family=fam.name == "Custom")
 
 
 class AMIProvider:

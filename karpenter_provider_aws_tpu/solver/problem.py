@@ -200,28 +200,21 @@ def _custom_keys_ok(reqs: Requirements, pool_labels: Mapping[str, str]) -> bool:
     return True
 
 
-def csi_volume_count(pod: Pod, pvcs: Mapping, storage_classes: Mapping,
+def csi_claims_count(claims, pvcs: Mapping, storage_classes: Mapping,
                      warnings: Optional[List[str]] = None) -> int:
-    """CSI volume attach slots the pod consumes on its node. The core
+    """CSI volume attach slots the claims in ``claims`` consume. The core
     scheduler counts a node's CSI volumes against the CSINode attach limit
     (reference troubleshooting.md:277-288 'Pods using PVCs can hit volume
     limits'); deprecated in-tree plugins publish no limits, so the
     reference logs an error and cannot enforce them
     (troubleshooting.md:290-294) — mirrored here as a warning + exclusion.
     Unknown PVCs/StorageClasses count one slot each (almost certainly CSI;
-    over-counting is the safe direction for attach limits). Counting is
-    per pod-claim reference, not per unique volume per node — pods sharing
-    one RWO claim on a node are charged a slot each, a conservative
-    approximation (the resource-axis encoding cannot dedup across groups
-    inside the kernel; resident-pod accounting in cluster state DOES dedup,
-    state/cluster.py existing_bins)."""
-    return csi_claims_count(pod.volume_claims, pvcs, storage_classes, warnings)
-
-
-def csi_claims_count(claims, pvcs: Mapping, storage_classes: Mapping,
-                     warnings: Optional[List[str]] = None) -> int:
-    """Count the claims in ``claims`` that consume a CSI attach slot
-    (see csi_volume_count; pass a set for per-unique-volume accounting)."""
+    over-counting is the safe direction for attach limits). Pass a SET of
+    claim names for per-unique-volume accounting (resident pods sharing a
+    claim attach it once, state/cluster.py existing_bins); pending-group
+    charging is per pod-claim reference — a conservative approximation,
+    since the resource-axis encoding cannot dedup across groups inside
+    the kernel."""
     n = 0
     for cname in claims:
         pvc = pvcs.get(cname)
@@ -719,8 +712,8 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
         sig = _SIG_TUPLES[sid]
         vec, _ = resources_to_vec_checked(rep.requests, implicit_pod=True)
         if rep.volume_claims:
-            vec[res_axis("attachable-volumes")] = csi_volume_count(
-                rep, pvcs or {}, storage_classes or {}, warnings)
+            vec[res_axis("attachable-volumes")] = csi_claims_count(
+                rep.volume_claims, pvcs or {}, storage_classes or {}, warnings)
         reqs = rep.scheduling_requirements()
         # custom-key constraints resolve exactly per-pool in np_ok below
         masks = compile_masks(reqs, lattice, skip_unresolved_custom=True)

@@ -190,3 +190,109 @@ class TestReviewRegressions:
     def test_gt_requires_integer(self):
         with pytest.raises(ValueError):
             Requirement("cpu", Operator.GT, ("4.2",))
+
+
+class TestEphemeralStorage:
+    """ephemeralStorage() resolution order (reference types.go:210-240):
+    RAID0 local store > root-volume BDM > family-device BDM (last BDM for
+    Custom AMIs) > the 20Gi default."""
+
+    def _spec(self, nvme_gb=0):
+        from karpenter_provider_aws_tpu.lattice import build_catalog
+        name = "m5d.4xlarge" if nvme_gb else "m5.4xlarge"
+        spec = next(s for s in build_catalog() if s.name == name)
+        return spec
+
+    def test_default_is_20gi_even_with_nvme(self):
+        from karpenter_provider_aws_tpu.lattice.tensors import (
+            DEFAULT_EBS_ROOT_MIB, ephemeral_storage_mib)
+        spec = self._spec(nvme_gb=1)
+        assert spec.local_nvme_gb > 0
+        # default instanceStorePolicy leaves instance-store disks unused
+        assert ephemeral_storage_mib(spec) == DEFAULT_EBS_ROOT_MIB
+
+    def test_raid0_uses_local_store_total(self):
+        from karpenter_provider_aws_tpu.lattice.tensors import (
+            StorageConfig, ephemeral_storage_mib)
+        spec = self._spec(nvme_gb=1)
+        got = ephemeral_storage_mib(
+            spec, StorageConfig(instance_store_policy="RAID0"))
+        assert got == pytest.approx(spec.local_nvme_gb * 1000.0 / 1.048576)
+
+    def test_raid0_without_local_store_falls_through(self):
+        from karpenter_provider_aws_tpu.lattice.tensors import (
+            DEFAULT_EBS_ROOT_MIB, StorageConfig, ephemeral_storage_mib)
+        got = ephemeral_storage_mib(
+            self._spec(), StorageConfig(instance_store_policy="RAID0"))
+        assert got == DEFAULT_EBS_ROOT_MIB
+
+    def test_root_volume_bdm_wins(self):
+        from karpenter_provider_aws_tpu.lattice.tensors import (
+            StorageConfig, ephemeral_storage_mib)
+        sc = StorageConfig(block_device_mappings=(
+            {"device_name": "/dev/xvdb", "volume_size_mib": 50 * 1024.0},
+            {"device_name": "/dev/xvda", "root_volume": True,
+             "volume_size_mib": 100 * 1024.0},
+        ), ephemeral_block_device="/dev/xvda")
+        assert ephemeral_storage_mib(self._spec(), sc) == 100 * 1024.0
+
+    def test_family_device_bdm(self):
+        from karpenter_provider_aws_tpu.lattice.tensors import (
+            StorageConfig, ephemeral_storage_mib)
+        sc = StorageConfig(block_device_mappings=(
+            {"device_name": "/dev/xvda", "volume_size_mib": 80 * 1024.0},),
+            ephemeral_block_device="/dev/xvda")
+        assert ephemeral_storage_mib(self._spec(), sc) == 80 * 1024.0
+
+    def test_custom_family_uses_last_bdm(self):
+        from karpenter_provider_aws_tpu.lattice.tensors import (
+            StorageConfig, ephemeral_storage_mib)
+        sc = StorageConfig(block_device_mappings=(
+            {"device_name": "/dev/sda1", "volume_size_mib": 30 * 1024.0},
+            {"device_name": "/dev/sdb", "volume_size_mib": 60 * 1024.0},),
+            custom_ami_family=True)
+        assert ephemeral_storage_mib(self._spec(), sc) == 60 * 1024.0
+
+    def test_nodeclass_wiring(self):
+        from karpenter_provider_aws_tpu.apis.objects import NodeClass
+        from karpenter_provider_aws_tpu.providers.amifamily import storage_config
+        nc = NodeClass(name="x", ami_family="Bottlerocket",
+                       instance_store_policy="RAID0")
+        sc = storage_config(nc)
+        assert sc.instance_store_policy == "RAID0"
+        assert sc.ephemeral_block_device == "/dev/xvdb"
+        nc2 = NodeClass(name="y", ami_family="Custom")
+        assert storage_config(nc2).custom_ami_family
+
+    def test_hash_covers_storage_policy(self):
+        from karpenter_provider_aws_tpu.apis.objects import NodeClass
+        from karpenter_provider_aws_tpu.cloudprovider.cloudprovider import nodeclass_hash
+        a = NodeClass(name="x")
+        b = NodeClass(name="x", instance_store_policy="RAID0")
+        assert nodeclass_hash(a) != nodeclass_hash(b)
+
+    def test_hash_version_restamps_instead_of_drifting(self):
+        """A pre-upgrade claim (older hash formula) must be re-stamped, not
+        reported NodeClassDrift fleet-wide (mirror of the NodePool
+        hash-version guard, controllers/disruption.py)."""
+        from karpenter_provider_aws_tpu.apis import wellknown as wk
+        from karpenter_provider_aws_tpu.cloudprovider.cloudprovider import (
+            NODECLASS_HASH_VERSION, nodeclass_hash)
+        from karpenter_provider_aws_tpu.operator import Operator
+        from karpenter_provider_aws_tpu.utils.clock import FakeClock
+        from karpenter_provider_aws_tpu.apis.objects import (
+            NodeClaim, NodeClaimPhase)
+        op = Operator(clock=FakeClock())
+        nc = op.node_classes["default"]
+        claim = NodeClaim(name="c0", node_pool="default")
+        claim.phase = NodeClaimPhase.LAUNCHED
+        claim.annotations[wk.ANNOTATION_NODECLASS_HASH] = "stale-v1-hash"
+        # no hash-version annotation = pre-upgrade claim
+        assert op.cloud_provider.is_drifted(claim) != "NodeClassDrift"
+        assert claim.annotations[wk.ANNOTATION_NODECLASS_HASH] == \
+            nodeclass_hash(nc)
+        assert claim.annotations[wk.ANNOTATION_NODECLASS_HASH_VERSION] == \
+            NODECLASS_HASH_VERSION
+        # same version, different hash = REAL drift
+        claim.annotations[wk.ANNOTATION_NODECLASS_HASH] = "actually-changed"
+        assert op.cloud_provider.is_drifted(claim) == "NodeClassDrift"

@@ -290,7 +290,7 @@ class DisruptionController:
         """All of a pass's what-ifs as ONE batched device call.
 
         Builds one padded problem per candidate set and rides the vmapped
-        probe kernel (solver.probe_batch / ops/binpack.pack_probe). Pods are
+        probe kernel (solver.probe_batch / ops/binpack.pack_probe_fused). Pods are
         probed with their soft constraints fully relaxed — the loosest state
         solve_relaxed can reach — so a probe's infeasible verdict is
         trustworthy while a feasible one is optimistic; the winning probe is

@@ -29,7 +29,7 @@ capacity comparisons.
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -54,7 +54,8 @@ def _clear_pack_caches() -> None:
     pack.clear_cache()
     pack_packed.clear_cache()
     pack_packed_fused.clear_cache()
-    pack_probe.clear_cache()
+    pack_packed_efused.clear_cache()
+    pack_probe_fused.clear_cache()
 
 
 def enable_pallas_argmin(interpret: bool = False) -> bool:
@@ -562,6 +563,116 @@ def pack_packed_fused(alloc: jnp.ndarray, avail: jnp.ndarray,
                               lean=lean)
 
 
+def init_layout(B: int, R: int,
+                A: int) -> Tuple[Tuple[FieldSpec, ...], int]:
+    """Byte layout of the fused EXISTING-BIN upload. An existing bin's
+    type/zone/captype masks are one-hot (the node IS one shape), so the
+    host ships only per-bin indices + resource rows — ~50 KB for 500
+    nodes instead of the ~800 KB of expanded [B,T] bool masks — and the
+    kernel rebuilds the masks on device (solve.py _fused_init /
+    _unpack_init). FieldSpec.src names the Problem attribute."""
+    fields = [
+        ("e_used", np.float32, (B, R), "e_used", 0),
+        ("e_alloc", np.float32, (B, R), "e_alloc", np.inf),
+        ("e_pm", np.int32, (B, A), "e_pm", 0),
+        ("e_type", np.int32, (B,), "e_type", -1),
+        ("e_zone", np.int32, (B,), "e_zone", -1),
+        ("e_cap", np.int32, (B,), "e_cap", -1),
+        ("e_np", np.int32, (B,), "e_np", -1),
+        ("e_po", np.uint8, (B, A), "e_po", 0),
+    ]
+    out, off = [], 0
+    for name, dt, shape, src, fill in fields:
+        out.append(FieldSpec(name, off, dt, shape, src, fill))
+        off += int(np.prod(shape)) * np.dtype(dt).itemsize
+    return tuple(out), off
+
+
+def _unpack_init(buf: Optional[jnp.ndarray], n_existing: jnp.ndarray,
+                 B: int, T: int, Z: int, C: int, A: int, R: int) -> BinState:
+    """Fused existing-bin upload → BinState (one-hot masks built on
+    device). ``buf`` None = no existing capacity (empty table, no host
+    bytes shipped at all)."""
+    if buf is None:
+        return empty_state(B, T, Z, C, R, A)
+    layout, _total = init_layout(B, R, A)
+    vals = {}
+    for f in layout:
+        n = int(np.prod(f.shape))
+        if f.dtype is np.uint8:
+            vals[f.name] = buf[f.offset: f.offset + n].reshape(f.shape)
+        else:
+            tgt = jnp.float32 if f.dtype is np.float32 else jnp.int32
+            vals[f.name] = jax.lax.bitcast_convert_type(
+                buf[f.offset: f.offset + 4 * n].reshape(n, 4), tgt
+            ).reshape(f.shape)
+    live = jnp.arange(B, dtype=jnp.int32) < n_existing
+    onehot = lambda idx, n: idx[:, None] == jnp.arange(n, dtype=jnp.int32)[None, :]
+    return BinState(
+        cum=vals["e_used"],
+        tmask=onehot(vals["e_type"], T),
+        zmask=onehot(vals["e_zone"], Z),
+        cmask=onehot(vals["e_cap"], C),
+        np_id=vals["e_np"],
+        npods=jnp.zeros((B,), jnp.int32),
+        open=live, fixed=live,
+        alloc_cap=vals["e_alloc"],
+        pm=vals["e_pm"],
+        po=vals["e_po"].astype(bool),
+        next_open=n_existing.astype(jnp.int32),
+    )
+
+
+@partial(jax.jit,
+         static_argnames=("B", "G", "T", "Z", "C", "NP", "A", "lean"))
+def pack_packed_efused(alloc: jnp.ndarray, avail: jnp.ndarray,
+                       price: jnp.ndarray, gbuf: jnp.ndarray,
+                       init_buf: Optional[jnp.ndarray],
+                       n_existing: jnp.ndarray,
+                       B: int, G: int, T: int, Z: int, C: int, NP: int,
+                       A: int, lean: bool = False) -> jnp.ndarray:
+    """Fully-fused pack: ONE upload for groups+pools, ONE (optional) for
+    existing bins, ONE fused result transfer back."""
+    assert not lean or NP < 2 ** 15
+    R_ = alloc.shape[1]
+    groups, pools = _unpack_inputs(gbuf, G, T, Z, C, NP, A, R_)
+    init = _unpack_init(init_buf, n_existing, B, T, Z, C, A, R_)
+    return _encode_decode_set(pack(alloc, avail, price, groups, pools, init),
+                              lean=lean)
+
+
+@partial(jax.jit,
+         static_argnames=("B", "G", "T", "Z", "C", "NP", "A"))
+def pack_probe_fused(alloc: jnp.ndarray, avail: jnp.ndarray,
+                     price: jnp.ndarray, gbufs: jnp.ndarray,
+                     init_bufs: Optional[jnp.ndarray],
+                     n_existing: jnp.ndarray,
+                     B: int, G: int, T: int, Z: int, C: int, NP: int,
+                     A: int) -> ProbeSummary:
+    """K consolidation what-ifs in ONE device call over fused uploads.
+
+    Each probe is a fully-built padded problem ("remove candidate set S:
+    do its pods repack onto the remaining capacity + ≤1 cheaper node?",
+    reference designs/consolidation.md:9-21). The disruption controller's
+    prefix ladder and single-node scan become one vmapped kernel launch
+    returning only tiny per-probe aggregates — the full NodePlan is
+    decoded later by a single exact solve of the chosen probe (SURVEY.md
+    §2.2 "embarrassingly batchable on device"). gbufs [K,·] and
+    init_bufs [K,·] replace K×18 separately-staged arrays with two
+    host→device transfers for the whole batch (measured 2.0-2.6 s → 0.65 s
+    for K=16 over 300 existing bins on the tunneled link)."""
+    R_ = alloc.shape[1]
+
+    def one(gbuf, init_buf, n_e) -> ProbeSummary:
+        groups, pools = _unpack_inputs(gbuf, G, T, Z, C, NP, A, R_)
+        init = _unpack_init(init_buf, n_e, B, T, Z, C, A, R_)
+        return _probe_one(alloc, avail, price, groups, pools, init)
+
+    if init_bufs is None:
+        return jax.vmap(lambda g, n: one(g, None, n))(gbufs, n_existing)
+    return jax.vmap(one)(gbufs, init_bufs, n_existing)
+
+
 class ProbeSummary(NamedTuple):
     """Per-probe aggregates of a batched what-if pack (all [K])."""
 
@@ -575,36 +686,21 @@ class ProbeSummary(NamedTuple):
     overflow: jnp.ndarray   # bool bin table exhausted (host retries bigger B)
 
 
-@jax.jit
-def pack_probe(alloc: jnp.ndarray, avail: jnp.ndarray, price: jnp.ndarray,
-               groups: GroupBatch, pools: PoolParams, init: BinState) -> ProbeSummary:
-    """K consolidation what-ifs in ONE device call.
-
-    ``groups``/``pools``/``init`` carry a leading probe axis K — each probe
-    is a fully-built padded problem ("remove candidate set S: do its pods
-    repack onto the remaining capacity + ≤1 cheaper node?", reference
-    designs/consolidation.md:9-21). The disruption controller's prefix
-    ladder and single-node scan become one vmapped kernel launch returning
-    only tiny per-probe aggregates — the full NodePlan is decoded later by
-    a single exact solve of the chosen probe (SURVEY.md §2.2:
-    "embarrassingly batchable on device")."""
-
+def _probe_one(alloc: jnp.ndarray, avail: jnp.ndarray, price: jnp.ndarray,
+               g: GroupBatch, pl: PoolParams, st: BinState) -> ProbeSummary:
+    """One what-if pack reduced to its per-probe aggregates."""
     avail_f = avail.astype(jnp.float32)
-
-    def one(g: GroupBatch, pl: PoolParams, st: BinState) -> ProbeSummary:
-        res = pack(alloc, avail, price, g, pl, st)
-        B = res.state.open.shape[0]
-        live = res.state.open & ~res.state.fixed & (res.state.npods > 0)
-        n_new = live.sum().astype(jnp.int32)
-        cost = jnp.where(live, res.chosen_price, 0.0).sum()
-        leftover = res.leftover.sum()
-        b = jnp.argmax(live)
-        reach = _offer_reachable(avail_f, res.state.zmask[b], res.state.cmask[b])
-        flex = (res.state.tmask[b] & reach).sum().astype(jnp.int32)
-        cap_c = jnp.where(n_new > 0, res.chosen_c[b], -1)
-        overflow = (leftover > 0) & (res.state.next_open >= B)
-        return ProbeSummary(leftover=leftover, n_new=n_new, new_cost=cost,
-                            cap_c=cap_c, flex=jnp.where(n_new > 0, flex, 0),
-                            overflow=overflow)
-
-    return jax.vmap(one)(groups, pools, init)
+    res = pack(alloc, avail, price, g, pl, st)
+    B = res.state.open.shape[0]
+    live = res.state.open & ~res.state.fixed & (res.state.npods > 0)
+    n_new = live.sum().astype(jnp.int32)
+    cost = jnp.where(live, res.chosen_price, 0.0).sum()
+    leftover = res.leftover.sum()
+    b = jnp.argmax(live)
+    reach = _offer_reachable(avail_f, res.state.zmask[b], res.state.cmask[b])
+    flex = (res.state.tmask[b] & reach).sum().astype(jnp.int32)
+    cap_c = jnp.where(n_new > 0, res.chosen_c[b], -1)
+    overflow = (leftover > 0) & (res.state.next_open >= B)
+    return ProbeSummary(leftover=leftover, n_new=n_new, new_cost=cost,
+                        cap_c=cap_c, flex=jnp.where(n_new > 0, flex, 0),
+                        overflow=overflow)

@@ -85,7 +85,7 @@ class NodePlan:
 @dataclass
 class ProbeResult:
     """Host-side aggregates of one batched what-if probe (ops/binpack.py
-    pack_probe). Enough to answer the consolidation criterion — "do the
+    pack_probe_fused). Enough to answer the consolidation criterion — "do the
     pods fit on the remaining capacity + ≤1 cheaper node?" (reference
     designs/consolidation.md) — without decoding a full NodePlan."""
 
@@ -375,21 +375,43 @@ class Solver:
             f.name: jnp.asarray(self._pad_field(problem, f))
             for f in layout if f.name in binpack.PoolParams._fields})
 
-    def _fused_inputs(self, problem: Problem, G: int,
-                      count_override: Optional[np.ndarray] = None) -> jnp.ndarray:
+    def _fused_inputs_np(self, problem: Problem, G: int,
+                         A: Optional[int] = None, NP: Optional[int] = None,
+                         count_override: Optional[np.ndarray] = None) -> np.ndarray:
         """All group + pool tensors padded into ONE uint8 host buffer →
         one host→device transfer. Staging 18 arrays separately pays the
         tunneled link's per-transfer cost 18×; field order/fill semantics
         are the shared spec in ops/binpack.group_layout, so this path and
-        _padded_groups/_pool_params (probe + sharded) cannot diverge."""
-        layout, total = self._layout(problem, G)
+        _padded_groups/_pool_params (sharded) cannot diverge."""
+        layout, total = self._layout(problem, G, A, NP)
         buf = np.zeros((total,), np.uint8)
         for f in layout:
             n = int(np.prod(f.shape)) * np.dtype(f.dtype).itemsize
             view = buf[f.offset: f.offset + n].view(f.dtype).reshape(f.shape)
             self._pad_field(problem, f, out=view,
                             override=count_override if f.name == "count" else None)
-        return jnp.asarray(buf)
+        return buf
+
+    def _fused_inputs(self, problem: Problem, G: int,
+                      count_override: Optional[np.ndarray] = None) -> jnp.ndarray:
+        return jnp.asarray(self._fused_inputs_np(
+            problem, G, count_override=count_override))
+
+    def _fused_init_np(self, problem: Problem, B: int,
+                       A: Optional[int] = None) -> np.ndarray:
+        """Existing bins as ONE small uint8 buffer (per-bin indices +
+        resource rows; ops/binpack.init_layout) — the kernel rebuilds the
+        one-hot masks on device. E == 0 yields the all-fill buffer
+        (equivalent to an empty table; callers skip the upload entirely
+        when no problem in the batch has existing capacity)."""
+        A = max(problem.A, 1) if A is None else A
+        layout, total = binpack.init_layout(B, R, A)
+        buf = np.zeros((total,), np.uint8)
+        for f in layout:
+            n = int(np.prod(f.shape)) * np.dtype(f.dtype).itemsize
+            view = buf[f.offset: f.offset + n].view(f.dtype).reshape(f.shape)
+            self._pad_field(problem, f, out=view)
+        return buf
 
     def _init_state(self, problem: Problem, B: int,
                     A: Optional[int] = None) -> binpack.BinState:
@@ -464,7 +486,7 @@ class Solver:
 
         Every problem is padded to a shared (K, G, B) bucket, stacked along
         a leading probe axis, and handed to the vmapped kernel
-        (ops/binpack.pack_probe); only tiny per-probe aggregates come back.
+        (ops/binpack.pack_probe_fused); only tiny per-probe aggregates return.
         The disruption controller's prefix ladder + single-node scan ride
         this instead of O(log n + budget) serial Solve() round trips
         (SURVEY.md §2.2 "embarrassingly batchable"); the chosen probe is
@@ -484,21 +506,29 @@ class Solver:
         B = _bucket(max(b_needed, max(p.E for p in problems) + 1),
                     _B_BUCKETS, clamp=True)
         avail, price = self._device_avail_price(problems[0])
+        lat = self.lattice
         # pad K with repeats of problem 0 so jit shapes stay bucketed
         Kp = _bucket(K, self._K_BUCKETS, clamp=True)
         idx = list(range(K)) + [0] * (Kp - K)
-        gs = [self._padded_groups(problems[i], G, A, NP) for i in idx]
-        ps = [self._pool_params(problems[i], NP) for i in idx]
-        stack = lambda *xs: jnp.stack(xs)
-        groups = jax.tree.map(stack, *gs)
-        pools = jax.tree.map(stack, *ps)
+        # ONE [K,·] upload for all probes' groups+pools (vs K×18 staged
+        # arrays), one more for their existing bins — the tunneled link
+        # charges per transfer, and a consolidation batch is ~dozens of
+        # what-ifs over hundreds of existing bins
+        gbufs = jnp.asarray(np.stack(
+            [self._fused_inputs_np(problems[i], G, A, NP) for i in idx]))
+        n_existing = jnp.asarray(np.array([problems[i].E for i in idx],
+                                          np.int32))
         while True:
-            init = jax.tree.map(
-                stack, *[self._init_state(problems[i], B, A) for i in idx])
+            if any(p.E for p in problems):
+                ibufs = jnp.asarray(np.stack(
+                    [self._fused_init_np(problems[i], B, A) for i in idx]))
+            else:
+                ibufs = None
             td = time.perf_counter()
             with self._trace_span("solver.pack_probe"):
-                summ = jax.tree.map(np.asarray, binpack.pack_probe(
-                    self._alloc, avail, price, groups, pools, init))
+                summ = jax.tree.map(np.asarray, binpack.pack_probe_fused(
+                    self._alloc, avail, price, gbufs, ibufs, n_existing,
+                    B, G, lat.T, lat.Z, lat.C, NP, A))
             device_s = time.perf_counter() - td
             if bool(summ.overflow[:K].any()):
                 B, grew = _grow_bucket(B)
@@ -614,14 +644,16 @@ class Solver:
 
         lat = self.lattice
         while True:
-            init = self._init_state(problem, B)
+            init_buf = self._fused_init_np(problem, B) if problem.E else None
             td = time.perf_counter()
-            # one fused input upload + one fused result transfer (sync
-            # included); lean layout: the plan decode never reads
-            # cum/alloc_cap/pm/po
+            # one fused input upload (+ one for existing bins) + one fused
+            # result transfer (sync included); lean layout: the plan decode
+            # never reads cum/alloc_cap/pm/po
             with self._trace_span("solver.pack"):
-                buf = np.asarray(binpack.pack_packed_fused(
-                    self._alloc, avail, price, fused, init,
+                buf = np.asarray(binpack.pack_packed_efused(
+                    self._alloc, avail, price, fused,
+                    None if init_buf is None else jnp.asarray(init_buf),
+                    problem.E, B,
                     G, lat.T, lat.Z, lat.C, max(problem.NP, 1),
                     max(problem.A, 1), lean=True))
             device_s = time.perf_counter() - td

@@ -461,7 +461,7 @@ class TestNativeReferee:
 
 
 class TestProbeBatch:
-    """Batched what-if probes (ops/binpack.pack_probe via Solver.probe_batch):
+    """Batched what-if probes (ops/binpack.pack_probe_fused via Solver.probe_batch):
     one device call must agree with the exact per-problem solves on
     feasibility, new-node count, and cost (SURVEY §2.2 consolidation
     what-ifs; reference designs/consolidation.md criterion)."""

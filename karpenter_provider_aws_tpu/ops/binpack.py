@@ -648,7 +648,7 @@ def pack_probe_fused(alloc: jnp.ndarray, avail: jnp.ndarray,
                      init_bufs: Optional[jnp.ndarray],
                      n_existing: jnp.ndarray,
                      B: int, G: int, T: int, Z: int, C: int, NP: int,
-                     A: int) -> ProbeSummary:
+                     A: int) -> jnp.ndarray:
     """K consolidation what-ifs in ONE device call over fused uploads.
 
     Each probe is a fully-built padded problem ("remove candidate set S:
@@ -659,14 +659,23 @@ def pack_probe_fused(alloc: jnp.ndarray, avail: jnp.ndarray,
     decoded later by a single exact solve of the chosen probe (SURVEY.md
     §2.2 "embarrassingly batchable on device"). gbufs [K,·] and
     init_bufs [K,·] replace K×18 separately-staged arrays with two
-    host→device transfers for the whole batch (measured 2.0-2.6 s → 0.65 s
-    for K=16 over 300 existing bins on the tunneled link)."""
+    host→device transfers for the whole batch, and the result returns as
+    ONE [K,6] f32 buffer — fetching the six ProbeSummary leaves
+    separately cost six sequential round trips (~90 ms each on the
+    tunneled link; measured 2.0-2.6 s → ~0.2 s for K=16 over 300
+    existing bins end to end). Columns: leftover, n_new, new_cost,
+    cap_c, flex, overflow (decoded by solve.py probe_batch; every count
+    is far below f32's 2^24 exact-integer range)."""
     R_ = alloc.shape[1]
 
-    def one(gbuf, init_buf, n_e) -> ProbeSummary:
+    def one(gbuf, init_buf, n_e) -> jnp.ndarray:
         groups, pools = _unpack_inputs(gbuf, G, T, Z, C, NP, A, R_)
         init = _unpack_init(init_buf, n_e, B, T, Z, C, A, R_)
-        return _probe_one(alloc, avail, price, groups, pools, init)
+        s = _probe_one(alloc, avail, price, groups, pools, init)
+        # ProbeSummary._fields IS the column order; the host decodes with
+        # ProbeSummary(*buf.T) so the contract lives in one place
+        return jnp.stack([getattr(s, f).astype(jnp.float32)
+                          for f in ProbeSummary._fields])
 
     if init_bufs is None:
         return jax.vmap(lambda g, n: one(g, None, n))(gbufs, n_existing)

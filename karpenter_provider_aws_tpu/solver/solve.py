@@ -525,28 +525,32 @@ class Solver:
             else:
                 ibufs = None
             td = time.perf_counter()
+            # ONE [K, len(ProbeSummary._fields)] f32 result buffer = one
+            # device→host transfer for the whole batch; ProbeSummary's
+            # field order IS the column contract on both sides
             with self._trace_span("solver.pack_probe"):
-                summ = jax.tree.map(np.asarray, binpack.pack_probe_fused(
-                    self._alloc, avail, price, gbufs, ibufs, n_existing,
-                    B, G, lat.T, lat.Z, lat.C, NP, A))
+                summ = binpack.ProbeSummary(*np.asarray(
+                    binpack.pack_probe_fused(
+                        self._alloc, avail, price, gbufs, ibufs, n_existing,
+                        B, G, lat.T, lat.Z, lat.C, NP, A)).T)
             device_s = time.perf_counter() - td
-            if bool(summ.overflow[:K].any()):
+            if bool((summ.overflow[:K] > 0).any()):
                 B, grew = _grow_bucket(B)
                 if grew:
                     continue
             break
         out: List[ProbeResult] = []
         for k in range(K):
-            n_new = int(summ.n_new[k])
+            nn = int(summ.n_new[k])
             cc = int(summ.cap_c[k])
             out.append(ProbeResult(
                 feasible=(int(summ.leftover[k]) == 0
                           and not bool(summ.overflow[k])
                           and not problems[k].unschedulable),
-                n_new=n_new,
+                n_new=nn,
                 new_cost=float(summ.new_cost[k]),
                 new_cap_type=(lat.capacity_types[cc]
-                              if n_new > 0 and 0 <= cc < lat.C else None),
+                              if nn > 0 and 0 <= cc < lat.C else None),
                 flex=int(summ.flex[k]),
                 device_seconds=device_s))
         return out

@@ -8,6 +8,7 @@ plane; defaulting fills the canonical optional fields.
 
 from __future__ import annotations
 
+import math
 from typing import List
 
 from .apis import wellknown as wk
@@ -109,6 +110,25 @@ def validate_node_class(nc: NodeClass) -> List[str]:
         errs.append(f"httpTokens must be required|optional, got {mo.http_tokens!r}")
     if mo.http_endpoint not in ("enabled", "disabled"):
         errs.append(f"httpEndpoint must be enabled|disabled, got {mo.http_endpoint!r}")
+    if nc.instance_store_policy not in (None, "RAID0"):
+        errs.append("instanceStorePolicy must be RAID0 when set, got "
+                    f"{nc.instance_store_policy!r}")
+    roots = 0
+    for b in nc.block_device_mappings:
+        if not isinstance(b, dict) or not b.get("device_name"):
+            errs.append("blockDeviceMapping needs a device_name")
+            continue
+        if b.get("root_volume"):
+            roots += 1
+        size = b.get("volume_size_mib")
+        if size is not None and (
+                isinstance(size, bool)          # bool is an int subclass
+                or not isinstance(size, (int, float))
+                or not math.isfinite(size) or size <= 0):
+            errs.append(f"blockDeviceMapping {b['device_name']!r} "
+                        "volume_size_mib must be a positive finite number")
+    if roots > 1:
+        errs.append("at most one blockDeviceMapping may set root_volume")
     return errs
 
 

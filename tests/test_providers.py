@@ -287,6 +287,30 @@ class TestWebhooks:
         with pytest.raises(AdmissionError):
             admit_node_class(NodeClass(name="x", role="r", ami_family="Custom"))
 
+    def test_nodeclass_storage_validation(self):
+        with pytest.raises(AdmissionError):
+            admit_node_class(NodeClass(name="x", role="r",
+                                       instance_store_policy="RAID5"))
+        admit_node_class(NodeClass(name="x", role="r",
+                                   instance_store_policy="RAID0"))
+        with pytest.raises(AdmissionError):
+            admit_node_class(NodeClass(name="x", role="r",
+                                       block_device_mappings=[{"volume_size_mib": 100}]))
+        with pytest.raises(AdmissionError):
+            admit_node_class(NodeClass(name="x", role="r", block_device_mappings=[
+                {"device_name": "/dev/xvda", "root_volume": True},
+                {"device_name": "/dev/xvdb", "root_volume": True}]))
+        with pytest.raises(AdmissionError):
+            admit_node_class(NodeClass(name="x", role="r", block_device_mappings=[
+                {"device_name": "/dev/xvda", "volume_size_mib": -5}]))
+        for bad in (True, float("nan")):
+            with pytest.raises(AdmissionError):
+                admit_node_class(NodeClass(name="x", role="r", block_device_mappings=[
+                    {"device_name": "/dev/xvda", "volume_size_mib": bad}]))
+        admit_node_class(NodeClass(name="x", role="r", block_device_mappings=[
+            {"device_name": "/dev/xvda", "root_volume": True,
+             "volume_size_mib": 100 * 1024.0}]))
+
     def test_nodeclass_metadata_options(self):
         nc = NodeClass(name="x", role="r",
                        metadata_options=MetadataOptions(http_tokens="sometimes"))

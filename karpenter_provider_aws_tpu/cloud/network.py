@@ -24,6 +24,9 @@ class Subnet:
     cidr: str
     available_ips: int
     tags: Dict[str, str] = field(default_factory=dict)
+    # "availability-zone" | "local-zone" (DescribeAvailabilityZones
+    # ZoneType; the reference's localzone suite selects zones by it)
+    zone_type: str = "availability-zone"
 
 
 @dataclass
@@ -77,8 +80,7 @@ def _match_tags(obj_tags: Dict[str, str], want: Dict[str, str]) -> bool:
 class FakeNetwork:
     """Attached to FakeCloud as `.network`."""
 
-    def __init__(self, zones: Sequence[str] = ("us-west-2a", "us-west-2b",
-                                               "us-west-2c", "us-west-2d"),
+    def __init__(self, zones: Optional[Sequence[str]] = None,
                  cluster_name: str = "sim", k8s_version: str = "1.29"):
         self._lock = threading.RLock()
         self._ids = itertools.count(1)
@@ -91,10 +93,15 @@ class FakeNetwork:
         self.launch_templates: Dict[str, LaunchTemplate] = {}
         self.ssm_parameters: Dict[str, str] = {}
         discovery = {f"kubernetes.io/cluster/{cluster_name}": "owned"}
+        from ..lattice import catalog as cat
+        if zones is None:
+            zones = cat.ZONES  # incl. the local zone (its subnet is tagged)
         for i, z in enumerate(zones):
             sid = f"subnet-{i+1:04d}"
-            self.subnets[sid] = Subnet(id=sid, zone=z, cidr=f"10.0.{i}.0/24",
-                                       available_ips=250, tags=dict(discovery))
+            self.subnets[sid] = Subnet(
+                id=sid, zone=z, cidr=f"10.0.{i}.0/24", available_ips=250,
+                tags=dict(discovery),
+                zone_type=cat.ZONE_TYPES.get(z, "availability-zone"))
         for i, name in enumerate(("default", "nodes")):
             gid = f"sg-{i+1:04d}"
             self.security_groups[gid] = SecurityGroup(id=gid, name=name,

@@ -66,7 +66,8 @@ class NodeClassController:
     def _hydrate(self, nc: NodeClass) -> None:
         """Resolve spec → status (controller.go:150-233)."""
         ready = True
-        nc.status_subnets = [{"id": s.id, "zone": s.zone}
+        nc.status_subnets = [{"id": s.id, "zone": s.zone,
+                              "zoneType": s.zone_type}
                              for s in self.subnets.list(nc)]
         nc.status_security_groups = [{"id": g.id, "name": g.name}
                                      for g in self.security_groups.list(nc)]

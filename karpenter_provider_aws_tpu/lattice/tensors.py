@@ -208,7 +208,8 @@ def build_lattice(specs: Optional[Sequence[cat.InstanceTypeSpec]] = None,
                 if not cat.offering_available(s, zone, ct):
                     continue
                 available[i, zi, ci] = True
-                price[i, zi, ci] = s.od_price if ct == "on-demand" else cat.spot_price(s, zone)
+                price[i, zi, ci] = (cat.od_price(s, zone) if ct == "on-demand"
+                                    else cat.spot_price(s, zone))
 
     # categorical vocab: id 0 reserved for "undefined on this type"
     cat_keys = wk.DEVICE_CATEGORICAL_KEYS

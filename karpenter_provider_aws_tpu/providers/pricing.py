@@ -15,6 +15,7 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from ..lattice import catalog as cat
 from ..lattice.tensors import Lattice
 from ..utils.clock import Clock
 
@@ -80,10 +81,17 @@ class PricingProvider:
             price = self._static.copy()
             if "on-demand" in lat.capacity_types:
                 ci = lat.capacity_types.index("on-demand")
+                # the Pricing API reports ONE regional OD price; zonal
+                # premiums (local zones) scale it per zone, same as the
+                # static lattice build (catalog.od_price)
+                zone_scale = np.array(
+                    [cat.od_zone_multiplier(z) for z in lat.zones],
+                    np.float32)
                 for t, p in self._od_overrides.items():
                     ti = lat.name_to_idx.get(t)
                     if ti is not None:
-                        price[ti, :, ci] = np.where(lat.available[ti, :, ci], p, np.inf)
+                        price[ti, :, ci] = np.where(
+                            lat.available[ti, :, ci], p * zone_scale, np.inf)
             if "spot" in lat.capacity_types:
                 ci = lat.capacity_types.index("spot")
                 for (t, z), p in self._spot_overrides.items():

@@ -674,3 +674,60 @@ class TestKubeletMaxPods:
         p2 = NodePool(name="x", kubelet=KubeletSpec(max_pods=50))
         p3 = NodePool(name="x")
         assert len({nodepool_hash(p1), nodepool_hash(p2), nodepool_hash(p3)}) == 3
+
+
+class TestLocalZone:
+    """Local-zone provisioning (reference test/suites/localzone/
+    suite_test.go:50-104): a NodePool restricted to the local zone scales
+    hostname-spread pods onto local-zone nodes, drawing from the zone's
+    restricted on-demand-only palette at its price premium."""
+
+    def test_scale_up_in_local_zone(self):
+        from karpenter_provider_aws_tpu.apis import (
+            NodePool, Operator as ReqOp, Pod, Requirement)
+        from karpenter_provider_aws_tpu.apis.objects import PodAffinityTerm
+        from karpenter_provider_aws_tpu.apis import wellknown as wk
+        from karpenter_provider_aws_tpu.lattice import build_lattice
+        from karpenter_provider_aws_tpu.lattice.catalog import (
+            LOCAL_ZONES, ZONE_TYPES, offering_available)
+        from karpenter_provider_aws_tpu.solver import Solver, build_problem
+
+        lz = next(iter(LOCAL_ZONES))
+        assert ZONE_TYPES[lz] == "local-zone"
+        lattice = build_lattice()
+        pool = NodePool(name="edge", requirements=[
+            Requirement(wk.LABEL_ZONE, ReqOp.IN, (lz,))])
+        pods = [Pod(name=f"edge-{i}", requests={"cpu": "1", "memory": "2Gi"},
+                    labels={"foo": "bar"},
+                    pod_affinity=[PodAffinityTerm(
+                        topology_key=wk.LABEL_HOSTNAME, anti=True,
+                        label_selector=(("foo", "bar"),))])
+                for i in range(3)]
+        problem = build_problem(pods, [pool], lattice)
+        plan = Solver(lattice).solve(problem)
+        assert not plan.unschedulable
+        assert len(plan.new_nodes) == 3  # hostname anti-affinity: 1 per node
+        for n in plan.new_nodes:
+            assert n.zone == lz
+            assert n.capacity_type == "on-demand"  # no spot market in a LZ
+            spec = lattice.specs[lattice.name_to_idx[n.instance_type]]
+            assert offering_available(spec, lz, "on-demand")
+            # local-zone premium over the regional on-demand price
+            assert n.price_per_hour > spec.od_price
+
+    def test_spot_constrained_pool_cannot_use_local_zone(self):
+        from karpenter_provider_aws_tpu.apis import (
+            NodePool, Operator as ReqOp, Pod, Requirement)
+        from karpenter_provider_aws_tpu.apis import wellknown as wk
+        from karpenter_provider_aws_tpu.lattice import build_lattice
+        from karpenter_provider_aws_tpu.lattice.catalog import LOCAL_ZONES
+        from karpenter_provider_aws_tpu.solver import Solver, build_problem
+
+        lz = next(iter(LOCAL_ZONES))
+        lattice = build_lattice()
+        pool = NodePool(name="edge-spot", requirements=[
+            Requirement(wk.LABEL_ZONE, ReqOp.IN, (lz,)),
+            Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN, ("spot",))])
+        pods = [Pod(name="p0", requests={"cpu": "1", "memory": "2Gi"})]
+        plan = Solver(lattice).solve(build_problem(pods, [pool], lattice))
+        assert "p0" in plan.unschedulable

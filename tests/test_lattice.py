@@ -98,7 +98,7 @@ class TestOverheadMath:
 class TestLatticeTensors:
     def test_shapes(self, lattice):
         T, Z, C = lattice.T, lattice.Z, lattice.C
-        assert T >= 700 and Z == 4 and C == 2
+        assert T >= 700 and Z == 5 and C == 2
         assert lattice.alloc.shape == (T, R)
         assert lattice.price.shape == (T, Z, C)
         assert lattice.available.shape == (T, Z, C)
@@ -153,7 +153,7 @@ class TestMaskCompiler:
             Requirement(wk.LABEL_CAPACITY_TYPE, Operator.IN, ("spot",)),
         ])
         m = compile_masks(reqs, lattice)
-        assert list(m.zone_mask) == [True, False, False, False]
+        assert list(m.zone_mask) == [True, False, False, False, False]
         assert list(m.cap_mask) == [False, True]
 
     def test_extra_labels(self, lattice):

@@ -47,10 +47,10 @@ def nodeclass(**kw):
 class TestSubnetProvider:
     def test_discovery_by_cluster_tag(self, cloud):
         p = SubnetProvider(cloud, cloud.clock)
+        from karpenter_provider_aws_tpu.lattice.catalog import ZONES
         subs = p.list(nodeclass())
-        assert len(subs) == 4
-        assert {s.zone for s in subs} == set(z for z in
-                                             ("us-west-2a", "us-west-2b", "us-west-2c", "us-west-2d"))
+        assert len(subs) == len(ZONES)
+        assert {s.zone for s in subs} == set(ZONES)
 
     def test_discovery_by_id(self, cloud):
         p = SubnetProvider(cloud, cloud.clock)
@@ -193,6 +193,28 @@ class TestLaunchTemplateProvider:
 
 
 class TestPricing:
+    def test_od_overlay_keeps_local_zone_premium(self, lattice):
+        """A 12h Pricing-API overlay reports ONE regional OD price; the
+        rebuild must re-apply the local-zone premium, not broadcast the
+        regional price into every zone."""
+        import numpy as np
+        from karpenter_provider_aws_tpu.lattice.catalog import (
+            LOCAL_ZONES, od_zone_multiplier)
+        p = PricingProvider(lattice)
+        ti = lattice.name_to_idx["m5.large"]
+        ci = lattice.capacity_types.index("on-demand")
+        p.update_on_demand_pricing({"m5.large": 0.1})
+        for zi, z in enumerate(lattice.zones):
+            if not lattice.available[ti, zi, ci]:
+                continue
+            assert lattice.price[ti, zi, ci] == pytest.approx(
+                0.1 * od_zone_multiplier(z), rel=1e-6)
+        lz = next(iter(LOCAL_ZONES))
+        zi = lattice.zones.index(lz)
+        if lattice.available[ti, zi, ci]:
+            assert lattice.price[ti, zi, ci] > np.float32(0.1)
+        p.reset()
+
     def test_static_fallback_prices(self, lattice):
         p = PricingProvider(lattice)
         od = p.on_demand_price("m5.large")
@@ -230,7 +252,7 @@ class TestNodeClassController:
                       cloud=FakeCloud(clock), clock=clock)
         op.run_once()
         nc = op.node_classes["default"]
-        assert len(nc.status_subnets) == 4
+        assert len(nc.status_subnets) == 5
         assert len(nc.status_security_groups) == 2
         assert len(nc.status_amis) == 2
         assert nc.status_instance_profile

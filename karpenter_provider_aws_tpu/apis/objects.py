@@ -277,6 +277,10 @@ class KubeletSpec:
     the claim fill (cloudprovider.create via NodeClaim.max_pods)."""
 
     max_pods: Optional[int] = None
+    # kubelet --cluster-dns override (the reference ipv6 suite sets an
+    # IPv6 kube-dns here; discovery is the operator-side default,
+    # reference operator.go:125-132)
+    cluster_dns: Optional[str] = None
 
     def clamp_pods(self, pods_value: float) -> float:
         if self.max_pods is None:
@@ -398,7 +402,9 @@ class NodeClaim:
     # clamps the pods axis of capacity/allocatable at fill time, so no
     # concurrent solve ever observes the unclamped ENI-derived density
     max_pods: Optional[int] = None
+    cluster_dns: Optional[str] = None  # kubelet ClusterDNS from the pool
     provider_id: Optional[str] = None
+    internal_ip: Optional[str] = None  # instance private IP (v4 or v6)
     instance_type: Optional[str] = None
     zone: Optional[str] = None
     capacity_type: Optional[str] = None
@@ -419,6 +425,7 @@ class NodeClaim:
 class Node:
     name: str
     provider_id: str
+    internal_ip: Optional[str] = None  # InternalIP address (v4 or v6)
     labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     taints: List[Taint] = field(default_factory=list)

@@ -144,7 +144,8 @@ def nodepool_to_dict(p: NodePool) -> Dict:
                 for b in p.disruption.budgets],
         },
         "nodeClassRef": p.node_class_ref,
-        "kubelet": ({"maxPods": p.kubelet.max_pods}
+        "kubelet": ({"maxPods": p.kubelet.max_pods,
+                     "clusterDNS": p.kubelet.cluster_dns}
                     if p.kubelet is not None else None),
     }
 
@@ -176,7 +177,8 @@ def nodepool_from_dict(d: Mapping) -> NodePool:
                 reasons=tuple(b.get("reasons", ())))
                 for b in dis.get("budgets", [{}])]),
         node_class_ref=d.get("nodeClassRef", "default"),
-        kubelet=(KubeletSpec(max_pods=d["kubelet"].get("maxPods"))
+        kubelet=(KubeletSpec(max_pods=d["kubelet"].get("maxPods"),
+                             cluster_dns=d["kubelet"].get("clusterDNS"))
                  if d.get("kubelet") else None),
     )
 

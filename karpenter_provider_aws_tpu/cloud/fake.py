@@ -51,6 +51,7 @@ class CloudInstance:
     image_id: Optional[str] = None
     subnet_id: Optional[str] = None
     security_group_ids: Tuple[str, ...] = ()
+    private_ip: Optional[str] = None  # InternalIP; v6 on ipv6 clusters
 
     @property
     def provider_id(self) -> str:
@@ -82,7 +83,8 @@ class FakeCloud:
     InsufficientCapacityPools (ec2api.go:40-44, 112-190)."""
 
     def __init__(self, clock: Optional[Clock] = None,
-                 cluster_name: str = "sim", k8s_version: str = "1.29"):
+                 cluster_name: str = "sim", k8s_version: str = "1.29",
+                 ip_family: str = "ipv4"):
         from .network import FakeNetwork
         self.clock = clock or Clock()
         self._lock = threading.RLock()
@@ -94,7 +96,8 @@ class FakeCloud:
         self.calls: "collections.deque[Tuple[str, object]]" = \
             collections.deque(maxlen=10000)
         # the VPC/IAM/image surface (subnets, SGs, AMIs+SSM, profiles, LTs)
-        self.network = FakeNetwork(cluster_name=cluster_name, k8s_version=k8s_version)
+        self.network = FakeNetwork(cluster_name=cluster_name,
+                                   k8s_version=k8s_version, ip_family=ip_family)
 
     # ---- fault injection -------------------------------------------------
 
@@ -137,10 +140,15 @@ class FakeCloud:
                     continue
                 if remaining is not None:
                     self.capacity_pools[o.offering] = remaining - 1
+                n = next(self._ids)
+                ip = (f"2600:1f14:73::{n:x}"
+                      if self.network.ip_family == "ipv6"
+                      else f"10.0.{(n >> 8) & 0xff}.{n & 0xff}")
                 inst = CloudInstance(
-                    id=f"i-{next(self._ids):08x}", instance_type=o.instance_type,
+                    id=f"i-{n:08x}", instance_type=o.instance_type,
                     zone=o.zone, capacity_type=o.capacity_type,
-                    launch_time=self.clock.now(), price=o.price, tags=dict(tags or {}))
+                    launch_time=self.clock.now(), price=o.price,
+                    tags=dict(tags or {}), private_ip=ip)
                 self.instances[inst.id] = inst
                 return FleetResult(instance=inst, ice=ice)
             raise UnfulfillableCapacityError(offerings=ice or [o.offering for o in overrides])

@@ -81,11 +81,19 @@ class FakeNetwork:
     """Attached to FakeCloud as `.network`."""
 
     def __init__(self, zones: Optional[Sequence[str]] = None,
-                 cluster_name: str = "sim", k8s_version: str = "1.29"):
+                 cluster_name: str = "sim", k8s_version: str = "1.29",
+                 ip_family: str = "ipv4"):
         self._lock = threading.RLock()
         self._ids = itertools.count(1)
         self.k8s_version = k8s_version
         self.cluster_endpoint = f"https://{cluster_name}.sim.local"
+        # single-stack IP family (reference test/suites/ipv6): the kube-dns
+        # service IP the operator discovers best-effort
+        # (operator.go:125-132) and the address family of launched nodes
+        assert ip_family in ("ipv4", "ipv6"), ip_family
+        self.ip_family = ip_family
+        self.kube_dns_ip = ("fd30:7061:6b65:74::a" if ip_family == "ipv6"
+                           else "10.100.0.10")
         self.subnets: Dict[str, Subnet] = {}
         self.security_groups: Dict[str, SecurityGroup] = {}
         self.images: Dict[str, Image] = {}

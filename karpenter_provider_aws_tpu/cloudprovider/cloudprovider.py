@@ -133,7 +133,13 @@ class CloudProvider:
         lts_by_arch = {}
         if self.launch_templates is not None and nc is not None:
             k8s_version = self.version.get() if self.version is not None else "1.29"
-            for lt in self.launch_templates.ensure_all(nc, k8s_version):
+            # kubelet cluster-DNS: the pool's kubelet block wins; else the
+            # kube-dns service IP discovered best-effort at startup
+            # (reference operator.go:125-132; ipv6 suite exercises both)
+            dns = claim.cluster_dns or getattr(
+                self.cloud.network, "kube_dns_ip", None)
+            for lt in self.launch_templates.ensure_all(nc, k8s_version,
+                                                       cluster_dns=dns):
                 img = self.cloud.network.images.get(lt.image_id)
                 if img is not None:
                     lts_by_arch[img.arch] = lt
@@ -255,6 +261,7 @@ class CloudProvider:
         lat = self.lattice
         ti = lat.name_to_idx[instance.instance_type]
         claim.provider_id = instance.provider_id
+        claim.internal_ip = instance.private_ip
         claim.instance_type = instance.instance_type
         claim.zone = instance.zone
         claim.capacity_type = instance.capacity_type

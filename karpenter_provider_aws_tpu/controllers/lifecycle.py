@@ -60,6 +60,7 @@ class LifecycleController:
         """Simulated kubelet joins the node and binds nominated pods."""
         node = Node(
             name=claim.name, provider_id=claim.provider_id or "",
+            internal_ip=claim.internal_ip,
             labels=dict(claim.labels), taints=list(claim.taints),
             capacity=dict(claim.capacity), allocatable=dict(claim.allocatable),
             ready=True, created_at=self.clock.now(),

@@ -42,7 +42,7 @@ _PODS_AXIS = res_axis("pods")
 # RE-STAMPED instead of drift-compared, so a controller upgrade never
 # rolls the whole fleet (the reference migrates its hash the same way —
 # wellknown ANNOTATION_NODEPOOL_HASH_VERSION).
-NODEPOOL_HASH_VERSION = "v2"
+NODEPOOL_HASH_VERSION = "v3"  # v3: + kubelet clusterDNS
 
 
 def nodepool_hash(pool: NodePool) -> str:
@@ -53,9 +53,9 @@ def nodepool_hash(pool: NodePool) -> str:
     payload = json.dumps({
         "labels": sorted(pool.labels.items()),
         "annotations": sorted(pool.annotations.items()),
-        # kubelet knobs are template spec: changing maxPods must drift
-        # (and roll) nodes launched with the old density
-        "kubelet": (pool.kubelet.max_pods
+        # kubelet knobs are template spec: changing maxPods or clusterDNS
+        # must drift (and roll) nodes launched with the old values
+        "kubelet": ((pool.kubelet.max_pods, pool.kubelet.cluster_dns)
                     if pool.kubelet is not None else None),
         "taints": [(t.key, t.value, t.effect) for t in pool.taints],
         "requirements": [(r.key, r.operator.value, r.values) for r in pool.requirements],
@@ -424,5 +424,7 @@ class Provisioner:
             taints=list(pool.taints), node_class_ref=pool.node_class_ref,
             max_pods=(pool.kubelet.max_pods if pool.kubelet is not None
                       else None),
+            cluster_dns=(pool.kubelet.cluster_dns if pool.kubelet is not None
+                         else None),
             created_at=self.clock.now())
         return claim

@@ -34,9 +34,11 @@ class ResolvedAMI:
 
 @dataclass
 class LaunchParameters:
-    """Per-(AMI, arch) launch template parameterization (resolver.go:122-165
-    groups by {AMI, maxPods, EFA}; the sim's kubelet knobs are uniform so
-    AMI x arch is the grouping key)."""
+    """Per-(AMI, arch, userdata) launch template parameterization
+    (resolver.go:122-165 groups by {AMI, maxPods, EFA}); userdata varies
+    with the kubelet cluster-DNS, so pools with different kubelet blocks
+    resolve distinct parameter sets (and distinct launch templates via
+    the content hash)."""
 
     ami: ResolvedAMI
     user_data: str
@@ -55,7 +57,9 @@ class AMIFamily:
         return {}
 
     def user_data(self, node_class: NodeClass, cluster_name: str,
-                  cluster_endpoint: str) -> str:
+                  cluster_endpoint: str,
+                  cluster_dns: Optional[str] = None) -> str:
+        # Custom AMIs own their full userdata, incl. DNS wiring
         return node_class.user_data or ""
 
 
@@ -70,12 +74,14 @@ class AL2(AMIFamily):
             "arm64": base.format(v=k8s_version, suffix="-arm64"),
         }
 
-    def user_data(self, node_class, cluster_name, cluster_endpoint):
+    def user_data(self, node_class, cluster_name, cluster_endpoint,
+                  cluster_dns=None):
         custom = node_class.user_data or ""
+        dns = f" --dns-cluster-ip '{cluster_dns}'" if cluster_dns else ""
         return (
             "MIME-Version: 1.0\n"
             f"{custom}\n"
-            f"/etc/eks/bootstrap.sh {cluster_name} --apiserver-endpoint {cluster_endpoint}\n"
+            f"/etc/eks/bootstrap.sh {cluster_name} --apiserver-endpoint {cluster_endpoint}{dns}\n"
         )
 
 
@@ -88,12 +94,14 @@ class AL2023(AMIFamily):
         return {a: base.format(v=k8s_version, arch=self._arch_alias[a])
                 for a in ("amd64", "arm64")}
 
-    def user_data(self, node_class, cluster_name, cluster_endpoint):
+    def user_data(self, node_class, cluster_name, cluster_endpoint,
+                  cluster_dns=None):
         custom = node_class.user_data or ""
+        dns = f"  clusterDNS: {cluster_dns}\n" if cluster_dns else ""
         return (
             "apiVersion: node.eks.aws/v1alpha1\nkind: NodeConfig\n"
             f"cluster:\n  name: {cluster_name}\n  apiServerEndpoint: {cluster_endpoint}\n"
-            f"{custom}\n"
+            f"{dns}{custom}\n"
         )
 
 
@@ -106,13 +114,15 @@ class Bottlerocket(AMIFamily):
         return {a: base.format(v=k8s_version, arch=self._arch_alias[a])
                 for a in ("amd64", "arm64")}
 
-    def user_data(self, node_class, cluster_name, cluster_endpoint):
+    def user_data(self, node_class, cluster_name, cluster_endpoint,
+                  cluster_dns=None):
         custom = node_class.user_data or ""
+        dns = f'cluster-dns-ip = "{cluster_dns}"\n' if cluster_dns else ""
         return (
             "[settings.kubernetes]\n"
             f'cluster-name = "{cluster_name}"\n'
             f'api-server = "{cluster_endpoint}"\n'
-            f"{custom}\n"
+            f"{dns}{custom}\n"
         )
 
 
@@ -125,8 +135,10 @@ class Ubuntu(AMIFamily):
         return {a: base.format(v=k8s_version, arch=self._arch_alias[a])
                 for a in ("amd64", "arm64")}
 
-    def user_data(self, node_class, cluster_name, cluster_endpoint):
-        return AL2().user_data(node_class, cluster_name, cluster_endpoint)
+    def user_data(self, node_class, cluster_name, cluster_endpoint,
+                  cluster_dns=None):
+        return AL2().user_data(node_class, cluster_name, cluster_endpoint,
+                               cluster_dns=cluster_dns)
 
 
 class Windows(AMIFamily):
@@ -137,9 +149,12 @@ class Windows(AMIFamily):
         return {"amd64":
                 f"/aws/service/ami-windows-latest/Windows_Server-2022-English-Core-EKS_Optimized-{k8s_version}/image_id"}
 
-    def user_data(self, node_class, cluster_name, cluster_endpoint):
+    def user_data(self, node_class, cluster_name, cluster_endpoint,
+                  cluster_dns=None):
         custom = node_class.user_data or ""
-        return f"<powershell>\n{custom}\n[EKS bootstrap {cluster_name}]\n</powershell>\n"
+        dns = f" -DNSClusterIP '{cluster_dns}'" if cluster_dns else ""
+        return (f"<powershell>\n{custom}\n"
+                f"[EKS bootstrap {cluster_name}{dns}]\n</powershell>\n")
 
 
 class Custom(AMIFamily):
@@ -221,13 +236,15 @@ class AMIProvider:
         return self._cache.get_or_compute(key, fetch)
 
     def resolve_launch_parameters(self, node_class: NodeClass,
-                                  k8s_version: str) -> List[LaunchParameters]:
+                                  k8s_version: str,
+                                  cluster_dns: Optional[str] = None) -> List[LaunchParameters]:
         """One launch parameter set per resolved AMI (resolver.go:122-165)."""
         fam = resolve_ami_family(node_class.ami_family)
         endpoint = self.cloud.network.cluster_endpoint
         return [LaunchParameters(
                     ami=ami, arch=ami.arch,
-                    user_data=fam.user_data(node_class, self.cluster_name, endpoint))
+                    user_data=fam.user_data(node_class, self.cluster_name,
+                                            endpoint, cluster_dns=cluster_dns))
                 for ami in self.list(node_class, k8s_version)]
 
     def reset(self) -> None:

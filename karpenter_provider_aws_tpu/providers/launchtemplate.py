@@ -66,14 +66,18 @@ class LaunchTemplateProvider:
         self._hydrated = True
         return n
 
-    def ensure_all(self, node_class: NodeClass, k8s_version: str) -> List[LaunchTemplate]:
+    def ensure_all(self, node_class: NodeClass, k8s_version: str,
+                   cluster_dns: Optional[str] = None) -> List[LaunchTemplate]:
         """One launch template per resolved (AMI, arch) launch parameter set
-        (EnsureAll, :112-136)."""
+        (EnsureAll, :112-136). ``cluster_dns`` parameterizes the userdata
+        (it feeds the content hash, so a pool-level kubelet ClusterDNS
+        override gets its own template)."""
         self.hydrate()
         sgs = tuple(g.id for g in self.security_groups.list(node_class))
         profile = self.instance_profiles.create(node_class)
         out: List[LaunchTemplate] = []
-        for params in self.amis.resolve_launch_parameters(node_class, k8s_version):
+        for params in self.amis.resolve_launch_parameters(
+                node_class, k8s_version, cluster_dns=cluster_dns):
             out.append(self._ensure_one(node_class, params, sgs, profile))
         return out
 

@@ -731,3 +731,42 @@ class TestLocalZone:
         pods = [Pod(name="p0", requests={"cpu": "1", "memory": "2Gi"})]
         plan = Solver(lattice).solve(build_problem(pods, [pool], lattice))
         assert "p0" in plan.unschedulable
+
+
+class TestIPv6:
+    """Single-stack IPv6 provisioning (reference test/suites/ipv6/
+    suite_test.go:72-97): nodes come up with an IPv6 internal address; the
+    kubelet cluster-DNS comes from operator kube-dns discovery by default
+    and from the NodePool kubelet block when set."""
+
+    def _settled_env(self, lattice, pool=None):
+        clock = FakeClock()
+        op = Operator(options=Options(registration_delay=2.0),
+                      lattice=lattice,
+                      cloud=FakeCloud(clock, ip_family="ipv6"), clock=clock,
+                      node_pools=[pool] if pool else None)
+        for p in pods(3, prefix="v6"):
+            op.cluster.add_pod(p)
+        assert op.settle() < 50
+        return op
+
+    def test_nodes_register_with_ipv6_internal_address(self, lattice):
+        op = self._settled_env(lattice)
+        assert op.cluster.nodes
+        for node in op.cluster.nodes.values():
+            assert node.internal_ip and ":" in node.internal_ip  # v6, not v4
+
+    def test_cluster_dns_discovered_into_userdata(self, lattice):
+        op = self._settled_env(lattice)
+        dns = op.cloud.network.kube_dns_ip
+        assert ":" in dns
+        lts = list(op.cloud.network.launch_templates.values())
+        assert lts and all(dns in lt.user_data for lt in lts)
+
+    def test_pool_kubelet_cluster_dns_overrides(self, lattice):
+        from karpenter_provider_aws_tpu.apis.objects import KubeletSpec
+        pool = NodePool(name="default",
+                        kubelet=KubeletSpec(cluster_dns="fd00:1234::53"))
+        op = self._settled_env(lattice, pool=pool)
+        lts = list(op.cloud.network.launch_templates.values())
+        assert lts and all("fd00:1234::53" in lt.user_data for lt in lts)

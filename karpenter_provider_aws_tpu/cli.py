@@ -67,6 +67,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-port", type=int, default=8000,
                    help="Port serving /metrics, /healthz, /readyz "
                         "(0 disables).")
+    p.add_argument("--warm-start", action="store_true",
+                   help="Precompile the warm (G,B) solver bucket set on a "
+                        "background thread at startup (XLA charges 20-40s "
+                        "per shape on first trace; without this the first "
+                        "pending-pod batch pays it)")
     p.add_argument("--profile-dir", default=None,
                    help="Write a JAX profiler (xprof) trace of every device "
                         "solve under this directory.")
@@ -186,6 +191,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.sidecar_address:
         from .parallel.sidecar import serve as serve_sidecar
         sidecar = serve_sidecar(op.solver, args.sidecar_address)
+    if args.warm_start:
+        op.solver.warmup(node_pools_count=len(op.node_pools),
+                         probes=True, background=True)
     if args.profile_dir:
         op.solver.start_profiling(args.profile_dir)
     deadline = (time.monotonic() + args.duration) if args.duration > 0 else None

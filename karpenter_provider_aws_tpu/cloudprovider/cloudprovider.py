@@ -136,8 +136,7 @@ class CloudProvider:
             # kubelet cluster-DNS: the pool's kubelet block wins; else the
             # kube-dns service IP discovered best-effort at startup
             # (reference operator.go:125-132; ipv6 suite exercises both)
-            dns = claim.cluster_dns or getattr(
-                self.cloud.network, "kube_dns_ip", None)
+            dns = claim.cluster_dns or self.cloud.network.kube_dns_ip
             for lt in self.launch_templates.ensure_all(nc, k8s_version,
                                                        cluster_dns=dns):
                 img = self.cloud.network.images.get(lt.image_id)

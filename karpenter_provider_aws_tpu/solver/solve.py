@@ -451,6 +451,62 @@ class Solver:
             next_open=jnp.array(E, jnp.int32),
         )
 
+    # ---- warmup (precompile the warm bucket set) ----
+
+    def warmup(self, node_pools_count: int = 1, affinity_classes: int = 1,
+               g_buckets: Sequence[int] = (16, 32, 64),
+               b_buckets: Sequence[int] = (32, 128, 512),
+               probes: bool = False,
+               background: bool = False):
+        """Precompile the solve kernels for the warm (G, B) bucket set.
+
+        The reference's Go scheduler has zero compile latency; XLA charges
+        20-40 s per bucket shape on first trace. A fresh operator would
+        otherwise pay that on its FIRST pending-pod batch — the worst
+        possible moment. Compilation is keyed on the STATIC dims
+        (G/B buckets, NP pool count, A affinity classes, lattice T/Z/C),
+        so warmup must know the pool count; extra affinity classes or pool
+        additions later still compile on demand.
+
+        ``background=True`` runs on a daemon thread and returns it —
+        operator startup proceeds while shapes compile; a real solve
+        arriving mid-warmup just serializes on the solver lock.
+        """
+        if background:
+            t = threading.Thread(
+                target=self.warmup, name="solver-warmup", daemon=True,
+                kwargs=dict(node_pools_count=node_pools_count,
+                            affinity_classes=affinity_classes,
+                            g_buckets=g_buckets, b_buckets=b_buckets,
+                            probes=probes))
+            t.start()
+            return t
+        lat = self.lattice
+        NP = max(node_pools_count, 1)
+        A = max(affinity_classes, 1)
+        for G in g_buckets:
+            _, g_total = binpack.group_layout(G, lat.T, lat.Z, lat.C, NP, A, R)
+            gbuf = jnp.asarray(np.zeros((g_total,), np.uint8))
+            for B in b_buckets:
+                _, i_total = binpack.init_layout(B, R, A)
+                ibuf = jnp.asarray(np.zeros((i_total,), np.uint8))
+                for init in (None, ibuf):
+                    with self._solve_lock:
+                        np.asarray(binpack.pack_packed_efused(
+                            self._alloc, self._avail, self._price, gbuf,
+                            init, 0, B, G, lat.T, lat.Z, lat.C, NP, A,
+                            lean=True))
+                if probes:
+                    for K in self._K_BUCKETS[:2]:
+                        with self._solve_lock:
+                            np.asarray(binpack.pack_probe_fused(
+                                self._alloc, self._avail, self._price,
+                                jnp.tile(gbuf, (K, 1)),
+                                jnp.tile(ibuf, (K, 1)),
+                                jnp.zeros((K,), jnp.int32),
+                                B, G, lat.T, lat.Z, lat.C, NP, A))
+        return None
+
     # ---- profiling (xprof hook) ----
 
     def start_profiling(self, log_dir: str) -> None:

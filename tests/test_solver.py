@@ -561,3 +561,27 @@ class TestStartupTaints:
                                 daemonset_pods=[ds])
         (pi,) = range(problem.NP)
         assert problem.ds_overhead[pi][0] >= 1000.0  # the agent's 1 cpu
+
+
+class TestWarmup:
+    def test_warmup_compiles_and_solve_reuses(self, lattice):
+        """warmup() precompiles the warm bucket set; a subsequent real solve
+        of a matching shape must hit the jit cache (no new trace)."""
+        from karpenter_provider_aws_tpu.ops import binpack
+        solver = Solver(lattice)
+        solver.warmup(node_pools_count=1, g_buckets=(16,), b_buckets=(32,))
+        sizes_after_warm = binpack.pack_packed_efused._cache_size()
+        assert sizes_after_warm >= 2  # with + without existing-bin buffer
+        pods = [Pod(name=f"w{i}", requests={"cpu": "1", "memory": "2Gi"})
+                for i in range(10)]
+        plan = solver.solve(build_problem(pods, [NodePool(name="default")],
+                                          lattice))
+        assert not plan.unschedulable
+        assert binpack.pack_packed_efused._cache_size() == sizes_after_warm
+
+    def test_background_warmup_joins(self, lattice):
+        solver = Solver(lattice)
+        t = solver.warmup(node_pools_count=1, g_buckets=(16,),
+                          b_buckets=(32,), background=True)
+        t.join(timeout=120)
+        assert not t.is_alive()

@@ -194,17 +194,34 @@ def _retained_cost(problem, used_names):
 
 def _repack_parity(problem, plan):
     """Non-vacuous cfg4 referee: total cost of the repacked cluster
-    (retained existing nodes + any new nodes), plan vs the Python FFD
-    oracle run on the SAME repack problem."""
-    from karpenter_provider_aws_tpu.solver.oracle import ffd_oracle
-    oracle = ffd_oracle(problem)
-    oracle_used = {problem.existing[b.existing_idx].name
-                   for b in oracle.bins if b.is_existing and b.pods}
+    (retained existing nodes + any new nodes), plan vs the FFD referee
+    run on the SAME repack problem — native (existing bins are in the
+    C++ referee's scope) with the Python oracle as fallback."""
+    oracle_used, oracle_new_cost, referee = None, None, "python"
+    try:
+        from karpenter_provider_aws_tpu.native import native_ffd_pack
+        ref = native_ffd_pack(problem)
+        # an incomplete native pack (leftover pods) would understate the
+        # baseline cost and report a false regression — fall back instead
+        if ref is not None and ref.leftover == 0:
+            oracle_used = {problem.existing[i].name
+                           for i in np.nonzero(ref.e_npods)[0]}
+            oracle_new_cost = ref.new_node_cost
+            referee = "native"
+    except Exception:
+        pass
+    if oracle_used is None:
+        from karpenter_provider_aws_tpu.solver.oracle import ffd_oracle
+        oracle = ffd_oracle(problem)
+        oracle_used = {problem.existing[b.existing_idx].name
+                       for b in oracle.bins if b.is_existing and b.pods}
+        oracle_new_cost = oracle.new_node_cost
     plan_cost = plan.new_node_cost + _retained_cost(
         problem, set(plan.existing_assignments))
-    oracle_cost = oracle.new_node_cost + _retained_cost(problem, oracle_used)
+    oracle_cost = oracle_new_cost + _retained_cost(problem, oracle_used)
     ratio = plan_cost / oracle_cost if oracle_cost > 0 else 1.0
-    return round(ratio, 4), len(oracle_used), round(plan_cost, 2), round(oracle_cost, 2)
+    return (round(ratio, 4), len(oracle_used), round(plan_cost, 2),
+            round(oracle_cost, 2), referee)
 
 
 def _referee_cost(problem, plan):
@@ -212,7 +229,9 @@ def _referee_cost(problem, plan):
     try:
         from karpenter_provider_aws_tpu.native import native_ffd_pack
         ref = native_ffd_pack(problem)
-        if ref is not None:
+        # an incomplete native pack (leftover pods) would understate the
+        # baseline cost and report a false regression — fall back instead
+        if ref is not None and ref.leftover == 0:
             return ref.new_node_cost, "native"
     except Exception:
         pass
@@ -282,8 +301,8 @@ def run_config(key, make, lattice, solver):
         detail["nodes_still_used"] = len(plan.existing_assignments)
         detail["nodes_emptied"] = problem.E - len(plan.existing_assignments)
         (detail["repack_cost_vs_oracle"], detail["oracle_nodes_retained"],
-         detail["repack_cost_per_hour"],
-         detail["oracle_repack_cost_per_hour"]) = _repack_parity(problem, plan)
+         detail["repack_cost_per_hour"], detail["oracle_repack_cost_per_hour"],
+         detail["repack_referee"]) = _repack_parity(problem, plan)
     return e2e_p50, detail
 
 

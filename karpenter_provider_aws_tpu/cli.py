@@ -71,7 +71,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Precompile the warm (G,B) solver bucket set on a "
                         "background thread at startup (XLA charges 20-40s "
                         "per shape on first trace; without this the first "
-                        "pending-pod batch pays it)")
+                        "pending-pod batch pays it). Covers the configured "
+                        "pool count with no affinity classes; workloads "
+                        "that add hostname-affinity classes or custom-label "
+                        "virtual pools compile their shapes on first use")
     p.add_argument("--profile-dir", default=None,
                    help="Write a JAX profiler (xprof) trace of every device "
                         "solve under this directory.")

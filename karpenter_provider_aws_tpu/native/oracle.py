@@ -24,7 +24,8 @@ class NativeOraclePlan:
     num_new_nodes: int
     new_node_cost: float
     leftover: int
-    chosen: List[Tuple[int, int, int]]   # (type, zone, captype) per bin
+    chosen: List[Tuple[int, int, int]]   # (type, zone, captype) per NEW bin
+    e_npods: Optional[np.ndarray] = None  # [E] pods ADDED per existing bin
 
 
 def _c(a: np.ndarray, dtype):
@@ -34,16 +35,20 @@ def _c(a: np.ndarray, dtype):
 
 def native_ffd_pack(problem: Problem, max_bins: int = 200_000) -> Optional[NativeOraclePlan]:
     """Run the native referee; None if the toolchain/library is unavailable
-    or the problem uses features outside the native scope (existing bins,
-    hostname affinity classes) — callers fall back to the Python oracle."""
+    or the problem uses features outside the native scope (hostname
+    affinity classes, strict custom keys over unknown-pool nodes) —
+    callers fall back to the Python oracle. Existing (fixed) bins and
+    per-pool allocatable ceilings are in native scope."""
     lib = ensure_built()
     if lib is None:
         return None
-    if problem.E > 0:
+    if problem.E > 0 and problem.strict_custom.any() \
+            and (problem.e_np < 0).any():
+        # unknown-pool nodes cannot be verified against custom-key
+        # selectors; the Python oracle holds that logic
         return None
-    if np.isfinite(problem.np_alloc_cap).any():
-        # per-pool allocatable ceilings (kubelet maxPods) are outside the
-        # native referee's scope — the Python oracle applies them
+    if problem.A and problem.E > 0 and (problem.e_pm.any() or problem.e_po.any()):
+        # bound-pod affinity seeding on existing bins is Python-only scope
         return None
     if problem.A and (problem.g_owner.any() or problem.g_need.any()
                       or problem.single_bin.any()):
@@ -77,9 +82,11 @@ def native_ffd_pack(problem: Problem, max_bins: int = 200_000) -> Optional[Nativ
     chosen_t = np.zeros((max_bins,), np.int32)
     chosen_z = np.zeros((max_bins,), np.int32)
     chosen_c = np.zeros((max_bins,), np.int32)
+    E = problem.E
+    e_npods = np.zeros((max(E, 1),), np.int32)
 
     n = lib.ffd_pack(
-        lat.T, lat.Z, lat.C, R, G, max(problem.NP, 1),
+        lat.T, lat.Z, lat.C, R, G, max(problem.NP, 1), E,
         arr(lat.alloc, np.float32),
         arr(lat.available, np.uint8),
         arr(np.nan_to_num(lat.price, posinf=3.4e38), np.float32),
@@ -94,16 +101,26 @@ def native_ffd_pack(problem: Problem, max_bins: int = 200_000) -> Optional[Nativ
         arr(problem.np_zone, np.uint8),
         arr(problem.np_cap, np.uint8),
         arr(problem.ds_overhead, np.float32),
+        # +inf ceilings pass through as f32 max (no ceiling)
+        arr(np.nan_to_num(problem.np_alloc_cap, posinf=3.4e38), np.float32),
+        arr(problem.e_used, np.float32),
+        arr(np.nan_to_num(problem.e_alloc, posinf=3.4e38), np.float32),
+        arr(problem.e_type, np.int32),
+        arr(problem.e_zone, np.int32),
+        arr(problem.e_cap, np.int32),
+        arr(problem.e_np, np.int32),
         ctypes.c_int(max_bins),
         ctypes.byref(out_cost),
         ctypes.byref(out_leftover),
         arr(chosen_t, np.int32),
         arr(chosen_z, np.int32),
         arr(chosen_c, np.int32),
+        arr(e_npods, np.int32),
     )
     if n < 0:
         return None
     chosen = [(int(chosen_t[i]), int(chosen_z[i]), int(chosen_c[i]))
               for i in range(min(n, max_bins))]
     return NativeOraclePlan(num_new_nodes=n, new_node_cost=float(out_cost.value),
-                            leftover=int(out_leftover.value), chosen=chosen)
+                            leftover=int(out_leftover.value), chosen=chosen,
+                            e_npods=e_npods[:E] if E else None)

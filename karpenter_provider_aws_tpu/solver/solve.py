@@ -465,8 +465,11 @@ class Solver:
         otherwise pay that on its FIRST pending-pod batch — the worst
         possible moment. Compilation is keyed on the STATIC dims
         (G/B buckets, NP pool count, A affinity classes, lattice T/Z/C),
-        so warmup must know the pool count; extra affinity classes or pool
-        additions later still compile on demand.
+        so warmup must know the pool count; extra affinity classes,
+        custom-label VIRTUAL pool variants (problem.NP can exceed the
+        configured pool count), or pool additions later still compile on
+        demand — the warm set covers the affinity-free common case, not
+        every workload shape.
 
         ``background=True`` runs on a daemon thread and returns it —
         operator startup proceeds while shapes compile; a real solve

@@ -10,9 +10,11 @@
 // every bench run.
 //
 // Scope: new-node packing with per-group type/zone/captype masks, pool
-// masks + weight order, daemonset overhead, and per-bin caps — the
-// semantics the large-scale benchmark configs exercise. Hostname affinity
-// classes and pre-existing bins stay in the Python referee (small-problem
+// masks + weight order, daemonset overhead, per-bin caps, per-pool
+// allocatable ceilings (kubelet maxPods), and pre-existing (fixed) bins
+// with their own reported allocatable — the semantics the large-scale
+// benchmark configs exercise, incl. the 500-node consolidation repack.
+// Hostname affinity classes stay in the Python referee (small-problem
 // regression tests).
 //
 // Built on demand by karpenter_provider_aws_tpu/native/build.py:
@@ -29,10 +31,13 @@ struct Bin {
     std::vector<uint64_t> zmask;  // bitset over Z
     std::vector<uint64_t> cmask;  // bitset over C
     std::vector<float> cum;       // [R]
-    int np_idx;
-    int npods;
+    int np_idx;                   // -1 = unknown pool (fixed bins only)
+    int npods;                    // pods ADDED by this pack
     int last_group;               // per-row cap bookkeeping
     int last_group_count;
+    int e_idx;                    // >=0: fixed existing bin (type pinned,
+                                  // capacity = its own reported allocatable,
+                                  // excluded from finalization cost)
 };
 
 inline bool bit(const std::vector<uint64_t>& m, int i) {
@@ -57,7 +62,7 @@ extern "C" {
 // bin), out_leftover[0] = pods that fit nowhere, out_chosen_t/z/c[b] = the
 // finalized offering per bin (arrays sized max_bins).
 int ffd_pack(
-    int T, int Z, int C, int R, int G, int NP,
+    int T, int Z, int C, int R, int G, int NP, int E,
     const float* alloc,        // [T,R]
     const uint8_t* avail,      // [T,Z,C]
     const float* price,        // [T,Z,C]
@@ -72,14 +77,23 @@ int ffd_pack(
     const uint8_t* np_zone,    // [NP,Z]
     const uint8_t* np_cap,     // [NP,C]
     const float* ds,           // [NP,R]
+    const float* pool_cap,     // [NP,R] allocatable ceiling (+inf = none)
+    const float* e_used,       // [E,R] existing-bin committed resources
+    const float* e_alloc,      // [E,R] existing-bin reported allocatable
+    const int32_t* e_type,     // [E]
+    const int32_t* e_zone,     // [E]
+    const int32_t* e_cap,      // [E]
+    const int32_t* e_np,       // [E] owning pool (-1 = unknown)
     int max_bins,
     float* out_cost,
     int64_t* out_leftover,
     int32_t* out_chosen_t,
     int32_t* out_chosen_z,
-    int32_t* out_chosen_c) {
+    int32_t* out_chosen_c,
+    int32_t* out_e_npods) {    // [E] pods ADDED per existing bin
 
-    if (T <= 0 || Z <= 0 || C <= 0 || R <= 0 || G < 0 || NP <= 0) return -1;
+    if (T <= 0 || Z <= 0 || C <= 0 || R <= 0 || G < 0 || NP <= 0 || E < 0)
+        return -1;
     const int TW = (T + 63) / 64, ZW = (Z + 63) / 64, CW = (C + 63) / 64;
     const float EPS = 1e-3f;
 
@@ -97,8 +111,27 @@ int ffd_pack(
     };
 
     std::vector<Bin> bins;
-    bins.reserve(256);
+    bins.reserve(256 + E);
     int64_t leftover = 0;
+
+    // pre-seed fixed bins from existing capacity (first-fit order: the
+    // Python oracle offers existing nodes before any new bin)
+    for (int e = 0; e < E; e++) {
+        Bin b;
+        b.tmask.assign(TW, 0);
+        b.zmask.assign(ZW, 0);
+        b.cmask.assign(CW, 0);
+        b.tmask[e_type[e] >> 6] |= 1ull << (e_type[e] & 63);
+        b.zmask[e_zone[e] >> 6] |= 1ull << (e_zone[e] & 63);
+        b.cmask[e_cap[e] >> 6] |= 1ull << (e_cap[e] & 63);
+        b.cum.assign(e_used + (size_t)e * R, e_used + (size_t)(e + 1) * R);
+        b.np_idx = e_np[e];
+        b.npods = 0;
+        b.last_group = -1;
+        b.last_group_count = 0;
+        b.e_idx = e;
+        bins.push_back(std::move(b));
+    }
 
     std::vector<uint64_t> tm(TW), zm(ZW), cm(CW);
 
@@ -115,10 +148,32 @@ int ffd_pack(
             // ---- first-fit over open bins ----
             for (size_t bi = resume; bi < bins.size() && !placed; bi++) {
                 Bin& b = bins[bi];
-                if (!g_np[(size_t)g * NP + b.np_idx]) continue;
+                // unknown-pool fixed bins are pool-agnostic (the gateway
+                // declines strict custom-key problems when any exist)
+                if (b.np_idx >= 0 && !g_np[(size_t)g * NP + b.np_idx]) continue;
                 if (cap != INT32_MAX) {
                     int cnt = (b.last_group == g) ? b.last_group_count : 0;
                     if (cnt >= cap) continue;
+                }
+                if (b.e_idx >= 0) {
+                    // fixed node: its own type/zone/captype must satisfy the
+                    // group, capacity checks against its reported allocatable
+                    if (!g_type[(size_t)g * T + e_type[b.e_idx]] ||
+                        !g_zone[(size_t)g * Z + e_zone[b.e_idx]] ||
+                        !g_cap[(size_t)g * C + e_cap[b.e_idx]]) continue;
+                    const float* al = e_alloc + (size_t)b.e_idx * R;
+                    bool fits = true;
+                    for (int r = 0; r < R; r++) {
+                        if (b.cum[r] + req[r] > al[r] + EPS) { fits = false; break; }
+                    }
+                    if (!fits) continue;
+                    for (int r = 0; r < R; r++) b.cum[r] += req[r];
+                    b.npods++;
+                    if (b.last_group == g) b.last_group_count++;
+                    else { b.last_group = g; b.last_group_count = 1; }
+                    resume = bi;
+                    placed = true;
+                    continue;
                 }
                 // intersect masks
                 bool tz_any = false;
@@ -133,12 +188,14 @@ int ffd_pack(
                 if (!any(zm) || !any(cm)) continue;
                 // per-type: group-compatible, still fits, reachable
                 for (int w = 0; w < TW; w++) tm[w] = 0;
+                const float* capv = pool_cap + (size_t)b.np_idx * R;
                 for (int t = 0; t < T; t++) {
                     if (!bit(b.tmask, t) || !g_type[(size_t)g * T + t]) continue;
                     const float* al = alloc + (size_t)t * R;
                     bool fits = true;
                     for (int r = 0; r < R; r++) {
-                        if (b.cum[r] + req[r] > al[r] + EPS) { fits = false; break; }
+                        float lim = al[r] < capv[r] ? al[r] : capv[r];
+                        if (b.cum[r] + req[r] > lim + EPS) { fits = false; break; }
                     }
                     if (!fits) continue;
                     if (!type_reachable(t, zm, cm)) continue;
@@ -173,12 +230,14 @@ int ffd_pack(
                 bool tz_any = false;
                 for (int w = 0; w < TW; w++) tm[w] = 0;
                 const float* dsv = ds + (size_t)p * R;
+                const float* capv = pool_cap + (size_t)p * R;
                 for (int t = 0; t < T; t++) {
                     if (!np_type[(size_t)p * T + t] || !g_type[(size_t)g * T + t]) continue;
                     const float* al = alloc + (size_t)t * R;
                     bool fits = true;
                     for (int r = 0; r < R; r++) {
-                        if (dsv[r] + req[r] > al[r] + EPS) { fits = false; break; }
+                        float lim = al[r] < capv[r] ? al[r] : capv[r];
+                        if (dsv[r] + req[r] > lim + EPS) { fits = false; break; }
                     }
                     if (!fits) continue;
                     if (!type_reachable(t, zm, cm)) continue;
@@ -197,6 +256,7 @@ int ffd_pack(
                 b.npods = 1;
                 b.last_group = g;
                 b.last_group_count = 1;
+                b.e_idx = -1;
                 bins.push_back(std::move(b));
                 resume = bins.size() - 1;
                 placed = true;
@@ -205,10 +265,16 @@ int ffd_pack(
         }
     }
 
-    // ---- finalize: cheapest available offering per bin ----
+    // ---- finalize: cheapest available offering per NEW bin (fixed bins
+    // report pods-added only; the caller prices retained capacity) ----
     double total = 0.0;
+    int n_new = 0;
     for (size_t bi = 0; bi < bins.size(); bi++) {
         const Bin& b = bins[bi];
+        if (b.e_idx >= 0) {
+            out_e_npods[b.e_idx] = b.npods;
+            continue;
+        }
         float best = -1.0f;
         int bt = -1, bz = -1, bc = -1;
         for (int t = 0; t < T; t++) {
@@ -226,15 +292,16 @@ int ffd_pack(
         }
         if (bt < 0) return -2;  // invariant violation: open bin w/o offering
         total += best;
-        if ((int)bi < max_bins) {
-            out_chosen_t[bi] = bt;
-            out_chosen_z[bi] = bz;
-            out_chosen_c[bi] = bc;
+        if (n_new < max_bins) {
+            out_chosen_t[n_new] = bt;
+            out_chosen_z[n_new] = bz;
+            out_chosen_c[n_new] = bc;
         }
+        n_new++;
     }
     *out_cost = (float)total;
     *out_leftover = leftover;
-    return (int)bins.size();
+    return n_new;
 }
 
 }  // extern "C"

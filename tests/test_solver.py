@@ -426,18 +426,62 @@ class TestNativeReferee:
         nat = native_ffd_pack(problem)
         assert nat is not None and nat.num_new_nodes >= 5  # <=2 pods per node
 
-    def test_native_declines_out_of_scope_problems(self, solver, lattice):
+    def test_native_repack_matches_python_oracle(self, solver, lattice):
+        """Existing bins + per-pool allocatable ceilings are in native
+        scope: the native referee must place pods on fixed bins exactly
+        like the Python oracle (the cfg4 repack referee path)."""
         from karpenter_provider_aws_tpu.native import native_available, native_ffd_pack
         if not native_available():
             import pytest as _pytest
             _pytest.skip("no C++ toolchain")
+        from karpenter_provider_aws_tpu.apis.objects import KubeletSpec
+        from karpenter_provider_aws_tpu.solver import ExistingBin, ffd_oracle
+        existing = [ExistingBin(name=f"n{i}", node_pool="default",
+                                instance_type="m5.2xlarge", zone="us-west-2a",
+                                capacity_type="on-demand",
+                                used=np.zeros(R, np.float32))
+                    for i in range(4)]
+        pool = default_pool()
+        pool.kubelet = KubeletSpec(max_pods=4)
+        pods = generic_pods(30)
+        problem = build_problem(pods, [pool], lattice, existing=existing)
+        native = native_ffd_pack(problem)
+        assert native is not None
+        oracle = ffd_oracle(problem)
+        assert native.leftover == 0
+        assert native.num_new_nodes == oracle.num_new_nodes
+        assert native.new_node_cost == pytest.approx(oracle.new_node_cost,
+                                                     rel=1e-5)
+        # per-existing-bin placements agree with the Python referee
+        want = np.zeros(4, np.int64)
+        for b in oracle.bins:
+            if b.is_existing:
+                want[b.existing_idx] = len(b.pods)
+        assert list(native.e_npods) == list(want)
+
+    def test_native_declines_out_of_scope_problems(self, solver, lattice):
+        """Bound-pod affinity seeding on existing bins stays Python-only."""
+        from karpenter_provider_aws_tpu.native import native_available, native_ffd_pack
+        if not native_available():
+            import pytest as _pytest
+            _pytest.skip("no C++ toolchain")
+        from karpenter_provider_aws_tpu.apis.objects import PodAffinityTerm
         from karpenter_provider_aws_tpu.solver import ExistingBin
+        from karpenter_provider_aws_tpu.solver.topology import BoundPod
         existing = [ExistingBin(name="n", node_pool="default",
                                 instance_type="m5.large", zone="us-west-2a",
                                 capacity_type="on-demand",
                                 used=np.zeros(R, np.float32))]
-        problem = build_problem(generic_pods(2), [default_pool()], lattice,
-                                existing=existing)
+        anti = [PodAffinityTerm(topology_key=wk.LABEL_HOSTNAME, anti=True,
+                                label_selector=(("app", "z"),))]
+        bound = [BoundPod(pod=Pod(name="resident", labels={"app": "z"},
+                                  pod_affinity=list(anti)),
+                          node_name="n", zone="us-west-2a")]
+        pods = [Pod(name="p0", labels={"app": "z"},
+                    requests={"cpu": "250m", "memory": "256Mi"},
+                    pod_affinity=list(anti))]
+        problem = build_problem(pods, [default_pool()], lattice,
+                                existing=existing, bound_pods=bound)
         assert native_ffd_pack(problem) is None
 
     def test_native_declines_shared_spread_class(self, solver, lattice):
@@ -530,7 +574,13 @@ class TestKubeletCapParity:
         assert sum(1 for b in oracle.bins if not b.is_existing and b.pods) >= 3
         assert all(len(n.pods) <= 2 for n in plan.new_nodes)
         assert plan.new_node_cost <= oracle.new_node_cost * 1.02 + 1e-6
-        assert native_ffd_pack(problem) is None  # out of native scope
+        # per-pool allocatable ceilings are in native scope: same packing
+        native = native_ffd_pack(problem)
+        assert native is not None
+        assert native.num_new_nodes == sum(
+            1 for b in oracle.bins if not b.is_existing and b.pods)
+        assert native.new_node_cost == pytest.approx(oracle.new_node_cost,
+                                                     rel=1e-5)
 
 
 class TestStartupTaints:

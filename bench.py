@@ -192,51 +192,40 @@ def _retained_cost(problem, used_names):
     return total
 
 
-def _repack_parity(problem, plan):
-    """Non-vacuous cfg4 referee: total cost of the repacked cluster
-    (retained existing nodes + any new nodes), plan vs the FFD referee
-    run on the SAME repack problem — native (existing bins are in the
-    C++ referee's scope) with the Python oracle as fallback."""
-    oracle_used, oracle_new_cost, referee = None, None, "python"
+def _run_referee(problem):
+    """ONE referee pack per config: native C++ where in scope, else the
+    Python oracle. Returns (new_node_cost, names of existing bins that
+    received pods, referee kind)."""
     try:
         from karpenter_provider_aws_tpu.native import native_ffd_pack
         ref = native_ffd_pack(problem)
         # an incomplete native pack (leftover pods) would understate the
         # baseline cost and report a false regression — fall back instead
         if ref is not None and ref.leftover == 0:
-            oracle_used = {problem.existing[i].name
-                           for i in np.nonzero(ref.e_npods)[0]}
-            oracle_new_cost = ref.new_node_cost
-            referee = "native"
+            used = ({problem.existing[i].name
+                     for i in np.nonzero(ref.e_npods)[0]}
+                    if problem.E else set())
+            return ref.new_node_cost, used, "native"
     except Exception:
         pass
-    if oracle_used is None:
-        from karpenter_provider_aws_tpu.solver.oracle import ffd_oracle
-        oracle = ffd_oracle(problem)
-        oracle_used = {problem.existing[b.existing_idx].name
-                       for b in oracle.bins if b.is_existing and b.pods}
-        oracle_new_cost = oracle.new_node_cost
+    from karpenter_provider_aws_tpu.solver.oracle import ffd_oracle
+    oracle = ffd_oracle(problem)
+    used = {problem.existing[b.existing_idx].name
+            for b in oracle.bins if b.is_existing and b.pods}
+    return oracle.new_node_cost, used, "python"
+
+
+def _repack_parity(problem, plan, referee_result):
+    """Non-vacuous cfg4 parity: total cost of the repacked cluster
+    (retained existing nodes + any new nodes), plan vs the shared referee
+    result from the SAME repack problem."""
+    oracle_new_cost, oracle_used, referee = referee_result
     plan_cost = plan.new_node_cost + _retained_cost(
         problem, set(plan.existing_assignments))
     oracle_cost = oracle_new_cost + _retained_cost(problem, oracle_used)
     ratio = plan_cost / oracle_cost if oracle_cost > 0 else 1.0
     return (round(ratio, 4), len(oracle_used), round(plan_cost, 2),
             round(oracle_cost, 2), referee)
-
-
-def _referee_cost(problem, plan):
-    """FFD referee cost: native C++ where in scope, else the Python oracle."""
-    try:
-        from karpenter_provider_aws_tpu.native import native_ffd_pack
-        ref = native_ffd_pack(problem)
-        # an incomplete native pack (leftover pods) would understate the
-        # baseline cost and report a false regression — fall back instead
-        if ref is not None and ref.leftover == 0:
-            return ref.new_node_cost, "native"
-    except Exception:
-        pass
-    from karpenter_provider_aws_tpu.solver.oracle import ffd_oracle
-    return ffd_oracle(problem).new_node_cost, "python"
 
 
 def measure_link_rtt() -> float:
@@ -276,7 +265,8 @@ def run_config(key, make, lattice, solver):
     e2e_p50 = float(np.percentile(e2e_ms, 50))
     dev_p50 = float(np.percentile(dev_ms, 50))
 
-    ref_cost, referee = _referee_cost(problem, plan)
+    referee_result = _run_referee(problem)
+    ref_cost, _, referee = referee_result
     if ref_cost > 0:
         cost_ratio = round(plan.new_node_cost / ref_cost, 4)
     else:
@@ -302,7 +292,8 @@ def run_config(key, make, lattice, solver):
         detail["nodes_emptied"] = problem.E - len(plan.existing_assignments)
         (detail["repack_cost_vs_oracle"], detail["oracle_nodes_retained"],
          detail["repack_cost_per_hour"], detail["oracle_repack_cost_per_hour"],
-         detail["repack_referee"]) = _repack_parity(problem, plan)
+         detail["repack_referee"]) = _repack_parity(problem, plan,
+                                                    referee_result)
     return e2e_p50, detail
 
 

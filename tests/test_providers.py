@@ -426,3 +426,99 @@ class TestOpsReviewRegressions:
             budgets=[DisruptionBudget(nodes="-10%")]))
         with pytest.raises(AdmissionError):
             admit_node_pool(pool)
+
+
+class TestAMIDeprecation:
+    def test_deprecated_ami_excluded_from_defaults(self, cloud):
+        """A newer-but-deprecated image must not win default resolution."""
+        p = AMIProvider(cloud, cloud.clock)
+        nc = nodeclass()
+        resolved = {a.arch: a.id for a in p.list(nc, "1.29")}
+        # plant a deprecated image newer than the current amd64 default
+        cloud.network.images["ami-deprecated"] = Image(
+            id="ami-deprecated", name="newer-but-pulled", arch="amd64",
+            creation_date=9e9, deprecated=True)
+        # alias the SSM default parameter at it (simulates a bad publish)
+        fam_params = list(cloud.network.ssm_parameters)
+        for k in fam_params:
+            if "amazon-linux-2023" in k and "x86_64" in k:
+                cloud.network.ssm_parameters[k] = "ami-deprecated"
+        p.reset()
+        resolved2 = {a.arch: a.id for a in p.list(nc, "1.29")}
+        assert resolved2.get("amd64") != "ami-deprecated"
+        # arm64 resolution unaffected
+        assert resolved2.get("arm64") == resolved.get("arm64")
+
+
+class TestSubnetInflightExpiry:
+    def test_bookings_expire_with_describe_window(self, cloud):
+        """In-flight IP bookings decay after the subnet cache TTL, when a
+        refreshed describe would reflect them for real (subnet.go:148-204)."""
+        from karpenter_provider_aws_tpu.providers.subnet import SUBNET_TTL
+        p = SubnetProvider(cloud, cloud.clock)
+        nc = nodeclass()
+        chosen = p.zonal_subnets_for_launch(nc)
+        zone = sorted(chosen)[0]
+        sid = chosen[zone].id
+        # book out nearly every IP in the chosen subnet
+        p.update_inflight_ips(sid, ips=245)
+        free_now = chosen[zone].available_ips - p._inflight_for(sid)
+        assert free_now <= 5
+        cloud.clock.step(SUBNET_TTL + 1)
+        assert p._inflight_for(sid) == 0
+
+
+class TestLaunchTemplateFailover:
+    def _providers(self, cloud):
+        sg = SecurityGroupProvider(cloud, cloud.clock)
+        prof = InstanceProfileProvider(cloud, cloud.clock)
+        ami = AMIProvider(cloud, cloud.clock)
+        return LaunchTemplateProvider(cloud, sg, prof, ami, cloud.clock)
+
+    def test_standby_hydrates_instead_of_recreating(self, cloud):
+        """A replica taking over leadership hydrates existing templates
+        from the cloud (launchtemplate.go:355-370) and ensure_all reuses
+        them instead of re-creating."""
+        nc = nodeclass()
+        lt1 = self._providers(cloud).ensure_all(nc, "1.29")
+        n_before = len(cloud.network.launch_templates)
+        standby = self._providers(cloud)
+        hydrated = standby.hydrate()
+        assert hydrated == n_before
+        lt2 = standby.ensure_all(nc, "1.29")
+        assert len(cloud.network.launch_templates) == n_before
+        assert {t.name for t in lt1} == {t.name for t in lt2}
+
+    def test_distinct_cluster_dns_distinct_templates(self, cloud):
+        """Per-pool kubelet ClusterDNS parameterizes the userdata, so two
+        pools with different DNS launch from different templates."""
+        nc = nodeclass()
+        p = self._providers(cloud)
+        a = {t.name for t in p.ensure_all(nc, "1.29", cluster_dns="10.100.0.10")}
+        b = {t.name for t in p.ensure_all(nc, "1.29", cluster_dns="fd00::53")}
+        assert a.isdisjoint(b)
+
+    def test_windows_resolves_amd64_only(self, cloud):
+        nc = nodeclass(ami_family="Windows")
+        lts = self._providers(cloud).ensure_all(nc, "1.29")
+        archs = {cloud.network.images[t.image_id].arch for t in lts}
+        assert archs == {"amd64"}
+
+
+class TestPricingControllerCadence:
+    def test_refresh_every_12h_only(self, lattice):
+        from karpenter_provider_aws_tpu.providers.pricing import (
+            PRICING_REFRESH_SECONDS, PricingController)
+        clock = FakeClock()  # epoch (1e6 s) already exceeds the window
+        p = PricingProvider(lattice, clock)
+        c = PricingController(p, clock)
+        v0 = lattice.price_version
+        assert c.reconcile()           # first pass refreshes
+        assert lattice.price_version > v0
+        v1 = lattice.price_version
+        clock.step(PRICING_REFRESH_SECONDS / 2)
+        assert not c.reconcile()       # mid-window: no refresh
+        assert lattice.price_version == v1
+        clock.step(PRICING_REFRESH_SECONDS)
+        assert c.reconcile()           # past the window
+        assert lattice.price_version > v1

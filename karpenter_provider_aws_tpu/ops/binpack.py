@@ -488,8 +488,10 @@ def group_layout(G: int, T: int, Z: int, C: int, NP: int, A: int,
     """Static spec of the staged solver input: byte layout of the fused
     GroupBatch+PoolParams upload AND the single source of truth for which
     Problem attribute feeds each field with which pad fill — both the
-    fused path (solve) and the per-array path (probe/sharded) derive
-    their staging from this table, so pad semantics cannot diverge.
+    fused path (every production solve/probe/sharded staging) and the
+    per-array path (kernel tests, the __graft_entry__ compile check)
+    derive their staging from this table, so pad semantics cannot
+    diverge.
 
     The host↔device link charges a ~fixed latency per transfer; shipping
     the 18 input leaves separately costs more than the bytes do (mirror of
@@ -608,18 +610,23 @@ def _unpack_init(buf: Optional[jnp.ndarray], n_existing: jnp.ndarray,
             ).reshape(f.shape)
     live = jnp.arange(B, dtype=jnp.int32) < n_existing
     onehot = lambda idx, n: idx[:, None] == jnp.arange(n, dtype=jnp.int32)[None, :]
+    # rows >= n_existing are neutralized even when the buffer carries data
+    # there: the sharded solve replicates ONE buffer across shards and only
+    # shard 0 owns the existing bins (n_existing = 0 elsewhere) — a closed
+    # row's cum is overwritten at bin open, but pm/po are accumulated into
+    # and MUST start clean
     return BinState(
-        cum=vals["e_used"],
-        tmask=onehot(vals["e_type"], T),
-        zmask=onehot(vals["e_zone"], Z),
-        cmask=onehot(vals["e_cap"], C),
-        np_id=vals["e_np"],
+        cum=jnp.where(live[:, None], vals["e_used"], 0.0),
+        tmask=onehot(vals["e_type"], T) & live[:, None],
+        zmask=onehot(vals["e_zone"], Z) & live[:, None],
+        cmask=onehot(vals["e_cap"], C) & live[:, None],
+        np_id=jnp.where(live, vals["e_np"], -1),
         npods=jnp.zeros((B,), jnp.int32),
         open=live, fixed=live,
-        alloc_cap=vals["e_alloc"],
-        pm=vals["e_pm"],
-        po=vals["e_po"].astype(bool),
-        next_open=n_existing.astype(jnp.int32),
+        alloc_cap=jnp.where(live[:, None], vals["e_alloc"], jnp.inf),
+        pm=jnp.where(live[:, None], vals["e_pm"], 0),
+        po=vals["e_po"].astype(bool) & live[:, None],
+        next_open=jnp.asarray(n_existing, jnp.int32),
     )
 
 

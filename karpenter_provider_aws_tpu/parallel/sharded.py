@@ -83,20 +83,24 @@ class ShardedPack(NamedTuple):
     total_leftover: jnp.ndarray  # psum over shards: pods no bin could take
 
 
-def _local_pack(alloc, avail, price, pools, req, count_shard, init_shard, g_type, g_zone,
-                g_cap, g_np, max_per_bin, spread_class, single_bin, match, owner, need,
-                strict_custom):
-    """Runs on each device over its pod-count shard; reduces over 'pods'."""
-    count_local = count_shard.reshape(count_shard.shape[-1])  # [1,G] block -> [G]
-    # each device gets its own bin table (existing capacity lives on shard 0
-    # only — replicating it would fill the same physical nodes D times)
-    init = binpack.BinState(*(x.reshape(x.shape[1:]) for x in init_shard))
-    groups = binpack.GroupBatch(req=req, count=count_local, g_type=g_type,
-                                g_zone=g_zone, g_cap=g_cap, g_np=g_np,
-                                max_per_bin=max_per_bin, spread_class=spread_class,
-                                single_bin=single_bin,
-                                match=match, owner=owner, need=need,
-                                strict_custom=strict_custom)
+def _local_pack(alloc, avail, price, dims, gbuf, count_shard, init_buf,
+                n_existing):
+    """Runs on each device over its pod-count shard; reduces over 'pods'.
+
+    Inputs arrive as the same fused uint8 buffers the single-device solve
+    ships (ops/binpack.group_layout / init_layout): one replicated upload
+    for groups+pools, one for existing bins — on a real multi-chip slice
+    the host link charges per transfer exactly like the single-chip
+    tunnel. Existing capacity lives on shard 0 only (replicating it would
+    fill the same physical nodes D times): every shard unpacks the same
+    init buffer with n_existing masked to zero off shard 0."""
+    B, G, T, Z, C, NP, A, R = dims
+    count_local = count_shard.reshape(count_shard.shape[-1])  # [1,G] -> [G]
+    groups, pools = binpack._unpack_inputs(gbuf, G, T, Z, C, NP, A, R)
+    groups = groups._replace(count=count_local)
+    d = jax.lax.axis_index("pods")
+    n_e = jnp.where(d == 0, jnp.asarray(n_existing, jnp.int32), 0)
+    init = binpack._unpack_init(init_buf, n_e, B, T, Z, C, A, R)
     res = binpack.pack(alloc, avail, price, groups, pools, init)
     live = res.state.open & ~res.state.fixed & (res.state.npods > 0)
     local_cost = jnp.sum(jnp.where(live, res.chosen_price, 0.0))
@@ -111,39 +115,34 @@ def _local_pack(alloc, avail, price, pools, req, count_shard, init_shard, g_type
             total_cost, total_nodes, total_leftover)
 
 
-def sharded_pack(mesh: Mesh, alloc, avail, price, groups: binpack.GroupBatch,
-                 pools: binpack.PoolParams, init: binpack.BinState,
-                 count_split: np.ndarray) -> ShardedPack:
+def sharded_pack(mesh: Mesh, alloc, avail, price, gbuf, init_buf,
+                 n_existing: int, count_split: np.ndarray,
+                 B: int, G: int, T: int, Z: int, C: int, NP: int,
+                 A: int) -> ShardedPack:
     """Compile + run the pod-sharded solve over ``mesh``.
 
-    ``count_split`` is [D,G] from split_counts; the lattice and group masks
-    are replicated (the lattice is the 'weights' of this model — resident on
-    every device, exactly the TP-style layout that avoids re-sharding the
-    lattice per step); the bin table is sharded so existing capacity lives on
-    shard 0 only.
+    ``gbuf``/``init_buf`` are the fused group+pool / existing-bin uploads
+    (solver/solve.py _fused_inputs / _fused_init_np; init_buf None = no
+    existing capacity); ``count_split`` is [D,G] from split_counts. The
+    lattice and the fused buffers are replicated (the lattice is the
+    'weights' of this model — resident on every device, exactly the
+    TP-style layout that avoids re-sharding the lattice per step); the
+    bin table is per-shard, with existing capacity materialized on shard
+    0 only (see _local_pack).
     """
-    D = mesh.devices.size
-    B = init.cum.shape[0]
-    empty = binpack.empty_state(B, init.tmask.shape[1], init.zmask.shape[1],
-                                init.cmask.shape[1], init.cum.shape[1],
-                                init.pm.shape[1])
-    init_stack = binpack.BinState(*(
-        jnp.concatenate([jnp.asarray(a)[None], jnp.broadcast_to(jnp.asarray(e)[None], (D - 1,) + e.shape)])
-        if D > 1 else jnp.asarray(a)[None]
-        for a, e in zip(init, empty)
-    ))
-
+    if init_buf is None:
+        _, i_total = binpack.init_layout(B, alloc.shape[1], A)
+        init_buf = jnp.zeros((i_total,), jnp.uint8)
+        n_existing = 0
+    dims = (B, G, T, Z, C, NP, A, alloc.shape[1])
     repl = P()
     fn = jax.shard_map(
-        partial(_local_pack, alloc, avail, price, pools),
+        partial(_local_pack, alloc, avail, price, dims),
         mesh=mesh,
-        in_specs=(repl, P("pods"), jax.tree.map(lambda _: P("pods"), empty),
-                  repl, repl, repl, repl, repl, repl, repl, repl, repl, repl, repl),
+        in_specs=(repl, P("pods"), repl, repl),
         out_specs=(P("pods"), repl, repl, repl),
         check_vma=False,
     )
-    out = jax.jit(fn)(groups.req, jnp.asarray(count_split), init_stack, groups.g_type,
-                      groups.g_zone, groups.g_cap, groups.g_np, groups.max_per_bin,
-                      groups.spread_class, groups.single_bin, groups.match,
-                      groups.owner, groups.need, groups.strict_custom)
-    return ShardedPack(*out)
+    return ShardedPack(*jax.jit(fn)(
+        jnp.asarray(gbuf), jnp.asarray(count_split), jnp.asarray(init_buf),
+        jnp.asarray(n_existing, jnp.int32)))

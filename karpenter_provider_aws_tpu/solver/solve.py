@@ -379,10 +379,12 @@ class Solver:
                          A: Optional[int] = None, NP: Optional[int] = None,
                          count_override: Optional[np.ndarray] = None) -> np.ndarray:
         """All group + pool tensors padded into ONE uint8 host buffer →
-        one host→device transfer. Staging 18 arrays separately pays the
-        tunneled link's per-transfer cost 18×; field order/fill semantics
-        are the shared spec in ops/binpack.group_layout, so this path and
-        _padded_groups/_pool_params (sharded) cannot diverge."""
+        one host→device transfer (every production path: solve, merge,
+        probe, sharded). Staging 18 arrays separately pays the tunneled
+        link's per-transfer cost 18×; field order/fill semantics are the
+        shared spec in ops/binpack.group_layout, which the per-array
+        helpers (_padded_groups/_pool_params — kernel tests and the
+        __graft_entry__ compile check) also derive from."""
         layout, total = self._layout(problem, G, A, NP)
         buf = np.zeros((total,), np.uint8)
         for f in layout:
@@ -880,8 +882,7 @@ class Solver:
                                    -(-capped_bins // D) + problem.G + n_whole + 64)
         B = _bucket(max(b_needed, problem.E + 1), _B_BUCKETS, clamp=True)
 
-        groups = self._padded_groups(problem, G)
-        pools = self._pool_params(problem)
+        fused = self._fused_inputs(problem, G)
         avail, price = self._device_avail_price(problem)
 
         count_pad = np.zeros((G,), np.int32)
@@ -896,12 +897,15 @@ class Solver:
 
         lat = self.lattice
         A = max(problem.A, 1)
+        NP = max(problem.NP, 1)
         while True:
-            init = self._init_state(problem, B)
+            init_buf = (jnp.asarray(self._fused_init_np(problem, B))
+                        if problem.E else None)
             td = time.perf_counter()
             with self._trace_span("solver.pack_sharded"):
-                sp = sharded_pack(mesh, self._alloc, avail, price, groups,
-                                  pools, init, count_split)
+                sp = sharded_pack(mesh, self._alloc, avail, price, fused,
+                                  init_buf, problem.E, count_split,
+                                  B, G, lat.T, lat.Z, lat.C, NP, A)
                 # one fused [D,B+n,W] buffer = one device→host transfer for
                 # all shards (sync included); host-side unpack stays off the
                 # device clock

@@ -51,16 +51,17 @@ class TestShardedPack:
         problem = build_problem(pods, pools, lattice)
         solver = Solver(lattice)
         G, B = 16, 512
-        groups = solver._padded_groups(problem, G)
-        pool_params = solver._pool_params(problem)
-        init = solver._init_state(problem, B)
-        count_split = split_counts(np.asarray(groups.count), 8)
+        gbuf = solver._fused_inputs(problem, G)
+        count_pad = np.zeros((G,), np.int32)
+        count_pad[: problem.G] = problem.count
+        count_split = split_counts(count_pad, 8)
         sp = sharded_pack(mesh, solver._alloc, solver._avail, solver._price,
-                          groups, pool_params, init, count_split)
+                          gbuf, None, 0, count_split,
+                          B, G, lattice.T, lattice.Z, lattice.C, 1, 1)
         decs = decode_sharded_pack(sp, G, lattice.T, lattice.Z, lattice.C, 1)
         assign = np.stack([d.assign for d in decs])    # [D,G,B]
         assert assign.shape == (8, G, B)
-        total = int(np.asarray(groups.count).sum())
+        total = int(count_pad.sum())
         placed = int(assign.sum())
         # conservation: every pod is placed or left over, per shard
         assert placed + int(sp.total_leftover) == total
@@ -77,15 +78,17 @@ class TestShardedPack:
                 for i in range(801)]
         problem = build_problem(pods, [NodePool(name="default")], lattice)
         solver = Solver(lattice)
-        groups = solver._padded_groups(problem, 16)
-        count_split = split_counts(np.asarray(groups.count), 8)
+        count_pad = np.zeros((16,), np.int32)
+        count_pad[: problem.G] = problem.count
+        count_split = split_counts(count_pad, 8)
         # 801 = 8*100 + 1: shard 0 gets 101, the rest 100
-        gi = int(np.argmax(np.asarray(groups.count)))
+        gi = int(np.argmax(count_pad))
         assert count_split[0, gi] == 101
         assert all(count_split[d, gi] == 100 for d in range(1, 8))
         sp = sharded_pack(mesh, solver._alloc, solver._avail, solver._price,
-                          groups, solver._pool_params(problem),
-                          solver._init_state(problem, 512), count_split)
+                          solver._fused_inputs(problem, 16), None, 0,
+                          count_split, 512, 16,
+                          lattice.T, lattice.Z, lattice.C, 1, 1)
         decs = decode_sharded_pack(sp, 16, lattice.T, lattice.Z, lattice.C, 1)
         per_shard = np.array([int(d.assign.sum()) for d in decs])
         np.testing.assert_array_equal(per_shard, count_split.sum(axis=1))

@@ -187,6 +187,7 @@ class Pod:
     def __setattr__(self, name, value):
         if name in Pod._SIG_FIELDS:
             self.__dict__.pop("_kpat_sig", None)
+            self.__dict__.pop("_kpat_selkeys", None)
         object.__setattr__(self, name, value)
 
     def hard_scheduling_requirements(self) -> Requirements:

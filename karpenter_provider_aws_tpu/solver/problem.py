@@ -286,25 +286,24 @@ def _selector_keys(pods: Sequence[Pod], bound_pods: Sequence[BoundPod]) -> froze
     signature projects labels onto them — per-pod-unique labels (StatefulSet
     pod names, pod-index) never break deduplication.
 
-    Pods stamped out by one controller share the same affinity/spread
-    container objects, so an id() memo keeps this a 2-load pass per pod."""
+    Each pod caches its contribution on itself (Pod.__setattr__ drops the
+    cache when a selector field is reassigned); cluster state hands the
+    SAME Pod objects to every scheduling pass, so steady-state batches pay
+    one dict get per pod — whether the selector containers are shared
+    (controller-stamped fixtures) or per-pod unique (anything parsed from
+    the API server is its own object)."""
     keys: set = set()
-    seen: set = set()
     def collect(p: Pod) -> None:
-        pa = p.pod_affinity
-        if pa:
-            i = id(pa)
-            if i not in seen:
-                seen.add(i)
-                for term in pa:
-                    keys.update(k for k, _ in term.label_selector)
-        ts = p.topology_spread
-        if ts:
-            i = id(ts)
-            if i not in seen:
-                seen.add(i)
-                for c in ts:
-                    keys.update(k for k, _ in c.label_selector)
+        cached = p.__dict__.get("_kpat_selkeys")
+        if cached is None:
+            mine: set = set()
+            for term in p.pod_affinity:
+                mine.update(k for k, _ in term.label_selector)
+            for c in p.topology_spread:
+                mine.update(k for k, _ in c.label_selector)
+            cached = frozenset(mine)
+            p.__dict__["_kpat_selkeys"] = cached
+        keys.update(cached)
     for p in pods:
         collect(p)
     for bp in bound_pods:

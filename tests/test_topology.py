@@ -405,3 +405,24 @@ class TestReviewRegressions:
         assert out.sum(axis=0).tolist() == [8, 8, 8]
         assert (out[:, 1] > 0).sum() == 1          # whole on one shard
         assert out[0, 2] == 8 and out[1:, 2].sum() == 0  # pinned to shard 0
+
+
+class TestSelectorKeyCache:
+    def test_per_pod_cache_invalidates_on_reassignment(self):
+        """_selector_keys caches each pod's contributed label keys on the
+        pod; reassigning a selector field must drop the cache (the same
+        __setattr__ contract as the scheduling-signature cache)."""
+        from karpenter_provider_aws_tpu.solver.problem import _selector_keys
+        p = Pod(name="x", requests={"cpu": "1"},
+                topology_spread=[TopologySpreadConstraint(
+                    max_skew=1, topology_key=wk.LABEL_ZONE,
+                    label_selector=(("app", "a"),))])
+        assert _selector_keys([p], []) == frozenset({"app"})
+        # steady-state: second pass hits the cache, same answer
+        assert _selector_keys([p], []) == frozenset({"app"})
+        p.topology_spread = [TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.LABEL_ZONE,
+            label_selector=(("tier", "web"),))]
+        assert _selector_keys([p], []) == frozenset({"tier"})
+        p.topology_spread = []
+        assert _selector_keys([p], []) == frozenset()

@@ -35,4 +35,4 @@ Package map (reference analog in parens):
 - ``utils``       unit parsing, hashing, misc (pkg/utils).
 """
 
-__version__ = "0.3.0"
+__version__ = "0.4.0"

@@ -8,8 +8,9 @@ Per config this measures BOTH:
 
 Cost parity uses the sequential FFD referee — the native C++ one
 (native/ffd.cc, same per-pod algorithm as the reference's Go scheduler
-loop) where the problem is in native scope, else the Python oracle
-(solver/oracle.py, which also covers existing bins and hostname affinity).
+loop; covers the full feature surface incl. affinity classes and
+existing bins, so ALL FIVE configs referee natively), with the Python
+oracle (solver/oracle.py) as fallback when no toolchain is available.
 BASELINE envelope: ≤2% cost regression (``cost_vs_ffd_oracle`` ≤ 1.02).
 
 Prints ONE JSON line per config; the LAST line is the north-star config 5

@@ -34,38 +34,23 @@ def _c(a: np.ndarray, dtype):
 
 
 def native_ffd_pack(problem: Problem, max_bins: int = 200_000) -> Optional[NativeOraclePlan]:
-    """Run the native referee; None if the toolchain/library is unavailable
-    or the problem uses features outside the native scope (hostname
-    affinity classes, strict custom keys over unknown-pool nodes) —
-    callers fall back to the Python oracle. Existing (fixed) bins and
-    per-pool allocatable ceilings are in native scope."""
+    """Run the native referee; None if the toolchain/library is
+    unavailable or the problem uses strict custom keys over unknown-pool
+    nodes (the one remaining Python-only scope) — callers fall back to
+    the Python oracle. Existing (fixed) bins, per-pool allocatable
+    ceilings, and hostname affinity classes (pm/po symmetry, presence
+    needs, spread-class caps, single-bin co-location, bound-pod seeds)
+    are all in native scope."""
     lib = ensure_built()
     if lib is None:
         return None
-    if problem.E > 0 and problem.strict_custom.any() \
+    if problem.strict_custom.any() and problem.E > 0 \
             and (problem.e_np < 0).any():
-        # unknown-pool nodes cannot be verified against custom-key
-        # selectors; the Python oracle holds that logic
+        # unknown-pool existing bins cannot be verified against custom-key
+        # selectors; the Python oracle holds that logic. With no
+        # unknown-pool bins the strictness resolves entirely through the
+        # np masks, which are native scope.
         return None
-    if problem.A and problem.E > 0 and (problem.e_pm.any() or problem.e_po.any()):
-        # bound-pod affinity seeding on existing bins is Python-only scope
-        return None
-    if problem.A and (problem.g_owner.any() or problem.g_need.any()
-                      or problem.single_bin.any()):
-        # hostname (anti-)affinity classes / co-location need the Python
-        # referee; per-row spread caps are in native scope
-        return None
-    if problem.A:
-        # the native cap counts only the row's own placements; if any OTHER
-        # group matches a row's spread class, the skew budget is shared
-        # cross-group and only the Python referee counts it correctly
-        for gi in range(problem.G):
-            a = int(problem.g_spread[gi])
-            if a < 0:
-                continue
-            for gj in range(problem.G):
-                if gj != gi and problem.g_match[gj, a]:
-                    return None
     lat = problem.lattice
     G = problem.G
     from ..apis.resources import R
@@ -85,8 +70,9 @@ def native_ffd_pack(problem: Problem, max_bins: int = 200_000) -> Optional[Nativ
     E = problem.E
     e_npods = np.zeros((max(E, 1),), np.int32)
 
+    A = problem.A
     n = lib.ffd_pack(
-        lat.T, lat.Z, lat.C, R, G, max(problem.NP, 1), E,
+        lat.T, lat.Z, lat.C, R, G, max(problem.NP, 1), E, A,
         arr(lat.alloc, np.float32),
         arr(lat.available, np.uint8),
         arr(np.nan_to_num(lat.price, posinf=3.4e38), np.float32),
@@ -97,6 +83,11 @@ def native_ffd_pack(problem: Problem, max_bins: int = 200_000) -> Optional[Nativ
         arr(problem.g_cap, np.uint8),
         arr(problem.g_np, np.uint8),
         arr(problem.max_per_bin, np.int32),
+        arr(problem.g_spread, np.int32),
+        arr(problem.single_bin, np.uint8),
+        arr(problem.g_match, np.uint8),
+        arr(problem.g_owner, np.uint8),
+        arr(problem.g_need, np.uint8),
         arr(problem.np_type, np.uint8),
         arr(problem.np_zone, np.uint8),
         arr(problem.np_cap, np.uint8),
@@ -109,6 +100,8 @@ def native_ffd_pack(problem: Problem, max_bins: int = 200_000) -> Optional[Nativ
         arr(problem.e_zone, np.int32),
         arr(problem.e_cap, np.int32),
         arr(problem.e_np, np.int32),
+        arr(problem.e_pm, np.int32),
+        arr(problem.e_po, np.uint8),
         ctypes.c_int(max_bins),
         ctypes.byref(out_cost),
         ctypes.byref(out_leftover),

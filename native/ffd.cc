@@ -14,8 +14,9 @@
 // allocatable ceilings (kubelet maxPods), and pre-existing (fixed) bins
 // with their own reported allocatable — the semantics the large-scale
 // benchmark configs exercise, incl. the 500-node consolidation repack.
-// Hostname affinity classes stay in the Python referee (small-problem
-// regression tests).
+// Hostname affinity classes (pm/po symmetry checks, presence needs,
+// spread-class skew caps, single-bin co-location) are in scope too; only
+// strict custom-key matching over unknown-pool nodes stays Python-side.
 //
 // Built on demand by karpenter_provider_aws_tpu/native/build.py:
 //   g++ -O3 -shared -fPIC -o libffd.so ffd.cc
@@ -31,6 +32,8 @@ struct Bin {
     std::vector<uint64_t> zmask;  // bitset over Z
     std::vector<uint64_t> cmask;  // bitset over C
     std::vector<float> cum;       // [R]
+    std::vector<int32_t> pm;      // [A] pods matching affinity class a
+    std::vector<uint64_t> po;     // bitset over A: holds an owner of class a
     int np_idx;                   // -1 = unknown pool (fixed bins only)
     int npods;                    // pods ADDED by this pack
     int last_group;               // per-row cap bookkeeping
@@ -62,7 +65,7 @@ extern "C" {
 // bin), out_leftover[0] = pods that fit nowhere, out_chosen_t/z/c[b] = the
 // finalized offering per bin (arrays sized max_bins).
 int ffd_pack(
-    int T, int Z, int C, int R, int G, int NP, int E,
+    int T, int Z, int C, int R, int G, int NP, int E, int A,
     const float* alloc,        // [T,R]
     const uint8_t* avail,      // [T,Z,C]
     const float* price,        // [T,Z,C]
@@ -73,6 +76,12 @@ int ffd_pack(
     const uint8_t* g_cap,      // [G,C]
     const uint8_t* g_np,       // [G,NP]
     const int32_t* g_maxper,   // [G] per-bin cap (INT32_MAX = none)
+    const int32_t* g_spread,   // [G] spread class whose pm count the cap
+                               // tracks (-1 = cap is per-row)
+    const uint8_t* g_single,   // [G] all replicas share one bin
+    const uint8_t* g_match,    // [G,A] affinity classes the group matches
+    const uint8_t* g_owner,    // [G,A] anti-affinity terms the group owns
+    const uint8_t* g_need,     // [G,A] classes the bin must already hold
     const uint8_t* np_type,    // [NP,T]
     const uint8_t* np_zone,    // [NP,Z]
     const uint8_t* np_cap,     // [NP,C]
@@ -84,6 +93,8 @@ int ffd_pack(
     const int32_t* e_zone,     // [E]
     const int32_t* e_cap,      // [E]
     const int32_t* e_np,       // [E] owning pool (-1 = unknown)
+    const int32_t* e_pm,       // [E,A] bound-pod affinity-class counts
+    const uint8_t* e_po,       // [E,A] bound pod owns anti-term a
     int max_bins,
     float* out_cost,
     int64_t* out_leftover,
@@ -92,9 +103,11 @@ int ffd_pack(
     int32_t* out_chosen_c,
     int32_t* out_e_npods) {    // [E] pods ADDED per existing bin
 
-    if (T <= 0 || Z <= 0 || C <= 0 || R <= 0 || G < 0 || NP <= 0 || E < 0)
+    if (T <= 0 || Z <= 0 || C <= 0 || R <= 0 || G < 0 || NP <= 0 || E < 0
+        || A < 0)
         return -1;
     const int TW = (T + 63) / 64, ZW = (Z + 63) / 64, CW = (C + 63) / 64;
+    const int AW = (A + 63) / 64;
     const float EPS = 1e-3f;
 
     // type t has an available offering within (zmask, cmask)?
@@ -125,6 +138,12 @@ int ffd_pack(
         b.zmask[e_zone[e] >> 6] |= 1ull << (e_zone[e] & 63);
         b.cmask[e_cap[e] >> 6] |= 1ull << (e_cap[e] & 63);
         b.cum.assign(e_used + (size_t)e * R, e_used + (size_t)(e + 1) * R);
+        if (A > 0) {
+            b.pm.assign(e_pm + (size_t)e * A, e_pm + (size_t)(e + 1) * A);
+            b.po.assign(AW, 0);
+            for (int a = 0; a < A; a++)
+                if (e_po[(size_t)e * A + a]) b.po[a >> 6] |= 1ull << (a & 63);
+        }
         b.np_idx = e_np[e];
         b.npods = 0;
         b.last_group = -1;
@@ -134,10 +153,26 @@ int ffd_pack(
     }
 
     std::vector<uint64_t> tm(TW), zm(ZW), cm(CW);
+    std::vector<uint64_t> owner_bits(AW), match_bits(AW);
+    std::vector<int> single_home(G, -1);
 
     for (int g = 0; g < G; g++) {
         const float* req = g_req + (size_t)g * R;
         const int32_t cap = g_maxper[g];
+        const int32_t spread = g_spread[g];
+        const bool single = g_single[g] != 0;
+        const uint8_t* match = g_match + (size_t)g * A;
+        const uint8_t* owner = g_owner + (size_t)g * A;
+        const uint8_t* need = g_need + (size_t)g * A;
+        bool seed_ok = true;   // a fresh bin satisfies needs by self-seeding
+        if (A > 0) {
+            for (int w = 0; w < AW; w++) { owner_bits[w] = 0; match_bits[w] = 0; }
+            for (int a = 0; a < A; a++) {
+                if (owner[a]) owner_bits[a >> 6] |= 1ull << (a & 63);
+                if (match[a]) match_bits[a >> 6] |= 1ull << (a & 63);
+                if (need[a] && !match[a]) seed_ok = false;
+            }
+        }
         // first-fit resume point: a bin this group's previous pod skipped is
         // unchanged (only entered bins mutate), so it stays infeasible for
         // the identical next pod — scanning may resume where the last pod
@@ -148,12 +183,35 @@ int ffd_pack(
             // ---- first-fit over open bins ----
             for (size_t bi = resume; bi < bins.size() && !placed; bi++) {
                 Bin& b = bins[bi];
+                if (single && single_home[g] >= 0 && (int)bi != single_home[g])
+                    continue;
                 // unknown-pool fixed bins are pool-agnostic (the gateway
                 // declines strict custom-key problems when any exist)
                 if (b.np_idx >= 0 && !g_np[(size_t)g * NP + b.np_idx]) continue;
                 if (cap != INT32_MAX) {
-                    int cnt = (b.last_group == g) ? b.last_group_count : 0;
+                    // spread-class caps count the CLASS's pods in the bin
+                    // (bound + sibling groups); class-less caps count this
+                    // row's own placements
+                    int cnt;
+                    if (spread >= 0) cnt = b.pm[spread];
+                    else cnt = (b.last_group == g) ? b.last_group_count : 0;
                     if (cnt >= cap) continue;
+                }
+                if (A > 0) {
+                    // k8s symmetry: the bin holds no pod we anti-affine
+                    // against, no pod anti-affining against us, and every
+                    // class we need is present (every bin carries pm/po
+                    // state when A > 0 — seeded at creation)
+                    bool conflict = false;
+                    for (int w = 0; w < AW && !conflict; w++)
+                        if (b.po[w] & match_bits[w]) conflict = true;
+                    for (int a = 0; a < A && !conflict; a++)
+                        if (owner[a] && b.pm[a] > 0) conflict = true;
+                    if (conflict) continue;
+                    bool need_ok = true;
+                    for (int a = 0; a < A && need_ok; a++)
+                        if (need[a] && b.pm[a] <= 0) need_ok = false;
+                    if (!need_ok) continue;
                 }
                 if (b.e_idx >= 0) {
                     // fixed node: its own type/zone/captype must satisfy the
@@ -169,8 +227,13 @@ int ffd_pack(
                     if (!fits) continue;
                     for (int r = 0; r < R; r++) b.cum[r] += req[r];
                     b.npods++;
+                    if (A > 0) {
+                        for (int a = 0; a < A; a++) b.pm[a] += match[a] ? 1 : 0;
+                        for (int w = 0; w < AW; w++) b.po[w] |= owner_bits[w];
+                    }
                     if (b.last_group == g) b.last_group_count++;
                     else { b.last_group = g; b.last_group_count = 1; }
+                    if (single) single_home[g] = (int)bi;
                     resume = bi;
                     placed = true;
                     continue;
@@ -209,12 +272,22 @@ int ffd_pack(
                 b.cmask = cm;
                 for (int r = 0; r < R; r++) b.cum[r] += req[r];
                 b.npods++;
+                if (A > 0) {
+                    for (int a = 0; a < A; a++) b.pm[a] += match[a] ? 1 : 0;
+                    for (int w = 0; w < AW; w++) b.po[w] |= owner_bits[w];
+                }
                 if (b.last_group == g) b.last_group_count++;
                 else { b.last_group = g; b.last_group_count = 1; }
+                if (single) single_home[g] = (int)bi;
                 resume = bi;
                 placed = true;
             }
             if (placed) continue;
+            // single-bin groups never straddle: once a home exists, a pod
+            // that doesn't fit it is unschedulable; a fresh bin satisfies
+            // presence needs only by self-seeding
+            if (single && single_home[g] >= 0) { leftover++; continue; }
+            if (A > 0 && !seed_ok) { leftover++; continue; }
             // ---- open a new bin: highest-weight compatible pool ----
             for (int p = 0; p < NP && !placed; p++) {
                 if (!g_np[(size_t)g * NP + p]) continue;
@@ -254,10 +327,16 @@ int ffd_pack(
                 for (int r = 0; r < R; r++) b.cum[r] += req[r];
                 b.np_idx = p;
                 b.npods = 1;
+                if (A > 0) {
+                    b.pm.assign(A, 0);
+                    for (int a = 0; a < A; a++) b.pm[a] = match[a] ? 1 : 0;
+                    b.po = owner_bits;
+                }
                 b.last_group = g;
                 b.last_group_count = 1;
                 b.e_idx = -1;
                 bins.push_back(std::move(b));
+                if (single) single_home[g] = (int)bins.size() - 1;
                 resume = bins.size() - 1;
                 placed = true;
             }

@@ -482,11 +482,21 @@ class TestNativeReferee:
                     pod_affinity=list(anti))]
         problem = build_problem(pods, [default_pool()], lattice,
                                 existing=existing, bound_pods=bound)
-        assert native_ffd_pack(problem) is None
+        native = native_ffd_pack(problem)
+        assert native is not None
+        # the resident owner repels p0 off the existing node, exactly like
+        # the Python referee
+        from karpenter_provider_aws_tpu.solver import ffd_oracle
+        oracle = ffd_oracle(problem)
+        assert int(native.e_npods[0]) == 0
+        assert native.num_new_nodes == oracle.num_new_nodes == 1
+        assert native.new_node_cost == pytest.approx(oracle.new_node_cost,
+                                                     rel=1e-5)
 
-    def test_native_declines_shared_spread_class(self, solver, lattice):
-        """Two groups sharing one spread selector: the native per-row cap
-        would undercount, so the wrapper must fall back to Python."""
+    def test_native_shared_spread_class_parity(self, solver, lattice):
+        """Two groups sharing one spread selector: the skew budget is
+        shared cross-group via the pm class counts — native must agree
+        with the Python referee."""
         from karpenter_provider_aws_tpu.native import native_available, native_ffd_pack
         if not native_available():
             import pytest as _pytest
@@ -501,7 +511,15 @@ class TestNativeReferee:
                      requests={"cpu": "500m", "memory": "512Mi"},
                      topology_spread=list(spread)) for i in range(4)]
         problem = build_problem(pods, [default_pool()], lattice)
-        assert native_ffd_pack(problem) is None
+        from karpenter_provider_aws_tpu.solver import ffd_oracle
+        native = native_ffd_pack(problem)
+        oracle = ffd_oracle(problem)
+        assert native is not None
+        assert native.leftover == 0 and not oracle.unschedulable
+        assert native.num_new_nodes == sum(
+            1 for b in oracle.bins if not b.is_existing and b.pods) == 8
+        assert native.new_node_cost == pytest.approx(oracle.new_node_cost,
+                                                     rel=1e-5)
 
 
 class TestProbeBatch:
@@ -635,3 +653,87 @@ class TestWarmup:
                           b_buckets=(32,), background=True)
         t.join(timeout=120)
         assert not t.is_alive()
+
+
+class TestNativeOracleFuzzParity:
+    """Randomized metamorphic parity: the C++ referee must match the
+    Python oracle pod-for-pod on random problems drawn from the full
+    in-scope feature surface (affinity classes, spread caps, single-bin,
+    existing bins with bound-pod seeds, pool ceilings, taints)."""
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_problem_parity(self, lattice, seed):
+        from karpenter_provider_aws_tpu.native import native_available, native_ffd_pack
+        if not native_available():
+            pytest.skip("no C++ toolchain")
+        from karpenter_provider_aws_tpu.apis.objects import (
+            KubeletSpec, PodAffinityTerm, TopologySpreadConstraint)
+        from karpenter_provider_aws_tpu.solver import ExistingBin, ffd_oracle
+        from karpenter_provider_aws_tpu.solver.topology import BoundPod
+
+        rng = np.random.default_rng(seed)
+        pools = [default_pool()]
+        if rng.random() < 0.5:
+            pools[0].kubelet = KubeletSpec(max_pods=int(rng.integers(3, 8)))
+        pods = []
+        napps = int(rng.integers(1, 4))
+        for i in range(int(rng.integers(5, 40))):
+            app = f"a{int(rng.integers(napps))}"
+            kw = {}
+            r = rng.random()
+            if r < 0.2:
+                kw["pod_affinity"] = [PodAffinityTerm(
+                    topology_key=wk.LABEL_HOSTNAME, anti=True,
+                    label_selector=(("app", app),))]
+            elif r < 0.4:
+                kw["topology_spread"] = [TopologySpreadConstraint(
+                    max_skew=int(rng.integers(1, 3)),
+                    topology_key=wk.LABEL_HOSTNAME,
+                    label_selector=(("app", app),))]
+            elif r < 0.5:
+                # positive self-affinity -> single-bin co-location homing
+                kw["pod_affinity"] = [PodAffinityTerm(
+                    topology_key=wk.LABEL_HOSTNAME,
+                    label_selector=(("app", app),))]
+            elif r < 0.6:
+                # affinity to another class -> presence need / self-seed
+                other = f"a{int(rng.integers(napps))}"
+                kw["pod_affinity"] = [PodAffinityTerm(
+                    topology_key=wk.LABEL_HOSTNAME,
+                    label_selector=(("app", other),))]
+            pods.append(Pod(
+                name=f"p{i}", labels={"app": app},
+                requests={"cpu": f"{int(rng.choice([250, 500, 1000]))}m",
+                          "memory": f"{int(rng.choice([512, 1024]))}Mi"},
+                **kw))
+        existing, bound = [], []
+        for e in range(int(rng.integers(0, 4))):
+            existing.append(ExistingBin(
+                name=f"n{e}", node_pool="default",
+                instance_type="m5.2xlarge", zone="us-west-2a",
+                capacity_type="on-demand", used=np.zeros(R, np.float32)))
+            if rng.random() < 0.5:
+                app = f"a{int(rng.integers(napps))}"
+                bound.append(BoundPod(
+                    pod=Pod(name=f"r{e}", labels={"app": app},
+                            pod_affinity=[PodAffinityTerm(
+                                topology_key=wk.LABEL_HOSTNAME, anti=True,
+                                label_selector=(("app", app),))]),
+                    node_name=f"n{e}", zone="us-west-2a"))
+        problem = build_problem(pods, pools, lattice, existing=existing,
+                                bound_pods=bound)
+        native = native_ffd_pack(problem)
+        assert native is not None, "all generated features are native scope"
+        oracle = ffd_oracle(problem)
+        o_new = sum(1 for b in oracle.bins if not b.is_existing and b.pods)
+        o_left = len(oracle.unschedulable) - len(problem.unschedulable)
+        assert native.num_new_nodes == o_new
+        assert native.leftover == o_left
+        assert native.new_node_cost == pytest.approx(oracle.new_node_cost,
+                                                     rel=1e-5, abs=1e-7)
+        if problem.E:
+            want = np.zeros(problem.E, np.int64)
+            for b in oracle.bins:
+                if b.is_existing:
+                    want[b.existing_idx] = len(b.pods)
+            assert list(native.e_npods) == list(want)

@@ -1,10 +1,13 @@
 """ctypes wrapper for the native FFD referee (native/ffd.cc).
 
 Same per-pod sequential semantics as solver/oracle.py (the reference's Go
-scheduler loop) over the new-node packing scope; runs the 50k-pod x
-700-type benchmark configs in about a second, so full-scale cost parity
-(BASELINE.md <=2% envelope) is asserted on every bench run instead of only
-on small regression fixtures.
+scheduler loop) over the full feature surface — new-node packing,
+existing bins with bound-pod seeds, per-pool allocatable ceilings, and
+hostname affinity classes; only strict custom keys over unknown-pool
+nodes stay Python-side. Runs the 50k-pod x 700-type benchmark configs in
+about a second, so full-scale cost parity (BASELINE.md <=2% envelope) is
+asserted against the native referee on every bench run for all five
+configs, not only on small regression fixtures.
 """
 
 from __future__ import annotations

@@ -378,6 +378,18 @@ class NodeClass:
 # NodeClaim lifecycle (core CRD + state machine)
 # ---------------------------------------------------------------------------
 
+@dataclass
+class Lease:
+    """A kube-node-lease Lease (coordination.k8s.io). The kubelet creates
+    one per node with an owner reference; orphaned leases (no owner, or an
+    owner that no longer exists) are garbage collected by the controller —
+    reference test/suites/integration/lease_garbagecollection_test.go."""
+
+    name: str
+    owner_node: Optional[str] = None
+    created_at: float = field(default_factory=time.time)
+
+
 class NodeClaimPhase(str, enum.Enum):
     PENDING = "Pending"         # created by scheduler, not yet launched
     LAUNCHED = "Launched"       # cloud capacity created (providerID set)

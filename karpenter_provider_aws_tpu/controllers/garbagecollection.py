@@ -77,3 +77,10 @@ class GarbageCollectionController:
                 self.cloud_provider.cloud.terminate_instances([inst.id])
             except NotFoundError:
                 pass
+        # orphaned node leases: no owner reference, or the owner node is
+        # gone (the kubelet that would heartbeat it no longer exists) —
+        # reference integration/lease_garbagecollection_test.go
+        for name in self.cluster.orphaned_leases():
+            self.recorder.publish("Normal", "LeaseGarbageCollected", "Lease",
+                                  name, "deleting orphaned node lease")
+            self.cluster.delete_lease(name)

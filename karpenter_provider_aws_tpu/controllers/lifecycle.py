@@ -14,7 +14,7 @@ from __future__ import annotations
 import itertools
 from typing import List, Optional
 
-from ..apis.objects import Node, NodeClaim, NodeClaimPhase
+from ..apis.objects import Lease, Node, NodeClaim, NodeClaimPhase
 from ..cloudprovider.cloudprovider import CloudProvider
 from ..errors import NotFoundError
 from ..events import Recorder
@@ -66,6 +66,9 @@ class LifecycleController:
             ready=True, created_at=self.clock.now(),
             node_pool=claim.node_pool, node_claim=claim.name)
         self.cluster.add_node(node)
+        # the (fake) kubelet creates the node's coordination lease
+        self.cluster.add_lease(Lease(name=node.name, owner_node=node.name,
+                                     created_at=self.clock.now()))
         for pod in self.cluster.nominated_pods(claim.name):
             self.cluster.bind_pod(pod.name, node.name)
         claim.phase = NodeClaimPhase.REGISTERED

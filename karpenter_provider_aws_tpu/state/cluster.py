@@ -49,6 +49,7 @@ class ClusterState:
         self.nodes: Dict[str, Node] = {}
         self.claims: Dict[str, NodeClaim] = {}
         self.pvcs: Dict[str, "PersistentVolumeClaim"] = {}
+        self.leases: Dict[str, "Lease"] = {}   # kube-node-lease mirror
         self.storage_classes: Dict[str, "StorageClass"] = {}
         self.pdbs: Dict[str, "PodDisruptionBudget"] = {}
         self._nominations: Dict[str, _Nomination] = {}   # pod -> claim
@@ -163,6 +164,24 @@ class ClusterState:
                     pod.node_name = None
                     out.append(pod)
             return out
+
+    # ---- node leases (kube-node-lease mirror) -----------------------------
+
+    def add_lease(self, lease) -> None:
+        with self._lock:
+            self.leases[lease.name] = lease
+
+    def delete_lease(self, name: str) -> None:
+        with self._lock:
+            self.leases.pop(name, None)
+
+    def orphaned_leases(self) -> List[str]:
+        """Leases with no owner reference, or whose owner node is gone —
+        the lease GC sweep's input (reference core GCs ownerless
+        kube-node-lease Leases; integration/lease_garbagecollection_test)."""
+        with self._lock:
+            return [l.name for l in self.leases.values()
+                    if l.owner_node is None or l.owner_node not in self.nodes]
 
     # ---- PodDisruptionBudgets ---------------------------------------------
 
@@ -481,6 +500,7 @@ class ClusterState:
             self.nodes.clear()
             self.claims.clear()
             self.pvcs.clear()
+            self.leases.clear()
             self.storage_classes.clear()
             self.pdbs.clear()
             self._nominations.clear()

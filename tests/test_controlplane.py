@@ -770,3 +770,25 @@ class TestIPv6:
         op = self._settled_env(lattice, pool=pool)
         lts = list(op.cloud.network.launch_templates.values())
         assert lts and all("fd00:1234::53" in lt.user_data for lt in lts)
+
+
+class TestLeaseGarbageCollection:
+    """Orphaned kube-node-lease Leases are GC'd (reference
+    test/suites/integration/lease_garbagecollection_test.go: a lease with
+    no OwnerReference is deleted); a live node's owned lease survives."""
+
+    def test_ownerless_and_orphaned_leases_collected(self, env):
+        from karpenter_provider_aws_tpu.apis.objects import Lease
+        for p in pods(2):
+            env.cluster.add_pod(p)
+        env.settle()
+        assert env.cluster.nodes
+        node_name = next(iter(env.cluster.nodes))
+        # registration created the node's owned lease
+        assert env.cluster.leases[node_name].owner_node == node_name
+        env.cluster.add_lease(Lease(name="bad-lease", owner_node=None))
+        env.cluster.add_lease(Lease(name="stale", owner_node="gone-node"))
+        env.gc.reconcile()
+        assert "bad-lease" not in env.cluster.leases
+        assert "stale" not in env.cluster.leases
+        assert node_name in env.cluster.leases  # live owner: kept

@@ -267,15 +267,27 @@ class DisruptionController:
         return total
 
     def _what_if(self, removed: Sequence[NodeClaim]) -> Tuple[NodePlan, float]:
-        """Solve the cluster with `removed` gone; returns (plan, removed $/hr)."""
+        """Solve the cluster with `removed` gone; returns (plan, removed $/hr).
+
+        A candidate's node can vanish between candidate selection and this
+        solve (interruption/GC run concurrently under the threaded
+        runtime). Vanished-node claims are filtered from the WHOLE
+        what-if — exclusion set, pod set, AND the removed price — with one
+        consistent snapshot: counting a gone claim's price while
+        re-placing none of its pods would over-credit the savings and
+        admit unprofitable disruptions."""
         self._whatif_used += 1
         lattice = masked_view(self.solver.lattice,
                               self.unavailable.mask(self.solver.lattice))
-        removed_nodes = {self.cluster.node_for_claim(c.name).name for c in removed}
-        pods = [p for c in removed for p in self._pods_on(c)]
+        node_by_claim = self.cluster.nodes_by_claim()
+        by_node = self.cluster.pods_by_node(include_daemonsets=False)
+        live = [c for c in removed if c.name in node_by_claim]
+        removed_nodes = {node_by_claim[c.name].name for c in live}
+        pods = [p for c in live
+                for p in by_node.get(node_by_claim[c.name].name, ())]
         existing = [b for b in self.cluster.existing_bins(lattice)
                     if b.name not in removed_nodes
-                    and b.name not in {c.name for c in removed}]
+                    and b.name not in {c.name for c in live}]
         bound = [bp for bp in self.cluster.bound_pods()
                  if bp.node_name not in removed_nodes]
         pvcs, storage_classes = self.cluster.volume_state()
@@ -283,7 +295,7 @@ class DisruptionController:
             pods, list(self.node_pools.values()), lattice,
             existing=existing, daemonset_pods=self.cluster.daemonset_pods(),
             bound_pods=bound, pvcs=pvcs, storage_classes=storage_classes)
-        return plan, self._removed_price(lattice, removed)
+        return plan, self._removed_price(lattice, live)
 
     def _probe_whatifs(self, removed_sets: Sequence[Sequence[NodeClaim]],
                        node_by_claim=None, by_node=None):

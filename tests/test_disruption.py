@@ -548,3 +548,25 @@ class TestHashVersionMigration:
         env.node_pools["default"].labels["rollme"] = "yes"
         env.disruption._reconcile_drift()
         assert any(a.reason == "Drifted" for a in env.disruption._in_flight)
+
+
+class TestWhatIfNodeVanishRace:
+    def test_what_if_survives_candidate_node_deletion(self, lattice):
+        """Soak-found race: a candidate's node can be deleted (interruption
+        / GC under the threaded runtime) between candidate selection and
+        the what-if solve — the vanished claim drops out of the whole
+        what-if (exclusions, pods, AND price), never crashing the solve
+        or over-crediting the savings."""
+        env = make_env(lattice)
+        for p in pods(4):
+            env.cluster.add_pod(p)
+        env.settle()
+        claim = next(iter(env.cluster.claims.values()))
+        node = env.cluster.node_for_claim(claim.name)
+        assert node is not None
+        env.cluster.evict_node(node.name)          # node gone, claim remains
+        plan, removed_cost = env.disruption._what_if([claim])
+        assert plan is not None                    # no AttributeError
+        # the gone claim contributes NO savings credit and no pods
+        assert removed_cost == 0.0
+        assert not plan.new_nodes

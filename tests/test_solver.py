@@ -737,3 +737,68 @@ class TestNativeOracleFuzzParity:
                 if b.is_existing:
                     want[b.existing_idx] = len(b.pods)
             assert list(native.e_npods) == list(want)
+
+
+class TestSolverFuzzEnvelope:
+    """Randomized metamorphic check of the DEVICE kernel itself: on random
+    problems from the full feature surface, the grouped-FFD pack must
+    place every placeable pod, produce a valid plan (capacity, masks), and
+    stay inside the ≤2% cost envelope vs the sequential FFD oracle
+    (SURVEY §7 hard part a: blockwise greedy must not lose pack quality)."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_problem_envelope(self, solver, lattice, seed):
+        from karpenter_provider_aws_tpu.apis.objects import (
+            KubeletSpec, PodAffinityTerm, TopologySpreadConstraint, Toleration,
+            Taint)
+        from karpenter_provider_aws_tpu.solver import ExistingBin, ffd_oracle
+
+        rng = np.random.default_rng(1000 + seed)
+        pools = [default_pool()]
+        if rng.random() < 0.4:
+            pools.append(NodePool(
+                name="tainted", weight=int(rng.integers(0, 20)),
+                taints=[Taint(key="team", value="x")]))
+        pods = []
+        for i in range(int(rng.integers(10, 60))):
+            app = f"a{int(rng.integers(3))}"
+            kw = {}
+            r = rng.random()
+            if r < 0.15:
+                kw["pod_affinity"] = [PodAffinityTerm(
+                    topology_key=wk.LABEL_HOSTNAME, anti=True,
+                    label_selector=(("app", app),))]
+            elif r < 0.3:
+                kw["topology_spread"] = [TopologySpreadConstraint(
+                    max_skew=1, topology_key=wk.LABEL_ZONE,
+                    label_selector=(("app", app),))]
+            elif r < 0.4:
+                kw["node_selector"] = {
+                    wk.LABEL_INSTANCE_CATEGORY: str(rng.choice(["m", "c"]))}
+            elif r < 0.45 and len(pools) > 1:
+                kw["node_selector"] = {}
+                kw["tolerations"] = [Toleration(key="team", value="x")]
+            pods.append(Pod(
+                name=f"p{i}", labels={"app": app},
+                requests={"cpu": f"{int(rng.choice([250, 500, 1000, 2000]))}m",
+                          "memory": f"{int(rng.choice([512, 1024, 4096]))}Mi"},
+                **kw))
+        existing = [ExistingBin(
+            name=f"n{e}", node_pool="default", instance_type="m5.2xlarge",
+            zone="us-west-2a", capacity_type="on-demand",
+            used=np.zeros(R, np.float32))
+            for e in range(int(rng.integers(0, 3)))]
+        problem = build_problem(pods, pools, lattice, existing=existing)
+        plan = solver.solve(problem)
+        # validity: every pod placed exactly once, nodes not overpacked
+        placed = sorted(p for n in plan.new_nodes for p in n.pods)
+        placed += sorted(p for v in plan.existing_assignments.values() for p in v)
+        assert sorted(placed + list(plan.unschedulable)) == \
+            sorted(p.name for p in pods)
+        assert_plan_valid(plan, problem)
+        # envelope: within 2% of the sequential oracle on total new cost,
+        # and never strands a pod the oracle can place
+        oracle = ffd_oracle(problem)
+        assert len(plan.unschedulable) <= len(oracle.unschedulable)
+        if oracle.new_node_cost > 0:
+            assert plan.new_node_cost <= oracle.new_node_cost * 1.02 + 1e-6

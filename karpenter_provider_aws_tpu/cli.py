@@ -44,6 +44,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="VM memory overhead subtracted from every instance "
                         "type's memory (env VM_MEMORY_OVERHEAD_PERCENT, "
                         "default 0.075).")
+    p.add_argument("--isolated-vpc", action="store_true", default=None,
+                   help="Assume AWS services without a VPC endpoint are "
+                        "unreachable; live on-demand pricing lookups are "
+                        "skipped and static prices serve (ISOLATED_VPC)")
     p.add_argument("--reserved-enis", type=int, default=None,
                    help="ENIs excluded from max-pods math "
                         "(env RESERVED_ENIS).")
@@ -110,6 +114,8 @@ def options_from_args(args: argparse.Namespace) -> Options:
         overrides["vm_memory_overhead_percent"] = args.vm_memory_overhead_percent
     if args.reserved_enis is not None:
         overrides["reserved_enis"] = args.reserved_enis
+    if args.isolated_vpc:
+        overrides["isolated_vpc"] = True
     if args.batch_idle_duration is not None:
         overrides["batch_idle_duration"] = args.batch_idle_duration
     if args.batch_max_duration is not None:

@@ -94,7 +94,9 @@ class Operator:
             self.cloud, self.security_group_provider, self.instance_profile_provider,
             self.ami_provider, self.clock, cluster_name=self.options.cluster_name)
         self.version_provider = VersionProvider(self.cloud, self.clock)
-        self.pricing_provider = PricingProvider(self.lattice, self.clock)
+        self.pricing_provider = PricingProvider(
+            self.lattice, self.clock,
+            isolated_vpc=self.options.isolated_vpc)
         from ..cloudprovider.decorator import decorate
         self.cloud_provider = decorate(CloudProvider(
             self.lattice, self.cloud, self.unavailable, self.recorder, self.clock,

@@ -38,6 +38,10 @@ class Options:
     # VM_MEMORY_OVERHEAD_PERCENT, default 0.075)
     vm_memory_overhead_percent: float = 0.075
     reserved_enis: int = 0
+    # assume AWS services without a VPC endpoint are unreachable: live
+    # pricing lookups are skipped and the compiled-in static prices serve
+    # (reference options.go:53 ISOLATED_VPC; pricing.go:150-163)
+    isolated_vpc: bool = False
     # pending-pod batch window (settings.md:17-18)
     batch_idle_duration: float = 1.0
     batch_max_duration: float = 10.0
@@ -70,6 +74,7 @@ class Options:
             cluster_name=_env("CLUSTER_NAME", "sim", str),
             vm_memory_overhead_percent=_env("VM_MEMORY_OVERHEAD_PERCENT", 0.075, float),
             reserved_enis=_env("RESERVED_ENIS", 0, int),
+            isolated_vpc=_env_bool("ISOLATED_VPC", False),
             batch_idle_duration=_env("BATCH_IDLE_DURATION", 1.0, float),
             batch_max_duration=_env("BATCH_MAX_DURATION", 10.0, float),
             interruption_queue=_env("INTERRUPTION_QUEUE", "", str),

@@ -23,9 +23,13 @@ PRICING_REFRESH_SECONDS = 12 * 3600.0  # 12h loop (pricing controller.go:56)
 
 
 class PricingProvider:
-    def __init__(self, lattice: Lattice, clock: Optional[Clock] = None):
+    def __init__(self, lattice: Lattice, clock: Optional[Clock] = None,
+                 isolated_vpc: bool = False):
         self.lattice = lattice
         self.clock = clock or Clock()
+        # isolated VPC: the Pricing API has no VPC endpoint, so live OD
+        # lookups are skipped and static prices serve (options.go:53)
+        self.isolated_vpc = isolated_vpc
         self._lock = threading.Lock()
         # static fallback = the catalog prices compiled into the lattice
         self._static = lattice.price.copy()
@@ -58,6 +62,10 @@ class PricingProvider:
 
     def update_on_demand_pricing(self, prices: Dict[str, float]) -> int:
         """Overlay live OD prices (the 12h Pricing-API fetch)."""
+        if self.isolated_vpc:
+            # the Pricing API has no VPC endpoint: static prices serve
+            # (reference pricing.go:150-163)
+            return 0
         with self._lock:
             self._od_overrides.update(prices)
             self.last_update = self.clock.now()
@@ -65,7 +73,9 @@ class PricingProvider:
         return len(prices)
 
     def update_spot_pricing(self, prices: Dict[Tuple[str, str], float]) -> int:
-        """Overlay live per-zone spot prices (DescribeSpotPriceHistory)."""
+        """Overlay live per-zone spot prices (DescribeSpotPriceHistory —
+        an EC2 API with a VPC endpoint, so isolated VPCs still get it,
+        reference pricing.go:348-391 UpdateSpotPricing has no gate)."""
         with self._lock:
             self._spot_overrides.update(prices)
             self.last_update = self.clock.now()

@@ -522,3 +522,24 @@ class TestPricingControllerCadence:
         clock.step(PRICING_REFRESH_SECONDS)
         assert c.reconcile()           # past the window
         assert lattice.price_version > v1
+
+
+class TestIsolatedVPC:
+    def test_od_overlay_skipped_but_spot_applies(self, lattice):
+        """ISOLATED_VPC: the Pricing API (no VPC endpoint) is never
+        consulted — OD overlays are dropped and static prices serve —
+        while spot prices (DescribeSpotPriceHistory, an EC2 API with a
+        VPC endpoint) still update (reference pricing.go:150-163)."""
+        p = PricingProvider(lattice, FakeClock(), isolated_vpc=True)
+        base = p.on_demand_price("m5.large")
+        assert p.update_on_demand_pricing({"m5.large": 99.0}) == 0
+        assert p.on_demand_price("m5.large") == base
+        zone = lattice.zones[0]
+        assert p.update_spot_pricing({("m5.large", zone): 0.011}) == 1
+        assert p.spot_price("m5.large", zone) == pytest.approx(0.011)
+        p.reset()
+
+    def test_option_env_layer(self, monkeypatch):
+        from karpenter_provider_aws_tpu.operator.options import Options
+        monkeypatch.setenv("ISOLATED_VPC", "true")
+        assert Options.from_env().isolated_vpc

@@ -68,6 +68,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--feature-gates", default=None,
                    help="Comma-separated gates, e.g. "
                         "'Drift=true,SpotToSpotConsolidation=false'.")
+    p.add_argument("--log-level", default="INFO",
+                   choices=("DEBUG", "INFO", "WARNING", "ERROR"),
+                   help="Structured log verbosity (key=value lines on the "
+                        "karpenter.* loggers)")
     p.add_argument("--metrics-port", type=int, default=8000,
                    help="Port serving /metrics, /healthz, /readyz "
                         "(0 disables).")
@@ -181,6 +185,8 @@ def start_server(op: Operator, port: int) -> ThreadingHTTPServer:
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    from .utils.logging import configure as configure_logging
+    configure_logging(args.log_level)
     opts = options_from_args(args)
     op = Operator(options=opts)
 

@@ -73,6 +73,14 @@ class Operator:
         self.cloud = cloud or FakeCloud(self.clock, cluster_name=self.options.cluster_name)
         # connectivity probe before anything else (operator.go:115-117)
         self.cloud.list_instances()
+        from ..utils.logging import get_logger
+        self.log = get_logger("operator")
+        # startup discovery, logged once (the reference logs kube-dns and
+        # endpoint discovery at operator build, operator.go:125-132)
+        self.log.info("discovered cluster network",
+                      endpoint=self.cloud.network.cluster_endpoint,
+                      kube_dns=self.cloud.network.kube_dns_ip,
+                      zones=self.lattice.Z, instance_types=self.lattice.T)
         self.recorder = Recorder(self.clock)
         self.metrics = Registry()
         wire_core_metrics(self.metrics)

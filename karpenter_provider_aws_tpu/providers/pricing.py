@@ -18,6 +18,7 @@ import numpy as np
 from ..lattice import catalog as cat
 from ..lattice.tensors import Lattice
 from ..utils.clock import Clock
+from ..utils.logging import ChangeMonitor, get_logger
 
 PRICING_REFRESH_SECONDS = 12 * 3600.0  # 12h loop (pricing controller.go:56)
 
@@ -31,6 +32,10 @@ class PricingProvider:
         # lookups are skipped and static prices serve (options.go:53)
         self.isolated_vpc = isolated_vpc
         self._lock = threading.Lock()
+        self._log = get_logger("pricing")
+        # log-on-delta (reference instancetype.go:150-152 idiom): a 12h
+        # refresh loop re-asserting identical prices stays quiet
+        self._monitor = ChangeMonitor(self.clock)
         # static fallback = the catalog prices compiled into the lattice
         self._static = lattice.price.copy()
         self._od_overrides: Dict[str, float] = {}                  # type -> $/hr
@@ -65,11 +70,18 @@ class PricingProvider:
         if self.isolated_vpc:
             # the Pricing API has no VPC endpoint: static prices serve
             # (reference pricing.go:150-163)
+            if self._monitor.has_changed("isolated-od", True):
+                self._log.debug("isolated VPC: on-demand pricing not updated")
             return 0
         with self._lock:
             self._od_overrides.update(prices)
             self.last_update = self.clock.now()
+            # gate on the RESULTING overlay state, not the call payload:
+            # partial re-sends of already-effective prices stay quiet
+            state = tuple(sorted(self._od_overrides.items()))
         self._rebuild()
+        if self._monitor.has_changed("od-prices", state):
+            self._log.info("updated on-demand pricing", entries=len(state))
         return len(prices)
 
     def update_spot_pricing(self, prices: Dict[Tuple[str, str], float]) -> int:
@@ -79,7 +91,10 @@ class PricingProvider:
         with self._lock:
             self._spot_overrides.update(prices)
             self.last_update = self.clock.now()
+            state = tuple(sorted(self._spot_overrides.items()))
         self._rebuild()
+        if self._monitor.has_changed("spot-prices", state):
+            self._log.info("updated spot pricing", entries=len(state))
         return len(prices)
 
     def _rebuild(self) -> None:
@@ -121,6 +136,9 @@ class PricingProvider:
             self._od_overrides.clear()
             self._spot_overrides.clear()
             self.last_update = None
+        # re-arm the log-on-delta gates: updates re-applied after a state
+        # wipe are real changes and must leave an audit line
+        self._monitor = ChangeMonitor(self.clock)
         self.lattice.price[...] = self._static
         self.lattice.price_version += 1
 

@@ -49,3 +49,30 @@ def test_format_roundtrip_binary():
     assert format_quantity(2**30) == "1Gi"
     assert format_quantity(512 * 2**20) == "512Mi"
     assert format_quantity(5) == "5"
+
+
+class TestLogging:
+    def test_change_monitor_logs_on_delta_only(self):
+        from karpenter_provider_aws_tpu.utils.clock import FakeClock
+        from karpenter_provider_aws_tpu.utils.logging import ChangeMonitor
+        clock = FakeClock()
+        m = ChangeMonitor(clock, ttl=100.0)
+        assert m.has_changed("k", 1)        # first observation
+        assert not m.has_changed("k", 1)    # steady state: quiet
+        assert m.has_changed("k", 2)        # delta
+        assert not m.has_changed("k", 2)
+        clock.step(101.0)
+        assert m.has_changed("k", 2)        # TTL re-asserts the fact
+
+    def test_structured_logger_formats_kv(self, capsys):
+        import logging as _logging
+        from karpenter_provider_aws_tpu.utils import logging as klog
+        klog.configure("DEBUG")
+        log = klog.get_logger("test")
+        handler = _logging.getLogger("karpenter").handlers[0]
+        record = _logging.LogRecord("karpenter.test", _logging.INFO, "", 0,
+                                    "hello", (), None)
+        record.kv = {"b": 2, "a": 1}
+        line = handler.format(record)
+        assert line.endswith("hello a=1 b=2")
+        assert "INFO" in line

@@ -275,6 +275,10 @@ def wire_core_metrics(reg: Registry) -> Dict[str, _Metric]:
         "interruption_actions": reg.counter(
             "karpenter_interruption_actions_performed_total",
             "Node drain actions taken for interruption messages.", ("action",)),
+        "cluster_state_synced": reg.gauge(
+            "karpenter_cluster_state_synced",
+            "1 when cluster state has synced with the cloud (reference "
+            "metrics.md:152: readiness of the state mirror).", ()),
         "cluster_state_node_count": reg.gauge(
             "karpenter_cluster_state_node_count", "Nodes tracked by cluster state.", ()),
         "cluster_state_pod_count": reg.gauge(

@@ -14,7 +14,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
-from ..apis.objects import NodeClass, NodePool
+from ..apis.objects import NodeClaimPhase, NodeClass, NodePool
 from ..cache.unavailable import UnavailableOfferings
 from ..cloud.fake import FakeCloud
 from ..cloudprovider.cloudprovider import CloudProvider
@@ -179,6 +179,23 @@ class Operator:
     def emit_gauges(self) -> None:
         """Refresh the state + offering gauge surfaces (run_once calls this
         every pass; the async runtime registers it as its own controller)."""
+        # synced = the mirror is internally consistent: every registered
+        # claim has its node and every node's owning claim exists (the
+        # core's karpenter_cluster_state_synced reports state-hydration
+        # readiness; it is NOT a cloud poll — the GC controller owns
+        # cloud reconciliation). Locked snapshots: the async runtime runs
+        # this in its own thread against live mutation.
+        claims = {c.name: c for c in self.cluster.snapshot_claims()}
+        nodes = self.cluster.snapshot_nodes()
+        synced = all(n.node_claim is None or n.node_claim in claims
+                     for n in nodes)
+        if synced:
+            with_node = {n.node_claim for n in nodes if n.node_claim}
+            synced = all(c.name in with_node for c in claims.values()
+                         if c.phase in (NodeClaimPhase.REGISTERED,
+                                        NodeClaimPhase.INITIALIZED)
+                         and not c.deletion_timestamp)
+        self.metrics.gauge("karpenter_cluster_state_synced").set(1.0 if synced else 0.0)
         self.metrics.gauge("karpenter_cluster_state_node_count").set(len(self.cluster.nodes))
         self.metrics.gauge("karpenter_cluster_state_pod_count").set(len(self.cluster.pods))
         self.metrics.gauge("karpenter_ice_cache_size").set(

@@ -76,12 +76,15 @@ class PricingProvider:
         with self._lock:
             self._od_overrides.update(prices)
             self.last_update = self.clock.now()
-            # gate on the RESULTING overlay state, not the call payload:
-            # partial re-sends of already-effective prices stay quiet
-            state = tuple(sorted(self._od_overrides.items()))
+            # gate on the RESULTING overlay state, not the call payload
+            # (partial re-sends of effective prices stay quiet) — decided
+            # under the lock so concurrent updates can't log stale state
+            changed = self._monitor.has_changed(
+                "od-prices", tuple(sorted(self._od_overrides.items())))
+            n = len(self._od_overrides)
         self._rebuild()
-        if self._monitor.has_changed("od-prices", state):
-            self._log.info("updated on-demand pricing", entries=len(state))
+        if changed:
+            self._log.info("updated on-demand pricing", entries=n)
         return len(prices)
 
     def update_spot_pricing(self, prices: Dict[Tuple[str, str], float]) -> int:
@@ -91,10 +94,12 @@ class PricingProvider:
         with self._lock:
             self._spot_overrides.update(prices)
             self.last_update = self.clock.now()
-            state = tuple(sorted(self._spot_overrides.items()))
+            changed = self._monitor.has_changed(
+                "spot-prices", tuple(sorted(self._spot_overrides.items())))
+            n = len(self._spot_overrides)
         self._rebuild()
-        if self._monitor.has_changed("spot-prices", state):
-            self._log.info("updated spot pricing", entries=len(state))
+        if changed:
+            self._log.info("updated spot pricing", entries=n)
         return len(prices)
 
     def _rebuild(self) -> None:
@@ -136,9 +141,10 @@ class PricingProvider:
             self._od_overrides.clear()
             self._spot_overrides.clear()
             self.last_update = None
-        # re-arm the log-on-delta gates: updates re-applied after a state
-        # wipe are real changes and must leave an audit line
-        self._monitor = ChangeMonitor(self.clock)
+            # re-arm the log-on-delta gates (under the lock — an in-flight
+            # update must not race the swap): post-wipe re-applications
+            # are real changes and must leave an audit line
+            self._monitor = ChangeMonitor(self.clock)
         self.lattice.price[...] = self._static
         self.lattice.price_version += 1
 

@@ -355,6 +355,10 @@ class ClusterState:
         with self._lock:
             return list(self.pods.values())
 
+    def snapshot_nodes(self) -> List[Node]:
+        with self._lock:
+            return list(self.nodes.values())
+
     def nodes_by_claim(self) -> Dict[str, Node]:
         """Snapshot index claim name -> node (one pass instead of an
         O(nodes) node_for_claim scan per claim)."""

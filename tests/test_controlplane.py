@@ -792,3 +792,19 @@ class TestLeaseGarbageCollection:
         assert "bad-lease" not in env.cluster.leases
         assert "stale" not in env.cluster.leases
         assert node_name in env.cluster.leases  # live owner: kept
+
+
+class TestClusterStateSynced:
+    def test_synced_gauge_tracks_cloud_agreement(self, env):
+        for p in pods(2):
+            env.cluster.add_pod(p)
+        env.settle()
+        env.emit_gauges()
+        assert env.metrics.gauge("karpenter_cluster_state_synced").value() == 1.0
+        # a registered claim whose node vanished from the mirror = not
+        # synced until the state machine converges (GC/lifecycle)
+        claim = next(c for c in env.cluster.claims.values()
+                     if env.cluster.node_for_claim(c.name) is not None)
+        env.cluster.evict_node(env.cluster.node_for_claim(claim.name).name)
+        env.emit_gauges()
+        assert env.metrics.gauge("karpenter_cluster_state_synced").value() == 0.0

@@ -378,6 +378,24 @@ class NodeClass:
 # NodeClaim lifecycle (core CRD + state machine)
 # ---------------------------------------------------------------------------
 
+# the Windows Server 2022 EKS-optimized AMI's build number — the value of
+# the well-known node.kubernetes.io/windows-build label every node of a
+# windows pool carries (reference labels.go registers v1.LabelWindowsBuild)
+WINDOWS_BUILD = "10.0.20348"
+
+
+def pool_os(pool: "NodePool") -> str:
+    """The OS every node of this pool boots (its AMI family's OS,
+    surfaced through the pool's os requirement OR its template label —
+    scheduling_requirements() folds both). Admission validates the
+    requirement to a single-valued In; unvalidated multi-value input
+    resolves deterministically (first sorted value). Default: linux."""
+    c = pool.scheduling_requirements().get(wellknown.LABEL_OS)
+    if c.include:
+        return sorted(c.include)[0]
+    return "linux"
+
+
 @dataclass
 class Lease:
     """A kube-node-lease Lease (coordination.k8s.io). The kubelet creates

@@ -24,6 +24,7 @@ LABEL_REGION = "topology.kubernetes.io/region"
 LABEL_INSTANCE_TYPE = "node.kubernetes.io/instance-type"
 LABEL_ARCH = "kubernetes.io/arch"                            # amd64 | arm64
 LABEL_OS = "kubernetes.io/os"                                # linux | windows
+LABEL_WINDOWS_BUILD = "node.kubernetes.io/windows-build"     # e.g. 10.0.20348
 LABEL_HOSTNAME = "kubernetes.io/hostname"
 
 # Provider instance-description keys (reference labels.go:27-50)
@@ -84,6 +85,9 @@ WELL_KNOWN_KEYS = frozenset({
     LABEL_INSTANCE_GPU_MANUFACTURER, LABEL_INSTANCE_GPU_COUNT,
     LABEL_INSTANCE_GPU_MEMORY, LABEL_INSTANCE_ACCELERATOR_NAME,
     LABEL_INSTANCE_ACCELERATOR_MANUFACTURER, LABEL_INSTANCE_ACCELERATOR_COUNT,
+    # registered like the reference's v1.LabelWindowsBuild (labels.go:48):
+    # resolved per pool — every windows pool's nodes carry the build
+    LABEL_WINDOWS_BUILD,
 })
 
 NUMERIC_KEYS = frozenset({
@@ -103,7 +107,6 @@ NUMERIC_KEYS = frozenset({
 DEVICE_CATEGORICAL_KEYS = (
     LABEL_INSTANCE_TYPE,
     LABEL_ARCH,
-    LABEL_OS,
     LABEL_INSTANCE_CATEGORY,
     LABEL_INSTANCE_FAMILY,
     LABEL_INSTANCE_SIZE,

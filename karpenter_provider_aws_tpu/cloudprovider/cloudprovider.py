@@ -33,7 +33,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..apis import wellknown as wk
-from ..apis.objects import NodeClaim, NodeClaimPhase, NodeClass, NodePool
+from ..apis.objects import (WINDOWS_BUILD, NodeClaim, NodeClaimPhase,
+                            NodeClass, NodePool)
 from ..apis.requirements import Requirements
 from ..apis.resources import vec_to_resources
 from ..batcher import Batcher, BatcherOptions
@@ -281,6 +282,12 @@ class CloudProvider:
             wk.LABEL_CAPACITY_TYPE: instance.capacity_type,
             wk.LABEL_NODEPOOL: claim.node_pool,
         }
+        if claim.labels.get(wk.LABEL_OS) == "windows":
+            # every windows node carries the AMI's build (well-known
+            # node.kubernetes.io/windows-build, reference labels.go
+            # v1.LabelWindowsBuild) — keyed on the claim's resolved OS so
+            # the stamp can never diverge from what the solver advertised
+            claim.labels.setdefault(wk.LABEL_WINDOWS_BUILD, WINDOWS_BUILD)
         nc = self.node_classes.get(claim.node_class_ref)
         if nc is not None:
             claim.annotations[wk.ANNOTATION_NODECLASS_HASH] = nodeclass_hash(nc)

@@ -411,6 +411,11 @@ class Provisioner:
                 labels[r.key] = f"kpat-{name}"
             elif r.operator == Operator.IN and r.values:
                 labels[r.key] = sorted(r.values)[0]
+        # the node's OS label comes from the pool's resolved OS (the AMI
+        # family's, pool_os — the same resolution build_problem pins the
+        # pool's constraint to, so label and schedulability always agree)
+        from ..apis.objects import pool_os
+        labels.setdefault(wk.LABEL_OS, pool_os(pool))
         claim = NodeClaim(
             name=name, node_pool=node.node_pool,
             requirements=reqs, resource_requests=requests,

@@ -36,7 +36,6 @@ def type_labels(spec: cat.InstanceTypeSpec) -> Dict[str, str]:
     labels = {
         wk.LABEL_INSTANCE_TYPE: spec.name,
         wk.LABEL_ARCH: spec.arch,
-        wk.LABEL_OS: "linux",
         wk.LABEL_REGION: cat.REGION,
         wk.LABEL_INSTANCE_CATEGORY: spec.category,
         wk.LABEL_INSTANCE_FAMILY: spec.family,

@@ -90,6 +90,20 @@ class Operator:
         self.unavailable = UnavailableOfferings(self.clock)
         self.cluster = ClusterState(self.clock)
         self.node_pools: Dict[str, NodePool] = {p.name: p for p in (node_pools or [NodePool(name="default")])}
+        # a pool's OS is its NodeClass AMI family's: reject wiring where
+        # the two disagree (the solver would otherwise schedule pods the
+        # booted AMI can never run)
+        from ..apis.objects import pool_os
+        for p in self.node_pools.values():
+            nc = self.node_classes.get(p.node_class_ref)
+            if nc is None:
+                continue
+            family_os = "windows" if nc.ami_family == "Windows" else "linux"
+            if pool_os(p) != family_os:
+                raise ValueError(
+                    f"NodePool/{p.name}: os requirement {pool_os(p)!r} "
+                    f"contradicts NodeClass/{nc.name} amiFamily "
+                    f"{nc.ami_family!r} ({family_os})")
         # domain providers (reference operator.go:135-178 builds all 11)
         self.subnet_provider = SubnetProvider(self.cloud, self.clock,
             cluster_name=self.options.cluster_name)

@@ -28,7 +28,10 @@ from ..apis.requirements import Constraint, Requirements, _num
 from ..lattice.tensors import Lattice
 
 # keys that live on dedicated axes rather than the type axis
-_AXIS_KEYS = frozenset({wk.LABEL_ZONE, wk.LABEL_CAPACITY_TYPE, wk.LABEL_NODEPOOL, wk.LABEL_HOSTNAME})
+# structural keys resolved off the type lattice: offering axes, bin/pool
+# identity, and the pool-level OS (the AMI family's, not the type's)
+_AXIS_KEYS = frozenset({wk.LABEL_ZONE, wk.LABEL_CAPACITY_TYPE,
+                        wk.LABEL_NODEPOOL, wk.LABEL_HOSTNAME, wk.LABEL_OS})
 
 _CAT_KEY_INDEX = {k: i for i, k in enumerate(wk.DEVICE_CATEGORICAL_KEYS)}
 _NUM_KEY_INDEX = {k: i for i, k in enumerate(wk.DEVICE_NUMERIC_KEYS)}
@@ -103,6 +106,12 @@ def compile_masks(reqs: Requirements, lattice: Lattice,
             cap_mask &= np.array([c.matches(ct) for ct in lattice.capacity_types], dtype=bool)
         elif key in (wk.LABEL_NODEPOOL, wk.LABEL_HOSTNAME):
             continue  # dedicated structural axes (bin identity / pool choice)
+        elif key == wk.LABEL_OS:
+            # the OS comes from the pool's AMI family, not the instance
+            # type (any EC2 type runs either OS): enforced pool-vs-pod via
+            # the requirements algebra in build_problem, with an implicit
+            # linux default on pools that don't constrain it
+            continue
         elif key == wk.LABEL_REGION:
             region = lattice.labels[0].get(wk.LABEL_REGION, "") if lattice.labels else ""
             if not c.matches(region):

@@ -34,8 +34,9 @@ from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 import numpy as np
 
 from ..apis import wellknown as wk
-from ..apis.objects import IN_TREE_PROVISIONERS, NodePool, Pod, tolerates_all
-from ..apis.requirements import Requirements
+from ..apis.objects import (IN_TREE_PROVISIONERS, WINDOWS_BUILD, NodePool,
+                            Pod, pool_os, tolerates_all)
+from ..apis.requirements import Operator, Requirement, Requirements
 from ..apis.resources import R, axis as res_axis, resources_to_vec_checked
 from ..lattice.tensors import Lattice
 from ..ops.masks import _AXIS_KEYS, _CAT_KEY_INDEX, _NUM_KEY_INDEX, compile_masks
@@ -645,6 +646,7 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
     ds_overhead = np.zeros((NP, R), dtype=np.float32)
     np_alloc_cap = np.full((NP, R), np.inf, dtype=np.float32)
     pool_reqs: List[Requirements] = []
+    pool_eff_labels: List[Mapping[str, str]] = []
     for pi, pool in enumerate(pools):
         if pool.kubelet is not None and pool.kubelet.max_pods is not None:
             # kubelet maxPods caps the pods axis of every node the pool
@@ -652,11 +654,27 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
             # nodepools CRD spec.template.spec.kubelet)
             np_alloc_cap[pi, res_axis("pods")] = float(pool.kubelet.max_pods)
         reqs = pool.scheduling_requirements()
+        # nodes of a pool boot ONE concrete OS (the AMI family's;
+        # pool_os resolves it, default linux) — pin the pool's os
+        # constraint to exactly that value so pod-vs-pool compatibility
+        # and the launched node's label can never disagree, whatever
+        # shape the user's os requirement took
+        p_os = pool_os(pool)
+        reqs = reqs.merge(Requirements(
+            [Requirement(wk.LABEL_OS, Operator.IN, (p_os,))]))
         pool_reqs.append(reqs)
         # a pool's OWN value-free custom-key requirements (Exists / In on
         # user keys) are label templates its nodes will carry — never
         # lattice constraints; they must not zero the pool's masks
-        m = compile_masks(reqs, lattice, extra_labels=pool.labels,
+        # effective template labels: every windows node carries the
+        # build label (cloudprovider.create stamps it), so pods selecting
+        # on it resolve against this pool like any template label —
+        # WITHOUT mutating the user's NodePool object
+        eff = pool.labels
+        if p_os == "windows" and wk.LABEL_WINDOWS_BUILD not in eff:
+            eff = {**eff, wk.LABEL_WINDOWS_BUILD: WINDOWS_BUILD}
+        pool_eff_labels.append(eff)
+        m = compile_masks(reqs, lattice, extra_labels=eff,
                           skip_unresolved_custom=True)
         np_type[pi], np_zone[pi], np_cap[pi] = m.type_mask, m.zone_mask, m.cap_mask
         if pool_headroom is not None:
@@ -687,7 +705,7 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
             ds_reqs = ds.hard_scheduling_requirements()
             if not ds_reqs.compatible_with(reqs):
                 continue
-            if not _custom_keys_ok(ds_reqs, pool.labels):
+            if not _custom_keys_ok(ds_reqs, pool_eff_labels[pi]):
                 continue
             vec, unknown = resources_to_vec_checked(ds.requests, implicit_pod=True)
             if unknown:
@@ -727,7 +745,7 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
             # required to tolerate these taints to be considered")
             if not tolerates_all(rep.tolerations, pool.taints):
                 continue
-            if not _custom_keys_ok(reqs, pool.labels):
+            if not _custom_keys_ok(reqs, pool_eff_labels[pi]):
                 continue
             merged = reqs.merge(pool_reqs[pi])
             if not merged.min_values_satisfied(key_values):
@@ -737,6 +755,13 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
             _is_custom_key(key) and not reqs.get(key).allows_absent
             for key in reqs.keys()
         )
+        # unknown-pool existing bins (their NodePool is gone) are treated
+        # as linux, the sim's universal default: a group whose os
+        # constraint excludes linux must stay off them exactly like a
+        # strict custom key (known-pool bins resolve os through np_ok)
+        if wk.LABEL_OS in reqs.keys() \
+                and not reqs.get(wk.LABEL_OS).matches("linux"):
+            strict = True
 
         zone_mask_eff = masks.zone_mask
         if rep.volume_claims:

@@ -49,6 +49,14 @@ def validate_node_pool(pool: NodePool) -> List[str]:
             errs.append(f"requirement on restricted key {r.key!r}")
         if r.min_values is not None and r.min_values < 1:
             errs.append(f"minValues must be >= 1 (key {r.key})")
+        if r.key == wk.LABEL_OS:
+            # a pool's nodes boot ONE OS (the AMI family's): the os
+            # requirement must name exactly one of linux|windows
+            if (r.operator != Operator.IN or len(r.values) != 1
+                    or r.values[0] not in ("linux", "windows")):
+                errs.append("the os requirement must be a single-valued In "
+                            "over linux|windows (a pool's nodes boot one "
+                            f"OS), got {r.operator.value} {r.values}")
     for key in pool.limits:
         if key not in RESOURCE_AXES:
             errs.append(f"unknown limit resource {key!r}")

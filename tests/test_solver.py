@@ -802,3 +802,98 @@ class TestSolverFuzzEnvelope:
         assert len(plan.unschedulable) <= len(oracle.unschedulable)
         if oracle.new_node_cost > 0:
             assert plan.new_node_cost <= oracle.new_node_cost * 1.02 + 1e-6
+
+
+class TestOSScheduling:
+    """kubernetes.io/os is the POOL's property (its AMI family's OS), not
+    the instance type's — any EC2 type runs either OS. A windows-selecting
+    pod schedules only on a pool whose requirements say windows; pools
+    without an os requirement default to linux (reference labels.go
+    registers the os well-known label; the AMI family determines it)."""
+
+    def test_os_routes_to_matching_pool(self, solver, lattice):
+        win = NodePool(name="win", requirements=[
+            Requirement(wk.LABEL_OS, Operator.IN, ("windows",))])
+        lin = default_pool()
+        wpod = Pod(name="w0", requests={"cpu": "1", "memory": "2Gi"},
+                   node_selector={wk.LABEL_OS: "windows"})
+        lpod = Pod(name="l0", requests={"cpu": "1", "memory": "2Gi"},
+                   node_selector={wk.LABEL_OS: "linux"})
+        plan = solver.solve(build_problem([wpod, lpod], [win, lin], lattice))
+        assert not plan.unschedulable
+        by_pool = {n.node_pool: n.pods for n in plan.new_nodes}
+        assert by_pool["win"] == ["w0"]
+        assert by_pool["default"] == ["l0"]
+
+    def test_windows_pod_never_lands_on_default_pool(self, solver, lattice):
+        wpod = Pod(name="w0", requests={"cpu": "1", "memory": "2Gi"},
+                   node_selector={wk.LABEL_OS: "windows"})
+        plan = solver.solve(build_problem([wpod], [default_pool()], lattice))
+        assert "w0" in plan.unschedulable
+
+    def test_unselective_pod_lands_anywhere(self, solver, lattice):
+        pod = Pod(name="p0", requests={"cpu": "1", "memory": "2Gi"})
+        win = NodePool(name="win", weight=10, requirements=[
+            Requirement(wk.LABEL_OS, Operator.IN, ("windows",))])
+        plan = solver.solve(build_problem([pod], [win, default_pool()],
+                                          lattice))
+        assert not plan.unschedulable  # os-agnostic pods run on either
+
+    def test_multi_valued_os_pool_pins_one_os(self, solver, lattice):
+        """A (rejected-by-admission but defensively handled) multi-valued
+        os requirement resolves to ONE concrete OS, consistently between
+        scheduling and the launched node's label."""
+        from karpenter_provider_aws_tpu.apis.objects import pool_os
+        pool = NodePool(name="both", requirements=[
+            Requirement(wk.LABEL_OS, Operator.IN, ("windows", "linux"))])
+        assert pool_os(pool) == "linux"  # deterministic: first sorted
+        wpod = Pod(name="w0", requests={"cpu": "1", "memory": "2Gi"},
+                   node_selector={wk.LABEL_OS: "windows"})
+        plan = solver.solve(build_problem([wpod], [pool], lattice))
+        # the pool's nodes ARE linux; the windows pod must not land there
+        assert "w0" in plan.unschedulable
+
+    def test_windows_build_label_selectable(self, solver, lattice):
+        """Pods may select the well-known windows-build label: every node
+        of a windows pool carries it (implied template label)."""
+        from karpenter_provider_aws_tpu.apis.objects import WINDOWS_BUILD
+        win = NodePool(name="win", requirements=[
+            Requirement(wk.LABEL_OS, Operator.IN, ("windows",))])
+        pod = Pod(name="b0", requests={"cpu": "1", "memory": "2Gi"},
+                  node_selector={wk.LABEL_OS: "windows",
+                                 wk.LABEL_WINDOWS_BUILD: WINDOWS_BUILD})
+        plan = solver.solve(build_problem([pod], [win, default_pool()],
+                                          lattice))
+        assert not plan.unschedulable
+        assert plan.new_nodes[0].node_pool == "win"
+
+    def test_windows_group_avoids_unknown_pool_bins(self, solver, lattice):
+        """Existing bins whose pool is unknown are treated as linux: a
+        windows-selecting group must not join them."""
+        from karpenter_provider_aws_tpu.solver import ExistingBin
+        existing = [ExistingBin(
+            name="orphan", node_pool="deleted-pool",
+            instance_type="m5.4xlarge", zone="us-west-2a",
+            capacity_type="on-demand", used=np.zeros(R, np.float32))]
+        win = NodePool(name="win", requirements=[
+            Requirement(wk.LABEL_OS, Operator.IN, ("windows",))])
+        wpod = Pod(name="w0", requests={"cpu": "1", "memory": "2Gi"},
+                   node_selector={wk.LABEL_OS: "windows"})
+        plan = solver.solve(build_problem([wpod], [win], lattice,
+                                          existing=existing))
+        assert not plan.unschedulable
+        assert not plan.existing_assignments  # NOT on the orphaned bin
+        assert plan.new_nodes and plan.new_nodes[0].node_pool == "win"
+
+    def test_pool_os_from_template_label(self, solver, lattice):
+        """A pool declaring windows via its template LABEL (not a
+        requirement) resolves identically — scheduling_requirements folds
+        labels in, so label and requirement forms agree."""
+        from karpenter_provider_aws_tpu.apis.objects import pool_os
+        pool = NodePool(name="win-lab", labels={wk.LABEL_OS: "windows"})
+        assert pool_os(pool) == "windows"
+        wpod = Pod(name="w0", requests={"cpu": "1", "memory": "2Gi"},
+                   node_selector={wk.LABEL_OS: "windows"})
+        plan = solver.solve(build_problem([wpod], [pool], lattice))
+        assert not plan.unschedulable
+        assert plan.new_nodes[0].node_pool == "win-lab"

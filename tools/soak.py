@@ -1,0 +1,131 @@
+"""soak — wall-clock chaos soak of the threaded control plane.
+
+The committed analog of the reference's long-running e2e chaos suite
+(test/suites/chaos + the scale deprovisioning matrix run against a real
+cluster for hours): every controller on its own thread
+(operator/runtime.ControllerRuntime), real time, and a churn driver that
+injects the full fault surface — pod waves, heavy deletion (consolidation
+pressure), spot interruption messages, transient API errors, and ICE'd
+capacity pools.
+
+Exit criteria (after churn stops, the control plane must converge):
+- zero pending pods,
+- zero leaked instances (checked AFTER the GC grace window — an instance
+  the GC hasn't been entitled to reap yet is not a leak),
+- zero orphaned node leases.
+
+Usage: python tools/soak.py [--minutes 5] [--seed 0]
+Exits non-zero if any invariant fails. A 6-minute run churns ~20k pods.
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from karpenter_provider_aws_tpu.apis import Pod
+from karpenter_provider_aws_tpu.controllers.garbagecollection import LEAK_GRACE_SECONDS
+from karpenter_provider_aws_tpu.errors import NotFoundError
+from karpenter_provider_aws_tpu.interruption.messages import spot_interruption
+from karpenter_provider_aws_tpu.interruption.queue import FakeQueue
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+from karpenter_provider_aws_tpu.operator import Operator, Options
+from karpenter_provider_aws_tpu.operator.runtime import (ControllerRuntime,
+                                                         operator_specs)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--minutes", type=float, default=5.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--families", default="m5,c5,r5,t3")
+    args = ap.parse_args(argv)
+
+    fams = tuple(args.families.split(","))
+    lattice = build_lattice([s for s in build_catalog() if s.family in fams])
+    q = FakeQueue("soak-q")
+    op = Operator(options=Options(registration_delay=0.2,
+                                  batch_idle_duration=0.05,
+                                  batch_max_duration=0.5,
+                                  interruption_queue="soak-q"),
+                  lattice=lattice, interruption_queue=q)
+    rt = ControllerRuntime(operator_specs(op)).start()
+    rng = random.Random(args.seed)
+    stop = time.monotonic() + args.minutes * 60.0
+    i = 0
+
+    def safe_instances():
+        try:
+            return op.cloud.list_instances()
+        except Exception:
+            return []
+
+    try:
+        while time.monotonic() < stop:
+            r = rng.random()
+            if r < 0.5:
+                for _ in range(rng.randint(1, 15)):
+                    i += 1
+                    op.cluster.add_pod(Pod(
+                        name=f"s{i}",
+                        requests={"cpu": f"{rng.choice([250, 500, 1000, 2000])}m",
+                                  "memory": f"{rng.choice([512, 1024, 2048])}Mi"}))
+            elif r < 0.8:
+                # heavy deletion waves -> underutilized nodes -> consolidation
+                names = list(op.cluster.pods)
+                for name in rng.sample(names,
+                                       min(len(names), rng.randint(5, 30))):
+                    op.cluster.delete_pod(name)
+            elif r < 0.88:
+                insts = safe_instances()
+                if insts:
+                    q.send(spot_interruption(rng.choice(insts).id))
+            elif r < 0.94:
+                op.cloud.inject_error(NotFoundError("soak-chaos"))
+            else:
+                insts = safe_instances()
+                if insts:
+                    v = rng.choice(insts)
+                    op.cloud.set_capacity(v.capacity_type, v.instance_type,
+                                          v.zone, 0)
+            time.sleep(rng.uniform(0.01, 0.08))
+    finally:
+        # a controller blocked mid-pass can outlive the join timeout;
+        # invariants must never be read over live mutation
+        while not rt.stop():
+            print("soak: waiting for a blocked controller thread...")
+
+    # converge: clear injected faults (all controller threads have joined,
+    # so plain writes are race-free here), then let the single-threaded
+    # loop settle PAST the GC grace window so every reapable leak is reaped
+    op.cloud.next_error = None
+    op.cloud.capacity_pools.clear()
+    deadline = time.monotonic() + LEAK_GRACE_SECONDS + 15.0
+    while time.monotonic() < deadline:
+        op.run_once()
+        if not op.cluster.pending_pods() \
+                and time.monotonic() > deadline - 10.0:
+            break
+        time.sleep(0.05)
+
+    pending = op.cluster.pending_pods()
+    claimed = {c.provider_id for c in op.cluster.claims.values()
+               if c.provider_id}
+    leaked = [x for x in op.cloud.list_instances()
+              if x.provider_id not in claimed]
+    orphans = op.cluster.orphaned_leases()
+    print(f"soak: pods_churned={i} pending={len(pending)} "
+          f"nodes={len(op.cluster.nodes)} claims={len(op.cluster.claims)} "
+          f"leaked={len(leaked)} orphan_leases={len(orphans)}")
+    ok = not pending and not leaked and not orphans
+    print("soak: INVARIANTS " + ("OK" if ok else "VIOLATED"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -63,13 +63,23 @@ class Operator:
             # the reference computes instance types per NodeClass
             # (types.go:210-240 ephemeralStorage reads instanceStorePolicy +
             # blockDeviceMappings); the lattice carries ONE storage config —
-            # the default NodeClass's
+            # the default NodeClass's. Reject wiring where another NodeClass
+            # would resolve different ephemeral-storage capacities (the
+            # solver would silently mis-state storage for its pools).
+            default_nc = (self.node_classes.get("default")
+                          or next(iter(self.node_classes.values())))
+            default_storage = storage_config(default_nc)
+            for nc in self.node_classes.values():
+                if storage_config(nc) != default_storage:
+                    raise ValueError(
+                        f"NodeClass/{nc.name}: storage config (instanceStorePolicy/"
+                        f"blockDeviceMappings/amiFamily root device) differs from "
+                        f"NodeClass/{default_nc.name}'s; the lattice carries one "
+                        f"storage config — pass a per-config lattice explicitly")
             self.lattice = build_lattice(
                 vm_memory_overhead_percent=self.options.vm_memory_overhead_percent,
                 reserved_enis=self.options.reserved_enis,
-                storage=storage_config(
-                    self.node_classes.get("default")
-                    or next(iter(self.node_classes.values()))))
+                storage=default_storage)
         self.cloud = cloud or FakeCloud(self.clock, cluster_name=self.options.cluster_name)
         # connectivity probe before anything else (operator.go:115-117)
         self.cloud.list_instances()
@@ -94,7 +104,21 @@ class Operator:
         # the two disagree (the solver would otherwise schedule pods the
         # booted AMI can never run)
         from ..apis.objects import pool_os
+        from ..apis import wellknown as _wk
         for p in self.node_pools.values():
+            # the single-valued-os admission check, enforced even for pools
+            # handed to the Operator programmatically (bypassing webhooks):
+            # pool_os would otherwise silently pin a multi-valued os to
+            # sorted()[0] and mis-type the pool for the solver/label path
+            os_c = p.scheduling_requirements().get(_wk.LABEL_OS)
+            if os_c.include is not None and len(os_c.include) != 1:
+                # covers both multi-valued In AND a contradictory empty
+                # intersection (e.g. label os=windows + requirement In
+                # (linux,)) — pool_os would silently pin linux for either
+                raise ValueError(
+                    f"NodePool/{p.name}: os requirement must resolve to "
+                    f"exactly one OS (a pool's nodes boot one OS), got "
+                    f"{sorted(os_c.include)}")
             nc = self.node_classes.get(p.node_class_ref)
             if nc is None:
                 continue

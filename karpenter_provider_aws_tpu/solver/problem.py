@@ -585,6 +585,12 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
                         _add_domain(key, v)
         for k, v in pool.labels.items():
             _add_domain(k, v)
+        # effective template labels are domain sources too: every node of
+        # a windows pool carries the build label even when the pool never
+        # names it (mirrors the pool_eff_labels stamping below)
+        if (pool_os(pool) == "windows"
+                and wk.LABEL_WINDOWS_BUILD not in pool.labels):
+            _add_domain(wk.LABEL_WINDOWS_BUILD, WINDOWS_BUILD)
 
     virtual: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], NodePool] = {}
 
@@ -650,6 +656,20 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
     np_cap = np.ones((NP, C), dtype=bool)
     ds_overhead = np.zeros((NP, R), dtype=np.float32)
     np_alloc_cap = np.full((NP, R), np.inf, dtype=np.float32)
+    # per-daemonset request vectors, computed ONCE (not per pool — the
+    # csi_claims_count warning side effect must fire once per solve):
+    # a daemonset mounting CSI PVCs consumes an attach slot on EVERY
+    # node it lands on, so its overhead vector charges the axis like
+    # pending groups do
+    ds_prepared: List[Tuple[Pod, np.ndarray]] = []
+    for ds in daemonset_pods:
+        vec, unknown = resources_to_vec_checked(ds.requests, implicit_pod=True)
+        if unknown:
+            continue
+        if ds.volume_claims:
+            vec[res_axis("attachable-volumes")] = csi_claims_count(
+                ds.volume_claims, pvcs or {}, storage_classes or {}, warnings)
+        ds_prepared.append((ds, vec))
     pool_reqs: List[Requirements] = []
     pool_eff_labels: List[Mapping[str, str]] = []
     for pi, pool in enumerate(pools):
@@ -695,7 +715,7 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
                                           np_alloc_cap[pi][None, :])
                 np_type[pi] &= np.all(eff_capacity <= rem[None, :] + 1e-6,
                                       axis=1)
-        for ds in daemonset_pods:
+        for ds, vec in ds_prepared:
             # a daemonset lands on the pool's nodes iff it tolerates the pool
             # taints and its node selectors are compatible (reference
             # resolves daemonset overhead per simulated node the same way)
@@ -711,9 +731,6 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
             if not ds_reqs.compatible_with(reqs):
                 continue
             if not _custom_keys_ok(ds_reqs, pool_eff_labels[pi]):
-                continue
-            vec, unknown = resources_to_vec_checked(ds.requests, implicit_pod=True)
-            if unknown:
                 continue
             ds_overhead[pi] += vec
 
@@ -790,11 +807,13 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
                 continue
             np_ok_s = np_ok
             if s.custom:
-                # custom-spread slice: only pools whose merged labels
-                # carry exactly this slice's domain values may host it
+                # custom-spread slice: only pools whose EFFECTIVE labels
+                # (template labels + derived well-knowns like windows-build,
+                # same map _custom_keys_ok resolves against) carry exactly
+                # this slice's domain values may host it
                 np_ok_s = np_ok & np.array(
-                    [all(p.labels.get(k) == v for k, v in s.custom.items())
-                     for p in pools], dtype=bool)
+                    [all(eff.get(k) == v for k, v in s.custom.items())
+                     for eff in pool_eff_labels], dtype=bool)
             g = PodGroup(
                 signature=repr(sig), pod_names=sub_names, req=vec,
                 type_mask=masks.type_mask, zone_mask=s.zone_mask, cap_mask=s.cap_mask,

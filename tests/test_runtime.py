@@ -202,3 +202,55 @@ class TestLeaderElection:
             assert rt.stop()
         # stop released the lease for the next replica
         assert store.get() is None
+
+
+class TestOperatorAdmissionBackstops:
+    """Startup checks for objects handed to the Operator programmatically,
+    bypassing webhook admission (advisor r3 #2, #4)."""
+
+    def test_disagreeing_storage_configs_rejected(self, lattice):
+        from karpenter_provider_aws_tpu.apis import NodeClass
+        ncs = {
+            "default": NodeClass(name="default"),
+            "raid": NodeClass(name="raid", instance_store_policy="RAID0"),
+        }
+        with pytest.raises(ValueError, match="storage config"):
+            Operator(node_classes=ncs)
+
+    def test_agreeing_storage_configs_accepted(self, lattice):
+        from karpenter_provider_aws_tpu.apis import NodeClass
+        ncs = {
+            "default": NodeClass(name="default"),
+            "alt": NodeClass(name="alt", tags={"team": "a"}),
+        }
+        Operator(node_classes=ncs)  # must not raise
+
+    def test_explicit_lattice_skips_storage_check(self, lattice):
+        from karpenter_provider_aws_tpu.apis import NodeClass
+        ncs = {
+            "default": NodeClass(name="default"),
+            "raid": NodeClass(name="raid", instance_store_policy="RAID0"),
+        }
+        Operator(lattice=lattice, node_classes=ncs)  # caller owns the lattice
+
+    def test_multi_valued_os_pool_rejected(self, lattice):
+        from karpenter_provider_aws_tpu.apis import Operator as ReqOp
+        from karpenter_provider_aws_tpu.apis import Requirement
+        from karpenter_provider_aws_tpu.apis import wellknown as wk
+        pool = NodePool(name="both", requirements=[
+            Requirement(wk.LABEL_OS, ReqOp.IN, ("linux", "windows"))])
+        with pytest.raises(ValueError, match="exactly one OS"):
+            Operator(lattice=lattice, node_pools=[pool])
+
+    def test_contradictory_os_constraint_rejected(self, lattice):
+        """Label os=windows + requirement In (linux,) intersects to the
+        empty set — pool_os would silently pin linux; reject instead."""
+        from karpenter_provider_aws_tpu.apis import Operator as ReqOp
+        from karpenter_provider_aws_tpu.apis import Requirement
+        from karpenter_provider_aws_tpu.apis import wellknown as wk
+        pool = NodePool(name="contradiction",
+                        labels={wk.LABEL_OS: "windows"},
+                        requirements=[
+                            Requirement(wk.LABEL_OS, ReqOp.IN, ("linux",))])
+        with pytest.raises(ValueError, match="exactly one OS"):
+            Operator(lattice=lattice, node_pools=[pool])

@@ -897,3 +897,29 @@ class TestOSScheduling:
         plan = solver.solve(build_problem([wpod], [pool], lattice))
         assert not plan.unschedulable
         assert plan.new_nodes[0].node_pool == "win-lab"
+
+    def test_windows_build_spread_matches_windows_pool(self, solver, lattice):
+        """A DoNotSchedule topology spread over windows-build must resolve
+        a windows pool as a domain host through its EFFECTIVE (build-
+        stamped) labels, exactly like plain selection on the same label
+        (advisor r3 #3)."""
+        from karpenter_provider_aws_tpu.apis.objects import (
+            TopologySpreadConstraint, WINDOWS_BUILD)
+        win = NodePool(name="win", requirements=[
+            Requirement(wk.LABEL_OS, Operator.IN, ("windows",))])
+        pods = [Pod(name=f"w{i}", labels={"app": "iis"},
+                    requests={"cpu": "1", "memory": "2Gi"},
+                    node_selector={wk.LABEL_OS: "windows"},
+                    topology_spread=[TopologySpreadConstraint(
+                        max_skew=1, topology_key=wk.LABEL_WINDOWS_BUILD,
+                        label_selector=(("app", "iis"),))])
+                for i in range(2)]
+        plan = solver.solve(build_problem(pods, [win, default_pool()],
+                                          lattice))
+        assert not plan.unschedulable, plan.unschedulable
+        assert all(n.node_pool == "win" for n in plan.new_nodes)
+        # without effective-label domain resolution the spread silently
+        # degrades to advisory ("no discoverable domains") — the windows
+        # pool's stamped build label IS a discoverable domain
+        assert not any("no discoverable domains" in w for w in plan.warnings), \
+            plan.warnings

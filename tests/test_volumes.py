@@ -363,3 +363,21 @@ class TestVolumeAttachLimits:
         assert ebs_attach_limit("", 15) == 28 - 15 - 1
         assert ebs_attach_limit("xen", 8) == 39
         assert ebs_attach_limit("nitro", 4) == 23
+
+
+class TestAdvisorR3Regressions:
+    def test_daemonset_volume_claims_charge_attach_slots(self, lattice):
+        """A daemonset mounting CSI PVCs consumes an attach slot on EVERY
+        node of the pool: its ds_overhead vector must carry the
+        attachable-volumes charge like pending groups do (advisor r3 #1)."""
+        from karpenter_provider_aws_tpu.apis.resources import axis
+        ds = Pod(name="csi-agent", is_daemonset=True,
+                 requests={"cpu": "100m", "memory": "128Mi"},
+                 volume_claims=["ds-cache"])
+        pvcs = {"ds-cache": PersistentVolumeClaim(
+            name="ds-cache", storage_class="gp3")}
+        scs = {"gp3": StorageClass(name="gp3")}
+        problem = build_problem(
+            [vol_pod("p0", [])], [NodePool(name="default")], lattice,
+            daemonset_pods=[ds], pvcs=pvcs, storage_classes=scs)
+        assert problem.ds_overhead[0, axis("attachable-volumes")] == 1

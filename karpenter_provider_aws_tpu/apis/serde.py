@@ -74,6 +74,7 @@ def pod_to_dict(p: Pod) -> Dict:
         "owner": p.owner,
         "isDaemonset": p.is_daemonset,
         "priority": p.priority,
+        "deletionTimestamp": p.deletion_timestamp,
     }
 
 
@@ -115,6 +116,7 @@ def pod_from_dict(d: Mapping) -> Pod:
         owner=d.get("owner"),
         is_daemonset=d.get("isDaemonset", False),
         priority=d.get("priority", 0),
+        deletion_timestamp=d.get("deletionTimestamp"),
     )
 
 
@@ -285,3 +287,221 @@ def plan_from_dict(d: Mapping):
         device_seconds=d.get("deviceSeconds", 0.0),
         warnings=list(d.get("warnings", ())),
     )
+
+# ---- node / nodeclaim / nodeclass / pdb / lease (apiserver wire) -----------
+# These ride the kube seam (kube/apiserver.py): everything the controllers
+# read or write crosses the watch/list protocol as these dicts, the way the
+# reference's objects cross the apiserver (SURVEY §2.1 #23 API types).
+
+
+def _taint_to_dict(t: Taint) -> Dict:
+    return {"key": t.key, "value": t.value, "effect": t.effect.value}
+
+
+def _taint_from_dict(d: Mapping) -> Taint:
+    return Taint(key=d["key"], value=d.get("value", ""),
+                 effect=TaintEffect(d.get("effect", "NoSchedule")))
+
+
+def node_to_dict(n) -> Dict:
+    return {
+        "name": n.name,
+        "providerID": n.provider_id,
+        "internalIP": n.internal_ip,
+        "labels": dict(n.labels),
+        "annotations": dict(n.annotations),
+        "taints": [_taint_to_dict(t) for t in n.taints],
+        "capacity": dict(n.capacity),
+        "allocatable": dict(n.allocatable),
+        "ready": n.ready,
+        "createdAt": n.created_at,
+        "nodePool": n.node_pool,
+        "nodeClaim": n.node_claim,
+    }
+
+
+def node_from_dict(d: Mapping):
+    from .objects import Node
+    return Node(
+        name=d["name"], provider_id=d.get("providerID", ""),
+        internal_ip=d.get("internalIP"),
+        labels=dict(d.get("labels", {})),
+        annotations=dict(d.get("annotations", {})),
+        taints=[_taint_from_dict(t) for t in d.get("taints", ())],
+        capacity=dict(d.get("capacity", {})),
+        allocatable=dict(d.get("allocatable", {})),
+        ready=d.get("ready", False),
+        created_at=d.get("createdAt", 0.0),
+        node_pool=d.get("nodePool"),
+        node_claim=d.get("nodeClaim"),
+    )
+
+
+def nodeclaim_to_dict(c) -> Dict:
+    return {
+        "name": c.name,
+        "nodePool": c.node_pool,
+        "requirements": [requirement_to_dict(r) for r in c.requirements],
+        "resourceRequests": dict(c.resource_requests),
+        "labels": dict(c.labels),
+        "annotations": dict(c.annotations),
+        "taints": [_taint_to_dict(t) for t in c.taints],
+        "nodeClassRef": c.node_class_ref,
+        "phase": c.phase.value,
+        "maxPods": c.max_pods,
+        "clusterDNS": c.cluster_dns,
+        "providerID": c.provider_id,
+        "internalIP": c.internal_ip,
+        "instanceType": c.instance_type,
+        "zone": c.zone,
+        "capacityType": c.capacity_type,
+        "imageID": c.image_id,
+        "capacity": dict(c.capacity),
+        "allocatable": dict(c.allocatable),
+        "createdAt": c.created_at,
+        "launchedAt": c.launched_at,
+        "registeredAt": c.registered_at,
+        "initializedAt": c.initialized_at,
+        "deletionTimestamp": c.deletion_timestamp,
+    }
+
+
+def nodeclaim_from_dict(d: Mapping):
+    from .objects import NodeClaim, NodeClaimPhase
+    return NodeClaim(
+        name=d["name"], node_pool=d.get("nodePool", ""),
+        requirements=[requirement_from_dict(r)
+                      for r in d.get("requirements", ())],
+        resource_requests=dict(d.get("resourceRequests", {})),
+        labels=dict(d.get("labels", {})),
+        annotations=dict(d.get("annotations", {})),
+        taints=[_taint_from_dict(t) for t in d.get("taints", ())],
+        node_class_ref=d.get("nodeClassRef", "default"),
+        phase=NodeClaimPhase(d.get("phase", "Pending")),
+        max_pods=d.get("maxPods"),
+        cluster_dns=d.get("clusterDNS"),
+        provider_id=d.get("providerID"),
+        internal_ip=d.get("internalIP"),
+        instance_type=d.get("instanceType"),
+        zone=d.get("zone"),
+        capacity_type=d.get("capacityType"),
+        image_id=d.get("imageID"),
+        capacity=dict(d.get("capacity", {})),
+        allocatable=dict(d.get("allocatable", {})),
+        created_at=d.get("createdAt", 0.0),
+        launched_at=d.get("launchedAt"),
+        registered_at=d.get("registeredAt"),
+        initialized_at=d.get("initializedAt"),
+        deletion_timestamp=d.get("deletionTimestamp"),
+    )
+
+
+def _selector_term_to_dict(t) -> Dict:
+    return {"tags": [list(kv) for kv in t.tags], "id": t.id, "name": t.name}
+
+
+def _selector_term_from_dict(d: Mapping):
+    from .objects import NodeClassSelectorTerm
+    return NodeClassSelectorTerm(
+        tags=tuple(tuple(kv) for kv in d.get("tags", ())),
+        id=d.get("id"), name=d.get("name"))
+
+
+def nodeclass_to_dict(nc) -> Dict:
+    return {
+        "name": nc.name,
+        "amiFamily": nc.ami_family,
+        "subnetSelectorTerms": [_selector_term_to_dict(t)
+                                for t in nc.subnet_selector_terms],
+        "securityGroupSelectorTerms": [_selector_term_to_dict(t)
+                                       for t in nc.security_group_selector_terms],
+        "amiSelectorTerms": [_selector_term_to_dict(t)
+                             for t in nc.ami_selector_terms],
+        "userData": nc.user_data,
+        "role": nc.role,
+        "instanceProfile": nc.instance_profile,
+        "tags": dict(nc.tags),
+        "blockDeviceMappings": [dict(b) for b in nc.block_device_mappings],
+        "instanceStorePolicy": nc.instance_store_policy,
+        "metadataOptions": {
+            "httpEndpoint": nc.metadata_options.http_endpoint,
+            "httpProtocolIPv6": nc.metadata_options.http_protocol_ipv6,
+            "httpPutResponseHopLimit": nc.metadata_options.http_put_response_hop_limit,
+            "httpTokens": nc.metadata_options.http_tokens,
+        },
+        "detailedMonitoring": nc.detailed_monitoring,
+        "associatePublicIP": nc.associate_public_ip,
+        "annotations": dict(nc.annotations),
+        "statusSubnets": [dict(s) for s in nc.status_subnets],
+        "statusSecurityGroups": [dict(s) for s in nc.status_security_groups],
+        "statusAMIs": [dict(s) for s in nc.status_amis],
+        "statusInstanceProfile": nc.status_instance_profile,
+        "statusConditions": dict(nc.status_conditions),
+    }
+
+
+def nodeclass_from_dict(d: Mapping):
+    from .objects import MetadataOptions, NodeClass
+    mo = d.get("metadataOptions") or {}
+    return NodeClass(
+        name=d["name"],
+        ami_family=d.get("amiFamily", "AL2023"),
+        subnet_selector_terms=[_selector_term_from_dict(t)
+                               for t in d.get("subnetSelectorTerms", ())],
+        security_group_selector_terms=[
+            _selector_term_from_dict(t)
+            for t in d.get("securityGroupSelectorTerms", ())],
+        ami_selector_terms=[_selector_term_from_dict(t)
+                            for t in d.get("amiSelectorTerms", ())],
+        user_data=d.get("userData"),
+        role=d.get("role"),
+        instance_profile=d.get("instanceProfile"),
+        tags=dict(d.get("tags", {})),
+        block_device_mappings=[dict(b)
+                               for b in d.get("blockDeviceMappings", ())],
+        instance_store_policy=d.get("instanceStorePolicy"),
+        metadata_options=MetadataOptions(
+            http_endpoint=mo.get("httpEndpoint", "enabled"),
+            http_protocol_ipv6=mo.get("httpProtocolIPv6", "disabled"),
+            http_put_response_hop_limit=mo.get("httpPutResponseHopLimit", 2),
+            http_tokens=mo.get("httpTokens", "required")),
+        detailed_monitoring=d.get("detailedMonitoring", False),
+        associate_public_ip=d.get("associatePublicIP"),
+        annotations=dict(d.get("annotations", {})),
+        status_subnets=[dict(s) for s in d.get("statusSubnets", ())],
+        status_security_groups=[dict(s)
+                                for s in d.get("statusSecurityGroups", ())],
+        status_amis=[dict(s) for s in d.get("statusAMIs", ())],
+        status_instance_profile=d.get("statusInstanceProfile"),
+        status_conditions=dict(d.get("statusConditions", {})),
+    )
+
+
+def pdb_to_dict(p) -> Dict:
+    return {
+        "name": p.name,
+        "namespace": p.namespace,
+        "labelSelector": dict(p.label_selector),
+        "maxUnavailable": p.max_unavailable,
+        "minAvailable": p.min_available,
+    }
+
+
+def pdb_from_dict(d: Mapping):
+    from .objects import PodDisruptionBudget
+    return PodDisruptionBudget(
+        name=d["name"], namespace=d.get("namespace", "default"),
+        label_selector=dict(d.get("labelSelector", {})),
+        max_unavailable=d.get("maxUnavailable"),
+        min_available=d.get("minAvailable"))
+
+
+def lease_to_dict(l) -> Dict:
+    return {"name": l.name, "ownerNode": l.owner_node,
+            "createdAt": l.created_at}
+
+
+def lease_from_dict(d: Mapping):
+    from .objects import Lease
+    return Lease(name=d["name"], owner_node=d.get("ownerNode"),
+                 created_at=d.get("createdAt", 0.0))

@@ -1,0 +1,454 @@
+"""In-memory Kubernetes-API-shaped object store: list/watch/create/update/
+patch/delete over versioned wire objects.
+
+This is the ingest boundary of the framework — the analog of the apiserver
+the reference's controllers are wired against (reference
+cmd/controller/main.go:47-53 builds core controllers over a client +
+cluster state; pkg/operator/operator.go:92-186 builds the manager and its
+field indexers; pkg/test/environment.go:83-162 drives the same protocol
+from envtest in unit tests). Everything that crosses this seam is a plain
+JSON-able dict in the apis/serde wire format wrapped in a k8s-style
+envelope::
+
+    {"kind": "Pod",
+     "metadata": {"name", "uid", "resourceVersion", "creationTimestamp",
+                  "deletionTimestamp", "finalizers"},
+     "spec": <serde dict>}
+
+Semantics mirrored from the real protocol:
+
+- **resourceVersion**: one global monotonic counter; every write stamps
+  the object and the emitted watch event. ``update`` requires the caller's
+  metadata.resourceVersion to match the stored one (409 Conflict
+  otherwise) — optimistic concurrency, exactly the reference's
+  client-side retry contract.
+- **watch**: per-kind subscriptions deliver ADDED/MODIFIED/DELETED events
+  in RV order. Each kind keeps a bounded event history; a watch resuming
+  from an RV older than the history raises ``TooOldError`` (the HTTP 410
+  Gone that forces a reflector relist).
+- **finalizers**: ``delete`` on an object with finalizers only stamps
+  deletionTimestamp (MODIFIED event); the object is removed when an
+  update clears the last finalizer while deletionTimestamp is set — the
+  reference's NodeClaim termination flow runs on exactly this contract.
+- **subresources**: pods/binding (``bind``) and pods/eviction (``evict``,
+  PDB-enforced server-side like the real Eviction API).
+- **field indexers**: ``add_index``/``get_by_index`` mirror the manager's
+  NodeClaim provider-id index (operator.go:180-186).
+- **admission**: pluggable per-kind hooks run on create/update — the
+  webhook seam (reference pkg/webhooks/webhooks.go) so invalid objects
+  are rejected AT the boundary, not after ingestion.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import threading
+import time as _time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+# kinds are plural lowercase, like REST resource paths
+KINDS = ("pods", "nodes", "nodeclaims", "nodepools", "nodeclasses",
+         "pvcs", "storageclasses", "pdbs", "leases")
+
+EVENT_HISTORY = 4096   # per-kind watch event ring; older RVs are "410 Gone"
+
+
+class APIError(Exception):
+    """Base of every apiserver error."""
+
+
+class NotFoundError(APIError):
+    pass
+
+
+class AlreadyExistsError(APIError):
+    pass
+
+
+class ConflictError(APIError):
+    """Stale resourceVersion on update (HTTP 409)."""
+
+
+class TooOldError(APIError):
+    """Watch RV fell off the event history (HTTP 410 Gone) — relist."""
+
+
+class InvalidObjectError(APIError):
+    """Admission rejected the object (HTTP 422); .causes lists reasons."""
+
+    def __init__(self, kind: str, name: str, causes: Sequence[str]):
+        super().__init__(f"{kind}/{name} rejected: " + "; ".join(causes))
+        self.causes = list(causes)
+
+
+class EvictionBlockedError(APIError):
+    """A PodDisruptionBudget currently permits no eviction (HTTP 429)."""
+
+
+@dataclass
+class WatchEvent:
+    type: str          # ADDED | MODIFIED | DELETED
+    kind: str
+    object: dict       # full envelope (deep copy)
+    resource_version: int
+
+
+class Watch:
+    """One watch subscription: an unbounded FIFO the server appends to.
+
+    ``pop_pending()`` drains without blocking (the deterministic pump);
+    ``get(timeout)`` blocks (the threaded reflector). ``stop()`` wakes
+    blocked readers with a ``None`` sentinel."""
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._events: deque = deque()
+        self._cond = threading.Condition()
+        self._stopped = False
+
+    def _push(self, ev: WatchEvent) -> None:
+        with self._cond:
+            self._events.append(ev)
+            self._cond.notify_all()
+
+    def pop_pending(self) -> List[WatchEvent]:
+        with self._cond:
+            out = list(self._events)
+            self._events.clear()
+            return out
+
+    def get(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        with self._cond:
+            if not self._events and not self._stopped:
+                self._cond.wait(timeout)
+            if self._events:
+                return self._events.popleft()
+            return None
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+
+
+class FakeAPIServer:
+    def __init__(self, clock=None):
+        """``clock`` (utils.clock.Clock-like) stamps server-side times —
+        deletionTimestamp on finalizer-gated deletes, like the real
+        apiserver stamps deletion times itself. Defaults to wall clock."""
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._rv = itertools.count(1)
+        self._store: Dict[str, Dict[str, dict]] = {k: {} for k in KINDS}
+        self._history: Dict[str, deque] = {
+            k: deque(maxlen=EVENT_HISTORY) for k in KINDS}
+        self._watches: Dict[str, List[Watch]] = {k: [] for k in KINDS}
+        self._indexes: Dict[Tuple[str, str], Callable[[dict], Optional[str]]] = {}
+        self._admission: Dict[str, List[Callable[[dict], List[str]]]] = {}
+        self._defaulters: Dict[str, List[Callable[[dict], dict]]] = {}
+        self._uid = itertools.count(1)
+        self.last_rv = 0
+
+    # ---- admission (webhook seam) -----------------------------------------
+
+    def register_admission(self, kind: str,
+                           validate: Optional[Callable[[dict], List[str]]] = None,
+                           default: Optional[Callable[[dict], dict]] = None) -> None:
+        """Install a validating and/or defaulting hook for a kind. The
+        validator sees the SPEC wire dict and returns error strings
+        (empty = admitted); the defaulter returns the (possibly mutated)
+        spec. Mirrors the reference's knative-style admission chain."""
+        if validate is not None:
+            self._admission.setdefault(kind, []).append(validate)
+        if default is not None:
+            self._defaulters.setdefault(kind, []).append(default)
+
+    def _admit(self, kind: str, name: str, spec: dict) -> dict:
+        for d in self._defaulters.get(kind, ()):
+            spec = d(spec)
+        causes: List[str] = []
+        for v in self._admission.get(kind, ()):
+            causes.extend(v(spec))
+        if causes:
+            raise InvalidObjectError(kind, name, causes)
+        return spec
+
+    # ---- core verbs --------------------------------------------------------
+
+    def _check_kind(self, kind: str) -> None:
+        if kind not in self._store:
+            raise APIError(f"unknown kind {kind!r}")
+
+    def _emit(self, type_: str, kind: str, obj: dict) -> None:
+        rv = obj["metadata"]["resourceVersion"]
+        # each subscriber AND the history ring get their OWN copy: a
+        # handler mutating a delivered envelope must corrupt neither the
+        # replay history nor its sibling watchers (the same isolation
+        # list()/get() give via their defensive copies)
+        self._history[kind].append(WatchEvent(
+            type=type_, kind=kind, object=copy.deepcopy(obj),
+            resource_version=rv))
+        for w in self._watches[kind]:
+            w._push(WatchEvent(type=type_, kind=kind,
+                               object=copy.deepcopy(obj),
+                               resource_version=rv))
+
+    def _next_rv(self) -> int:
+        self.last_rv = next(self._rv)
+        return self.last_rv
+
+    def create(self, kind: str, spec: dict, *,
+               finalizers: Sequence[str] = ()) -> dict:
+        """Create an object from its serde spec; returns the envelope."""
+        self._check_kind(kind)
+        name = spec.get("name")
+        if not name:
+            raise APIError(f"{kind}: spec has no name")
+        with self._lock:
+            if name in self._store[kind]:
+                raise AlreadyExistsError(f"{kind}/{name} already exists")
+            spec = self._admit(kind, name, copy.deepcopy(spec))
+            rv = self._next_rv()
+            obj = {
+                "kind": kind,
+                "metadata": {
+                    "name": name,
+                    "uid": f"uid-{next(self._uid):06d}",
+                    "resourceVersion": rv,
+                    "creationTimestamp": None,   # clock-free; RV orders
+                    "deletionTimestamp": None,
+                    "finalizers": list(finalizers),
+                },
+                "spec": spec,
+            }
+            self._store[kind][name] = obj
+            self._emit("ADDED", kind, obj)
+            return copy.deepcopy(obj)
+
+    def get(self, kind: str, name: str) -> dict:
+        self._check_kind(kind)
+        with self._lock:
+            obj = self._store[kind].get(name)
+            if obj is None:
+                raise NotFoundError(f"{kind}/{name} not found")
+            return copy.deepcopy(obj)
+
+    def list(self, kind: str) -> Tuple[List[dict], int]:
+        """Returns (items, listResourceVersion) — watch from the returned
+        RV to observe every later change exactly once."""
+        self._check_kind(kind)
+        with self._lock:
+            items = [copy.deepcopy(o) for o in self._store[kind].values()]
+            return items, self.last_rv
+
+    def update(self, kind: str, obj: dict) -> dict:
+        """Full-object update with optimistic concurrency: the caller's
+        metadata.resourceVersion must match the stored object's."""
+        self._check_kind(kind)
+        name = obj["metadata"]["name"]
+        with self._lock:
+            cur = self._store[kind].get(name)
+            if cur is None:
+                raise NotFoundError(f"{kind}/{name} not found")
+            if obj["metadata"]["resourceVersion"] != cur["metadata"]["resourceVersion"]:
+                raise ConflictError(
+                    f"{kind}/{name}: stale resourceVersion "
+                    f"{obj['metadata']['resourceVersion']} "
+                    f"(current {cur['metadata']['resourceVersion']})")
+            spec = self._admit(kind, name, copy.deepcopy(obj["spec"]))
+            new = copy.deepcopy(cur)
+            new["spec"] = spec
+            new["metadata"]["finalizers"] = list(obj["metadata"].get("finalizers", ()))
+            new["metadata"]["resourceVersion"] = self._next_rv()
+            # clearing the last finalizer of a deleting object removes it
+            if (new["metadata"]["deletionTimestamp"] is not None
+                    and not new["metadata"]["finalizers"]):
+                del self._store[kind][name]
+                self._emit("DELETED", kind, new)
+            else:
+                self._store[kind][name] = new
+                self._emit("MODIFIED", kind, new)
+            return copy.deepcopy(new)
+
+    def patch(self, kind: str, name: str, spec_patch: Optional[dict] = None, *,
+              finalizers: Optional[Sequence[str]] = None) -> dict:
+        """JSON-merge-patch on the spec (``None`` values delete keys) and/or
+        replace the finalizer list. No RV precondition — a patch applies to
+        whatever is current, like a server-side strategic merge."""
+        self._check_kind(kind)
+        with self._lock:
+            cur = self._store[kind].get(name)
+            if cur is None:
+                raise NotFoundError(f"{kind}/{name} not found")
+            new = copy.deepcopy(cur)
+            if spec_patch:
+                for k, v in spec_patch.items():
+                    if v is None:
+                        new["spec"].pop(k, None)
+                    else:
+                        new["spec"][k] = copy.deepcopy(v)
+                new["spec"] = self._admit(kind, name, new["spec"])
+            if finalizers is not None:
+                new["metadata"]["finalizers"] = list(finalizers)
+            new["metadata"]["resourceVersion"] = self._next_rv()
+            if (new["metadata"]["deletionTimestamp"] is not None
+                    and not new["metadata"]["finalizers"]):
+                del self._store[kind][name]
+                self._emit("DELETED", kind, new)
+            else:
+                self._store[kind][name] = new
+                self._emit("MODIFIED", kind, new)
+            return copy.deepcopy(new)
+
+    def delete(self, kind: str, name: str, *, now: Optional[float] = None,
+               force: bool = False) -> None:
+        """Delete an object. With finalizers present (and not ``force``),
+        only stamps deletionTimestamp — the finalizing controller removes
+        the object later by clearing the finalizer list."""
+        self._check_kind(kind)
+        with self._lock:
+            cur = self._store[kind].get(name)
+            if cur is None:
+                raise NotFoundError(f"{kind}/{name} not found")
+            if cur["metadata"]["finalizers"] and not force:
+                if cur["metadata"]["deletionTimestamp"] is None:
+                    new = copy.deepcopy(cur)
+                    # the server stamps deletion time itself when the
+                    # caller didn't; never 0.0/falsy — every downstream
+                    # consumer truth-tests deletion_timestamp
+                    if now is None:
+                        now = (self._clock.now() if self._clock is not None
+                               else _time.time())
+                    new["metadata"]["deletionTimestamp"] = now or 1e-9
+                    new["metadata"]["resourceVersion"] = self._next_rv()
+                    self._store[kind][name] = new
+                    self._emit("MODIFIED", kind, new)
+                return
+            gone = copy.deepcopy(cur)
+            gone["metadata"]["resourceVersion"] = self._next_rv()
+            del self._store[kind][name]
+            self._emit("DELETED", kind, gone)
+
+    # ---- watch -------------------------------------------------------------
+
+    def watch(self, kind: str, resource_version: int = 0) -> Watch:
+        """Subscribe from ``resource_version`` (exclusive). Events already
+        past that RV replay from the history ring; an RV older than the
+        ring raises TooOldError (relist, like a 410 Gone)."""
+        self._check_kind(kind)
+        with self._lock:
+            hist = self._history[kind]
+            # a full ring has dropped events (all with RV < hist[0]'s);
+            # resuming below that horizon can't replay them — 410 Gone.
+            # A non-full ring still holds the kind's entire lifetime, so
+            # any RV (including 0) is safe.
+            if (len(hist) == hist.maxlen
+                    and resource_version < hist[0].resource_version - 1):
+                raise TooOldError(
+                    f"{kind}: watch from rv={resource_version} too old "
+                    f"(history starts at {hist[0].resource_version})")
+            w = Watch(kind)
+            for ev in hist:
+                if ev.resource_version > resource_version:
+                    # replayed events are copies too — the ring must stay
+                    # pristine for the next resuming watcher
+                    w._push(WatchEvent(type=ev.type, kind=ev.kind,
+                                       object=copy.deepcopy(ev.object),
+                                       resource_version=ev.resource_version))
+            self._watches[kind].append(w)
+            return w
+
+    def stop_watch(self, w: Watch) -> None:
+        with self._lock:
+            if w in self._watches[w.kind]:
+                self._watches[w.kind].remove(w)
+        w.stop()
+
+    # ---- subresources ------------------------------------------------------
+
+    def bind(self, pod_name: str, node_name: str) -> dict:
+        """pods/binding: set spec.nodeName on an unbound pod."""
+        with self._lock:
+            cur = self._store["pods"].get(pod_name)
+            if cur is None:
+                raise NotFoundError(f"pods/{pod_name} not found")
+            if cur["spec"].get("nodeName"):
+                raise ConflictError(
+                    f"pod {pod_name} already bound to {cur['spec']['nodeName']}")
+            return self.patch("pods", pod_name, {"nodeName": node_name})
+
+    def _pdb_allowance(self, pdb_spec: dict) -> int:
+        """Server-side disruptions-allowed math (policy/v1): healthy =
+        bound matching pods without deletionTimestamp. Caller holds lock."""
+        sel = pdb_spec.get("labelSelector", {})
+        ns = pdb_spec.get("namespace", "default")
+        matching = []
+        for obj in self._store["pods"].values():
+            s = obj["spec"]
+            if s.get("isDaemonset"):
+                continue
+            if s.get("namespace", "default") != ns:
+                continue
+            if all(s.get("labels", {}).get(k) == v for k, v in sel.items()):
+                matching.append(obj)
+        healthy = sum(1 for o in matching
+                      if o["spec"].get("nodeName")
+                      and o["metadata"]["deletionTimestamp"] is None
+                      # pods carry deletion state in SPEC too (our pods
+                      # have no finalizers, so a draining pod is marked
+                      # at the spec level — state/cluster.py:204 uses the
+                      # same representation for healthy math)
+                      and o["spec"].get("deletionTimestamp") is None)
+        allowed = len(matching)
+        if pdb_spec.get("minAvailable") is not None:
+            allowed = min(allowed, healthy - int(pdb_spec["minAvailable"]))
+        if pdb_spec.get("maxUnavailable") is not None:
+            unavailable = len(matching) - healthy
+            allowed = min(allowed, int(pdb_spec["maxUnavailable"]) - unavailable)
+        return max(allowed, 0)
+
+    def evict(self, pod_name: str, *, force: bool = False) -> dict:
+        """pods/eviction: unbind the pod (the workload controller instantly
+        re-creates it pending in this simulation, so eviction == unbind).
+        PDBs are enforced HERE, server-side, exactly like the real
+        Eviction API; ``force`` models a grace-zero pod delete that
+        bypasses budgets (the reference's force-drain backstop)."""
+        with self._lock:
+            cur = self._store["pods"].get(pod_name)
+            if cur is None:
+                raise NotFoundError(f"pods/{pod_name} not found")
+            spec = cur["spec"]
+            if not force and not spec.get("isDaemonset"):
+                for pdb in self._store["pdbs"].values():
+                    ps = pdb["spec"]
+                    sel = ps.get("labelSelector", {})
+                    if ps.get("namespace", "default") != spec.get("namespace", "default"):
+                        continue
+                    if not all(spec.get("labels", {}).get(k) == v
+                               for k, v in sel.items()):
+                        continue
+                    if self._pdb_allowance(ps) <= 0:
+                        raise EvictionBlockedError(
+                            f"pod {pod_name}: PDB {pdb['metadata']['name']} "
+                            f"permits no eviction now")
+            return self.patch("pods", pod_name, {"nodeName": None})
+
+    # ---- field indexers ----------------------------------------------------
+
+    def add_index(self, kind: str, index: str,
+                  key_fn: Callable[[dict], Optional[str]]) -> None:
+        """Register a field index over SPEC dicts (the manager's
+        FieldIndexer analog, operator.go:180-186)."""
+        self._check_kind(kind)
+        self._indexes[(kind, index)] = key_fn
+
+    def get_by_index(self, kind: str, index: str, value: str) -> List[dict]:
+        key_fn = self._indexes.get((kind, index))
+        if key_fn is None:
+            raise APIError(f"no index {index!r} on {kind}")
+        with self._lock:
+            return [copy.deepcopy(o) for o in self._store[kind].values()
+                    if key_fn(o["spec"]) == value]

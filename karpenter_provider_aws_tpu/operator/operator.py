@@ -57,20 +57,25 @@ class Operator:
         self.node_classes: Dict[str, NodeClass] = node_classes or {
             "default": NodeClass(name="default",
                                  role=f"KarpenterNodeRole-{self.options.cluster_name}")}
+        pool_list = list(node_pools) if node_pools else [NodePool(name="default")]
         if lattice is not None:
             self.lattice = lattice
         else:
             # the reference computes instance types per NodeClass
             # (types.go:210-240 ephemeralStorage reads instanceStorePolicy +
             # blockDeviceMappings); the lattice carries ONE storage config —
-            # the default NodeClass's. Reject wiring where another NodeClass
-            # would resolve different ephemeral-storage capacities (the
-            # solver would silently mis-state storage for its pools).
+            # the default NodeClass's. Reject wiring where a NodeClass a
+            # pool actually REFERENCES would resolve different
+            # ephemeral-storage capacities (the solver would silently
+            # mis-state storage for that pool's nodes); merely-present
+            # unreferenced classes are harmless.
             default_nc = (self.node_classes.get("default")
                           or next(iter(self.node_classes.values())))
             default_storage = storage_config(default_nc)
-            for nc in self.node_classes.values():
-                if storage_config(nc) != default_storage:
+            referenced = {p.node_class_ref for p in pool_list}
+            for name in sorted(referenced):
+                nc = self.node_classes.get(name)
+                if nc is not None and storage_config(nc) != default_storage:
                     raise ValueError(
                         f"NodeClass/{nc.name}: storage config (instanceStorePolicy/"
                         f"blockDeviceMappings/amiFamily root device) differs from "
@@ -99,7 +104,7 @@ class Operator:
         self._pool_gauge_rev = -1
         self.unavailable = UnavailableOfferings(self.clock)
         self.cluster = ClusterState(self.clock)
-        self.node_pools: Dict[str, NodePool] = {p.name: p for p in (node_pools or [NodePool(name="default")])}
+        self.node_pools: Dict[str, NodePool] = {p.name: p for p in pool_list}
         # a pool's OS is its NodeClass AMI family's: reject wiring where
         # the two disagree (the solver would otherwise schedule pods the
         # booted AMI can never run)

@@ -1,0 +1,428 @@
+"""The Kubernetes-API-shaped ingest seam: protocol semantics.
+
+Mirrors the contracts the reference's controllers rely on from the real
+apiserver/client-go stack: resourceVersion optimistic concurrency, watch
+event ordering + 410-Gone relists, finalizer-gated deletion, server-side
+PDB enforcement on the eviction subresource, field indexers
+(operator.go:180-186), and admission at the boundary
+(pkg/webhooks/webhooks.go).
+"""
+
+import threading
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import (
+    NodePool, Pod, PodDisruptionBudget, Requirement,
+)
+from karpenter_provider_aws_tpu.apis import Operator as ReqOp
+from karpenter_provider_aws_tpu.apis import serde
+from karpenter_provider_aws_tpu.apis import wellknown as wk
+from karpenter_provider_aws_tpu.apis.objects import NodeClaim
+from karpenter_provider_aws_tpu.kube import (
+    ConflictError, EvictionBlockedError, FakeAPIServer, Informer,
+    InformerSet, InvalidObjectError, KubeClient, NotFoundError,
+    TERMINATION_FINALIZER, TooOldError, install_admission,
+    install_default_indexes,
+)
+import karpenter_provider_aws_tpu.kube.apiserver as apiserver_mod
+
+
+def pod(name, **kw):
+    return Pod(name=name, requests={"cpu": "1", "memory": "1Gi"}, **kw)
+
+
+class TestVerbs:
+    def test_create_get_roundtrip(self):
+        s = FakeAPIServer()
+        s.create("pods", serde.pod_to_dict(pod("p0")))
+        obj = s.get("pods", "p0")
+        assert obj["metadata"]["name"] == "p0"
+        assert obj["metadata"]["resourceVersion"] == 1
+        assert serde.pod_from_dict(obj["spec"]).requests["cpu"] == "1"
+
+    def test_create_duplicate_rejected(self):
+        s = FakeAPIServer()
+        s.create("pods", serde.pod_to_dict(pod("p0")))
+        with pytest.raises(Exception, match="already exists"):
+            s.create("pods", serde.pod_to_dict(pod("p0")))
+
+    def test_resource_version_is_global_and_monotonic(self):
+        s = FakeAPIServer()
+        a = s.create("pods", serde.pod_to_dict(pod("a")))
+        b = s.create("nodes", {"name": "n0"})
+        c = s.patch("pods", "a", {"priority": 5})
+        rvs = [a["metadata"]["resourceVersion"],
+               b["metadata"]["resourceVersion"],
+               c["metadata"]["resourceVersion"]]
+        assert rvs == sorted(rvs) and len(set(rvs)) == 3
+
+    def test_update_conflict_on_stale_rv(self):
+        s = FakeAPIServer()
+        obj = s.create("pods", serde.pod_to_dict(pod("p0")))
+        s.patch("pods", "p0", {"priority": 1})   # bumps RV behind our back
+        obj["spec"]["priority"] = 2
+        with pytest.raises(ConflictError):
+            s.update("pods", obj)
+        # refetch-and-retry succeeds (the client-go retry contract)
+        fresh = s.get("pods", "p0")
+        fresh["spec"]["priority"] = 2
+        s.update("pods", fresh)
+        assert s.get("pods", "p0")["spec"]["priority"] == 2
+
+    def test_patch_merges_and_deletes_keys(self):
+        s = FakeAPIServer()
+        s.create("pods", serde.pod_to_dict(pod("p0", node_name="n0")))
+        s.patch("pods", "p0", {"nodeName": None, "priority": 7})
+        spec = s.get("pods", "p0")["spec"]
+        assert "nodeName" not in spec
+        assert spec["priority"] == 7
+
+    def test_get_missing_raises(self):
+        with pytest.raises(NotFoundError):
+            FakeAPIServer().get("pods", "ghost")
+
+    def test_list_returns_rv_high_water_mark(self):
+        s = FakeAPIServer()
+        s.create("pods", serde.pod_to_dict(pod("a")))
+        s.create("nodes", {"name": "n0"})  # other-kind write bumps global RV
+        items, rv = s.list("pods")
+        assert len(items) == 1
+        assert rv == 2
+
+
+class TestFinalizers:
+    def test_delete_with_finalizer_only_stamps_timestamp(self):
+        s = FakeAPIServer()
+        s.create("nodeclaims", {"name": "c0"}, finalizers=("fin",))
+        s.delete("nodeclaims", "c0", now=42.0)
+        obj = s.get("nodeclaims", "c0")
+        assert obj["metadata"]["deletionTimestamp"] == 42.0
+        # second delete is a no-op (timestamp not re-stamped)
+        s.delete("nodeclaims", "c0", now=99.0)
+        assert s.get("nodeclaims", "c0")["metadata"]["deletionTimestamp"] == 42.0
+
+    def test_clearing_last_finalizer_removes_deleting_object(self):
+        s = FakeAPIServer()
+        s.create("nodeclaims", {"name": "c0"}, finalizers=("fin",))
+        s.delete("nodeclaims", "c0", now=1.0)
+        s.patch("nodeclaims", "c0", finalizers=())
+        with pytest.raises(NotFoundError):
+            s.get("nodeclaims", "c0")
+
+    def test_clearing_finalizer_on_live_object_keeps_it(self):
+        s = FakeAPIServer()
+        s.create("nodeclaims", {"name": "c0"}, finalizers=("fin",))
+        s.patch("nodeclaims", "c0", finalizers=())
+        assert s.get("nodeclaims", "c0")["metadata"]["finalizers"] == []
+
+    def test_force_delete_bypasses_finalizer(self):
+        s = FakeAPIServer()
+        s.create("nodeclaims", {"name": "c0"}, finalizers=("fin",))
+        s.delete("nodeclaims", "c0", force=True)
+        with pytest.raises(NotFoundError):
+            s.get("nodeclaims", "c0")
+
+
+class TestWatch:
+    def test_events_arrive_in_rv_order(self):
+        s = FakeAPIServer()
+        w = s.watch("pods")
+        s.create("pods", serde.pod_to_dict(pod("a")))
+        s.patch("pods", "a", {"priority": 1})
+        s.delete("pods", "a")
+        evs = w.pop_pending()
+        assert [e.type for e in evs] == ["ADDED", "MODIFIED", "DELETED"]
+        rvs = [e.resource_version for e in evs]
+        assert rvs == sorted(rvs)
+
+    def test_watch_from_rv_replays_only_later_events(self):
+        s = FakeAPIServer()
+        s.create("pods", serde.pod_to_dict(pod("a")))
+        _, rv = s.list("pods")
+        s.create("pods", serde.pod_to_dict(pod("b")))
+        w = s.watch("pods", resource_version=rv)
+        evs = w.pop_pending()
+        assert len(evs) == 1
+        assert evs[0].object["metadata"]["name"] == "b"
+
+    def test_watch_too_old_raises_gone(self):
+        s = FakeAPIServer()
+        old_max = apiserver_mod.EVENT_HISTORY
+        s._history["pods"] = __import__("collections").deque(maxlen=4)
+        for i in range(8):
+            s.create("pods", serde.pod_to_dict(pod(f"p{i}")))
+        with pytest.raises(TooOldError):
+            s.watch("pods", resource_version=1)
+        assert old_max == apiserver_mod.EVENT_HISTORY  # module constant untouched
+
+    def test_blocking_get_wakes_on_event(self):
+        s = FakeAPIServer()
+        w = s.watch("pods")
+        got = []
+
+        def reader():
+            got.append(w.get(timeout=5.0))
+
+        t = threading.Thread(target=reader)
+        t.start()
+        s.create("pods", serde.pod_to_dict(pod("a")))
+        t.join(5.0)
+        assert got and got[0].type == "ADDED"
+
+
+class TestSubresources:
+    def test_bind_sets_node_name_once(self):
+        s = FakeAPIServer()
+        s.create("pods", serde.pod_to_dict(pod("p0")))
+        s.bind("p0", "n0")
+        assert s.get("pods", "p0")["spec"]["nodeName"] == "n0"
+        with pytest.raises(ConflictError):
+            s.bind("p0", "n1")
+
+    def test_evict_unbinds(self):
+        s = FakeAPIServer()
+        s.create("pods", serde.pod_to_dict(pod("p0", node_name="n0")))
+        s.evict("p0")
+        assert s.get("pods", "p0")["spec"].get("nodeName") is None
+
+    def test_evict_blocked_by_pdb(self):
+        s = FakeAPIServer()
+        s.create("pods", serde.pod_to_dict(
+            pod("p0", node_name="n0", labels={"app": "db"})))
+        s.create("pdbs", serde.pdb_to_dict(PodDisruptionBudget(
+            name="db-pdb", label_selector={"app": "db"}, min_available=1)))
+        with pytest.raises(EvictionBlockedError):
+            s.evict("p0")
+        # a second healthy replica restores the allowance
+        s.create("pods", serde.pod_to_dict(
+            pod("p1", node_name="n1", labels={"app": "db"})))
+        s.evict("p0")
+        assert s.get("pods", "p0")["spec"].get("nodeName") is None
+
+    def test_force_evict_bypasses_pdb(self):
+        s = FakeAPIServer()
+        s.create("pods", serde.pod_to_dict(
+            pod("p0", node_name="n0", labels={"app": "db"})))
+        s.create("pdbs", serde.pdb_to_dict(PodDisruptionBudget(
+            name="db-pdb", label_selector={"app": "db"}, min_available=1)))
+        s.evict("p0", force=True)
+        assert s.get("pods", "p0")["spec"].get("nodeName") is None
+
+    def test_sequential_evictions_decrement_allowance(self):
+        s = FakeAPIServer()
+        for i in range(3):
+            s.create("pods", serde.pod_to_dict(
+                pod(f"p{i}", node_name=f"n{i}", labels={"app": "web"})))
+        s.create("pdbs", serde.pdb_to_dict(PodDisruptionBudget(
+            name="web-pdb", label_selector={"app": "web"}, min_available=2)))
+        s.evict("p0")
+        with pytest.raises(EvictionBlockedError):
+            s.evict("p1")
+
+
+class TestIndexes:
+    def test_provider_id_index(self):
+        s = FakeAPIServer()
+        install_default_indexes(s)
+        c = NodeClaim(name="c0", node_pool="default",
+                      provider_id="aws:///us-west-2a/i-0abc")
+        KubeClient(s).create_nodeclaim(c)
+        hits = KubeClient(s).claims_by_provider_id("aws:///us-west-2a/i-0abc")
+        assert [h.name for h in hits] == ["c0"]
+        assert KubeClient(s).claims_by_provider_id("aws:///zz/i-none") == []
+
+
+class TestAdmission:
+    def test_invalid_nodepool_rejected_at_boundary(self):
+        s = FakeAPIServer()
+        install_admission(s)
+        c = KubeClient(s)
+        bad = NodePool(name="bad", requirements=[
+            Requirement(wk.LABEL_OS, ReqOp.IN, ("linux", "windows"))])
+        with pytest.raises(InvalidObjectError, match="os"):
+            c.create_nodepool(bad)
+
+    def test_defaults_applied_on_create(self):
+        s = FakeAPIServer()
+        install_admission(s)
+        c = KubeClient(s)
+        c.create_nodepool(NodePool(name="plain"))
+        stored = c.list_nodepools()[0]
+        keys = {r.key for r in stored.requirements}
+        assert wk.LABEL_CAPACITY_TYPE in keys and wk.LABEL_ARCH in keys
+
+    def test_invalid_pdb_rejected(self):
+        s = FakeAPIServer()
+        install_admission(s)
+        with pytest.raises(InvalidObjectError):
+            KubeClient(s).create_pdb(PodDisruptionBudget(
+                name="both", min_available=1, max_unavailable=1))
+
+
+class TestInformer:
+    def test_initial_list_then_watch(self):
+        s = FakeAPIServer()
+        s.create("pods", serde.pod_to_dict(pod("a")))
+        seen = []
+        inf = Informer(s, "pods",
+                       lambda t, n, o, old: seen.append((t, n)))
+        inf.sync_once()
+        assert inf.has_synced
+        assert seen == [("ADDED", "a")]
+        s.create("pods", serde.pod_to_dict(pod("b")))
+        s.patch("pods", "a", {"priority": 3})
+        inf.sync_once()
+        assert seen == [("ADDED", "a"), ("ADDED", "b"), ("MODIFIED", "a")]
+        assert set(inf.store) == {"a", "b"}
+
+    def test_delete_reaches_store_and_handler(self):
+        s = FakeAPIServer()
+        s.create("pods", serde.pod_to_dict(pod("a")))
+        seen = []
+        inf = Informer(s, "pods", lambda t, n, o, old: seen.append((t, n)))
+        inf.sync_once()
+        s.delete("pods", "a")
+        inf.sync_once()
+        assert ("DELETED", "a") in seen
+        assert inf.store == {}
+
+    def test_relist_after_gone_synthesizes_delta(self):
+        """A reflector whose watch fell off the history ring must relist
+        and reconcile its store, synthesizing handler events for exactly
+        the delta (client-go reflector recovery)."""
+        import collections
+        s = FakeAPIServer()
+        s.create("pods", serde.pod_to_dict(pod("a")))
+        s.create("pods", serde.pod_to_dict(pod("stale")))
+        seen = []
+        inf = Informer(s, "pods", lambda t, n, o, old: seen.append((t, n)))
+        inf.sync_once()
+        assert set(inf.store) == {"a", "stale"}
+        seen.clear()
+        # the informer's connection "breaks"; many events fall off a tiny
+        # ring while it is away
+        s.stop_watch(inf._watch)
+        s._history["pods"] = collections.deque(maxlen=2)
+        s.delete("pods", "stale")
+        s.create("pods", serde.pod_to_dict(pod("c")))
+        s.create("pods", serde.pod_to_dict(pod("d")))
+        s.patch("pods", "a", {"priority": 9})
+        # resuming the watch from the informer's old RV is 410 Gone...
+        with pytest.raises(TooOldError):
+            s.watch("pods", resource_version=inf._rv)
+        # ...so the reflector relists: store replaced, delta synthesized
+        inf._relist()
+        assert set(inf.store) == {"a", "c", "d"}
+        assert ("DELETED", "stale") in seen
+        assert ("ADDED", "c") in seen and ("ADDED", "d") in seen
+        assert ("MODIFIED", "a") in seen
+
+    def test_threaded_informer_converges(self):
+        s = FakeAPIServer()
+        inf = Informer(s, "pods").start()
+        try:
+            for i in range(5):
+                s.create("pods", serde.pod_to_dict(pod(f"p{i}")))
+            import time
+            deadline = time.time() + 5.0
+            while time.time() < deadline and len(inf.store) < 5:
+                time.sleep(0.01)
+            assert len(inf.store) == 5
+        finally:
+            inf.stop()
+
+    def test_informer_set_pumps_in_order(self):
+        s = FakeAPIServer()
+        seen = []
+        iset = InformerSet(s)
+        iset.add("nodepools", lambda t, n, o, old: seen.append(("pool", n)))
+        iset.add("pods", lambda t, n, o, old: seen.append(("pod", n)))
+        s.create("pods", serde.pod_to_dict(pod("p")))
+        s.create("nodepools", serde.nodepool_to_dict(NodePool(name="np")))
+        iset.sync_once()
+        assert seen == [("pool", "np"), ("pod", "p")]
+
+
+class TestClientRoundTrips:
+    def test_nodeclaim_finalizer_flow_via_client(self):
+        s = FakeAPIServer()
+        c = KubeClient(s)
+        c.create_nodeclaim(NodeClaim(name="c0", node_pool="default"))
+        obj = s.get("nodeclaims", "c0")
+        assert obj["metadata"]["finalizers"] == [TERMINATION_FINALIZER]
+        c.delete_nodeclaim("c0", now=10.0)
+        got = c.get_nodeclaim("c0")
+        assert got.deletion_timestamp == 10.0
+        c.remove_nodeclaim_finalizer("c0")
+        with pytest.raises(NotFoundError):
+            c.get_nodeclaim("c0")
+
+    def test_node_taint_helper_is_idempotent(self):
+        from karpenter_provider_aws_tpu.apis.objects import Node
+        from karpenter_provider_aws_tpu.controllers.termination import (
+            DISRUPTION_TAINT,
+        )
+        s = FakeAPIServer()
+        c = KubeClient(s)
+        c.create_node(Node(name="n0", provider_id="aws:///z/i-1"))
+        assert c.taint_node("n0", DISRUPTION_TAINT) is True
+        assert c.taint_node("n0", DISRUPTION_TAINT) is False
+        assert len(c.get_node("n0").taints) == 1
+
+
+class TestReviewRegressions:
+    def test_default_delete_timestamp_is_truthy(self):
+        """delete() without an explicit time must never stamp a falsy
+        deletionTimestamp — every consumer truth-tests it."""
+        s = FakeAPIServer()
+        c = KubeClient(s)
+        c.create_nodeclaim(NodeClaim(name="c0", node_pool="default"))
+        c.delete_nodeclaim("c0")  # no now= given
+        assert c.get_nodeclaim("c0").deletion_timestamp  # truthy
+        # a FakeClock at t=0 still yields a truthy stamp
+        from karpenter_provider_aws_tpu.utils.clock import FakeClock
+        s2 = FakeAPIServer(clock=FakeClock())
+        c2 = KubeClient(s2)
+        c2.create_nodeclaim(NodeClaim(name="c0", node_pool="default"))
+        c2.delete_nodeclaim("c0")
+        assert c2.get_nodeclaim("c0").deletion_timestamp
+
+    def test_pdb_healthy_excludes_spec_deleting_pods(self):
+        """A bound pod marked deleting at the SPEC level is not healthy:
+        the eviction budget must block evicting its sibling."""
+        s = FakeAPIServer()
+        s.create("pods", serde.pod_to_dict(
+            pod("p0", node_name="n0", labels={"app": "db"})))
+        s.create("pods", serde.pod_to_dict(
+            pod("p1", node_name="n1", labels={"app": "db"},
+                deletion_timestamp=5.0)))
+        s.create("pdbs", serde.pdb_to_dict(PodDisruptionBudget(
+            name="db-pdb", label_selector={"app": "db"}, min_available=1)))
+        with pytest.raises(EvictionBlockedError):
+            s.evict("p0")
+
+    def test_index_lookup_overlays_deletion_timestamp(self):
+        """claims_by_provider_id must see the API-level deletion stamp
+        like get/list do — a terminating claim must not look live."""
+        s = FakeAPIServer()
+        install_default_indexes(s)
+        c = KubeClient(s)
+        c.create_nodeclaim(NodeClaim(name="c0", node_pool="default",
+                                     provider_id="aws:///z/i-9"))
+        c.delete_nodeclaim("c0", now=7.0)
+        hits = c.claims_by_provider_id("aws:///z/i-9")
+        assert hits and hits[0].deletion_timestamp == 7.0
+
+    def test_watch_subscribers_are_isolated(self):
+        """A handler mutating its delivered envelope corrupts neither the
+        history replay nor sibling watchers."""
+        s = FakeAPIServer()
+        w1 = s.watch("pods")
+        w2 = s.watch("pods")
+        s.create("pods", serde.pod_to_dict(pod("a")))
+        ev1 = w1.pop_pending()[0]
+        ev1.object["spec"]["name"] = "CORRUPTED"
+        assert w2.pop_pending()[0].object["spec"]["name"] == "a"
+        w3 = s.watch("pods", resource_version=0)  # replays from history
+        assert w3.pop_pending()[0].object["spec"]["name"] == "a"

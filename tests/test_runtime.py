@@ -214,8 +214,21 @@ class TestOperatorAdmissionBackstops:
             "default": NodeClass(name="default"),
             "raid": NodeClass(name="raid", instance_store_policy="RAID0"),
         }
+        pools = [NodePool(name="default"),
+                 NodePool(name="fast", node_class_ref="raid")]
         with pytest.raises(ValueError, match="storage config"):
-            Operator(node_classes=ncs)
+            Operator(node_classes=ncs, node_pools=pools)
+
+    def test_unreferenced_disagreeing_storage_config_tolerated(self, lattice):
+        """A merely-present NodeClass no pool references must not block
+        startup — the solver never uses its storage config."""
+        from karpenter_provider_aws_tpu.apis import NodeClass
+        ncs = {
+            "default": NodeClass(name="default"),
+            "raid": NodeClass(name="raid", instance_store_policy="RAID0"),
+        }
+        Operator(node_classes=ncs,
+                 node_pools=[NodePool(name="default")])  # must not raise
 
     def test_agreeing_storage_configs_accepted(self, lattice):
         from karpenter_provider_aws_tpu.apis import NodeClass

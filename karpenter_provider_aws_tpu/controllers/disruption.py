@@ -73,7 +73,8 @@ class DisruptionController:
                  clock: Optional[Clock] = None,
                  drift_enabled: bool = True,
                  spot_to_spot_consolidation: bool = False,
-                 metrics: Optional[Registry] = None):
+                 metrics: Optional[Registry] = None,
+                 writer=None):
         self.cluster = cluster
         self.solver = solver
         self.node_pools = node_pools
@@ -82,6 +83,8 @@ class DisruptionController:
         self.termination = termination
         self.unavailable = unavailable
         self.clock = clock or Clock()
+        from ..kube.writer import DirectWriter
+        self.writer = writer or DirectWriter(cluster, self.clock)
         self.recorder = recorder or Recorder(self.clock)
         self.drift_enabled = drift_enabled
         self.spot_to_spot_consolidation = spot_to_spot_consolidation
@@ -548,9 +551,10 @@ class DisruptionController:
         action = DisruptionAction(reason=reason, claims=[c.name for c in removed])
         for node in planned:
             claim = self.provisioner._make_claim(node)
-            self.cluster.add_claim(claim)
+            self.writer.create_claim(claim)
             try:
                 self.cloud_provider.create(claim)
+                self.writer.update_claim_status(claim)
             except Exception as e:
                 # ICE or any launch failure: roll back — never drain without
                 # standing replacement capacity
@@ -560,7 +564,7 @@ class DisruptionController:
                                       f"{type(e).__name__}: {e}")
                 for r in action.replacements:
                     self.termination.delete_claim(r)
-                self.cluster.delete_claim(claim.name)
+                self.writer.rollback_claim(claim.name)
                 return False
             action.replacements.append(claim.name)
         self._in_flight.append(action)

@@ -24,11 +24,14 @@ LEAK_GRACE_SECONDS = 30.0  # garbagecollection/controller.go:64
 
 class GarbageCollectionController:
     def __init__(self, cluster: ClusterState, cloud_provider: CloudProvider,
-                 recorder: Optional[Recorder] = None, clock: Optional[Clock] = None):
+                 recorder: Optional[Recorder] = None, clock: Optional[Clock] = None,
+                 writer=None):
         from ..utils.fanout import LazyPool
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.clock = clock or Clock()
+        from ..kube.writer import DirectWriter
+        self.writer = writer or DirectWriter(cluster, self.clock)
         self.recorder = recorder or Recorder(self.clock)
         self._pool = LazyPool(self.EXISTENCE_WORKERS, "gc-exists")
 
@@ -61,10 +64,11 @@ class GarbageCollectionController:
                                   claim.name, f"instance {iid} is gone")
             node = self.cluster.node_for_claim(claim.name)
             if node is not None:
-                # evict_node deletes daemonset pods with the node — no
+                # teardown deletes daemonset pods with the node — no
                 # phantom overhead in future node sizing
-                self.cluster.evict_node(node.name)
-            self.cluster.delete_claim(claim.name)
+                self.writer.teardown_node(node.name)
+            # the backing instance is GONE: hard delete, no finalizer round
+            self.writer.rollback_claim(claim.name)
         # leaked instances: running but unclaimed past the grace window
         for inst in self.cloud_provider.list_instances():
             if inst.id in claimed_ids or inst.state == "terminated":
@@ -83,4 +87,4 @@ class GarbageCollectionController:
         for name in self.cluster.orphaned_leases():
             self.recorder.publish("Normal", "LeaseGarbageCollected", "Lease",
                                   name, "deleting orphaned node lease")
-            self.cluster.delete_lease(name)
+            self.writer.delete_lease(name)

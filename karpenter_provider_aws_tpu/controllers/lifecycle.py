@@ -29,10 +29,13 @@ class LifecycleController:
     def __init__(self, cluster: ClusterState, cloud_provider: CloudProvider,
                  recorder: Optional[Recorder] = None, clock: Optional[Clock] = None,
                  registration_delay: float = 5.0,
-                 metrics: Optional[Registry] = None):
+                 metrics: Optional[Registry] = None,
+                 writer=None):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.clock = clock or Clock()
+        from ..kube.writer import DirectWriter
+        self.writer = writer or DirectWriter(cluster, self.clock)
         self.recorder = recorder or Recorder(self.clock)
         self.registration_delay = registration_delay
         m = wire_core_metrics(metrics or Registry())
@@ -46,8 +49,11 @@ class LifecycleController:
                 continue
             if claim.phase == NodeClaimPhase.LAUNCHED:
                 if claim.launched_at is not None and now - claim.launched_at >= self.registration_delay:
-                    self._register(claim)
-                    self._initialize(claim)  # sim nodes are born Ready
+                    node = self._register(claim)
+                    # sim nodes are born Ready; pass the node we just
+                    # registered — in API mode the mirror only learns of
+                    # it at the next informer pump
+                    self._initialize(claim, node=node)
                 elif now - claim.created_at > REGISTRATION_TTL:
                     self._liveness_delete(claim, "registration deadline exceeded")
             elif claim.phase == NodeClaimPhase.PENDING:
@@ -56,7 +62,7 @@ class LifecycleController:
             elif claim.phase == NodeClaimPhase.REGISTERED:
                 self._initialize(claim)
 
-    def _register(self, claim: NodeClaim) -> None:
+    def _register(self, claim: NodeClaim) -> "Node":
         """Simulated kubelet joins the node and binds nominated pods."""
         node = Node(
             name=claim.name, provider_id=claim.provider_id or "",
@@ -65,26 +71,31 @@ class LifecycleController:
             capacity=dict(claim.capacity), allocatable=dict(claim.allocatable),
             ready=True, created_at=self.clock.now(),
             node_pool=claim.node_pool, node_claim=claim.name)
-        self.cluster.add_node(node)
-        # the (fake) kubelet creates the node's coordination lease
-        self.cluster.add_lease(Lease(name=node.name, owner_node=node.name,
-                                     created_at=self.clock.now()))
+        # the (fake) kubelet joins the node and creates its coordination
+        # lease — through the writer seam, like every k8s-object write
+        self.writer.register_node(node, Lease(
+            name=node.name, owner_node=node.name,
+            created_at=self.clock.now()))
         for pod in self.cluster.nominated_pods(claim.name):
-            self.cluster.bind_pod(pod.name, node.name)
+            self.writer.bind_pod(pod.name, node.name)
         claim.phase = NodeClaimPhase.REGISTERED
         claim.registered_at = self.clock.now()
+        self.writer.update_claim_status(claim)
         self._m_registered.inc(nodepool=claim.node_pool)
         self.recorder.publish("Normal", "Registered", "NodeClaim", claim.name,
                               f"node {node.name} joined")
+        return node
 
-    def _initialize(self, claim: NodeClaim) -> None:
+    def _initialize(self, claim: NodeClaim, node=None) -> None:
         """Registered → Initialized once the node is Ready and startup
         taints cleared (the sim node is born ready)."""
-        node = self.cluster.node_for_claim(claim.name)
+        if node is None:
+            node = self.cluster.node_for_claim(claim.name)
         if node is None or not node.ready:
             return
         claim.phase = NodeClaimPhase.INITIALIZED
         claim.initialized_at = self.clock.now()
+        self.writer.update_claim_status(claim)
         self._m_initialized.inc(nodepool=claim.node_pool)
         self.recorder.publish("Normal", "Initialized", "NodeClaim", claim.name, "")
 
@@ -95,4 +106,6 @@ class LifecycleController:
                 self.cloud_provider.delete(claim)
             except NotFoundError:
                 pass
-        self.cluster.delete_claim(claim.name)
+        # the instance (if any) is gone and no node ever registered: a
+        # hard delete, no drain/finalizer round needed
+        self.writer.rollback_claim(claim.name)

@@ -83,13 +83,19 @@ class Provisioner:
                  clock: Optional[Clock] = None,
                  batch_idle_seconds: float = BATCH_IDLE_SECONDS,
                  batch_max_seconds: float = BATCH_MAX_SECONDS,
-                 metrics: Optional[Registry] = None):
+                 metrics: Optional[Registry] = None,
+                 writer=None):
         self.cluster = cluster
         self.solver = solver
         self.node_pools = node_pools
         self.cloud_provider = cloud_provider
         self.unavailable = unavailable
         self.clock = clock or Clock()
+        from ..kube.writer import DirectWriter
+        # every k8s-object write goes through the writer seam: direct to
+        # the mirror (simulation stratum) or through the apiserver client
+        # (kube/writer.py ApiWriter)
+        self.writer = writer or DirectWriter(cluster, self.clock)
         self.recorder = recorder or Recorder(self.clock)
         self.batch_idle_seconds = batch_idle_seconds
         self.batch_max_seconds = batch_max_seconds
@@ -179,7 +185,7 @@ class Provisioner:
                     if target_is_claim:
                         self.cluster.nominate(pn, node_name)
                     else:
-                        self.cluster.bind_pod(pn, node_name)
+                        self.writer.bind_pod(pn, node_name)
                     result.pods_scheduled += 1
 
         surface_unschedulable(plan)
@@ -224,13 +230,16 @@ class Provisioner:
             bind_existing(current)
         for node in planned:
             claim = self._make_claim(node)
-            self.cluster.add_claim(claim)
+            self.writer.create_claim(claim)
             self._m_created.inc(nodepool=claim.node_pool)
             result.created_claims.append(claim)
             for p in node.pods:
                 self.cluster.nominate(p, claim.name)
             try:
                 self.cloud_provider.create(claim)
+                # write the launch results (providerID/type/zone/phase)
+                # back through the seam — the reference's status update
+                self.writer.update_claim_status(claim)
                 self._m_launched.inc(nodepool=claim.node_pool)
                 result.launched += 1
                 result.pods_scheduled += len(node.pods)
@@ -238,7 +247,7 @@ class Provisioner:
                 # claims NOW so a cross-batch consumer arriving before the
                 # node registers already sees the pinned zone
                 for p in node.pods:
-                    self.cluster.bind_volumes(p, claim.zone)
+                    self.writer.bind_volumes(p, claim.zone)
                 self.recorder.publish("Normal", "Launched", "NodeClaim", claim.name,
                                       f"{claim.instance_type}/{claim.zone}/{claim.capacity_type} "
                                       f"for {len(node.pods)} pod(s)")
@@ -247,7 +256,7 @@ class Provisioner:
                 # pods return to pending and the next pass re-solves with the
                 # tightened ICE mask (instance.go:348-354 feedback loop)
                 result.launch_failures += 1
-                self.cluster.delete_claim(claim.name)
+                self.writer.rollback_claim(claim.name)
                 result.created_claims.pop()
             except Exception as e:
                 # a reconcile loop must survive any launch failure
@@ -256,7 +265,7 @@ class Provisioner:
                 result.launch_failures += 1
                 self.recorder.publish("Warning", "LaunchFailed", "NodeClaim",
                                       claim.name, f"{type(e).__name__}: {e}")
-                self.cluster.delete_claim(claim.name)
+                self.writer.rollback_claim(claim.name)
                 result.created_claims.pop()
         self._m_sched_pods.inc(result.pods_scheduled)
         self._m_unsched_pods.set(result.pods_unschedulable)

@@ -28,10 +28,13 @@ class TerminationController:
     def __init__(self, cluster: ClusterState, cloud_provider: CloudProvider,
                  recorder: Optional[Recorder] = None, clock: Optional[Clock] = None,
                  metrics: Optional[Registry] = None,
-                 termination_grace_period: Optional[float] = None):
+                 termination_grace_period: Optional[float] = None,
+                 writer=None):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.clock = clock or Clock()
+        from ..kube.writer import DirectWriter
+        self.writer = writer or DirectWriter(cluster, self.clock)
         self.recorder = recorder or Recorder(self.clock)
         # None = a PDB-blocked drain waits forever (the pinned reference
         # release); a float force-drains claims terminating longer than
@@ -44,14 +47,7 @@ class TerminationController:
 
     def delete_claim(self, claim_name: str) -> None:
         """Mark for deletion (the k8s delete that starts the finalizer flow)."""
-        claim = self.cluster.claims.get(claim_name)
-        if claim is None:
-            return
-        if not claim.deletion_timestamp:
-            claim.deletion_timestamp = self.clock.now()
-            claim.phase = NodeClaimPhase.TERMINATING
-            # the claim leaves pool_usage() immediately: re-render gauges
-            self.cluster.touch_capacity()
+        self.writer.mark_claim_deleting(claim_name)
 
     def reconcile(self) -> None:
         for claim in list(self.cluster.claims.values()):
@@ -63,10 +59,9 @@ class TerminationController:
                 # only once fully drained (reference disruption.md:33 —
                 # evict via the Eviction API to respect PDBs, wait for the
                 # node to be fully drained before terminating)
-                if all(t.key != DISRUPTION_TAINT.key for t in node.taints):
-                    node.taints.append(DISRUPTION_TAINT)
+                if self.writer.cordon(node, DISRUPTION_TAINT):
                     self.recorder.publish("Normal", "Cordoned", "Node", node.name, "")
-                evicted, blocked = self.cluster.drain_node(node.name)
+                evicted, blocked = self.writer.drain_node(node.name)
                 if evicted:
                     self.recorder.publish("Normal", "Drained", "Node", node.name,
                                           f"evicted {len(evicted)} pod(s)")
@@ -98,7 +93,7 @@ class TerminationController:
                 self._drain_blocked_logged.discard(claim.name)
                 # fully drained (or force-drained): final teardown evicts
                 # any stragglers and deletes daemonset pods with the node
-                self.cluster.evict_node(node.name)
+                self.writer.teardown_node(node.name)
             if claim.provider_id is not None:
                 try:
                     self.cloud_provider.delete(claim)
@@ -107,5 +102,6 @@ class TerminationController:
             claim.phase = NodeClaimPhase.TERMINATED
             self._m_terminated.inc(nodepool=claim.node_pool)
             self._drain_blocked_logged.discard(claim.name)
-            self.cluster.delete_claim(claim.name)
+            # finalizer cleared -> the claim object is removed
+            self.writer.finalize_claim(claim)
             self.recorder.publish("Normal", "Terminated", "NodeClaim", claim.name, "")

@@ -1,0 +1,211 @@
+"""The controllers' write seam: every Kubernetes-object mutation a
+controller makes goes through this interface.
+
+Two implementations, one contract:
+
+- ``DirectWriter`` applies writes straight into the ClusterState mirror —
+  the deterministic simulation stratum (FakeClock unit tests), where
+  read-your-write is immediate.
+- ``ApiWriter`` writes to the fake apiserver through the typed client;
+  the mirror only changes when the operator's informers deliver the watch
+  events (operator/sync.py). This is the reference's wiring: controllers
+  own NO state — they act through the client and observe through caches
+  (cmd/controller/main.go:47-53, operator.go:92-186).
+
+The split keeps controller code identical across strata — the reference
+achieves the same by running envtest (a real apiserver) under its unit
+suites (pkg/test/environment.go:83-162).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..apis.objects import Lease, Node, NodeClaim, NodeClaimPhase, Pod
+from ..state.cluster import ClusterState
+from ..utils.clock import Clock
+from .apiserver import (
+    ConflictError, EvictionBlockedError, NotFoundError,
+)
+from .client import KubeClient
+
+
+class DirectWriter:
+    """Write-through to the ClusterState mirror (simulation stratum)."""
+
+    def __init__(self, cluster: ClusterState, clock: Clock):
+        self.cluster = cluster
+        self.clock = clock
+
+    # ---- claims ------------------------------------------------------------
+
+    def create_claim(self, claim: NodeClaim) -> None:
+        self.cluster.add_claim(claim)
+
+    def update_claim_status(self, claim: NodeClaim) -> None:
+        # in-place mutation is already visible through the mirror
+        pass
+
+    def mark_claim_deleting(self, name: str) -> None:
+        """The k8s delete that starts the finalizer/termination flow."""
+        claim = self.cluster.claims.get(name)
+        if claim is None:
+            return
+        if not claim.deletion_timestamp:
+            claim.deletion_timestamp = self.clock.now()
+            claim.phase = NodeClaimPhase.TERMINATING
+            # the claim leaves pool_usage() immediately: re-render gauges
+            self.cluster.touch_capacity()
+
+    def rollback_claim(self, name: str) -> None:
+        """Hard delete of a claim whose instance never materialized (or is
+        already gone) — no drain, no finalizer round."""
+        self.cluster.delete_claim(name)
+
+    def finalize_claim(self, claim: NodeClaim) -> None:
+        """Termination complete: remove the claim object."""
+        self.cluster.delete_claim(claim.name)
+
+    # ---- nodes -------------------------------------------------------------
+
+    def register_node(self, node: Node, lease: Optional[Lease] = None) -> None:
+        self.cluster.add_node(node)
+        if lease is not None:
+            self.cluster.add_lease(lease)
+
+    def cordon(self, node: Node, taint) -> bool:
+        if all(t.key != taint.key for t in node.taints):
+            node.taints.append(taint)
+            return True
+        return False
+
+    def drain_node(self, node_name: str) -> Tuple[List[Pod], List[Pod]]:
+        return self.cluster.drain_node(node_name)
+
+    def teardown_node(self, node_name: str) -> None:
+        self.cluster.evict_node(node_name)
+
+    # ---- pods / volumes / leases ------------------------------------------
+
+    def bind_pod(self, pod_name: str, node_name: str) -> None:
+        self.cluster.bind_pod(pod_name, node_name)
+
+    def bind_volumes(self, pod_name: str, zone: Optional[str]) -> None:
+        self.cluster.bind_volumes(pod_name, zone)
+
+    def delete_lease(self, name: str) -> None:
+        self.cluster.delete_lease(name)
+
+
+class ApiWriter:
+    """Write-through to the apiserver; the mirror follows via informers."""
+
+    def __init__(self, kube: KubeClient, cluster: ClusterState, clock: Clock):
+        self.kube = kube
+        self.cluster = cluster
+        self.clock = clock
+
+    # ---- claims ------------------------------------------------------------
+
+    def create_claim(self, claim: NodeClaim) -> None:
+        self.kube.create_nodeclaim(claim)
+
+    def update_claim_status(self, claim: NodeClaim) -> None:
+        try:
+            self.kube.update_nodeclaim(claim)
+        except NotFoundError:
+            pass  # deleted out from under us; the next reconcile observes it
+
+    def mark_claim_deleting(self, name: str) -> None:
+        try:
+            self.kube.delete_nodeclaim(name, now=self.clock.now())
+        except NotFoundError:
+            pass
+        # the mirror's claim leaves pool_usage() when the MODIFIED event
+        # lands; gauges re-render then
+
+    def rollback_claim(self, name: str) -> None:
+        try:
+            self.kube.delete_nodeclaim_now(name)
+        except NotFoundError:
+            pass
+
+    def finalize_claim(self, claim: NodeClaim) -> None:
+        self.kube.remove_nodeclaim_finalizer(claim.name)
+
+    # ---- nodes -------------------------------------------------------------
+
+    def register_node(self, node: Node, lease: Optional[Lease] = None) -> None:
+        self.kube.create_node(node)
+        if lease is not None:
+            self.kube.create_lease(lease)
+
+    def cordon(self, node: Node, taint) -> bool:
+        try:
+            return self.kube.taint_node(node.name, taint)
+        except NotFoundError:
+            return False
+
+    def drain_node(self, node_name: str) -> Tuple[List[Pod], List[Pod]]:
+        """PDB-respecting drain THROUGH the eviction subresource: the
+        server enforces budgets (the real Eviction API contract); we
+        report (evicted, blocked) from its verdicts. Pod set comes from
+        the mirror — the same information a real drainer lists."""
+        evicted: List[Pod] = []
+        blocked: List[Pod] = []
+        for pod in self.cluster.pods_by_node().get(node_name, []):
+            if pod.is_daemonset:
+                continue
+            try:
+                self.kube.evict_pod(pod.name)
+                evicted.append(pod)
+            except EvictionBlockedError:
+                blocked.append(pod)
+            except NotFoundError:
+                continue
+        return evicted, blocked
+
+    def teardown_node(self, node_name: str) -> None:
+        """Final teardown: force-evict stragglers (grace-zero delete
+        analog), remove daemonset pods with the node, delete the node."""
+        for pod in self.cluster.pods_by_node().get(node_name, []):
+            try:
+                if pod.is_daemonset:
+                    self.kube.delete_pod(pod.name)
+                else:
+                    self.kube.evict_pod(pod.name, force=True)
+            except NotFoundError:
+                continue
+        try:
+            self.kube.delete_node(node_name)
+        except NotFoundError:
+            pass
+
+    # ---- pods / volumes / leases ------------------------------------------
+
+    def bind_pod(self, pod_name: str, node_name: str) -> None:
+        try:
+            self.kube.bind_pod(pod_name, node_name)
+        except (ConflictError, NotFoundError):
+            # already bound (raced) or deleted — the watch stream carries
+            # whatever the truth is
+            pass
+
+    def bind_volumes(self, pod_name: str, zone: Optional[str]) -> None:
+        """Persist WaitForFirstConsumer zone pins server-side (the CSI
+        controller analog); the mirror converges via the pvcs informer."""
+        if not zone:
+            return
+        pod = self.cluster.pods.get(pod_name)
+        if pod is None:
+            return
+        for cname in pod.volume_claims:
+            pvc = self.cluster.pvcs.get(cname)
+            if pvc is not None and pvc.bound_zone is None:
+                try:
+                    self.kube.patch_pvc(cname, boundZone=zone)
+                except NotFoundError:
+                    pass
+
+    def delete_lease(self, name: str) -> None:
+        self.kube.delete_lease(name)

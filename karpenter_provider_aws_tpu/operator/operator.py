@@ -50,7 +50,16 @@ class Operator:
                  clock: Optional[Clock] = None,
                  node_pools: Optional[Sequence[NodePool]] = None,
                  node_classes: Optional[Dict[str, NodeClass]] = None,
-                 interruption_queue: Optional[FakeQueue] = None):
+                 interruption_queue: Optional[FakeQueue] = None,
+                 api_server=None):
+        """``api_server`` (kube.FakeAPIServer) switches the operator into
+        API mode: controllers write through the apiserver client and the
+        ClusterState mirror is fed ONLY by informers (operator/sync.py) —
+        the reference's wiring (cmd/controller/main.go:47-53). Without
+        it, writes go straight to the mirror (deterministic simulation
+        stratum). NodePools/NodeClasses passed here are seeded INTO the
+        apiserver in API mode; later API writes supersede them
+        (watch-driven config)."""
         self.options = options or Options()
         self.options.validate()
         self.clock = clock or Clock()
@@ -58,6 +67,7 @@ class Operator:
             "default": NodeClass(name="default",
                                  role=f"KarpenterNodeRole-{self.options.cluster_name}")}
         pool_list = list(node_pools) if node_pools else [NodePool(name="default")]
+        self._lattice_storage = None   # unknown when a lattice is passed in
         if lattice is not None:
             self.lattice = lattice
         else:
@@ -72,19 +82,11 @@ class Operator:
             default_nc = (self.node_classes.get("default")
                           or next(iter(self.node_classes.values())))
             default_storage = storage_config(default_nc)
-            referenced = {p.node_class_ref for p in pool_list}
-            for name in sorted(referenced):
-                nc = self.node_classes.get(name)
-                if nc is not None and storage_config(nc) != default_storage:
-                    raise ValueError(
-                        f"NodeClass/{nc.name}: storage config (instanceStorePolicy/"
-                        f"blockDeviceMappings/amiFamily root device) differs from "
-                        f"NodeClass/{default_nc.name}'s; the lattice carries one "
-                        f"storage config — pass a per-config lattice explicitly")
             self.lattice = build_lattice(
                 vm_memory_overhead_percent=self.options.vm_memory_overhead_percent,
                 reserved_enis=self.options.reserved_enis,
                 storage=default_storage)
+            self._lattice_storage = default_storage
         self.cloud = cloud or FakeCloud(self.clock, cluster_name=self.options.cluster_name)
         # connectivity probe before anything else (operator.go:115-117)
         self.cloud.list_instances()
@@ -105,34 +107,54 @@ class Operator:
         self.unavailable = UnavailableOfferings(self.clock)
         self.cluster = ClusterState(self.clock)
         self.node_pools: Dict[str, NodePool] = {p.name: p for p in pool_list}
-        # a pool's OS is its NodeClass AMI family's: reject wiring where
-        # the two disagree (the solver would otherwise schedule pods the
-        # booted AMI can never run)
-        from ..apis.objects import pool_os
-        from ..apis import wellknown as _wk
+        # cross-object config validation (single-valued os, os-vs-ami-
+        # family, storage-config-vs-lattice): programmatically-passed
+        # config fails LOUD here; watch-delivered config runs the same
+        # guard in StateSync (a violating pool is skipped + event)
         for p in self.node_pools.values():
-            # the single-valued-os admission check, enforced even for pools
-            # handed to the Operator programmatically (bypassing webhooks):
-            # pool_os would otherwise silently pin a multi-valued os to
-            # sorted()[0] and mis-type the pool for the solver/label path
-            os_c = p.scheduling_requirements().get(_wk.LABEL_OS)
-            if os_c.include is not None and len(os_c.include) != 1:
-                # covers both multi-valued In AND a contradictory empty
-                # intersection (e.g. label os=windows + requirement In
-                # (linux,)) — pool_os would silently pin linux for either
-                raise ValueError(
-                    f"NodePool/{p.name}: os requirement must resolve to "
-                    f"exactly one OS (a pool's nodes boot one OS), got "
-                    f"{sorted(os_c.include)}")
-            nc = self.node_classes.get(p.node_class_ref)
-            if nc is None:
-                continue
-            family_os = "windows" if nc.ami_family == "Windows" else "linux"
-            if pool_os(p) != family_os:
-                raise ValueError(
-                    f"NodePool/{p.name}: os requirement {pool_os(p)!r} "
-                    f"contradicts NodeClass/{nc.name} amiFamily "
-                    f"{nc.ami_family!r} ({family_os})")
+            err = self._validate_pool_config(p, self.node_classes)
+            if err:
+                raise ValueError(f"NodePool/{p.name}: {err}")
+        # ---- the kube seam: apiserver client + writer + state sync ------
+        # (reference operator.go:92-186 manager/client/indexers; the
+        # DirectWriter keeps the deterministic stratum byte-identical)
+        self.api_server = api_server
+        self.kube = None
+        self.sync = None
+        if api_server is not None:
+            from ..kube import (KubeClient, install_admission,
+                                install_default_indexes)
+            from ..kube.apiserver import AlreadyExistsError
+            from ..kube.writer import ApiWriter
+            from .sync import StateSync
+            install_default_indexes(api_server)
+            install_admission(api_server)
+            if api_server._clock is None:
+                api_server._clock = self.clock
+            self.kube = KubeClient(api_server)
+            # seed programmatically-passed config into the server (tests
+            # may also have pre-created objects there — first write wins)
+            for pool in self.node_pools.values():
+                try:
+                    self.kube.create_nodepool(pool)
+                except AlreadyExistsError:
+                    pass
+            for nc in self.node_classes.values():
+                try:
+                    self.kube.create_nodeclass(nc)
+                except AlreadyExistsError:
+                    pass
+            self.writer = ApiWriter(self.kube, self.cluster, self.clock)
+            self.sync = StateSync(
+                api_server, self.cluster, self.node_pools, self.node_classes,
+                synced_gauge=self.metrics.gauge(
+                    "karpenter_cluster_state_synced"),
+                config_guard=self._validate_pool_config,
+                recorder=self.recorder)
+            self.sync.sync_once()   # initial list: config + state hydrated
+        else:
+            from ..kube.writer import DirectWriter
+            self.writer = DirectWriter(self.cluster, self.clock)
         # domain providers (reference operator.go:135-178 builds all 11)
         self.subnet_provider = SubnetProvider(self.cloud, self.clock,
             cluster_name=self.options.cluster_name)
@@ -161,17 +183,19 @@ class Operator:
             self.unavailable, self.recorder, self.clock,
             batch_idle_seconds=self.options.batch_idle_duration,
             batch_max_seconds=self.options.batch_max_duration,
-            metrics=self.metrics)
+            metrics=self.metrics, writer=self.writer)
         self.lifecycle = LifecycleController(
             self.cluster, self.cloud_provider, self.recorder, self.clock,
             registration_delay=self.options.registration_delay,
-            metrics=self.metrics)
+            metrics=self.metrics, writer=self.writer)
         self.termination = TerminationController(
             self.cluster, self.cloud_provider, self.recorder, self.clock,
             metrics=self.metrics,
-            termination_grace_period=self.options.termination_grace_period)
+            termination_grace_period=self.options.termination_grace_period,
+            writer=self.writer)
         self.gc = GarbageCollectionController(
-            self.cluster, self.cloud_provider, self.recorder, self.clock)
+            self.cluster, self.cloud_provider, self.recorder, self.clock,
+            writer=self.writer)
         self.tagging = TaggingController(
             self.cluster, self.cloud, self.recorder, self.clock)
         self.disruption = DisruptionController(
@@ -179,7 +203,7 @@ class Operator:
             self.provisioner, self.termination, self.unavailable, self.recorder,
             self.clock, drift_enabled=self.options.drift_enabled,
             spot_to_spot_consolidation=self.options.spot_to_spot_consolidation,
-            metrics=self.metrics)
+            metrics=self.metrics, writer=self.writer)
         self.nodeclass_controller = NodeClassController(
             self.node_classes, self.cluster, self.subnet_provider,
             self.security_group_provider, self.ami_provider,
@@ -198,21 +222,73 @@ class Operator:
                 self.unavailable, self.recorder, self.clock, self.metrics)
         self._last_cache_cleanup = 0.0
 
+    def _validate_pool_config(self, pool: NodePool,
+                              node_classes: Dict[str, NodeClass]):
+        """Cross-object config checks a single-object admission webhook
+        cannot perform. Returns an error string, or None when valid.
+
+        - os requirement must resolve to exactly ONE os (pool_os would
+          silently pin sorted()[0] for multi-valued or contradictory
+          input and mis-type the pool for the solver/label path)
+        - the pool's os must match its NodeClass amiFamily's (the solver
+          would otherwise schedule pods the booted AMI can never run)
+        - the NodeClass's storage config must match the lattice's (one
+          lattice carries ONE ephemeral-storage resolution; a differing
+          class would silently mis-state storage for the pool's nodes)
+        """
+        from ..apis.objects import pool_os
+        from ..apis import wellknown as _wk
+        os_c = pool.scheduling_requirements().get(_wk.LABEL_OS)
+        if os_c.include is not None and len(os_c.include) != 1:
+            return (f"os requirement must resolve to exactly one OS (a "
+                    f"pool's nodes boot one OS), got {sorted(os_c.include)}")
+        nc = node_classes.get(pool.node_class_ref)
+        if nc is None:
+            return None
+        family_os = "windows" if nc.ami_family == "Windows" else "linux"
+        if pool_os(pool) != family_os:
+            return (f"os requirement {pool_os(pool)!r} contradicts "
+                    f"NodeClass/{nc.name} amiFamily {nc.ami_family!r} "
+                    f"({family_os})")
+        if (self._lattice_storage is not None
+                and storage_config(nc) != self._lattice_storage):
+            return (f"NodeClass/{nc.name} storage config (instanceStore"
+                    f"Policy/blockDeviceMappings/amiFamily root device) "
+                    f"differs from the lattice's; the lattice carries one "
+                    f"storage config — pass a per-config lattice explicitly")
+        return None
+
     # ---- run loop --------------------------------------------------------
 
+    def sync_once(self) -> int:
+        """Pump the informers into the mirror (API mode; no-op direct)."""
+        return self.sync.sync_once() if self.sync is not None else 0
+
     def run_once(self, force_provision: bool = False) -> None:
-        """One deterministic reconcile pass over every controller."""
+        """One deterministic reconcile pass over every controller. In API
+        mode the informer pump runs between controllers so each observes
+        its predecessors' writes within the pass — the deterministic
+        analog of the threaded runtime's continuous watch delivery."""
+        self.sync_once()
         if force_provision or self.provisioner.batch_ready():
             self.provisioner.provision_once()
+        self.sync_once()
         self.nodeclass_controller.reconcile()
         self.pricing_controller.reconcile()
         self.lifecycle.reconcile()
+        self.sync_once()
         self.tagging.reconcile()
         if self.interruption is not None:
             self.interruption.reconcile()
+            # disruption must observe interruption's claim deletions (a
+            # doomed claim must neither be a candidate nor landing space)
+            self.sync_once()
         self.disruption.reconcile()
+        self.sync_once()
         self.termination.reconcile()
+        self.sync_once()
         self.gc.reconcile()
+        self.sync_once()
         self.emit_gauges()
         now = self.clock.now()
         if now - self._last_cache_cleanup >= 10.0:  # ICE cleanup cadence (cache.go:39-42)

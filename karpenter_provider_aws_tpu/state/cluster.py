@@ -498,6 +498,68 @@ class ClusterState:
                 usage[claim.node_pool] = usage.get(claim.node_pool, np.zeros((R,), np.float32)) + vec
             return usage
 
+    # ---- watch-stream appliers (operator/sync.py StateSync) ---------------
+    # The mirror as informer cache: these locked appliers replace whole
+    # objects from watch events while routing state TRANSITIONS through
+    # the same side-effecting paths the direct stratum uses (bind_pod's
+    # startup samples + WaitForFirstConsumer pins, capacity_rev bumps).
+
+    def apply_pod_spec(self, pod: Pod) -> None:
+        with self._lock:
+            existing = self.pods.get(pod.name)
+            if existing is None:
+                self.add_pod(pod)
+                return
+            old_node, new_node = existing.node_name, pod.node_name
+            if old_node is None and new_node is not None:
+                # install unbound, then bind — side effects fire exactly
+                # as in the direct stratum
+                pod.node_name = None
+                self.pods[pod.name] = pod
+                self.bind_pod(pod.name, new_node)
+            else:
+                self.pods[pod.name] = pod
+
+    def apply_node(self, node: Node) -> None:
+        with self._lock:
+            if node.name in self.nodes:
+                # in-place refresh (e.g. a cordon taint) can flip capacity
+                # semantics without an add/delete
+                self.nodes[node.name] = node
+                self.capacity_rev += 1
+            else:
+                self.add_node(node)
+
+    def apply_claim(self, claim: NodeClaim) -> None:
+        with self._lock:
+            prev = self.claims.get(claim.name)
+            if prev is None:
+                self.add_claim(claim)
+                return
+            self.claims[claim.name] = claim
+            if (bool(prev.deletion_timestamp) != bool(claim.deletion_timestamp)
+                    or prev.phase != claim.phase):
+                # deletion stamp / phase flips change pool_usage() without
+                # an add/delete
+                self.capacity_rev += 1
+
+    def delete_pvc(self, name: str) -> None:
+        with self._lock:
+            self.pvcs.pop(name, None)
+
+    def delete_storage_class(self, name: str) -> None:
+        with self._lock:
+            self.storage_classes.pop(name, None)
+
+    def apply_pvc(self, pvc) -> None:
+        with self._lock:
+            existing = self.pvcs.get(pvc.name)
+            if existing is not None and existing.bound_zone and not pvc.bound_zone:
+                # the mirror may have fast-forwarded a WaitForFirstConsumer
+                # pin before the server write landed — never regress it
+                pvc.bound_zone = existing.bound_zone
+            self.add_pvc(pvc)
+
     def reset(self) -> None:
         with self._lock:
             self.pods.clear()

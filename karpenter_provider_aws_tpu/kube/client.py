@@ -212,10 +212,13 @@ def install_default_indexes(server: FakeAPIServer) -> None:
 
 
 def install_admission(server: FakeAPIServer) -> None:
-    """Wire the webhook defaulting + validation chain at the API boundary
-    (reference pkg/webhooks/webhooks.go): invalid NodePools/NodeClasses/
-    PDBs are rejected at create/update, defaults applied first."""
+    """Wire the admission chain at the API boundary (reference
+    pkg/webhooks/webhooks.go): defaults first, then SCHEMA validation
+    (apis/schema.py — the machine-readable CRD contract, patterns/enums/
+    cross-field rules), then the semantic webhooks. Nothing structurally
+    or semantically invalid crosses the seam."""
     from .. import webhooks
+    from ..apis import schema
 
     def _np_default(spec: dict) -> dict:
         pool = serde.nodepool_from_dict(spec)
@@ -223,10 +226,19 @@ def install_admission(server: FakeAPIServer) -> None:
         return serde.nodepool_to_dict(pool)
 
     def _np_validate(spec: dict) -> List[str]:
+        errs = schema.validate("nodepools", spec)
+        if errs:
+            return errs   # semantic checks assume structural validity
         return webhooks.validate_node_pool(serde.nodepool_from_dict(spec))
 
     def _nc_validate(spec: dict) -> List[str]:
+        errs = schema.validate("nodeclasses", spec)
+        if errs:
+            return errs
         return webhooks.validate_node_class(serde.nodeclass_from_dict(spec))
+
+    def _claim_validate(spec: dict) -> List[str]:
+        return schema.validate("nodeclaims", spec)
 
     def _pdb_validate(spec: dict) -> List[str]:
         return webhooks.validate_pdb(serde.pdb_from_dict(spec))
@@ -234,4 +246,5 @@ def install_admission(server: FakeAPIServer) -> None:
     server.register_admission("nodepools", validate=_np_validate,
                               default=_np_default)
     server.register_admission("nodeclasses", validate=_nc_validate)
+    server.register_admission("nodeclaims", validate=_claim_validate)
     server.register_admission("pdbs", validate=_pdb_validate)

@@ -1,0 +1,247 @@
+"""Machine-readable schema contract (apis/schema.py → deploy/crds/).
+
+Mirrors the reference's CRD validation surface: per-requirement minValues
+(karpenter.sh_nodepools.yaml:338-401), disruption-budget patterns
+(:55-100), operator enums, label patterns, and the EC2NodeClass inline
+CEL (ec2nodeclass.go:321-330 role XOR instanceProfile) — all enforced at
+the apiserver admission boundary.
+"""
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import (
+    NodeClass, NodePool, Requirement, serde,
+)
+from karpenter_provider_aws_tpu.apis import Operator as ReqOp
+from karpenter_provider_aws_tpu.apis import schema
+from karpenter_provider_aws_tpu.apis import wellknown as wk
+from karpenter_provider_aws_tpu.apis.objects import (
+    DisruptionBudget, KubeletSpec, NodeClaim, NodePoolDisruption, Taint,
+    TaintEffect,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def pool_spec(**kw) -> dict:
+    return serde.nodepool_to_dict(NodePool(name="p", **kw))
+
+
+class TestRoundTrips:
+    def test_default_objects_validate(self):
+        assert schema.validate("nodepools", pool_spec()) == []
+        assert schema.validate("nodeclasses", serde.nodeclass_to_dict(
+            NodeClass(name="d", role="r"))) == []
+        assert schema.validate("nodeclaims", serde.nodeclaim_to_dict(
+            NodeClaim(name="c", node_pool="p"))) == []
+
+    def test_rich_pool_validates(self):
+        spec = pool_spec(
+            weight=50,
+            labels={"team": "a"},
+            requirements=[
+                Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN,
+                            ("spot", "on-demand")),
+                Requirement("karpenter.tpu/instance-cpu", ReqOp.GT, ("4",)),
+                Requirement(wk.LABEL_INSTANCE_TYPE, ReqOp.IN,
+                            ("m5.large", "m5.xlarge", "c5.large"),
+                            min_values=2),
+            ],
+            taints=[Taint(key="dedicated", value="gpu",
+                          effect=TaintEffect.NO_SCHEDULE)],
+            limits={"cpu": "1000", "memory": "1000Gi"},
+            disruption=NodePoolDisruption(budgets=[
+                DisruptionBudget(nodes="10%"),
+                DisruptionBudget(nodes="5", schedule="0 9 * * 1-5",
+                                 duration=8 * 3600.0),
+            ]),
+            kubelet=KubeletSpec(max_pods=58))
+        assert schema.validate("nodepools", spec) == []
+
+    def test_launched_claim_validates(self):
+        claim = NodeClaim(
+            name="c1", node_pool="default", provider_id="aws:///z/i-1",
+            instance_type="m5.large", zone="us-west-2a",
+            capacity_type="spot", phase=__import__(
+                "karpenter_provider_aws_tpu.apis.objects",
+                fromlist=["NodeClaimPhase"]).NodeClaimPhase.LAUNCHED,
+            capacity={"cpu": 2000.0}, allocatable={"cpu": 1930.0})
+        assert schema.validate("nodeclaims",
+                               serde.nodeclaim_to_dict(claim)) == []
+
+
+class TestStructuralRejection:
+    def test_unknown_field_rejected(self):
+        spec = pool_spec()
+        spec["unknownKnob"] = True
+        assert any("unknownKnob" in e
+                   for e in schema.validate("nodepools", spec))
+
+    def test_bad_budget_nodes_pattern(self):
+        spec = pool_spec()
+        spec["disruption"]["budgets"] = [{"nodes": "200%"}]
+        errs = schema.validate("nodepools", spec)
+        assert errs and any("nodes" in e for e in errs)
+
+    def test_bad_budget_duration_rejected(self):
+        """Wire durations are canonical SECONDS (numeric) — a Go-style
+        string or a non-positive number is structurally invalid."""
+        spec = pool_spec()
+        spec["disruption"]["budgets"] = [
+            {"nodes": "10%", "schedule": "* * * * *", "duration": "30s"}]
+        assert schema.validate("nodepools", spec)
+        spec["disruption"]["budgets"] = [
+            {"nodes": "10%", "schedule": "* * * * *", "duration": 0}]
+        assert schema.validate("nodepools", spec)
+
+    def test_bad_limit_quantity_rejected(self):
+        spec = pool_spec()
+        spec["limits"] = {"cpu": "banana"}
+        assert schema.validate("nodepools", spec)
+        spec["limits"] = {"cpu": "1000", "memory": "512Gi", "pods": 100}
+        assert schema.validate("nodepools", spec) == []
+
+    def test_bad_operator_enum(self):
+        spec = pool_spec()
+        spec["requirements"] = [
+            {"key": "team", "operator": "Matches", "values": ["a"]}]
+        assert schema.validate("nodepools", spec)
+
+    def test_min_values_bounds(self):
+        spec = pool_spec()
+        spec["requirements"] = [{"key": "t", "operator": "In",
+                                 "values": ["a"], "minValues": 0}]
+        assert schema.validate("nodepools", spec)
+        spec["requirements"][0]["minValues"] = 51
+        assert schema.validate("nodepools", spec)
+
+    def test_wrong_type_rejected(self):
+        spec = pool_spec()
+        spec["weight"] = "heavy"
+        assert schema.validate("nodepools", spec)
+
+    def test_bad_label_value_rejected(self):
+        spec = pool_spec()
+        spec["labels"] = {"team": "-leading-dash"}
+        assert schema.validate("nodepools", spec)
+
+
+class TestCrossFieldRules:
+    def test_in_requires_values(self):
+        spec = pool_spec()
+        spec["requirements"] = [{"key": "t", "operator": "In", "values": []}]
+        errs = schema.validate("nodepools", spec)
+        assert any("'In' must have a value" in e for e in errs)
+
+    def test_gt_requires_single_int(self):
+        spec = pool_spec()
+        spec["requirements"] = [
+            {"key": "karpenter.tpu/instance-cpu", "operator": "Gt",
+             "values": ["4", "8"]}]
+        assert any("'Gt' or 'Lt'" in e
+                   for e in schema.validate("nodepools", spec))
+        # "-4" is rejected too (structurally, by the value pattern —
+        # label values never start with '-')
+        spec["requirements"][0]["values"] = ["-4"]
+        assert schema.validate("nodepools", spec)
+
+    def test_min_values_coverage(self):
+        spec = pool_spec()
+        spec["requirements"] = [
+            {"key": "node.kubernetes.io/instance-type", "operator": "In",
+             "values": ["m5.large"], "minValues": 3}]
+        assert any("minValues" in e
+                   for e in schema.validate("nodepools", spec))
+
+    def test_exists_must_not_have_values(self):
+        spec = pool_spec()
+        spec["requirements"] = [
+            {"key": "team", "operator": "Exists", "values": ["a"]}]
+        assert any("Exists" in e for e in schema.validate("nodepools", spec))
+
+    def test_schedule_requires_duration(self):
+        spec = pool_spec()
+        spec["disruption"]["budgets"] = [
+            {"nodes": "10%", "schedule": "0 9 * * *"}]
+        assert any("duration" in e
+                   for e in schema.validate("nodepools", spec))
+
+    def test_role_xor_instance_profile(self):
+        both = serde.nodeclass_to_dict(
+            NodeClass(name="d", role="r", instance_profile="p"))
+        assert any("role or instanceProfile" in e
+                   for e in schema.validate("nodeclasses", both))
+        neither = serde.nodeclass_to_dict(NodeClass(name="d"))
+        assert any("role or instanceProfile" in e
+                   for e in schema.validate("nodeclasses", neither))
+
+
+class TestAdmissionIntegration:
+    def test_schema_errors_surface_through_apiserver(self):
+        from karpenter_provider_aws_tpu.kube import (
+            FakeAPIServer, InvalidObjectError, install_admission,
+        )
+        s = FakeAPIServer()
+        install_admission(s)
+        spec = pool_spec()
+        spec["disruption"]["budgets"] = [{"nodes": "999%"}]
+        with pytest.raises(InvalidObjectError, match="nodes"):
+            s.create("nodepools", spec)
+
+    def test_invalid_claim_rejected_at_boundary(self):
+        from karpenter_provider_aws_tpu.kube import (
+            FakeAPIServer, InvalidObjectError, install_admission,
+        )
+        s = FakeAPIServer()
+        install_admission(s)
+        spec = serde.nodeclaim_to_dict(NodeClaim(name="c", node_pool="p"))
+        spec["phase"] = "Exploded"
+        with pytest.raises(InvalidObjectError, match="phase"):
+            s.create("nodeclaims", spec)
+
+
+class TestArtifacts:
+    def test_checked_in_crds_are_current(self):
+        """deploy/crds/ must match the generator byte-for-byte (the
+        reference's make-codegen freshness contract)."""
+        r = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "gen_crds.py"), "--check"],
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_crd_documents_are_structural(self):
+        """apiextensions v1 structural-schema legality: no type arrays,
+        no prefixItems/propertyNames/anyOf, no null enum members —
+        nullable: true instead (kubectl apply must not choke)."""
+        def walk(node):
+            if isinstance(node, dict):
+                assert not isinstance(node.get("type"), list), node
+                for bad in ("prefixItems", "propertyNames", "anyOf"):
+                    assert bad not in node, bad
+                if isinstance(node.get("enum"), list):
+                    assert None not in node["enum"], node
+                if "exclusiveMinimum" in node:
+                    assert isinstance(node["exclusiveMinimum"], bool), node
+                for v in node.values():
+                    walk(v)
+            elif isinstance(node, list):
+                for v in node:
+                    walk(v)
+        for kind in ("nodepools", "nodeclasses", "nodeclaims"):
+            walk(schema.crd_document(kind))
+
+    def test_crd_documents_carry_cel_rules(self):
+        doc = schema.crd_document("nodepools")
+        spec_schema = (doc["spec"]["versions"][0]["schema"]
+                       ["openAPIV3Schema"]["properties"]["spec"])
+        rules = spec_schema["x-kubernetes-validations"]
+        assert any("minValues" in r["message"] for r in rules)
+        doc = schema.crd_document("nodeclasses")
+        spec_schema = (doc["spec"]["versions"][0]["schema"]
+                       ["openAPIV3Schema"]["properties"]["spec"])
+        assert any("role" in r["message"]
+                   for r in spec_schema["x-kubernetes-validations"])

@@ -25,6 +25,8 @@ RESOURCE_AXES = (
     "pods",                      # count (ENI-limited density lives here)
     "ephemeral-storage",         # MiB
     "nvidia.com/gpu",            # count
+    "amd.com/gpu",               # count (reference types.go:176-192 maps
+    "habana.ai/gaudi",           #   GPUs per manufacturer: nvidia/amd/habana)
     "aws.amazon.com/neuron",     # count
     "vpc.amazonaws.com/efa",     # count
     "vpc.amazonaws.com/pod-eni", # count

@@ -116,8 +116,16 @@ def capacity_vec(spec: cat.InstanceTypeSpec, kc: Optional[KubeletConfiguration] 
     vec[axis("memory")] = vm_usable_memory_mib(spec.memory_mib, spec.arch, vm_memory_overhead_percent)
     vec[axis("pods")] = pods
     vec[axis("ephemeral-storage")] = ephemeral_storage_mib(spec, storage)
-    vec[axis("nvidia.com/gpu")] = spec.gpu_count
-    vec[axis("aws.amazon.com/neuron")] = spec.accelerator_count if spec.accelerator_name in ("inferentia", "inferentia2", "trainium") else 0
+    # GPUs surface as per-manufacturer extended resources (reference
+    # types.go:176-192: nvidia.com/gpu, amd.com/gpu, habana.ai/gaudi)
+    gm = (spec.gpu_manufacturer or "").lower()
+    vec[axis("nvidia.com/gpu")] = spec.gpu_count if gm in ("", "nvidia") else 0
+    vec[axis("amd.com/gpu")] = spec.gpu_count if gm == "amd" else 0
+    vec[axis("habana.ai/gaudi")] = spec.gpu_count if gm == "habana" else 0
+    vec[axis("aws.amazon.com/neuron")] = (
+        spec.accelerator_count
+        if (spec.accelerator_name or "").lower()
+        in ("inferentia", "inferentia2", "trainium") else 0)
     vec[axis("vpc.amazonaws.com/efa")] = spec.efa_count
     vec[axis("vpc.amazonaws.com/pod-eni")] = spec.pod_eni_count
     vec[axis("attachable-volumes")] = ebs_attach_limit(spec.hypervisor, spec.enis)

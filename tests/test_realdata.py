@@ -1,0 +1,153 @@
+"""Real-data catalog: reference fixtures → JSON → lattice.
+
+The imported facts (tools/import_reference_data.py from the reference's
+zz_generated tables) must survive into the lattice EXACTLY: hardware
+shapes from pkg/fake/zz_generated.describe_instance_types.go, ENI/pod
+density + trunking from zz_generated.vpclimits.go, prices from
+zz_generated.pricing_aws.go (us-east-1), and the trn1 Neuron hardcodes
+(types.go:281-291).
+"""
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis.resources import RESOURCE_AXES
+from karpenter_provider_aws_tpu.lattice import build_lattice
+from karpenter_provider_aws_tpu.lattice.realdata import (
+    DEFAULT_PATH, load_catalog, parse_family,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+REFERENCE = pathlib.Path("/root/reference")
+
+
+def ax(name):
+    return RESOURCE_AXES.index(name)
+
+
+@pytest.fixture(scope="module")
+def specs():
+    return load_catalog()
+
+
+@pytest.fixture(scope="module")
+def lattice(specs):
+    return build_lattice(specs)
+
+
+class TestLoader:
+    def test_all_fixture_types_load(self, specs):
+        names = {s.name for s in specs}
+        assert {"m5.large", "m5.metal", "c6g.large", "t4g.medium",
+                "dl1.24xlarge", "inf1.2xlarge", "trn1.2xlarge",
+                "g4dn.8xlarge", "p3.8xlarge", "m6idn.32xlarge"} <= names
+        assert len(specs) == 15
+
+    def test_family_parsing(self):
+        assert parse_family("m6idn") == ("m", 6)
+        assert parse_family("trn1") == ("trn", 1)
+        assert parse_family("g4dn") == ("g", 4)
+        assert parse_family("c6g") == ("c", 6)
+
+    def test_m5_large_facts(self, specs):
+        m5 = next(s for s in specs if s.name == "m5.large")
+        assert (m5.vcpus, m5.memory_mib) == (2, 8192)
+        assert (m5.enis, m5.ipv4_per_eni) == (3, 10)
+        assert m5.pod_eni_count == 9        # vpclimits BranchInterface
+        assert m5.od_price == 0.096         # us-east-1 pricing table
+        assert m5.arch == "amd64" and m5.cpu_manufacturer == "intel"
+        assert m5.network_bandwidth_mbps == 750   # bandwidth table
+
+    def test_graviton_facts(self, specs):
+        c6g = next(s for s in specs if s.name == "c6g.large")
+        assert c6g.arch == "arm64" and c6g.cpu_manufacturer == "aws"
+
+    def test_metal_has_no_hypervisor(self, specs):
+        metal = next(s for s in specs if s.name == "m5.metal")
+        assert metal.hypervisor == ""
+        assert metal.size == "metal"
+
+    def test_accelerators(self, specs):
+        by = {s.name: s for s in specs}
+        assert by["dl1.24xlarge"].gpu_manufacturer == "habana"
+        assert by["dl1.24xlarge"].gpu_count == 8
+        assert by["p3.8xlarge"].gpu_manufacturer == "nvidia"
+        assert by["inf1.6xlarge"].accelerator_count == 4
+        # trn1 Neurons are the reference's hardcoded facts (types.go:283-291)
+        assert by["trn1.2xlarge"].accelerator_name == "Trainium"
+        assert by["trn1.2xlarge"].accelerator_count == 1
+
+
+class TestLatticeFromRealData:
+    def test_real_eni_pod_density(self, lattice):
+        """ENI-limited pods = enis*(ipv4-1)+2 over the REAL vpclimits
+        numbers — the eni-max-pods contract the synthetic catalog only
+        mirrors in shape."""
+        pods_ax = ax("pods")
+        expect = {"m5.large": 29, "m5.xlarge": 58, "t3.large": 35,
+                  "m5.metal": 737, "c6g.large": 29}
+        for name, pods in expect.items():
+            i = lattice.name_to_idx[name]
+            assert lattice.capacity[i, pods_ax] == pods, name
+
+    def test_gpu_resources_by_manufacturer(self, lattice):
+        i = lattice.name_to_idx["dl1.24xlarge"]
+        assert lattice.capacity[i, ax("habana.ai/gaudi")] == 8
+        assert lattice.capacity[i, ax("nvidia.com/gpu")] == 0
+        j = lattice.name_to_idx["p3.8xlarge"]
+        assert lattice.capacity[j, ax("nvidia.com/gpu")] == 4
+        k = lattice.name_to_idx["inf1.6xlarge"]
+        assert lattice.capacity[k, ax("aws.amazon.com/neuron")] == 4
+        t = lattice.name_to_idx["trn1.2xlarge"]
+        assert lattice.capacity[t, ax("aws.amazon.com/neuron")] == 1
+
+    def test_real_prices_reach_offerings(self, lattice):
+        i = lattice.name_to_idx["m5.large"]
+        # on-demand price in a plain AZ is the regional price
+        zi, ci = 0, lattice.capacity_types.index("on-demand")
+        assert abs(lattice.price[i, zi, ci] - 0.096) < 1e-9
+
+    def test_solver_runs_on_real_lattice(self, lattice):
+        from karpenter_provider_aws_tpu.apis import NodePool, Pod
+        from karpenter_provider_aws_tpu.solver import Solver, build_problem
+        pods = [Pod(name=f"p{i}", requests={"cpu": "1", "memory": "2Gi"})
+                for i in range(10)]
+        pods.append(Pod(name="gpu0",
+                        requests={"cpu": "4", "memory": "16Gi",
+                                  "nvidia.com/gpu": 1}))
+        plan = Solver(lattice).solve(build_problem(
+            pods, [NodePool(name="default")], lattice))
+        assert not plan.unschedulable
+        gpu_nodes = [n for n in plan.new_nodes if "gpu0" in n.pods]
+        assert gpu_nodes and gpu_nodes[0].instance_type in (
+            "g4dn.8xlarge", "p3.8xlarge")
+
+    def test_allocatable_matches_reference_formulas(self, lattice):
+        """The overhead math (types.go:341-431) applied to REAL m5.large
+        numbers: kube-reserved cpu for 2 vCPU = 70m (60+10), memory
+        reserved = 11*pods + 255, eviction 100Mi."""
+        i = lattice.name_to_idx["m5.large"]
+        cap_cpu = lattice.capacity[i, ax("cpu")]
+        alloc_cpu = lattice.alloc[i, ax("cpu")]
+        assert cap_cpu == 2000.0
+        assert alloc_cpu == 2000.0 - 70.0
+        cap_mem = lattice.capacity[i, ax("memory")]
+        alloc_mem = lattice.alloc[i, ax("memory")]
+        reserved = 11 * 29 + 255
+        assert abs((cap_mem - alloc_mem) - (reserved + 100)) < 1e-3
+
+
+class TestImporterFreshness:
+    @pytest.mark.skipif(not REFERENCE.exists(),
+                        reason="reference checkout unavailable")
+    def test_checked_in_catalog_is_current(self, tmp_path):
+        out = tmp_path / "cat.json"
+        r = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "import_reference_data.py"),
+             "--out", str(out)],
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        assert out.read_text() == DEFAULT_PATH.read_text()

@@ -34,13 +34,22 @@ def main(argv=None) -> int:
     p.add_argument("--reported", default=None,
                    help="CSV of reported allocatable "
                         "(instance_type,cpu_m,memory_mib)")
+    p.add_argument("--catalog", default=None,
+                   help="'real' (bundled reference-fixture catalog) or a "
+                        "real-data JSON path (lattice/realdata.py schema); "
+                        "default: the synthetic catalog")
     args = p.parse_args(argv)
 
     from karpenter_provider_aws_tpu.apis.resources import RESOURCE_AXES
     from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
 
+    if args.catalog:
+        from karpenter_provider_aws_tpu.lattice.realdata import load_catalog
+        specs = load_catalog(None if args.catalog == "real" else args.catalog)
+    else:
+        specs = build_catalog()
     lattice = build_lattice(
-        build_catalog(), vm_memory_overhead_percent=args.overhead_percent)
+        specs, vm_memory_overhead_percent=args.overhead_percent)
     cpu_ax = RESOURCE_AXES.index("cpu")
     mem_ax = RESOURCE_AXES.index("memory")
     pods_ax = RESOURCE_AXES.index("pods")

@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Import REAL EC2 data from the reference's generated Go tables into the
+framework's JSON catalog format.
+
+The reference compiles scraped reality into Go sources (hack/code/
+generators → pkg/fake/zz_generated.describe_instance_types.go hardware
+fixtures, pkg/providers/pricing/zz_generated.pricing_aws.go on-demand
+prices, pkg/providers/instancetype/zz_generated.{bandwidth,vpclimits}.go
+network tables). This tool parses those DATA tables (facts about EC2, not
+code) and emits the JSON schema lattice/realdata.py loads, so the solver
+can run over real instance types, real ENI/pod-density limits, and real
+prices instead of the synthetic catalog.
+
+Usage:
+  python tools/import_reference_data.py \
+      --reference /root/reference \
+      --out karpenter_provider_aws_tpu/lattice/data/reference_catalog.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import re
+import sys
+
+
+def _s(pattern: str, block: str, default=None):
+    m = re.search(pattern, block)
+    return m.group(1) if m else default
+
+
+def _i(pattern: str, block: str, default=0):
+    v = _s(pattern, block)
+    return int(v) if v is not None else default
+
+
+def parse_instance_types(path: pathlib.Path) -> dict:
+    """pkg/fake/zz_generated.describe_instance_types.go →
+    {name: hardware dict}. Each InstanceTypeInfo literal becomes one
+    entry; nested info blocks are matched within the entry's extent."""
+    text = path.read_text()
+    # split on the InstanceType field; each chunk runs to the next one
+    chunks = re.split(r'\n\s*InstanceType:\s+aws\.String\("', text)[1:]
+    out = {}
+    for chunk in chunks:
+        name = chunk[: chunk.index('"')]
+        block = chunk
+        arch = _s(r'SupportedArchitectures:\s+aws\.StringSlice\(\[\]string\{"([^"]+)"', block, "x86_64")
+        gpu = re.search(
+            r'GpuInfo:.*?Name:\s+aws\.String\("([^"]+)"\).*?'
+            r'Manufacturer:\s+aws\.String\("([^"]+)"\).*?'
+            r'Count:\s+aws\.Int64\((\d+)\).*?SizeInMiB:\s+aws\.Int64\((\d+)\)',
+            block, re.S)
+        accel = re.search(
+            r'InferenceAcceleratorInfo:.*?Name:\s+aws\.String\("([^"]+)"\).*?'
+            r'Manufacturer:\s+aws\.String\("([^"]+)"\).*?Count:\s+aws\.Int64\((\d+)\)',
+            block, re.S)
+        # trn1's NeuronInfo rides the same InferenceAccelerator shape in
+        # newer fixtures; the pinned one models Trainium via GpuInfo-less
+        # InferenceAcceleratorInfo too
+        out[name] = {
+            "name": name,
+            "arch": "arm64" if arch == "arm64" else "amd64",
+            "cpuManufacturer": (re.search(
+                r'ProcessorInfo:.*?Manufacturer:\s+aws\.String\("([^"]+)"\)',
+                block, re.S).group(1).lower()
+                if re.search(r'ProcessorInfo:.*?Manufacturer', block, re.S)
+                else "intel"),
+            "hypervisor": _s(r'Hypervisor:\s+aws\.String\("([^"]+)"\)', block, ""),
+            "bareMetal": _s(r'BareMetal:\s+aws\.Bool\((\w+)\)', block, "false") == "true",
+            "vcpus": _i(r'DefaultVCpus:\s+aws\.Int64\((\d+)\)', block),
+            "memoryMiB": _i(r'MemoryInfo:\s+&ec2\.MemoryInfo\{\s*SizeInMiB:\s+aws\.Int64\((\d+)\)', block),
+            "enis": _i(r'MaximumNetworkInterfaces:\s+aws\.Int64\((\d+)\)', block),
+            "ipv4PerEni": _i(r'Ipv4AddressesPerInterface:\s+aws\.Int64\((\d+)\)', block),
+            "localNvmeGb": _i(r'InstanceStorageInfo:.*?TotalSizeInGB:\s+aws\.Int64\((\d+)\)', block),
+            "efaCount": _i(r'MaximumEfaInterfaces:\s+aws\.Int64\((\d+)\)', block),
+            "gpuName": gpu.group(1) if gpu else None,
+            "gpuManufacturer": gpu.group(2).lower() if gpu else None,
+            "gpuCount": int(gpu.group(3)) if gpu else 0,
+            "gpuMemoryMiB": int(gpu.group(4)) if gpu else 0,
+            "acceleratorName": accel.group(1) if accel else None,
+            "acceleratorManufacturer": (accel.group(2).lower()
+                                        if accel else None),
+            "acceleratorCount": int(accel.group(3)) if accel else 0,
+        }
+    return out
+
+
+def parse_prices(path: pathlib.Path, region: str = "us-east-1") -> dict:
+    """zz_generated.pricing_aws.go → {type: $/hr} for one region."""
+    text = path.read_text()
+    m = re.search(r'"%s":\s*\{(.*?)\n\t\},' % re.escape(region), text, re.S)
+    if m is None:
+        raise SystemExit(f"region {region} not in {path}")
+    return {t: float(p) for t, p in
+            re.findall(r'"([^"]+)":\s*([0-9.]+)', m.group(1))}
+
+
+def parse_bandwidth(path: pathlib.Path) -> dict:
+    text = path.read_text()
+    return {t: int(b) for t, b in
+            re.findall(r'"([^"]+)":\s+(\d+),', text)}
+
+
+def parse_vpclimits(path: pathlib.Path) -> dict:
+    """zz_generated.vpclimits.go → {type: {enis, ipv4PerEni,
+    podEniCount}} (BranchInterface = security-groups-for-pods trunking)."""
+    text = path.read_text()
+    out = {}
+    for m in re.finditer(
+            r'"([^"]+)":\s*\{\s*Interface:\s*(\d+),\s*'
+            r'IPv4PerInterface:\s*(\d+),\s*'
+            r'IsTrunkingCompatible:\s*(\w+),\s*'
+            r'BranchInterface:\s*(\d+),', text):
+        name, enis, ipv4, trunk, branch = m.groups()
+        out[name] = {"enis": int(enis), "ipv4PerEni": int(ipv4),
+                     "podEniCount": int(branch) if trunk == "true" else 0}
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--reference", default="/root/reference")
+    p.add_argument("--region", default="us-east-1")
+    p.add_argument("--out", default=str(
+        pathlib.Path(__file__).resolve().parent.parent /
+        "karpenter_provider_aws_tpu" / "lattice" / "data" /
+        "reference_catalog.json"))
+    args = p.parse_args(argv)
+
+    ref = pathlib.Path(args.reference)
+    hw = parse_instance_types(
+        ref / "pkg" / "fake" / "zz_generated.describe_instance_types.go")
+    prices = parse_prices(
+        ref / "pkg" / "providers" / "pricing" / "zz_generated.pricing_aws.go",
+        args.region)
+    bandwidth = parse_bandwidth(
+        ref / "pkg" / "providers" / "instancetype" /
+        "zz_generated.bandwidth.go")
+    vpc = parse_vpclimits(
+        ref / "pkg" / "providers" / "instancetype" /
+        "zz_generated.vpclimits.go")
+
+    # the reference hardcodes Trainium counts pending DescribeInstanceTypes
+    # support (types.go:281-291 awsNeurons) — mirror the same facts
+    TRN1_NEURONS = {"trn1.2xlarge": 1, "trn1.32xlarge": 16,
+                    "trn1n.32xlarge": 16}
+    types = []
+    for name, t in sorted(hw.items()):
+        if name in TRN1_NEURONS and not t["acceleratorCount"]:
+            t = {**t, "acceleratorName": "Trainium",
+                 "acceleratorManufacturer": "aws",
+                 "acceleratorCount": TRN1_NEURONS[name]}
+        v = vpc.get(name, {})
+        t = dict(t)
+        # vpclimits is the authoritative ENI table (the fixture's
+        # NetworkInfo can disagree for multi-card types)
+        if v:
+            t["enis"] = v["enis"]
+            t["ipv4PerEni"] = v["ipv4PerEni"]
+            t["podEniCount"] = v.get("podEniCount", 0)
+        else:
+            t["podEniCount"] = 0
+        t["networkBandwidthMbps"] = bandwidth.get(name, 0)
+        t["odPrice"] = prices.get(name, 0.0)
+        types.append(t)
+
+    doc = {
+        "source": "reference zz_generated tables",
+        "region": args.region,
+        "types": types,
+    }
+    out = pathlib.Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(doc, indent=1, sort_keys=True) + "\n")
+    print(f"wrote {out}: {len(types)} types "
+          f"({sum(1 for t in types if t['odPrice'] > 0)} priced, "
+          f"{sum(1 for t in types if t.get('podEniCount'))} trunking)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -55,6 +55,7 @@ def _clear_pack_caches() -> None:
     pack_packed.clear_cache()
     pack_packed_fused.clear_cache()
     pack_packed_efused.clear_cache()
+    pack_packed_combined.clear_cache()
     pack_probe_fused.clear_cache()
 
 
@@ -644,6 +645,27 @@ def pack_packed_efused(alloc: jnp.ndarray, avail: jnp.ndarray,
     R_ = alloc.shape[1]
     groups, pools = _unpack_inputs(gbuf, G, T, Z, C, NP, A, R_)
     init = _unpack_init(init_buf, n_existing, B, T, Z, C, A, R_)
+    return _encode_decode_set(pack(alloc, avail, price, groups, pools, init),
+                              lean=lean)
+
+
+@partial(jax.jit,
+         static_argnames=("split", "B", "G", "T", "Z", "C", "NP", "A",
+                          "lean"))
+def pack_packed_combined(alloc: jnp.ndarray, avail: jnp.ndarray,
+                         price: jnp.ndarray, buf: jnp.ndarray, split: int,
+                         n_existing: jnp.ndarray,
+                         B: int, G: int, T: int, Z: int, C: int, NP: int,
+                         A: int, lean: bool = False) -> jnp.ndarray:
+    """One-round-trip pack WITH existing bins: groups+pools AND the
+    existing-bin table ride ONE uint8 upload (``buf[:split]`` /
+    ``buf[split:]``), against pack_packed_efused's two. On a tunneled TPU
+    the second upload costs a full link leg — fusing it keeps the solve
+    at exactly one host→device and one device→host transfer."""
+    assert not lean or NP < 2 ** 15
+    R_ = alloc.shape[1]
+    groups, pools = _unpack_inputs(buf[:split], G, T, Z, C, NP, A, R_)
+    init = _unpack_init(buf[split:], n_existing, B, T, Z, C, A, R_)
     return _encode_decode_set(pack(alloc, avail, price, groups, pools, init),
                               lean=lean)
 

@@ -704,23 +704,33 @@ class Solver:
         else:
             B = fresh
 
-        fused = self._fused_inputs(problem, G)
+        fused_np = self._fused_inputs_np(problem, G)
+        fused = jnp.asarray(fused_np) if problem.E == 0 else None
         avail, price = self._device_avail_price(problem)
 
         lat = self.lattice
         while True:
-            init_buf = self._fused_init_np(problem, B) if problem.E else None
             td = time.perf_counter()
-            # one fused input upload (+ one for existing bins) + one fused
-            # result transfer (sync included); lean layout: the plan decode
-            # never reads cum/alloc_cap/pm/po
+            # exactly ONE fused input upload (existing bins ride the same
+            # buffer via pack_packed_combined) + one fused result transfer
+            # (sync included); lean layout: the plan decode never reads
+            # cum/alloc_cap/pm/po
             with self._trace_span("solver.pack"):
-                buf = np.asarray(binpack.pack_packed_efused(
-                    self._alloc, avail, price, fused,
-                    None if init_buf is None else jnp.asarray(init_buf),
-                    problem.E, B,
-                    G, lat.T, lat.Z, lat.C, max(problem.NP, 1),
-                    max(problem.A, 1), lean=True))
+                if problem.E:
+                    init_np = self._fused_init_np(problem, B)
+                    combined = jnp.asarray(
+                        np.concatenate([fused_np, init_np]))
+                    buf = np.asarray(binpack.pack_packed_combined(
+                        self._alloc, avail, price, combined, len(fused_np),
+                        problem.E, B,
+                        G, lat.T, lat.Z, lat.C, max(problem.NP, 1),
+                        max(problem.A, 1), lean=True))
+                else:
+                    buf = np.asarray(binpack.pack_packed_efused(
+                        self._alloc, avail, price, fused, None,
+                        problem.E, B,
+                        G, lat.T, lat.Z, lat.C, max(problem.NP, 1),
+                        max(problem.A, 1), lean=True))
             device_s = time.perf_counter() - td
             dec = _unpack_decode_set(buf, G, lat.T, lat.Z, lat.C,
                                      max(problem.A, 1), lean=True)

@@ -1,0 +1,19 @@
+#!/bin/sh
+# Render deploy/templates/*.yaml with deploy/values.env into deploy/rendered/
+# (the minimal Helm-template analog). Usage: sh deploy/render.sh [values.env]
+# Uses envsubst when present, else a python fallback (same ${VAR} syntax).
+set -e
+dir="$(dirname "$0")"
+values="${1:-$dir/values.env}"
+set -a; . "$values"; set +a
+mkdir -p "$dir/rendered"
+for f in "$dir"/templates/*.yaml; do
+  out="$dir/rendered/$(basename "$f")"
+  if command -v envsubst >/dev/null 2>&1; then
+    envsubst < "$f" > "$out"
+  else
+    python3 -c 'import os,sys; sys.stdout.write(os.path.expandvars(sys.stdin.read()))' < "$f" > "$out"
+  fi
+done
+cp "$dir"/crds/*.yaml "$dir/rendered/"
+echo "rendered $(ls "$dir/rendered" | wc -l) manifests to $dir/rendered/"

@@ -1,0 +1,182 @@
+"""Live-debug dumpers + time-series monitor for soak/scale harnesses.
+
+The analog of the reference's scale-stratum debug tooling:
+test/pkg/debug/{events,node,nodeclaim,pod,monitor}.go (watch dumpers that
+print state deltas while a long test runs) and
+test/pkg/environment/aws/metrics.go:66-119 (the duration-metric pipeline
+that records provisioning/deprovisioning time series for later analysis).
+
+- ``snapshot(op)`` — one structured sample of the control plane.
+- ``Monitor`` — samples on an interval (or on demand) into a list and
+  writes a JSON time-series artifact; tools/soak.py records one per run.
+- ``dump_state(op)`` — a full human-readable dump (nodes with their pods,
+  claims with phases, recent events) for failure diagnosis; the soak
+  harness and tests print it when an invariant breaks.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+def _committed_cost_per_hour(op) -> float:
+    """$/hr of live capacity: registered nodes + unregistered launched
+    claims (the bill the cluster is running up right now)."""
+    lat = op.lattice
+    total = 0.0
+
+    def price(itype, zone, cap):
+        ti = lat.name_to_idx.get(itype)
+        if ti is None or zone not in lat.zones:
+            return 0.0
+        zi = lat.zones.index(zone)
+        ci = lat.capacity_types.index(cap) if cap in lat.capacity_types else 0
+        p = float(lat.price[ti, zi, ci])
+        return p if p == p and p != float("inf") else 0.0
+
+    from .apis import wellknown as wk
+    counted = set()
+    for node in op.cluster.snapshot_nodes():
+        total += price(node.labels.get(wk.LABEL_INSTANCE_TYPE, ""),
+                       node.labels.get(wk.LABEL_ZONE, ""),
+                       node.labels.get(wk.LABEL_CAPACITY_TYPE, "on-demand"))
+        if node.node_claim:
+            counted.add(node.node_claim)
+    for claim in op.cluster.snapshot_claims():
+        if claim.name in counted or claim.deletion_timestamp:
+            continue
+        if claim.instance_type:
+            total += price(claim.instance_type, claim.zone or "",
+                           claim.capacity_type or "on-demand")
+    return round(total, 4)
+
+
+def snapshot(op) -> Dict:
+    """One structured control-plane sample (cheap: locked snapshots)."""
+    cluster = op.cluster
+    claims = cluster.snapshot_claims()
+    return {
+        "t": round(time.time(), 3),
+        "sim_t": round(op.clock.now(), 3),
+        "pending_pods": len(cluster.pending_pods()),
+        "bound_pods": sum(1 for p in cluster.snapshot_pods()
+                          if p.node_name is not None),
+        "nodes": len(cluster.nodes),
+        "claims": len(claims),
+        "claims_deleting": sum(1 for c in claims if c.deletion_timestamp),
+        "cost_per_hour": _committed_cost_per_hour(op),
+        "ice_entries": sum(1 for _ in op.unavailable.entries()),
+    }
+
+
+class Monitor:
+    """Time-series sampler over an Operator (the monitor.go analog).
+
+    ``sample()`` on demand (deterministic loops), or ``start(interval)``
+    for a daemon thread (the threaded soak). ``write(path)`` emits the
+    JSON artifact: {"samples": [...], "summary": {...}}.
+    """
+
+    def __init__(self, op):
+        self.op = op
+        self.samples: List[Dict] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def sample(self) -> Dict:
+        s = snapshot(self.op)
+        with self._lock:
+            self.samples.append(s)
+        return s
+
+    def start(self, interval: float = 1.0) -> "Monitor":
+        def run():
+            while not self._stop.is_set():
+                try:
+                    self.sample()
+                except Exception:
+                    pass   # the monitor must never kill the soak
+                self._stop.wait(interval)
+        self._stop.clear()
+        self._thread = threading.Thread(target=run, name="debug-monitor",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(2.0)
+
+    def summary(self) -> Dict:
+        with self._lock:
+            if not self.samples:
+                return {}
+            peak_nodes = max(s["nodes"] for s in self.samples)
+            peak_pending = max(s["pending_pods"] for s in self.samples)
+            peak_cost = max(s["cost_per_hour"] for s in self.samples)
+            return {
+                "samples": len(self.samples),
+                "wall_seconds": round(self.samples[-1]["t"]
+                                      - self.samples[0]["t"], 3),
+                "peak_nodes": peak_nodes,
+                "peak_pending_pods": peak_pending,
+                "peak_cost_per_hour": peak_cost,
+                "final": self.samples[-1],
+            }
+
+    def write(self, path: str) -> None:
+        with self._lock:
+            doc = {"samples": list(self.samples)}
+        doc["summary"] = self.summary()
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1)
+
+
+def dump_state(op, max_events: int = 40) -> str:
+    """Full human-readable control-plane dump for failure diagnosis (the
+    debug-watcher analog: nodes with their pods, claims with phases, ICE
+    entries, the recent event tail)."""
+    cluster = op.cluster
+    lines: List[str] = ["=== control-plane dump ==="]
+    lines.append(f"clock: {op.clock.now():.1f}")
+    pods_by_node = cluster.pods_by_node()
+    lines.append(f"-- nodes ({len(cluster.nodes)}):")
+    for node in cluster.snapshot_nodes():
+        from .apis import wellknown as wk
+        pods = pods_by_node.get(node.name, [])
+        taints = ",".join(t.key for t in node.taints) or "-"
+        lines.append(
+            f"  {node.name} {node.labels.get(wk.LABEL_INSTANCE_TYPE)}"
+            f"/{node.labels.get(wk.LABEL_ZONE)}"
+            f"/{node.labels.get(wk.LABEL_CAPACITY_TYPE)} "
+            f"ready={node.ready} taints={taints} pods={len(pods)}")
+        for p in pods[:10]:
+            lines.append(f"      {p.name}"
+                         + (" [ds]" if p.is_daemonset else ""))
+    lines.append(f"-- claims ({len(cluster.claims)}):")
+    for c in cluster.snapshot_claims():
+        lines.append(
+            f"  {c.name} phase={c.phase.value} type={c.instance_type} "
+            f"zone={c.zone} deleting={bool(c.deletion_timestamp)}")
+    pending = cluster.pending_pods()
+    lines.append(f"-- pending pods ({len(pending)}):")
+    for p in pending[:20]:
+        lines.append(f"  {p.name} requests={dict(p.requests)}")
+    ice = list(op.unavailable.entries())
+    lines.append(f"-- ICE entries ({len(ice)}):")
+    for e in ice[:10]:
+        lines.append(f"  {e}")
+    try:
+        events = op.recorder.events()[-max_events:]
+        lines.append(f"-- recent events ({len(events)}):")
+        for ev in events:
+            lines.append(f"  [{ev.type}] {ev.object_kind}/{ev.object_name} "
+                         f"{ev.reason}: {ev.message}")
+    except Exception:
+        pass
+    return "\n".join(lines)

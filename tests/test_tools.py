@@ -127,3 +127,53 @@ class TestDeflake:
         import deflake
         rc = deflake.main(["-n", "2", "tests/test_units.py"])
         assert rc == 0
+
+
+class TestDebugDumpers:
+    def test_snapshot_and_dump(self):
+        """debug.snapshot/dump_state over a live control plane (the
+        reference's test/pkg/debug watcher analog)."""
+        from karpenter_provider_aws_tpu.apis import NodePool, Pod
+        from karpenter_provider_aws_tpu.cloud import FakeCloud
+        from karpenter_provider_aws_tpu.debug import Monitor, dump_state, snapshot
+        from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+        from karpenter_provider_aws_tpu.operator import Operator, Options
+        from karpenter_provider_aws_tpu.utils.clock import FakeClock
+        clock = FakeClock()
+        lattice = build_lattice([s for s in build_catalog()
+                                 if s.family in ("m5", "t3")])
+        op = Operator(options=Options(registration_delay=1.0),
+                      lattice=lattice, cloud=FakeCloud(clock), clock=clock)
+        mon = Monitor(op)
+        mon.sample()
+        for i in range(3):
+            op.cluster.add_pod(Pod(name=f"p{i}",
+                                   requests={"cpu": "1", "memory": "2Gi"}))
+        s0 = mon.sample()
+        assert s0["pending_pods"] == 3 and s0["nodes"] == 0
+        op.settle()
+        s1 = mon.sample()
+        assert s1["pending_pods"] == 0 and s1["nodes"] >= 1
+        assert s1["cost_per_hour"] > 0
+        text = dump_state(op)
+        assert "control-plane dump" in text
+        assert "p0" in text and "phase=Initialized" in text
+        summ = mon.summary()
+        assert summ["samples"] == 3 and summ["peak_pending_pods"] == 3
+
+    def test_monitor_writes_artifact(self, tmp_path):
+        import json
+        from karpenter_provider_aws_tpu.debug import Monitor
+        from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+        from karpenter_provider_aws_tpu.operator import Operator, Options
+        from karpenter_provider_aws_tpu.utils.clock import FakeClock
+        lattice = build_lattice([s for s in build_catalog()
+                                 if s.family in ("t3",)])
+        op = Operator(options=Options(), lattice=lattice, clock=FakeClock())
+        mon = Monitor(op)
+        mon.sample(); mon.sample()
+        out = tmp_path / "ts.json"
+        mon.write(str(out))
+        doc = json.loads(out.read_text())
+        assert len(doc["samples"]) == 2
+        assert doc["summary"]["samples"] == 2

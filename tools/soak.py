@@ -14,8 +14,11 @@ Exit criteria (after churn stops, the control plane must converge):
   the GC hasn't been entitled to reap yet is not a leak),
 - zero orphaned node leases.
 
-Usage: python tools/soak.py [--minutes 5] [--seed 0]
-Exits non-zero if any invariant fails. A 6-minute run churns ~20k pods.
+Usage: python tools/soak.py [--minutes 5] [--seed 0] [--out soak_timeseries.json]
+Exits non-zero if any invariant fails (and prints a full control-plane
+dump). A 6-minute run churns ~20k pods. The run records a time-series
+artifact (pending/nodes/claims/cost per second — the reference's
+monitor.go + Timestream metrics-pipeline analog, debug.Monitor).
 """
 
 from __future__ import annotations
@@ -44,6 +47,8 @@ def main(argv=None) -> int:
     ap.add_argument("--minutes", type=float, default=5.0)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--families", default="m5,c5,r5,t3")
+    ap.add_argument("--out", default="soak_timeseries.json",
+                    help="time-series artifact path ('' disables)")
     args = ap.parse_args(argv)
 
     fams = tuple(args.families.split(","))
@@ -55,6 +60,8 @@ def main(argv=None) -> int:
                                   interruption_queue="soak-q"),
                   lattice=lattice, interruption_queue=q)
     rt = ControllerRuntime(operator_specs(op)).start()
+    from karpenter_provider_aws_tpu.debug import Monitor, dump_state
+    monitor = Monitor(op).start(interval=1.0)
     rng = random.Random(args.seed)
     stop = time.monotonic() + args.minutes * 60.0
     i = 0
@@ -99,6 +106,7 @@ def main(argv=None) -> int:
         # invariants must never be read over live mutation
         while not rt.stop():
             print("soak: waiting for a blocked controller thread...")
+        monitor.stop()
 
     # converge: clear injected faults (all controller threads have joined,
     # so plain writes are race-free here), then let the single-threaded
@@ -106,12 +114,17 @@ def main(argv=None) -> int:
     op.cloud.next_error = None
     op.cloud.capacity_pools.clear()
     deadline = time.monotonic() + LEAK_GRACE_SECONDS + 15.0
+    ticks = 0
     while time.monotonic() < deadline:
         op.run_once()
+        ticks += 1
+        if ticks % 20 == 0:
+            monitor.sample()   # the convergence tail rides the series too
         if not op.cluster.pending_pods() \
                 and time.monotonic() > deadline - 10.0:
             break
         time.sleep(0.05)
+    monitor.sample()
 
     pending = op.cluster.pending_pods()
     claimed = {c.provider_id for c in op.cluster.claims.values()
@@ -123,7 +136,15 @@ def main(argv=None) -> int:
           f"nodes={len(op.cluster.nodes)} claims={len(op.cluster.claims)} "
           f"leaked={len(leaked)} orphan_leases={len(orphans)}")
     ok = not pending and not leaked and not orphans
+    if args.out:
+        monitor.write(args.out)
+        print(f"soak: time series -> {args.out} "
+              f"({len(monitor.samples)} samples, "
+              f"peak_nodes={monitor.summary().get('peak_nodes')}, "
+              f"peak_cost/hr={monitor.summary().get('peak_cost_per_hour')})")
     print("soak: INVARIANTS " + ("OK" if ok else "VIOLATED"))
+    if not ok:
+        print(dump_state(op))
     return 0 if ok else 1
 
 

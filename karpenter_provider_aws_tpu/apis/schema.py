@@ -204,9 +204,11 @@ NODECLASS_SCHEMA = {
         "annotations": {"type": "object",
                         "additionalProperties": {"type": "string"}},
         # status (controller-owned; accepted on the wire like a CRD's)
-        "statusSubnets": {"type": "array"},
-        "statusSecurityGroups": {"type": "array"},
-        "statusAMIs": {"type": "array"},
+        "statusSubnets": {"type": "array",
+                          "items": {"type": "object"}},
+        "statusSecurityGroups": {"type": "array",
+                                 "items": {"type": "object"}},
+        "statusAMIs": {"type": "array", "items": {"type": "object"}},
         "statusInstanceProfile": {"type": ["string", "null"]},
         "statusConditions": {"type": "object",
                              "additionalProperties": {"type": "boolean"}},
@@ -313,27 +315,31 @@ def _rule_schedule_requires_duration(spec: Mapping) -> bool:
 
 CROSS_FIELD_RULES: Dict[str, List[Tuple[str, str, Callable]]] = {
     "nodepools": [
-        ("self.requirements.all(x, x.operator == 'In' ? "
-         "x.values.size() != 0 : true)",
+        ("!has(self.requirements) || self.requirements.all(x, "
+         "x.operator == 'In' ? x.values.size() != 0 : true)",
          "requirements with operator 'In' must have a value defined",
          _rule_in_has_values),
-        ("self.requirements.all(x, (x.operator == 'Gt' || "
+        ("!has(self.requirements) || self.requirements.all(x, "
+         "(x.operator == 'Gt' || "
          "x.operator == 'Lt') ? (x.values.size() == 1 && "
          "int(x.values[0]) >= 0) : true)",
          "requirements operator 'Gt' or 'Lt' must have a single positive "
          "integer value",
          _rule_gt_lt_single_int),
-        ("self.requirements.all(x, (x.operator == 'In' && "
+        ("!has(self.requirements) || self.requirements.all(x, "
+         "(x.operator == 'In' && "
          "has(x.minValues)) ? x.values.size() >= x.minValues : true)",
          "requirements with 'minValues' must have at least that many "
          "values specified in the 'values' field",
          _rule_min_values_coverage),
-        ("self.requirements.all(x, (x.operator == 'Exists' || "
+        ("!has(self.requirements) || self.requirements.all(x, "
+         "(x.operator == 'Exists' || "
          "x.operator == 'DoesNotExist') ? x.values.size() == 0 : true)",
          "requirements with operator 'Exists' or 'DoesNotExist' must not "
          "have values",
          _rule_exists_no_values),
-        ("self.disruption.budgets.all(b, has(b.schedule) ? "
+        ("!has(self.disruption) || !has(self.disruption.budgets) || "
+         "self.disruption.budgets.all(b, has(b.schedule) ? "
          "has(b.duration) : true)",
          "budgets with a schedule must set a duration",
          _rule_schedule_requires_duration),
@@ -345,8 +351,8 @@ CROSS_FIELD_RULES: Dict[str, List[Tuple[str, str, Callable]]] = {
          _rule_role_xor_profile),
     ],
     "nodeclaims": [
-        ("self.requirements.all(x, x.operator == 'In' ? "
-         "x.values.size() != 0 : true)",
+        ("!has(self.requirements) || self.requirements.all(x, "
+         "x.operator == 'In' ? x.values.size() != 0 : true)",
          "requirements with operator 'In' must have a value defined",
          _rule_in_has_values),
     ],
@@ -418,10 +424,18 @@ def _to_structural(node):
             out["items"] = merged
             continue
         if k == "anyOf":
-            # value-position anyOf is forbidden: widen to the loosest
-            # branch (admission still enforces the strict union)
             branches = [_to_structural(b) for b in v]
-            out.update(branches[-1] if branches else {})
+            types = {b.get("type") for b in branches}
+            if types <= {"number", "integer", "string"} and len(types) > 1:
+                # the k8s-native projection of a number-or-quantity-string
+                # union (the reference CRDs use the same marker for
+                # IntOrString fields)
+                out["x-kubernetes-int-or-string"] = True
+            elif branches:
+                # otherwise keep the FIRST branch (schemas list the
+                # widest branch first); admission still enforces the
+                # full union
+                out.update(branches[0])
             continue
         out[k] = _to_structural(v)
     t = out.get("type")

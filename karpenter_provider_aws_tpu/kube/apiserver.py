@@ -168,7 +168,14 @@ class FakeAPIServer:
 
     def _admit(self, kind: str, name: str, spec: dict) -> dict:
         for d in self._defaulters.get(kind, ()):
-            spec = d(spec)
+            try:
+                spec = d(spec)
+            except Exception as e:
+                # a defaulter typed-parsing a malformed spec must surface
+                # as an admission rejection, not a raw crash — callers
+                # only handle InvalidObjectError
+                raise InvalidObjectError(
+                    kind, name, [f"defaulting failed: {e}"])
         causes: List[str] = []
         for v in self._admission.get(kind, ()):
             causes.extend(v(spec))

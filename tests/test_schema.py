@@ -181,6 +181,20 @@ class TestCrossFieldRules:
 
 
 class TestAdmissionIntegration:
+    def test_malformed_spec_rejected_not_crashed(self):
+        """A defaulter typed-parsing garbage must surface as an admission
+        rejection (InvalidObjectError), never a raw exception."""
+        from karpenter_provider_aws_tpu.kube import (
+            FakeAPIServer, InvalidObjectError, install_admission,
+        )
+        s = FakeAPIServer()
+        install_admission(s)
+        spec = pool_spec()
+        spec["requirements"] = [{"key": "t", "operator": "Bogus"}]
+        with pytest.raises(InvalidObjectError):
+            s.create("nodepools", spec)
+
+
     def test_schema_errors_surface_through_apiserver(self):
         from karpenter_provider_aws_tpu.kube import (
             FakeAPIServer, InvalidObjectError, install_admission,
@@ -226,6 +240,8 @@ class TestArtifacts:
                     assert None not in node["enum"], node
                 if "exclusiveMinimum" in node:
                     assert isinstance(node["exclusiveMinimum"], bool), node
+                if node.get("type") == "array":
+                    assert "items" in node, node
                 for v in node.values():
                     walk(v)
             elif isinstance(node, list):

@@ -328,11 +328,19 @@ def run_config(key, make, lattice, solver):
         plan = solver.solve(problem)
         e2e_ms.append((time.perf_counter() - t0) * 1000.0)
         dev_ms.append(plan.device_seconds * 1000.0)
-        # interleaved link probe: the RTT THIS config's samples rode on
+        # interleaved link probe: the RTT THIS sample rode on
         rtt_ms.append(_rtt_probe())
     e2e_p50 = float(np.percentile(e2e_ms, 50))
     dev_p50 = float(np.percentile(dev_ms, 50))
     rtt_p50 = float(np.percentile(rtt_ms, 50))
+    # PER-SAMPLE normalization: median of (sample - its adjacent probe).
+    # Subtracting medians of two separate distributions overstates algo
+    # time whenever the link wobbles between solve and probe; pairing
+    # cancels the weather sample-by-sample.
+    e2e_algo = float(np.percentile(
+        [max(e - r, 0.0) for e, r in zip(e2e_ms, rtt_ms)], 50))
+    dev_algo = float(np.percentile(
+        [max(d - r, 0.0) for d, r in zip(dev_ms, rtt_ms)], 50))
 
     referee_result = _run_referee(problem)
     ref_cost, _, referee = referee_result
@@ -353,9 +361,9 @@ def run_config(key, make, lattice, solver):
         "e2e_p50_ms": round(e2e_p50, 3),
         "device_link_rtt_ms": round(rtt_p50, 3),
         # RTT-normalized views: what the ALGORITHM costs once the link's
-        # fixed per-call latency (measured interleaved) is subtracted
-        "device_algo_ms": round(max(dev_p50 - rtt_p50, 0.0), 3),
-        "e2e_algo_ms": round(max(e2e_p50 - rtt_p50, 0.0), 3),
+        # per-call latency (paired probe per sample) is subtracted
+        "device_algo_ms": round(dev_algo, 3),
+        "e2e_algo_ms": round(e2e_algo, 3),
         "pods_per_sec": round(n_pods / (e2e_p50 / 1000.0), 1),
         "plan_cost_per_hour": round(plan.new_node_cost, 2),
         "cost_vs_ffd_oracle": cost_ratio,

@@ -103,10 +103,14 @@ NODEPOOL_SCHEMA = {
                          "maxItems": 30},             # nodepools.yaml:391
         "taints": {"type": "array", "items": _TAINT},
         "startupTaints": {"type": "array", "items": _TAINT},
+        # serde stringifies limits on the wire; bare integers are also
+        # accepted (hand-built specs). Fractional NUMBERS are not — write
+        # "1.5" as a quantity string — which makes the CRD projection to
+        # x-kubernetes-int-or-string exact, not just approximate.
         "limits": {"type": "object",
                    "additionalProperties": {
                        "anyOf": [
-                           {"type": "number", "minimum": 0},
+                           {"type": "integer", "minimum": 0},
                            {"type": "string",
                             "pattern": QUANTITY_PATTERN}]}},
         "disruption": {

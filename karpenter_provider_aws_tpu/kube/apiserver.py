@@ -170,10 +170,13 @@ class FakeAPIServer:
         for d in self._defaulters.get(kind, ()):
             try:
                 spec = d(spec)
+            except InvalidObjectError:
+                raise   # a defaulter's own precise rejection passes through
             except Exception as e:
-                # a defaulter typed-parsing a malformed spec must surface
-                # as an admission rejection, not a raw crash — callers
-                # only handle InvalidObjectError
+                # a defaulter crashing on input the schema would have
+                # rejected must still surface as an admission rejection
+                # (callers only handle InvalidObjectError); the message
+                # class distinguishes defaulter bugs from bad input
                 raise InvalidObjectError(
                     kind, name, [f"defaulting failed: {e}"])
         causes: List[str] = []

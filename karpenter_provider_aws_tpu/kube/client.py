@@ -221,6 +221,13 @@ def install_admission(server: FakeAPIServer) -> None:
     from ..apis import schema
 
     def _np_default(spec: dict) -> dict:
+        # schema-check BEFORE typed parsing: malformed input gets the
+        # precise structural diagnostic, not a parse crash
+        errs = schema.validate("nodepools", spec)
+        if errs:
+            from .apiserver import InvalidObjectError
+            raise InvalidObjectError("nodepools",
+                                     spec.get("name", "?"), errs)
         pool = serde.nodepool_from_dict(spec)
         webhooks.default_node_pool(pool)
         return serde.nodepool_to_dict(pool)

@@ -294,12 +294,8 @@ def _selector_keys(pods: Sequence[Pod], bound_pods: Sequence[BoundPod]) -> froze
     (controller-stamped fixtures) or per-pod unique (anything parsed from
     the API server is its own object)."""
     keys: set = set()
+
     def collect(p: Pod) -> None:
-        # fast path: most pods carry no selectors at all — two attribute
-        # loads, no cache traffic (50k selector-free pods cost ~5 ms here;
-        # the cached path below costs ~3x that per pod)
-        if not p.pod_affinity and not p.topology_spread:
-            return
         cached = p.__dict__.get("_kpat_selkeys")
         if cached is None:
             mine: set = set()
@@ -310,10 +306,17 @@ def _selector_keys(pods: Sequence[Pod], bound_pods: Sequence[BoundPod]) -> froze
             cached = frozenset(mine)
             p.__dict__["_kpat_selkeys"] = cached
         keys.update(cached)
+
+    # the emptiness check lives IN the loop, not in collect: most pods
+    # carry no selectors at all, and 50k no-op FUNCTION CALLS alone cost
+    # ~12 ms of the build budget — two inline attribute loads don't
     for p in pods:
-        collect(p)
+        if p.pod_affinity or p.topology_spread:
+            collect(p)
     for bp in bound_pods:
-        collect(bp.pod)
+        p = bp.pod
+        if p.pod_affinity or p.topology_spread:
+            collect(p)
     return frozenset(keys)
 
 

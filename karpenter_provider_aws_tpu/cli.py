@@ -11,6 +11,9 @@ take the reference's `--feature-gates Drift=true,...` form
 While the reconcile loop runs, the process serves:
 - ``/metrics``  — the Prometheus text exposition of the registry
   (including the per-offering lattice gauge surface),
+- ``/validate`` — the HTTP admission endpoint (POST an AdmissionReview-
+  shaped document; schema + semantic validation answer allowed/denied —
+  the reference serves the same contract from pkg/webhooks)
 - ``/healthz`` and ``/readyz`` — liveness/readiness, mirroring the
   operator's AddHealthzCheck wiring (main.go:44).
 """
@@ -25,6 +28,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence
 
 from .operator import Operator, Options
+from .webhooks import validate_wire
 
 _GATES = {
     "Drift": "drift_enabled",
@@ -153,6 +157,37 @@ def start_server(op: Operator, port: int) -> ThreadingHTTPServer:
     an ephemeral port (server.server_address reports it)."""
 
     class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            # HTTP admission endpoint (reference pkg/webhooks/webhooks.go
+            # serves knative-style admission; here the review body is
+            # {"kind": <plural>, "spec": <wire dict>} and the response is
+            # {"allowed": bool, "causes": [..]} — an external writer can
+            # ask before persisting, closing the callable-only gap)
+            if self.path not in ("/validate", "/validate/"):
+                self.send_error(404)
+                return
+            import json as _json
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                review = _json.loads(self.rfile.read(length) or b"{}")
+                kind = review["kind"]
+                spec = review["spec"]
+                if not isinstance(kind, str) or not isinstance(spec, dict):
+                    raise ValueError("kind must be a string, spec an object")
+                causes = validate_wire(kind, spec)
+            except Exception as e:
+                # ANY malformed review answers 400 — a webhook endpoint
+                # must never drop the connection with a traceback
+                self.send_error(400, f"bad review document: {e}")
+                return
+            body = _json.dumps({"allowed": not causes,
+                                "causes": causes}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_GET(self):
             if self.path == "/metrics":
                 body = op.metrics.render().encode()

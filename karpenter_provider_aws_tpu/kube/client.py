@@ -233,9 +233,9 @@ def install_admission(server: FakeAPIServer) -> None:
         return serde.nodepool_to_dict(pool)
 
     def _np_validate(spec: dict) -> List[str]:
-        errs = schema.validate("nodepools", spec)
-        if errs:
-            return errs   # semantic checks assume structural validity
+        # structural validation already ran in _np_default (before typed
+        # parsing) and the spec only round-tripped serde since — running
+        # the jsonschema pass again here would double the admission cost
         return webhooks.validate_node_pool(serde.nodepool_from_dict(spec))
 
     def _nc_validate(spec: dict) -> List[str]:

@@ -142,3 +142,65 @@ class LeaderElector:
         if leading != self._leading:
             self.transitions += 1
         self._leading = leading
+
+
+class ApiLeaseStore:
+    """Lease in the apiserver's coordination resource — true
+    client-go-style election: compare-and-swap rides the server's
+    optimistic concurrency (a stale resourceVersion on update = lost the
+    race), exactly how the reference's replicas elect through the
+    coordination/v1 API. Election leases carry ``"election": true`` so
+    the StateSync lease applier keeps them OUT of the kube-node-lease
+    mirror (they would otherwise be reaped as ownerless by the lease GC —
+    the namespace separation the real cluster gives for free)."""
+
+    NAME = "karpenter-tpu-leader-election"
+
+    def __init__(self, server, name: str = NAME):
+        self.server = server
+        self.name = name
+
+    def get(self) -> Optional[Lease]:
+        from ..kube.apiserver import NotFoundError
+        try:
+            spec = self.server.get("leases", self.name)["spec"]
+        except NotFoundError:
+            return None
+        if spec.get("holder") is None:
+            return None
+        return Lease(holder=spec["holder"],
+                     renew_time=float(spec["renewTime"]))
+
+    def swap(self, expect_holder: Optional[str],
+             lease: Optional[Lease]) -> bool:
+        from ..kube.apiserver import (AlreadyExistsError, ConflictError,
+                                      NotFoundError)
+        try:
+            obj = self.server.get("leases", self.name)
+        except NotFoundError:
+            if expect_holder is not None:
+                return False
+            if lease is None:
+                return True
+            try:
+                self.server.create("leases", {
+                    "name": self.name, "election": True,
+                    "holder": lease.holder, "renewTime": lease.renew_time})
+                return True
+            except AlreadyExistsError:
+                return False   # lost the creation race
+        if obj["spec"].get("holder") != expect_holder:
+            return False
+        if lease is None:
+            # release: clear the holder (keep the object — its RV history
+            # stays useful and re-creation races disappear)
+            obj["spec"]["holder"] = None
+            obj["spec"]["renewTime"] = 0.0
+        else:
+            obj["spec"]["holder"] = lease.holder
+            obj["spec"]["renewTime"] = lease.renew_time
+        try:
+            self.server.update("leases", obj)
+            return True
+        except (ConflictError, NotFoundError):
+            return False   # another replica wrote first: CAS failed

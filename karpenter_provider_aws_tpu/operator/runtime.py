@@ -28,6 +28,10 @@ class ControllerSpec:
     reconcile: Callable[[], object]
     interval: float = 1.0          # seconds between the END of one pass
                                    # and the start of the next
+    gate_on_leadership: bool = True  # False = runs on standbys too (the
+                                     # informer pump: client-go reflectors
+                                     # run on ALL replicas so a failover
+                                     # starts from a warm mirror)
 
 
 class ControllerRuntime:
@@ -45,7 +49,7 @@ class ControllerRuntime:
             from .leaderelection import RETRY_PERIOD
             self.specs.append(ControllerSpec(
                 "leader-election", elector.try_acquire_or_renew,
-                interval=RETRY_PERIOD))
+                interval=RETRY_PERIOD, gate_on_leadership=False))
         self._on_error = on_error
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -55,7 +59,7 @@ class ControllerRuntime:
     def _run(self, spec: ControllerSpec) -> None:
         while not self._stop.is_set():
             try:
-                if (self.elector is None or spec.name == "leader-election"
+                if (self.elector is None or not spec.gate_on_leadership
                         or self.elector.is_leader):
                     spec.reconcile()
             except BaseException as e:  # a controller crash must not kill
@@ -104,7 +108,17 @@ class ControllerRuntime:
 def operator_specs(op) -> List[ControllerSpec]:
     """The production cadence map for an Operator's controllers (the
     reference's per-controller registration in controllers.go)."""
-    specs = [
+    specs = []
+    if getattr(op, "sync", None) is not None:
+        # API mode: the informer pump feeds the mirror continuously (its
+        # own thread = the reflector goroutines of the reference manager).
+        # NOT leadership-gated: standbys keep their mirror warm (and their
+        # watch queues drained) so failover starts hot, like client-go
+        # informers running on every replica
+        specs.append(ControllerSpec("statesync", op.sync.sync_once,
+                                    interval=0.05,
+                                    gate_on_leadership=False))
+    specs += [
         ControllerSpec("provisioning",
                        lambda: (op.provisioner.provision_once()
                                 if op.provisioner.batch_ready() else None),

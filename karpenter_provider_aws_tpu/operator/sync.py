@@ -125,6 +125,12 @@ class StateSync:
         if type_ == "DELETED":
             self.cluster.delete_lease(name)
             return
+        if obj["spec"].get("election"):
+            # leader-election leases are coordination state, not
+            # kube-node-leases: keeping them out of the mirror keeps the
+            # ownerless-lease GC off them (the real cluster separates
+            # them by namespace)
+            return
         self.cluster.add_lease(serde.lease_from_dict(obj["spec"]))
 
     def _install_pool(self, pool: NodePool) -> None:

@@ -177,3 +177,31 @@ def admit_node_class(nc: NodeClass) -> NodeClass:
     if errs:
         raise AdmissionError(f"NodeClass/{nc.name}: " + "; ".join(errs))
     return nc
+
+
+def validate_wire(kind: str, spec) -> List[str]:
+    """One validation entry over WIRE dicts: schema first (apis/schema.py,
+    the CRD contract), then the semantic webhook for the kind. This is
+    what the in-process apiserver admission runs (kube/client.py) and
+    what the HTTP /validate endpoint serves (cli.py) — same answer at
+    every boundary."""
+    from .apis import schema, serde
+    KNOWN = ("nodepools", "nodeclasses", "pdbs", "nodeclaims")
+    if kind not in KNOWN:
+        # an "allowed" answer for a kind we cannot validate would be a
+        # false green light (the apiserver rejects unknown kinds)
+        return [f"unknown kind {kind!r}; validatable kinds: "
+                + ", ".join(KNOWN)]
+    errs = schema.validate(kind, spec)
+    if errs:
+        return errs
+    try:
+        if kind == "nodepools":
+            return validate_node_pool(serde.nodepool_from_dict(spec))
+        if kind == "nodeclasses":
+            return validate_node_class(serde.nodeclass_from_dict(spec))
+        if kind == "pdbs":
+            return validate_pdb(serde.pdb_from_dict(spec))
+    except Exception as e:  # malformed-but-schema-clean input must reject
+        return [f"validation failed: {e}"]
+    return []   # nodeclaims: schema-only (status is controller-owned)

@@ -106,3 +106,79 @@ class TestProfilingHook:
         assert profile_root.is_dir()
         runs = list(profile_root.iterdir())
         assert runs and any(run.iterdir() for run in runs)
+
+
+class TestValidateEndpoint:
+    def test_http_admission_answers_allowed_and_denied(self, lattice):
+        """The HTTP admission endpoint (reference pkg/webhooks serves the
+        same contract): POST a review, get allowed/causes."""
+        import json
+        import urllib.request
+        from karpenter_provider_aws_tpu.apis import NodePool, serde
+        from karpenter_provider_aws_tpu.cli import start_server
+        from karpenter_provider_aws_tpu.cloud import FakeCloud
+        from karpenter_provider_aws_tpu.operator import Operator, Options
+        from karpenter_provider_aws_tpu.utils.clock import FakeClock
+        clock = FakeClock()
+        op = Operator(options=Options(), lattice=lattice,
+                      cloud=FakeCloud(clock), clock=clock)
+        server = start_server(op, 0)
+        try:
+            port = server.server_address[1]
+
+            def post(doc):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/validate",
+                    data=json.dumps(doc).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req) as r:
+                    return json.loads(r.read())
+
+            ok = post({"kind": "nodepools",
+                       "spec": serde.nodepool_to_dict(NodePool(name="p"))})
+            assert ok == {"allowed": True, "causes": []}
+            bad_spec = serde.nodepool_to_dict(NodePool(name="p"))
+            bad_spec["disruption"]["budgets"] = [{"nodes": "150%"}]
+            denied = post({"kind": "nodepools", "spec": bad_spec})
+            assert denied["allowed"] is False
+            assert any("nodes" in c for c in denied["causes"])
+        finally:
+            server.shutdown()
+
+    def test_validate_endpoint_rejects_garbage_without_crashing(self, lattice):
+        """Malformed reviews answer 400/denied — never a dropped
+        connection (review r4 finding)."""
+        import json
+        import urllib.error
+        import urllib.request
+        from karpenter_provider_aws_tpu.cli import start_server
+        from karpenter_provider_aws_tpu.cloud import FakeCloud
+        from karpenter_provider_aws_tpu.operator import Operator, Options
+        from karpenter_provider_aws_tpu.utils.clock import FakeClock
+        clock = FakeClock()
+        op = Operator(options=Options(), lattice=lattice,
+                      cloud=FakeCloud(clock), clock=clock)
+        server = start_server(op, 0)
+        try:
+            port = server.server_address[1]
+
+            def post_raw(payload):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/validate", data=payload,
+                    headers={"Content-Type": "application/json"})
+                try:
+                    with urllib.request.urlopen(req) as r:
+                        return r.status, json.loads(r.read())
+                except urllib.error.HTTPError as e:
+                    return e.code, None
+
+            assert post_raw(b"[1, 2]")[0] == 400          # non-dict review
+            assert post_raw(json.dumps(
+                {"kind": "nodepools", "spec": "hello"}).encode())[0] == 400
+            # unknown kind: denied, not allowed
+            code, body = post_raw(json.dumps(
+                {"kind": "nodepool", "spec": {"name": "x"}}).encode())
+            assert code == 200 and body["allowed"] is False
+            assert any("unknown kind" in c for c in body["causes"])
+        finally:
+            server.shutdown()

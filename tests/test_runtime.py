@@ -173,6 +173,72 @@ class TestLeaderElection:
         a.release()
         assert b.try_acquire_or_renew() is True
 
+    def test_api_lease_store_elects_one_and_fails_over(self):
+        """ApiLeaseStore: election rides the apiserver's optimistic
+        concurrency (the client-go coordination/v1 path)."""
+        from karpenter_provider_aws_tpu.kube import FakeAPIServer
+        from karpenter_provider_aws_tpu.operator.leaderelection import (
+            ApiLeaseStore, LeaderElector)
+        from karpenter_provider_aws_tpu.utils.clock import FakeClock
+        clock = FakeClock()
+        server = FakeAPIServer(clock=clock)
+        a = LeaderElector(ApiLeaseStore(server), "replica-a", 15.0, clock)
+        b = LeaderElector(ApiLeaseStore(server), "replica-b", 15.0, clock)
+        assert a.try_acquire_or_renew() is True
+        assert b.try_acquire_or_renew() is False
+        # dead holder: takeover after expiry
+        clock.step(16)
+        assert b.try_acquire_or_renew() is True
+        assert a.try_acquire_or_renew() is False
+        # clean release hands over immediately
+        b.release()
+        assert a.try_acquire_or_renew() is True
+
+    def test_api_lease_store_cas_on_stale_read(self):
+        """swap() must return False (never split leadership, never raise)
+        when another replica wrote between its read and its update — the
+        race is simulated by serving swap a stale envelope."""
+        import copy
+        from karpenter_provider_aws_tpu.kube import FakeAPIServer
+        from karpenter_provider_aws_tpu.operator.leaderelection import (
+            ApiLeaseStore, Lease)
+        server = FakeAPIServer()
+        s1, s2 = ApiLeaseStore(server), ApiLeaseStore(server)
+        assert s1.swap(None, Lease("a", 1.0)) is True
+        stale = server.get("leases", s1.name)   # s2's in-flight read
+        assert s1.swap("a", Lease("a", 2.0)) is True   # a renews: RV bumps
+        real_get = server.get
+        server.get = lambda kind, name: copy.deepcopy(stale)
+        try:
+            # s2 acts on the stale read: the server-side RV check makes
+            # the CAS fail and swap reports it — no exception, no split
+            assert s2.swap("a", Lease("b", 9.0)) is False
+        finally:
+            del server.get   # restore the class method
+        assert real_get("leases", s1.name)["spec"]["holder"] == "a"
+
+    def test_election_lease_stays_out_of_node_lease_mirror(self):
+        """The leader-election lease must not be reaped by the ownerless-
+        lease GC: the sync applier keeps it out of the mirror."""
+        from karpenter_provider_aws_tpu.kube import FakeAPIServer
+        from karpenter_provider_aws_tpu.operator.leaderelection import (
+            ApiLeaseStore, LeaderElector)
+        from karpenter_provider_aws_tpu.utils.clock import FakeClock
+        clock = FakeClock()
+        server = FakeAPIServer(clock=clock)
+        op = Operator(options=Options(registration_delay=1.0),
+                      lattice=build_lattice([s for s in build_catalog()
+                                             if s.family in ("t3",)]),
+                      clock=clock, api_server=server)
+        elector = LeaderElector(ApiLeaseStore(server), "replica-a",
+                                15.0, clock)
+        assert elector.try_acquire_or_renew()
+        op.sync_once()
+        assert "karpenter-tpu-leader-election" not in op.cluster.leases
+        assert op.cluster.orphaned_leases() == []
+        op.gc.reconcile()   # the lease GC must not touch it
+        assert elector.try_acquire_or_renew() is True
+
     def test_runtime_gates_controllers_on_leadership(self):
         import time as _time
         from karpenter_provider_aws_tpu.operator.leaderelection import (
@@ -267,3 +333,40 @@ class TestOperatorAdmissionBackstops:
                             Requirement(wk.LABEL_OS, ReqOp.IN, ("linux",))])
         with pytest.raises(ValueError, match="exactly one OS"):
             Operator(lattice=lattice, node_pools=[pool])
+
+
+class TestAsyncApiMode:
+    def test_threaded_runtime_over_apiserver(self, lattice):
+        """API mode under the production threaded runtime: pods created
+        through the client get capacity with the informer pump running as
+        its own controller thread."""
+        from karpenter_provider_aws_tpu.kube import FakeAPIServer, KubeClient
+        clock = Clock()
+        server = FakeAPIServer(clock=clock)
+        op = Operator(options=Options(registration_delay=0.05,
+                                      batch_idle_duration=0.05,
+                                      batch_max_duration=0.5),
+                      lattice=lattice, cloud=FakeCloud(clock), clock=clock,
+                      api_server=server)
+        client = KubeClient(server)
+        specs = [ControllerSpec(s.name, s.reconcile,
+                                interval=min(s.interval, 0.05))
+                 for s in operator_specs(op)]
+        assert any(s.name == "statesync" for s in specs)
+        runtime = ControllerRuntime(specs).start()
+        try:
+            for i in range(5):
+                client.create_pod(Pod(name=f"p{i}",
+                                      requests={"cpu": "500m",
+                                                "memory": "1Gi"}))
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if all(p.node_name for p in client.list_pods()):
+                    break
+                time.sleep(0.1)
+        finally:
+            runtime.stop()
+        assert all(p.node_name for p in client.list_pods()), \
+            "async API mode failed to bind pods"
+        assert client.list_nodes()
+        assert not runtime.error_counts, runtime.error_counts

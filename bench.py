@@ -381,8 +381,11 @@ def run_config(key, make, lattice, solver):
 
 # budget on ALGORITHM-controlled time for the north-star config: e2e p50
 # minus the measured link RTT must stay under this, so link weather and
-# real regressions are distinguishable in the bench record
-CFG5_ALGO_BUDGET_MS = 60.0
+# real regressions are distinguishable in the bench record. Calibrated to
+# the accel-bin-splitting plan shape (~1500 nodes for 22.7% lower cost —
+# $5949/hr vs $7697 pre-split — the decode and kernel legitimately do
+# ~3x the per-bin work of the 519-node plan the old 60 ms budget fit).
+CFG5_ALGO_BUDGET_MS = 80.0
 
 
 def main(argv=None):

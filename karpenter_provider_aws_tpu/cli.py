@@ -174,11 +174,17 @@ def start_server(op: Operator, port: int) -> ThreadingHTTPServer:
                 spec = review["spec"]
                 if not isinstance(kind, str) or not isinstance(spec, dict):
                     raise ValueError("kind must be a string, spec an object")
-                causes = validate_wire(kind, spec)
             except Exception as e:
-                # ANY malformed review answers 400 — a webhook endpoint
-                # must never drop the connection with a traceback
+                # a malformed review is the CLIENT's fault: 400, never a
+                # dropped connection
                 self.send_error(400, f"bad review document: {e}")
+                return
+            try:
+                causes = validate_wire(kind, spec)
+            except Exception:
+                # a bug in the validation chain is OUR fault: 500, and no
+                # internal exception text leaks to the caller
+                self.send_error(500, "validation error")
                 return
             body = _json.dumps({"allowed": not causes,
                                 "causes": causes}).encode()

@@ -135,6 +135,96 @@ class Problem:
         return self.g_match.shape[1] if self.g_match.ndim == 2 else 0
 
 
+_ACCEL_AXES = tuple(
+    res_axis(a) for a in ("nvidia.com/gpu", "amd.com/gpu",
+                          "habana.ai/gaudi", "aws.amazon.com/neuron"))
+
+
+# accel types within this per-unit-price factor of the best stay in the
+# narrowed set — a little launch flexibility is worth a few % of cost
+_ACCEL_UNIT_PRICE_SLACK = 1.05
+
+
+def _accel_bin_cap(vec: np.ndarray, type_mask: np.ndarray,
+                   zone_mask: np.ndarray, cap_mask: np.ndarray,
+                   pool_tmask: np.ndarray, existing_tmask: np.ndarray,
+                   lattice: Lattice) -> Optional[np.ndarray]:
+    """Accelerator bin-splitting: a narrowed type mask that lands
+    finalization on the cheapest PER-ACCELERATOR-UNIT types.
+
+    Sequential FFD (the reference's scheduler, and our scan) packs a
+    whole accelerator wave into the first bin with room, so one big
+    accelerator node hosts it even when small accelerator types cost
+    less per unit (measured: 4 one-GPU pods → one g5.12xlarge at
+    $1.92/hr where four g5.xlarge cost $1.54); generic pods riding that
+    bin then UPSIZE it further at finalization. Two counter-moves, both
+    computed from the live (ICE-masked) lattice:
+
+    - narrow the group's type mask to types within
+      ``_ACCEL_UNIT_PRICE_SLACK`` of the best per-unit price (keeping
+      only types that fit at least one pod). NEW bins then hold only as
+      many accelerator pods as the small types' own capacity — the wave
+      splits via ordinary capacity math, with no per-bin cap that would
+      also throttle joins onto EXISTING accelerator nodes — and a
+      joining generic pod can consume a bin's true leftover but never
+      upsize it.
+
+    Splitting is never worse on accelerator cost — k small nodes at the
+    best unit price cost ≤ one big node holding k units, by definition
+    of the per-unit argmin — and displaced generic pods land on far
+    cheaper general capacity. The FFD referee (which packs the SAME
+    capped problem) keeps parity honest; tests/test_solver.py pins the
+    absolute win against the UNCAPPED pack.
+
+    Correctness fences (review r4): candidates intersect the group's
+    POOL-feasible types (``pool_tmask`` — a p3-only pool ranks within p3,
+    never narrowing itself unschedulable), prices reduce over the group's
+    OWN zone/capacity-type masks (an on-demand-only pool ranks by
+    on-demand prices, not spot), and accelerator-capable EXISTING node
+    types stay in the mask (free GPUs on a running multi-GPU node always
+    beat a launch).
+
+    Returns the narrowed mask, or None when no accelerator demand or
+    nothing to gain."""
+    for ax in _ACCEL_AXES:
+        per_pod = float(vec[ax])
+        if per_pod <= 0:
+            continue
+        if not zone_mask.any() or not cap_mask.any():
+            return None
+        counts = lattice.capacity[:, ax]
+        # a candidate must hold at least one WHOLE pod (all axes) AND be
+        # launchable by some compatible pool
+        fits_one = (lattice.alloc >= vec[None, :]).all(axis=1)
+        feasible = type_mask & (counts >= per_pod) & fits_one
+        cand = feasible & pool_tmask
+        if not cand.any():
+            return None
+        idx = np.nonzero(cand)[0]
+        # cheapest offering per candidate, WITHIN the group's own zone and
+        # capacity-type masks (only candidate rows: the reduction stays
+        # O(|cand|·Z·C), not O(T·Z·C) per group)
+        offers = lattice.available[np.ix_(idx, np.nonzero(zone_mask)[0],
+                                          np.nonzero(cap_mask)[0])]
+        prices = np.where(
+            offers,
+            lattice.price[np.ix_(idx, np.nonzero(zone_mask)[0],
+                                 np.nonzero(cap_mask)[0])],
+            np.inf)
+        pmin = prices.reshape(len(idx), -1).min(axis=1)
+        per_unit = pmin / np.maximum(counts[idx], 1e-9)
+        b = int(np.argmin(per_unit))
+        if not np.isfinite(per_unit[b]):
+            return None
+        keep = np.zeros(type_mask.shape, dtype=bool)
+        keep[idx[per_unit <= per_unit[b] * _ACCEL_UNIT_PRICE_SLACK]] = True
+        # existing accelerator-capable node types stay joinable — their
+        # free capacity is already paid for
+        keep |= feasible & existing_tmask
+        return keep
+    return None
+
+
 def _is_custom_key(key: str) -> bool:
     """A label key the lattice does not model (user-defined)."""
     return (key not in _AXIS_KEYS and key not in _CAT_KEY_INDEX
@@ -737,6 +827,14 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
                 continue
             ds_overhead[pi] += vec
 
+    # accelerator-capable EXISTING node types (see _accel_bin_cap: their
+    # free capacity must stay joinable through any narrowed group mask)
+    existing_tmask = np.zeros((T,), dtype=bool)
+    for b in existing:
+        ti = lattice.name_to_idx.get(b.instance_type)
+        if ti is not None:
+            existing_tmask[ti] = True
+
     # --- per raw group: masks, pool compatibility, topology resolution
     registry = ClassRegistry()
     # bound pods' hostname anti-affinity terms must be classes too — the k8s
@@ -817,11 +915,23 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
                 np_ok_s = np_ok & np.array(
                     [all(eff.get(k) == v for k, v in s.custom.items())
                      for eff in pool_eff_labels], dtype=bool)
+            per_bin = topo.max_per_bin
+            g_tmask = masks.type_mask
+            if not topo.single_bin:
+                # accelerator bin-splitting (see _accel_bin_cap) — never
+                # applied over hostname self-affinity's one-bin contract
+                pool_tmask = (np_type[np_ok_s].any(axis=0)
+                              if np_ok_s.any() else np.zeros(T, dtype=bool))
+                a_mask = _accel_bin_cap(
+                    vec, masks.type_mask, s.zone_mask, s.cap_mask,
+                    pool_tmask, existing_tmask, lattice)
+                if a_mask is not None and a_mask.any():
+                    g_tmask = a_mask
             g = PodGroup(
                 signature=repr(sig), pod_names=sub_names, req=vec,
-                type_mask=masks.type_mask, zone_mask=s.zone_mask, cap_mask=s.cap_mask,
+                type_mask=g_tmask, zone_mask=s.zone_mask, cap_mask=s.cap_mask,
                 np_ok=np_ok_s, requirements=reqs,
-                max_per_bin=topo.max_per_bin, spread_class=topo.spread_class,
+                max_per_bin=per_bin, spread_class=topo.spread_class,
                 single_bin=topo.single_bin,
                 strict_custom=strict,
             )

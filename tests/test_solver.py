@@ -923,3 +923,134 @@ class TestOSScheduling:
         # pool's stamped build label IS a discoverable domain
         assert not any("no discoverable domains" in w for w in plan.warnings), \
             plan.warnings
+
+
+class TestAccelBinSplitting:
+    """Accelerator bin-splitting (_accel_bin_cap): the solve beats the
+    sequential FFD baseline on mixed accelerator+generic waves by landing
+    accelerator pods on the cheapest PER-UNIT types instead of letting
+    the scan stack a whole wave (plus co-located generics) onto one big
+    upsized accelerator node."""
+
+    def _mixed_problem(self):
+        from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+        specs = [s for s in build_catalog()
+                 if s.family in ("m5", "c5", "g5")]
+        lattice = build_lattice(specs)
+        pods = [Pod(name=f"p{i}", requests={"cpu": "500m", "memory": "1Gi"})
+                for i in range(24)]
+        pods += [Pod(name=f"g{i}", requests={"cpu": "2", "nvidia.com/gpu": 1})
+                 for i in range(4)]
+        return lattice, pods
+
+    def test_beats_uncapped_ffd_on_mixed_wave(self):
+        """The capped pack must cost LESS than the same pods packed
+        without the cap (the reference's FFD behavior)."""
+        from karpenter_provider_aws_tpu.solver import problem as pm
+        lattice, pods = self._mixed_problem()
+        s = Solver(lattice)
+        capped = s.solve(build_problem(pods, [default_pool()], lattice))
+        orig = pm._accel_bin_cap
+        pm._accel_bin_cap = lambda *a, **k: None
+        try:
+            uncapped = s.solve(build_problem(pods, [default_pool()], lattice))
+        finally:
+            pm._accel_bin_cap = orig
+        assert not capped.unschedulable and not uncapped.unschedulable
+        assert capped.new_node_cost < uncapped.new_node_cost * 0.9, \
+            (capped.new_node_cost, uncapped.new_node_cost)
+        # every accelerator bin is a 1-GPU type (the per-unit optimum)
+        gpu_bins = [n for n in capped.new_nodes
+                    if any(p.startswith("g") for p in n.pods)]
+        assert all(n.instance_type.startswith("g5.xlarge")
+                   for n in gpu_bins), [n.instance_type for n in gpu_bins]
+
+    def test_no_cap_when_big_type_is_per_unit_cheapest(self):
+        """When the multi-GPU type IS the per-unit optimum (e.g. 4-GPU
+        pods that only p4-class types serve), the cap must keep bins at
+        the big type's full count — never force a harmful split."""
+        from karpenter_provider_aws_tpu.apis.resources import resources_to_vec
+        from karpenter_provider_aws_tpu.solver.problem import _accel_bin_cap
+        lattice, _ = self._mixed_problem()
+        vec = resources_to_vec({"cpu": "4", "memory": "16Gi",
+                                "nvidia.com/gpu": 4}, implicit_pod=True)
+        import numpy as np
+        ones_t = np.ones(lattice.T, bool)
+        keep = _accel_bin_cap(
+            vec, ones_t, np.ones(lattice.Z, bool),
+            np.ones(lattice.C, bool), ones_t,
+            np.zeros(lattice.T, bool), lattice)
+        if keep is not None:
+            # whatever types won per-unit, a 4-GPU pod fits whole
+            assert keep.any()
+            gpu_counts = lattice.capacity[keep][:, 4]
+            assert (gpu_counts >= 4).all()
+
+    def test_pool_restricted_gpu_pods_stay_schedulable(self):
+        """Fence (review r4 #1): a pool pinned to one accelerator family
+        must not be narrowed unschedulable by globally-cheaper types the
+        pool can never launch."""
+        from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+        specs = [s for s in build_catalog()
+                 if s.family in ("m5", "g5", "p4d")]
+        lattice = build_lattice(specs)
+        pool = NodePool(name="p4-only", requirements=[
+            Requirement(wk.LABEL_INSTANCE_FAMILY, Operator.IN, ("p4d",))])
+        pods = [Pod(name=f"g{i}", requests={"cpu": "2", "memory": "8Gi",
+                                            "nvidia.com/gpu": 1})
+                for i in range(4)]
+        plan = Solver(lattice).solve(build_problem(pods, [pool], lattice))
+        assert not plan.unschedulable, plan.unschedulable
+        assert all(n.instance_type.startswith("p4d")
+                   for n in plan.new_nodes)
+
+    def test_existing_gpu_capacity_still_joinable(self):
+        """Fence (review r4 #2): free GPUs on a running multi-GPU node
+        beat launching new small nodes — the narrowed mask must keep the
+        existing node's type joinable."""
+        lattice, _ = self._mixed_problem()
+        big = "g5.12xlarge"
+        ti = lattice.name_to_idx[big]
+        existing = [ExistingBin(
+            name="running-gpu", node_pool="default", instance_type=big,
+            zone=lattice.zones[0], capacity_type="on-demand",
+            used=np.zeros((R,), np.float32))]
+        pods = [Pod(name=f"g{i}", requests={"cpu": "2", "nvidia.com/gpu": 1})
+                for i in range(3)]
+        plan = Solver(lattice).solve(build_problem(
+            pods, [default_pool()], lattice, existing=existing))
+        assert not plan.unschedulable
+        assert sorted(sum(plan.existing_assignments.values(), [])) ==             ["g0", "g1", "g2"], (plan.existing_assignments,
+                                 [n.instance_type for n in plan.new_nodes])
+        assert plan.new_nodes == []
+
+    def test_per_unit_ranking_respects_capacity_type(self):
+        """Fence (review r4 #3): an on-demand-only group ranks per-unit
+        prices over ON-DEMAND offerings; the cap still applies and the
+        pods schedule on on-demand accelerator capacity."""
+        lattice, _ = self._mixed_problem()
+        pool = NodePool(name="od", requirements=[
+            Requirement(wk.LABEL_CAPACITY_TYPE, Operator.IN,
+                        ("on-demand",))])
+        pods = [Pod(name=f"g{i}", requests={"cpu": "2", "nvidia.com/gpu": 1})
+                for i in range(4)]
+        plan = Solver(lattice).solve(build_problem(pods, [pool], lattice))
+        assert not plan.unschedulable, plan.unschedulable
+        assert all(n.capacity_type == "on-demand" for n in plan.new_nodes)
+
+    def test_cap_respects_hostname_self_affinity(self):
+        """single_bin (hostname self-affinity) outranks the accel cap:
+        all replicas still co-locate."""
+        from karpenter_provider_aws_tpu.apis.objects import PodAffinityTerm
+        lattice, _ = self._mixed_problem()
+        pods = [Pod(name=f"co{i}", labels={"app": "trainer"},
+                    requests={"cpu": "1", "nvidia.com/gpu": 1},
+                    pod_affinity=[PodAffinityTerm(
+                        topology_key=wk.LABEL_HOSTNAME,
+                        label_selector=(("app", "trainer"),))])
+                for i in range(3)]
+        plan = Solver(lattice).solve(
+            build_problem(pods, [default_pool()], lattice))
+        assert not plan.unschedulable
+        assert len(plan.new_nodes) == 1
+        assert len(plan.new_nodes[0].pods) == 3

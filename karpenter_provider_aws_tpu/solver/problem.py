@@ -77,6 +77,9 @@ class PodGroup:
     need: np.ndarray = None        # [A] hostname affinity presence requirements
     strict_custom: bool = False    # has existence-requiring custom-key constraints
                                    # (resolvable only via a known pool's labels)
+    unnarrowed_type_mask: Optional[np.ndarray] = None  # pre-accel-narrowing
+                                   # mask; the feasibility gate falls back to
+                                   # it if narrowing made the group infeasible
 
 
 @dataclass
@@ -915,25 +918,39 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
                 np_ok_s = np_ok & np.array(
                     [all(eff.get(k) == v for k, v in s.custom.items())
                      for eff in pool_eff_labels], dtype=bool)
-            per_bin = topo.max_per_bin
             g_tmask = masks.type_mask
+            unnarrowed = None
             if not topo.single_bin:
                 # accelerator bin-splitting (see _accel_bin_cap) — never
-                # applied over hostname self-affinity's one-bin contract
-                pool_tmask = (np_type[np_ok_s].any(axis=0)
-                              if np_ok_s.any() else np.zeros(T, dtype=bool))
+                # applied over hostname self-affinity's one-bin contract.
+                # Ranking sees only offerings SOME compatible pool can
+                # launch (union of pool type/zone/captype masks); the
+                # feasibility gate below still holds the pre-narrowing
+                # mask as a fallback for per-pool interactions the union
+                # can't capture.
+                if np_ok_s.any():
+                    pool_tmask = np_type[np_ok_s].any(axis=0)
+                    pool_zmask = np_zone[np_ok_s].any(axis=0)
+                    pool_cmask = np_cap[np_ok_s].any(axis=0)
+                else:
+                    pool_tmask = np.zeros(T, dtype=bool)
+                    pool_zmask = np.zeros(Z, dtype=bool)
+                    pool_cmask = np.zeros(C, dtype=bool)
                 a_mask = _accel_bin_cap(
-                    vec, masks.type_mask, s.zone_mask, s.cap_mask,
-                    pool_tmask, existing_tmask, lattice)
+                    vec, masks.type_mask, s.zone_mask & pool_zmask,
+                    s.cap_mask & pool_cmask, pool_tmask, existing_tmask,
+                    lattice)
                 if a_mask is not None and a_mask.any():
+                    unnarrowed = masks.type_mask
                     g_tmask = a_mask
             g = PodGroup(
                 signature=repr(sig), pod_names=sub_names, req=vec,
                 type_mask=g_tmask, zone_mask=s.zone_mask, cap_mask=s.cap_mask,
                 np_ok=np_ok_s, requirements=reqs,
-                max_per_bin=per_bin, spread_class=topo.spread_class,
+                max_per_bin=topo.max_per_bin, spread_class=topo.spread_class,
                 single_bin=topo.single_bin,
                 strict_custom=strict,
+                unnarrowed_type_mask=unnarrowed,
             )
             groups.append(g)
             pending_topo.append((g, rep, topo.owner, topo.need))
@@ -950,16 +967,27 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
             g.need[: need.size] = need
 
     # mark groups with no feasible (pool, type, offering) at all
-    schedulable_groups: List[PodGroup] = []
-    for g in groups:
-        feasible = False
+    def _has_offering(g) -> bool:
         for pi in np.nonzero(g.np_ok)[0]:
             tm = g.type_mask & np_type[pi]
             zm = g.zone_mask & np_zone[pi]
             cm = g.cap_mask & np_cap[pi]
-            if (tm[:, None, None] & zm[None, :, None] & cm[None, None, :] & lattice.available).any():
-                feasible = True
-                break
+            if (tm[:, None, None] & zm[None, :, None] & cm[None, None, :]
+                    & lattice.available).any():
+                return True
+        return False
+
+    schedulable_groups: List[PodGroup] = []
+    for g in groups:
+        feasible = _has_offering(g)
+        if not feasible and g.unnarrowed_type_mask is not None:
+            # accel narrowing must never COST schedulability: per-pool
+            # interactions (zone pins, ICE, daemonset overhead at pack
+            # time) the union-masked ranking can't see fall back to the
+            # full mask (the pre-narrowing behavior)
+            g.type_mask = g.unnarrowed_type_mask
+            g.unnarrowed_type_mask = None
+            feasible = _has_offering(g)
         if feasible or len(existing) > 0:
             # groups infeasible for new nodes may still fit existing capacity
             schedulable_groups.append(g)

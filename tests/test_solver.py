@@ -1054,3 +1054,34 @@ class TestAccelBinSplitting:
         assert not plan.unschedulable
         assert len(plan.new_nodes) == 1
         assert len(plan.new_nodes[0].pods) == 3
+
+    def test_narrowing_never_costs_schedulability(self):
+        """Fence (review r4 second pass): when narrowing interacts badly
+        with downstream constraints (here: the narrowed type is ICE'd in
+        the only pool-launchable zone), the group falls back to the full
+        mask instead of going unschedulable."""
+        from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+        from karpenter_provider_aws_tpu.lattice.tensors import masked_view
+        import numpy as np
+        specs = [s for s in build_catalog() if s.family in ("m5", "g5")]
+        lattice = build_lattice(specs)
+        # pool pinned to one zone
+        pool = NodePool(name="pinned", requirements=[
+            Requirement(wk.LABEL_ZONE, Operator.IN, ("us-west-2a",))])
+        # ICE out every 1-GPU type's offerings in that zone so the
+        # narrowed set (cheap small types) has nothing the pool can launch
+        mask = np.ones_like(lattice.available)
+        zi = lattice.zones.index("us-west-2a")
+        for i, name in enumerate(lattice.names):
+            if lattice.capacity[i, 4] in (1.0,):   # nvidia axis
+                mask[i, zi, :] = False
+        view = masked_view(lattice, mask)
+        pods = [Pod(name=f"g{i}", requests={"cpu": "2", "nvidia.com/gpu": 1})
+                for i in range(2)]
+        plan = Solver(view).solve(build_problem(pods, [pool], view))
+        assert not plan.unschedulable, plan.unschedulable
+        # landed on a multi-GPU type in the pinned zone (the fallback)
+        for n in plan.new_nodes:
+            assert n.zone == "us-west-2a"
+            ti = view.name_to_idx[n.instance_type]
+            assert view.capacity[ti, 4] > 1

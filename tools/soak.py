@@ -49,16 +49,27 @@ def main(argv=None) -> int:
     ap.add_argument("--families", default="m5,c5,r5,t3")
     ap.add_argument("--out", default="soak_timeseries.json",
                     help="time-series artifact path ('' disables)")
+    ap.add_argument("--api-mode", action="store_true",
+                    help="drive ALL churn through the fake apiserver "
+                         "(watch/list protocol + ApiWriter controllers); "
+                         "adds a server-vs-mirror agreement invariant")
     args = ap.parse_args(argv)
 
     fams = tuple(args.families.split(","))
     lattice = build_lattice([s for s in build_catalog() if s.family in fams])
     q = FakeQueue("soak-q")
+    api_server = client = None
+    if args.api_mode:
+        from karpenter_provider_aws_tpu.kube import FakeAPIServer, KubeClient
+        from karpenter_provider_aws_tpu.kube.apiserver import NotFoundError as KubeNotFound
+        api_server = FakeAPIServer()
+        client = KubeClient(api_server)
     op = Operator(options=Options(registration_delay=0.2,
                                   batch_idle_duration=0.05,
                                   batch_max_duration=0.5,
                                   interruption_queue="soak-q"),
-                  lattice=lattice, interruption_queue=q)
+                  lattice=lattice, interruption_queue=q,
+                  api_server=api_server)
     rt = ControllerRuntime(operator_specs(op)).start()
     from karpenter_provider_aws_tpu.debug import Monitor, dump_state
     monitor = Monitor(op).start(interval=1.0)
@@ -78,16 +89,26 @@ def main(argv=None) -> int:
             if r < 0.5:
                 for _ in range(rng.randint(1, 15)):
                     i += 1
-                    op.cluster.add_pod(Pod(
+                    pod = Pod(
                         name=f"s{i}",
                         requests={"cpu": f"{rng.choice([250, 500, 1000, 2000])}m",
-                                  "memory": f"{rng.choice([512, 1024, 2048])}Mi"}))
+                                  "memory": f"{rng.choice([512, 1024, 2048])}Mi"})
+                    if client is not None:
+                        client.create_pod(pod)   # through the protocol
+                    else:
+                        op.cluster.add_pod(pod)
             elif r < 0.8:
                 # heavy deletion waves -> underutilized nodes -> consolidation
                 names = list(op.cluster.pods)
                 for name in rng.sample(names,
                                        min(len(names), rng.randint(5, 30))):
-                    op.cluster.delete_pod(name)
+                    if client is not None:
+                        try:
+                            client.delete_pod(name)
+                        except KubeNotFound:
+                            pass   # raced a controller's teardown
+                    else:
+                        op.cluster.delete_pod(name)
             elif r < 0.88:
                 insts = safe_instances()
                 if insts:
@@ -136,6 +157,18 @@ def main(argv=None) -> int:
           f"nodes={len(op.cluster.nodes)} claims={len(op.cluster.claims)} "
           f"leaked={len(leaked)} orphan_leases={len(orphans)}")
     ok = not pending and not leaked and not orphans
+    if client is not None:
+        # server-vs-mirror agreement: after convergence the watch-fed
+        # mirror and the apiserver's truth must be identical sets
+        op.sync_once()
+        server_pods = {p.name for p in client.list_pods()}
+        server_nodes = {n.name for n in client.list_nodes()}
+        agree = (server_pods == set(op.cluster.pods)
+                 and server_nodes == set(op.cluster.nodes))
+        print(f"soak: server-vs-mirror agreement "
+              f"{'OK' if agree else 'VIOLATED'} "
+              f"(pods {len(server_pods)}, nodes {len(server_nodes)})")
+        ok = ok and agree
     if args.out:
         monitor.write(args.out)
         print(f"soak: time series -> {args.out} "

@@ -400,3 +400,41 @@ class TestKitchenSink:
         converge(env, rounds=80, step=2.0)
         assert_all_bound(env)
         assert_no_leaks(env)
+
+
+class TestApiModeScale:
+    """The envtest stratum at scale: a few hundred pods through the
+    watch/list protocol, then a deletion wave consolidating down — the
+    apiserver seam under the same load shapes the direct stratum runs."""
+
+    def test_scale_up_and_consolidate_through_api(self, lattice):
+        from karpenter_provider_aws_tpu.kube import FakeAPIServer, KubeClient
+        clock = FakeClock()
+        server = FakeAPIServer(clock=clock)
+        op = Operator(options=Options(registration_delay=1.0),
+                      lattice=lattice, clock=clock, api_server=server)
+        client = KubeClient(server)
+        for i in range(300):
+            client.create_pod(Pod(
+                name=f"w{i}", requests={"cpu": "1", "memory": "2Gi"}))
+        op.settle(max_rounds=80)
+        pods = client.list_pods()
+        assert all(p.node_name for p in pods), \
+            sum(1 for p in pods if not p.node_name)
+        n_before = len(client.list_nodes())
+        assert n_before >= 3
+        # mirror/server agreement at scale
+        assert {n.name for n in client.list_nodes()} == set(op.cluster.nodes)
+        # delete 80% through the API → consolidation shrinks the fleet
+        for i in range(240):
+            client.delete_pod(f"w{i}")
+        for _ in range(50):
+            op.run_once()
+            clock.step(30.0)
+        op.settle(max_rounds=40)   # land any mid-flight drain/replace
+        survivors = client.list_pods()
+        assert len(survivors) == 60 and all(p.node_name for p in survivors)
+        n_after = len(client.list_nodes())
+        assert n_after < n_before, (n_before, n_after)
+        assert {c.name for c in client.list_nodeclaims()} == \
+            set(op.cluster.claims)

@@ -819,22 +819,30 @@ class Solver:
         """Vectorized feasible sets for L bins at once: [L,T],[L,Z],[L,C]
         masks → per-bin (types cheapest-first, zones, captypes) lists.
 
-        Bins are bucketed by their (zone, captype) mask pattern — Z and C
-        are tiny, so hundreds of bins collapse to a handful of patterns,
-        each reduced over the lattice once — instead of materializing the
-        full [L,T,Z,C] offer tensor."""
+        Bins are bucketed by their FULL (type, zone, captype) mask
+        pattern — a 50k-pod wave's ~1500 bins collapse to a handful of
+        patterns (bins seeded by the same group share all three masks),
+        so the T-wide price argsort runs once per pattern instead of once
+        per bin (measured: 13 ms → <1 ms at 1486 bins). Callers get
+        FRESH lists per bin (downstream code reassigns but must never
+        see a neighbor's mutation)."""
         lat = self.lattice
         L = tm.shape[0]
         if L == 0:
             return []
         avail_np = problem.lattice.available                  # [T,Z,C]
         p_all = np.where(avail_np, problem.lattice.price, np.inf)
-        patterns: Dict[bytes, List[int]] = {}
+        # two-level bucketing: the [T,nz,nc] price/availability reductions
+        # run once per OUTER (zone,captype) pattern; the cheap T-wide
+        # argsort + list build run once per inner type-mask variant
+        outer: Dict[bytes, Dict[bytes, List[int]]] = {}
         for l in range(L):
-            patterns.setdefault(zm[l].tobytes() + cm[l].tobytes(), []).append(l)
+            outer.setdefault(zm[l].tobytes() + cm[l].tobytes(), {})                  .setdefault(tm[l].tobytes(), []).append(l)
         out: List[tuple] = [None] * L                          # type: ignore[list-item]
-        for idxs in patterns.values():
-            z, c = zm[idxs[0]], cm[idxs[0]]
+        names, zone_names, cap_names = lat.names, lat.zones, lat.capacity_types
+        for zc_groups in outer.values():
+            first = next(iter(zc_groups.values()))[0]
+            z, c = zm[first], cm[first]
             best = np.full(lat.T, np.inf)                      # [T]
             av_tz = np.zeros((lat.T, lat.Z), bool)
             av_tc = np.zeros((lat.T, lat.C), bool)
@@ -844,23 +852,20 @@ class Solver:
                 sub_av = avail_np[:, z][:, :, c]
                 av_tz[:, z] = sub_av.any(axis=2)
                 av_tc[:, c] = sub_av.any(axis=1)
-            tms = tm[idxs]                                     # [K,T]
-            bpt = np.where(tms, best[None], np.inf)            # [K,T]
-            # argsort puts inf (infeasible) types last, so the first
-            # n_finite[k] entries of order[k] are exactly the feasible types
-            order = np.argsort(bpt, axis=1, kind="stable")
-            n_fin = np.isfinite(bpt).sum(axis=1)               # [K]
-            top = order[:, :MAX_FLEXIBLE_TYPES].tolist()
-            zones_any = (tms @ av_tz).tolist()                 # [K,Z]
-            caps_any = (tms @ av_tc).tolist()                  # [K,C]
-            names, zone_names, cap_names = lat.names, lat.zones, lat.capacity_types
-            for k, l in enumerate(idxs):
-                nf = min(int(n_fin[k]), MAX_FLEXIBLE_TYPES)
-                out[l] = (
-                    [names[t] for t in top[k][:nf]],
-                    [zone_names[zi] for zi, v in enumerate(zones_any[k]) if v],
-                    [cap_names[ci] for ci, v in enumerate(caps_any[k]) if v],
-                )
+            for idxs in zc_groups.values():
+                t_mask = tm[idxs[0]]
+                bpt = np.where(t_mask, best, np.inf)           # [T]
+                # argsort puts inf (infeasible) types last, so the first
+                # n_fin entries of order are exactly the feasible types
+                order = np.argsort(bpt, kind="stable")
+                nf = min(int(np.isfinite(bpt).sum()), MAX_FLEXIBLE_TYPES)
+                types = [names[t] for t in order[:nf].tolist()]
+                zones = [zone_names[zi]
+                         for zi, v in enumerate(t_mask @ av_tz) if v]
+                caps = [cap_names[ci]
+                        for ci, v in enumerate(t_mask @ av_tc) if v]
+                for l in idxs:
+                    out[l] = (list(types), list(zones), list(caps))
         return out
 
     # ---- pod-axis sharded solve (multi-chip path) ----

@@ -106,6 +106,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "thread (the controller-runtime analog with "
                         "MaxConcurrentReconciles-style concurrency) instead "
                         "of the deterministic single-threaded loop.")
+    p.add_argument("--api-host", default="127.0.0.1",
+                   help="Bind host for --api-port (default loopback: the "
+                        "REST surface is write-capable and "
+                        "unauthenticated; exposing it is an explicit "
+                        "deployment decision).")
+    p.add_argument("--api-port", type=int, default=0,
+                   help="Serve the control plane's apiserver over HTTP "
+                        "REST on this port (kube/httpserver.py: "
+                        "list/watch/create/update/patch/delete + "
+                        "binding/eviction subresources). The operator "
+                        "runs in API mode: controllers write through "
+                        "the client, informers feed the mirror, and "
+                        "EXTERNAL agents drive the same seam over the "
+                        "wire. 0 disables (direct mode).")
     p.add_argument("--leader-elect-lease-file", default=None,
                    help="Enable lease-based leader election over this "
                         "shared file (async runtime only): standby "
@@ -224,14 +238,35 @@ def start_server(op: Operator, port: int) -> ThreadingHTTPServer:
     return server
 
 
-def main(argv: Optional[Sequence[str]] = None) -> int:
+def main(argv: Optional[Sequence[str]] = None,
+         stop_event: Optional[threading.Event] = None) -> int:
+    """``stop_event`` is the programmatic SIGTERM: tests (which cannot
+    signal a thread) set it to end the run early."""
     args = build_parser().parse_args(argv)
     from .utils.logging import configure as configure_logging
     configure_logging(args.log_level)
     opts = options_from_args(args)
-    op = Operator(options=opts)
+    api_server = None
+    api_httpd = None
+    if args.api_port:
+        from .kube import (FakeAPIServer, install_admission,
+                           install_default_indexes)
+        from .kube.httpserver import serve as serve_api
+        api_server = FakeAPIServer()
+        # admission/indexes are wired BEFORE the first byte is served:
+        # objects written during the (slow) operator build face the same
+        # 422-with-causes contract as every later write
+        install_default_indexes(api_server)
+        install_admission(api_server)
+        api_httpd = serve_api(api_server, args.api_port,
+                              host=args.api_host)
+        from .utils.logging import get_logger
+        get_logger("cli").info(
+            "apiserver REST surface listening",
+            port=api_httpd.server_address[1])
+    op = Operator(options=opts, api_server=api_server)
 
-    stop = threading.Event()
+    stop = stop_event or threading.Event()
 
     def _stop(signum, frame):
         stop.set()
@@ -264,6 +299,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 elector = LeaderElector(
                     FileLeaseStore(args.leader_elect_lease_file),
                     identity=f"{os.uname().nodename}-{os.getpid()}")
+            elif api_server is not None:
+                # API mode elects through the apiserver's coordination
+                # lease (client-go semantics) with no extra wiring
+                import os
+                from .operator.leaderelection import (ApiLeaseStore,
+                                                      LeaderElector)
+                elector = LeaderElector(
+                    ApiLeaseStore(api_server),
+                    identity=f"{os.uname().nodename}-{os.getpid()}")
             runtime = ControllerRuntime(operator_specs(op),
                                         elector=elector).start()
             while not stop.is_set():
@@ -285,6 +329,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             sidecar.stop(grace=None)
         if server is not None:
             server.shutdown()
+        if api_httpd is not None:
+            api_httpd.shutdown()
     return 0
 
 

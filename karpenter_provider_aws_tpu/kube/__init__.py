@@ -12,11 +12,12 @@ from .client import (
     install_default_indexes,
 )
 from .informer import Informer, InformerSet
+from .httpserver import serve as serve_http
 
 __all__ = [
     "APIError", "AlreadyExistsError", "ConflictError",
     "EvictionBlockedError", "FakeAPIServer", "Informer", "InformerSet",
     "InvalidObjectError", "KubeClient", "NotFoundError",
     "TERMINATION_FINALIZER", "TooOldError", "Watch", "WatchEvent",
-    "install_admission", "install_default_indexes",
+    "install_admission", "install_default_indexes", "serve_http",
 ]

@@ -205,7 +205,11 @@ class KubeClient:
 
 def install_default_indexes(server: FakeAPIServer) -> None:
     """The manager's field indexes (reference operator.go:180-186 indexes
-    NodeClaims on status.providerID for instance→claim lookups)."""
+    NodeClaims on status.providerID for instance→claim lookups).
+    Idempotent: double wiring (cli pre-serve + Operator) is a no-op."""
+    if getattr(server, "_kpat_indexes_installed", False):
+        return
+    server._kpat_indexes_installed = True
     server.add_index("nodeclaims", "providerID",
                      lambda spec: spec.get("providerID"))
     server.add_index("pods", "nodeName", lambda spec: spec.get("nodeName"))
@@ -216,7 +220,11 @@ def install_admission(server: FakeAPIServer) -> None:
     pkg/webhooks/webhooks.go): defaults first, then SCHEMA validation
     (apis/schema.py — the machine-readable CRD contract, patterns/enums/
     cross-field rules), then the semantic webhooks. Nothing structurally
-    or semantically invalid crosses the seam."""
+    or semantically invalid crosses the seam. Idempotent: double wiring
+    (cli pre-serve + Operator) must not chain validators twice."""
+    if getattr(server, "_kpat_admission_installed", False):
+        return
+    server._kpat_admission_installed = True
     from .. import webhooks
     from ..apis import schema
 

@@ -1,0 +1,218 @@
+"""HTTP REST surface over the FakeAPIServer — the wire-reachable seam.
+
+In-process, controllers speak to the apiserver through KubeClient. This
+module serves the SAME verbs over HTTP so an external agent (kubectl-
+style tooling, a non-Python writer, another host) can drive the control
+plane across a process boundary — the last step of the reference's
+ingest story (its controllers talk to a remote apiserver over REST;
+SURVEY §1 L0). Routes, mirroring the k8s path shapes:
+
+    GET    /apis/{kind}                    list → {items, resourceVersion}
+    GET    /apis/{kind}?watch=1&resourceVersion=N
+                                           chunked JSON-lines watch stream
+    GET    /apis/{kind}/{name}             get → envelope
+    POST   /apis/{kind}                    create (spec body) → envelope
+    PUT    /apis/{kind}/{name}             update (full envelope body)
+    PATCH  /apis/{kind}/{name}             merge patch {spec?, finalizers?}
+    DELETE /apis/{kind}/{name}[?force=1]   delete (finalizer-aware)
+    POST   /apis/pods/{name}/binding       {"nodeName": ...}
+    POST   /apis/pods/{name}/eviction[?force=1]
+
+Error mapping is the real protocol's: 404 NotFound, 409 Conflict /
+AlreadyExists, 410 Gone (watch too old), 422 Invalid (admission, with
+causes), 429 eviction blocked by a PodDisruptionBudget.
+
+The watch stream emits one JSON object per line ({type, object,
+resourceVersion}) and a periodic heartbeat line so half-open
+connections die; it ends when the client disconnects.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from .apiserver import (
+    AlreadyExistsError, APIError, ConflictError, EvictionBlockedError,
+    FakeAPIServer, InvalidObjectError, NotFoundError, TooOldError,
+)
+
+WATCH_HEARTBEAT_SECONDS = 15.0
+
+
+def _route(path: str) -> Tuple[str, Optional[str], Optional[str]]:
+    """'/apis/pods/p0/binding' → ('pods', 'p0', 'binding')."""
+    parts = [p for p in path.split("/") if p]
+    if len(parts) < 2 or parts[0] != "apis":
+        raise NotFoundError(f"no route {path}")
+    kind = parts[1]
+    name = parts[2] if len(parts) > 2 else None
+    sub = parts[3] if len(parts) > 3 else None
+    return kind, name, sub
+
+
+def serve(server: FakeAPIServer, port: int = 0,
+          host: str = "127.0.0.1") -> ThreadingHTTPServer:
+    """Serve the apiserver on ``host:port`` (port 0 = ephemeral); returns
+    the HTTP server (``.server_address[1]`` carries the bound port).
+    Defaults to loopback: this surface is WRITE-CAPABLE and
+    unauthenticated — exposing it beyond the host is an explicit
+    deployment decision (pass host='0.0.0.0')."""
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        # ---- plumbing --------------------------------------------------
+
+        def _json(self, code: int, doc) -> None:
+            body = json.dumps(doc).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _error(self, e: Exception) -> None:
+            code = (404 if isinstance(e, NotFoundError) else
+                    409 if isinstance(e, (ConflictError, AlreadyExistsError))
+                    else 410 if isinstance(e, TooOldError) else
+                    422 if isinstance(e, InvalidObjectError) else
+                    429 if isinstance(e, EvictionBlockedError) else
+                    400 if isinstance(e, (APIError, ValueError, KeyError))
+                    else 500)
+            doc = {"error": type(e).__name__, "message": str(e)}
+            if isinstance(e, InvalidObjectError):
+                doc["causes"] = e.causes
+            self._json(code, doc)
+
+        def _body(self) -> dict:
+            length = int(self.headers.get("Content-Length", "0"))
+            raw = self.rfile.read(length) if length else b"{}"
+            doc = json.loads(raw or b"{}")
+            if not isinstance(doc, dict):
+                raise ValueError("body must be a JSON object")
+            return doc
+
+        # ---- verbs -----------------------------------------------------
+
+        def do_GET(self):
+            try:
+                url = urlparse(self.path)
+                kind, name, sub = _route(url.path)
+                if sub is not None:
+                    raise NotFoundError(f"no route {url.path}")
+                q = parse_qs(url.query)
+                if name is not None:
+                    self._json(200, server.get(kind, name))
+                    return
+                if q.get("watch", ["0"])[0] in ("1", "true"):
+                    self._watch(kind, int(q.get("resourceVersion", ["0"])[0]))
+                    return
+                items, rv = server.list(kind)
+                self._json(200, {"items": items, "resourceVersion": rv})
+            except Exception as e:
+                self._error(e)
+
+        def _watch(self, kind: str, rv: int) -> None:
+            w = server.watch(kind, rv)   # raises TooOldError → 410
+
+            def chunk(payload: bytes) -> None:
+                self.wfile.write(f"{len(payload):x}\r\n".encode()
+                                 + payload + b"\r\n")
+                self.wfile.flush()
+
+            # everything after subscription lives under the finally that
+            # unsubscribes — a client dropping during the header writes
+            # must not leak the Watch (its queue would grow forever)
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                while True:
+                    ev = w.get(timeout=WATCH_HEARTBEAT_SECONDS)
+                    if ev is None:
+                        chunk(b'{"type":"HEARTBEAT"}\n')
+                        continue
+                    chunk(json.dumps({
+                        "type": ev.type, "object": ev.object,
+                        "resourceVersion": ev.resource_version,
+                    }).encode() + b"\n")
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass   # client went away: normal watch teardown
+            finally:
+                server.stop_watch(w)
+
+        def do_POST(self):
+            try:
+                url = urlparse(self.path)
+                kind, name, sub = _route(url.path)
+                q = parse_qs(url.query)
+                if kind == "pods" and name is not None and sub == "binding":
+                    body = self._body()
+                    self._json(200, server.bind(name, body["nodeName"]))
+                    return
+                if kind == "pods" and name is not None and sub == "eviction":
+                    force = q.get("force", ["0"])[0] in ("1", "true")
+                    self._json(200, server.evict(name, force=force))
+                    return
+                if name is not None:
+                    raise NotFoundError(f"no route {url.path}")
+                self._json(201, server.create(kind, self._body()))
+            except Exception as e:
+                self._error(e)
+
+        def do_PUT(self):
+            try:
+                kind, name, sub = _route(urlparse(self.path).path)
+                if sub is not None:
+                    raise NotFoundError(f"no route {self.path}")
+                if name is None:
+                    raise NotFoundError("PUT needs a name")
+                obj = self._body()
+                if obj.get("metadata", {}).get("name") != name:
+                    raise ValueError("metadata.name must match the URL")
+                self._json(200, server.update(kind, obj))
+            except Exception as e:
+                self._error(e)
+
+        def do_PATCH(self):
+            try:
+                kind, name, sub = _route(urlparse(self.path).path)
+                if sub is not None:
+                    raise NotFoundError(f"no route {self.path}")
+                if name is None:
+                    raise NotFoundError("PATCH needs a name")
+                body = self._body()
+                self._json(200, server.patch(
+                    kind, name, body.get("spec"),
+                    finalizers=body.get("finalizers")))
+            except Exception as e:
+                self._error(e)
+
+        def do_DELETE(self):
+            try:
+                url = urlparse(self.path)
+                kind, name, sub = _route(url.path)
+                if sub is not None:
+                    # e.g. DELETE /apis/pods/p0/eviction — the wrong verb
+                    # must NEVER fall through to deleting the parent
+                    raise NotFoundError(f"no route {url.path}")
+                if name is None:
+                    raise NotFoundError("DELETE needs a name")
+                q = parse_qs(url.query)
+                force = q.get("force", ["0"])[0] in ("1", "true")
+                server.delete(kind, name, force=force)
+                self._json(200, {"status": "ok"})
+            except Exception as e:
+                self._error(e)
+
+        def log_message(self, *a):   # quiet by default
+            pass
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd

@@ -1,0 +1,212 @@
+"""The apiserver over HTTP: the wire-reachable ingest boundary.
+
+An external agent (urllib here, standing in for any non-Python client)
+drives the SAME control plane the in-process controllers reconcile —
+create pods over REST, watch the node stream, observe the operator
+provision; protocol errors map to the real status codes.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import NodePool, Pod, serde
+from karpenter_provider_aws_tpu.kube import FakeAPIServer, install_admission
+from karpenter_provider_aws_tpu.kube.httpserver import serve
+
+
+@pytest.fixture()
+def api():
+    s = FakeAPIServer()
+    install_admission(s)
+    httpd = serve(s, 0)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield s, base
+    httpd.shutdown()
+
+
+def req(method, url, doc=None):
+    r = urllib.request.Request(
+        url, method=method,
+        data=None if doc is None else json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(r) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def status_of(err_ctx):
+    return err_ctx.value.code
+
+
+class TestRestVerbs:
+    def test_create_get_list_roundtrip(self, api):
+        _, base = api
+        spec = serde.pod_to_dict(Pod(name="p0",
+                                     requests={"cpu": "1", "memory": "1Gi"}))
+        code, obj = req("POST", f"{base}/apis/pods", spec)
+        assert code == 201 and obj["metadata"]["name"] == "p0"
+        code, got = req("GET", f"{base}/apis/pods/p0")
+        assert got["spec"]["requests"]["cpu"] == "1"
+        code, listed = req("GET", f"{base}/apis/pods")
+        assert len(listed["items"]) == 1
+        assert listed["resourceVersion"] >= 1
+
+    def test_update_conflict_409(self, api):
+        _, base = api
+        spec = serde.pod_to_dict(Pod(name="p0",
+                                     requests={"cpu": "1", "memory": "1Gi"}))
+        req("POST", f"{base}/apis/pods", spec)
+        _, obj = req("GET", f"{base}/apis/pods/p0")
+        req("PATCH", f"{base}/apis/pods/p0", {"spec": {"priority": 1}})
+        obj["spec"]["priority"] = 2
+        with pytest.raises(urllib.error.HTTPError) as e:
+            req("PUT", f"{base}/apis/pods/p0", obj)
+        assert status_of(e) == 409
+
+    def test_admission_422_with_causes(self, api):
+        _, base = api
+        bad = serde.nodepool_to_dict(NodePool(name="bad"))
+        bad["disruption"]["budgets"] = [{"nodes": "150%"}]
+        with pytest.raises(urllib.error.HTTPError) as e:
+            req("POST", f"{base}/apis/nodepools", bad)
+        assert status_of(e) == 422
+        causes = json.loads(e.value.read())["causes"]
+        assert any("nodes" in c for c in causes)
+
+    def test_missing_404_unknown_kind_400(self, api):
+        _, base = api
+        with pytest.raises(urllib.error.HTTPError) as e:
+            req("GET", f"{base}/apis/pods/ghost")
+        assert status_of(e) == 404
+        with pytest.raises(urllib.error.HTTPError) as e:
+            req("GET", f"{base}/apis/gadgets")
+        assert status_of(e) == 400
+
+    def test_binding_and_eviction_subresources(self, api):
+        server, base = api
+        spec = serde.pod_to_dict(Pod(name="p0",
+                                     requests={"cpu": "1", "memory": "1Gi"}))
+        req("POST", f"{base}/apis/pods", spec)
+        req("POST", f"{base}/apis/pods/p0/binding", {"nodeName": "n0"})
+        assert server.get("pods", "p0")["spec"]["nodeName"] == "n0"
+        req("POST", f"{base}/apis/pods/p0/eviction", {})
+        assert server.get("pods", "p0")["spec"].get("nodeName") is None
+
+    def test_eviction_blocked_429(self, api):
+        server, base = api
+        from karpenter_provider_aws_tpu.apis import PodDisruptionBudget
+        req("POST", f"{base}/apis/pods", serde.pod_to_dict(
+            Pod(name="p0", requests={"cpu": "1", "memory": "1Gi"},
+                node_name="n0", labels={"app": "db"})))
+        req("POST", f"{base}/apis/pdbs", serde.pdb_to_dict(
+            PodDisruptionBudget(name="pdb", label_selector={"app": "db"},
+                                min_available=1)))
+        with pytest.raises(urllib.error.HTTPError) as e:
+            req("POST", f"{base}/apis/pods/p0/eviction", {})
+        assert status_of(e) == 429
+
+    def test_finalizer_delete_flow(self, api):
+        server, base = api
+        from karpenter_provider_aws_tpu.apis.objects import NodeClaim
+        from karpenter_provider_aws_tpu.kube import KubeClient
+        KubeClient(server).create_nodeclaim(
+            NodeClaim(name="c0", node_pool="default"))
+        req("DELETE", f"{base}/apis/nodeclaims/c0")
+        _, obj = req("GET", f"{base}/apis/nodeclaims/c0")
+        assert obj["metadata"]["deletionTimestamp"] is not None
+        req("PATCH", f"{base}/apis/nodeclaims/c0", {"finalizers": []})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            req("GET", f"{base}/apis/nodeclaims/c0")
+        assert status_of(e) == 404
+
+
+class TestWatchStream:
+    def test_watch_delivers_events_as_json_lines(self, api):
+        server, base = api
+        got = []
+
+        def reader():
+            r = urllib.request.urlopen(
+                f"{base}/apis/pods?watch=1&resourceVersion=0", timeout=10)
+            for line in r:
+                ev = json.loads(line)
+                if ev["type"] == "HEARTBEAT":
+                    continue
+                got.append(ev)
+                if len(got) >= 2:
+                    return
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        for i in range(2):
+            server.create("pods", serde.pod_to_dict(
+                Pod(name=f"p{i}", requests={"cpu": "1", "memory": "1Gi"})))
+        t.join(10)
+        assert [e["type"] for e in got] == ["ADDED", "ADDED"]
+        assert got[0]["object"]["metadata"]["name"] == "p0"
+        assert got[0]["resourceVersion"] < got[1]["resourceVersion"]
+
+    def test_watch_too_old_410(self, api):
+        import collections
+        server, base = api
+        server._history["pods"] = collections.deque(maxlen=2)
+        for i in range(5):
+            server.create("pods", serde.pod_to_dict(
+                Pod(name=f"p{i}", requests={"cpu": "1", "memory": "1Gi"})))
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"{base}/apis/pods?watch=1&resourceVersion=1", timeout=5)
+        assert status_of(e) == 410
+
+
+class TestExternalAgentDrivesControlPlane:
+    def test_rest_created_pods_get_capacity(self):
+        """The full story: an external agent creates pods over HTTP; the
+        operator (informer-fed) provisions; the agent observes nodes and
+        bound pods over HTTP. No shared memory with the scenario at all."""
+        from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+        from karpenter_provider_aws_tpu.operator import Operator, Options
+        from karpenter_provider_aws_tpu.utils.clock import FakeClock
+        clock = FakeClock()
+        server = FakeAPIServer(clock=clock)
+        op = Operator(options=Options(registration_delay=1.0),
+                      lattice=build_lattice([s for s in build_catalog()
+                                             if s.family in ("m5", "t3")]),
+                      clock=clock, api_server=server)
+        httpd = serve(server, 0)
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            for i in range(3):
+                req("POST", f"{base}/apis/pods", serde.pod_to_dict(
+                    Pod(name=f"w{i}",
+                        requests={"cpu": "1", "memory": "2Gi"})))
+            op.settle()
+            _, pods = req("GET", f"{base}/apis/pods")
+            assert all(o["spec"].get("nodeName") for o in pods["items"])
+            _, nodes = req("GET", f"{base}/apis/nodes")
+            assert nodes["items"], "no nodes visible over REST"
+        finally:
+            httpd.shutdown()
+
+
+class TestReviewRegressions:
+    def test_wrong_verb_on_subresource_is_404_not_parent_action(self, api):
+        """DELETE /apis/pods/p0/eviction must NEVER delete the pod."""
+        server, base = api
+        req("POST", f"{base}/apis/pods", serde.pod_to_dict(
+            Pod(name="p0", requests={"cpu": "1", "memory": "1Gi"})))
+        for method in ("DELETE", "PUT", "PATCH", "GET"):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                req(method, f"{base}/apis/pods/p0/eviction",
+                    {} if method != "GET" else None)
+            assert status_of(e) == 404, method
+        server.get("pods", "p0")   # still exists
+
+    def test_binds_loopback_by_default(self, api):
+        _, base = api
+        assert "127.0.0.1" in base

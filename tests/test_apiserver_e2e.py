@@ -223,3 +223,42 @@ class TestWatchDrivenConfigGuard:
         # now the pair contradicts (windows pool, linux family): evicted
         assert "winpool" not in op.node_pools
         assert op.recorder.events(reason="InvalidConfig")
+
+
+class TestInterruptionThroughAPI:
+    def test_spot_interruption_drains_and_replaces_via_api(self, lattice):
+        """The interruption flow in API mode: a spot message cordons and
+        drains through the ApiWriter (eviction subresource, finalizer
+        removal), the pod reschedules, and the doomed node disappears
+        server-side."""
+        from karpenter_provider_aws_tpu.interruption import (
+            FakeQueue, spot_interruption,
+        )
+        from karpenter_provider_aws_tpu.cloud.fake import parse_instance_id
+        clock = FakeClock()
+        server = FakeAPIServer(clock=clock)
+        queue = FakeQueue("e2e-int")
+        # note: API-mode admission DEFAULTS an os/capacity-less pool to
+        # on-demand; the spot→ICE path needs an explicitly spot pool
+        spot_pool = NodePool(name="default", requirements=[
+            Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN, ("spot",))])
+        op = Operator(options=Options(registration_delay=1.0,
+                                      interruption_queue="e2e-int"),
+                      lattice=lattice, clock=clock, api_server=server,
+                      node_pools=[spot_pool],
+                      interruption_queue=queue)
+        client = KubeClient(server)
+        client.create_pod(run_pod("w0"))
+        op.settle()
+        assert client.list_nodeclaims()[0].capacity_type == "spot"
+        claim = client.list_nodeclaims()[0]
+        old_node = client.list_pods()[0].node_name
+        queue.send(spot_interruption(parse_instance_id(claim.provider_id)))
+        op.settle(max_rounds=60)
+        # old claim finalized through the API; the pod rides a new node
+        assert claim.name not in {c.name for c in client.list_nodeclaims()}
+        pod = client.list_pods()[0]
+        assert pod.node_name and pod.node_name != old_node
+        assert old_node not in {n.name for n in client.list_nodes()}
+        # the interrupted offering went into the ICE mask
+        assert any(True for _ in op.unavailable.entries())

@@ -71,6 +71,9 @@ def spec_from_dict(d: dict) -> InstanceTypeSpec:
         efa_count=int(d.get("efaCount", 0)),
         pod_eni_count=int(d.get("podEniCount", 0)),
         od_price=float(d.get("odPrice", 0.0)),
+        spot_prices=(tuple(sorted(
+            (z, float(p)) for z, p in d["spotPrices"].items()))
+            if d.get("spotPrices") else None),
     )
 
 

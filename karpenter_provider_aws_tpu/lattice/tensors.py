@@ -215,8 +215,15 @@ def build_lattice(specs: Optional[Sequence[cat.InstanceTypeSpec]] = None,
                 if not cat.offering_available(s, zone, ct):
                     continue
                 available[i, zi, ci] = True
-                price[i, zi, ci] = (cat.od_price(s, zone) if ct == "on-demand"
-                                    else cat.spot_price(s, zone))
+                if ct == "on-demand":
+                    price[i, zi, ci] = cat.od_price(s, zone)
+                else:
+                    # prefer the spec's data-carried per-AZ spot price
+                    # (real-data catalogs); fall back to the synthetic
+                    # discount model
+                    sp = s.spot_price_in(zone)
+                    price[i, zi, ci] = (sp if sp is not None
+                                        else cat.spot_price(s, zone))
 
     # categorical vocab: id 0 reserved for "undefined on this type"
     cat_keys = wk.DEVICE_CATEGORICAL_KEYS

@@ -1,13 +1,17 @@
-"""Real-data catalog: reference fixtures → JSON → lattice.
+"""Real-data catalog: reference data tables → JSON → lattice.
 
-The imported facts (tools/import_reference_data.py from the reference's
-zz_generated tables) must survive into the lattice EXACTLY: hardware
-shapes from pkg/fake/zz_generated.describe_instance_types.go, ENI/pod
-density + trunking from zz_generated.vpclimits.go, prices from
-zz_generated.pricing_aws.go (us-east-1), and the trn1 Neuron hardcodes
-(types.go:281-291).
+The imported facts (tools/import_reference_data.py) must survive into
+the lattice EXACTLY: the full-breadth per-type labels from the
+reference's generated instance-types doc (website/content/en/preview/
+reference/instance-types.md, 759 sections), ENI/pod density + trunking
+from zz_generated.vpclimits.go (default-card inversion per
+types.go:319-332), prices from zz_generated.pricing_aws.go (us-east-1),
+bandwidth from zz_generated.bandwidth.go, and the trn1 Neuron hardcodes
+(types.go:281-291). Spot prices are data-carried per-AZ (flagged
+derived — the reference ships no static spot table, pricing.go:409-415).
 """
 
+import json
 import pathlib
 import subprocess
 import sys
@@ -38,13 +42,25 @@ def lattice(specs):
     return build_lattice(specs)
 
 
+@pytest.fixture(scope="module")
+def raw_doc():
+    return json.loads(DEFAULT_PATH.read_text())
+
+
 class TestLoader:
-    def test_all_fixture_types_load(self, specs):
+    def test_full_breadth(self, specs):
+        """The catalog is the reference's real ~750-type breadth, not a
+        fixture subset."""
         names = {s.name for s in specs}
+        assert len(specs) >= 700
         assert {"m5.large", "m5.metal", "c6g.large", "t4g.medium",
                 "dl1.24xlarge", "inf1.2xlarge", "trn1.2xlarge",
-                "g4dn.8xlarge", "p3.8xlarge", "m6idn.32xlarge"} <= names
-        assert len(specs) == 15
+                "g4dn.8xlarge", "p3.8xlarge", "m6idn.32xlarge",
+                "p5.48xlarge", "u-24tb1.112xlarge", "hpc7g.16xlarge",
+                "a1.medium", "c7gn.16xlarge"} <= names
+
+    def test_all_types_priced(self, specs):
+        assert all(s.od_price > 0 for s in specs)
 
     def test_family_parsing(self):
         assert parse_family("m6idn") == ("m", 6)
@@ -59,7 +75,7 @@ class TestLoader:
         assert m5.pod_eni_count == 9        # vpclimits BranchInterface
         assert m5.od_price == 0.096         # us-east-1 pricing table
         assert m5.arch == "amd64" and m5.cpu_manufacturer == "intel"
-        assert m5.network_bandwidth_mbps == 750   # bandwidth table
+        assert m5.network_bandwidth_mbps == 750
 
     def test_graviton_facts(self, specs):
         c6g = next(s for s in specs if s.name == "c6g.large")
@@ -79,6 +95,33 @@ class TestLoader:
         # trn1 Neurons are the reference's hardcoded facts (types.go:283-291)
         assert by["trn1.2xlarge"].accelerator_name == "Trainium"
         assert by["trn1.2xlarge"].accelerator_count == 1
+        assert by["p5.48xlarge"].gpu_count == 8     # H100s
+        assert by["p5.48xlarge"].gpu_memory_mib == 81920
+
+    def test_multi_network_card_default_card_enis(self, specs):
+        """vpclimits counts ENIs across all cards, but the VPC CNI only
+        uses the default card (types.go:319-332); the importer inverts
+        the doc's published pods to recover the default-card count."""
+        by = {s.name: s for s in specs}
+        assert by["trn1n.32xlarge"].enis == 5       # not the 80 total
+        assert by["p5.48xlarge"].enis == 2          # not the 64 total
+        assert by["c6in.32xlarge"].enis == 7        # not the 14 total
+
+    def test_efa_from_doc_resources(self, specs):
+        by = {s.name: s for s in specs}
+        assert by["p4d.24xlarge"].efa_count == 4
+        assert by["trn1n.32xlarge"].efa_count == 16
+        assert by["m5.large"].efa_count == 0
+
+    def test_spot_prices_are_data_carried(self, specs, raw_doc):
+        """Spot prices ride the JSON (per-AZ), flagged derived."""
+        assert "derived" in raw_doc["spotSource"]
+        m5 = next(s for s in specs if s.name == "m5.large")
+        assert m5.spot_prices, "real catalog must carry spot prices"
+        zones = [z for z, _ in m5.spot_prices]
+        assert "us-west-2a" in zones
+        for _, p in m5.spot_prices:
+            assert 0 < p < m5.od_price
 
 
 class TestLatticeFromRealData:
@@ -88,7 +131,9 @@ class TestLatticeFromRealData:
         mirrors in shape."""
         pods_ax = ax("pods")
         expect = {"m5.large": 29, "m5.xlarge": 58, "t3.large": 35,
-                  "m5.metal": 737, "c6g.large": 29}
+                  "m5.metal": 737, "c6g.large": 29,
+                  "trn1n.32xlarge": 247, "p5.48xlarge": 100,
+                  "hpc7g.16xlarge": 198}
         for name, pods in expect.items():
             i = lattice.name_to_idx[name]
             assert lattice.capacity[i, pods_ax] == pods, name
@@ -110,6 +155,24 @@ class TestLatticeFromRealData:
         zi, ci = 0, lattice.capacity_types.index("on-demand")
         assert abs(lattice.price[i, zi, ci] - 0.096) < 1e-9
 
+    def test_spot_prices_from_data_not_synthetic(self, lattice, specs):
+        """The lattice's spot axis equals the JSON's numbers (data
+        path), for every available spot offering."""
+        import numpy as np
+        ci = lattice.capacity_types.index("spot")
+        by = {s.name: s for s in specs}
+        checked = 0
+        for i, name in enumerate(lattice.names[:50]):
+            s = by[name]
+            for zi, z in enumerate(lattice.zones):
+                if not lattice.available[i, zi, ci]:
+                    continue
+                sp = s.spot_price_in(z)
+                assert sp is not None, (name, z)
+                assert abs(lattice.price[i, zi, ci] - sp) < 1e-6
+                checked += 1
+        assert checked > 50
+
     def test_solver_runs_on_real_lattice(self, lattice):
         from karpenter_provider_aws_tpu.apis import NodePool, Pod
         from karpenter_provider_aws_tpu.solver import Solver, build_problem
@@ -122,8 +185,9 @@ class TestLatticeFromRealData:
             pods, [NodePool(name="default")], lattice))
         assert not plan.unschedulable
         gpu_nodes = [n for n in plan.new_nodes if "gpu0" in n.pods]
-        assert gpu_nodes and gpu_nodes[0].instance_type in (
-            "g4dn.8xlarge", "p3.8xlarge")
+        assert gpu_nodes
+        gi = lattice.name_to_idx[gpu_nodes[0].instance_type]
+        assert lattice.capacity[gi, ax("nvidia.com/gpu")] >= 1
 
     def test_allocatable_matches_reference_formulas(self, lattice):
         """The overhead math (types.go:341-431) applied to REAL m5.large
@@ -138,6 +202,27 @@ class TestLatticeFromRealData:
         alloc_mem = lattice.alloc[i, ax("memory")]
         reserved = 11 * 29 + 255
         assert abs((cap_mem - alloc_mem) - (reserved + 100)) < 1e-3
+
+    def test_allocatable_matches_reference_published(self, lattice,
+                                                     raw_doc):
+        """Our predicted allocatable equals the reference's OWN published
+        numbers (the instance-types doc's Resources table, preserved per
+        type as refAllocatable) across the ENTIRE catalog — cpu exact,
+        memory within 2 MiB (one rounding divergence on the 24 TiB
+        type)."""
+        cpu_ax, mem_ax, pods_ax = ax("cpu"), ax("memory"), ax("pods")
+        checked = 0
+        for t in raw_doc["types"]:
+            ra = t.get("refAllocatable")
+            if not ra or not ra.get("cpuMilli"):
+                continue
+            i = lattice.name_to_idx[t["name"]]
+            assert lattice.alloc[i, cpu_ax] == ra["cpuMilli"], t["name"]
+            assert abs(lattice.alloc[i, mem_ax] - ra["memoryMi"]) <= 2, \
+                t["name"]
+            assert lattice.alloc[i, pods_ax] == ra["pods"], t["name"]
+            checked += 1
+        assert checked >= 700
 
 
 class TestImporterFreshness:

@@ -35,10 +35,17 @@ def main(argv=None) -> int:
                    help="CSV of reported allocatable "
                         "(instance_type,cpu_m,memory_mib)")
     p.add_argument("--catalog", default=None,
-                   help="'real' (bundled reference-fixture catalog) or a "
+                   help="'real' (bundled reference catalog) or a "
                         "real-data JSON path (lattice/realdata.py schema); "
                         "default: the synthetic catalog")
+    p.add_argument("--against-reference", action="store_true",
+                   help="diff against the reference's own published "
+                        "allocatable (the refAllocatable block the importer "
+                        "preserves from instance-types.md) instead of a "
+                        "reported CSV; implies --catalog real")
     args = p.parse_args(argv)
+    if args.against_reference and not args.catalog:
+        args.catalog = "real"
 
     from karpenter_provider_aws_tpu.apis.resources import RESOURCE_AXES
     from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
@@ -60,6 +67,16 @@ def main(argv=None) -> int:
             for row in csv.DictReader(f):
                 reported[row["instance_type"]] = (
                     float(row["cpu_m"]), float(row["memory_mib"]))
+    elif args.against_reference:
+        import json
+        from karpenter_provider_aws_tpu.lattice.realdata import DEFAULT_PATH
+        path = (DEFAULT_PATH if args.catalog == "real" else args.catalog)
+        doc = json.loads(Path(path).read_text())
+        for t in doc["types"]:
+            ra = t.get("refAllocatable")
+            if ra and ra.get("cpuMilli"):
+                reported[t["name"]] = (float(ra["cpuMilli"]),
+                                       float(ra["memoryMi"]))
 
     rows = []
     for i, name in enumerate(lattice.names):

@@ -228,6 +228,103 @@ def _accel_bin_cap(vec: np.ndarray, type_mask: np.ndarray,
     return None
 
 
+# a group only counts as a "wave" (per-pod-cost narrowing candidate)
+# above this many identical pods; below it, bin-sharing with other
+# groups usually matters more than homogeneous type choice
+_WAVE_MIN_PODS = 64
+# trigger only when the predicted per-pod saving is large (best per-pod
+# cost ≤ this fraction of the densest type's per-pod cost): flat price
+# curves — the common case, where FFD is already near-optimal — must
+# not be fragmented for marginal gains
+_WAVE_GAIN = 0.7
+_WAVE_PRICE_SLACK = 1.05
+
+
+def _wave_bin_cap(vec: np.ndarray, count: int, type_mask: np.ndarray,
+                  zone_mask: np.ndarray, cap_mask: np.ndarray,
+                  pool_tmask: np.ndarray, existing_tmask: np.ndarray,
+                  ds_vec: np.ndarray, lattice: Lattice,
+                  max_per_bin: int = 0) -> Optional[np.ndarray]:
+    """Per-POD-cost narrowing for pods-axis-bound waves.
+
+    Sequential FFD (the reference's scheduler: first-fit, then price each
+    bin at its cheapest fitting type — designs/bin-packing.md:16-43)
+    grows a tiny-pod wave's bins to the maximum pod DENSITY any feasible
+    type offers, then must price at the huge types that carry that
+    density (ENI-limited pods: 737 needs 15×50-ENI machines). When the
+    wave is bound by the pods axis rather than cpu/memory, the big
+    type's vCPUs go unused and its $/pod is several times worse than a
+    small type's (real catalog: m5.24xlarge at 737 pods = $6.3e-3/pod vs
+    t3.medium-class nodes under $2.5e-3/pod). This narrows the wave's
+    type mask to the types within ``_WAVE_PRICE_SLACK`` of the best
+    per-pod cost, so bins seal at the small types' own density and the
+    wave splits via ordinary capacity math.
+
+    Per-pod cost of a type = its cheapest offering price (within the
+    group's OWN zone/captype masks) divided by how many of THIS group's
+    pods fit an empty bin of that type after daemonset overhead — the
+    pods axis, cpu, memory, and every other requested axis all cap the
+    fit, so the ranking is exact for homogeneous bins.
+
+    Fences mirror _accel_bin_cap: candidates intersect the group's
+    pool-feasible types; existing node types stay joinable (their free
+    capacity is paid for); the caller holds the unnarrowed mask as a
+    schedulability fallback; and the ``_WAVE_GAIN`` gate keeps the
+    narrowing OFF whenever FFD's densest-type choice is already within
+    30% of optimal — only genuinely pods-axis-bound shapes trigger.
+    Never applied to accelerator groups (_accel_bin_cap owns those).
+    """
+    if count < _WAVE_MIN_PODS:
+        return None
+    if not zone_mask.any() or not cap_mask.any():
+        return None
+    cand = type_mask & pool_tmask
+    if not cand.any():
+        return None
+    idx = np.nonzero(cand)[0]
+    # pods of this group per empty bin of each candidate type
+    free = lattice.alloc[idx] - ds_vec[None, :]
+    need = vec[None, :]
+    with np.errstate(divide="ignore", invalid="ignore"):
+        per_axis = np.where(need > 0, free / np.maximum(need, 1e-9), np.inf)
+    K = np.floor(per_axis.min(axis=1))
+    if max_per_bin:
+        # hostname-spread groups seal bins early; rank at the density
+        # the bins will actually reach
+        K = np.minimum(K, max_per_bin)
+    fits = K >= 1
+    if not fits.any():
+        return None
+    idx, K = idx[fits], K[fits]
+    offers = lattice.available[np.ix_(idx, np.nonzero(zone_mask)[0],
+                                      np.nonzero(cap_mask)[0])]
+    prices = np.where(
+        offers,
+        lattice.price[np.ix_(idx, np.nonzero(zone_mask)[0],
+                             np.nonzero(cap_mask)[0])],
+        np.inf)
+    pmin = prices.reshape(len(idx), -1).min(axis=1)
+    per_pod = pmin / K
+    b = int(np.argmin(per_pod))
+    if not np.isfinite(per_pod[b]):
+        return None
+    # what FFD would effectively pay: the per-pod cost of the DENSEST
+    # priced type (first-fit grows bins to max density; end-pricing then
+    # needs a type carrying that density)
+    priced = np.isfinite(pmin)
+    if not priced.any():
+        return None
+    dense = int(np.argmax(np.where(priced, K, -1)))
+    ffd_per_pod = per_pod[dense]
+    if not np.isfinite(ffd_per_pod) or per_pod[b] > ffd_per_pod * _WAVE_GAIN:
+        return None
+    keep = np.zeros(type_mask.shape, dtype=bool)
+    keep[idx[per_pod <= per_pod[b] * _WAVE_PRICE_SLACK]] = True
+    # existing node types stay joinable — free capacity is paid for
+    keep |= type_mask & existing_tmask
+    return keep
+
+
 def _is_custom_key(key: str) -> bool:
     """A label key the lattice does not model (user-defined)."""
     return (key not in _AXIS_KEYS and key not in _CAT_KEY_INDEX
@@ -482,7 +579,8 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
                   bound_pods: Sequence[BoundPod] = (),
                   pvcs: Optional[Mapping] = None,
                   storage_classes: Optional[Mapping] = None,
-                  pool_headroom: Optional[Mapping[str, np.ndarray]] = None) -> Problem:
+                  pool_headroom: Optional[Mapping[str, np.ndarray]] = None,
+                  narrow: bool = True) -> Problem:
     with _INTERN_LOCK:
         if len(_SIG_TUPLES) >= _INTERN_MAX:
             _RK_INTERN.clear()
@@ -491,7 +589,7 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
             _BAD_SIDS.clear()
         return _build_problem(pods, node_pools, lattice, existing,
                               daemonset_pods, bound_pods, pvcs,
-                              storage_classes, pool_headroom)
+                              storage_classes, pool_headroom, narrow)
 
 
 def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: Lattice,
@@ -500,7 +598,8 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
                    bound_pods: Sequence[BoundPod] = (),
                    pvcs: Optional[Mapping] = None,
                    storage_classes: Optional[Mapping] = None,
-                   pool_headroom: Optional[Mapping[str, np.ndarray]] = None) -> Problem:
+                   pool_headroom: Optional[Mapping[str, np.ndarray]] = None,
+                   narrow: bool = True) -> Problem:
     real_pools = sorted(node_pools, key=lambda p: (-p.weight, p.name))
     T, Z, C = lattice.T, lattice.Z, lattice.C
     key_values = lattice.key_values_present()
@@ -920,7 +1019,7 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
                      for eff in pool_eff_labels], dtype=bool)
             g_tmask = masks.type_mask
             unnarrowed = None
-            if not topo.single_bin:
+            if narrow and not topo.single_bin:
                 # accelerator bin-splitting (see _accel_bin_cap) — never
                 # applied over hostname self-affinity's one-bin contract.
                 # Ranking sees only offerings SOME compatible pool can
@@ -940,6 +1039,17 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
                     vec, masks.type_mask, s.zone_mask & pool_zmask,
                     s.cap_mask & pool_cmask, pool_tmask, existing_tmask,
                     lattice)
+                if a_mask is None and np_ok_s.any():
+                    # pods-axis-bound wave narrowing (generic groups
+                    # only — accel groups are _accel_bin_cap's); rank
+                    # with the heaviest compatible pool's daemonset
+                    # overhead so small types are never over-favored
+                    a_mask = _wave_bin_cap(
+                        vec, len(sub_names), masks.type_mask,
+                        s.zone_mask & pool_zmask, s.cap_mask & pool_cmask,
+                        pool_tmask, existing_tmask,
+                        ds_overhead[np_ok_s].max(axis=0), lattice,
+                        max_per_bin=topo.max_per_bin)
                 if a_mask is not None and a_mask.any():
                     unnarrowed = masks.type_mask
                     g_tmask = a_mask

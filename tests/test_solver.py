@@ -1024,6 +1024,83 @@ class TestAccelBinSplitting:
                                  [n.instance_type for n in plan.new_nodes])
         assert plan.new_nodes == []
 
+    def test_wave_narrowing_beats_uncapped_ffd_on_tiny_pods(self):
+        """Pods-axis-bound wave (_wave_bin_cap): sequential FFD grows
+        tiny-pod bins to max density and end-prices at the huge types
+        that carry it; the wave narrowing seals bins at the best
+        per-POD-cost types instead. The capped solve must beat the
+        UNCAPPED pack (the reference's behavior) outright."""
+        lattice = build_lattice([s for s in build_catalog()
+                                 if s.family in ("m5", "t3", "c5")])
+        s = Solver(lattice)
+        pods = [Pod(name=f"w{i}", requests={"cpu": "50m", "memory": "96Mi"})
+                for i in range(500)]
+        capped = s.solve(build_problem(pods, [default_pool()], lattice))
+        uncapped = s.solve(build_problem(pods, [default_pool()], lattice,
+                                         narrow=False))
+        assert not capped.unschedulable and not uncapped.unschedulable
+        assert capped.new_node_cost < uncapped.new_node_cost * 0.9, \
+            (capped.new_node_cost, uncapped.new_node_cost)
+        # and the uncapped solve stays at parity with the FFD referee
+        # over the same (unnarrowed) problem
+        o = ffd_oracle(build_problem(pods, [default_pool()], lattice,
+                                     narrow=False))
+        assert uncapped.new_node_cost <= o.new_node_cost * 1.02
+
+    def test_wave_narrowing_gain_gate_stays_off_flat_shapes(self):
+        """Small counts and non-pods-bound shapes must not narrow: the
+        plan with narrowing enabled equals the plan without it."""
+        lattice = build_lattice([s for s in build_catalog()
+                                 if s.family in ("m5", "c5", "r5")])
+        s = Solver(lattice)
+        # under _WAVE_MIN_PODS: no narrowing by count
+        pods = [Pod(name=f"p{i}", requests={"cpu": "50m", "memory": "96Mi"})
+                for i in range(16)]
+        a = s.solve(build_problem(pods, [default_pool()], lattice))
+        b = s.solve(build_problem(pods, [default_pool()], lattice,
+                                  narrow=False))
+        assert a.new_node_cost == b.new_node_cost
+        # cpu-bound wave on a flat-price palette (m/c/r scale ~linearly):
+        # gain gate holds, identical plans
+        pods = [Pod(name=f"q{i}", requests={"cpu": "3", "memory": "6Gi"})
+                for i in range(200)]
+        a = s.solve(build_problem(pods, [default_pool()], lattice))
+        b = s.solve(build_problem(pods, [default_pool()], lattice,
+                                  narrow=False))
+        assert a.new_node_cost == b.new_node_cost
+
+    def test_wave_narrowing_never_costs_schedulability(self):
+        """A pool pinned away from the per-pod-cheapest types must still
+        schedule the wave (unnarrowed fallback / pool fence)."""
+        lattice = build_lattice([s for s in build_catalog()
+                                 if s.family in ("m5", "t3")])
+        pool = NodePool(name="m5-only", requirements=[
+            Requirement(wk.LABEL_INSTANCE_FAMILY, Operator.IN, ("m5",))])
+        pods = [Pod(name=f"w{i}", requests={"cpu": "50m", "memory": "96Mi"})
+                for i in range(200)]
+        plan = Solver(lattice).solve(build_problem(pods, [pool], lattice))
+        assert not plan.unschedulable, plan.unschedulable
+        assert all(n.instance_type.startswith("m5.")
+                   for n in plan.new_nodes)
+
+    def test_wave_narrowing_keeps_existing_nodes_joinable(self):
+        """Free capacity on a running big node beats launching: the
+        narrowed mask keeps the existing type joinable."""
+        lattice = build_lattice([s for s in build_catalog()
+                                 if s.family in ("m5", "t3")])
+        big = "m5.4xlarge"
+        existing = [ExistingBin(
+            name="running-big", node_pool="default", instance_type=big,
+            zone=lattice.zones[0], capacity_type="on-demand",
+            used=np.zeros((R,), np.float32))]
+        pods = [Pod(name=f"w{i}", requests={"cpu": "50m", "memory": "96Mi"})
+                for i in range(100)]
+        plan = Solver(lattice).solve(build_problem(
+            pods, [default_pool()], lattice, existing=existing))
+        assert not plan.unschedulable
+        assert "running-big" in plan.existing_assignments
+        assert len(plan.existing_assignments["running-big"]) > 0
+
     def test_per_unit_ranking_respects_capacity_type(self):
         """Fence (review r4 #3): an on-demand-only group ranks per-unit
         prices over ON-DEMAND offerings; the cap still applies and the

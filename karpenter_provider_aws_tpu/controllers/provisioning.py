@@ -183,10 +183,15 @@ class Provisioner:
                                    and node_name not in self.cluster.nodes)
                 for pn in pods:
                     if target_is_claim:
+                        # nominations count at decision time — a pod
+                        # deleted before the claim registers drops out
+                        # of nominated_pods() and is simply never bound
                         self.cluster.nominate(pn, node_name)
-                    else:
-                        self.writer.bind_pod(pn, node_name)
-                    result.pods_scheduled += 1
+                        result.pods_scheduled += 1
+                    elif self.writer.bind_pod(pn, node_name):
+                        # raced binds (pod evicted/deleted under us in
+                        # threaded API mode) don't count as scheduled
+                        result.pods_scheduled += 1
 
         surface_unschedulable(plan)
         bind_existing(plan)

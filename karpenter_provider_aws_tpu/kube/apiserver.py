@@ -283,11 +283,27 @@ class FakeAPIServer:
                 self._emit("MODIFIED", kind, new)
             return copy.deepcopy(new)
 
+    @staticmethod
+    def _merge_value(target: dict, k: str, v) -> None:
+        """RFC 7386 JSON merge patch for one key: ``None`` deletes, maps
+        merge RECURSIVELY (so writers of disjoint annotation/label keys
+        never clobber each other's entries), everything else replaces."""
+        if v is None:
+            target.pop(k, None)
+        elif isinstance(v, dict) and isinstance(target.get(k), dict):
+            sub = dict(target[k])
+            for sk, sv in v.items():
+                FakeAPIServer._merge_value(sub, sk, sv)
+            target[k] = sub
+        else:
+            target[k] = copy.deepcopy(v)
+
     def patch(self, kind: str, name: str, spec_patch: Optional[dict] = None, *,
               finalizers: Optional[Sequence[str]] = None) -> dict:
-        """JSON-merge-patch on the spec (``None`` values delete keys) and/or
-        replace the finalizer list. No RV precondition — a patch applies to
-        whatever is current, like a server-side strategic merge."""
+        """JSON-merge-patch on the spec (RFC 7386: ``None`` values delete
+        keys, nested maps merge per-key) and/or replace the finalizer
+        list. No RV precondition — a patch applies to whatever is
+        current, like a server-side strategic merge."""
         self._check_kind(kind)
         with self._lock:
             cur = self._store[kind].get(name)
@@ -296,10 +312,7 @@ class FakeAPIServer:
             new = copy.deepcopy(cur)
             if spec_patch:
                 for k, v in spec_patch.items():
-                    if v is None:
-                        new["spec"].pop(k, None)
-                    else:
-                        new["spec"][k] = copy.deepcopy(v)
+                    self._merge_value(new["spec"], k, v)
                 new["spec"] = self._admit(kind, name, new["spec"])
             if finalizers is not None:
                 new["metadata"]["finalizers"] = list(finalizers)

@@ -111,13 +111,31 @@ class KubeClient:
         items, _ = self.server.list("nodeclaims")
         return [self.claim_from_envelope(o) for o in items]
 
+    # the status-ish fields a controller OWNS when it writes launch
+    # results / phase transitions back (the reference's status().Update
+    # contract). Spec fields (requirements, nodePool, taints, ...) and
+    # lifecycle metadata (deletionTimestamp, finalizers) are deliberately
+    # NOT here: patching them from a stale typed claim would last-writer-
+    # wins another controller's write (e.g. clear a concurrent delete's
+    # deletionTimestamp). annotations/labels ARE here (launch stamps the
+    # nodeclass drift hashes); distinct controllers own distinct KEYS,
+    # and the server's RFC 7386 merge keeps per-key writes from
+    # clobbering siblings.
+    _CLAIM_STATUS_FIELDS = (
+        "phase", "providerID", "internalIP", "instanceType", "zone",
+        "capacityType", "imageID", "capacity", "allocatable", "labels",
+        "annotations", "launchedAt", "registeredAt", "initializedAt",
+    )
+
     def update_nodeclaim(self, claim: NodeClaim) -> None:
-        """Status write-back (launch results, phase transitions): merge the
-        claim's CURRENT typed state over the stored spec. Patch semantics —
-        no RV precondition — because exactly one controller owns each
-        status field (the reference's status().Update contract)."""
+        """Status write-back (launch results, phase transitions): merge
+        ONLY the caller-owned status fields over the stored object. Patch
+        semantics — no RV precondition — because exactly one controller
+        owns each status field; restricting the patch to those fields is
+        what makes that contract safe under concurrency."""
+        full = serde.nodeclaim_to_dict(claim)
         self.server.patch("nodeclaims", claim.name,
-                          serde.nodeclaim_to_dict(claim))
+                          {k: full[k] for k in self._CLAIM_STATUS_FIELDS})
 
     def delete_nodeclaim(self, name: str, now: Optional[float] = None) -> None:
         """The k8s delete that STARTS the finalizer flow: stamps

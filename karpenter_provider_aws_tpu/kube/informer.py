@@ -61,36 +61,40 @@ class Informer:
 
     def _relist(self) -> None:
         """Initial list, or recovery from a 410: replace the store with
-        the server's truth, synthesizing handler events for the delta."""
-        items, rv = self.server.list(self.kind)
-        fresh = {o["metadata"]["name"]: o for o in items}
-        with self._lock:
-            old = self.store
-            self.store = fresh
-            self._rv = rv
-            self._synced = True
-        if self.handler is not None:
-            for name, obj in fresh.items():
-                prev = old.get(name)
-                if prev is None:
-                    self.handler("ADDED", name, obj, None)
-                elif (prev["metadata"]["resourceVersion"]
-                      != obj["metadata"]["resourceVersion"]):
-                    self.handler("MODIFIED", name, obj, prev)
-            for name, obj in old.items():
-                if name not in fresh:
-                    self.handler("DELETED", name, None, obj)
-        if self._watch is not None:
-            self.server.stop_watch(self._watch)
-            self._watch = None
-        try:
-            self._watch = self.server.watch(self.kind, self._rv)
-        except TooOldError:
-            # events raced past the ring between our list and watch —
-            # immediately relist from the new high-water mark (client-go
-            # reflectors loop the same way); _watch stays None so the
-            # next pump retries rather than reading a dead handle
-            self._relist()
+        the server's truth, synthesizing handler events for the delta.
+        A LOOP, not recursion: under sustained churn (>ring events
+        landing between each list and watch attempt) recursion would
+        grow the Python stack and eventually kill the informer thread
+        instead of retrying like a client-go reflector."""
+        while True:
+            items, rv = self.server.list(self.kind)
+            fresh = {o["metadata"]["name"]: o for o in items}
+            with self._lock:
+                old = self.store
+                self.store = fresh
+                self._rv = rv
+                self._synced = True
+            if self.handler is not None:
+                for name, obj in fresh.items():
+                    prev = old.get(name)
+                    if prev is None:
+                        self.handler("ADDED", name, obj, None)
+                    elif (prev["metadata"]["resourceVersion"]
+                          != obj["metadata"]["resourceVersion"]):
+                        self.handler("MODIFIED", name, obj, prev)
+                for name, obj in old.items():
+                    if name not in fresh:
+                        self.handler("DELETED", name, None, obj)
+            if self._watch is not None:
+                self.server.stop_watch(self._watch)
+                self._watch = None
+            try:
+                self._watch = self.server.watch(self.kind, self._rv)
+                return
+            except TooOldError:
+                # events raced past the ring between our list and watch —
+                # relist from the new high-water mark
+                continue
 
     def _apply(self, ev: WatchEvent) -> None:
         name = ev.object["metadata"]["name"]

@@ -87,8 +87,9 @@ class DirectWriter:
 
     # ---- pods / volumes / leases ------------------------------------------
 
-    def bind_pod(self, pod_name: str, node_name: str) -> None:
+    def bind_pod(self, pod_name: str, node_name: str) -> bool:
         self.cluster.bind_pod(pod_name, node_name)
+        return True
 
     def bind_volumes(self, pod_name: str, zone: Optional[str]) -> None:
         self.cluster.bind_volumes(pod_name, zone)
@@ -183,13 +184,16 @@ class ApiWriter:
 
     # ---- pods / volumes / leases ------------------------------------------
 
-    def bind_pod(self, pod_name: str, node_name: str) -> None:
+    def bind_pod(self, pod_name: str, node_name: str) -> bool:
+        """Returns False when the bind raced an eviction/delete — the
+        watch stream carries whatever the truth is, and callers must not
+        count the pod as scheduled (karpenter_pods_scheduled_total would
+        overcount)."""
         try:
             self.kube.bind_pod(pod_name, node_name)
+            return True
         except (ConflictError, NotFoundError):
-            # already bound (raced) or deleted — the watch stream carries
-            # whatever the truth is
-            pass
+            return False
 
     def bind_volumes(self, pod_name: str, zone: Optional[str]) -> None:
         """Persist WaitForFirstConsumer zone pins server-side (the CSI

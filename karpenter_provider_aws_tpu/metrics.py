@@ -233,7 +233,10 @@ def wire_core_metrics(reg: Registry) -> Dict[str, _Metric]:
             "Pending pods per scheduling batch.", (),
             buckets=(1, 5, 10, 50, 100, 500, 1000, 5000, 10000, 50000)),
         "pods_scheduled": reg.counter(
-            "karpenter_pods_scheduled_total", "Pods placed by the provisioner.", ()),
+            "karpenter_pods_scheduled_total",
+            "Pods placed by the provisioner (scheduling decisions: "
+            "direct binds count on success; nominations to pending "
+            "claims count at decision time).", ()),
         "pods_unschedulable": reg.gauge(
             "karpenter_pods_unschedulable",
             "Pods the last scheduling pass could not place.", ()),

@@ -65,12 +65,15 @@ class MemoryLeaseStore:
 
 class FileLeaseStore:
     """Lease in a JSON file, swapped atomically via rename. Suitable for
-    replicas sharing a filesystem; last-writer-wins races are narrowed by
-    re-reading after write (good enough for the sim/single-host story —
-    a real cluster deployment uses the coordination API)."""
+    replicas sharing a filesystem. The compare and the write run under an
+    exclusive flock on a sidecar lockfile, so two replicas cannot
+    interleave the read-check-write and both believe they won (the
+    dual-leader window the pre-lock implementation had); a real cluster
+    deployment still uses the coordination API (ApiLeaseStore)."""
 
     def __init__(self, path: str):
         self.path = Path(path)
+        self._lockpath = self.path.with_name(self.path.name + ".lock")
 
     def get(self) -> Optional[Lease]:
         try:
@@ -80,22 +83,27 @@ class FileLeaseStore:
             return None
 
     def swap(self, expect_holder: Optional[str], lease: Optional[Lease]) -> bool:
-        current = self.get()
-        if (current.holder if current else None) != expect_holder:
-            return False
-        if lease is None:
+        import fcntl
+        with open(self._lockpath, "w") as lockf:
+            fcntl.flock(lockf.fileno(), fcntl.LOCK_EX)
             try:
-                self.path.unlink()
-            except OSError:
-                pass
-            return True
-        fd, tmp = tempfile.mkstemp(dir=str(self.path.parent))
-        with os.fdopen(fd, "w") as f:
-            json.dump({"holder": lease.holder, "renewTime": lease.renew_time}, f)
-        os.replace(tmp, self.path)
-        after = self.get()
-        return after is not None and after.holder == lease.holder \
-            and after.renew_time == lease.renew_time
+                current = self.get()
+                if (current.holder if current else None) != expect_holder:
+                    return False
+                if lease is None:
+                    try:
+                        self.path.unlink()
+                    except OSError:
+                        pass
+                    return True
+                fd, tmp = tempfile.mkstemp(dir=str(self.path.parent))
+                with os.fdopen(fd, "w") as f:
+                    json.dump({"holder": lease.holder,
+                               "renewTime": lease.renew_time}, f)
+                os.replace(tmp, self.path)
+                return True
+            finally:
+                fcntl.flock(lockf.fileno(), fcntl.LOCK_UN)
 
 
 class LeaderElector:

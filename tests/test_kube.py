@@ -372,6 +372,73 @@ class TestClientRoundTrips:
 
 
 class TestReviewRegressions:
+    def test_status_update_cannot_resurrect_deleting_claim(self):
+        """update_nodeclaim patches ONLY caller-owned status fields: a
+        stale typed claim (deletion_timestamp None) written back during a
+        concurrent delete must not clear the server's deletionTimestamp
+        or any other lifecycle metadata (advisor r4)."""
+        s = FakeAPIServer()
+        c = KubeClient(s)
+        claim = NodeClaim(name="c0", node_pool="default")
+        c.create_nodeclaim(claim)
+        c.delete_nodeclaim("c0", now=10.0)      # finalizer holds it
+        # stale typed copy: no deletion stamp, new phase
+        from karpenter_provider_aws_tpu.apis.objects import NodeClaimPhase
+        claim.phase = NodeClaimPhase.LAUNCHED
+        claim.provider_id = "aws:///z/i-1"
+        c.update_nodeclaim(claim)
+        got = c.get_nodeclaim("c0")
+        assert got.deletion_timestamp == 10.0   # survives the status write
+        assert got.phase == NodeClaimPhase.LAUNCHED
+        assert got.provider_id == "aws:///z/i-1"
+        obj = s.get("nodeclaims", "c0")
+        assert obj["metadata"]["finalizers"]    # finalizers untouched
+
+    def test_status_update_persists_annotations_with_per_key_merge(self):
+        """Launch stamps drift-hash annotations on the claim; the status
+        write must persist them (review r5: dropping them breaks
+        NodeClassDrift in API mode), and the server's RFC 7386 merge
+        must keep OTHER controllers' annotation keys intact."""
+        s = FakeAPIServer()
+        c = KubeClient(s)
+        claim = NodeClaim(name="c2", node_pool="default")
+        c.create_nodeclaim(claim)
+        # another controller's annotation key lands first (tagging)
+        s.patch("nodeclaims", "c2",
+                {"annotations": {"karpenter.k8s.aws/tagged": "true"}})
+        claim.annotations["karpenter.k8s.aws/nodeclass-hash"] = "abc123"
+        c.update_nodeclaim(claim)
+        got = s.get("nodeclaims", "c2")["spec"]["annotations"]
+        assert got["karpenter.k8s.aws/nodeclass-hash"] == "abc123"
+        assert got["karpenter.k8s.aws/tagged"] == "true"   # not clobbered
+
+    def test_status_update_does_not_regress_spec_fields(self):
+        """A status write from a holder of a STALE spec leaves the
+        server's spec fields (requirements/nodePool/taints) alone."""
+        s = FakeAPIServer()
+        c = KubeClient(s)
+        claim = NodeClaim(name="c1", node_pool="default")
+        c.create_nodeclaim(claim)
+        s.patch("nodeclaims", "c1", {"nodePool": "gpu"})
+        c.update_nodeclaim(claim)               # stale nodePool="default"
+        assert s.get("nodeclaims", "c1")["spec"]["nodePool"] == "gpu"
+
+    def test_raced_bind_reports_false_and_is_not_counted(self):
+        """ApiWriter.bind_pod returns False when the pod vanished; True
+        on success (advisor r4: pods_scheduled overcount)."""
+        from karpenter_provider_aws_tpu.apis.objects import Node
+        from karpenter_provider_aws_tpu.kube.writer import ApiWriter
+        from karpenter_provider_aws_tpu.state.cluster import ClusterState
+        from karpenter_provider_aws_tpu.utils.clock import FakeClock
+        s = FakeAPIServer()
+        c = KubeClient(s)
+        cluster = ClusterState(clock=FakeClock())
+        w = ApiWriter(c, cluster, FakeClock())
+        c.create_node(Node(name="n0", provider_id="aws:///z/i-1"))
+        s.create("pods", serde.pod_to_dict(pod("p0")))
+        assert w.bind_pod("p0", "n0") is True
+        assert w.bind_pod("vanished", "n0") is False
+
     def test_default_delete_timestamp_is_truthy(self):
         """delete() without an explicit time must never stamp a falsy
         deletionTimestamp — every consumer truth-tests it."""

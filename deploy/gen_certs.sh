@@ -1,0 +1,35 @@
+#!/bin/sh
+# Mint self-signed serving material + a bearer token for the REST/webhook
+# surfaces at render time — the analog of the reference chart's
+# secret-webhook-cert.yaml (whose data a controller injects at runtime;
+# here openssl does it up front, keeping the render hermetic).
+#
+# Usage: sh deploy/gen_certs.sh [values.env]
+# Writes deploy/certs/{tls.crt,tls.key,token} and appends the base64
+# values render.sh substitutes into secret-webhook-cert.yaml /
+# webhooks.yaml to deploy/certs/certs.env. Re-run to rotate.
+set -e
+dir="$(dirname "$0")"
+values="${1:-$dir/values.env}"
+set -a; . "$values"; set +a
+mkdir -p "$dir/certs"
+
+openssl req -x509 -newkey rsa:2048 -nodes -days 365 \
+  -keyout "$dir/certs/tls.key" -out "$dir/certs/tls.crt" \
+  -subj "/CN=${NAME}.${NAMESPACE}.svc" \
+  -addext "subjectAltName=DNS:${NAME}.${NAMESPACE}.svc,DNS:${NAME}.${NAMESPACE}.svc.cluster.local,IP:127.0.0.1" \
+  2>/dev/null
+
+# 256-bit bearer token for --api-token-file
+openssl rand -hex 32 > "$dir/certs/token"
+chmod 600 "$dir/certs/tls.key" "$dir/certs/token"
+
+b64() { base64 < "$1" | tr -d '\n'; }
+{
+  echo "TLS_CRT_B64=$(b64 "$dir/certs/tls.crt")"
+  echo "TLS_KEY_B64=$(b64 "$dir/certs/tls.key")"
+  echo "API_TOKEN_B64=$(b64 "$dir/certs/token")"
+  # self-signed: the cert IS the CA bundle the webhook config trusts
+  echo "CA_BUNDLE_B64=$(b64 "$dir/certs/tls.crt")"
+} > "$dir/certs/certs.env"
+echo "wrote $dir/certs/{tls.crt,tls.key,token,certs.env}"

@@ -5,7 +5,15 @@
 set -e
 dir="$(dirname "$0")"
 values="${1:-$dir/values.env}"
-set -a; . "$values"; set +a
+set -a; . "$values"
+# serving cert/token material: auto-mint on first render (a render
+# without real material would produce a crashlooping deployment — the
+# container flags, HTTPS probes, and webhook caBundle all expect it)
+if [ ! -f "$dir/certs/certs.env" ]; then
+  sh "$dir/gen_certs.sh" "$values"
+fi
+. "$dir/certs/certs.env"
+set +a
 mkdir -p "$dir/rendered"
 for f in "$dir"/templates/*.yaml; do
   out="$dir/rendered/$(basename "$f")"

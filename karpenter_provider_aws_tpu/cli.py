@@ -107,10 +107,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "MaxConcurrentReconciles-style concurrency) instead "
                         "of the deterministic single-threaded loop.")
     p.add_argument("--api-host", default="127.0.0.1",
-                   help="Bind host for --api-port (default loopback: the "
-                        "REST surface is write-capable and "
-                        "unauthenticated; exposing it is an explicit "
-                        "deployment decision).")
+                   help="Bind host for --api-port (default loopback). "
+                        "Binding beyond loopback requires TLS + a bearer "
+                        "token, or the explicit --api-insecure opt-out: "
+                        "the REST surface is write-capable.")
+    p.add_argument("--api-token-file", default=None,
+                   help="File holding the bearer token every REST / "
+                        "admission request must present "
+                        "(Authorization: Bearer <token>; 401 otherwise).")
+    p.add_argument("--api-tls-cert", default=None,
+                   help="PEM certificate for serving the REST apiserver "
+                        "and admission endpoint over HTTPS "
+                        "(deploy/gen_certs.sh mints self-signed material).")
+    p.add_argument("--api-tls-key", default=None,
+                   help="PEM private key matching --api-tls-cert.")
+    p.add_argument("--api-insecure", action="store_true",
+                   help="Explicitly allow serving the write-capable REST "
+                        "surface beyond loopback WITHOUT TLS + token.")
     p.add_argument("--api-port", type=int, default=0,
                    help="Serve the control plane's apiserver over HTTP "
                         "REST on this port (kube/httpserver.py: "
@@ -166,17 +179,31 @@ def options_from_args(args: argparse.Namespace) -> Options:
     return Options.from_env(**overrides)
 
 
-def start_server(op: Operator, port: int) -> ThreadingHTTPServer:
-    """Serve /metrics, /healthz, /readyz on a daemon thread. Port 0 binds
-    an ephemeral port (server.server_address reports it)."""
+def start_server(op: Operator, port: int,
+                 certfile: Optional[str] = None,
+                 keyfile: Optional[str] = None) -> ThreadingHTTPServer:
+    """Serve /metrics, /healthz, /readyz and POST /validate on a daemon
+    thread; ``certfile``/``keyfile`` serve it all over HTTPS (the
+    reference's webhook cert posture; the TLS handshake runs
+    per-connection, kube/httpserver.py). The whole surface is
+    deliberately token-free: metrics/health are the scrape/probe
+    contract, and /validate must be callable by a kube-apiserver webhook
+    client, which authenticates the SERVER via the caBundle but sends no
+    bearer token — and validation is a pure function with nothing to
+    protect. Port 0 binds an ephemeral port (server.server_address
+    reports it)."""
 
     class Handler(BaseHTTPRequestHandler):
         def do_POST(self):
             # HTTP admission endpoint (reference pkg/webhooks/webhooks.go
-            # serves knative-style admission; here the review body is
-            # {"kind": <plural>, "spec": <wire dict>} and the response is
-            # {"allowed": bool, "causes": [..]} — an external writer can
-            # ask before persisting, closing the callable-only gap)
+            # serves knative-style admission). Two review dialects:
+            # - native: {"kind": <plural>, "spec": <wire dict>} →
+            #   {"allowed": bool, "causes": [...]}
+            # - AdmissionReview v1 (what a real kube-apiserver POSTs per
+            #   deploy/templates/webhooks.yaml): {"kind":
+            #   "AdmissionReview", "request": {"uid", "resource":
+            #   {"resource": <plural>}, "object": {"spec": ...}}} →
+            #   the AdmissionReview response envelope.
             if self.path not in ("/validate", "/validate/"):
                 self.send_error(404)
                 return
@@ -184,8 +211,17 @@ def start_server(op: Operator, port: int) -> ThreadingHTTPServer:
             try:
                 length = int(self.headers.get("Content-Length", "0"))
                 review = _json.loads(self.rfile.read(length) or b"{}")
-                kind = review["kind"]
-                spec = review["spec"]
+                if review.get("kind") == "AdmissionReview":
+                    req = review["request"]
+                    uid = req.get("uid", "")
+                    kind = req["resource"]["resource"]
+                    obj = req["object"]
+                    spec = obj.get("spec", obj)
+                    wrap = "admissionreview"
+                else:
+                    uid, wrap = "", "native"
+                    kind = review["kind"]
+                    spec = review["spec"]
                 if not isinstance(kind, str) or not isinstance(spec, dict):
                     raise ValueError("kind must be a string, spec an object")
             except Exception as e:
@@ -200,8 +236,15 @@ def start_server(op: Operator, port: int) -> ThreadingHTTPServer:
                 # internal exception text leaks to the caller
                 self.send_error(500, "validation error")
                 return
-            body = _json.dumps({"allowed": not causes,
-                                "causes": causes}).encode()
+            if wrap == "admissionreview":
+                doc = {"apiVersion": "admission.k8s.io/v1",
+                       "kind": "AdmissionReview",
+                       "response": {"uid": uid, "allowed": not causes,
+                                    **({"status": {"message": "; ".join(
+                                        causes)}} if causes else {})}}
+            else:
+                doc = {"allowed": not causes, "causes": causes}
+            body = _json.dumps(doc).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
@@ -233,7 +276,8 @@ def start_server(op: Operator, port: int) -> ThreadingHTTPServer:
         def log_message(self, *a):  # quiet by default
             pass
 
-    server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    from .kube.httpserver import make_http_server
+    server = make_http_server(("0.0.0.0", port), Handler, certfile, keyfile)
     threading.Thread(target=server.serve_forever, daemon=True).start()
     return server
 
@@ -246,25 +290,55 @@ def main(argv: Optional[Sequence[str]] = None,
     from .utils.logging import configure as configure_logging
     configure_logging(args.log_level)
     opts = options_from_args(args)
+    api_token = None
+    if args.api_token_file:
+        api_token = open(args.api_token_file).read().strip()
+        if not api_token:
+            raise SystemExit(f"--api-token-file {args.api_token_file} "
+                             "is empty")
+    if bool(args.api_tls_cert) != bool(args.api_tls_key):
+        raise SystemExit("--api-tls-cert and --api-tls-key go together")
     api_server = None
     api_httpd = None
+    queue = None
     if args.api_port:
+        # loopback names resolvable by the AF_INET server only
+        loopback = args.api_host in ("127.0.0.1", "localhost")
+        if (not loopback and not args.api_insecure
+                and not (api_token and args.api_tls_cert)):
+            raise SystemExit(
+                "refusing to serve the write-capable REST surface on "
+                f"{args.api_host} without TLS (--api-tls-cert/key) AND a "
+                "bearer token (--api-token-file); pass --api-insecure to "
+                "override explicitly")
+        from .interruption.queue import FakeQueue
         from .kube import (FakeAPIServer, install_admission,
                            install_default_indexes)
         from .kube.httpserver import serve as serve_api
         api_server = FakeAPIServer()
         # admission/indexes are wired BEFORE the first byte is served:
         # objects written during the (slow) operator build face the same
-        # 422-with-causes contract as every later write
+        # 422-with-causes contract as every later write — and the
+        # surface comes up BEFORE that build, so external agents connect
+        # while JAX imports/compiles. The interruption queue is built
+        # here (injected into the Operator below) so its wire route
+        # serves equally early.
         install_default_indexes(api_server)
         install_admission(api_server)
+        if opts.interruption_queue:
+            queue = FakeQueue(opts.interruption_queue)
         api_httpd = serve_api(api_server, args.api_port,
-                              host=args.api_host)
+                              host=args.api_host, token=api_token,
+                              certfile=args.api_tls_cert,
+                              keyfile=args.api_tls_key,
+                              queue=queue)
         from .utils.logging import get_logger
         get_logger("cli").info(
             "apiserver REST surface listening",
-            port=api_httpd.server_address[1])
-    op = Operator(options=opts, api_server=api_server)
+            port=api_httpd.server_address[1],
+            tls=bool(args.api_tls_cert), auth=bool(api_token))
+    op = Operator(options=opts, api_server=api_server,
+                  interruption_queue=queue)
 
     stop = stop_event or threading.Event()
 
@@ -277,7 +351,10 @@ def main(argv: Optional[Sequence[str]] = None,
     except ValueError:
         pass  # not the main thread (tests drive main() directly)
 
-    server = start_server(op, args.metrics_port) if args.metrics_port else None
+    server = (start_server(op, args.metrics_port, token=api_token,
+                           certfile=args.api_tls_cert,
+                           keyfile=args.api_tls_key)
+              if args.metrics_port else None)
     sidecar = None
     if args.sidecar_address:
         from .parallel.sidecar import serve as serve_sidecar

@@ -18,18 +18,28 @@ SURVEY §1 L0). Routes, mirroring the k8s path shapes:
     POST   /apis/pods/{name}/binding       {"nodeName": ...}
     POST   /apis/pods/{name}/eviction[?force=1]
 
-Error mapping is the real protocol's: 404 NotFound, 409 Conflict /
+Error mapping is the real protocol's: 401 Unauthorized (bad/missing
+bearer token when auth is enabled), 404 NotFound, 409 Conflict /
 AlreadyExists, 410 Gone (watch too old), 422 Invalid (admission, with
 causes), 429 eviction blocked by a PodDisruptionBudget.
 
 The watch stream emits one JSON object per line ({type, object,
 resourceVersion}) and a periodic heartbeat line so half-open
 connections die; it ends when the client disconnects.
+
+Transport security (the real apiserver's posture): pass ``token`` to
+require ``Authorization: Bearer <token>`` on every request, and
+``certfile``/``keyfile`` to serve HTTPS (deploy/gen_certs.sh mints
+self-signed material at render time, the analog of the reference
+chart's secret-webhook-cert.yaml). The CLI refuses to bind this
+surface beyond loopback without both unless --api-insecure is given.
 """
 
 from __future__ import annotations
 
+import hmac
 import json
+import ssl
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
@@ -41,6 +51,57 @@ from .apiserver import (
 )
 
 WATCH_HEARTBEAT_SECONDS = 15.0
+
+
+def check_bearer(auth_header: Optional[str], token: str) -> bool:
+    """Constant-time check of an ``Authorization: Bearer`` header."""
+    if not auth_header or not auth_header.startswith("Bearer "):
+        return False
+    # bytes on both sides: compare_digest(str, str) raises on the
+    # non-ASCII header an arbitrary client can send
+    return hmac.compare_digest(
+        auth_header[len("Bearer "):].encode("utf-8", "surrogateescape"),
+        token.encode("utf-8"))
+
+
+class TLSThreadingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that performs the TLS handshake in the
+    PER-CONNECTION thread (finish_request), not in accept(): wrapping the
+    listening socket would run do_handshake inside serve_forever, where
+    one stalled client (``nc host port`` sending nothing) blocks every
+    other connection — including /healthz, so the kubelet would kill the
+    pod. A handshake timeout bounds the slow-client window."""
+
+    HANDSHAKE_TIMEOUT = 10.0
+
+    def __init__(self, addr, handler, certfile: str, keyfile: Optional[str]):
+        self._ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        self._ctx.load_cert_chain(certfile, keyfile)
+        super().__init__(addr, handler)
+
+    def finish_request(self, request, client_address):
+        request.settimeout(self.HANDSHAKE_TIMEOUT)
+        try:
+            request = self._ctx.wrap_socket(request, server_side=True)
+        except (ssl.SSLError, OSError):
+            # plaintext probe / handshake garbage: drop quietly
+            try:
+                request.close()
+            except OSError:
+                pass
+            return
+        request.settimeout(None)
+        super().finish_request(request, client_address)
+
+
+def make_http_server(addr, handler, certfile: Optional[str] = None,
+                     keyfile: Optional[str] = None) -> ThreadingHTTPServer:
+    """The one place HTTP(S) servers are built (REST apiserver + the
+    CLI's metrics/webhook server): plaintext ThreadingHTTPServer, or the
+    per-connection-handshake TLS variant when a cert is given."""
+    if certfile:
+        return TLSThreadingHTTPServer(addr, handler, certfile, keyfile)
+    return ThreadingHTTPServer(addr, handler)
 
 
 def _route(path: str) -> Tuple[str, Optional[str], Optional[str]]:
@@ -55,17 +116,46 @@ def _route(path: str) -> Tuple[str, Optional[str], Optional[str]]:
 
 
 def serve(server: FakeAPIServer, port: int = 0,
-          host: str = "127.0.0.1") -> ThreadingHTTPServer:
+          host: str = "127.0.0.1", token: Optional[str] = None,
+          certfile: Optional[str] = None,
+          keyfile: Optional[str] = None,
+          queue=None) -> ThreadingHTTPServer:
     """Serve the apiserver on ``host:port`` (port 0 = ephemeral); returns
     the HTTP server (``.server_address[1]`` carries the bound port).
-    Defaults to loopback: this surface is WRITE-CAPABLE and
-    unauthenticated — exposing it beyond the host is an explicit
-    deployment decision (pass host='0.0.0.0')."""
+    Defaults to loopback: this surface is WRITE-CAPABLE — exposing it
+    beyond the host is an explicit deployment decision that should come
+    with ``token`` (bearer auth) and ``certfile``/``keyfile`` (TLS).
+
+    ``queue`` (an interruption FakeQueue) additionally serves
+    ``POST /queue/messages`` — the SQS-over-HTTP ingest analog (the real
+    EventBridge→SQS path is an HTTP API too), so external chaos /
+    integration harnesses can inject interruption events across the
+    process boundary (tests/test_crossprocess_e2e.py)."""
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
         # ---- plumbing --------------------------------------------------
+
+        def handle_one_request(self):
+            # TLS handshake failures (a plaintext client probing the
+            # HTTPS port) surface as SSL errors mid-read: drop quietly
+            try:
+                super().handle_one_request()
+            except ssl.SSLError:
+                self.close_connection = True
+
+        def parse_request(self):
+            ok = super().parse_request()
+            if not ok:
+                return False
+            if token is not None and not check_bearer(
+                    self.headers.get("Authorization"), token):
+                self._json(401, {"error": "Unauthorized",
+                                 "message": "missing or bad bearer token"})
+                self.close_connection = True
+                return False
+            return True
 
         def _json(self, code: int, doc) -> None:
             body = json.dumps(doc).encode()
@@ -149,6 +239,12 @@ def serve(server: FakeAPIServer, port: int = 0,
         def do_POST(self):
             try:
                 url = urlparse(self.path)
+                if url.path == "/queue/messages":
+                    if queue is None:
+                        raise NotFoundError("no interruption queue served")
+                    mid = queue.send(self._body())
+                    self._json(201, {"messageId": mid})
+                    return
                 kind, name, sub = _route(url.path)
                 q = parse_qs(url.query)
                 if kind == "pods" and name is not None and sub == "binding":
@@ -213,6 +309,6 @@ def serve(server: FakeAPIServer, port: int = 0,
         def log_message(self, *a):   # quiet by default
             pass
 
-    httpd = ThreadingHTTPServer((host, port), Handler)
+    httpd = make_http_server((host, port), Handler, certfile, keyfile)
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
     return httpd

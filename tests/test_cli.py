@@ -145,6 +145,48 @@ class TestValidateEndpoint:
         finally:
             server.shutdown()
 
+    def test_admissionreview_v1_dialect(self, lattice):
+        """A real kube-apiserver webhook client POSTs AdmissionReview v1
+        (deploy/templates/webhooks.yaml registers exactly that); the
+        endpoint must answer in the AdmissionReview response envelope."""
+        import json
+        import urllib.request
+        from karpenter_provider_aws_tpu.apis import NodePool, serde
+        from karpenter_provider_aws_tpu.cli import start_server
+        from karpenter_provider_aws_tpu.cloud import FakeCloud
+        from karpenter_provider_aws_tpu.operator import Operator, Options
+        from karpenter_provider_aws_tpu.utils.clock import FakeClock
+        clock = FakeClock()
+        op = Operator(options=Options(), lattice=lattice,
+                      cloud=FakeCloud(clock), clock=clock)
+        server = start_server(op, 0)
+        try:
+            port = server.server_address[1]
+
+            def post(doc):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/validate",
+                    data=json.dumps(doc).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req) as r:
+                    return json.loads(r.read())
+
+            spec = serde.nodepool_to_dict(NodePool(name="p"))
+            review = {"apiVersion": "admission.k8s.io/v1",
+                      "kind": "AdmissionReview",
+                      "request": {"uid": "u-1",
+                                  "resource": {"resource": "nodepools"},
+                                  "object": {"spec": spec}}}
+            ok = post(review)
+            assert ok["kind"] == "AdmissionReview"
+            assert ok["response"] == {"uid": "u-1", "allowed": True}
+            spec["disruption"]["budgets"] = [{"nodes": "150%"}]
+            denied = post(review)
+            assert denied["response"]["allowed"] is False
+            assert "nodes" in denied["response"]["status"]["message"]
+        finally:
+            server.shutdown()
+
     def test_validate_endpoint_rejects_garbage_without_crashing(self, lattice):
         """Malformed reviews answer 400/denied — never a dropped
         connection (review r4 finding)."""

@@ -164,6 +164,149 @@ class TestWatchStream:
         assert status_of(e) == 410
 
 
+class TestWatchReconnectOver410:
+    def test_reflector_relists_after_410_over_http(self, api):
+        """The client-go reflector contract, ON THE WIRE: a watcher whose
+        RV fell off the ring gets 410, relists over REST, and resumes
+        watching from the fresh RV with no lost objects."""
+        import collections
+        server, base = api
+        server._history["pods"] = collections.deque(maxlen=2)
+        for i in range(5):
+            server.create("pods", serde.pod_to_dict(
+                Pod(name=f"p{i}", requests={"cpu": "1", "memory": "1Gi"})))
+        # stale watch → 410 Gone
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"{base}/apis/pods?watch=1&resourceVersion=1", timeout=5)
+        assert status_of(e) == 410
+        # recovery: relist, then watch from the listed RV
+        _, listed = req("GET", f"{base}/apis/pods")
+        store = {o["metadata"]["name"] for o in listed["items"]}
+        assert store == {f"p{i}" for i in range(5)}
+        got = []
+
+        def reader():
+            r = urllib.request.urlopen(
+                f"{base}/apis/pods?watch=1"
+                f"&resourceVersion={listed['resourceVersion']}", timeout=10)
+            for line in r:
+                ev = json.loads(line)
+                if ev["type"] == "HEARTBEAT":
+                    continue
+                got.append(ev)
+                return
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        server.create("pods", serde.pod_to_dict(
+            Pod(name="p-after", requests={"cpu": "1", "memory": "1Gi"})))
+        t.join(10)
+        assert [e["object"]["metadata"]["name"] for e in got] == ["p-after"]
+
+
+class TestAuthAndTLS:
+    def test_bearer_token_required_when_enabled(self):
+        s = FakeAPIServer()
+        httpd = serve(s, 0, token="s3cret")
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                req("GET", f"{base}/apis/pods")
+            assert status_of(e) == 401
+            r = urllib.request.Request(
+                f"{base}/apis/pods",
+                headers={"Authorization": "Bearer wrong"})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(r, timeout=5)
+            assert status_of(e) == 401
+            r = urllib.request.Request(
+                f"{base}/apis/pods",
+                headers={"Authorization": "Bearer s3cret"})
+            with urllib.request.urlopen(r, timeout=5) as resp:
+                assert resp.status == 200
+        finally:
+            httpd.shutdown()
+
+    def test_tls_serves_https(self, tmp_path):
+        import ssl
+        import subprocess
+        crt, key = tmp_path / "tls.crt", tmp_path / "tls.key"
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-days", "1", "-keyout", str(key), "-out", str(crt),
+             "-subj", "/CN=127.0.0.1",
+             "-addext", "subjectAltName=IP:127.0.0.1"],
+            check=True, capture_output=True)
+        s = FakeAPIServer()
+        httpd = serve(s, 0, token="t0k", certfile=str(crt),
+                      keyfile=str(key))
+        port = httpd.server_address[1]
+        try:
+            ctx = ssl.create_default_context(cafile=str(crt))
+            r = urllib.request.Request(
+                f"https://127.0.0.1:{port}/apis/pods",
+                headers={"Authorization": "Bearer t0k"})
+            with urllib.request.urlopen(r, timeout=5, context=ctx) as resp:
+                assert resp.status == 200
+                assert json.loads(resp.read())["items"] == []
+        finally:
+            httpd.shutdown()
+
+    def test_stalled_tls_client_does_not_block_other_connections(
+            self, tmp_path):
+        """The TLS handshake runs per-connection (TLSThreadingHTTPServer):
+        a client that connects and sends NOTHING must not stall accept()
+        — a concurrent well-formed request still answers."""
+        import socket
+        import ssl
+        import subprocess
+        crt, key = tmp_path / "tls.crt", tmp_path / "tls.key"
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-days", "1", "-keyout", str(key), "-out", str(crt),
+             "-subj", "/CN=127.0.0.1",
+             "-addext", "subjectAltName=IP:127.0.0.1"],
+            check=True, capture_output=True)
+        s = FakeAPIServer()
+        httpd = serve(s, 0, certfile=str(crt), keyfile=str(key))
+        port = httpd.server_address[1]
+        stall = socket.create_connection(("127.0.0.1", port))
+        try:
+            ctx = ssl.create_default_context(cafile=str(crt))
+            with urllib.request.urlopen(
+                    f"https://127.0.0.1:{port}/apis/pods",
+                    timeout=5, context=ctx) as resp:
+                assert resp.status == 200
+        finally:
+            stall.close()
+            httpd.shutdown()
+
+    def test_non_ascii_auth_header_is_401_not_crash(self):
+        s = FakeAPIServer()
+        httpd = serve(s, 0, token="tok")
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            r = urllib.request.Request(
+                f"{base}/apis/pods",
+                headers={"Authorization": "Bearer café"})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(r, timeout=5)
+            assert status_of(e) == 401
+        finally:
+            httpd.shutdown()
+
+    def test_cli_refuses_public_plaintext_bind(self):
+        """Serving the write-capable surface beyond loopback without
+        TLS+token must exit unless --api-insecure is explicit."""
+        from karpenter_provider_aws_tpu.cli import main
+        with pytest.raises(SystemExit) as e:
+            main(["--api-port", "1", "--api-host", "0.0.0.0",
+                  "--duration", "0.1", "--metrics-port", "0"])
+        assert "refusing" in str(e.value)
+
+
 class TestExternalAgentDrivesControlPlane:
     def test_rest_created_pods_get_capacity(self):
         """The full story: an external agent creates pods over HTTP; the

@@ -1,0 +1,274 @@
+#!/usr/bin/env python
+"""kpctl — the kubectl analog for the framework's REST apiserver.
+
+The reference's operational UX is kubectl against its CRDs (the entire
+website getting-started flow drives `kubectl apply/get/delete`); this is
+the same surface against the control plane served by
+``karpenter-tpu-controller --api-port`` (kube/httpserver.py routes):
+
+    kpctl get KIND [NAME] [-o json|wide]     k8s-style tables
+    kpctl apply -f FILE                      create-or-update from YAML/JSON
+    kpctl delete KIND NAME [--force]
+    kpctl watch KIND [--resource-version N]  streamed events
+    kpctl evict POD [--force]
+
+Connection flags mirror kubectl's: --server (or KPCTL_SERVER), bearer
+auth via --token/--token-file, TLS via --cacert (self-signed material
+from deploy/gen_certs.sh) or --insecure-skip-tls-verify.
+
+Files for apply hold one or many documents (YAML stream or JSON list),
+each ``{"kind": <plural>, "spec": {...}}`` in the serde wire schema —
+`kpctl apply` is how the cross-process e2e drives provisioning
+(tests/test_crossprocess_e2e.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import ssl
+import sys
+import urllib.error
+import urllib.request
+
+
+class Client:
+    def __init__(self, server: str, token: str = None, cacert: str = None,
+                 insecure: bool = False):
+        self.server = server.rstrip("/")
+        self.token = token
+        if cacert:
+            self.ctx = ssl.create_default_context(cafile=cacert)
+        elif insecure:
+            self.ctx = ssl.create_default_context()
+            self.ctx.check_hostname = False
+            self.ctx.verify_mode = ssl.CERT_NONE
+        else:
+            self.ctx = None
+
+    def request(self, method: str, path: str, doc=None, stream=False):
+        r = urllib.request.Request(
+            f"{self.server}{path}", method=method,
+            data=None if doc is None else json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json",
+                     **({"Authorization": f"Bearer {self.token}"}
+                        if self.token else {})})
+        resp = urllib.request.urlopen(r, timeout=None if stream else 30,
+                                      context=self.ctx)
+        if stream:
+            return resp
+        with resp:
+            return json.loads(resp.read() or b"{}")
+
+
+def _age(created, now=None):
+    if not created:
+        return "<none>"
+    import time
+    d = max((now if now is not None else time.time()) - float(created), 0)
+    if d < 120:
+        return f"{int(d)}s"
+    if d < 7200:
+        return f"{int(d / 60)}m"
+    return f"{int(d / 3600)}h"
+
+
+# per-kind table columns: (header, spec-path extractor)
+_COLUMNS = {
+    "nodeclaims": (
+        ("NAME", lambda o: o["metadata"]["name"]),
+        ("TYPE", lambda o: o["spec"].get("instanceType") or "<pending>"),
+        ("ZONE", lambda o: o["spec"].get("zone") or ""),
+        ("CAPACITY", lambda o: o["spec"].get("capacityType") or ""),
+        ("PHASE", lambda o: o["spec"].get("phase", "")),
+        ("NODEPOOL", lambda o: o["spec"].get("nodePool", "")),
+    ),
+    "nodes": (
+        ("NAME", lambda o: o["metadata"]["name"]),
+        ("READY", lambda o: str(bool(o["spec"].get("ready"))).lower()),
+        ("TYPE", lambda o: o["spec"].get("labels", {}).get(
+            "node.kubernetes.io/instance-type", "")),
+        ("ZONE", lambda o: o["spec"].get("labels", {}).get(
+            "topology.kubernetes.io/zone", "")),
+    ),
+    "pods": (
+        ("NAME", lambda o: o["metadata"]["name"]),
+        ("NODE", lambda o: o["spec"].get("nodeName") or "<pending>"),
+        ("CPU", lambda o: o["spec"].get("requests", {}).get("cpu", "")),
+        ("MEMORY", lambda o: o["spec"].get("requests", {}).get("memory", "")),
+    ),
+    "nodepools": (
+        ("NAME", lambda o: o["metadata"]["name"]),
+        ("WEIGHT", lambda o: str(o["spec"].get("weight", 0))),
+    ),
+}
+_DEFAULT_COLUMNS = (
+    ("NAME", lambda o: o["metadata"]["name"]),
+    ("RV", lambda o: str(o["metadata"]["resourceVersion"])),
+)
+
+
+def print_table(kind: str, objs) -> None:
+    cols = _COLUMNS.get(kind, _DEFAULT_COLUMNS)
+    rows = [[h for h, _ in cols]]
+    for o in objs:
+        rows.append([f(o) or "" for _, f in cols])
+    widths = [max(len(r[i]) for r in rows) for i in range(len(cols))]
+    for r in rows:
+        print("   ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+
+
+def load_documents(path):
+    """YAML stream or JSON (object or list) → [{'kind','spec'}, ...]."""
+    raw = sys.stdin.read() if path == "-" else open(path).read()
+    try:
+        docs = json.loads(raw)
+        docs = docs if isinstance(docs, list) else [docs]
+    except ValueError:
+        import yaml
+        docs = [d for d in yaml.safe_load_all(raw) if d]
+    for d in docs:
+        if "kind" not in d or "spec" not in d:
+            raise SystemExit(
+                f"each document needs kind+spec (got {sorted(d)})")
+    return docs
+
+
+def cmd_get(c: Client, args) -> int:
+    if args.name:
+        obj = c.request("GET", f"/apis/{args.kind}/{args.name}")
+        objs = [obj]
+    else:
+        objs = c.request("GET", f"/apis/{args.kind}")["items"]
+    if args.output == "json":
+        print(json.dumps(objs if args.name is None else objs[0], indent=2))
+    else:
+        print_table(args.kind, objs)
+    return 0
+
+
+def cmd_apply(c: Client, args) -> int:
+    for d in load_documents(args.filename):
+        kind, spec = d["kind"], d["spec"]
+        name = spec.get("name", "<unnamed>")
+        try:
+            c.request("POST", f"/apis/{kind}", spec)
+            print(f"{kind}/{name} created")
+        except urllib.error.HTTPError as e:
+            if e.code != 409:
+                raise
+            # exists: kubectl-apply semantics — replace the spec at the
+            # server's current RV
+            cur = c.request("GET", f"/apis/{kind}/{name}")
+            cur["spec"] = spec
+            c.request("PUT", f"/apis/{kind}/{name}", cur)
+            print(f"{kind}/{name} configured")
+    return 0
+
+
+def cmd_delete(c: Client, args) -> int:
+    force = "?force=1" if args.force else ""
+    c.request("DELETE", f"/apis/{args.kind}/{args.name}{force}")
+    print(f"{args.kind}/{args.name} deleted")
+    return 0
+
+
+def cmd_watch(c: Client, args) -> int:
+    rv = args.resource_version
+    if rv is None:
+        rv = c.request("GET", f"/apis/{args.kind}")["resourceVersion"]
+    resp = c.request(
+        "GET", f"/apis/{args.kind}?watch=1&resourceVersion={rv}",
+        stream=True)
+    for line in resp:
+        ev = json.loads(line)
+        if ev["type"] == "HEARTBEAT":
+            continue
+        name = ev["object"]["metadata"]["name"]
+        print(f"{ev['type']}\t{args.kind}/{name}\trv={ev['resourceVersion']}",
+              flush=True)
+        if args.once:
+            return 0
+    return 0
+
+
+def cmd_evict(c: Client, args) -> int:
+    force = "?force=1" if args.force else ""
+    try:
+        c.request("POST", f"/apis/pods/{args.name}/eviction{force}")
+    except urllib.error.HTTPError as e:
+        if e.code == 429:
+            print(f"pod/{args.name} eviction blocked by a "
+                  "PodDisruptionBudget", file=sys.stderr)
+            return 1
+        raise
+    print(f"pod/{args.name} evicted")
+    return 0
+
+
+def main(argv=None) -> int:
+    import os
+    p = argparse.ArgumentParser(prog="kpctl", description=__doc__)
+    p.add_argument("--server", default=os.environ.get("KPCTL_SERVER"),
+                   help="API base URL, e.g. https://127.0.0.1:8443 "
+                        "(env KPCTL_SERVER)")
+    p.add_argument("--token", default=os.environ.get("KPCTL_TOKEN"))
+    p.add_argument("--token-file", default=None)
+    p.add_argument("--cacert", default=None,
+                   help="PEM bundle to trust (deploy/certs/tls.crt)")
+    p.add_argument("--insecure-skip-tls-verify", action="store_true")
+    sub = p.add_subparsers(dest="verb", required=True)
+
+    g = sub.add_parser("get")
+    g.add_argument("kind")
+    g.add_argument("name", nargs="?")
+    g.add_argument("-o", "--output", choices=("table", "json"),
+                   default="table")
+    g.set_defaults(fn=cmd_get)
+
+    a = sub.add_parser("apply")
+    a.add_argument("-f", "--filename", required=True,
+                   help="YAML/JSON file of {kind, spec} documents "
+                        "('-' = stdin)")
+    a.set_defaults(fn=cmd_apply)
+
+    d = sub.add_parser("delete")
+    d.add_argument("kind")
+    d.add_argument("name")
+    d.add_argument("--force", action="store_true")
+    d.set_defaults(fn=cmd_delete)
+
+    w = sub.add_parser("watch")
+    w.add_argument("kind")
+    w.add_argument("--resource-version", type=int, default=None)
+    w.add_argument("--once", action="store_true",
+                   help="exit after the first event (scripting)")
+    w.set_defaults(fn=cmd_watch)
+
+    e = sub.add_parser("evict")
+    e.add_argument("name")
+    e.add_argument("--force", action="store_true")
+    e.set_defaults(fn=cmd_evict)
+
+    args = p.parse_args(argv)
+    if not args.server:
+        raise SystemExit("--server (or KPCTL_SERVER) is required")
+    token = args.token
+    if args.token_file:
+        token = open(args.token_file).read().strip()
+    c = Client(args.server, token=token, cacert=args.cacert,
+               insecure=args.insecure_skip_tls_verify)
+    try:
+        return args.fn(c, args)
+    except urllib.error.HTTPError as err:
+        try:
+            doc = json.loads(err.read())
+            msg = doc.get("message", "")
+        except Exception:
+            msg = ""
+        print(f"error: {err.code} {msg}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

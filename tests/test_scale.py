@@ -37,6 +37,85 @@ def lattice():
     return build_lattice([s for s in build_catalog() if s.family in _FAMILIES])
 
 
+class Harness:
+    """One scale scenario over either writer stratum.
+
+    ``direct``: the deterministic simulation stratum (DirectWriter,
+    mutations straight into the ClusterState mirror). ``api``: the
+    envtest analog — every mutation this harness makes goes through the
+    typed client against the fake apiserver, controllers write through
+    ApiWriter, and the mirror only changes when informers deliver watch
+    events. The reference's controllers only exist behind the API
+    (cmd/controller/main.go:47-53), so the API stratum is where
+    informer-lag and conflict-retry bugs reproduce — running the SAME
+    500-node matrix/storm/chaos scenarios in both strata is the point
+    (round-5 item: API mode as the primary stratum at scale)."""
+
+    def __init__(self, lattice, clock, stratum, node_pools=None,
+                 options=None, cloud=None, interruption_queue=None):
+        self.stratum = stratum
+        self.client = None
+        kw = dict(options=options or Options(registration_delay=1.0),
+                  lattice=lattice, clock=clock,
+                  cloud=cloud or FakeCloud(clock),
+                  node_pools=node_pools,
+                  interruption_queue=interruption_queue)
+        if stratum == "api":
+            from karpenter_provider_aws_tpu.kube import (FakeAPIServer,
+                                                         KubeClient)
+            server = FakeAPIServer(clock=clock)
+            kw["api_server"] = server
+            self.op = Operator(**kw)
+            self.client = KubeClient(server)
+        else:
+            self.op = Operator(**kw)
+
+    def __getattr__(self, name):
+        return getattr(self.op, name)
+
+    # ---- mutations through the stratum's proper seam -----------------
+
+    def add_pod(self, pod: Pod) -> None:
+        if self.client is not None:
+            self.client.create_pod(pod)
+        else:
+            self.op.cluster.add_pod(pod)
+
+    def delete_pod(self, name: str) -> None:
+        if self.client is not None:
+            self.client.delete_pod(name)
+        else:
+            self.op.cluster.delete_pod(name)
+
+    def add_pdb(self, pdb) -> None:
+        if self.client is not None:
+            self.client.create_pdb(pdb)
+        else:
+            self.op.cluster.add_pdb(pdb)
+
+    def update_pool(self, pool) -> None:
+        """Template change (drift): server-side in API mode so the config
+        watch delivers it, in-place in direct mode."""
+        if self.client is not None:
+            self.client.update_nodepool(pool)
+
+    def assert_mirror_consistent(self) -> None:
+        """API stratum: the informer-fed mirror agrees with the server."""
+        if self.client is None:
+            return
+        assert ({c.name for c in self.client.list_nodeclaims()}
+                == set(self.op.cluster.claims))
+        assert ({n.name for n in self.client.list_nodes()}
+                == set(self.op.cluster.nodes))
+        assert ({p.name for p in self.client.list_pods()}
+                == set(self.op.cluster.pods))
+
+
+@pytest.fixture(params=["direct", "api"])
+def stratum(request):
+    return request.param
+
+
 def assert_no_leaks(env):
     """Zero leaked instances / claims / nodes (the scale suite's core
     post-condition: EventuallyExpect...Count equalities + cleanup)."""
@@ -83,17 +162,17 @@ def converge(env, rounds, step=2.0):
 
 
 class TestNodeDenseScaleUp:
-    def test_500_nodes_one_pod_each(self, lattice):
+    def test_500_nodes_one_pod_each(self, lattice, stratum):
         """provisioning_test.go:82-118: 500 replicas with hostname
-        anti-affinity -> exactly 500 nodes, every pod bound."""
+        anti-affinity -> exactly 500 nodes, every pod bound — in BOTH
+        writer strata."""
         clock = FakeClock()
-        env = Operator(options=Options(registration_delay=1.0),
-                       lattice=lattice, cloud=FakeCloud(clock), clock=clock,
-                       node_pools=[NodePool(name="default")])
+        env = Harness(lattice, clock, stratum,
+                      node_pools=[NodePool(name="default")])
         anti = [PodAffinityTerm(topology_key=wk.LABEL_HOSTNAME,
                                 label_selector=(("app", "dense"),), anti=True)]
         for i in range(500):
-            env.cluster.add_pod(Pod(
+            env.add_pod(Pod(
                 name=f"d-{i}", labels={"app": "dense"},
                 requests={"cpu": "250m", "memory": "256Mi"},
                 pod_affinity=list(anti)))
@@ -107,8 +186,9 @@ class TestNodeDenseScaleUp:
         for p in env.cluster.pods.values():
             per_node[p.node_name] = per_node.get(p.node_name, 0) + 1
         assert max(per_node.values()) == 1
+        env.assert_mirror_consistent()
 
-    def test_pod_dense_110_per_node(self, lattice):
+    def test_pod_dense_110_per_node(self, lattice, stratum):
         """provisioning_test.go:119-157: 6600 pods at 110/node density on
         .large sizes -> 60 nodes."""
         replicas_per_node, node_count = 110, 60
@@ -119,12 +199,10 @@ class TestNodeDenseScaleUp:
         pool = NodePool(name="default", requirements=[
             Requirement(wk.LABEL_INSTANCE_SIZE, ReqOp.IN, ("large",)),
             Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN, ("on-demand",))])
-        env = Operator(options=Options(registration_delay=1.0),
-                       lattice=dense_lattice, cloud=FakeCloud(clock),
-                       clock=clock, node_pools=[pool])
+        env = Harness(dense_lattice, clock, stratum, node_pools=[pool])
         for i in range(replicas_per_node * node_count):
-            env.cluster.add_pod(Pod(name=f"p-{i}",
-                                    requests={"cpu": "10m", "memory": "50Mi"}))
+            env.add_pod(Pod(name=f"p-{i}",
+                            requests={"cpu": "10m", "memory": "50Mi"}))
         env.settle(max_rounds=30)
         assert_all_bound(env)
         assert_no_leaks(env)
@@ -134,6 +212,7 @@ class TestNodeDenseScaleUp:
         for p in env.cluster.pods.values():
             per_node[p.node_name] = per_node.get(p.node_name, 0) + 1
         assert max(per_node.values()) <= replicas_per_node
+        env.assert_mirror_consistent()
 
 
 class TestDeprovisioningMatrix:
@@ -142,7 +221,8 @@ class TestDeprovisioningMatrix:
 
     METHODS = ("consolidation", "emptiness", "expiration", "drift")
 
-    def _matrix_env(self, lattice, nodes_per_pool=5, pods_per_node=4):
+    def _matrix_env(self, lattice, stratum, nodes_per_pool=5,
+                    pods_per_node=4):
         clock = FakeClock()
         pools = []
         for m in self.METHODS:
@@ -153,9 +233,7 @@ class TestDeprovisioningMatrix:
                 disruption=NodePoolDisruption(
                     consolidate_after=30.0,
                     expire_after=100000.0 if m == "expiration" else None)))
-        env = Operator(options=Options(registration_delay=1.0),
-                       lattice=lattice, cloud=FakeCloud(clock), clock=clock,
-                       node_pools=pools)
+        env = Harness(lattice, clock, stratum, node_pools=pools)
         # pods pinned to their pool via nodeSelector; hostname
         # anti-affinity within a group caps one GROUP pod per node, sized
         # so pods_per_node groups fill a node
@@ -165,7 +243,7 @@ class TestDeprovisioningMatrix:
                     topology_key=wk.LABEL_HOSTNAME,
                     label_selector=(("grp", f"{m}-{g}"),), anti=True)]
                 for i in range(nodes_per_pool):
-                    env.cluster.add_pod(Pod(
+                    env.add_pod(Pod(
                         name=f"{m}-{g}-{i}", labels={"grp": f"{m}-{g}"},
                         node_selector={"testing/deprovisioning-type": m},
                         requests={"cpu": "800m", "memory": "1536Mi"},
@@ -173,9 +251,10 @@ class TestDeprovisioningMatrix:
         env.settle(max_rounds=40)
         return env
 
-    def test_all_methods_simultaneously(self, lattice):
+    def test_all_methods_simultaneously(self, lattice, stratum):
         nodes_per_pool = 5
-        env = self._matrix_env(lattice, nodes_per_pool=nodes_per_pool)
+        env = self._matrix_env(lattice, stratum,
+                               nodes_per_pool=nodes_per_pool)
         assert_all_bound(env)
         assert_no_leaks(env)
         by_pool_before = {m: [c for c in env.cluster.claims.values()
@@ -187,20 +266,22 @@ class TestDeprovisioningMatrix:
         # consolidation: shrink its pods so they repack onto fewer nodes
         for p in [p for p in list(env.cluster.pods.values())
                   if p.name.startswith("consolidation-")]:
-            env.cluster.delete_pod(p.name)
+            env.delete_pod(p.name)
         for i in range(3):
-            env.cluster.add_pod(Pod(
+            env.add_pod(Pod(
                 name=f"consolidation-tiny-{i}",
                 node_selector={"testing/deprovisioning-type": "consolidation"},
                 requests={"cpu": "100m", "memory": "128Mi"}))
         # emptiness: drain every pod from its pool
         for p in [p for p in list(env.cluster.pods.values())
                   if p.name.startswith("emptiness-")]:
-            env.cluster.delete_pod(p.name)
+            env.delete_pod(p.name)
         # expiration: jump the clock past expire_after (100000s)
         env.clock.step(100001)
         # drift: mutate the pool template so the stamped hash mismatches
+        # (API stratum: server-side, so the config watch delivers it)
         env.node_pools["drift"].labels["drift-marker"] = "v2"
+        env.update_pool(env.node_pools["drift"])
 
         converge(env, rounds=300, step=5.0)
         assert_all_bound(env)
@@ -225,21 +306,21 @@ class TestDeprovisioningMatrix:
         for c in env.cluster.claims.values():
             if c.node_pool == "drift":
                 assert c.annotations.get(wk.ANNOTATION_NODEPOOL_HASH) == want
+        env.assert_mirror_consistent()
 
-    def test_interruption_storm(self, lattice):
+    def test_interruption_storm(self, lattice, stratum):
         """deprovisioning_test.go:681+ scaled: spot-interrupt EVERY node at
-        once; all are drained, replaced, and pods rebind."""
+        once; all are drained, replaced, and pods rebind — both strata."""
         clock = FakeClock()
         queue = FakeQueue("interruptions")
         pool = NodePool(name="default", requirements=[
             Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN, ("spot",))])
-        env = Operator(options=Options(registration_delay=1.0),
-                       lattice=lattice, cloud=FakeCloud(clock), clock=clock,
-                       node_pools=[pool], interruption_queue=queue)
+        env = Harness(lattice, clock, stratum, node_pools=[pool],
+                      interruption_queue=queue)
         anti = [PodAffinityTerm(topology_key=wk.LABEL_HOSTNAME,
                                 label_selector=(("app", "storm"),), anti=True)]
         for i in range(10):
-            env.cluster.add_pod(Pod(
+            env.add_pod(Pod(
                 name=f"s-{i}", labels={"app": "storm"},
                 requests={"cpu": "500m", "memory": "1Gi"},
                 pod_affinity=list(anti)))
@@ -256,20 +337,21 @@ class TestDeprovisioningMatrix:
         for c in env.cluster.claims.values():
             assert parse_instance_id(c.provider_id) not in interrupted
         assert len(env.cluster.claims) == 10
+        env.assert_mirror_consistent()
 
 
 class TestIceChaos:
-    def test_scale_up_through_ice(self, lattice):
+    def test_scale_up_through_ice(self, lattice, stratum):
         """Chaos: the cheapest offerings are ICE'd mid-scale-up; the
         launch path falls through its flexible-type overrides, the ICE
-        cache masks the dead offerings, and the wave still lands."""
+        cache masks the dead offerings, and the wave still lands — in
+        both writer strata."""
         clock = FakeClock()
         cloud = FakeCloud(clock)
         pool = NodePool(name="default", requirements=[
             Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN, ("on-demand",))])
-        env = Operator(options=Options(registration_delay=1.0),
-                       lattice=lattice, cloud=cloud, clock=clock,
-                       node_pools=[pool])
+        env = Harness(lattice, clock, stratum, node_pools=[pool],
+                      cloud=cloud)
         # pre-compute what an unconstrained solve would choose, then ICE it
         probe = Operator(options=Options(registration_delay=1.0),
                          lattice=lattice, cloud=FakeCloud(FakeClock()),
@@ -287,8 +369,8 @@ class TestIceChaos:
             cloud.set_capacity("on-demand", itype, zone, 0)
 
         for i in range(40):
-            env.cluster.add_pod(Pod(name=f"x-{i}",
-                                    requests={"cpu": "1", "memory": "2Gi"}))
+            env.add_pod(Pod(name=f"x-{i}",
+                            requests={"cpu": "1", "memory": "2Gi"}))
         env.settle(max_rounds=40)
         assert_all_bound(env)
         assert_no_leaks(env)
@@ -297,6 +379,7 @@ class TestIceChaos:
             assert cloud.capacity_pools.get(("on-demand", c.instance_type, c.zone)) != 0
         # the ICE cache remembers at least one dead offering
         assert any(True for _ in env.unavailable.entries())
+        env.assert_mirror_consistent()
 
 
 class TestKitchenSink:
@@ -305,7 +388,7 @@ class TestKitchenSink:
     a scheduled disruption freeze, spot interruptions, and ICE chaos —
     converging with zero leaks and every invariant held."""
 
-    def test_everything_at_once(self, lattice):
+    def test_everything_at_once(self, lattice, stratum):
         from karpenter_provider_aws_tpu.apis import PodDisruptionBudget
         from karpenter_provider_aws_tpu.apis.objects import (
             DisruptionBudget, TopologySpreadConstraint)
@@ -340,27 +423,26 @@ class TestKitchenSink:
                 Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN, ("on-demand",)),
                 Requirement("cs", ReqOp.IN, ("1",))]),
         ]
-        env = Operator(options=Options(registration_delay=1.0),
-                       lattice=lattice, cloud=FakeCloud(clock), clock=clock,
-                       node_pools=pools, interruption_queue=queue)
+        env = Harness(lattice, clock, stratum, node_pools=pools,
+                      interruption_queue=queue)
         # workloads
         for i in range(6):   # generic (no selector) -> reserved fills
-            env.cluster.add_pod(Pod(  # first, overflow spills elsewhere
+            env.add_pod(Pod(  # first, overflow spills elsewhere
                 name=f"gen{i}", requests={"cpu": "2", "memory": "2Gi"}))
         for t in ("team-a", "team-b"):
             for i in range(2):
-                env.cluster.add_pod(Pod(
+                env.add_pod(Pod(
                     name=f"{t}-{i}", labels={"app": t},
                     requests={"cpu": "500m", "memory": "1Gi"},
                     node_selector={"company.com/team": t}))
         for i in range(6):   # ratio-spread workload
-            env.cluster.add_pod(Pod(
+            env.add_pod(Pod(
                 name=f"web{i}", labels={"app": "web"},
                 requests={"cpu": "1", "memory": "2Gi"},
                 topology_spread=[TopologySpreadConstraint(
                     max_skew=1, topology_key="cs",
                     label_selector=(("app", "web"),))]))
-        env.cluster.add_pdb(PodDisruptionBudget(
+        env.add_pdb(PodDisruptionBudget(
             name="web-pdb", label_selector={"app": "web"}, max_unavailable=1))
         env.settle(max_rounds=60)
         assert_all_bound(env)
@@ -400,6 +482,7 @@ class TestKitchenSink:
         converge(env, rounds=80, step=2.0)
         assert_all_bound(env)
         assert_no_leaks(env)
+        env.assert_mirror_consistent()
 
 
 class TestApiModeScale:

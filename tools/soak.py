@@ -113,6 +113,16 @@ def main(argv=None) -> int:
                 insts = safe_instances()
                 if insts:
                     q.send(spot_interruption(rng.choice(insts).id))
+            elif r < 0.91:
+                # drift churn: rev the pool template; the drift
+                # controller must roll stale-hash nodes while the rest
+                # of the storm rages (API mode: server-side, so the
+                # config watch delivers it like any operator would)
+                pool = op.node_pools.get("default")
+                if pool is not None:
+                    pool.labels["soak/rev"] = f"r{i}"
+                    if client is not None:
+                        client.update_nodepool(pool)
             elif r < 0.94:
                 op.cloud.inject_error(NotFoundError("soak-chaos"))
             else:

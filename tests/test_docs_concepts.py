@@ -1,0 +1,133 @@
+"""Concept docs tell the truth.
+
+docs/concepts/{scheduling,disruption}.md are standalone behavioral
+specs (the reference's concepts pages are its spec of record); this
+pins the load-bearing numbers and names they cite to the code
+constants that implement them, the same freshness discipline the
+generated reference docs get from tools/gen_docs.py --check.
+"""
+
+import pathlib
+import re
+
+DOCS = pathlib.Path(__file__).resolve().parent.parent / "docs" / "concepts"
+
+
+def _read(name):
+    # collapse hard wraps so phrase assertions are layout-independent
+    return re.sub(r"\s+", " ", (DOCS / name).read_text())
+
+
+def _lines(name):
+    return (DOCS / name).read_text().splitlines()
+
+
+class TestSchedulingDocFacts:
+    def test_spec_depth(self):
+        assert len(_lines("scheduling.md")) >= 250
+
+    def test_batching_defaults_match_options(self):
+        from karpenter_provider_aws_tpu.operator.options import Options
+        o = Options()
+        doc = _read("scheduling.md")
+        assert f"default {o.batch_idle_duration:.0f} s" in doc
+        assert f"default {o.batch_max_duration:.0f} s" in doc
+
+    def test_max_flexible_types_matches(self):
+        from karpenter_provider_aws_tpu.solver.solve import MAX_FLEXIBLE_TYPES
+        assert f"**{MAX_FLEXIBLE_TYPES}** feasible types" in _read(
+            "scheduling.md")
+
+    def test_narrowing_constants_match(self):
+        from karpenter_provider_aws_tpu.solver.problem import (
+            _ACCEL_UNIT_PRICE_SLACK, _WAVE_GAIN, _WAVE_MIN_PODS,
+            _WAVE_PRICE_SLACK,
+        )
+        doc = _read("scheduling.md")
+        slack_pct = round((_ACCEL_UNIT_PRICE_SLACK - 1) * 100)
+        assert f"within {slack_pct}% of the best **per-unit** price" in doc
+        wave_pct = round((_WAVE_PRICE_SLACK - 1) * 100)
+        assert f"within {wave_pct}% of the best" in doc
+        assert f"≥{_WAVE_MIN_PODS} identical small pods" in doc
+        gain_pct = round((1 - _WAVE_GAIN) * 100)
+        assert f"≥{gain_pct}%" in doc
+
+    def test_overhead_formula_matches(self):
+        doc = _read("scheduling.md")
+        # 11*maxPods + 255 Mi kube-reserved memory; 100 Mi eviction
+        assert "11·maxPods + 255 Mi" in doc
+        assert "100 Mi" in doc
+        from karpenter_provider_aws_tpu.lattice.overhead import kube_reserved
+        vec = kube_reserved(2000.0, 29)
+        from karpenter_provider_aws_tpu.apis.resources import RESOURCE_AXES
+        assert vec[RESOURCE_AXES.index("memory")] == 11 * 29 + 255
+
+    def test_wellknown_labels_listed(self):
+        from karpenter_provider_aws_tpu.apis import wellknown as wk
+        doc = _read("scheduling.md")
+        for label in (wk.LABEL_CAPACITY_TYPE, wk.LABEL_INSTANCE_CATEGORY,
+                      wk.LABEL_INSTANCE_FAMILY, wk.LABEL_INSTANCE_CPU,
+                      wk.LABEL_WINDOWS_BUILD):
+            assert label in doc, label
+
+
+class TestDisruptionDocFacts:
+    def test_spec_depth(self):
+        assert len(_lines("disruption.md")) >= 250
+
+    def test_spot_guard_floor_matches(self):
+        from karpenter_provider_aws_tpu.controllers.disruption import (
+            SPOT_TO_SPOT_MIN_TYPES,
+        )
+        assert (f"≥{SPOT_TO_SPOT_MIN_TYPES} distinct feasible instance "
+                "types" in _read("disruption.md"))
+
+    def test_disruption_taint_matches(self):
+        from karpenter_provider_aws_tpu.controllers.termination import (
+            DISRUPTION_TAINT,
+        )
+        effect = getattr(DISRUPTION_TAINT.effect, "value",
+                         DISRUPTION_TAINT.effect)
+        want = f"{DISRUPTION_TAINT.key}={DISRUPTION_TAINT.value}:{effect}"
+        assert want in _read("disruption.md")
+
+    def test_do_not_disrupt_annotation_matches(self):
+        from karpenter_provider_aws_tpu.apis import wellknown as wk
+        assert wk.ANNOTATION_DO_NOT_DISRUPT in _read("disruption.md")
+
+    def test_registration_ttl_matches(self):
+        from karpenter_provider_aws_tpu.controllers.lifecycle import (
+            REGISTRATION_TTL,
+        )
+        minutes = int(REGISTRATION_TTL // 60)
+        assert f"{minutes}-minute registration TTL" in _read("disruption.md")
+
+    def test_lease_timing_matches(self):
+        from karpenter_provider_aws_tpu.operator.leaderelection import (
+            LEASE_DURATION, RETRY_PERIOD,
+        )
+        doc = _read("disruption.md")
+        assert f"{LEASE_DURATION:.0f} s lease" in doc
+        assert f"{RETRY_PERIOD:.0f} s" in doc
+
+    def test_budget_rounding_is_up(self):
+        """The doc's worked example (19 × 20% → 4) must match the
+        implementation's ceil."""
+        import numpy as np
+        assert int(np.ceil(19 * 0.2)) == 4
+        assert "round **up**" in _read("disruption.md")
+
+    def test_method_order_stated(self):
+        doc = _read("disruption.md")
+        assert "expiration" in doc and "drift" in doc
+        i = doc.find("expiration →")
+        assert i >= 0 and "drift → emptiness → consolidation" in doc
+
+    def test_cited_metric_names_exist(self):
+        """Every karpenter_* metric the doc names must exist in the
+        registry source."""
+        import pathlib
+        src = (pathlib.Path(__file__).resolve().parent.parent /
+               "karpenter_provider_aws_tpu" / "metrics.py").read_text()
+        for m in re.findall(r"karpenter_[a-z_]+", _read("disruption.md")):
+            assert m in src, m

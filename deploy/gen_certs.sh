@@ -26,6 +26,9 @@ chmod 600 "$dir/certs/tls.key" "$dir/certs/token"
 
 b64() { base64 < "$1" | tr -d '\n'; }
 {
+  # the identity this cert names — render.sh re-mints when values.env
+  # changes NAME/NAMESPACE so a stale CN can't break webhook TLS
+  echo "CERT_CN=${NAME}.${NAMESPACE}.svc"
   echo "TLS_CRT_B64=$(b64 "$dir/certs/tls.crt")"
   echo "TLS_KEY_B64=$(b64 "$dir/certs/tls.key")"
   echo "API_TOKEN_B64=$(b64 "$dir/certs/token")"

@@ -8,8 +8,12 @@ values="${1:-$dir/values.env}"
 set -a; . "$values"
 # serving cert/token material: auto-mint on first render (a render
 # without real material would produce a crashlooping deployment — the
-# container flags, HTTPS probes, and webhook caBundle all expect it)
-if [ ! -f "$dir/certs/certs.env" ]; then
+# container flags, HTTPS probes, and webhook caBundle all expect it),
+# and re-mint when NAME/NAMESPACE changed since the cert was cut (a
+# stale CN would fail the kube-apiserver's webhook TLS verification)
+want_cn="${NAME}.${NAMESPACE}.svc"
+have_cn="$(grep '^CERT_CN=' "$dir/certs/certs.env" 2>/dev/null | cut -d= -f2)"
+if [ "$have_cn" != "$want_cn" ]; then
   sh "$dir/gen_certs.sh" "$values"
 fi
 . "$dir/certs/certs.env"

@@ -216,7 +216,12 @@ def start_server(op: Operator, port: int,
                     uid = req.get("uid", "")
                     kind = req["resource"]["resource"]
                     obj = req["object"]
-                    spec = obj.get("spec", obj)
+                    spec = dict(obj.get("spec", obj))
+                    # real k8s objects carry name under metadata; the
+                    # wire schema requires spec.name — fold it in
+                    meta_name = obj.get("metadata", {}).get("name")
+                    if "name" not in spec and meta_name:
+                        spec["name"] = meta_name
                     wrap = "admissionreview"
                 else:
                     uid, wrap = "", "native"
@@ -351,7 +356,7 @@ def main(argv: Optional[Sequence[str]] = None,
     except ValueError:
         pass  # not the main thread (tests drive main() directly)
 
-    server = (start_server(op, args.metrics_port, token=api_token,
+    server = (start_server(op, args.metrics_port,
                            certfile=args.api_tls_cert,
                            keyfile=args.api_tls_key)
               if args.metrics_port else None)

@@ -228,7 +228,10 @@ class FakeAPIServer:
                     "name": name,
                     "uid": f"uid-{next(self._uid):06d}",
                     "resourceVersion": rv,
-                    "creationTimestamp": None,   # clock-free; RV orders
+                    # stamped when a clock is wired (live mode); None in
+                    # clock-free tests, where RV orders events
+                    "creationTimestamp": (self._clock.now()
+                                          if self._clock else None),
                     "deletionTimestamp": None,
                     "finalizers": list(finalizers),
                 },

@@ -187,6 +187,10 @@ def validate_wire(kind: str, spec) -> List[str]:
     every boundary."""
     from .apis import schema, serde
     KNOWN = ("nodepools", "nodeclasses", "pdbs", "nodeclaims")
+    # the NodeClass CRD's real-world plural (deploy/crds,
+    # webhooks.yaml registration) — same object, same validation
+    if kind == "ec2nodeclasses":
+        kind = "nodeclasses"
     if kind not in KNOWN:
         # an "allowed" answer for a kind we cannot validate would be a
         # false green light (the apiserver rejects unknown kinds)

@@ -171,12 +171,16 @@ class TestValidateEndpoint:
                 with urllib.request.urlopen(req) as r:
                     return json.loads(r.read())
 
+            # the REAL AdmissionReview shape: name lives under
+            # metadata, not in spec
             spec = serde.nodepool_to_dict(NodePool(name="p"))
+            del spec["name"]
             review = {"apiVersion": "admission.k8s.io/v1",
                       "kind": "AdmissionReview",
                       "request": {"uid": "u-1",
                                   "resource": {"resource": "nodepools"},
-                                  "object": {"spec": spec}}}
+                                  "object": {"metadata": {"name": "p"},
+                                             "spec": spec}}}
             ok = post(review)
             assert ok["kind"] == "AdmissionReview"
             assert ok["response"] == {"uid": "u-1", "allowed": True}
@@ -184,6 +188,17 @@ class TestValidateEndpoint:
             denied = post(review)
             assert denied["response"]["allowed"] is False
             assert "nodes" in denied["response"]["status"]["message"]
+            # the registered group plural for NodeClasses resolves too
+            nc_review = {"apiVersion": "admission.k8s.io/v1",
+                         "kind": "AdmissionReview",
+                         "request": {
+                             "uid": "u-2",
+                             "resource": {"resource": "ec2nodeclasses"},
+                             "object": {"metadata": {"name": "default"},
+                                        "spec": {"amiFamily": "AL2",
+                                                 "role": "KarpenterNode"}}}}
+            ok = post(nc_review)
+            assert ok["response"]["allowed"] is True, ok
         finally:
             server.shutdown()
 

@@ -51,6 +51,11 @@ def control_plane():
         XLA_FLAGS="--xla_force_host_platform_device_count=1",
         CLUSTER_NAME="xproc-e2e",
     )
+    # log to a FILE, not a pipe: an undrained pipe backs up after ~64KB
+    # of chaos-path logging and deadlocks the control plane mid-test
+    import tempfile
+    log = tempfile.NamedTemporaryFile(
+        mode="w+", prefix="xproc-e2e-", suffix=".log", delete=False)
     proc = subprocess.Popen(
         [sys.executable, "-m", "karpenter_provider_aws_tpu",
          "--api-port", str(port),
@@ -59,16 +64,20 @@ def control_plane():
          "--step", "0.2",
          "--log-level", "WARNING"],
         cwd=str(REPO), env=env,
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        stdout=log, stderr=subprocess.STDOUT, text=True)
+
+    def _tail():
+        with open(log.name) as f:
+            return f.read()[-4000:]
+
     base = f"http://127.0.0.1:{port}"
     client = kpctl.Client(base)
     deadline = time.monotonic() + STARTUP_TIMEOUT
     last_err = None
     while time.monotonic() < deadline:
         if proc.poll() is not None:
-            out = proc.stdout.read()
             raise RuntimeError(
-                f"control plane exited rc={proc.returncode}:\n{out[-4000:]}")
+                f"control plane exited rc={proc.returncode}:\n{_tail()}")
         try:
             client.request("GET", "/apis/nodepools")
             break

@@ -108,8 +108,14 @@ _DEFAULT_COLUMNS = (
 )
 
 
-def print_table(kind: str, objs) -> None:
-    cols = _COLUMNS.get(kind, _DEFAULT_COLUMNS)
+def print_table(kind: str, objs, wide: bool = False) -> None:
+    cols = list(_COLUMNS.get(kind, _DEFAULT_COLUMNS))
+    if wide:
+        cols += [
+            ("AGE", lambda o: _age(
+                o["metadata"].get("creationTimestamp"))),
+            ("RV", lambda o: str(o["metadata"]["resourceVersion"])),
+        ]
     rows = [[h for h, _ in cols]]
     for o in objs:
         rows.append([f(o) or "" for _, f in cols])
@@ -143,7 +149,7 @@ def cmd_get(c: Client, args) -> int:
     if args.output == "json":
         print(json.dumps(objs if args.name is None else objs[0], indent=2))
     else:
-        print_table(args.kind, objs)
+        print_table(args.kind, objs, wide=args.output == "wide")
     return 0
 
 
@@ -222,7 +228,7 @@ def main(argv=None) -> int:
     g = sub.add_parser("get")
     g.add_argument("kind")
     g.add_argument("name", nargs="?")
-    g.add_argument("-o", "--output", choices=("table", "json"),
+    g.add_argument("-o", "--output", choices=("table", "wide", "json"),
                    default="table")
     g.set_defaults(fn=cmd_get)
 

@@ -428,11 +428,14 @@ def run_config(key, make, lattice, solver, uncapped_referee=False):
 
 # budget on ALGORITHM-controlled time for the north-star config: e2e p50
 # minus the measured link RTT must stay under this, so link weather and
-# real regressions are distinguishable in the bench record. Calibrated to
-# the accel-bin-splitting plan shape (~1500 nodes for 22.7% lower cost —
-# $5949/hr vs $7697 pre-split — the decode and kernel legitimately do
-# ~3x the per-bin work of the 519-node plan the old 60 ms budget fit).
-CFG5_ALGO_BUDGET_MS = 80.0
+# real regressions are distinguishable in the bench record. Recalibrated
+# round 5 for the real-catalog plan shape: the wave/accel narrowing +
+# density floor land cfg5 on ~1840 bins at 0.39x the uncapped-FFD cost
+# (vs round 4's 1486-bin synthetic plan under the old 80 ms budget);
+# measured e2e_algo 72.8-79.2 ms across runs, so 100 ms separates
+# weather from regression with real margin while the raw <200 ms p50
+# target stays the headline gate.
+CFG5_ALGO_BUDGET_MS = 100.0
 
 
 def main(argv=None):

@@ -152,6 +152,11 @@ class Lattice:
     # bumped whenever price is rewritten in place (pricing refresh) so
     # device-resident copies know to re-upload
     price_version: int = 0
+    # key_values_present memo (labels are static per lattice); carried
+    # through masked_view's replace() too, which is correct — masked
+    # views share the same labels
+    _kv_cache: Optional[Dict[str, List[str]]] = field(
+        default=None, repr=False, compare=False)
 
     @property
     def T(self) -> int:
@@ -166,12 +171,18 @@ class Lattice:
         return len(self.capacity_types)
 
     def key_values_present(self) -> Dict[str, List[str]]:
-        """key -> distinct values across the lattice (for minValues checks)."""
+        """key -> distinct values across the lattice (for minValues
+        checks). Labels are static per lattice, so the scan memoizes —
+        build_problem calls this on every batch and the T-wide dict walk
+        was a measurable slice of the 50k-pod host budget."""
+        if self._kv_cache is not None:
+            return self._kv_cache
         out: Dict[str, set] = {}
         for lab in self.labels:
             for k, v in lab.items():
                 out.setdefault(k, set()).add(v)
-        return {k: sorted(v) for k, v in out.items()}
+        self._kv_cache = {k: sorted(v) for k, v in out.items()}
+        return self._kv_cache
 
 
 def masked_view(lattice: Lattice, offering_mask: np.ndarray) -> Lattice:

@@ -238,13 +238,25 @@ _WAVE_MIN_PODS = 64
 # not be fragmented for marginal gains
 _WAVE_GAIN = 0.7
 _WAVE_PRICE_SLACK = 1.05
+# density floor: the whole BATCH may narrow into at most this many
+# bins' worth of nodes — each wave's candidates must hold at least
+# total_pending/this pods per bin. Nodes are not free beyond their
+# price (kubelet, daemonsets, API-object load, and the pack kernel's
+# scan length all scale with bin count), so the narrowing picks the
+# best per-pod cost among types that keep the plan size bounded rather
+# than fragmenting a 50k-pod batch into thousands of burstable
+# nanonodes. The floor is GLOBAL (total pending / bins), not
+# per-group: a batch of thirty 1.6k-pod waves fragments exactly like
+# one 50k wave, and a per-group bound cannot see that.
+_WAVE_MAX_BINS = 1024
 
 
 def _wave_bin_cap(vec: np.ndarray, count: int, type_mask: np.ndarray,
                   zone_mask: np.ndarray, cap_mask: np.ndarray,
                   pool_tmask: np.ndarray, existing_tmask: np.ndarray,
                   ds_vec: np.ndarray, lattice: Lattice,
-                  max_per_bin: int = 0) -> Optional[np.ndarray]:
+                  max_per_bin: int = 0,
+                  total_pending: int = 0) -> Optional[np.ndarray]:
     """Per-POD-cost narrowing for pods-axis-bound waves.
 
     Sequential FFD (the reference's scheduler: first-fit, then price each
@@ -296,6 +308,10 @@ def _wave_bin_cap(vec: np.ndarray, count: int, type_mask: np.ndarray,
     if not fits.any():
         return None
     idx, K = idx[fits], K[fits]
+    # price every candidate BEFORE the floor: the floor's relaxation
+    # point must be the densest candidate that actually has an offering
+    # in the group's zone/captype masks — an ICE'd or out-of-zone big
+    # type must not anchor a floor no available type can meet
     offers = lattice.available[np.ix_(idx, np.nonzero(zone_mask)[0],
                                       np.nonzero(cap_mask)[0])]
     prices = np.where(
@@ -304,19 +320,30 @@ def _wave_bin_cap(vec: np.ndarray, count: int, type_mask: np.ndarray,
                              np.nonzero(cap_mask)[0])],
         np.inf)
     pmin = prices.reshape(len(idx), -1).min(axis=1)
-    per_pod = pmin / K
-    b = int(np.argmin(per_pod))
-    if not np.isfinite(per_pod[b]):
-        return None
-    # what FFD would effectively pay: the per-pod cost of the DENSEST
-    # priced type (first-fit grows bins to max density; end-pricing then
-    # needs a type carrying that density)
     priced = np.isfinite(pmin)
     if not priced.any():
         return None
-    dense = int(np.argmax(np.where(priced, K, -1)))
+    # density floor (see _WAVE_MAX_BINS): candidates must carry the
+    # batch-wide density that keeps the whole plan bounded. Clamped by
+    # max_per_bin — a hostname-spread wave's bin count is fixed by the
+    # spread, so excluding cheap small types there saves zero bins —
+    # and relaxed to the densest PRICED candidate when nothing meets it
+    # (a t-family-only pool offers only small types; FFD would use them
+    # too, and the gain gate still decides).
+    floor = max(total_pending, count) / _WAVE_MAX_BINS
+    if max_per_bin:
+        floor = min(floor, max_per_bin)
+    floor = min(floor, float(K[priced].max()))
+    meets_floor = (K >= floor) & priced
+    idx, K, pmin = idx[meets_floor], K[meets_floor], pmin[meets_floor]
+    per_pod = pmin / K
+    b = int(np.argmin(per_pod))
+    # what FFD would effectively pay: the per-pod cost of the DENSEST
+    # priced type (first-fit grows bins to max density; end-pricing then
+    # needs a type carrying that density)
+    dense = int(np.argmax(K))
     ffd_per_pod = per_pod[dense]
-    if not np.isfinite(ffd_per_pod) or per_pod[b] > ffd_per_pod * _WAVE_GAIN:
+    if per_pod[b] > ffd_per_pod * _WAVE_GAIN:
         return None
     keep = np.zeros(type_mask.shape, dtype=bool)
     keep[idx[per_pod <= per_pod[b] * _WAVE_PRICE_SLACK]] = True
@@ -1049,7 +1076,8 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
                         s.zone_mask & pool_zmask, s.cap_mask & pool_cmask,
                         pool_tmask, existing_tmask,
                         ds_overhead[np_ok_s].max(axis=0), lattice,
-                        max_per_bin=topo.max_per_bin)
+                        max_per_bin=topo.max_per_bin,
+                        total_pending=len(pods))
                 if a_mask is not None and a_mask.any():
                     unnarrowed = masks.type_mask
                     g_tmask = a_mask

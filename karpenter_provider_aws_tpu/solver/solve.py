@@ -773,32 +773,48 @@ class Solver:
         )
         feasible_for = dict(zip(live_rows.tolist(), feasible))
 
+        # hoist device-result arrays into Python lists once: per-bin
+        # numpy scalar extraction (int(arr[b]) ×4 per new bin) is real
+        # money at wave-narrowed plan sizes (6k+ bins)
+        fixed_l = fixed.tolist()
+        np_id_l = np_id.tolist()
+        chosen_t = dec.chosen_t.tolist()
+        chosen_z = dec.chosen_z.tolist()
+        chosen_c = dec.chosen_c.tolist()
+        chosen_price = dec.chosen_price.tolist()
+        leftover_l = leftover.tolist()
+        existing = problem.existing
+        node_pools = problem.node_pools
+
         for gi, group in enumerate(problem.groups):
             names = group.pod_names
             cursor = 0
-            for b in np.nonzero(assign[gi])[0]:
-                n = int(assign[gi, b])
+            row = assign[gi]
+            bs = np.nonzero(row)[0]
+            for b, n in zip(bs.tolist(), row[bs].tolist()):
+                n = int(n)
                 pod_slice = names[cursor: cursor + n]
                 cursor += n
-                if fixed[b]:
-                    existing_assignments.setdefault(problem.existing[b].name, []).extend(pod_slice)
+                if fixed_l[b]:
+                    existing_assignments.setdefault(
+                        existing[b].name, []).extend(pod_slice)
                 else:
-                    node = new_bins.get(int(b))
+                    node = new_bins.get(b)
                     if node is None:
-                        ftypes, fzones, fcaps = feasible_for[int(b)]
-                        pname, extra = _pool_out(problem.node_pools[int(np_id[b])])
+                        ftypes, fzones, fcaps = feasible_for[b]
+                        pname, extra = _pool_out(node_pools[np_id_l[b]])
                         node = PlannedNode(
                             node_pool=pname, extra_labels=extra,
-                            instance_type=lat.names[int(dec.chosen_t[b])],
-                            zone=lat.zones[int(dec.chosen_z[b])],
-                            capacity_type=lat.capacity_types[int(dec.chosen_c[b])],
-                            price_per_hour=float(dec.chosen_price[b]),
+                            instance_type=lat.names[chosen_t[b]],
+                            zone=lat.zones[chosen_z[b]],
+                            capacity_type=lat.capacity_types[chosen_c[b]],
+                            price_per_hour=float(chosen_price[b]),
                             feasible_types=ftypes, feasible_zones=fzones,
                             feasible_capacity_types=fcaps,
                         )
-                        new_bins[int(b)] = node
+                        new_bins[b] = node
                     node.pods.extend(pod_slice)
-            for name in names[cursor: cursor + int(leftover[gi])]:
+            for name in names[cursor: cursor + int(leftover_l[gi])]:
                 unschedulable[name] = "does not fit any existing node or new-node shape"
 
         new_nodes = [new_bins[b] for b in sorted(new_bins)]
@@ -823,9 +839,11 @@ class Solver:
         pattern — a 50k-pod wave's ~1500 bins collapse to a handful of
         patterns (bins seeded by the same group share all three masks),
         so the T-wide price argsort runs once per pattern instead of once
-        per bin (measured: 13 ms → <1 ms at 1486 bins). Callers get
-        FRESH lists per bin (downstream code reassigns but must never
-        see a neighbor's mutation)."""
+        per bin (measured: 13 ms → <1 ms at 1486 bins). Same-pattern
+        bins SHARE one result as immutable tuples: consumers reassign
+        the fields (provisioning.py:382) but can never mutate a
+        neighbor's copy, and the per-bin list materialization (~90k
+        elements at 1500 bins) disappears from the decode budget."""
         lat = self.lattice
         L = tm.shape[0]
         if L == 0:
@@ -859,13 +877,15 @@ class Solver:
                 # n_fin entries of order are exactly the feasible types
                 order = np.argsort(bpt, kind="stable")
                 nf = min(int(np.isfinite(bpt).sum()), MAX_FLEXIBLE_TYPES)
-                types = [names[t] for t in order[:nf].tolist()]
-                zones = [zone_names[zi]
-                         for zi, v in enumerate(t_mask @ av_tz) if v]
-                caps = [cap_names[ci]
-                        for ci, v in enumerate(t_mask @ av_tc) if v]
+                shared = (
+                    tuple(names[t] for t in order[:nf].tolist()),
+                    tuple(zone_names[zi]
+                          for zi, v in enumerate(t_mask @ av_tz) if v),
+                    tuple(cap_names[ci]
+                          for ci, v in enumerate(t_mask @ av_tc) if v),
+                )
                 for l in idxs:
-                    out[l] = (list(types), list(zones), list(caps))
+                    out[l] = shared
         return out
 
     # ---- pod-axis sharded solve (multi-chip path) ----

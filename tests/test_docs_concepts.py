@@ -51,6 +51,9 @@ class TestSchedulingDocFacts:
         assert f"≥{_WAVE_MIN_PODS} identical small pods" in doc
         gain_pct = round((1 - _WAVE_GAIN) * 100)
         assert f"≥{gain_pct}%" in doc
+        from karpenter_provider_aws_tpu.solver.problem import _WAVE_MAX_BINS
+        assert f"under {_WAVE_MAX_BINS} bins" in doc
+        assert "global density floor" in doc
 
     def test_overhead_formula_matches(self):
         doc = _read("scheduling.md")

@@ -1069,6 +1069,24 @@ class TestAccelBinSplitting:
                                   narrow=False))
         assert a.new_node_cost == b.new_node_cost
 
+    def test_wave_narrowing_density_floor_bounds_plan_size(self):
+        """A big tiny-pod wave must not fragment into thousands of
+        minimum-size bins: candidates under count/_WAVE_MAX_BINS pods
+        per bin are excluded, so the plan stays bounded while still
+        beating the uncapped pack."""
+        from karpenter_provider_aws_tpu.solver.problem import _WAVE_MAX_BINS
+        lattice = build_lattice([s for s in build_catalog()
+                                 if s.family in ("m5", "t3", "c5")])
+        s = Solver(lattice)
+        pods = [Pod(name=f"w{i}", requests={"cpu": "50m", "memory": "64Mi"})
+                for i in range(3000)]
+        capped = s.solve(build_problem(pods, [default_pool()], lattice))
+        uncapped = s.solve(build_problem(pods, [default_pool()], lattice,
+                                         narrow=False))
+        assert not capped.unschedulable
+        assert capped.num_new_nodes <= _WAVE_MAX_BINS + 2
+        assert capped.new_node_cost < uncapped.new_node_cost
+
     def test_wave_narrowing_never_costs_schedulability(self):
         """A pool pinned away from the per-pod-cheapest types must still
         schedule the wave (unnarrowed fallback / pool fence)."""

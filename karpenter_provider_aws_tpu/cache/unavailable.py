@@ -27,9 +27,20 @@ UNAVAILABLE_OFFERINGS_TTL = 180.0  # 3 min (reference pkg/cache/cache.go:27-29)
 
 class UnavailableOfferings:
     def __init__(self, clock: Optional[Clock] = None, ttl: float = UNAVAILABLE_OFFERINGS_TTL):
-        self._cache = TTLCache(ttl, clock)
+        # expiry bumps seq through the evict hook, whichever path drops
+        # the entry — the periodic cleanup() sweep or a lazy delete
+        # inside TTLCache.get/__contains__ (is_unavailable between
+        # expiry and the next sweep). Version-keyed consumers
+        # (masked_view_versioned's memo, the disruption controller's
+        # failed-search fingerprints) would otherwise keep a recovered
+        # offering off-market until an unrelated mark happened to bump.
+        self._cache = TTLCache(ttl, clock, on_evict=lambda _k, _v: self._bump())
         self._seq = 0
         self._lock = threading.Lock()
+
+    def _bump(self) -> None:
+        with self._lock:
+            self._seq += 1
 
     @staticmethod
     def _key(capacity_type: str, instance_type: str, zone: str) -> str:
@@ -69,12 +80,9 @@ class UnavailableOfferings:
         """Expire stale entries. Expiry CHANGES the offering set (capacity is
         back on the market), so it bumps seq_num like marking does —
         downstream fingerprints (e.g. the disruption controller's failed-
-        search cache) must invalidate when offerings return."""
-        n = self._cache.cleanup()
-        if n:
-            with self._lock:
-                self._seq += 1
-        return n
+        search cache) must invalidate when offerings return. The bump
+        itself rides the evict hook (see __init__), once per entry."""
+        return self._cache.cleanup()
 
     def entries(self) -> Iterable[Offering]:
         for key, _ in self._cache.items():

@@ -41,7 +41,7 @@ from ..cache.unavailable import UnavailableOfferings
 from ..cloudprovider.cloudprovider import CloudProvider
 from ..errors import UnfulfillableCapacityError
 from ..events import Recorder
-from ..lattice.tensors import masked_view
+from ..lattice.tensors import masked_view_versioned
 from ..metrics import Registry, wire_core_metrics
 from ..solver.solve import NodePlan, ProbeResult, Solver
 from ..state.cluster import ClusterState
@@ -280,8 +280,8 @@ class DisruptionController:
         re-placing none of its pods would over-credit the savings and
         admit unprofitable disruptions."""
         self._whatif_used += 1
-        lattice = masked_view(self.solver.lattice,
-                              self.unavailable.mask(self.solver.lattice))
+        lattice = masked_view_versioned(self.solver.lattice,
+                                        self.unavailable)
         node_by_claim = self.cluster.nodes_by_claim()
         by_node = self.cluster.pods_by_node(include_daemonsets=False)
         live = [c for c in removed if c.name in node_by_claim]
@@ -314,8 +314,8 @@ class DisruptionController:
         from ..apis.objects import relax_pod, relaxation_depth
         from ..solver.problem import build_problem
 
-        lattice = masked_view(self.solver.lattice,
-                              self.unavailable.mask(self.solver.lattice))
+        lattice = masked_view_versioned(self.solver.lattice,
+                                        self.unavailable)
         all_bins = self.cluster.existing_bins(lattice)
         bound_all = self.cluster.bound_pods()
         pvcs, storage_classes = self.cluster.volume_state()

@@ -27,7 +27,7 @@ from ..cache.unavailable import UnavailableOfferings
 from ..cloudprovider.cloudprovider import CloudProvider
 from ..errors import UnfulfillableCapacityError
 from ..events import Recorder
-from ..lattice.tensors import Lattice, masked_view
+from ..lattice.tensors import Lattice, masked_view_versioned
 from ..metrics import Registry, wire_core_metrics
 from ..solver.solve import NodePlan, PlannedNode, Solver
 from ..state.cluster import ClusterState
@@ -151,7 +151,10 @@ class Provisioner:
         pending = self.cluster.pending_pods()
         if not pending:
             return ProvisionResult(plan=None)
-        lattice = masked_view(self.solver.lattice, self.unavailable.mask(self.solver.lattice))
+        # versioned memo: the SAME view object comes back while prices and
+        # the ICE set are unchanged, so the solver's identity-keyed
+        # narrowing cache hits across steady-state passes
+        lattice = masked_view_versioned(self.solver.lattice, self.unavailable)
         pvcs, storage_classes = self.cluster.volume_state()
         # one usage snapshot serves the whole pass: the initial solve's
         # headroom, every _enforce_limits round, and every retry's headroom

@@ -197,6 +197,41 @@ def masked_view(lattice: Lattice, offering_mask: np.ndarray) -> Lattice:
     return replace(lattice, available=available, price=price)
 
 
+# masked_view memoized per BASE lattice on (price_version, ICE seq_num):
+# a steady controller pass re-solves against an unchanged price table and
+# ICE set, and minting a fresh view object every pass would defeat every
+# identity-keyed memo downstream (the solver's narrowing cache,
+# solver/problem.py _NARROW_CACHE). TTL-expired ICE entries re-enter the
+# offering set at the operator's 10 s cleanup tick, which bumps seq_num
+# (cache/unavailable.py cleanup; the reference frees offerings on the
+# same cadence, cache.go:39-42) — so the memoized view is never staler
+# than the reference's own cache. The memo slot is per (base, ICE cache)
+# PAIR — seq numbers are only comparable within one UnavailableOfferings
+# instance, and two operators may share one injected base lattice — and
+# both objects are held strongly: a dead one's id can never alias a
+# live key.
+_VIEW_MEMO: Dict[tuple, tuple] = {}  # (id(base), id(ice)) -> (base, ice, key, view)
+_VIEW_MEMO_MAX = 4
+
+
+def masked_view_versioned(lattice: Lattice, unavailable) -> Lattice:
+    """``masked_view(lattice, unavailable.mask(lattice))`` with the view
+    object REUSED while ``(lattice.price_version, unavailable.seq_num)``
+    is unchanged. ``unavailable`` is duck-typed (needs ``.mask(lattice)``
+    and ``.seq_num``): cache/unavailable.py's UnavailableOfferings."""
+    key = (lattice.price_version, unavailable.seq_num)
+    slot = (id(lattice), id(unavailable))
+    e = _VIEW_MEMO.get(slot)
+    if (e is not None and e[0] is lattice and e[1] is unavailable
+            and e[2] == key):
+        return e[3]
+    view = masked_view(lattice, unavailable.mask(lattice))
+    if len(_VIEW_MEMO) >= _VIEW_MEMO_MAX:
+        _VIEW_MEMO.clear()
+    _VIEW_MEMO[slot] = (lattice, unavailable, key, view)
+    return view
+
+
 def build_lattice(specs: Optional[Sequence[cat.InstanceTypeSpec]] = None,
                   kc: Optional[KubeletConfiguration] = None,
                   zones: Sequence[str] = cat.ZONES,

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass, field
-from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -250,6 +250,22 @@ _WAVE_PRICE_SLACK = 1.05
 # one 50k wave, and a per-group bound cannot see that.
 _WAVE_MAX_BINS = 1024
 
+# narrowing results memoized by CONTENT (every array input's bytes +
+# the scalar knobs) plus lattice identity: the numpy reductions in
+# _accel_bin_cap/_wave_bin_cap are ~0.5 ms per group, and a steady
+# controller rebuilds the same groups every batch. price/availability
+# moves invalidate via price_version in the key and the `is` check on
+# the stored lattice ref (pricing mutates price[...] in place but bumps
+# the version; ICE produces a NEW masked_view lattice object — holding
+# the ref strongly means a dead lattice's id can never alias a live
+# key). Two-level: at most _NARROW_LATS lattices are retained (an
+# ICE-churning controller mints a masked_view per cycle; an unbounded
+# flat map would pin every dead one), each with at most _NARROW_MAX
+# per-group entries. Guarded by build_problem's _INTERN_LOCK.
+_NARROW_MAX = 4096
+_NARROW_LATS = 4
+_NARROW_CACHE: Dict[int, tuple] = {}   # id(lat) -> (lattice, {key: mask|None})
+
 
 def _wave_bin_cap(vec: np.ndarray, count: int, type_mask: np.ndarray,
                   zone_mask: np.ndarray, cap_mask: np.ndarray,
@@ -324,15 +340,13 @@ def _wave_bin_cap(vec: np.ndarray, count: int, type_mask: np.ndarray,
     if not priced.any():
         return None
     # density floor (see _WAVE_MAX_BINS): candidates must carry the
-    # batch-wide density that keeps the whole plan bounded. Clamped by
-    # max_per_bin — a hostname-spread wave's bin count is fixed by the
-    # spread, so excluding cheap small types there saves zero bins —
-    # and relaxed to the densest PRICED candidate when nothing meets it
-    # (a t-family-only pool offers only small types; FFD would use them
-    # too, and the gain gate still decides).
+    # batch-wide density that keeps the whole plan bounded — relaxed to
+    # the densest PRICED candidate when nothing meets it (a t-family-only
+    # pool offers only small types; FFD would use them too, and the gain
+    # gate still decides). A hostname-spread wave needs no extra clamp:
+    # K was already capped to max_per_bin above, so the densest-candidate
+    # relaxation can never demand more than the spread's per-bin cap.
     floor = max(total_pending, count) / _WAVE_MAX_BINS
-    if max_per_bin:
-        floor = min(floor, max_per_bin)
     floor = min(floor, float(K[priced].max()))
     meets_floor = (K >= floor) & priced
     idx, K, pmin = idx[meets_floor], K[meets_floor], pmin[meets_floor]
@@ -511,29 +525,31 @@ def _selector_keys(pods: Sequence[Pod], bound_pods: Sequence[BoundPod]) -> froze
     (controller-stamped fixtures) or per-pod unique (anything parsed from
     the API server is its own object)."""
     keys: set = set()
+    upd = keys.update
 
-    def collect(p: Pod) -> None:
-        cached = p.__dict__.get("_kpat_selkeys")
-        if cached is None:
-            mine: set = set()
-            for term in p.pod_affinity:
-                mine.update(k for k, _ in term.label_selector)
-            for c in p.topology_spread:
-                mine.update(k for k, _ in c.label_selector)
-            cached = frozenset(mine)
-            p.__dict__["_kpat_selkeys"] = cached
-        keys.update(cached)
+    def fill(p: Pod) -> frozenset:
+        mine: set = set()
+        for term in p.pod_affinity:
+            mine.update(k for k, _ in term.label_selector)
+        for c in p.topology_spread:
+            mine.update(k for k, _ in c.label_selector)
+        out = frozenset(mine)
+        p.__dict__["_kpat_selkeys"] = out
+        return out
 
-    # the emptiness check lives IN the loop, not in collect: most pods
-    # carry no selectors at all, and 50k no-op FUNCTION CALLS alone cost
-    # ~12 ms of the build budget — two inline attribute loads don't
+    # the emptiness check and the cache hit live INLINE in the loop:
+    # most pods carry no selectors at all, and 50k no-op FUNCTION CALLS
+    # alone cost ~12 ms of the build budget — two attribute loads don't;
+    # fill() only runs on a selector-carrying pod's first sighting
     for p in pods:
         if p.pod_affinity or p.topology_spread:
-            collect(p)
+            cached = p.__dict__.get("_kpat_selkeys")
+            upd(cached if cached is not None else fill(p))
     for bp in bound_pods:
         p = bp.pod
         if p.pod_affinity or p.topology_spread:
-            collect(p)
+            cached = p.__dict__.get("_kpat_selkeys")
+            upd(cached if cached is not None else fill(p))
     return frozenset(keys)
 
 
@@ -660,21 +676,30 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
     coarse: Dict[tuple, tuple] = {}   # identity key -> (rep pod, names or None)
     lab_rel = bool(relevant_keys)
     _SIG = "_kpat_sig"
+    # bound `names.append` per live sid: the steady-state per-pod cost is
+    # one dict get on the pod + one pointer compare + one dict get here +
+    # one call — no tuple index or method-attribute lookup per pod (at
+    # 50k pods those two extra ops alone are ~10 ms of the build budget)
+    appenders: Dict[int, Any] = {}
+    ap_get = appenders.get
+    bad_get = _BAD_SIDS.get
     for pod in pods:
         cache = pod.__dict__.get(_SIG)
         if cache is not None and cache[0] is relevant_keys:
             sid = cache[1]
-            entry = raw_groups.get(sid)
-            if entry is not None:
-                entry[1].append(pod.name)
+            ap = ap_get(sid)
+            if ap is not None:
+                ap(pod.name)
                 continue
-            reason = _BAD_SIDS.get(sid)
+            reason = bad_get(sid)
             if reason is not None:
                 unschedulable[pod.name] = reason
                 for c in pod.volume_claims:
                     bad_claims[c] = bad_claims.get(c, 0) + 1
                 continue
-            raw_groups[sid] = (pod, [pod.name])
+            names = [pod.name]
+            raw_groups[sid] = (pod, names)
+            appenders[sid] = names.append
             order.append(sid)
             continue
         ck = (id(pod.requests) if pod.requests else 0,
@@ -728,6 +753,7 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
             continue
         names = [pod.name]
         raw_groups[sid] = (pod, names)
+        appenders[sid] = names.append
         order.append(sid)
         if hit is None:
             coarse[ck] = (pod, names)
@@ -1054,7 +1080,8 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
                 # feasibility gate below still holds the pre-narrowing
                 # mask as a fallback for per-pool interactions the union
                 # can't capture.
-                if np_ok_s.any():
+                any_pool = bool(np_ok_s.any())
+                if any_pool:
                     pool_tmask = np_type[np_ok_s].any(axis=0)
                     pool_zmask = np_zone[np_ok_s].any(axis=0)
                     pool_cmask = np_cap[np_ok_s].any(axis=0)
@@ -1062,22 +1089,44 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
                     pool_tmask = np.zeros(T, dtype=bool)
                     pool_zmask = np.zeros(Z, dtype=bool)
                     pool_cmask = np.zeros(C, dtype=bool)
-                a_mask = _accel_bin_cap(
-                    vec, masks.type_mask, s.zone_mask & pool_zmask,
-                    s.cap_mask & pool_cmask, pool_tmask, existing_tmask,
-                    lattice)
-                if a_mask is None and np_ok_s.any():
-                    # pods-axis-bound wave narrowing (generic groups
-                    # only — accel groups are _accel_bin_cap's); rank
-                    # with the heaviest compatible pool's daemonset
-                    # overhead so small types are never over-favored
-                    a_mask = _wave_bin_cap(
-                        vec, len(sub_names), masks.type_mask,
-                        s.zone_mask & pool_zmask, s.cap_mask & pool_cmask,
-                        pool_tmask, existing_tmask,
-                        ds_overhead[np_ok_s].max(axis=0), lattice,
-                        max_per_bin=topo.max_per_bin,
-                        total_pending=len(pods))
+                zm = s.zone_mask & pool_zmask
+                cm = s.cap_mask & pool_cmask
+                # heaviest compatible pool's daemonset overhead: ranking
+                # with it keeps small types from being over-favored
+                ds_max = (ds_overhead[np_ok_s].max(axis=0)
+                          if any_pool else None)
+                nkey = (lattice.price_version, vec.tobytes(),
+                        masks.type_mask.tobytes(), zm.tobytes(),
+                        cm.tobytes(), pool_tmask.tobytes(),
+                        existing_tmask.tobytes(),
+                        ds_max.tobytes() if ds_max is not None else b"",
+                        len(sub_names), topo.max_per_bin, len(pods))
+                slot = _NARROW_CACHE.get(id(lattice))
+                if slot is not None and slot[0] is not lattice:
+                    slot = None                     # id reuse: stale slot
+                if slot is not None and nkey in slot[1]:
+                    a_mask = slot[1][nkey]
+                else:
+                    a_mask = _accel_bin_cap(
+                        vec, masks.type_mask, zm, cm, pool_tmask,
+                        existing_tmask, lattice)
+                    if a_mask is None and any_pool:
+                        # pods-axis-bound wave narrowing (generic groups
+                        # only — accel groups are _accel_bin_cap's)
+                        a_mask = _wave_bin_cap(
+                            vec, len(sub_names), masks.type_mask,
+                            zm, cm, pool_tmask, existing_tmask,
+                            ds_max, lattice,
+                            max_per_bin=topo.max_per_bin,
+                            total_pending=len(pods))
+                    if slot is None:
+                        if len(_NARROW_CACHE) >= _NARROW_LATS:
+                            _NARROW_CACHE.clear()
+                        slot = (lattice, {})
+                        _NARROW_CACHE[id(lattice)] = slot
+                    if len(slot[1]) >= _NARROW_MAX:
+                        slot[1].clear()
+                    slot[1][nkey] = a_mask
                 if a_mask is not None and a_mask.any():
                     unnarrowed = masks.type_mask
                     g_tmask = a_mask

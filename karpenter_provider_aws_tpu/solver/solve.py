@@ -49,10 +49,13 @@ class PlannedNode:
     # bin's contents, cheapest-first, capped at MAX_FLEXIBLE_TYPES): the
     # launch path hands these to the cloud as CreateFleet overrides so an
     # ICE on the chosen offering falls through to the next-cheapest without
-    # a re-solve (reference instance.go MaxInstanceTypes=60)
-    feasible_types: List[str] = field(default_factory=list)
-    feasible_zones: List[str] = field(default_factory=list)
-    feasible_capacity_types: List[str] = field(default_factory=list)
+    # a re-solve (reference instance.go MaxInstanceTypes=60).
+    # Sequence, not List: _feasible_sets_batch shares ONE immutable tuple
+    # across same-pattern bins — consumers may REASSIGN the field
+    # (provisioning.py relaxation) but must never mutate it in place
+    feasible_types: Sequence[str] = field(default_factory=tuple)
+    feasible_zones: Sequence[str] = field(default_factory=tuple)
+    feasible_capacity_types: Sequence[str] = field(default_factory=tuple)
     # custom labels a virtual-pool bin pins on its node (the Exists-
     # operator workload segregation, solver/problem.py expansion);
     # node_pool is always the REAL pool name

@@ -125,6 +125,70 @@ class TestLatticeTensors:
         assert lattice.labels[i][wk.LABEL_INSTANCE_GPU_NAME] == "a100"
 
 
+class TestMaskedViewVersioned:
+    """masked_view_versioned must hand back the SAME view object while
+    (price_version, ICE seq_num) is unchanged — the solver's
+    identity-keyed narrowing cache only hits across controller passes if
+    the view survives — and mint a fresh one the moment either moves."""
+
+    def test_reuse_and_invalidation(self, lattice):
+        from karpenter_provider_aws_tpu.cache.unavailable import UnavailableOfferings
+        from karpenter_provider_aws_tpu.lattice.tensors import masked_view_versioned
+        from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        u = UnavailableOfferings(clock)
+        v1 = masked_view_versioned(lattice, u)
+        assert masked_view_versioned(lattice, u) is v1
+
+        t, z = lattice.names[0], lattice.zones[0]
+        u.mark_unavailable("ice", "on-demand", t, z)
+        v2 = masked_view_versioned(lattice, u)
+        assert v2 is not v1
+        ti = lattice.name_to_idx[t]
+        ci = lattice.capacity_types.index("on-demand")
+        assert not v2.available[ti, 0, ci]
+        assert masked_view_versioned(lattice, u) is v2
+
+        # TTL expiry re-enters the market at the cleanup tick (seq bump)
+        clock.step(10_000.0)
+        u.cleanup()
+        v3 = masked_view_versioned(lattice, u)
+        assert v3 is not v2
+        assert bool(v3.available[ti, 0, ci]) == bool(lattice.available[ti, 0, ci])
+
+        lattice.price_version += 1
+        try:
+            assert masked_view_versioned(lattice, u) is not v3
+        finally:
+            lattice.price_version -= 1
+
+    def test_two_ice_caches_sharing_one_base_never_alias(self, lattice):
+        """Two operators over one injected base lattice each own an
+        UnavailableOfferings instance; seq numbers are only comparable
+        WITHIN an instance, so equal (price_version, seq) pairs from
+        different caches must not serve each other's views."""
+        from karpenter_provider_aws_tpu.cache.unavailable import UnavailableOfferings
+        from karpenter_provider_aws_tpu.lattice.tensors import masked_view_versioned
+        from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        a, b = UnavailableOfferings(clock), UnavailableOfferings(clock)
+        ta, tb = lattice.names[0], lattice.names[1]
+        z = lattice.zones[0]
+        a.mark_unavailable("ice", "on-demand", ta, z)   # a.seq == 1
+        b.mark_unavailable("ice", "on-demand", tb, z)   # b.seq == 1
+        va = masked_view_versioned(lattice, a)
+        vb = masked_view_versioned(lattice, b)
+        assert va is not vb
+        ia, ib = lattice.name_to_idx[ta], lattice.name_to_idx[tb]
+        ci = lattice.capacity_types.index("on-demand")
+        assert not va.available[ia, 0, ci]
+        assert bool(va.available[ib, 0, ci]) == bool(lattice.available[ib, 0, ci])
+        assert not vb.available[ib, 0, ci]
+        assert bool(vb.available[ia, 0, ci]) == bool(lattice.available[ia, 0, ci])
+
+
 class TestMaskCompiler:
     def _names(self, lattice, mask):
         return {lattice.names[i] for i in np.nonzero(mask)[0]}

@@ -945,17 +945,16 @@ class TestAccelBinSplitting:
 
     def test_beats_uncapped_ffd_on_mixed_wave(self):
         """The capped pack must cost LESS than the same pods packed
-        without the cap (the reference's FFD behavior)."""
-        from karpenter_provider_aws_tpu.solver import problem as pm
+        without the cap (the reference's FFD behavior). The uncapped
+        referee uses the first-class ``narrow=False`` path (exactly what
+        bench cfg6 referees against) — monkeypatching the narrowing
+        internals would be defeated by the content-keyed narrowing
+        cache, which legitimately serves the memoized mask."""
         lattice, pods = self._mixed_problem()
         s = Solver(lattice)
         capped = s.solve(build_problem(pods, [default_pool()], lattice))
-        orig = pm._accel_bin_cap
-        pm._accel_bin_cap = lambda *a, **k: None
-        try:
-            uncapped = s.solve(build_problem(pods, [default_pool()], lattice))
-        finally:
-            pm._accel_bin_cap = orig
+        uncapped = s.solve(build_problem(pods, [default_pool()], lattice,
+                                         narrow=False))
         assert not capped.unschedulable and not uncapped.unschedulable
         assert capped.new_node_cost < uncapped.new_node_cost * 0.9, \
             (capped.new_node_cost, uncapped.new_node_cost)
@@ -964,6 +963,39 @@ class TestAccelBinSplitting:
                     if any(p.startswith("g") for p in n.pods)]
         assert all(n.instance_type.startswith("g5.xlarge")
                    for n in gpu_bins), [n.instance_type for n in gpu_bins]
+
+    def test_narrowing_cache_invalidates_on_price_version(self):
+        """The content-keyed narrowing cache must serve identical masks
+        for identical inputs, and recompute when in-place price edits
+        bump ``price_version`` (pricing.py:133-134 mutates price[...]
+        and bumps the version under the provider lock)."""
+        import numpy as np
+        lattice, pods = self._mixed_problem()
+        pool = [default_pool()]
+        p1 = build_problem(pods, pool, lattice)
+        p1b = build_problem(pods, pool, lattice)
+
+        def gpu_group(problem):
+            for g in problem.groups:
+                if any(n.startswith("g") for n in g.pod_names):
+                    return g
+            raise AssertionError("no gpu group")
+
+        g1, g1b = gpu_group(p1), gpu_group(p1b)
+        assert np.array_equal(g1.type_mask, g1b.type_mask)
+        xl = lattice.name_to_idx["g5.xlarge"]
+        assert g1.type_mask[xl]          # per-unit optimum pre-edit
+        # 50x the per-unit winner's price; the cache must NOT serve the
+        # stale mask once the version moves
+        lattice.price[xl, :, :] *= 50.0
+        lattice.price_version += 1
+        try:
+            g2 = gpu_group(build_problem(pods, pool, lattice))
+            assert not g2.type_mask[xl], \
+                "stale narrowing mask served after price_version bump"
+        finally:
+            lattice.price[xl, :, :] /= 50.0
+            lattice.price_version += 1
 
     def test_no_cap_when_big_type_is_per_unit_cheapest(self):
         """When the multi-GPU type IS the per-unit optimum (e.g. 4-GPU

@@ -42,6 +42,12 @@ from ..state.cluster import ClusterState
 from ..utils.clock import Clock, FakeClock
 from .options import Options
 
+# ICE cleanup cadence: expired offerings re-enter the market at this
+# tick (the reference sweeps its unavailable-offerings cache on the
+# same interval, cache.go:39-42). docs/concepts/performance.md cites
+# this as the staleness bound of the versioned masked-view memo.
+ICE_CLEANUP_INTERVAL = 10.0
+
 
 class Operator:
     def __init__(self, options: Optional[Options] = None,
@@ -291,7 +297,7 @@ class Operator:
         self.sync_once()
         self.emit_gauges()
         now = self.clock.now()
-        if now - self._last_cache_cleanup >= 10.0:  # ICE cleanup cadence (cache.go:39-42)
+        if now - self._last_cache_cleanup >= ICE_CLEANUP_INTERVAL:
             self.unavailable.cleanup()
             self._last_cache_cleanup = now
 

@@ -22,6 +22,15 @@ def _lines(name):
     return (DOCS / name).read_text().splitlines()
 
 
+def _assert_cited_metrics_exist(doc_name):
+    """Every karpenter_* metric a doc names must exist in the registry
+    source."""
+    src = (DOCS.parent.parent / "karpenter_provider_aws_tpu" /
+           "metrics.py").read_text()
+    for m in re.findall(r"karpenter_[a-z_]+", _read(doc_name)):
+        assert m in src, m
+
+
 class TestSchedulingDocFacts:
     def test_spec_depth(self):
         assert len(_lines("scheduling.md")) >= 250
@@ -127,10 +136,57 @@ class TestDisruptionDocFacts:
         assert i >= 0 and "drift → emptiness → consolidation" in doc
 
     def test_cited_metric_names_exist(self):
-        """Every karpenter_* metric the doc names must exist in the
-        registry source."""
-        import pathlib
-        src = (pathlib.Path(__file__).resolve().parent.parent /
-               "karpenter_provider_aws_tpu" / "metrics.py").read_text()
-        for m in re.findall(r"karpenter_[a-z_]+", _read("disruption.md")):
-            assert m in src, m
+        _assert_cited_metrics_exist("disruption.md")
+
+
+class TestPerformanceDocFacts:
+    """docs/concepts/performance.md pins the solve path's latency
+    machinery — its budgets, buckets, TTLs, and memo invalidation
+    story — to the constants that implement them."""
+
+    def test_algo_budget_matches_bench(self):
+        import bench
+        assert f"**{bench.CFG5_ALGO_BUDGET_MS:.0f} ms** budget" in _read(
+            "performance.md")
+
+    def test_bucket_tables_match(self):
+        from karpenter_provider_aws_tpu.solver.solve import (_B_BUCKETS,
+                                                             _G_BUCKETS)
+        doc = _read("performance.md")
+        assert "G ∈ {" + ", ".join(str(g) for g in _G_BUCKETS) + "}" in doc
+        assert "B ∈ {" + ", ".join(str(b) for b in _B_BUCKETS) + "}" in doc
+
+    def test_ice_ttl_and_cleanup_cadence(self):
+        from karpenter_provider_aws_tpu.cache.unavailable import (
+            UNAVAILABLE_OFFERINGS_TTL,
+        )
+        from karpenter_provider_aws_tpu.operator.operator import (
+            ICE_CLEANUP_INTERVAL,
+        )
+        doc = _read("performance.md")
+        assert f"**{UNAVAILABLE_OFFERINGS_TTL:.0f} s**" in doc
+        assert f"**{ICE_CLEANUP_INTERVAL:.0f} s** cleanup tick" in doc
+
+    def test_density_floor_matches(self):
+        from karpenter_provider_aws_tpu.solver.problem import _WAVE_MAX_BINS
+        assert f"at most **{_WAVE_MAX_BINS}** bins" in _read("performance.md")
+
+    def test_narrow_cache_bounds_match(self):
+        from karpenter_provider_aws_tpu.solver.problem import (_NARROW_LATS,
+                                                               _NARROW_MAX)
+        assert (f"at most {_NARROW_LATS} lattices × {_NARROW_MAX} entries"
+                in _read("performance.md"))
+
+    def test_cited_symbols_exist(self):
+        """Every code symbol the doc cites must exist where it says."""
+        from karpenter_provider_aws_tpu.lattice.tensors import (
+            masked_view_versioned,
+        )
+        from karpenter_provider_aws_tpu.solver.problem import _NARROW_CACHE
+        from karpenter_provider_aws_tpu.solver.solve import Solver
+        assert callable(masked_view_versioned)
+        assert isinstance(_NARROW_CACHE, dict)
+        assert hasattr(Solver, "start_profiling")
+
+    def test_cited_metric_names_exist(self):
+        _assert_cited_metrics_exist("performance.md")

@@ -1212,3 +1212,71 @@ class TestAccelBinSplitting:
             assert n.zone == "us-west-2a"
             ti = view.name_to_idx[n.instance_type]
             assert view.capacity[ti, 4] > 1
+
+
+class TestMetamorphicInvariances:
+    """Transformations with provable effects on the optimum: the solve
+    must track them exactly. These pin the decode/caching layers as hard
+    as the fuzz envelopes pin the kernel (SURVEY §4: solver tested by
+    property/metamorphic checks vs the FFD oracle)."""
+
+    def _mixed_pods(self):
+        pods = generic_pods(60)
+        pods += generic_pods(10, cpu="2", mem="8Gi", prefix="big")
+        pods += [Pod(name=f"g{i}", requests={"cpu": "2", "nvidia.com/gpu": 1})
+                 for i in range(3)]
+        return pods
+
+    def test_plan_idempotence(self, solver, lattice):
+        """Identical inputs → identical plans, field for field (the
+        memo layers must be exact, not approximate)."""
+        pods = self._mixed_pods()
+        pools = [default_pool()]
+        p1 = solver.solve(build_problem(pods, pools, lattice))
+        p2 = solver.solve(build_problem(pods, pools, lattice))
+        assert p1.new_node_cost == p2.new_node_cost
+        assert len(p1.new_nodes) == len(p2.new_nodes)
+        for a, b in zip(p1.new_nodes, p2.new_nodes):
+            assert (a.instance_type, a.zone, a.capacity_type,
+                    sorted(a.pods)) == \
+                   (b.instance_type, b.zone, b.capacity_type,
+                    sorted(b.pods))
+        assert p1.unschedulable == p2.unschedulable
+
+    def test_price_scaling_covariance(self, lattice):
+        """Scaling every price by k changes no argmin: the same nodes
+        come back and the cost scales by exactly k."""
+        from dataclasses import replace
+        pods = self._mixed_pods()
+        pools = [default_pool()]
+        base = Solver(lattice).solve(build_problem(pods, pools, lattice))
+        k = 3.0
+        scaled_lat = replace(lattice, price=lattice.price * k)
+        scaled = Solver(scaled_lat).solve(
+            build_problem(pods, pools, scaled_lat))
+        assert sorted((n.instance_type, n.zone, n.capacity_type)
+                      for n in scaled.new_nodes) == \
+               sorted((n.instance_type, n.zone, n.capacity_type)
+                      for n in base.new_nodes)
+        assert scaled.new_node_cost == pytest.approx(
+            base.new_node_cost * k, rel=1e-5)
+
+    def test_irrelevant_pool_invariance(self, solver, lattice):
+        """A pool that can launch nothing must not change the plan at
+        all. The impossible demand must be a WELL-KNOWN key: a pool
+        requirement on a custom key OFFERS that label to pods (workload
+        segregation, tests/test_custom_labels.py) — it would admit
+        every pod rather than none."""
+        pods = self._mixed_pods()
+        base = solver.solve(build_problem(pods, [default_pool()], lattice))
+        noise = NodePool(name="zzz-unmatchable", requirements=[
+            Requirement(wk.LABEL_INSTANCE_TYPE, Operator.IN,
+                        ("no-such-type",))])
+        with_noise = solver.solve(
+            build_problem(pods, [default_pool(), noise], lattice))
+        assert with_noise.new_node_cost == base.new_node_cost
+        assert sorted((n.instance_type, n.zone, n.capacity_type,
+                       len(n.pods)) for n in with_noise.new_nodes) == \
+               sorted((n.instance_type, n.zone, n.capacity_type,
+                       len(n.pods)) for n in base.new_nodes)
+        assert with_noise.unschedulable == base.unschedulable

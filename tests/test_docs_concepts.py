@@ -190,3 +190,65 @@ class TestPerformanceDocFacts:
 
     def test_cited_metric_names_exist(self):
         _assert_cited_metrics_exist("performance.md")
+
+
+class TestGettingStartedDocFacts:
+    """docs/getting-started.md promises that every command it shows is
+    the surface the cross-process e2e drives — so each cited flag,
+    subcommand, route, and schema string must exist in the code."""
+
+    def _doc(self):
+        return _read("../getting-started.md")
+
+    def test_cited_cli_flags_exist(self):
+        import karpenter_provider_aws_tpu.cli as cli
+        src = pathlib.Path(cli.__file__).read_text()
+        doc = self._doc()
+        for flag in ("--api-port", "--interruption-queue", "--metrics-port",
+                     "--api-insecure", "--cluster-name", "--log-level",
+                     "--api-tls-cert", "--api-tls-key", "--api-token-file"):
+            assert flag in doc
+            assert flag in src, flag
+
+    def test_cert_paths_match_gen_certs(self):
+        """The doc's TLS/token paths are what deploy/gen_certs.sh
+        actually writes."""
+        sh = (DOCS.parent.parent / "deploy" / "gen_certs.sh").read_text()
+        doc = self._doc()
+        for p in ("deploy/certs/tls.crt", "deploy/certs/tls.key",
+                  "deploy/certs/token"):
+            assert p in doc, p
+            assert p.rsplit("/", 1)[-1] in sh, p
+
+    def test_cited_kpctl_subcommands_exist(self):
+        tools = DOCS.parent.parent / "tools" / "kpctl.py"
+        src = tools.read_text()
+        for sub in ("get", "apply", "delete", "watch"):
+            assert f'"{sub}"' in src or f"'{sub}'" in src, sub
+        assert "--token-file" in src and "--token-file" in self._doc()
+
+    def test_queue_wire_route_exists(self):
+        from karpenter_provider_aws_tpu.kube import httpserver
+        src = pathlib.Path(httpserver.__file__).read_text()
+        assert "/queue/messages" in self._doc()
+        assert "/queue/messages" in src
+
+    def test_interruption_schema_string_matches(self):
+        from karpenter_provider_aws_tpu.interruption import messages
+        src = pathlib.Path(messages.__file__).read_text()
+        assert "EC2 Spot Instance Interruption Warning" in self._doc()
+        assert "EC2 Spot Instance Interruption Warning" in src
+
+    def test_batch_window_defaults_match(self):
+        from karpenter_provider_aws_tpu.operator.options import Options
+        o = Options()
+        assert (f"default {o.batch_idle_duration:.0f} s idle / "
+                f"{o.batch_max_duration:.0f} s max") in self._doc()
+
+    def test_cited_kinds_are_real(self):
+        from karpenter_provider_aws_tpu.kube.apiserver import KINDS
+        doc = self._doc()
+        for kind in ("nodepools", "pods", "nodeclaims"):
+            # word-boundary: 'pods' must not ride along inside 'nodepools'
+            assert re.search(rf"\b{kind}\b", doc), kind
+            assert kind in KINDS, kind

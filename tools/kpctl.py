@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import ssl
 import sys
 import urllib.error
@@ -213,7 +214,6 @@ def cmd_evict(c: Client, args) -> int:
 
 
 def main(argv=None) -> int:
-    import os
     p = argparse.ArgumentParser(prog="kpctl", description=__doc__)
     p.add_argument("--server", default=os.environ.get("KPCTL_SERVER"),
                    help="API base URL, e.g. https://127.0.0.1:8443 "
@@ -265,7 +265,13 @@ def main(argv=None) -> int:
     c = Client(args.server, token=token, cacert=args.cacert,
                insecure=args.insecure_skip_tls_verify)
     try:
-        return args.fn(c, args)
+        rc = args.fn(c, args)
+        # flush INSIDE the try: for outputs under the pipe buffer the
+        # EPIPE only fires at flush time, and an interpreter-shutdown
+        # flush would bypass the handler below ("Exception ignored"
+        # noise, exit 120)
+        sys.stdout.flush()
+        return rc
     except urllib.error.HTTPError as err:
         try:
             doc = json.loads(err.read())
@@ -274,6 +280,13 @@ def main(argv=None) -> int:
             msg = ""
         print(f"error: {err.code} {msg}", file=sys.stderr)
         return 1
+    except BrokenPipeError:
+        # downstream closed early (`kpctl get -o json | head`): exit
+        # quietly like kubectl, with the conventional 128+SIGPIPE code.
+        # stdout is already broken — devnull it so interpreter shutdown
+        # doesn't print a second traceback flushing the dead buffer
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 141
 
 
 if __name__ == "__main__":

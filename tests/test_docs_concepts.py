@@ -192,6 +192,41 @@ class TestPerformanceDocFacts:
         _assert_cited_metrics_exist("performance.md")
 
 
+class TestInterruptionDocFacts:
+    """docs/concepts/interruption.md pins the queue semantics, schema
+    strings, fanout width, and metric names to the implementation."""
+
+    def test_spec_depth(self):
+        assert len(_lines("interruption.md")) >= 90
+
+    def test_schema_strings_match(self):
+        import pathlib as _p
+        from karpenter_provider_aws_tpu.interruption import messages
+        src = _p.Path(messages.__file__).read_text()
+        doc = _read("interruption.md")
+        for dt in ("EC2 Spot Instance Interruption Warning",
+                   "EC2 Instance Rebalance Recommendation",
+                   "AWS Health Event",
+                   "EC2 Instance State-change Notification"):
+            assert dt in doc, dt
+            assert dt in src, dt
+
+    def test_queue_constants_match(self):
+        from karpenter_provider_aws_tpu.interruption.controller import (
+            InterruptionController,
+        )
+        from karpenter_provider_aws_tpu.interruption.queue import (
+            WAIT_TIME_SECONDS,
+        )
+        doc = _read("interruption.md")
+        assert f"`WAIT_TIME_SECONDS = {WAIT_TIME_SECONDS}`" in doc
+        assert (f"`MESSAGE_WORKERS = "
+                f"{InterruptionController.MESSAGE_WORKERS}` wide") in doc
+
+    def test_cited_metric_names_exist(self):
+        _assert_cited_metrics_exist("interruption.md")
+
+
 class TestGettingStartedDocFacts:
     """docs/getting-started.md promises that every command it shows is
     the surface the cross-process e2e drives — so each cited flag,

@@ -355,31 +355,38 @@ class TestReviewRegressions:
         assert "127.0.0.1" in base
 
 
+def _kpctl_get_json_with_early_close(server, base, n_pods, prefix):
+    """Seed n_pods, run `kpctl get pods -o json` as a subprocess, close
+    its stdout after one byte (the `| head -c1` shape), and return
+    (returncode, stderr)."""
+    import pathlib
+    import subprocess
+    import sys as _sys
+    for i in range(n_pods):
+        server.create("pods", serde.pod_to_dict(Pod(
+            name=f"{prefix}{i}", requests={"cpu": "1", "memory": "1Gi"})))
+    kpctl = (pathlib.Path(__file__).resolve().parent.parent /
+             "tools" / "kpctl.py")
+    proc = subprocess.Popen(
+        [_sys.executable, str(kpctl), "--server", base,
+         "get", "pods", "-o", "json"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    proc.stdout.read(1)
+    proc.stdout.close()              # reader goes away mid-stream
+    rc = proc.wait(timeout=30)
+    err = proc.stderr.read().decode()
+    proc.stderr.close()
+    return rc, err
+
+
 class TestKpctlPipeHygiene:
     def test_epipe_exits_quietly(self, api):
         """`kpctl get -o json | head -c1` closes kpctl's stdout early;
         the CLI must exit with 128+SIGPIPE like kubectl, not dump a
-        BrokenPipeError traceback."""
-        import pathlib
-        import subprocess
-        import sys as _sys
+        BrokenPipeError traceback. 400 pods ≈ 160 KB of JSON overruns
+        the 64 KB pipe buffer, so the EPIPE reliably fires mid-write."""
         server, base = api
-        # enough JSON (~160 KB) to overrun the 64 KB pipe buffer, so the
-        # writer reliably takes the EPIPE after the reader closes
-        for i in range(400):
-            server.create("pods", serde.pod_to_dict(Pod(
-                name=f"pp{i}", requests={"cpu": "1", "memory": "1Gi"})))
-        kpctl = (pathlib.Path(__file__).resolve().parent.parent /
-                 "tools" / "kpctl.py")
-        proc = subprocess.Popen(
-            [_sys.executable, str(kpctl), "--server", base,
-             "get", "pods", "-o", "json"],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
-        proc.stdout.read(1)
-        proc.stdout.close()          # reader goes away mid-stream
-        rc = proc.wait(timeout=30)
-        err = proc.stderr.read().decode()
-        proc.stderr.close()
+        rc, err = _kpctl_get_json_with_early_close(server, base, 400, "pp")
         assert rc == 141, (rc, err)
         assert "Traceback" not in err, err
 
@@ -388,24 +395,8 @@ class TestKpctlPipeHygiene:
         time, not mid-write; without an in-try flush that lands at
         interpreter shutdown as 'Exception ignored' noise with exit
         code 120."""
-        import pathlib
-        import subprocess
-        import sys as _sys
         server, base = api
-        for i in range(25):     # ~10 KB of JSON: fits the pipe buffer
-            server.create("pods", serde.pod_to_dict(Pod(
-                name=f"fp{i}", requests={"cpu": "1", "memory": "1Gi"})))
-        kpctl = (pathlib.Path(__file__).resolve().parent.parent /
-                 "tools" / "kpctl.py")
-        proc = subprocess.Popen(
-            [_sys.executable, str(kpctl), "--server", base,
-             "get", "pods", "-o", "json"],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
-        proc.stdout.read(1)
-        proc.stdout.close()
-        rc = proc.wait(timeout=30)
-        err = proc.stderr.read().decode()
-        proc.stderr.close()
+        rc, err = _kpctl_get_json_with_early_close(server, base, 25, "fp")
         assert rc in (0, 141), (rc, err)   # raced: may finish clean
         assert "Exception ignored" not in err, err
         assert "Traceback" not in err, err

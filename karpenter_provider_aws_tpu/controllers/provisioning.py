@@ -42,12 +42,15 @@ _PODS_AXIS = res_axis("pods")
 # RE-STAMPED instead of drift-compared, so a controller upgrade never
 # rolls the whole fleet (the reference migrates its hash the same way —
 # wellknown ANNOTATION_NODEPOOL_HASH_VERSION).
-NODEPOOL_HASH_VERSION = "v3"  # v3: + kubelet clusterDNS
+NODEPOOL_HASH_VERSION = "v4"  # v4: + startupTaints
 
 
 def nodepool_hash(pool: NodePool) -> str:
     """Template hash for NodePool drift detection (the core's
-    karpenter.sh/nodepool-hash annotation; CRD nodepools drift semantics)."""
+    karpenter.sh/nodepool-hash annotation; CRD nodepools drift semantics).
+    Every field stamped onto launched nodes participates; fields that
+    only steer the SOLVE (weight, limits, the disruption block) stay
+    out — retuning them must never roll the fleet."""
     import hashlib
     import json
     payload = json.dumps({
@@ -58,6 +61,10 @@ def nodepool_hash(pool: NodePool) -> str:
         "kubelet": ((pool.kubelet.max_pods, pool.kubelet.cluster_dns)
                     if pool.kubelet is not None else None),
         "taints": [(t.key, t.value, t.effect) for t in pool.taints],
+        # startupTaints shape the node exactly like taints do (the init
+        # daemon contract changes with them); the reference hashes them
+        "startup_taints": [(t.key, t.value, t.effect)
+                           for t in pool.startup_taints],
         "requirements": [(r.key, r.operator.value, r.values) for r in pool.requirements],
         "node_class_ref": pool.node_class_ref,
     }, sort_keys=True, default=str)

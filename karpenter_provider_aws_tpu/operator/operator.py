@@ -199,9 +199,23 @@ class Operator:
             metrics=self.metrics,
             termination_grace_period=self.options.termination_grace_period,
             writer=self.writer)
+        # NodePool-deletion cascade source of truth: in API mode the
+        # nodepools INFORMER store (an invalid-config pool is absent from
+        # the guarded active dict but still exists — its nodes must
+        # survive a config hiccup; the store has always completed its
+        # initial list by now: sync_once() ran above), in direct mode
+        # the operator's pool dict itself
+        if self.sync is not None:
+            pools_inf = self.sync.informers.informers["nodepools"]
+
+            def pool_exists(name: str) -> bool:
+                return name in pools_inf.store
+        else:
+            def pool_exists(name: str) -> bool:
+                return name in self.node_pools
         self.gc = GarbageCollectionController(
             self.cluster, self.cloud_provider, self.recorder, self.clock,
-            writer=self.writer)
+            writer=self.writer, pool_exists=pool_exists)
         self.tagging = TaggingController(
             self.cluster, self.cloud, self.recorder, self.clock)
         self.disruption = DisruptionController(

@@ -262,3 +262,71 @@ class TestInterruptionThroughAPI:
         assert old_node not in {n.name for n in client.list_nodes()}
         # the interrupted offering went into the ICE mask
         assert any(True for _ in op.unavailable.entries())
+
+
+class TestNodePoolDeletionCascadeAPI:
+    def test_pool_deleted_over_api_drains_nodes(self, lattice):
+        """The cascade in API mode keys off the nodepools INFORMER
+        store: deleting the pool at the server drains its claims."""
+        clock, server, client, op = make_env(lattice)
+        client.create_nodepool(NodePool(name="team-b", weight=90))
+        op.sync_once()
+        for i in range(3):
+            client.create_pod(run_pod(f"cb{i}"))
+        op.settle()
+        mine = [c for c in client.list_nodeclaims()
+                if c.node_pool == "team-b"]
+        assert mine, "pods landed on the default pool, scenario vacuous"
+        client.delete_nodepool("team-b")
+        # settle() exits on no-pending; give the drain full rounds
+        for _ in range(6):
+            op.settle()
+            clock.step(5.0)
+        left = [c for c in client.list_nodeclaims()
+                if c.node_pool == "team-b" and not c.deletion_timestamp]
+        assert not left, left
+        # the displaced pods rebound onto surviving capacity
+        assert all(p.node_name for p in client.list_pods())
+
+    def test_invalid_config_pool_does_not_cascade(self, lattice):
+        """A pool the cross-object config guard rejects leaves the
+        ACTIVE dict but still exists at the server — its nodes must
+        survive the config hiccup (the cascade consults the informer
+        store, not the guarded dict)."""
+        clock, server, client, op = make_env(lattice)
+        for i in range(2):
+            client.create_pod(run_pod(f"cg{i}"))
+        op.settle()
+        assert client.list_nodeclaims()
+        # break the default pool's config: os the amiFamily can't serve
+        bad = next(p for p in client.list_nodepools()
+                   if p.name == "default")
+        bad.requirements = [Requirement(
+            wk.LABEL_OS, ReqOp.IN, ("windows",))]
+        client.update_nodepool(bad)
+        op.settle()
+        # guard rejected it from the active dict...
+        assert "default" not in op.node_pools
+        # ...but no claim drains: the pool still exists at the server
+        assert all(not c.deletion_timestamp
+                   for c in client.list_nodeclaims())
+
+    def test_cascade_publishes_one_event_per_claim(self, lattice):
+        """The mirror's deletion_timestamp lags the server write by one
+        informer pump; GC ticks inside that window must not re-publish
+        NodePoolDeleted for the same claim."""
+        clock, server, client, op = make_env(lattice)
+        client.create_nodepool(NodePool(name="team-c", weight=90))
+        op.sync_once()
+        for i in range(3):
+            client.create_pod(run_pod(f"cc{i}"))
+        op.settle()
+        n_claims = len([c for c in client.list_nodeclaims()
+                        if c.node_pool == "team-c"])
+        assert n_claims
+        client.delete_nodepool("team-c")
+        op.sync_once()           # pool deletion reaches the informer store
+        op.gc.reconcile()        # cascades; mirror claims not yet updated
+        op.gc.reconcile()        # second tick inside the lag window
+        evs = op.recorder.events(reason="NodePoolDeleted")
+        assert len(evs) == n_claims, [e.object_name for e in evs]

@@ -808,3 +808,42 @@ class TestClusterStateSynced:
         env.cluster.evict_node(env.cluster.node_for_claim(claim.name).name)
         env.emit_gauges()
         assert env.metrics.gauge("karpenter_cluster_state_synced").value() == 0.0
+
+
+class TestNodePoolDeletionCascade:
+    """Deleting a NodePool drains its nodes (the reference cascades via
+    ownerReferences + the termination finalizer, nodepools.md "deleting
+    a NodePool deletes its nodes"); claims of live pools are untouched."""
+
+    def test_deleted_pool_drains_and_pods_move(self, env):
+        for p in pods(3):
+            env.cluster.add_pod(p)
+        env.settle()
+        first_nodes = set(env.cluster.nodes)
+        assert first_nodes
+        # replace the pool: fresh capacity takes over, old nodes drain
+        env.node_pools["fallback"] = NodePool(name="fallback")
+        del env.node_pools["default"]
+        env.gc.reconcile()
+        assert all(c.deletion_timestamp for c in env.cluster.claims.values()
+                   if c.node_pool == "default")
+        # settle() exits on no-pending — the drain may still be paging
+        # evictions through the old nodes; give it full rounds
+        for _ in range(6):
+            env.settle()
+            env.clock.step(5.0)
+            if not (set(env.cluster.nodes) & first_nodes):
+                break
+        assert not (set(env.cluster.nodes) & first_nodes)
+        assert env.cluster.nodes and not env.cluster.pending_pods()
+        assert all(c.node_pool == "fallback"
+                   for c in env.cluster.claims.values())
+
+    def test_live_pool_claims_survive_gc(self, env):
+        for p in pods(2):
+            env.cluster.add_pod(p)
+        env.settle()
+        env.gc.reconcile()
+        assert env.cluster.claims
+        assert all(not c.deletion_timestamp
+                   for c in env.cluster.claims.values())

@@ -549,6 +549,19 @@ class TestHashVersionMigration:
         env.disruption._reconcile_drift()
         assert any(a.reason == "Drifted" for a in env.disruption._in_flight)
 
+    def test_startup_taints_participate_in_hash(self, lattice):
+        """startupTaints are stamped on launched nodes (the init-daemon
+        contract), so editing them must change the template hash and
+        roll nodes exactly like taints do — the reference hashes them."""
+        from karpenter_provider_aws_tpu.apis.objects import Taint
+        from karpenter_provider_aws_tpu.controllers.provisioning import (
+            nodepool_hash)
+        pool = NodePool(name="st")
+        before = nodepool_hash(pool)
+        pool.startup_taints = [Taint(key="node.example.com/setup",
+                                     value="pending", effect="NoSchedule")]
+        assert nodepool_hash(pool) != before
+
 
 class TestWhatIfNodeVanishRace:
     def test_what_if_survives_candidate_node_deletion(self, lattice):

@@ -42,7 +42,7 @@ _PODS_AXIS = res_axis("pods")
 # RE-STAMPED instead of drift-compared, so a controller upgrade never
 # rolls the whole fleet (the reference migrates its hash the same way —
 # wellknown ANNOTATION_NODEPOOL_HASH_VERSION).
-NODEPOOL_HASH_VERSION = "v4"  # v4: + startupTaints
+NODEPOOL_HASH_VERSION = "v5"  # v5: slice fields hash as SETS (+ startupTaints in v4)
 
 
 def nodepool_hash(pool: NodePool) -> str:
@@ -50,7 +50,10 @@ def nodepool_hash(pool: NodePool) -> str:
     karpenter.sh/nodepool-hash annotation; CRD nodepools drift semantics).
     Every field stamped onto launched nodes participates; fields that
     only steer the SOLVE (weight, limits, the disruption block) stay
-    out — retuning them must never roll the fleet."""
+    out — retuning them must never roll the fleet. Slice fields hash
+    ORDER-INSENSITIVELY (the reference's hashstructure SlicesAsSets):
+    reordering semantically-identical taints/requirements in YAML must
+    never roll a fleet."""
     import hashlib
     import json
     payload = json.dumps({
@@ -60,12 +63,15 @@ def nodepool_hash(pool: NodePool) -> str:
         # must drift (and roll) nodes launched with the old values
         "kubelet": ((pool.kubelet.max_pods, pool.kubelet.cluster_dns)
                     if pool.kubelet is not None else None),
-        "taints": [(t.key, t.value, t.effect) for t in pool.taints],
+        "taints": sorted((t.key, t.value or "", t.effect)
+                         for t in pool.taints),
         # startupTaints shape the node exactly like taints do (the init
         # daemon contract changes with them); the reference hashes them
-        "startup_taints": [(t.key, t.value, t.effect)
-                           for t in pool.startup_taints],
-        "requirements": [(r.key, r.operator.value, r.values) for r in pool.requirements],
+        "startup_taints": sorted((t.key, t.value or "", t.effect)
+                                 for t in pool.startup_taints),
+        "requirements": sorted((r.key, r.operator.value,
+                                sorted(str(v) for v in r.values))
+                               for r in pool.requirements),
         "node_class_ref": pool.node_class_ref,
     }, sort_keys=True, default=str)
     return hashlib.sha256(payload.encode()).hexdigest()[:16]

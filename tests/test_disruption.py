@@ -562,6 +562,27 @@ class TestHashVersionMigration:
                                      value="pending", effect="NoSchedule")]
         assert nodepool_hash(pool) != before
 
+    def test_slice_fields_hash_order_insensitively(self, lattice):
+        """Reordering semantically-identical taints/requirements must
+        NOT change the hash (the reference hashes slices as sets —
+        hashstructure SlicesAsSets); a YAML reorder must never roll a
+        fleet."""
+        from karpenter_provider_aws_tpu.apis.objects import Taint
+        from karpenter_provider_aws_tpu.controllers.provisioning import (
+            nodepool_hash)
+        t1 = Taint(key="a", value="1", effect="NoSchedule")
+        t2 = Taint(key="b", value="2", effect="NoExecute")
+        r1 = Requirement(wk.LABEL_ZONE, ReqOp.IN,
+                         ("us-west-2a", "us-west-2b"))
+        r2 = Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN, ("spot",))
+        p_fwd = NodePool(name="x", taints=[t1, t2],
+                         startup_taints=[t2, t1], requirements=[r1, r2])
+        r1_rev = Requirement(wk.LABEL_ZONE, ReqOp.IN,
+                             ("us-west-2b", "us-west-2a"))
+        p_rev = NodePool(name="x", taints=[t2, t1],
+                         startup_taints=[t1, t2], requirements=[r2, r1_rev])
+        assert nodepool_hash(p_fwd) == nodepool_hash(p_rev)
+
 
 class TestWhatIfNodeVanishRace:
     def test_what_if_survives_candidate_node_deletion(self, lattice):

@@ -192,6 +192,74 @@ class TestPerformanceDocFacts:
         _assert_cited_metrics_exist("performance.md")
 
 
+class TestNodePoolsDocFacts:
+    """docs/concepts/nodepools.md pins the weight order, hash contents,
+    and version-migration story to the implementation."""
+
+    def test_spec_depth(self):
+        assert len(_lines("nodepools.md")) >= 100
+
+    def test_hash_covers_startup_taints_and_skips_weight(self):
+        from karpenter_provider_aws_tpu.apis.objects import NodePool, Taint
+        from karpenter_provider_aws_tpu.controllers.provisioning import (
+            nodepool_hash)
+        doc = _read("nodepools.md")
+        assert "startupTaints" in doc
+        p = NodePool(name="x")
+        h = nodepool_hash(p)
+        p.startup_taints = [Taint(key="k", value="v", effect="NoSchedule")]
+        assert nodepool_hash(p) != h          # stamped fields hash
+        p2 = NodePool(name="x", weight=99, limits={"cpu": 1})
+        assert nodepool_hash(p2) == h         # solve-only fields don't
+
+    def test_hash_version_symbol_cited(self):
+        from karpenter_provider_aws_tpu.controllers.provisioning import (
+            NODEPOOL_HASH_VERSION,
+        )
+        assert "NODEPOOL_HASH_VERSION" in _read("nodepools.md")
+        assert NODEPOOL_HASH_VERSION
+
+    def test_weight_order_matches(self):
+        # pools sort weight-descending, name-ascending (problem.py)
+        import pathlib as _p
+        from karpenter_provider_aws_tpu.solver import problem
+        src = _p.Path(problem.__file__).read_text()
+        assert "key=lambda p: (-p.weight, p.name)" in src
+        assert "weight-descending, name-ascending" in _read("nodepools.md")
+
+
+class TestNodeClassesDocFacts:
+    """docs/concepts/nodeclasses.md pins the family set, reconcile
+    cadence, and the role/instanceProfile exclusivity to the code."""
+
+    def test_spec_depth(self):
+        assert len(_lines("nodeclasses.md")) >= 90
+
+    def test_family_enum_matches(self):
+        from karpenter_provider_aws_tpu.providers.amifamily import (
+            AMI_FAMILIES,
+        )
+        doc = _read("nodeclasses.md")
+        for fam in AMI_FAMILIES:
+            assert fam in doc, fam
+
+    def test_reconcile_interval_matches(self):
+        from karpenter_provider_aws_tpu.controllers.nodeclass import (
+            RECONCILE_INTERVAL,
+        )
+        assert (f"`RECONCILE_INTERVAL = {RECONCILE_INTERVAL:.0f} s`"
+                in _read("nodeclasses.md"))
+
+    def test_role_xor_profile_rule_exists(self):
+        from karpenter_provider_aws_tpu.apis.schema import (
+            _rule_role_xor_profile,
+        )
+        assert _rule_role_xor_profile({"role": "r"})
+        assert not _rule_role_xor_profile({"role": "r",
+                                           "instanceProfile": "p"})
+        assert "exactly one" in _read("nodeclasses.md")
+
+
 class TestInterruptionDocFacts:
     """docs/concepts/interruption.md pins the queue semantics, schema
     strings, fanout width, and metric names to the implementation."""

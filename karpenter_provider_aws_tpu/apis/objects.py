@@ -309,6 +309,12 @@ class NodePool:
     # ``custom_labels`` the label values this variant's nodes carry.
     base_name: Optional[str] = None
     custom_labels: Dict[str, str] = field(default_factory=dict)
+    # status: live committed usage (registered nodes + in-flight claims),
+    # quantity strings per axis — the reference NodePool's
+    # status.resources. Controller-owned; outside the template hash
+    # (controllers/provisioning.py nodepool_hash) so status refreshes
+    # never read as drift.
+    status_resources: Dict[str, str] = field(default_factory=dict)
 
     def scheduling_requirements(self) -> Requirements:
         reqs = Requirements.from_labels(self.labels)

@@ -87,6 +87,25 @@ def vec_to_resources(vec: np.ndarray) -> Dict[str, float]:
     return {name: float(vec[i]) for i, name in enumerate(RESOURCE_AXES) if vec[i] != 0}
 
 
+def vec_to_quantities(vec: np.ndarray) -> Dict[str, str]:
+    """Canonical vector → k8s quantity strings, for status surfaces
+    (the reference NodePool's status.resources): cpu in millicores
+    ("12000m"), memory/ephemeral-storage in Mi, counts plain. Zero axes
+    are omitted, like a resource list."""
+    out: Dict[str, str] = {}
+    for i, name in enumerate(RESOURCE_AXES):
+        v = float(vec[i])
+        if v == 0:
+            continue
+        if name == "cpu":
+            out[name] = f"{int(round(v))}m"
+        elif name in ("memory", "ephemeral-storage"):
+            out[name] = f"{int(round(v))}Mi"
+        else:
+            out[name] = f"{int(round(v))}"
+    return out
+
+
 def canonical_to_vec(resources: Mapping[str, float],
                      missing: float = 0.0) -> np.ndarray:
     """Canonical-unit map (cpu millicores, memory MiB — e.g. a NodeClaim's

@@ -136,6 +136,12 @@ NODEPOOL_SCHEMA = {
             },
             "additionalProperties": False,
         },
+        # controller-owned live usage (the reference NodePool's
+        # status.resources); quantity strings per axis
+        "statusResources": {"type": "object",
+                            "additionalProperties": {
+                                "type": "string",
+                                "pattern": QUANTITY_PATTERN}},
     },
     "required": ["name"],
     "additionalProperties": False,

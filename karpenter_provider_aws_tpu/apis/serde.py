@@ -149,6 +149,7 @@ def nodepool_to_dict(p: NodePool) -> Dict:
         "kubelet": ({"maxPods": p.kubelet.max_pods,
                      "clusterDNS": p.kubelet.cluster_dns}
                     if p.kubelet is not None else None),
+        "statusResources": dict(p.status_resources),
     }
 
 
@@ -182,6 +183,7 @@ def nodepool_from_dict(d: Mapping) -> NodePool:
         kubelet=(KubeletSpec(max_pods=d["kubelet"].get("maxPods"),
                              cluster_dns=d["kubelet"].get("clusterDNS"))
                  if d.get("kubelet") else None),
+        status_resources=dict(d.get("statusResources", {})),
     )
 
 

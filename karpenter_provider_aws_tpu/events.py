@@ -35,12 +35,22 @@ class Recorder:
         self._clock = clock or Clock()
         self._events: Deque[Event] = deque(maxlen=MAX_EVENTS)
         self._lock = threading.Lock()
+        # optional mirror (kube.eventsink.ApiEventSink in API mode):
+        # called per event, under the lock, so the mirrored stream keeps
+        # publish order. A sink failure must never break the publishing
+        # controller — events are observability, not control flow.
+        self.sink = None
 
     def publish(self, type: str, reason: str, object_kind: str, object_name: str,
                 message: str) -> None:
         ev = Event(self._clock.now(), type, reason, object_kind, object_name, message)
         with self._lock:
             self._events.append(ev)
+            if self.sink is not None:
+                try:
+                    self.sink(ev)
+                except Exception:
+                    pass
 
     def events(self, reason: Optional[str] = None,
                object_name: Optional[str] = None) -> List[Event]:

@@ -51,7 +51,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 # kinds are plural lowercase, like REST resource paths
 KINDS = ("pods", "nodes", "nodeclaims", "nodepools", "nodeclasses",
-         "pvcs", "storageclasses", "pdbs", "leases")
+         "pvcs", "storageclasses", "pdbs", "leases", "events")
 
 EVENT_HISTORY = 4096   # per-kind watch event ring; older RVs are "410 Gone"
 

@@ -1,0 +1,77 @@
+"""Mirror recorder events into the apiserver as ``events`` objects.
+
+The reference's controllers publish Kubernetes Events through the
+manager's recorder (pkg/controllers/interruption/events/events.go,
+pkg/cloudprovider/events) and the documented debugging flow is
+``kubectl get events``. In API mode this sink gives the same surface:
+every `events.Recorder.publish` also creates an object of kind
+``events`` in the apiserver, so ``kpctl get events`` (and the REST
+``/apis/events`` route, including watches) see the stream a real
+cluster would.
+
+Retention is the sink's job, like an apiserver's event TTL: only the
+newest EVENTS_RETAINED mirrored events are kept; older ones are
+deleted as new ones arrive, so a chatty controller can never grow the
+store without bound. The in-memory recorder ring (events.MAX_EVENTS)
+is unaffected — tests and the direct stratum keep reading that.
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import deque
+
+from .apiserver import AlreadyExistsError, FakeAPIServer, NotFoundError
+
+EVENTS_RETAINED = 1000
+
+
+class ApiEventSink:
+    """``Recorder.sink`` implementation writing through an apiserver.
+
+    Called under the recorder's lock, so creates are ordered exactly as
+    published. Event names are sequential (``ev-000001``); against a
+    pre-populated server the counter skips forward past collisions so a
+    restarted operator keeps appending rather than failing.
+    """
+
+    def __init__(self, api: FakeAPIServer, retained: int = EVENTS_RETAINED):
+        self._api = api
+        self._retained = retained
+        # adopt whatever a prior run left behind: retention must cover
+        # the WHOLE store, not just this instance's writes, and the
+        # counter resumes past the newest adopted name so appends rarely
+        # collide (the create loop still handles races)
+        existing, _ = api.list("events")
+        names = sorted(o["metadata"]["name"] for o in existing)
+        self._names: deque = deque(names)
+        start = 1
+        if names:
+            tail = names[-1].rsplit("-", 1)[-1]
+            if tail.isdigit():
+                start = int(tail) + 1
+        self._seq = itertools.count(start)
+
+    def __call__(self, event) -> None:
+        spec = {
+            "name": "",   # filled per attempt below
+            "time": event.time,
+            "type": event.type,
+            "reason": event.reason,
+            "objectKind": event.object_kind,
+            "objectName": event.object_name,
+            "message": event.message,
+        }
+        while True:
+            spec["name"] = f"ev-{next(self._seq):06d}"
+            try:
+                self._api.create("events", spec)
+                break
+            except AlreadyExistsError:
+                continue
+        self._names.append(spec["name"])
+        while len(self._names) > self._retained:
+            try:
+                self._api.delete("events", self._names.popleft())
+            except NotFoundError:
+                pass   # someone else aged it out — retention still holds

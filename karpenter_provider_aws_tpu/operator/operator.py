@@ -110,6 +110,7 @@ class Operator:
         self._lattice_gauges = wire_lattice_metrics(self.metrics)
         self._lattice_gauge_state = None
         self._pool_gauge_rev = -1
+        self._pool_status_cache: Dict[str, Dict[str, str]] = {}
         self.unavailable = UnavailableOfferings(self.clock)
         self.cluster = ClusterState(self.clock)
         self.node_pools: Dict[str, NodePool] = {p.name: p for p in pool_list}
@@ -151,6 +152,10 @@ class Operator:
                 except AlreadyExistsError:
                     pass
             self.writer = ApiWriter(self.kube, self.cluster, self.clock)
+            # events mirror into the apiserver so `kpctl get events` /
+            # GET /apis/events see what a kubectl user would
+            from ..kube.eventsink import ApiEventSink
+            self.recorder.sink = ApiEventSink(api_server)
             self.sync = StateSync(
                 api_server, self.cluster, self.node_pools, self.node_classes,
                 synced_gauge=self.metrics.gauge(
@@ -345,14 +350,32 @@ class Operator:
             startup.observe(s)
         # per-pool committed usage + limits (reference metrics.md:16-22).
         # pool_usage() depends only on the node/claim capacity set —
-        # re-render on its revision, not on every per-second pass
-        if self.cluster.capacity_rev != self._pool_gauge_rev:
+        # re-render on its revision, not on every per-second pass. A
+        # user `kpctl apply` replaces the wire spec (statusResources
+        # resets to {}) without touching capacity_rev, so a cheap
+        # dict-compare against the last computed status also re-arms
+        # the pass — otherwise the wire object would show zero usage
+        # until the next node/claim change.
+        # snapshot: the async runtime's statesync thread mutates
+        # node_pools concurrently with this (metrics-thread) scan
+        pools_now = list(self.node_pools.items())
+        # deleted pools leave the cache promptly (unbounded growth under
+        # pool churn; a stale entry would also fire one spurious
+        # re-render if the name is ever reused)
+        live = {n for n, _ in pools_now}
+        for gone in [n for n in self._pool_status_cache if n not in live]:
+            del self._pool_status_cache[gone]
+        status_dirty = self.api_server is not None and any(
+            p.status_resources != self._pool_status_cache.get(n)
+            for n, p in pools_now)
+        if self.cluster.capacity_rev != self._pool_gauge_rev or status_dirty:
             self._pool_gauge_rev = self.cluster.capacity_rev
-            from ..apis.resources import RESOURCE_AXES
+            from ..apis.resources import RESOURCE_AXES, vec_to_quantities
+            from ..kube.apiserver import NotFoundError
             usage_g = self.metrics.get("karpenter_nodepool_usage")
             limit_g = self.metrics.get("karpenter_nodepool_limit")
             usage = self.cluster.pool_usage()
-            for name, pool in self.node_pools.items():
+            for name, pool in pools_now:
                 vec = usage.get(name)
                 limit = pool.limits_vec()
                 # usage covers the primary axes plus every LIMITED axis —
@@ -367,6 +390,23 @@ class Operator:
                     if limit is not None and ax in pool.limits:
                         limit_g.set(float(limit[ai]), nodepool=name,
                                     resource_type=ax)
+                # status.resources (the reference NodePool status): keep
+                # the typed pool current, and in API mode patch the wire
+                # object so `kpctl get nodepools` shows live usage
+                sr = vec_to_quantities(vec) if vec is not None else {}
+                self._pool_status_cache[name] = sr
+                if sr != pool.status_resources:
+                    # merge-patch deletes need explicit None markers for
+                    # axes that dropped to zero (RFC 7386)
+                    delta = {**{k: None for k in pool.status_resources
+                                if k not in sr}, **sr}
+                    pool.status_resources = sr
+                    if self.api_server is not None:
+                        try:
+                            self.api_server.patch(
+                                "nodepools", name, {"statusResources": delta})
+                        except NotFoundError:
+                            pass   # pool deleted mid-pass; watch will prune
         # offering gauge surface: re-emit only when pricing or the ICE set
         # actually changed (both are versioned)
         gstate = (self.lattice.price_version, self.unavailable.seq_num)

@@ -330,3 +330,112 @@ class TestNodePoolDeletionCascadeAPI:
         op.gc.reconcile()        # second tick inside the lag window
         evs = op.recorder.events(reason="NodePoolDeleted")
         assert len(evs) == n_claims, [e.object_name for e in evs]
+
+
+class TestEventsThroughAPI:
+    """Controller events are wire-visible objects (kind ``events``) —
+    the `kubectl get events` debugging flow of the reference docs."""
+
+    def test_lifecycle_events_mirror_into_apiserver(self, lattice):
+        clock, server, client, op = make_env(lattice)
+        client.create_pod(run_pod("evt-p0"))
+        op.settle()
+        objs, _ = server.list("events")
+        reasons = [o["spec"]["reason"] for o in objs]
+        for expected in ("Launched", "Registered", "Initialized"):
+            assert expected in reasons, reasons
+        # mirrored stream preserves publish order vs the in-memory ring
+        assert reasons == [e.reason for e in op.recorder.events()][-len(reasons):]
+
+    def test_kpctl_renders_events_table(self, lattice, capsys):
+        import pathlib
+        import sys
+        sys.path.insert(0, str(
+            pathlib.Path(__file__).resolve().parent.parent / "tools"))
+        import kpctl
+        clock, server, client, op = make_env(lattice)
+        client.create_pod(run_pod("evt-p1"))
+        op.settle()
+        objs, _ = server.list("events")
+        kpctl.print_table("events", objs)
+        out = capsys.readouterr().out
+        assert "REASON" in out and "Launched" in out
+        assert "NodeClaim/" in out
+
+
+class TestNodePoolStatusResources:
+    """Live pool usage surfaces as the wire object's statusResources —
+    the reference NodePool's status.resources."""
+
+    def test_usage_patched_onto_pool_object(self, lattice):
+        clock, server, client, op = make_env(lattice)
+        for i in range(3):
+            client.create_pod(run_pod(f"sr-{i}"))
+        op.settle()
+        obj = server.get("nodepools", "default")
+        sr = obj["spec"]["statusResources"]
+        assert sr.get("cpu", "").endswith("m")
+        assert sr.get("memory", "").endswith("Mi")
+        assert int(sr["pods"]) >= 3
+        # quantity strings parse back to the mirror's usage vector
+        from karpenter_provider_aws_tpu.apis.resources import (
+            axis, resources_to_vec)
+        vec = resources_to_vec(sr)
+        assert vec[axis("cpu")] == op.cluster.pool_usage()["default"][
+            axis("cpu")]
+
+    def test_usage_clears_when_nodes_terminate(self, lattice):
+        clock, server, client, op = make_env(lattice)
+        client.create_pod(run_pod("sr-gone"))
+        op.settle()
+        client.delete_pod("sr-gone")
+        # consolidation needs its stabilization window to empty the node
+        for _ in range(40):
+            op.run_once()
+            clock.step(30.0)
+        # the node is gone; usage axes drop out of the status (the
+        # merge-patch carries explicit deletes for zeroed axes)
+        assert client.list_nodes() == []
+        sr = server.get("nodepools", "default")["spec"]["statusResources"]
+        assert not sr, sr
+
+    def test_user_apply_does_not_wipe_status_for_long(self, lattice):
+        """`kpctl apply` replaces the wire spec (statusResources resets);
+        the operator re-stamps live usage on the next pass even though
+        capacity never changed (review r5)."""
+        from karpenter_provider_aws_tpu.apis import serde
+        clock, server, client, op = make_env(lattice)
+        client.create_pod(run_pod("sr-apply"))
+        op.settle()
+        assert server.get("nodepools", "default")["spec"]["statusResources"]
+        # user-style apply: serde round-trip of a FRESH pool spec (no
+        # status), like kpctl apply -f would PUT
+        spec = serde.nodepool_to_dict(NodePool(name="default", weight=7))
+        obj = server.get("nodepools", "default")
+        obj["spec"] = spec
+        server.update("nodepools", obj)
+        assert not server.get("nodepools", "default")["spec"][
+            "statusResources"]
+        op.run_once()
+        sr = server.get("nodepools", "default")["spec"]["statusResources"]
+        assert sr.get("cpu", "").endswith("m"), sr
+
+    def test_status_cache_pruned_on_pool_delete(self, lattice):
+        """Deleted pools leave _pool_status_cache (review r5: unbounded
+        growth under per-job pool churn)."""
+        clock, server, client, op = make_env(lattice)
+        client.create_nodepool(NodePool(name="job-1", weight=9))
+        client.create_pod(run_pod("jp", node_selector={
+            "karpenter.sh/nodepool": "job-1"}))
+        op.settle()
+        assert "job-1" in op._pool_status_cache
+        client.delete_pod("jp")
+        for _ in range(40):
+            op.run_once()
+            clock.step(30.0)
+        client.delete_nodepool("job-1")
+        for _ in range(10):
+            op.run_once()
+            clock.step(30.0)
+        assert "job-1" not in op.node_pools
+        assert "job-1" not in op._pool_status_cache

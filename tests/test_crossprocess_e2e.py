@@ -195,6 +195,11 @@ def test_provision_interrupt_converge_over_the_wire(control_plane,
     # of a doomed pod is a different node object
     assert set(rebound.values()), rebound
 
+    # ---- events: the kubectl-get-events flow, over the wire ----------
+    table = kpctl_cli(base, "get", "events")
+    assert "REASON" in table and "Launched" in table
+    assert "Cordoned" in table   # the interruption drain left its trace
+
 
 @pytest.mark.slow
 def test_kpctl_watch_and_delete_over_the_wire(control_plane, tmp_path):

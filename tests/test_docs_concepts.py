@@ -355,3 +355,132 @@ class TestGettingStartedDocFacts:
             # word-boundary: 'pods' must not ride along inside 'nodepools'
             assert re.search(rf"\b{kind}\b", doc), kind
             assert kind in KINDS, kind
+
+
+class TestTroubleshootingDocFacts:
+    """docs/troubleshooting.md (the reference's 698-line symptom guide)
+    cites constants, event reasons, metrics, and flags — pin them all."""
+
+    PKG = DOCS.parent.parent / "karpenter_provider_aws_tpu"
+
+    def _doc(self):
+        return re.sub(r"\s+", " ",
+                      (DOCS.parent / "troubleshooting.md").read_text())
+
+    def _pkg_src(self):
+        if not hasattr(self, "_src_cache"):
+            self._src_cache = "\n".join(
+                p.read_text() for p in self.PKG.rglob("*.py"))
+        return self._src_cache
+
+    def test_spec_depth(self):
+        lines = (DOCS.parent / "troubleshooting.md").read_text().splitlines()
+        assert len(lines) >= 250
+
+    def test_cited_event_reasons_are_published(self):
+        """Every CamelCase reason the doc tells the user to grep for is
+        actually published somewhere in the package."""
+        src = self._pkg_src()
+        for reason in ("FailedScheduling", "InsufficientCapacity",
+                       "Launched", "Registered", "Initialized",
+                       "LivenessFailure", "InstanceDisappeared",
+                       "LeakedInstance", "DisruptionBlocked", "Cordoned",
+                       "Drained", "Terminated", "InvalidConfig"):
+            assert reason in self._doc(), reason
+            assert f'"{reason}"' in src, reason
+
+    def test_cited_metric_names_exist(self):
+        src = (self.PKG / "metrics.py").read_text()
+        for m in re.findall(r"karpenter_[a-z_]+", self._doc()):
+            assert m in src, m
+
+    def test_cited_constants_match(self):
+        from karpenter_provider_aws_tpu.cache.unavailable import (
+            UNAVAILABLE_OFFERINGS_TTL)
+        from karpenter_provider_aws_tpu.controllers.disruption import (
+            SPOT_TO_SPOT_MIN_TYPES)
+        from karpenter_provider_aws_tpu.controllers.garbagecollection import (
+            LEAK_GRACE_SECONDS)
+        from karpenter_provider_aws_tpu.controllers.lifecycle import (
+            REGISTRATION_TTL)
+        from karpenter_provider_aws_tpu.events import MAX_EVENTS
+        from karpenter_provider_aws_tpu.kube.eventsink import EVENTS_RETAINED
+        doc = self._doc()
+        assert f"{UNAVAILABLE_OFFERINGS_TTL:.0f} s" in doc
+        assert f"≥15" not in doc or SPOT_TO_SPOT_MIN_TYPES == 15
+        assert "≥15 candidate types" in doc
+        assert f"older than {LEAK_GRACE_SECONDS:.0f} s" in doc
+        assert f"{REGISTRATION_TTL:.0f} s" in doc
+        assert f"newest {MAX_EVENTS}" in doc
+        assert f"newest {EVENTS_RETAINED}" in doc
+
+    def test_cited_cli_flags_exist(self):
+        src = (self.PKG / "cli.py").read_text()
+        for flag in re.findall(r"--[a-z][a-z-]+", self._doc()):
+            if flag in ("--token", "--token-file", "--cacert",
+                        "--insecure-skip-tls-verify"):   # kpctl's flags
+                continue
+            assert flag in src, flag
+
+    def test_force_drain_message_matches(self):
+        src = (self.PKG / "controllers" / "termination.py").read_text()
+        assert "termination grace period expired" in self._doc()
+        assert "termination grace period expired" in src
+
+    def test_batch_window_defaults_match(self):
+        from karpenter_provider_aws_tpu.operator.options import Options
+        o = Options()
+        doc = self._doc()
+        assert f"default {o.batch_idle_duration:.0f} s" in doc
+        assert f"{o.batch_max_duration:.0f} s" in doc
+
+    def test_hash_version_symbol_exists(self):
+        from karpenter_provider_aws_tpu.controllers import provisioning
+        assert hasattr(provisioning, "NODEPOOL_HASH_VERSION")
+        assert "NODEPOOL_HASH_VERSION" in self._doc()
+
+    def test_status_resources_surface_exists(self):
+        from karpenter_provider_aws_tpu.apis.objects import NodePool
+        assert "statusResources" in self._doc()
+        assert hasattr(NodePool(name="x"), "status_resources")
+
+
+class TestFaqDocFacts:
+    def _doc(self):
+        return re.sub(r"\s+", " ", (DOCS.parent / "faq.md").read_text())
+
+    def test_ami_family_count_matches(self):
+        from karpenter_provider_aws_tpu.providers.amifamily import (
+            AMI_FAMILIES)
+        assert len(AMI_FAMILIES) == 6
+        assert "Six AMI families" in self._doc()
+        for fam in ("AL2023", "Bottlerocket", "Ubuntu", "Windows"):
+            assert fam in self._doc(), fam
+
+    def test_flexibility_threshold_matches(self):
+        from karpenter_provider_aws_tpu.cloudprovider.cloudprovider import (
+            FLEXIBILITY_THRESHOLD)
+        assert f"≥{FLEXIBILITY_THRESHOLD}-type flexibility warning" in \
+            self._doc()
+
+    def test_cited_labels_exist(self):
+        from karpenter_provider_aws_tpu.apis import wellknown as wk
+        doc = self._doc()
+        for label in ("karpenter.sh/nodepool", "karpenter.sh/capacity-type",
+                      "kubernetes.io/arch", "kubernetes.io/os"):
+            assert label in doc, label
+        assert wk.LABEL_NODEPOOL == "karpenter.sh/nodepool"
+
+    def test_catalog_has_graviton(self):
+        """The FAQ promises arm64 Graviton types in the catalog."""
+        import json
+        import pathlib
+        cat = json.loads(
+            (DOCS.parent.parent / "karpenter_provider_aws_tpu" / "lattice" /
+             "data" / "reference_catalog.json").read_text())
+        assert any(t["name"].startswith("m6g.") for t in cat["types"])
+        assert "m6g" in self._doc()
+
+    def test_do_not_disrupt_matches(self):
+        from karpenter_provider_aws_tpu.apis import wellknown as wk
+        assert wk.ANNOTATION_DO_NOT_DISRUPT in self._doc()

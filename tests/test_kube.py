@@ -493,3 +493,87 @@ class TestReviewRegressions:
         assert w2.pop_pending()[0].object["spec"]["name"] == "a"
         w3 = s.watch("pods", resource_version=0)  # replays from history
         assert w3.pop_pending()[0].object["spec"]["name"] == "a"
+
+
+class TestEventSink:
+    """Recorder → apiserver events mirror (kube/eventsink.py)."""
+
+    def _recorder(self, server, retained=None):
+        from karpenter_provider_aws_tpu.events import Recorder
+        from karpenter_provider_aws_tpu.kube.eventsink import ApiEventSink
+        from karpenter_provider_aws_tpu.utils.clock import FakeClock
+        r = Recorder(FakeClock(100.0))
+        r.sink = (ApiEventSink(server) if retained is None
+                  else ApiEventSink(server, retained=retained))
+        return r
+
+    def test_publish_mirrors_into_apiserver_in_order(self):
+        s = FakeAPIServer()
+        r = self._recorder(s)
+        r.publish("Normal", "Launched", "NodeClaim", "c0", "type=m5.large")
+        r.publish("Warning", "LaunchFailed", "NodeClaim", "c1", "ICE")
+        objs, _ = s.list("events")
+        assert [o["spec"]["reason"] for o in objs] == [
+            "Launched", "LaunchFailed"]
+        assert objs[0]["spec"]["objectKind"] == "NodeClaim"
+        assert objs[0]["spec"]["objectName"] == "c0"
+        assert objs[0]["spec"]["time"] == 100.0
+        # the in-memory ring still serves reads (direct-stratum surface)
+        assert len(r.events()) == 2
+
+    def test_retention_cap_ages_out_oldest(self):
+        s = FakeAPIServer()
+        r = self._recorder(s, retained=3)
+        for i in range(7):
+            r.publish("Normal", "R", "Pod", f"p{i}", "")
+        objs, _ = s.list("events")
+        assert len(objs) == 3
+        assert [o["spec"]["objectName"] for o in objs] == ["p4", "p5", "p6"]
+
+    def test_sink_failure_never_breaks_publish(self):
+        from karpenter_provider_aws_tpu.events import Recorder
+        r = Recorder()
+        calls = []
+
+        def bad_sink(ev):
+            calls.append(ev)
+            raise RuntimeError("apiserver down")
+
+        r.sink = bad_sink
+        r.publish("Normal", "Launched", "NodeClaim", "c0", "")
+        assert calls and len(r.events()) == 1
+
+    def test_restart_skips_past_existing_names(self):
+        """A second sink against a pre-populated server (operator
+        restart) keeps appending instead of failing on name collisions."""
+        s = FakeAPIServer()
+        r1 = self._recorder(s)
+        r1.publish("Normal", "A", "Pod", "p0", "")
+        r2 = self._recorder(s)   # fresh counter, same server
+        r2.publish("Normal", "B", "Pod", "p1", "")
+        objs, _ = s.list("events")
+        assert [o["spec"]["reason"] for o in objs] == ["A", "B"]
+
+    def test_events_kind_is_watchable(self):
+        s = FakeAPIServer()
+        w = s.watch("events", resource_version=0)
+        r = self._recorder(s)
+        r.publish("Warning", "DisruptionBlocked", "NodeClaim", "c0", "budget")
+        evs = w.pop_pending()
+        assert evs and evs[0].type == "ADDED"
+        assert evs[0].object["spec"]["reason"] == "DisruptionBlocked"
+
+    def test_retention_adopts_preexisting_events_on_restart(self):
+        """A fresh sink (operator restart) counts the prior run's events
+        against the cap instead of letting them live forever."""
+        s = FakeAPIServer()
+        r1 = self._recorder(s, retained=4)
+        for i in range(3):
+            r1.publish("Normal", "Old", "Pod", f"o{i}", "")
+        r2 = self._recorder(s, retained=4)   # adopts the 3 above
+        for i in range(3):
+            r2.publish("Normal", "New", "Pod", f"n{i}", "")
+        objs, _ = s.list("events")
+        assert len(objs) == 4
+        names = [o["spec"]["objectName"] for o in objs]
+        assert names == ["o2", "n0", "n1", "n2"], names

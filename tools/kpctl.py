@@ -101,6 +101,22 @@ _COLUMNS = {
     "nodepools": (
         ("NAME", lambda o: o["metadata"]["name"]),
         ("WEIGHT", lambda o: str(o["spec"].get("weight", 0))),
+        # live usage vs ceiling (statusResources is the reference
+        # NodePool's status.resources; "-" = unlimited axis)
+        ("CPU", lambda o: "{}/{}".format(
+            o["spec"].get("statusResources", {}).get("cpu", "0"),
+            o["spec"].get("limits", {}).get("cpu", "-"))),
+        ("MEMORY", lambda o: "{}/{}".format(
+            o["spec"].get("statusResources", {}).get("memory", "0"),
+            o["spec"].get("limits", {}).get("memory", "-"))),
+    ),
+    "events": (
+        ("LAST SEEN", lambda o: _age(o["spec"].get("time"))),
+        ("TYPE", lambda o: o["spec"].get("type", "")),
+        ("REASON", lambda o: o["spec"].get("reason", "")),
+        ("OBJECT", lambda o: "{}/{}".format(
+            o["spec"].get("objectKind", ""), o["spec"].get("objectName", ""))),
+        ("MESSAGE", lambda o: o["spec"].get("message", "")),
     ),
 }
 _DEFAULT_COLUMNS = (

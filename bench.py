@@ -435,9 +435,12 @@ def run_config(key, make, lattice, solver, uncapped_referee=False):
 # measured e2e_algo 72.8-79.2 ms across runs, so 100 ms separates
 # weather from regression with real margin while the raw <200 ms p50
 # target stays the headline gate. The content-keyed narrowing cache +
-# grouping fast path (problem.py) then cut the steady-state host share:
-# measured e2e_algo 61.1 (synthetic) / 75.3 (real) on the chip, so the
-# budget now carries 25-40 ms of weather margin.
+# grouping fast path (problem.py) then cut the steady-state host share
+# to 61.1 (synthetic) / 75.3 (real) on the chip, and the round-5 host
+# work — run-sharing the grouping cache pointer, the unrestricted-axes
+# feasibility fast path, __dict__-direct selector scans — landed it at
+# 42.3 (real) / 47.3 (synthetic): under half the budget, so weather and
+# regression cannot be confused.
 CFG5_ALGO_BUDGET_MS = 100.0
 
 

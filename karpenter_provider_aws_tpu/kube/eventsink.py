@@ -41,16 +41,20 @@ class ApiEventSink:
         # adopt whatever a prior run left behind: retention must cover
         # the WHOLE store, not just this instance's writes, and the
         # counter resumes past the newest adopted name so appends rarely
-        # collide (the create loop still handles races)
+        # collide (the create loop still handles races). Order and resume
+        # NUMERICALLY — lexicographic order breaks past ev-999999 (a
+        # 7-digit name sorts before 6-digit ones), which would age out
+        # the newest events and re-issue taken names after a restart.
         existing, _ = api.list("events")
-        names = sorted(o["metadata"]["name"] for o in existing)
-        self._names: deque = deque(names)
-        start = 1
-        if names:
-            tail = names[-1].rsplit("-", 1)[-1]
-            if tail.isdigit():
-                start = int(tail) + 1
-        self._seq = itertools.count(start)
+        numbered = []
+        for o in existing:
+            name = o["metadata"]["name"]
+            tail = name.rsplit("-", 1)[-1]
+            numbered.append((int(tail) if tail.isdigit() else -1, name))
+        numbered.sort()
+        self._names: deque = deque(n for _, n in numbered)
+        start = numbered[-1][0] + 1 if numbered else 1
+        self._seq = itertools.count(max(start, 1))
 
     def __call__(self, event) -> None:
         spec = {

@@ -402,11 +402,21 @@ class Operator:
                                 if k not in sr}, **sr}
                     pool.status_resources = sr
                     if self.api_server is not None:
+                        from ..kube.apiserver import InvalidObjectError
                         try:
                             self.api_server.patch(
                                 "nodepools", name, {"statusResources": delta})
                         except NotFoundError:
                             pass   # pool deleted mid-pass; watch will prune
+                        except InvalidObjectError:
+                            # a hand-PUT spec without the statusResources
+                            # key can race this patch: RFC 7386 deletion
+                            # markers against a missing map fail admission.
+                            # The watch delivers the fresh (empty-status)
+                            # pool next pass and the dirty scan re-patches
+                            # with a marker-free delta — never abort the
+                            # gauge pass over a best-effort status write.
+                            pass
         # offering gauge surface: re-emit only when pricing or the ICE set
         # actually changed (both are versioned)
         gstate = (self.lattice.price_version, self.unavailable.seq_num)

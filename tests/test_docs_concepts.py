@@ -484,3 +484,42 @@ class TestFaqDocFacts:
     def test_do_not_disrupt_matches(self):
         from karpenter_provider_aws_tpu.apis import wellknown as wk
         assert wk.ANNOTATION_DO_NOT_DISRUPT in self._doc()
+
+
+class TestManagingAmisDocFacts:
+    def _doc(self):
+        return re.sub(r"\s+", " ",
+                      (DOCS.parent / "tasks" / "managing-amis.md").read_text())
+
+    def test_drift_reason_strings_exist(self):
+        src = (DOCS.parent.parent / "karpenter_provider_aws_tpu" /
+               "cloudprovider" / "cloudprovider.py").read_text()
+        doc = self._doc()
+        for reason in ("AMIDrift", "NodeClassDrift"):
+            assert reason in doc, reason
+            assert f'"{reason}"' in src, reason
+
+    def test_budget_reason_literal_valid(self):
+        """The YAML example's reasons entry must use a schema-valid
+        enum value."""
+        from karpenter_provider_aws_tpu.apis.schema import _BUDGET
+        assert "Drifted" in _BUDGET["properties"]["reasons"]["items"]["enum"]
+        assert "reasons: [Drifted]" in self._doc()
+
+    def test_ami_ttl_matches(self):
+        from karpenter_provider_aws_tpu.providers.amifamily import AMI_TTL
+        assert f"{AMI_TTL:.0f} s" in self._doc()
+        assert "AMI_TTL" in self._doc()
+
+    def test_cited_metric_label_matches(self):
+        assert 'reason="Drifted"' in self._doc()
+        src = (DOCS.parent.parent / "karpenter_provider_aws_tpu" /
+               "metrics.py").read_text()
+        assert "karpenter_nodeclaims_disrupted_total" in src
+
+    def test_selector_field_names_match_serde(self):
+        src = (DOCS.parent.parent / "karpenter_provider_aws_tpu" / "apis" /
+               "serde.py").read_text()
+        for fld in ("amiSelectorTerms", "statusAMIs"):
+            assert fld in self._doc(), fld
+            assert fld in src, fld

@@ -577,3 +577,26 @@ class TestEventSink:
         assert len(objs) == 4
         names = [o["spec"]["objectName"] for o in objs]
         assert names == ["o2", "n0", "n1", "n2"], names
+
+    def test_adoption_orders_numerically_past_six_digits(self):
+        """Restart adoption must order ev-1000000 AFTER ev-999999 and
+        resume the counter past the numeric max (review r5)."""
+        from karpenter_provider_aws_tpu.kube.eventsink import ApiEventSink
+        s = FakeAPIServer()
+        for n in ("ev-999999", "ev-1000000", "ev-1000001"):
+            s.create("events", {"name": n, "reason": "Old",
+                                "objectName": n, "type": "Normal",
+                                "objectKind": "Pod", "message": "",
+                                "time": 0.0})
+        sink = ApiEventSink(s, retained=3)
+        from karpenter_provider_aws_tpu.events import Recorder
+        from karpenter_provider_aws_tpu.utils.clock import FakeClock
+        r = Recorder(FakeClock(1.0))
+        r.sink = sink
+        r.publish("Normal", "New", "Pod", "fresh", "")
+        objs, _ = s.list("events")
+        names = sorted(o["metadata"]["name"] for o in objs)
+        # oldest (ev-999999) aged out; the new event took 1000002
+        assert "ev-999999" not in names
+        assert "ev-1000002" in names, names
+        assert len(objs) == 3

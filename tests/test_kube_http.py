@@ -400,3 +400,30 @@ class TestKpctlPipeHygiene:
         assert rc in (0, 141), (rc, err)   # raced: may finish clean
         assert "Exception ignored" not in err, err
         assert "Traceback" not in err, err
+
+
+class TestWatchHeartbeat:
+    def test_idle_watch_emits_heartbeats_then_resumes_events(
+            self, api, monkeypatch):
+        """An idle watch stream carries periodic HEARTBEAT lines (the
+        half-open-connection detector) and still delivers real events
+        afterward."""
+        from karpenter_provider_aws_tpu.kube import httpserver as hs
+        monkeypatch.setattr(hs, "WATCH_HEARTBEAT_SECONDS", 0.2)
+        s, base = api
+        resp = urllib.request.urlopen(
+            f"{base}/apis/pods?watch=1&resourceVersion=0", timeout=10)
+        # idle: the first line must be a heartbeat, not a real event
+        line = json.loads(resp.readline())
+        assert line["type"] == "HEARTBEAT"
+        # liveness resumes: a create lands as an ADDED after heartbeats
+        s.create("pods", serde.pod_to_dict(
+            Pod(name="hb-pod", requests={"cpu": "1", "memory": "1Gi"})))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            line = json.loads(resp.readline())
+            if line["type"] != "HEARTBEAT":
+                break
+        assert line["type"] == "ADDED"
+        assert line["object"]["metadata"]["name"] == "hb-pod"
+        resp.close()

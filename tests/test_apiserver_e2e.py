@@ -347,10 +347,9 @@ class TestEventsThroughAPI:
         # mirrored stream preserves publish order vs the in-memory ring
         assert reasons == [e.reason for e in op.recorder.events()][-len(reasons):]
 
-    def test_kpctl_renders_events_table(self, lattice, capsys):
+    def test_kpctl_renders_events_table(self, lattice, capsys, monkeypatch):
         import pathlib
-        import sys
-        sys.path.insert(0, str(
+        monkeypatch.syspath_prepend(str(
             pathlib.Path(__file__).resolve().parent.parent / "tools"))
         import kpctl
         clock, server, client, op = make_env(lattice)

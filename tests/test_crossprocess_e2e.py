@@ -200,6 +200,14 @@ def test_provision_interrupt_converge_over_the_wire(control_plane,
     assert "REASON" in table and "Launched" in table
     assert "Cordoned" in table   # the interruption drain left its trace
 
+    # describe stitches an object to its events, kubectl-style
+    claims = client.request("GET", "/apis/nodeclaims")["items"]
+    some = claims[0]["metadata"]["name"]
+    desc = kpctl_cli(base, "describe", "nodeclaims", some)
+    assert f"Name:             {some}" in desc
+    assert "Spec:" in desc and "Events:" in desc
+    assert "Launched" in desc
+
 
 @pytest.mark.slow
 def test_kpctl_watch_and_delete_over_the_wire(control_plane, tmp_path):

@@ -427,3 +427,44 @@ class TestWatchHeartbeat:
         assert line["type"] == "ADDED"
         assert line["object"]["metadata"]["name"] == "hb-pod"
         resp.close()
+
+
+class TestKpctlDescribe:
+    def test_describe_without_events_shows_none(self, api, capsys,
+                                                monkeypatch):
+        import pathlib
+        monkeypatch.syspath_prepend(str(
+            pathlib.Path(__file__).resolve().parent.parent / "tools"))
+        import kpctl
+        s, base = api
+        s.create("pods", serde.pod_to_dict(
+            Pod(name="d-pod", requests={"cpu": "1", "memory": "1Gi"})))
+        rc = kpctl.main(["--server", base, "describe", "pods", "d-pod"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "Name:             d-pod" in out
+        assert "Spec:" in out and '"cpu": "1"' in out
+        assert "Events:" in out and "<none>" in out
+
+    def test_describe_matches_kind_not_just_name(self, api, capsys,
+                                                 monkeypatch):
+        """A Node shares its NodeClaim's name; describe must attribute
+        events by kind+name like kubectl (review r5)."""
+        import pathlib
+        monkeypatch.syspath_prepend(str(
+            pathlib.Path(__file__).resolve().parent.parent / "tools"))
+        import kpctl
+        from karpenter_provider_aws_tpu.events import Recorder
+        from karpenter_provider_aws_tpu.kube.eventsink import ApiEventSink
+        s, base = api
+        s.create("pods", serde.pod_to_dict(
+            Pod(name="shared", requests={"cpu": "1", "memory": "1Gi"})))
+        r = Recorder()
+        r.sink = ApiEventSink(s)
+        r.publish("Normal", "Launched", "NodeClaim", "shared", "not yours")
+        r.publish("Normal", "Scheduled", "Pod", "shared", "yours")
+        rc = kpctl.main(["--server", base, "describe", "pods", "shared"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "Scheduled" in out and "yours" in out
+        assert "Launched" not in out and "not yours" not in out

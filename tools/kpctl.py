@@ -11,6 +11,7 @@ the same surface against the control plane served by
     kpctl delete KIND NAME [--force]
     kpctl watch KIND [--resource-version N]  streamed events
     kpctl evict POD [--force]
+    kpctl describe KIND NAME                 object + its recorded events
 
 Connection flags mirror kubectl's: --server (or KPCTL_SERVER), bearer
 auth via --token/--token-file, TLS via --cacert (self-signed material
@@ -125,6 +126,13 @@ _DEFAULT_COLUMNS = (
 )
 
 
+def _print_rows(rows, indent: str = "") -> None:
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    for r in rows:
+        print(indent
+              + "   ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+
+
 def print_table(kind: str, objs, wide: bool = False) -> None:
     cols = list(_COLUMNS.get(kind, _DEFAULT_COLUMNS))
     if wide:
@@ -136,9 +144,7 @@ def print_table(kind: str, objs, wide: bool = False) -> None:
     rows = [[h for h, _ in cols]]
     for o in objs:
         rows.append([f(o) or "" for _, f in cols])
-    widths = [max(len(r[i]) for r in rows) for i in range(len(cols))]
-    for r in rows:
-        print("   ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+    _print_rows(rows)
 
 
 def load_documents(path):
@@ -215,6 +221,50 @@ def cmd_watch(c: Client, args) -> int:
     return 0
 
 
+def cmd_describe(c: Client, args) -> int:
+    """kubectl-describe analog: the object plus its recorded events
+    (the `events` kind the control plane mirrors in API mode)."""
+    obj = c.request("GET", f"/apis/{args.kind}/{args.name}")
+    md = obj["metadata"]
+    print(f"Name:             {md['name']}")
+    print(f"Kind:             {args.kind}")
+    print(f"UID:              {md.get('uid', '')}")
+    print(f"ResourceVersion:  {md['resourceVersion']}")
+    if md.get("creationTimestamp"):
+        print(f"Age:              {_age(md['creationTimestamp'])}")
+    if md.get("deletionTimestamp"):
+        print(f"Deleting:         since {_age(md['deletionTimestamp'])} ago")
+    if md.get("finalizers"):
+        print(f"Finalizers:       {', '.join(md['finalizers'])}")
+    print("Spec:")
+    for line in json.dumps(obj["spec"], indent=2).splitlines()[1:-1]:
+        print(f" {line}")
+    try:
+        events = c.request("GET", "/apis/events")["items"]
+    except urllib.error.HTTPError as e:
+        if e.code != 404:
+            raise   # auth/server failure must not read as "no events"
+        events = []   # pre-events server: describe still works
+
+    def _matches(spec) -> bool:
+        # kubectl matches involvedObject kind+name; objectName alone
+        # would mis-attribute (a Node shares its NodeClaim's name)
+        ok = spec.get("objectKind", "").lower()
+        return (spec.get("objectName") == args.name
+                and ok and args.kind in (ok + "s", ok + "es"))
+
+    mine = [e["spec"] for e in events if _matches(e["spec"])]
+    print("Events:")
+    if not mine:
+        print("  <none>")
+        return 0
+    rows = [["TYPE", "REASON", "AGE", "MESSAGE"]]
+    rows += [[e.get("type", ""), e.get("reason", ""),
+              _age(e.get("time")), e.get("message", "")] for e in mine]
+    _print_rows(rows, indent="  ")
+    return 0
+
+
 def cmd_evict(c: Client, args) -> int:
     force = "?force=1" if args.force else ""
     try:
@@ -271,6 +321,11 @@ def main(argv=None) -> int:
     e.add_argument("name")
     e.add_argument("--force", action="store_true")
     e.set_defaults(fn=cmd_evict)
+
+    ds = sub.add_parser("describe")
+    ds.add_argument("kind")
+    ds.add_argument("name")
+    ds.set_defaults(fn=cmd_describe)
 
     args = p.parse_args(argv)
     if not args.server:

@@ -523,3 +523,35 @@ class TestManagingAmisDocFacts:
         for fld in ("amiSelectorTerms", "statusAMIs"):
             assert fld in self._doc(), fld
             assert fld in src, fld
+
+
+class TestUpgradingDocFacts:
+    def _doc(self):
+        return re.sub(r"\s+", " ",
+                      (DOCS.parent / "tasks" / "upgrading.md").read_text())
+
+    def test_hash_versions_match_code(self):
+        from karpenter_provider_aws_tpu.cloudprovider.cloudprovider import (
+            NODECLASS_HASH_VERSION)
+        from karpenter_provider_aws_tpu.controllers.provisioning import (
+            NODEPOOL_HASH_VERSION)
+        doc = self._doc()
+        assert f"currently `{NODEPOOL_HASH_VERSION}`" in doc
+        assert f"currently `{NODECLASS_HASH_VERSION}`" in doc
+
+    def test_lease_timings_match_code(self):
+        from karpenter_provider_aws_tpu.operator.leaderelection import (
+            LEASE_DURATION, RETRY_PERIOD)
+        doc = self._doc()
+        assert f"{LEASE_DURATION:.0f} s lease" in doc
+        assert f"{RETRY_PERIOD:.0f} s renew" in doc
+
+    def test_kompat_usage_is_real(self):
+        doc = self._doc()
+        assert "tools/kompat.py check" in doc
+        src = (DOCS.parent.parent / "tools" / "kompat.py").read_text()
+        assert '"check"' in src or "'check'" in src
+
+    def test_linked_pages_exist(self):
+        for rel in ("../reference/compatibility.md", "managing-amis.md"):
+            assert (DOCS.parent / "tasks" / rel).resolve().exists(), rel

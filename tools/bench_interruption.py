@@ -9,9 +9,13 @@ capacity, and the message mix exercises all four parsed schemas (spot
 interruption, rebalance recommendation, scheduled change, instance
 state-change).
 
-Usage: python tools/bench_interruption.py [depths...]
+Usage: python tools/bench_interruption.py [--api-mode] [depths...]
 Prints one JSON line per depth: messages/sec through a full
 receive→parse→handle→delete drain, plus handled/ICE'd counts.
+``--api-mode`` drives the same drain through the apiserver seam
+(claims created via the typed client, informer-fed mirror, writer
+deletions, events mirrored as wire objects) — the stratum the
+reference's controllers always run in.
 """
 
 from __future__ import annotations
@@ -38,20 +42,30 @@ DEPTHS = (100, 1_000, 5_000, 15_000)
 N_CLAIMS = 200
 
 
-def build_env(lattice):
+def build_env(lattice, api_mode: bool = False):
     clock = FakeClock()
     queue = FakeQueue("bench-interruptions")
+    kw = {}
+    if api_mode:
+        from karpenter_provider_aws_tpu.kube import FakeAPIServer
+        kw["api_server"] = FakeAPIServer(clock=clock)
     env = Operator(options=Options(), lattice=lattice, cloud=FakeCloud(clock),
                    clock=clock, node_pools=[NodePool(name="default")],
-                   interruption_queue=queue)
+                   interruption_queue=queue, **kw)
     zones = lattice.zones
     for i in range(N_CLAIMS):
-        env.cluster.add_claim(NodeClaim(
+        claim = NodeClaim(
             name=f"claim-{i}", node_pool="default",
             phase=NodeClaimPhase.INITIALIZED,
             provider_id=f"fake:///{zones[i % len(zones)]}/i-{i:08x}",
             instance_type="m5.xlarge", zone=zones[i % len(zones)],
-            capacity_type="spot"))
+            capacity_type="spot")
+        if api_mode:
+            env.kube.create_nodeclaim(claim)
+        else:
+            env.cluster.add_claim(claim)
+    if api_mode:
+        env.sync.sync_once()   # informer-feed the mirror
     return env
 
 
@@ -74,25 +88,31 @@ def seed_messages(env, depth: int) -> None:
 
 
 def drain(env) -> int:
-    """reconcile() until the queue is empty; returns messages handled."""
+    """reconcile() until the queue is empty; returns messages handled.
+    In API mode the informer pump runs inside the timed loop — the
+    deletions/ICE state flowing back into the mirror is part of what
+    the stratum costs."""
     handled = 0
     while len(env.interruption_queue):
         n = env.interruption.reconcile()
+        if env.sync is not None:
+            env.sync.sync_once()
         if n == 0:
             break
         handled += n
     return handled
 
 
-def run(depth: int, lattice) -> dict:
-    env = build_env(lattice)
+def run(depth: int, lattice, api_mode: bool = False) -> dict:
+    env = build_env(lattice, api_mode=api_mode)
     seed_messages(env, depth)
     t0 = time.perf_counter()
     handled = drain(env)
     wall = time.perf_counter() - t0
     ice = sum(1 for _ in env.unavailable.entries())
     return {
-        "metric": f"interruption_throughput_{depth}",
+        "metric": f"interruption_throughput_{depth}"
+                  + ("_api" if api_mode else ""),
         "value": round(handled / wall, 1),
         "unit": "msgs/sec",
         "detail": {
@@ -101,6 +121,7 @@ def run(depth: int, lattice) -> dict:
             "remaining": len(env.interruption_queue),
             "wall_ms": round(wall * 1000.0, 1),
             "ice_entries": ice,
+            "stratum": "api" if api_mode else "direct",
             "claims_drained": sum(
                 1 for c in env.cluster.snapshot_claims()
                 if c.deletion_timestamp is not None),
@@ -109,11 +130,14 @@ def run(depth: int, lattice) -> dict:
 
 
 def main() -> None:
-    depths = [int(a) for a in sys.argv[1:]] or list(DEPTHS)
+    args = sys.argv[1:]
+    api_mode = "--api-mode" in args
+    depths = [int(a) for a in args if a != "--api-mode"] or list(DEPTHS)
     lattice = build_lattice([s for s in build_catalog()
                              if s.family in ("m5", "c5", "r5")])
     for depth in depths:
-        print(json.dumps(run(depth, lattice)), flush=True)
+        print(json.dumps(run(depth, lattice, api_mode=api_mode)),
+              flush=True)
 
 
 if __name__ == "__main__":

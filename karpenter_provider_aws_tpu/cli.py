@@ -41,6 +41,14 @@ def build_parser() -> argparse.ArgumentParser:
         prog="karpenter-tpu-controller",
         description="TPU-native karpenter controller (device-solved "
                     "scheduling over an instance-type lattice).")
+    p.add_argument("--cluster-endpoint", default=None,
+                   help="apiserver endpoint for node bootstrap userdata "
+                        "(env CLUSTER_ENDPOINT; empty = discover from the "
+                        "cloud network, the reference's EKS fallback)")
+    p.add_argument("--assume-role-arn", default=None,
+                   help="role to assume for cloud calls "
+                        "(env ASSUME_ROLE_ARN; reference STS session "
+                        "layering)")
     p.add_argument("--cluster-name", default=None,
                    help="The cluster name for resource discovery "
                         "(env CLUSTER_NAME).")
@@ -145,6 +153,10 @@ def options_from_args(args: argparse.Namespace) -> Options:
     overrides = {}
     if args.cluster_name is not None:
         overrides["cluster_name"] = args.cluster_name
+    if args.cluster_endpoint is not None:
+        overrides["cluster_endpoint"] = args.cluster_endpoint
+    if args.assume_role_arn is not None:
+        overrides["assume_role_arn"] = args.assume_role_arn
     if args.vm_memory_overhead_percent is not None:
         overrides["vm_memory_overhead_percent"] = args.vm_memory_overhead_percent
     if args.reserved_enis is not None:

@@ -95,6 +95,10 @@ class FakeCloud:
         # bounded: a long-running daemon polls list/describe every pass
         self.calls: "collections.deque[Tuple[str, object]]" = \
             collections.deque(maxlen=10000)
+        # the session's assumed role, recorded by assume_role (the STS
+        # layering seam, reference operator.go:93-107); None = base
+        # credentials
+        self.assumed_role_arn: Optional[str] = None
         # the VPC/IAM/image surface (subnets, SGs, AMIs+SSM, profiles, LTs)
         self.network = FakeNetwork(cluster_name=cluster_name,
                                    k8s_version=k8s_version, ip_family=ip_family)
@@ -116,6 +120,14 @@ class FakeCloud:
             raise err
 
     # ---- APIs ------------------------------------------------------------
+
+    def assume_role(self, role_arn: str) -> None:
+        """Layer an assumed role onto the session (STS analog: every
+        later call runs 'as' this role; the fake just records it so the
+        operator's session wiring is observable)."""
+        with self._lock:
+            self.calls.append(("assume_role", role_arn))
+            self.assumed_role_arn = role_arn
 
     def create_fleet(self, overrides: Sequence[LaunchOverride],
                      tags: Optional[Dict[str, str]] = None) -> "FleetResult":

@@ -99,11 +99,23 @@ class Operator:
         from ..utils.logging import get_logger
         self.log = get_logger("operator")
         # startup discovery, logged once (the reference logs kube-dns and
-        # endpoint discovery at operator build, operator.go:125-132)
+        # endpoint discovery at operator build, operator.go:125-132); a
+        # configured CLUSTER_ENDPOINT wins over discovery
+        # (operator.go:224-236), and an assume-role ARN layers the cloud
+        # session (operator.go:93-107)
+        endpoint = (self.options.cluster_endpoint
+                    or self.cloud.network.cluster_endpoint)
         self.log.info("discovered cluster network",
-                      endpoint=self.cloud.network.cluster_endpoint,
+                      endpoint=endpoint,
+                      endpoint_source=("configured"
+                                       if self.options.cluster_endpoint
+                                       else "discovered"),
                       kube_dns=self.cloud.network.kube_dns_ip,
                       zones=self.lattice.Z, instance_types=self.lattice.T)
+        if self.options.assume_role_arn:
+            self.cloud.assume_role(self.options.assume_role_arn)
+            self.log.info("assuming role for cloud session",
+                          role_arn=self.options.assume_role_arn)
         self.recorder = Recorder(self.clock)
         self.metrics = Registry()
         wire_core_metrics(self.metrics)
@@ -172,8 +184,10 @@ class Operator:
         self.security_group_provider = SecurityGroupProvider(self.cloud, self.clock,
             cluster_name=self.options.cluster_name)
         self.instance_profile_provider = InstanceProfileProvider(self.cloud, self.clock)
-        self.ami_provider = AMIProvider(self.cloud, self.clock,
-                                        cluster_name=self.options.cluster_name)
+        self.ami_provider = AMIProvider(
+            self.cloud, self.clock,
+            cluster_name=self.options.cluster_name,
+            cluster_endpoint=self.options.cluster_endpoint or None)
         self.launch_template_provider = LaunchTemplateProvider(
             self.cloud, self.security_group_provider, self.instance_profile_provider,
             self.ami_provider, self.clock, cluster_name=self.options.cluster_name)

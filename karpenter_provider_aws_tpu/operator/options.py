@@ -34,6 +34,15 @@ def _env_bool(name: str, default: bool) -> bool:
 @dataclass
 class Options:
     cluster_name: str = "sim"
+    # apiserver endpoint handed to node bootstrap userdata. Empty =
+    # discover from the cloud's network description, like the
+    # reference's EKS describe-cluster fallback (operator.go:119-124,
+    # 224-236: the CLUSTER_ENDPOINT option wins when set)
+    cluster_endpoint: str = ""
+    # role to assume for every cloud call (reference operator.go:93-107
+    # STS assume-role session layering). The fake session records it;
+    # a real backend would chain credentials through it.
+    assume_role_arn: str = ""
     # VM memory the hypervisor eats before the OS sees it (options.go
     # VM_MEMORY_OVERHEAD_PERCENT, default 0.075)
     vm_memory_overhead_percent: float = 0.075
@@ -63,6 +72,12 @@ class Options:
     def validate(self) -> None:
         if not self.cluster_name:
             raise ValueError("cluster_name is required")
+        if self.cluster_endpoint and not self.cluster_endpoint.startswith(
+                "https://"):
+            # the reference validates the configured endpoint is a URL
+            # (options_validation.go); a bootstrap pointed at plaintext
+            # would fail far later and far less legibly
+            raise ValueError("cluster_endpoint must be an https:// URL")
         if not (0.0 <= self.vm_memory_overhead_percent < 1.0):
             raise ValueError("vm_memory_overhead_percent must be in [0, 1)")
         if self.batch_idle_duration < 0 or self.batch_max_duration < self.batch_idle_duration:
@@ -72,6 +87,8 @@ class Options:
     def from_env(**overrides) -> "Options":
         opts = Options(
             cluster_name=_env("CLUSTER_NAME", "sim", str),
+            cluster_endpoint=_env("CLUSTER_ENDPOINT", "", str),
+            assume_role_arn=_env("ASSUME_ROLE_ARN", "", str),
             vm_memory_overhead_percent=_env("VM_MEMORY_OVERHEAD_PERCENT", 0.075, float),
             reserved_enis=_env("RESERVED_ENIS", 0, int),
             isolated_vpc=_env_bool("ISOLATED_VPC", False),

@@ -190,9 +190,14 @@ def storage_config(node_class: NodeClass) -> "StorageConfig":
 
 class AMIProvider:
     def __init__(self, cloud: FakeCloud, clock: Optional[Clock] = None,
-                 cluster_name: str = "sim"):
+                 cluster_name: str = "sim",
+                 cluster_endpoint: Optional[str] = None):
+        """``cluster_endpoint`` overrides network discovery for node
+        bootstrap userdata (the reference's CLUSTER_ENDPOINT option,
+        operator.go:119-124; None = discover)."""
         self.cloud = cloud
         self.cluster_name = cluster_name
+        self.cluster_endpoint = cluster_endpoint
         self._cache = TTLCache(AMI_TTL, clock)
 
     def list(self, node_class: NodeClass, k8s_version: str) -> List[ResolvedAMI]:
@@ -240,7 +245,7 @@ class AMIProvider:
                                   cluster_dns: Optional[str] = None) -> List[LaunchParameters]:
         """One launch parameter set per resolved AMI (resolver.go:122-165)."""
         fam = resolve_ami_family(node_class.ami_family)
-        endpoint = self.cloud.network.cluster_endpoint
+        endpoint = self.cluster_endpoint or self.cloud.network.cluster_endpoint
         return [LaunchParameters(
                     ami=ami, arch=ami.arch,
                     user_data=fam.user_data(node_class, self.cluster_name,

@@ -370,3 +370,50 @@ class TestAsyncApiMode:
             "async API mode failed to bind pods"
         assert client.list_nodes()
         assert not runtime.error_counts, runtime.error_counts
+
+
+class TestClusterEndpointOverride:
+    """The configured CLUSTER_ENDPOINT wins over network discovery
+    (reference operator.go:119-124, 224-236)."""
+
+    def test_configured_endpoint_reaches_userdata(self):
+        from karpenter_provider_aws_tpu.lattice import (
+            build_catalog, build_lattice)
+        from karpenter_provider_aws_tpu.operator import Operator, Options
+        lat = build_lattice([s for s in build_catalog()
+                             if s.family == "m5"])
+        op = Operator(options=Options(
+            cluster_endpoint="https://override.example:443"), lattice=lat)
+        nc = op.node_classes["default"]
+        params = op.ami_provider.resolve_launch_parameters(nc, "1.29")
+        assert params
+        assert "https://override.example:443" in params[0].user_data
+        assert op.cloud.network.cluster_endpoint not in params[0].user_data
+
+    def test_discovery_remains_the_default(self):
+        from karpenter_provider_aws_tpu.lattice import (
+            build_catalog, build_lattice)
+        from karpenter_provider_aws_tpu.operator import Operator, Options
+        lat = build_lattice([s for s in build_catalog()
+                             if s.family == "m5"])
+        op = Operator(options=Options(), lattice=lat)
+        nc = op.node_classes["default"]
+        params = op.ami_provider.resolve_launch_parameters(nc, "1.29")
+        assert op.cloud.network.cluster_endpoint in params[0].user_data
+
+    def test_non_https_endpoint_rejected(self):
+        from karpenter_provider_aws_tpu.operator import Options
+        import pytest
+        with pytest.raises(ValueError):
+            Options.from_env(cluster_endpoint="http://plain.example")
+
+    def test_assume_role_recorded_on_session(self):
+        from karpenter_provider_aws_tpu.lattice import (
+            build_catalog, build_lattice)
+        from karpenter_provider_aws_tpu.operator import Operator, Options
+        lat = build_lattice([s for s in build_catalog()
+                             if s.family == "m5"])
+        op = Operator(options=Options(
+            assume_role_arn="arn:aws:iam::1:role/k"), lattice=lat)
+        assert op.cloud.assumed_role_arn == "arn:aws:iam::1:role/k"
+        assert ("assume_role", "arn:aws:iam::1:role/k") in op.cloud.calls

@@ -468,3 +468,22 @@ class TestKpctlDescribe:
         assert rc == 0
         assert "Scheduled" in out and "yours" in out
         assert "Launched" not in out and "not yours" not in out
+
+
+class TestKpctlYamlOutput:
+    def test_get_o_yaml_round_trips(self, api, capsys, monkeypatch):
+        import pathlib
+        monkeypatch.syspath_prepend(str(
+            pathlib.Path(__file__).resolve().parent.parent / "tools"))
+        import kpctl
+        import yaml
+        s, base = api
+        s.create("pods", serde.pod_to_dict(
+            Pod(name="y-pod", requests={"cpu": "2", "memory": "4Gi"})))
+        rc = kpctl.main(["--server", base, "get", "pods", "y-pod",
+                         "-o", "yaml"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        doc = yaml.safe_load(out)
+        assert doc["metadata"]["name"] == "y-pod"
+        assert doc["spec"]["requests"]["cpu"] == "2"

@@ -6,7 +6,7 @@ website getting-started flow drives `kubectl apply/get/delete`); this is
 the same surface against the control plane served by
 ``karpenter-tpu-controller --api-port`` (kube/httpserver.py routes):
 
-    kpctl get KIND [NAME] [-o json|wide]     k8s-style tables
+    kpctl get KIND [NAME] [-o json|yaml|wide]   k8s-style tables
     kpctl apply -f FILE                      create-or-update from YAML/JSON
     kpctl delete KIND NAME [--force]
     kpctl watch KIND [--resource-version N]  streamed events
@@ -169,8 +169,12 @@ def cmd_get(c: Client, args) -> int:
         objs = [obj]
     else:
         objs = c.request("GET", f"/apis/{args.kind}")["items"]
+    payload = objs if args.name is None else objs[0]
     if args.output == "json":
-        print(json.dumps(objs if args.name is None else objs[0], indent=2))
+        print(json.dumps(payload, indent=2))
+    elif args.output == "yaml":
+        import yaml
+        print(yaml.safe_dump(payload, sort_keys=False), end="")
     else:
         print_table(args.kind, objs, wide=args.output == "wide")
     return 0
@@ -294,7 +298,8 @@ def main(argv=None) -> int:
     g = sub.add_parser("get")
     g.add_argument("kind")
     g.add_argument("name", nargs="?")
-    g.add_argument("-o", "--output", choices=("table", "wide", "json"),
+    g.add_argument("-o", "--output",
+                   choices=("table", "wide", "json", "yaml"),
                    default="table")
     g.set_defaults(fn=cmd_get)
 

@@ -7,6 +7,7 @@ plane across a process boundary — the last step of the reference's
 ingest story (its controllers talk to a remote apiserver over REST;
 SURVEY §1 L0). Routes, mirroring the k8s path shapes:
 
+    GET    /apis                           discovery → {kinds: [...]}
     GET    /apis/{kind}                    list → {items, resourceVersion}
     GET    /apis/{kind}?watch=1&resourceVersion=N
                                            chunked JSON-lines watch stream
@@ -46,8 +47,9 @@ from typing import Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from .apiserver import (
-    AlreadyExistsError, APIError, ConflictError, EvictionBlockedError,
-    FakeAPIServer, InvalidObjectError, NotFoundError, TooOldError,
+    KINDS, AlreadyExistsError, APIError, ConflictError,
+    EvictionBlockedError, FakeAPIServer, InvalidObjectError, NotFoundError,
+    TooOldError,
 )
 
 WATCH_HEARTBEAT_SECONDS = 15.0
@@ -191,6 +193,11 @@ def serve(server: FakeAPIServer, port: int = 0,
         def do_GET(self):
             try:
                 url = urlparse(self.path)
+                # discovery: the kubectl api-resources flow (a real
+                # apiserver serves its group/resource lists under /apis)
+                if url.path.rstrip("/") == "/apis":
+                    self._json(200, {"kinds": list(KINDS)})
+                    return
                 kind, name, sub = _route(url.path)
                 if sub is not None:
                     raise NotFoundError(f"no route {url.path}")

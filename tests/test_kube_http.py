@@ -487,3 +487,23 @@ class TestKpctlYamlOutput:
         doc = yaml.safe_load(out)
         assert doc["metadata"]["name"] == "y-pod"
         assert doc["spec"]["requests"]["cpu"] == "2"
+
+
+class TestDiscovery:
+    def test_apis_lists_served_kinds(self, api):
+        from karpenter_provider_aws_tpu.kube.apiserver import KINDS
+        _, base = api
+        code, doc = req("GET", f"{base}/apis")
+        assert code == 200
+        assert doc["kinds"] == list(KINDS)
+
+    def test_kpctl_api_resources(self, api, capsys, monkeypatch):
+        import pathlib
+        monkeypatch.syspath_prepend(str(
+            pathlib.Path(__file__).resolve().parent.parent / "tools"))
+        import kpctl
+        _, base = api
+        rc = kpctl.main(["--server", base, "api-resources"])
+        out = capsys.readouterr().out.split()
+        assert rc == 0
+        assert "nodepools" in out and "events" in out

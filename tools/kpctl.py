@@ -12,6 +12,7 @@ the same surface against the control plane served by
     kpctl watch KIND [--resource-version N]  streamed events
     kpctl evict POD [--force]
     kpctl describe KIND NAME                 object + its recorded events
+    kpctl api-resources                      served kinds (discovery)
 
 Connection flags mirror kubectl's: --server (or KPCTL_SERVER), bearer
 auth via --token/--token-file, TLS via --cacert (self-signed material
@@ -225,6 +226,13 @@ def cmd_watch(c: Client, args) -> int:
     return 0
 
 
+def cmd_api_resources(c: Client, args) -> int:
+    """kubectl api-resources analog: the kinds the server serves."""
+    for k in c.request("GET", "/apis")["kinds"]:
+        print(k)
+    return 0
+
+
 def cmd_describe(c: Client, args) -> int:
     """kubectl-describe analog: the object plus its recorded events
     (the `events` kind the control plane mirrors in API mode)."""
@@ -331,6 +339,9 @@ def main(argv=None) -> int:
     ds.add_argument("kind")
     ds.add_argument("name")
     ds.set_defaults(fn=cmd_describe)
+
+    ar = sub.add_parser("api-resources")
+    ar.set_defaults(fn=cmd_api_resources)
 
     args = p.parse_args(argv)
     if not args.server:

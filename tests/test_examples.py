@@ -1,0 +1,103 @@
+"""Every shipped example applies cleanly — and does what it says.
+
+The reference's examples/ gallery is untested YAML; ours is pinned:
+each file round-trips kpctl's document loader and the apiserver's full
+admission chain (schema + webhooks), and the scenario-bearing ones are
+exercised against the solver so the example's *behavior* is true, not
+just its syntax.
+"""
+
+import pathlib
+import sys
+
+import pytest
+
+from karpenter_provider_aws_tpu.kube import FakeAPIServer, install_admission
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+EXAMPLES = sorted((REPO / "examples").rglob("*.yaml"))
+
+sys.path.insert(0, str(REPO / "tools"))
+import kpctl  # noqa: E402  (the SHIPPED loader — what apply -f runs)
+
+
+def load_documents(path):
+    return kpctl.load_documents(str(path))
+
+
+@pytest.mark.parametrize("path", EXAMPLES, ids=lambda p: p.name)
+def test_example_passes_admission(path):
+    from karpenter_provider_aws_tpu.apis import serde
+    from karpenter_provider_aws_tpu.apis.resources import resources_to_vec
+    s = FakeAPIServer()
+    install_admission(s)
+    docs = load_documents(path)
+    assert docs, f"{path} holds no documents"
+    for d in docs:
+        assert set(d) == {"kind", "spec"}, f"{path}: non-wire document"
+        s.create(d["kind"], d["spec"])   # raises InvalidObjectError on drift
+        assert s.get(d["kind"], d["spec"]["name"])
+        if d["kind"] == "pods":
+            # no admission hook is installed for pods — validate via the
+            # typed round-trip instead, and require REAL resource demand
+            # (a typo'd requests key would silently stop inflating)
+            pod = serde.pod_from_dict(d["spec"])
+            assert resources_to_vec(pod.requests).sum() > 0, d["spec"]
+
+
+def test_readme_table_lists_every_file():
+    readme = (REPO / "examples" / "README.md").read_text()
+    for p in EXAMPLES:
+        rel = p.relative_to(REPO / "examples")
+        assert str(rel) in readme, f"examples/README.md misses {rel}"
+
+
+def test_general_purpose_example_schedules_a_pod():
+    """The flagship example provisions: its pool serves a generic pod
+    with a current-generation m/c/r type."""
+    from karpenter_provider_aws_tpu.apis import Pod, serde
+    from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+    from karpenter_provider_aws_tpu.operator import Operator, Options
+    from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+    docs = load_documents(REPO / "examples" / "general-purpose.yaml")
+    pools = [serde.nodepool_from_dict(d["spec"]) for d in docs
+             if d["kind"] == "nodepools"]
+    classes = {d["spec"]["name"]: serde.nodeclass_from_dict(d["spec"])
+               for d in docs if d["kind"] == "nodeclasses"}
+    lat = build_lattice([s for s in build_catalog()
+                         if s.family in ("m5", "c5", "t3", "m6g")])
+    op = Operator(options=Options(cluster_name="my-cluster",
+                                  registration_delay=1.0),
+                  lattice=lat, clock=FakeClock(),
+                  node_pools=pools, node_classes=classes)
+    op.cluster.add_pod(Pod(name="w0",
+                           requests={"cpu": "1", "memory": "2Gi"}))
+    op.settle()
+    node = next(iter(op.cluster.nodes.values()))
+    assert node.node_pool == "general-purpose"
+    # the pool's requirements held — asserted on the node's own labels
+    # so each requirement is checked directly, not via lattice contents
+    assert node.labels["karpenter.k8s.aws/instance-category"] in (
+        "c", "m", "r")
+    assert int(node.labels["karpenter.k8s.aws/instance-generation"]) > 2
+
+
+def test_spot_example_launches_spot():
+    from karpenter_provider_aws_tpu.apis import Pod, serde
+    from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+    from karpenter_provider_aws_tpu.operator import Operator, Options
+    from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+    docs = load_documents(REPO / "examples" / "spot.yaml")
+    pools = [serde.nodepool_from_dict(d["spec"]) for d in docs
+             if d["kind"] == "nodepools"]
+    lat = build_lattice([s for s in build_catalog()
+                         if s.family in ("m5", "c5", "r5")])
+    op = Operator(options=Options(registration_delay=1.0), lattice=lat,
+                  clock=FakeClock(), node_pools=pools)
+    op.cluster.add_pod(Pod(name="w0",
+                           requests={"cpu": "1", "memory": "2Gi"}))
+    op.settle()
+    claim = next(iter(op.cluster.claims.values()))
+    assert claim.capacity_type == "spot"

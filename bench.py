@@ -1,4 +1,5 @@
-"""Benchmarks: the five BASELINE configs + the FFD-beat config, e2e.
+"""Benchmarks: the five BASELINE configs + the FFD-beat config + the
+high-G wave-split degradation config, e2e.
 
 Runs on the REAL EC2 catalog by default (759 types imported from the
 reference's own data tables — instance-types.md joined with the
@@ -224,6 +225,22 @@ def config6_ffd_beat():
     return pods, _pools_default(), []
 
 
+def config7_highG_wave_split():
+    """The adversarial-diversity wave: ≥4,096 DISTINCT scheduling
+    signatures, so grouping cannot collapse the batch, the group axis
+    overflows the largest compiled bucket, and the solve exercises the
+    wave-split planner (docs/concepts/degradation.md). Unique cpu
+    requests defeat signature dedup exactly the way a pathologically
+    heterogeneous tenant mix would; the row records wave-split latency
+    and its cost envelope vs the sequential FFD referee."""
+    from karpenter_provider_aws_tpu.apis import Pod
+    pods = [Pod(name=f"hg{i}",
+                requests={"cpu": f"{100 + i}m",
+                          "memory": f"{256 + (i % 8) * 64}Mi"})
+            for i in range(4608)]
+    return pods, _pools_default(), []
+
+
 def build_bench_problem():
     """Back-compat hook (tests + driver round 1): the config-5 problem."""
     from karpenter_provider_aws_tpu.lattice import build_lattice
@@ -351,7 +368,7 @@ def pallas_parity_check(lattice) -> dict:
 
 
 def run_config(key, make, lattice, solver, uncapped_referee=False,
-               also_uncapped=False):
+               also_uncapped=False, iters=ITERS):
     from karpenter_provider_aws_tpu.solver import build_problem
     pods, pools, existing = make()
     n_pods = len(pods)
@@ -364,7 +381,7 @@ def run_config(key, make, lattice, solver, uncapped_referee=False,
     assert scheduled + len(plan.unschedulable) == n_pods
 
     e2e_ms, dev_ms, rtt_ms = [], [], []
-    for _ in range(ITERS):
+    for _ in range(iters):
         t0 = time.perf_counter()
         problem = build_problem(pods, pools, lattice, existing=existing)
         plan = solver.solve(problem)
@@ -417,6 +434,11 @@ def run_config(key, make, lattice, solver, uncapped_referee=False,
         "cost_vs_ffd_oracle": cost_ratio,
         "referee": referee,
     }
+    if plan.solver_path != "device":
+        # degradation-ladder provenance (the high-G row): which rung
+        # produced the plan and how many waves the group axis split into
+        detail["solver_path"] = plan.solver_path
+        detail["waves"] = plan.waves
     if uncapped_referee:
         detail["referee_problem"] = "uncapped"
         detail["ffd_cost_per_hour"] = round(ref_cost, 2)
@@ -503,14 +525,14 @@ def main(argv=None):
     pallas = pallas_parity_check(lattice)
 
     def _emit(key, make, lattice, solver, uncapped_referee=False,
-              cname=None, cfg5=False, pallas_detail=None):
+              cname=None, cfg5=False, pallas_detail=None, iters=ITERS):
         # EVERY row records both views: parity vs FFD on the same
         # problem, and cost vs what the reference heuristic would build
         # (cfg4's all-on-existing repack skips the latter via the
         # un_cost > 0 guard — both sides open zero new nodes)
         e2e_p50, detail = run_config(key, make, lattice, solver,
                                      uncapped_referee=uncapped_referee,
-                                     also_uncapped=True)
+                                     also_uncapped=True, iters=iters)
         detail["start_link_rtt_ms"] = link_rtt
         detail["catalog"] = cname or catalog_name
         if cfg5:
@@ -537,6 +559,10 @@ def main(argv=None):
         _emit(key, make, lattice, solver)
     _emit("cfg6_ffd_beat_mixed_waves", config6_ffd_beat, lattice, solver,
           uncapped_referee=True)
+    # the high-G degradation row: >4,096 distinct signatures force the
+    # wave-split planner; fewer iters — each sample is a multi-wave solve
+    _emit("cfg7_highG_wave_split", config7_highG_wave_split, lattice,
+          solver, iters=5)
 
     # cross-catalog continuity: the SAME cfg5 problem on the other
     # catalog, so round-over-round comparisons survive the default flip

@@ -265,6 +265,13 @@ def plan_to_dict(plan) -> Dict:
         "solveSeconds": plan.solve_seconds,
         "deviceSeconds": plan.device_seconds,
         "warnings": list(plan.warnings),
+        # degradation provenance crosses the wire so a sidecar client's
+        # controller observes degraded mode exactly like an in-process one
+        "degraded": plan.degraded,
+        "degradedReason": plan.degraded_reason,
+        "solverPath": plan.solver_path,
+        "waves": plan.waves,
+        "deviceRetries": plan.device_retries,
     }
 
 
@@ -288,6 +295,11 @@ def plan_from_dict(d: Mapping):
         solve_seconds=d.get("solveSeconds", 0.0),
         device_seconds=d.get("deviceSeconds", 0.0),
         warnings=list(d.get("warnings", ())),
+        degraded=bool(d.get("degraded", False)),
+        degraded_reason=d.get("degradedReason", ""),
+        solver_path=d.get("solverPath", "device"),
+        waves=int(d.get("waves", 1)),
+        device_retries=int(d.get("deviceRetries", 0)),
     )
 
 # ---- node / nodeclaim / nodeclass / pdb / lease (apiserver wire) -----------

@@ -85,6 +85,12 @@ class ProvisionResult:
     launch_failures: int = 0
     pods_scheduled: int = 0
     pods_unschedulable: int = 0
+    # degradation provenance of the pass (docs/concepts/degradation.md):
+    # True when any solve left the primary device path, or when the solve
+    # itself failed and the pass returned a PARTIAL result (pods stay
+    # pending for the next pass instead of the wave being dropped)
+    degraded: bool = False
+    degraded_reason: str = ""
 
 
 class Provisioner:
@@ -120,6 +126,9 @@ class Provisioner:
         self._m_unsched_pods = m["pods_unschedulable"]
         self._m_created = m["nodeclaims_created"]
         self._m_launched = m["nodeclaims_launched"]
+        self._m_degraded = m["solver_degraded"]
+        self._m_solver_retries = m["solver_device_retries"]
+        self._m_waves = m["solver_waves"]
         self._claim_ids = itertools.count(1)
         self._batch_start: Optional[float] = None
         self._last_pod_seen: Optional[float] = None
@@ -172,17 +181,25 @@ class Provisioner:
         # one usage snapshot serves the whole pass: the initial solve's
         # headroom, every _enforce_limits round, and every retry's headroom
         pass_usage = self.cluster.pool_usage()
-        plan = self.solver.solve_relaxed(
-            pending, list(self.node_pools.values()), lattice,
-            existing=self.cluster.existing_bins(lattice),
-            daemonset_pods=self.cluster.daemonset_pods(),
-            bound_pods=self.cluster.bound_pods(),
-            pvcs=pvcs, storage_classes=storage_classes,
-            pool_headroom=self._pool_headroom(pass_usage))
+        try:
+            plan = self.solver.solve_relaxed(
+                pending, list(self.node_pools.values()), lattice,
+                existing=self.cluster.existing_bins(lattice),
+                daemonset_pods=self.cluster.daemonset_pods(),
+                bound_pods=self.cluster.bound_pods(),
+                pvcs=pvcs, storage_classes=storage_classes,
+                pool_headroom=self._pool_headroom(pass_usage))
+        except Exception as e:
+            # the solve ladder already absorbs device failures; anything
+            # that still escapes must not kill the reconcile loop. Report a
+            # PARTIAL (empty) result — the pods stay pending and the next
+            # pass retries — instead of dropping the wave with a crash.
+            return self._solve_failed(e, len(pending))
         self._m_batch.observe(len(pending))
         self._m_sched.observe(plan.solve_seconds)
         self._m_sim.observe(plan.device_seconds)
         result = ProvisionResult(plan=plan)
+        self._observe_solver_health(plan, result)
 
         def surface_unschedulable(p: NodePlan) -> None:
             for name, reason in p.unschedulable.items():
@@ -240,13 +257,21 @@ class Provisioner:
                             f"nodepool {n.node_pool} limit exceeded")
                     result.pods_unschedulable += len(live)
                 break
-            current = self.solver.solve_relaxed(
-                retry_pods, pools_left, lattice,
-                existing=self.cluster.existing_bins(lattice),
-                daemonset_pods=self.cluster.daemonset_pods(),
-                bound_pods=self.cluster.bound_pods(),
-                pvcs=pvcs, storage_classes=storage_classes,
-                pool_headroom=self._pool_headroom(pass_usage))
+            try:
+                current = self.solver.solve_relaxed(
+                    retry_pods, pools_left, lattice,
+                    existing=self.cluster.existing_bins(lattice),
+                    daemonset_pods=self.cluster.daemonset_pods(),
+                    bound_pods=self.cluster.bound_pods(),
+                    pvcs=pvcs, storage_classes=storage_classes,
+                    pool_headroom=self._pool_headroom(pass_usage))
+            except Exception as e:
+                # a failed limit-fallback re-solve degrades to a partial
+                # pass: keep everything already planned/bound, leave the
+                # retry pods pending for the next pass
+                self._note_solve_failure(e, result)
+                break
+            self._observe_solver_health(current, result)
             surface_unschedulable(current)
             bind_existing(current)
         for node in planned:
@@ -290,6 +315,44 @@ class Provisioner:
                 result.created_claims.pop()
         self._m_sched_pods.inc(result.pods_scheduled)
         self._m_unsched_pods.set(result.pods_unschedulable)
+        return result
+
+    # ---- degradation observation (docs/concepts/degradation.md) ----------
+
+    def _observe_solver_health(self, plan: NodePlan,
+                               result: ProvisionResult) -> None:
+        """Mirror a plan's degradation provenance into the metric surface
+        and the event stream — the operator-facing signal that the solve
+        left the primary device path."""
+        if plan.device_retries:
+            self._m_solver_retries.inc(plan.device_retries)
+        self._m_waves.observe(plan.waves)
+        if plan.degraded:
+            reason = plan.degraded_reason or "unknown"
+            self._m_degraded.inc(path=plan.solver_path, reason=reason)
+            result.degraded = True
+            result.degraded_reason = result.degraded_reason or reason
+            self.recorder.publish(
+                "Warning", "SolverDegraded", "Provisioner", "default",
+                f"solve degraded to {plan.solver_path} ({reason}, "
+                f"{plan.waves} wave(s))")
+
+    def _note_solve_failure(self, e: Exception,
+                            result: ProvisionResult) -> None:
+        self._m_degraded.inc(path="none", reason="solve-error")
+        result.degraded = True
+        result.degraded_reason = result.degraded_reason or "solve-error"
+        self.recorder.publish("Warning", "SolverFailed", "Provisioner",
+                              "default", f"{type(e).__name__}: {e}")
+
+    def _solve_failed(self, e: Exception, n_pending: int) -> ProvisionResult:
+        result = ProvisionResult(plan=None)
+        self._note_solve_failure(e, result)
+        # the early return skips the end-of-pass gauge update: reflect the
+        # whole stuck batch as unschedulable so dashboards show the outage's
+        # blast radius instead of freezing at the previous pass's value
+        result.pods_unschedulable = n_pending
+        self._m_unsched_pods.set(n_pending)
         return result
 
     @staticmethod

@@ -43,6 +43,54 @@ class RateLimitedError(CloudError):
     pass
 
 
+# ---- solver degradation taxonomy -------------------------------------------
+#
+# The device solve path can fail in ways the cloud taxonomy above never
+# names: a problem whose group axis exceeds the largest compiled bucket,
+# a bin table that cannot grow past its top bucket, an XLA compile error
+# or device OOM on the pack call. Each is classified here so the solve
+# ladder (solver/solve.py) can decide mechanically: capacity errors are
+# NEVER retryable on the same path (the same input will exceed the same
+# ceiling again) and route straight to the next degradation tier;
+# device errors are presumed transient and earn a bounded retry before
+# the host-FFD fallback engages.
+
+
+class SolverError(Exception):
+    """Base class for solver-path failures. ``retryable`` says whether
+    re-running the SAME path with the SAME input could succeed."""
+
+    retryable = False
+
+
+class SolverCapacityError(SolverError):
+    """The problem exceeds a structural ceiling of the device path (group
+    bucket, bin-table growth exhausted). Terminal for that path: retrying
+    cannot help, only degrading to wave-split or host FFD can."""
+
+    retryable = False
+
+    def __init__(self, message: str, axis: str = ""):
+        super().__init__(message)
+        self.axis = axis   # "G" | "B" | "" — which ceiling was hit
+
+
+class SolverDeviceError(SolverError):
+    """The device call itself failed (XLA compile error, device OOM,
+    transfer failure). Presumed transient: the ladder retries once with
+    backoff before falling back to the host path."""
+
+    retryable = True
+
+    def __init__(self, message: str, cause: BaseException = None):
+        super().__init__(message)
+        self.cause = cause
+
+
+def is_retryable_solver_error(err: BaseException) -> bool:
+    return isinstance(err, SolverError) and err.retryable
+
+
 def is_not_found(err: BaseException) -> bool:
     return isinstance(err, NotFoundError)
 

@@ -249,6 +249,14 @@ class FakeAPIServer:
                 raise NotFoundError(f"{kind}/{name} not found")
             return copy.deepcopy(obj)
 
+    def now(self) -> float:
+        """The server's clock reading — the timebase every timestamp the
+        server stamps (creationTimestamp, deletionTimestamp, event times)
+        lives on. Clients rendering ages must anchor to THIS, not their
+        own wall clock: under a FakeClock (or plain clock skew) the two
+        can differ arbitrarily."""
+        return self._clock.now() if self._clock is not None else _time.time()
+
     def list(self, kind: str) -> Tuple[List[dict], int]:
         """Returns (items, listResourceVersion) — watch from the returned
         RV to observe every later change exactly once."""

@@ -164,6 +164,10 @@ def serve(server: FakeAPIServer, port: int = 0,
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            # every response carries the server clock, so clients can
+            # anchor age rendering even off single-object GETs (the
+            # list-body serverTime field covers only list responses)
+            self.send_header("X-Server-Time", repr(server.now()))
             self.end_headers()
             self.wfile.write(body)
 
@@ -209,7 +213,11 @@ def serve(server: FakeAPIServer, port: int = 0,
                     self._watch(kind, int(q.get("resourceVersion", ["0"])[0]))
                     return
                 items, rv = server.list(kind)
-                self._json(200, {"items": items, "resourceVersion": rv})
+                # serverTime lets clients (kpctl) anchor AGE/LAST SEEN
+                # columns to the clock that stamped the timestamps,
+                # instead of their own wall clock
+                self._json(200, {"items": items, "resourceVersion": rv,
+                                 "serverTime": server.now()})
             except Exception as e:
                 self._error(e)
 

@@ -240,6 +240,26 @@ def wire_core_metrics(reg: Registry) -> Dict[str, _Metric]:
         "pods_unschedulable": reg.gauge(
             "karpenter_pods_unschedulable",
             "Pods the last scheduling pass could not place.", ()),
+        # the solver degradation ladder (docs/concepts/degradation.md):
+        # device solve → wave-split → host FFD. Operators alarm on the
+        # degraded counter; the wave histogram shows how often the group
+        # axis overflows; the retry counter separates transient device
+        # weather from real fallbacks.
+        "solver_degraded": reg.counter(
+            "karpenter_solver_degraded_total",
+            "Scheduling passes that left the primary device-solve path, "
+            "by degradation rung (path: wave-split | host-ffd | none) and "
+            "reason (g-overflow | b-exhausted | device-error | "
+            "internal-error | solve-error).", ("path", "reason")),
+        "solver_device_retries": reg.counter(
+            "karpenter_solver_device_retries_total",
+            "Transient device-solve failures retried before any fallback "
+            "engaged.", ()),
+        "solver_waves": reg.histogram(
+            "karpenter_solver_wave_count",
+            "Waves per scheduling solve (1 = one device pass; >1 = the "
+            "group axis wave-split).", (),
+            buckets=(1, 2, 4, 8, 16, 32, 64)),
         # reference metrics.md:62,16,19
         "pods_startup_time": reg.histogram(
             "karpenter_pods_startup_time_seconds",

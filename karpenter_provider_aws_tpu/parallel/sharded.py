@@ -35,6 +35,17 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops import binpack
 
+if hasattr(jax, "shard_map"):          # jax >= 0.6: top-level, check_vma kwarg
+    def _shard_map(f, *, mesh, in_specs, out_specs):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+else:                                  # jax 0.4/0.5: experimental, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def _shard_map(f, *, mesh, in_specs, out_specs):
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=False)
+
 
 def split_counts(count: np.ndarray, n_devices: int,
                  keep_whole: Optional[np.ndarray] = None,
@@ -136,12 +147,11 @@ def sharded_pack(mesh: Mesh, alloc, avail, price, gbuf, init_buf,
         n_existing = 0
     dims = (B, G, T, Z, C, NP, A, alloc.shape[1])
     repl = P()
-    fn = jax.shard_map(
+    fn = _shard_map(
         partial(_local_pack, alloc, avail, price, dims),
         mesh=mesh,
         in_specs=(repl, P("pods"), repl, repl),
         out_specs=(P("pods"), repl, repl, repl),
-        check_vma=False,
     )
     return ShardedPack(*jax.jit(fn)(
         jnp.asarray(gbuf), jnp.asarray(count_split), jnp.asarray(init_buf),

@@ -543,15 +543,19 @@ def _selector_keys(pods: Sequence[Pod], bound_pods: Sequence[BoundPod]) -> froze
     # read directly: a plain attribute load first scans the type (miss —
     # default_factory fields leave no class attribute) before the
     # instance dict, and at 50k pods the two skipped type scans per pod
-    # are another measurable slice of the build budget.
+    # are another measurable slice of the build budget. ``.get`` (not
+    # indexing): a Pod built without __init__ (object.__new__ +
+    # piecemeal assignment, serde fast paths, test doubles) may lack the
+    # keys entirely, and a missing selector field must read as "no
+    # selectors", not KeyError.
     for p in pods:
         d = p.__dict__
-        if d["pod_affinity"] or d["topology_spread"]:
+        if d.get("pod_affinity") or d.get("topology_spread"):
             cached = d.get("_kpat_selkeys")
             upd(cached if cached is not None else fill(p))
     for bp in bound_pods:
         d = bp.pod.__dict__
-        if d["pod_affinity"] or d["topology_spread"]:
+        if d.get("pod_affinity") or d.get("topology_spread"):
             cached = d.get("_kpat_selkeys")
             upd(cached if cached is not None else fill(bp.pod))
     return frozenset(keys)

@@ -8,7 +8,14 @@ reference spreads across its provisioner loop:
 - bin-table overflow retry with the next bucket size,
 - NodePlan decoding: bin table + assignment matrix → named NodeClaims-to-be
   (instance type, zone, capacity type, price, pod list per node), existing
-  node assignments, and per-pod unschedulable reasons.
+  node assignments, and per-pod unschedulable reasons,
+- the graceful-degradation ladder (docs/concepts/degradation.md): a batch
+  whose group axis exceeds the largest compiled bucket is wave-split into
+  bucket-sized waves carrying open-bin state between them; any device-path
+  failure (G overflow under an injected ceiling, bin-table growth
+  exhaustion, XLA compile error, device OOM) lands on a pure-host
+  sequential FFD fallback (solver/oracle.py) after a bounded retry —
+  adversarial input degrades latency, never availability.
 
 The decoded NodePlan is what the provisioning controller turns into
 NodeClaims and hands to the CloudProvider (the reference's scheduler →
@@ -19,7 +26,7 @@ from __future__ import annotations
 
 import hashlib
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import threading
@@ -29,8 +36,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..apis.resources import R
+from ..errors import (SolverCapacityError, SolverDeviceError, SolverError,
+                      is_retryable_solver_error)
 from ..lattice.tensors import Lattice
 from ..ops import binpack
+from .faults import FaultInjector
 from .problem import Problem
 
 _G_BUCKETS = (16, 32, 64, 96, 128, 192, 256, 512, 1024, 4096)
@@ -79,6 +89,16 @@ class NodePlan:
     solve_seconds: float
     device_seconds: float
     warnings: List[str] = field(default_factory=list)
+    # degradation-ladder provenance (docs/concepts/degradation.md): which
+    # rung produced this plan, and what pushed the solve off the primary
+    # device path. ``degraded_reason`` is a bounded enum ("g-overflow",
+    # "b-exhausted", "device-error", "internal-error") so it can ride a
+    # metric label; the human detail lands in ``warnings``.
+    degraded: bool = False
+    degraded_reason: str = ""
+    solver_path: str = "device"                  # device | wave-split | host-ffd
+    waves: int = 1
+    device_retries: int = 0
 
     @property
     def num_new_nodes(self) -> int:
@@ -275,8 +295,52 @@ class Solver:
         # the same pending set every pass (bench: every iteration), and the
         # [G,T,R] fit scan costs ~10 ms host time per 80-group problem
         self._est_cache: Dict[bytes, int] = {}
+        # degradation ladder state: an optional FaultInjector (tests/soaks
+        # force each failure mode deterministically) and plain counters of
+        # every off-primary-path event — the provisioning controller mirrors
+        # these into the karpenter_solver_degraded_total metric family
+        self.faults: Optional[FaultInjector] = None
+        self.degraded_counts: Dict[str, int] = {}
 
     _EST_CACHE_MAX = 128
+    _DEVICE_RETRIES = 1          # transient device failures retried this often
+    _RETRY_BACKOFF_SECONDS = 0.05
+    _WAVE_G_TARGET = 1024        # per-wave group budget (a warm-ish bucket:
+                                 # smaller compiles than the 4096 top bucket,
+                                 # still few waves for realistic overflows)
+
+    # ---- degradation ladder plumbing ----
+
+    def inject_faults(self, faults: Optional[FaultInjector]) -> None:
+        """Attach (or clear) a FaultInjector; see solver/faults.py."""
+        with self._solve_lock:
+            self.faults = faults
+
+    def _count_degraded(self, key: str) -> None:
+        self.degraded_counts[key] = self.degraded_counts.get(key, 0) + 1
+
+    def _g_ceiling(self) -> int:
+        """Effective group-axis ceiling: the largest compiled bucket, or an
+        injected fake ceiling so tests exercise wave-split at small G."""
+        top = _G_BUCKETS[-1]
+        f = self.faults
+        if f is not None and f.g_limit:
+            return max(1, min(int(f.g_limit), top))
+        return top
+
+    def _b_ceiling(self) -> int:
+        """Effective bin-table ceiling (snapped down to a bucket value)."""
+        top = _B_BUCKETS[-1]
+        f = self.faults
+        if f is not None and f.b_limit:
+            snapped = [b for b in _B_BUCKETS if b <= int(f.b_limit)]
+            return snapped[-1] if snapped else _B_BUCKETS[0]
+        return top
+
+    def _maybe_inject_device_fault(self) -> None:
+        f = self.faults
+        if f is not None and f.take_device_error():
+            raise SolverDeviceError("injected device fault")
 
     def _estimate_bins(self, problem: Problem) -> int:
         key = None
@@ -651,6 +715,12 @@ class Solver:
         max_rounds = min(1 + sum(depth.values()), 64)
         best = None
         total_solve = total_device = 0.0
+        # degradation provenance aggregates across rounds: the returned
+        # plan reports the WORST rung any round landed on, so one degraded
+        # relaxation round is never laundered into a clean-looking plan
+        path_order = {"device": 0, "wave-split": 1, "host-ffd": 2}
+        worst_path, any_degraded, reasons = "device", False, []
+        total_retries, max_waves = 0, 1
         for _ in range(max_rounds):
             eff = [p if relax.get(p.name, 0) == 0 else relax_pod(p, relax[p.name])
                    for p in pods]
@@ -662,6 +732,14 @@ class Solver:
             plan = self.solve(problem, mesh=mesh)
             total_solve += plan.solve_seconds
             total_device += plan.device_seconds
+            total_retries += plan.device_retries
+            max_waves = max(max_waves, plan.waves)
+            if plan.degraded:
+                any_degraded = True
+                if plan.degraded_reason and plan.degraded_reason not in reasons:
+                    reasons.append(plan.degraded_reason)
+            if path_order.get(plan.solver_path, 0) > path_order[worst_path]:
+                worst_path = plan.solver_path
             # a relaxation round re-packs globally and may regress a pod
             # relaxation cannot help — keep the best plan seen, not the last
             if best is None or ((len(plan.unschedulable), plan.new_node_cost)
@@ -678,21 +756,89 @@ class Solver:
                 relax[n] = relax.get(n, 0) + 1
         best.solve_seconds = total_solve
         best.device_seconds = total_device
+        best.degraded = any_degraded
+        best.degraded_reason = reasons[0] if reasons else best.degraded_reason
+        best.solver_path = worst_path
+        best.device_retries = total_retries
+        best.waves = max_waves
         return best
 
     @_locked
     def solve(self, problem: Problem, mesh=None) -> NodePlan:
-        """Solve a problem into a NodePlan.
+        """Solve a problem into a NodePlan, degrading gracefully.
 
         ``mesh`` (a 1-D ``jax.sharding.Mesh`` over a 'pods' axis) shards the
         pod dimension across devices — the scale-out path for 50k+ pod waves
         (the reference handles this axis with batching windows on one Go
         core; here it is data-parallel over ICI, SURVEY.md §2.3).
+
+        The degradation ladder (docs/concepts/degradation.md): the primary
+        device solve; a group axis past the largest compiled bucket goes
+        through the wave-split planner (still on device); any device-path
+        failure — capacity ceiling, XLA compile error, device OOM — earns a
+        bounded retry for transient errors and then lands on the pure-host
+        sequential FFD fallback. The ladder never raises for input shape or
+        device health: adversarial batches degrade in latency, not
+        availability.
         """
         t0 = time.perf_counter()
         if problem.G == 0:
             return NodePlan([], {}, dict(problem.unschedulable), 0.0,
                             time.perf_counter() - t0, 0.0)
+        retries = 0
+        while True:
+            try:
+                if problem.G > self._g_ceiling():
+                    # provenance counts ONCE per solve, not per retry
+                    # attempt — these are the counters soaks assert on
+                    if retries == 0:
+                        self._count_degraded("wave_split")
+                        if self.faults is not None and self.faults.g_limit:
+                            self.faults.note("g_overflow")
+                    plan = self._solve_waves(problem, mesh, t0)
+                else:
+                    plan = self._solve_device(problem, mesh, t0)
+                plan.device_retries = retries
+                return plan
+            except SolverCapacityError as e:
+                # structural ceiling: retrying the same path cannot help
+                reason = "b-exhausted" if e.axis == "B" else "g-overflow"
+                detail = str(e)
+                break
+            except Exception as e:
+                # only errors the taxonomy marks retryable (device weather:
+                # XLA compile error, device OOM — _solve_device wraps these
+                # as SolverDeviceError) earn a backoff + re-solve; a
+                # deterministic host-side failure goes straight to the
+                # fallback so a programming error is never misreported as
+                # transient hardware trouble
+                if is_retryable_solver_error(e) and retries < self._DEVICE_RETRIES:
+                    retries += 1
+                    self._count_degraded("device_retry")
+                    time.sleep(self._RETRY_BACKOFF_SECONDS * retries)
+                    continue
+                reason = ("device-error" if isinstance(e, SolverDeviceError)
+                          else "internal-error")
+                detail = f"{type(e).__name__}: {e}"
+                break
+        self._count_degraded("host_ffd")
+        plan = self.solve_host_ffd(problem)
+        plan.solve_seconds = time.perf_counter() - t0
+        plan.degraded = True
+        plan.degraded_reason = reason
+        plan.solver_path = "host-ffd"
+        plan.device_retries = retries
+        plan.warnings = list(problem.warnings) + [
+            f"solver degraded to host FFD ({reason}: {detail})"]
+        return plan
+
+    def _solve_device(self, problem: Problem, mesh=None,
+                      t0: Optional[float] = None) -> NodePlan:
+        """The primary path: one bucketed device pack (or the pod-axis
+        sharded variant when a multi-device mesh is supplied). Raises
+        SolverCapacityError when the bin table cannot grow past its
+        ceiling; the ladder in solve() owns what happens next."""
+        t0 = time.perf_counter() if t0 is None else t0
         if mesh is not None and mesh.devices.size > 1:
             return self._solve_sharded(problem, mesh, t0)
         G = _bucket(problem.G, _G_BUCKETS)
@@ -706,6 +852,7 @@ class Solver:
             B = max(fresh, prev[1])
         else:
             B = fresh
+        B = min(B, self._b_ceiling())
 
         fused_np = self._fused_inputs_np(problem, G)
         fused = jnp.asarray(fused_np) if problem.E == 0 else None
@@ -713,35 +860,54 @@ class Solver:
 
         lat = self.lattice
         while True:
+            self._maybe_inject_device_fault()
             td = time.perf_counter()
             # exactly ONE fused input upload (existing bins ride the same
             # buffer via pack_packed_combined) + one fused result transfer
             # (sync included); lean layout: the plan decode never reads
             # cum/alloc_cap/pm/po
-            with self._trace_span("solver.pack"):
-                if problem.E:
-                    init_np = self._fused_init_np(problem, B)
-                    combined = jnp.asarray(
-                        np.concatenate([fused_np, init_np]))
-                    buf = np.asarray(binpack.pack_packed_combined(
-                        self._alloc, avail, price, combined, len(fused_np),
-                        problem.E, B,
-                        G, lat.T, lat.Z, lat.C, max(problem.NP, 1),
-                        max(problem.A, 1), lean=True))
-                else:
-                    buf = np.asarray(binpack.pack_packed_efused(
-                        self._alloc, avail, price, fused, None,
-                        problem.E, B,
-                        G, lat.T, lat.Z, lat.C, max(problem.NP, 1),
-                        max(problem.A, 1), lean=True))
+            try:
+                with self._trace_span("solver.pack"):
+                    if problem.E:
+                        init_np = self._fused_init_np(problem, B)
+                        combined = jnp.asarray(
+                            np.concatenate([fused_np, init_np]))
+                        buf = np.asarray(binpack.pack_packed_combined(
+                            self._alloc, avail, price, combined,
+                            len(fused_np), problem.E, B,
+                            G, lat.T, lat.Z, lat.C, max(problem.NP, 1),
+                            max(problem.A, 1), lean=True))
+                    else:
+                        buf = np.asarray(binpack.pack_packed_efused(
+                            self._alloc, avail, price, fused, None,
+                            problem.E, B,
+                            G, lat.T, lat.Z, lat.C, max(problem.NP, 1),
+                            max(problem.A, 1), lean=True))
+            except SolverError:
+                raise
+            except Exception as e:
+                # XLA compile error / device OOM / transfer failure: the
+                # retryable rung of the ladder, as opposed to host-side
+                # bugs which must NOT earn a blind re-solve
+                raise SolverDeviceError(
+                    f"{type(e).__name__}: {e}", cause=e) from e
             device_s = time.perf_counter() - td
             dec = _unpack_decode_set(buf, G, lat.T, lat.Z, lat.C,
                                      max(problem.A, 1), lean=True)
             overflowed = (dec.leftover.sum() > 0) and dec.next_open >= B
             if overflowed:
-                B, grew = _grow_bucket(B)
-                if grew:
+                nb, grew = _grow_bucket(B)
+                if grew and nb <= self._b_ceiling():
+                    B = nb
                     continue
+                # growth exhausted: don't decode a plan that silently drops
+                # the leftover — the ladder degrades to host FFD, whose bin
+                # table is unbounded (availability over latency)
+                if self.faults is not None and self.faults.b_limit:
+                    self.faults.note("b_exhausted")
+                raise SolverCapacityError(
+                    f"bin table exhausted at B={B} with "
+                    f"{int(dec.leftover.sum())} pod(s) left over", axis="B")
             break
 
         # record what this estimate bucket actually consumed (dec.next_open
@@ -753,6 +919,230 @@ class Solver:
         plan.solve_seconds = time.perf_counter() - t0
         plan.warnings = list(problem.warnings)
         return plan
+
+    # ---- wave-split planner (group-axis graceful degradation) ----
+
+    def _solve_waves(self, problem: Problem, mesh, t0: float) -> NodePlan:
+        """Solve a problem whose group axis exceeds the largest compiled
+        bucket by partitioning it into bucket-sized WAVES and solving them
+        in sequence on the device.
+
+        Groups are already FFD-ordered (build_problem sorts descending), so
+        waves run cost-ordered exactly like the sequential reference: the
+        first wave packs the biggest groups, later waves fill in around
+        them. Open-bin state carries BETWEEN waves — every node an earlier
+        wave planned re-enters the next wave's problem as a pre-initialized
+        existing bin (with its real chosen-type allocatable and its
+        affinity-class presence counts), and placements onto REAL existing
+        capacity update that capacity's remaining headroom — so packing
+        quality stays within the host-FFD envelope instead of each wave
+        opening its own fresh fleet."""
+        ceiling = self._g_ceiling()
+        wave = max(1, min(self._WAVE_G_TARGET, ceiling))
+        n_waves = -(-problem.G // wave)
+
+        A = problem.A
+        # pod name -> group index (req/match/owner lookups while carrying
+        # bin state across waves)
+        gi_of: Dict[str, int] = {}
+        for gi, g in enumerate(problem.groups):
+            for name in g.pod_names:
+                gi_of[name] = gi
+        # pool identity -> index; virtual pools share a base name but
+        # differ by custom labels, so the key carries both
+        pool_idx: Dict[Tuple[str, frozenset], int] = {}
+        for i, p in enumerate(problem.node_pools):
+            pool_idx.setdefault(
+                (p.base_name or p.name, frozenset(p.custom_labels.items())), i)
+        e_idx = {b.name: i for i, b in enumerate(problem.existing)}
+
+        # mutable copies of the real existing-bin running state
+        e_used = problem.e_used.copy()
+        e_pm = problem.e_pm.copy()
+        e_po = problem.e_po.copy()
+
+        # carried open bins: one pseudo existing bin per node planned by an
+        # earlier wave (parallel lists; index = pseudo bin id)
+        pseudo_nodes: List[PlannedNode] = []
+        pseudo_used: List[np.ndarray] = []
+        pseudo_np: List[int] = []
+        pseudo_pm: List[np.ndarray] = []
+        pseudo_po: List[np.ndarray] = []
+        pseudo_by_name: Dict[str, int] = {}
+
+        merged_assign: Dict[str, List[str]] = {}
+        merged_unsched: Dict[str, str] = dict(problem.unschedulable)
+        device_s = 0.0
+
+        def register_pod(pn: str, used: np.ndarray, pm: np.ndarray,
+                         po: np.ndarray) -> None:
+            gi = gi_of[pn]
+            used += problem.req[gi]
+            if A:
+                pm += problem.g_match[gi]
+                po |= problem.g_owner[gi]
+
+        for lo in range(0, problem.G, wave):
+            hi = min(lo + wave, problem.G)
+            sub = self._wave_problem(problem, lo, hi, e_used, e_pm, e_po,
+                                     pseudo_nodes, pseudo_used, pseudo_np,
+                                     pseudo_pm, pseudo_po)
+            plan_w = self._solve_device(sub, mesh)
+            device_s += plan_w.device_seconds
+            merged_unsched.update(plan_w.unschedulable)
+            for node_name, pod_names in plan_w.existing_assignments.items():
+                pi = pseudo_by_name.get(node_name)
+                if pi is not None:
+                    # pods joining an earlier wave's planned node
+                    pseudo_nodes[pi].pods.extend(pod_names)
+                    for pn in pod_names:
+                        register_pod(pn, pseudo_used[pi], pseudo_pm[pi],
+                                     pseudo_po[pi])
+                else:
+                    merged_assign.setdefault(node_name, []).extend(pod_names)
+                    ei = e_idx[node_name]
+                    for pn in pod_names:
+                        register_pod(pn, e_used[ei], e_pm[ei], e_po[ei])
+            for node in plan_w.new_nodes:
+                np_i = pool_idx.get(
+                    (node.node_pool, frozenset(node.extra_labels.items())), 0)
+                used = problem.ds_overhead[np_i].copy()
+                pm = np.zeros((A,), np.int32)
+                po = np.zeros((A,), bool)
+                for pn in node.pods:
+                    register_pod(pn, used, pm, po)
+                # the name is positional — _wave_problem re-derives it from
+                # the pseudo index, so later waves' assignments route back
+                pseudo_by_name[f"__wave:{len(pseudo_nodes)}__"] = \
+                    len(pseudo_nodes)
+                pseudo_nodes.append(node)
+                pseudo_used.append(used)
+                pseudo_np.append(np_i)
+                pseudo_pm.append(pm)
+                pseudo_po.append(po)
+
+        new_nodes = [n for n in pseudo_nodes if n.pods]
+        cost = float(sum(n.price_per_hour for n in new_nodes))
+        return NodePlan(
+            new_nodes=new_nodes, existing_assignments=merged_assign,
+            unschedulable=merged_unsched, new_node_cost=cost,
+            solve_seconds=time.perf_counter() - t0, device_seconds=device_s,
+            warnings=list(problem.warnings) + [
+                f"wave-split: G={problem.G} over ceiling {ceiling}, "
+                f"{n_waves} wave(s) of ≤{wave} groups"],
+            degraded=True, degraded_reason="g-overflow",
+            solver_path="wave-split", waves=n_waves)
+
+    def _wave_problem(self, problem: Problem, lo: int, hi: int,
+                      e_used: np.ndarray, e_pm: np.ndarray, e_po: np.ndarray,
+                      pseudo_nodes: List[PlannedNode],
+                      pseudo_used: List[np.ndarray], pseudo_np: List[int],
+                      pseudo_pm: List[np.ndarray],
+                      pseudo_po: List[np.ndarray]) -> Problem:
+        """One wave's sub-problem: groups [lo, hi) plus the carried bin
+        state — real existing bins at their RUNNING usage and every earlier
+        wave's planned node as a fixed pre-initialized bin."""
+        lat = self.lattice
+        from .problem import ExistingBin
+        sl = slice(lo, hi)
+        existing = list(problem.existing)
+        if pseudo_nodes:
+            k = len(pseudo_nodes)
+            p_type = np.array([lat.name_to_idx[n.instance_type]
+                               for n in pseudo_nodes], np.int32)
+            p_zone = np.array([lat.zones.index(n.zone)
+                               for n in pseudo_nodes], np.int32)
+            p_cap = np.array([lat.capacity_types.index(n.capacity_type)
+                              for n in pseudo_nodes], np.int32)
+            p_np = np.asarray(pseudo_np, np.int32)
+            p_used = np.stack(pseudo_used).astype(np.float32)
+            # a planned node's allocatable is its chosen type's, clamped by
+            # its pool's kubelet ceiling — what the launch will deliver
+            p_alloc = np.minimum(
+                lat.alloc[p_type],
+                problem.np_alloc_cap[p_np]).astype(np.float32)
+            p_pm = (np.stack(pseudo_pm).astype(np.int32) if problem.A
+                    else np.zeros((k, 0), np.int32))
+            p_po = (np.stack(pseudo_po).astype(bool) if problem.A
+                    else np.zeros((k, 0), bool))
+            for i, n in enumerate(pseudo_nodes):
+                existing.append(ExistingBin(
+                    name=f"__wave:{i}__", node_pool=n.node_pool,
+                    instance_type=n.instance_type, zone=n.zone,
+                    capacity_type=n.capacity_type, used=p_used[i],
+                    alloc_override=p_alloc[i]))
+            e_used2 = np.concatenate([e_used, p_used])
+            e_alloc2 = np.concatenate([problem.e_alloc, p_alloc])
+            e_type2 = np.concatenate([problem.e_type, p_type])
+            e_zone2 = np.concatenate([problem.e_zone, p_zone])
+            e_cap2 = np.concatenate([problem.e_cap, p_cap])
+            e_np2 = np.concatenate([problem.e_np, p_np])
+            e_pm2 = np.concatenate([e_pm, p_pm])
+            e_po2 = np.concatenate([e_po, p_po])
+        else:
+            e_used2, e_alloc2 = e_used, problem.e_alloc
+            e_type2, e_zone2 = problem.e_type, problem.e_zone
+            e_cap2, e_np2 = problem.e_cap, problem.e_np
+            e_pm2, e_po2 = e_pm, e_po
+        return replace(
+            problem,
+            groups=problem.groups[sl], unschedulable={}, warnings=[],
+            req=problem.req[sl], count=problem.count[sl],
+            g_type=problem.g_type[sl], g_zone=problem.g_zone[sl],
+            g_cap=problem.g_cap[sl], g_np=problem.g_np[sl],
+            max_per_bin=problem.max_per_bin[sl],
+            g_spread=problem.g_spread[sl], single_bin=problem.single_bin[sl],
+            g_match=problem.g_match[sl], g_owner=problem.g_owner[sl],
+            g_need=problem.g_need[sl], strict_custom=problem.strict_custom[sl],
+            existing=existing, e_used=e_used2, e_alloc=e_alloc2,
+            e_type=e_type2, e_zone=e_zone2, e_cap=e_cap2, e_np=e_np2,
+            e_pm=e_pm2, e_po=e_po2)
+
+    # ---- host-FFD fallback (bottom rung of the ladder) ----
+
+    def solve_host_ffd(self, problem: Problem) -> NodePlan:
+        """Pure-host sequential FFD (solver/oracle.py — reference parity by
+        construction) decoded into a NodePlan. No device dependency, no
+        shape ceilings: the bottom rung of the degradation ladder, and the
+        path of last resort when the device is unreachable entirely."""
+        from .oracle import ffd_oracle
+        t0 = time.perf_counter()
+        plat = problem.lattice
+        oracle = ffd_oracle(problem)
+        existing_assignments: Dict[str, List[str]] = {}
+        new_bins = []
+        for b in oracle.bins:
+            if not b.pods:
+                continue
+            if b.is_existing:
+                existing_assignments.setdefault(
+                    problem.existing[b.existing_idx].name, []).extend(b.pods)
+            else:
+                new_bins.append(b)
+        nodes: List[PlannedNode] = []
+        if new_bins:
+            feasible = self._feasible_sets_batch(
+                problem,
+                np.stack([b.tmask for b in new_bins]),
+                np.stack([b.zmask for b in new_bins]),
+                np.stack([b.cmask for b in new_bins]))
+            for b, (t, z, c), (ftypes, fzones, fcaps) in zip(
+                    new_bins, oracle.chosen, feasible):
+                pname, extra = _pool_out(problem.node_pools[b.np_idx])
+                nodes.append(PlannedNode(
+                    node_pool=pname, extra_labels=extra,
+                    instance_type=plat.names[t], zone=plat.zones[z],
+                    capacity_type=plat.capacity_types[c],
+                    price_per_hour=float(plat.price[t, z, c]),
+                    pods=list(b.pods),
+                    feasible_types=ftypes, feasible_zones=fzones,
+                    feasible_capacity_types=fcaps))
+        return NodePlan(
+            new_nodes=nodes, existing_assignments=existing_assignments,
+            unschedulable=dict(oracle.unschedulable),
+            new_node_cost=oracle.new_node_cost,
+            solve_seconds=time.perf_counter() - t0, device_seconds=0.0,
+            warnings=list(problem.warnings), solver_path="host-ffd")
 
     def _decode(self, problem: Problem, dec: _DecodeSet, device_s: float) -> NodePlan:
         lat = self.lattice
@@ -918,7 +1308,8 @@ class Solver:
         # of the splittable groups + one tail bin per group + whole groups
         b_needed = problem.E + min(total_pods,
                                    -(-capped_bins // D) + problem.G + n_whole + 64)
-        B = _bucket(max(b_needed, problem.E + 1), _B_BUCKETS, clamp=True)
+        B = min(_bucket(max(b_needed, problem.E + 1), _B_BUCKETS, clamp=True),
+                self._b_ceiling())
 
         fused = self._fused_inputs(problem, G)
         avail, price = self._device_avail_price(problem)
@@ -939,15 +1330,22 @@ class Solver:
         while True:
             init_buf = (jnp.asarray(self._fused_init_np(problem, B))
                         if problem.E else None)
+            self._maybe_inject_device_fault()
             td = time.perf_counter()
-            with self._trace_span("solver.pack_sharded"):
-                sp = sharded_pack(mesh, self._alloc, avail, price, fused,
-                                  init_buf, problem.E, count_split,
-                                  B, G, lat.T, lat.Z, lat.C, NP, A)
-                # one fused [D,B+n,W] buffer = one device→host transfer for
-                # all shards (sync included); host-side unpack stays off the
-                # device clock
-                packed = np.asarray(sp.packed)
+            try:
+                with self._trace_span("solver.pack_sharded"):
+                    sp = sharded_pack(mesh, self._alloc, avail, price, fused,
+                                      init_buf, problem.E, count_split,
+                                      B, G, lat.T, lat.Z, lat.C, NP, A)
+                    # one fused [D,B+n,W] buffer = one device→host transfer
+                    # for all shards (sync included); host-side unpack stays
+                    # off the device clock
+                    packed = np.asarray(sp.packed)
+            except SolverError:
+                raise
+            except Exception as e:
+                raise SolverDeviceError(
+                    f"{type(e).__name__}: {e}", cause=e) from e
             device_s = time.perf_counter() - td
             decs = [_unpack_decode_set(packed[d], G, lat.T, lat.Z, lat.C, A)
                     for d in range(packed.shape[0])]
@@ -955,9 +1353,18 @@ class Solver:
             next_open = np.array([dec.next_open for dec in decs])          # [D]
             overflowed = bool(((leftover.sum(axis=1) > 0) & (next_open >= B)).any())
             if overflowed:
-                B, grew = _grow_bucket(B)
-                if grew:
+                nb, grew = _grow_bucket(B)
+                if grew and nb <= self._b_ceiling():
+                    B = nb
                     continue
+                # same exhaustion contract as the single-device path: the
+                # ladder degrades to host FFD rather than decoding a plan
+                # that drops the spilled pods
+                if self.faults is not None and self.faults.b_limit:
+                    self.faults.note("b_exhausted")
+                raise SolverCapacityError(
+                    f"sharded bin table exhausted at B={B} with "
+                    f"{int(leftover.sum())} pod(s) left over", axis="B")
             break
 
         plan = self._decode_sharded(problem, sp, decs, count_split, device_s)

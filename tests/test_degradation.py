@@ -1,0 +1,443 @@
+"""Degradation-ladder tests (docs/concepts/degradation.md).
+
+The paper's perf story assumes pending pods collapse to a few thousand
+scheduling signatures; these tests are the adversarial counterpart: a
+batch too diverse for the compiled bucket set must wave-split, and every
+device-path failure mode — injected deterministically via
+solver/faults.py — must land on the host-FFD fallback with metrics
+incremented and ZERO pods silently dropped. Plus the satellite
+robustness fixes that ride the same PR (eventsink retention re-list,
+kpctl rendering, non-__init__ Pods in _selector_keys).
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.apis import NodePool, Pod
+from karpenter_provider_aws_tpu.errors import (SolverCapacityError,
+                                               SolverDeviceError,
+                                               is_retryable_solver_error)
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+from karpenter_provider_aws_tpu.solver import (FaultInjector, Solver,
+                                               build_problem, ffd_oracle)
+
+_FAMILIES = ("m5", "c5", "r5", "t3")
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    return build_lattice([s for s in build_catalog() if s.family in _FAMILIES])
+
+
+@pytest.fixture()
+def solver(lattice):
+    # function-scoped: fault injectors and degraded counters are per-test
+    # state (jit caches are process-global, so this stays cheap)
+    return Solver(lattice)
+
+
+def diverse_pods(n, prefix="u"):
+    """n pods with n DISTINCT scheduling signatures (unique cpu requests
+    defeat signature dedup the way an adversarial tenant mix would)."""
+    return [Pod(name=f"{prefix}{i}",
+                requests={"cpu": f"{100 + i}m",
+                          "memory": f"{256 + (i % 8) * 64}Mi"})
+            for i in range(n)]
+
+
+def scheduled_count(plan):
+    return (sum(len(x.pods) for x in plan.new_nodes)
+            + sum(len(v) for v in plan.existing_assignments.values()))
+
+
+def assert_nothing_dropped(plan, n_pods):
+    """Every pod is either placed or explicitly unschedulable — the
+    ladder's core contract: degrade latency, never drop pods silently."""
+    assert scheduled_count(plan) + len(plan.unschedulable) == n_pods
+    names = set()
+    for node in plan.new_nodes:
+        names.update(node.pods)
+    for pods in plan.existing_assignments.values():
+        names.update(pods)
+    names.update(plan.unschedulable)
+    assert len(names) == n_pods
+
+
+class TestErrorTaxonomy:
+    def test_capacity_terminal_device_retryable(self):
+        assert not SolverCapacityError("full", axis="B").retryable
+        assert SolverDeviceError("boom").retryable
+        assert is_retryable_solver_error(SolverDeviceError("boom"))
+        assert not is_retryable_solver_error(SolverCapacityError("full"))
+        assert not is_retryable_solver_error(RuntimeError("boom"))
+
+    def test_capacity_error_names_axis(self):
+        assert SolverCapacityError("bins", axis="B").axis == "B"
+
+
+class TestWaveSplit:
+    def test_small_batch_stays_on_device(self, solver, lattice):
+        pods = diverse_pods(24)
+        plan = solver.solve(build_problem(pods, [NodePool(name="default")],
+                                          lattice))
+        assert plan.solver_path == "device"
+        assert not plan.degraded and plan.waves == 1
+        assert_nothing_dropped(plan, 24)
+
+    def test_wave_split_engages_and_holds_cost_envelope(self, solver, lattice):
+        """A batch over the (injected) group ceiling wave-splits and packs
+        within the ≤2% FFD envelope — open-bin state carries between
+        waves, so later waves fill earlier waves' headroom."""
+        pods = diverse_pods(200)
+        problem = build_problem(pods, [NodePool(name="default")], lattice)
+        assert problem.G == 200
+        solver.inject_faults(FaultInjector(g_limit=64))
+        plan = solver.solve(problem)
+        assert plan.solver_path == "wave-split"
+        assert plan.degraded and plan.degraded_reason == "g-overflow"
+        assert plan.waves == 4  # ceil(200 / 64)
+        assert_nothing_dropped(plan, 200)
+        assert not plan.unschedulable
+        oracle = ffd_oracle(problem)
+        assert plan.new_node_cost <= oracle.new_node_cost * 1.02
+        assert solver.degraded_counts.get("wave_split", 0) == 1
+        assert solver.faults.fired.get("g_overflow", 0) == 1
+
+    def test_wave_split_fills_existing_capacity(self, solver, lattice):
+        """Real existing headroom is consumed across waves exactly once
+        (running usage carries), never double-booked."""
+        from karpenter_provider_aws_tpu.solver import ExistingBin
+        from karpenter_provider_aws_tpu.apis.resources import R
+        ti = lattice.name_to_idx["m5.2xlarge"]
+        existing = [ExistingBin(
+            name="node-a", node_pool="default", instance_type="m5.2xlarge",
+            zone=lattice.zones[0], capacity_type="on-demand",
+            used=np.zeros((R,), np.float32))]
+        pods = diverse_pods(80)
+        problem = build_problem(pods, [NodePool(name="default")], lattice,
+                                existing=existing)
+        solver.inject_faults(FaultInjector(g_limit=32))
+        plan = solver.solve(problem)
+        assert plan.solver_path == "wave-split"
+        assert_nothing_dropped(plan, 80)
+        # whatever landed on node-a fits its allocatable
+        placed = plan.existing_assignments.get("node-a", [])
+        req_of = {n: g.req for g in problem.groups for n in g.pod_names}
+        total = sum((req_of[n] for n in placed),
+                    np.zeros((R,), np.float32))
+        assert (total <= lattice.alloc[ti] + 1e-2).all()
+        # no pseudo wave-bin names leak into the plan
+        assert all(not k.startswith("__wave") for k in plan.existing_assignments)
+
+    def test_5000_signature_batch_parity(self, solver, lattice):
+        """The acceptance batch at full size, solver-level: 5,120 distinct
+        signatures wave-split end to end within 2% of sequential FFD."""
+        pods = diverse_pods(5120)
+        problem = build_problem(pods, [NodePool(name="default")], lattice)
+        assert problem.G == 5120
+        solver.inject_faults(FaultInjector(g_limit=256))
+        plan = solver.solve(problem)
+        assert plan.solver_path == "wave-split"
+        assert plan.waves == 20
+        assert_nothing_dropped(plan, 5120)
+        assert not plan.unschedulable
+        oracle = ffd_oracle(problem)
+        assert plan.new_node_cost <= oracle.new_node_cost * 1.02
+
+
+class TestHostFallback:
+    def test_bucket_exhaustion_falls_back(self, solver, lattice):
+        """Bin-table growth exhaustion no longer drops the leftover as
+        unschedulable: host FFD (unbounded bins) schedules everything."""
+        # 60 node-sized pods (one bin each): far over the faked ceiling
+        pods = [Pod(name=f"b{i}", requests={"cpu": "60", "memory": "64Gi"})
+                for i in range(60)]
+        problem = build_problem(pods, [NodePool(name="default")], lattice)
+        solver.inject_faults(FaultInjector(b_limit=32))
+        plan = solver.solve(problem)
+        assert plan.solver_path == "host-ffd"
+        assert plan.degraded and plan.degraded_reason == "b-exhausted"
+        assert_nothing_dropped(plan, 60)
+        assert not plan.unschedulable
+        assert solver.faults.fired.get("b_exhausted", 0) >= 1
+        assert any("host FFD" in w for w in plan.warnings)
+
+    def test_device_error_retries_then_recovers(self, solver, lattice):
+        pods = diverse_pods(12)
+        problem = build_problem(pods, [NodePool(name="default")], lattice)
+        solver.inject_faults(FaultInjector(device_errors=1))
+        plan = solver.solve(problem)
+        assert plan.solver_path == "device"
+        assert not plan.degraded
+        assert plan.device_retries == 1
+        assert solver.degraded_counts.get("device_retry", 0) == 1
+        assert_nothing_dropped(plan, 12)
+
+    def test_persistent_device_error_falls_back(self, solver, lattice):
+        pods = diverse_pods(12)
+        problem = build_problem(pods, [NodePool(name="default")], lattice)
+        solver.inject_faults(FaultInjector(device_errors=10))
+        plan = solver.solve(problem)
+        assert plan.solver_path == "host-ffd"
+        assert plan.degraded and plan.degraded_reason == "device-error"
+        assert_nothing_dropped(plan, 12)
+        assert not plan.unschedulable
+        # fallback plan quality equals the oracle by construction
+        oracle = ffd_oracle(problem)
+        assert plan.new_node_cost == pytest.approx(oracle.new_node_cost)
+
+    def test_host_side_bug_goes_straight_to_fallback(self, solver, lattice,
+                                                     monkeypatch):
+        """A deterministic non-retryable failure must NOT pay the blind
+        backoff-and-retry (the same input would fail identically) and must
+        not be laundered into reason='device-error' — the taxonomy's
+        retryable contract, enforced by the ladder."""
+        pods = diverse_pods(12)
+        problem = build_problem(pods, [NodePool(name="default")], lattice)
+
+        def boom(self, problem, mesh=None, t0=None):
+            raise KeyError("host-side bug")
+
+        monkeypatch.setattr(Solver, "_solve_device", boom)
+        plan = solver.solve(problem)
+        assert plan.solver_path == "host-ffd"
+        assert plan.degraded and plan.degraded_reason == "internal-error"
+        assert plan.device_retries == 0
+        assert solver.degraded_counts.get("device_retry", 0) == 0
+        assert_nothing_dropped(plan, 12)
+
+    def test_fallback_plan_carries_feasible_sets(self, solver, lattice):
+        """Degraded plans feed the SAME launch path: every node needs its
+        CreateFleet flexibility lists."""
+        pods = diverse_pods(8)
+        problem = build_problem(pods, [NodePool(name="default")], lattice)
+        solver.inject_faults(FaultInjector(device_errors=10))
+        plan = solver.solve(problem)
+        assert plan.new_nodes
+        for node in plan.new_nodes:
+            assert node.feasible_types
+            assert node.instance_type in node.feasible_types
+            assert node.zone in node.feasible_zones
+            assert np.isfinite(node.price_per_hour)
+
+    def test_relaxed_solve_reports_worst_rung(self, solver, lattice):
+        """solve_relaxed aggregates provenance: one degraded round is
+        never laundered into a clean-looking plan."""
+        pods = diverse_pods(10)
+        solver.inject_faults(FaultInjector(device_errors=10))
+        plan = solver.solve_relaxed(pods, [NodePool(name="default")])
+        assert plan.solver_path == "host-ffd"
+        assert plan.degraded
+
+
+class TestProvisionerDegraded:
+    def _operator(self, lattice):
+        from karpenter_provider_aws_tpu.cloud import FakeCloud
+        from karpenter_provider_aws_tpu.operator import Operator, Options
+        from karpenter_provider_aws_tpu.utils.clock import FakeClock
+        clock = FakeClock()
+        return Operator(options=Options(registration_delay=1.0),
+                        lattice=lattice, cloud=FakeCloud(clock), clock=clock)
+
+    def test_high_g_batch_end_to_end(self, lattice):
+        """A high-G batch flows through the provisioning controller: no
+        exception, claims launched for every planned node, the degraded
+        metric incremented, a SolverDegraded event published, and zero
+        pods dropped (all nominated or explicitly unschedulable)."""
+        op = self._operator(lattice)
+        op.solver.inject_faults(FaultInjector(g_limit=64))
+        pods = diverse_pods(150)
+        for p in pods:
+            op.cluster.add_pod(p)
+        result = op.provisioner.provision_once()
+        assert result.degraded and result.degraded_reason == "g-overflow"
+        assert result.plan.solver_path == "wave-split"
+        assert result.launch_failures == 0
+        assert result.pods_scheduled + result.pods_unschedulable == 150
+        assert result.pods_unschedulable == 0
+        m = op.metrics.get("karpenter_solver_degraded_total")
+        assert m.value(path="wave-split", reason="g-overflow") >= 1
+        assert op.recorder.events(reason="SolverDegraded")
+        # every created claim launched
+        assert result.launched == len(result.created_claims) > 0
+
+    def test_device_failure_end_to_end(self, lattice):
+        op = self._operator(lattice)
+        op.solver.inject_faults(FaultInjector(device_errors=10))
+        for p in diverse_pods(20):
+            op.cluster.add_pod(p)
+        result = op.provisioner.provision_once()
+        assert result.degraded
+        assert result.plan.solver_path == "host-ffd"
+        assert result.pods_scheduled == 20
+        m = op.metrics.get("karpenter_solver_degraded_total")
+        assert m.value(path="host-ffd", reason="device-error") >= 1
+        # transparent recovery: clearing the fault restores the device path
+        op.solver.inject_faults(None)
+        for p in diverse_pods(5, prefix="v"):
+            op.cluster.add_pod(p)
+        result = op.provisioner.provision_once()
+        assert not result.degraded
+        assert result.plan.solver_path == "device"
+
+    def test_solver_exception_yields_partial_result(self, lattice):
+        """Even a failure the ladder cannot absorb returns a PARTIAL
+        result (pods stay pending) instead of killing the pass."""
+        op = self._operator(lattice)
+        for p in diverse_pods(5):
+            op.cluster.add_pod(p)
+
+        def boom(*a, **kw):
+            raise RuntimeError("catastrophic")
+
+        op.provisioner.solver = type("S", (), {"solve_relaxed": boom,
+                                               "lattice": lattice})()
+        result = op.provisioner.provision_once()
+        assert result.plan is None
+        assert result.degraded and result.degraded_reason == "solve-error"
+        assert op.recorder.events(reason="SolverFailed")
+        m = op.metrics.get("karpenter_solver_degraded_total")
+        assert m.value(path="none", reason="solve-error") == 1
+        # nothing was consumed: all pods still pending for the next pass
+        assert len(op.cluster.pending_pods()) == 5
+        # the early return must not freeze the end-of-pass gauge at its
+        # previous value: the whole stuck batch reads as unschedulable
+        assert result.pods_unschedulable == 5
+        g = op.metrics.get("karpenter_pods_unschedulable")
+        assert g.value() == 5
+
+
+class TestWireMetrics:
+    def test_degradation_series_registered(self):
+        from karpenter_provider_aws_tpu.metrics import (Registry,
+                                                        wire_core_metrics)
+        m = wire_core_metrics(Registry())
+        assert m["solver_degraded"].name == "karpenter_solver_degraded_total"
+        assert m["solver_device_retries"].name == \
+            "karpenter_solver_device_retries_total"
+        assert m["solver_waves"].name == "karpenter_solver_wave_count"
+
+
+class TestSatellites:
+    def test_selector_keys_tolerates_bare_pods(self, lattice):
+        """A Pod built without __init__ (serde fast paths, test doubles)
+        must read as 'no selectors', not raise KeyError."""
+        from karpenter_provider_aws_tpu.solver.problem import _selector_keys
+        bare = object.__new__(Pod)
+        bare.__dict__.update(name="bare", requests={"cpu": "1"})
+        assert _selector_keys([bare], []) == frozenset()
+
+    def test_eventsink_ages_out_external_events(self):
+        """Events written by OTHER actors age out under the retention
+        ceiling once the sink re-lists."""
+        from karpenter_provider_aws_tpu.events import Event
+        from karpenter_provider_aws_tpu.kube.apiserver import FakeAPIServer
+        from karpenter_provider_aws_tpu.kube.eventsink import ApiEventSink
+        api = FakeAPIServer()
+        sink = ApiEventSink(api, retained=10, relist_every=4)
+
+        def publish(i):
+            sink(Event(time=float(i), type="Normal", reason="r",
+                       object_kind="Pod", object_name=f"p{i}", message="m"))
+
+        for i in range(3):
+            publish(i)
+        # an external writer floods the store behind the sink's back
+        # (non-numeric tails: adoption orders them before any sink name)
+        for i in range(25):
+            api.create("events", {"name": f"external-x{i}", "time": 0.0,
+                                  "type": "Normal", "reason": "x",
+                                  "objectKind": "Pod", "objectName": "q",
+                                  "message": "m"})
+        assert len(api.list("events")[0]) == 28
+        for i in range(3, 3 + 8):   # crosses the relist_every=4 boundary
+            publish(i)
+        items, _ = api.list("events")
+        assert len(items) <= 10
+        # the newest sink-written events survive
+        names = {o["metadata"]["name"] for o in items}
+        assert f"ev-{3 + 8:06d}" in names
+
+    def test_kpctl_unit_normalization(self, monkeypatch):
+        import pathlib
+        monkeypatch.syspath_prepend(str(
+            pathlib.Path(__file__).resolve().parent.parent / "tools"))
+        import kpctl
+        assert kpctl._cores("12000m") == "12"
+        assert kpctl._cores("500m") == "0.5"
+        assert kpctl._cores("48") == "48"
+        assert kpctl._cores("-") == "-"
+        assert kpctl._mem("2048Mi") == "2Gi"
+        assert kpctl._mem("1.5Gi") == "1536Mi"
+        assert kpctl._mem("64Gi") == "64Gi"
+        assert kpctl._mem("-") == "-"
+
+    def test_kpctl_age_anchors_to_server_clock(self, monkeypatch):
+        import pathlib
+        monkeypatch.syspath_prepend(str(
+            pathlib.Path(__file__).resolve().parent.parent / "tools"))
+        import kpctl
+
+        class FakeClient:
+            def request(self, method, path, doc=None, stream=False):
+                return {"items": [{"metadata": {"name": "e1"}}],
+                        "resourceVersion": 7, "serverTime": 1000.0}
+
+        monkeypatch.setattr(kpctl, "_SERVER_NOW", None)
+        kpctl._list(FakeClient(), "events")
+        assert kpctl._SERVER_NOW == 1000.0
+        # ages render on the SERVER clock: an event stamped at server
+        # time 940 is 60s old regardless of the local wall clock
+        assert kpctl._age(940.0) == "60s"
+
+    def test_kpctl_single_get_adopts_server_clock(self, monkeypatch):
+        """`kpctl get KIND NAME` must anchor ages to the server clock too:
+        every httpserver response carries X-Server-Time (the list-body
+        serverTime field only covers the no-name path)."""
+        import pathlib
+        monkeypatch.syspath_prepend(str(
+            pathlib.Path(__file__).resolve().parent.parent / "tools"))
+        import kpctl
+        from karpenter_provider_aws_tpu.apis import serde
+        from karpenter_provider_aws_tpu.kube import (FakeAPIServer,
+                                                     install_admission)
+        from karpenter_provider_aws_tpu.kube.httpserver import serve
+
+        class FrozenClock:
+            def now(self):
+                return 5000.0
+
+        s = FakeAPIServer(clock=FrozenClock())
+        install_admission(s)
+        httpd = serve(s, 0)
+        try:
+            c = kpctl.Client(f"http://127.0.0.1:{httpd.server_address[1]}")
+            spec = serde.pod_to_dict(
+                Pod(name="p0", requests={"cpu": "1", "memory": "1Gi"}))
+            c.request("POST", "/apis/pods", spec)
+            monkeypatch.setattr(kpctl, "_SERVER_NOW", None)
+            obj = c.request("GET", "/apis/pods/p0")
+            assert obj["metadata"]["name"] == "p0"
+            assert kpctl._SERVER_NOW == 5000.0
+        finally:
+            httpd.shutdown()
+
+    def test_soak_fault_schedule_parser(self, monkeypatch):
+        import pathlib
+        monkeypatch.syspath_prepend(str(
+            pathlib.Path(__file__).resolve().parent.parent / "tools"))
+        import soak
+        sched = soak.parse_fault_schedule(
+            "60:g-limit=64, 30:device-error, 120:clear")
+        assert sched == [(30.0, "device-error", None),
+                         (60.0, "g-limit", 64), (120.0, "clear", None)]
+        s = Solver.__new__(Solver)   # only inject_faults/faults needed
+        s._solve_lock = __import__("threading").RLock()
+        s.faults = None
+        soak.apply_fault(s, "g-limit", 64)
+        soak.apply_fault(s, "device-error", None)
+        assert s.faults.g_limit == 64 and s.faults.device_errors == 3
+        soak.apply_fault(s, "clear", None)
+        assert s.faults is None
+        with pytest.raises(SystemExit):
+            soak.parse_fault_schedule("oops")

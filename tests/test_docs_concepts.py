@@ -139,6 +139,30 @@ class TestDisruptionDocFacts:
         _assert_cited_metrics_exist("disruption.md")
 
 
+class TestDegradationDocFacts:
+    """docs/concepts/degradation.md pins the solve ladder — its rungs,
+    wave budget, retry count, and metric names — to the code."""
+
+    def test_ladder_rungs_and_cited_metrics(self):
+        doc = _read("degradation.md")
+        for rung in ("device solve", "wave-split", "host FFD"):
+            assert rung in doc
+        _assert_cited_metrics_exist("degradation.md")
+
+    def test_wave_budget_and_retries_match(self):
+        from karpenter_provider_aws_tpu.solver.solve import (Solver,
+                                                             _G_BUCKETS)
+        doc = _read("degradation.md")
+        assert f"≤{Solver._WAVE_G_TARGET} groups per wave" in doc
+        assert f"G ≤ {_G_BUCKETS[-1]}" in doc
+
+    def test_reason_enum_matches_plan_contract(self):
+        doc = _read("degradation.md")
+        for reason in ("g-overflow", "b-exhausted", "device-error",
+                       "internal-error"):
+            assert reason in doc
+
+
 class TestPerformanceDocFacts:
     """docs/concepts/performance.md pins the solve path's latency
     machinery — its budgets, buckets, TTLs, and memo invalidation

@@ -58,22 +58,77 @@ class Client:
                         if self.token else {})})
         resp = urllib.request.urlopen(r, timeout=None if stream else 30,
                                       context=self.ctx)
+        st = resp.headers.get("X-Server-Time")
+        if st is not None:
+            global _SERVER_NOW
+            try:
+                _SERVER_NOW = float(st)
+            except ValueError:
+                pass
         if stream:
             return resp
         with resp:
             return json.loads(resp.read() or b"{}")
 
 
+# the reference clock for AGE/LAST SEEN columns: the SERVER's clock as
+# reported by its last response (the `X-Server-Time` header on every
+# route, plus the `serverTime` field in list bodies), so ages render
+# correctly even when the server runs a simulated clock or the client's
+# wall clock is skewed — including single-object `get KIND NAME`. Falls
+# back to local time against pre-serverTime servers.
+_SERVER_NOW = None
+
+
 def _age(created, now=None):
     if not created:
         return "<none>"
     import time
-    d = max((now if now is not None else time.time()) - float(created), 0)
+    if now is None:
+        now = _SERVER_NOW if _SERVER_NOW is not None else time.time()
+    d = max(now - float(created), 0)
     if d < 120:
         return f"{int(d)}s"
     if d < 7200:
         return f"{int(d / 60)}m"
     return f"{int(d / 3600)}h"
+
+
+def _cores(v):
+    """Normalize a CPU quantity to cores: '12000m' → '12', '500m' → '0.5',
+    '48' stays '48'. The usage/limit pair then reads in ONE unit instead
+    of mixing millicores (solver-side accounting) with cores (YAML)."""
+    s = str(v)
+    if not s or s == "-":
+        return s or "-"
+    try:
+        n = float(s[:-1]) / 1000.0 if s.endswith("m") else float(s)
+    except ValueError:
+        return s
+    return f"{n:g}"
+
+
+def _mem(v):
+    """Normalize a memory quantity to a common suffix (Gi when it divides
+    cleanly, else Mi): '2048Mi' → '2Gi', '1.5Gi' → '1536Mi'."""
+    s = str(v)
+    if not s or s == "-":
+        return s or "-"
+    suffixes = {"Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40,
+                "K": 10**3, "M": 10**6, "G": 10**9, "T": 10**12}
+    num, mult = s, 1
+    for suf, m in suffixes.items():
+        if s.endswith(suf):
+            num, mult = s[: -len(suf)], m
+            break
+    try:
+        b = float(num) * mult
+    except ValueError:
+        return s
+    gi = b / 2**30
+    if gi >= 1 and float(gi).is_integer():
+        return f"{gi:g}Gi"
+    return f"{b / 2**20:g}Mi"
 
 
 # per-kind table columns: (header, spec-path extractor)
@@ -104,13 +159,15 @@ _COLUMNS = {
         ("NAME", lambda o: o["metadata"]["name"]),
         ("WEIGHT", lambda o: str(o["spec"].get("weight", 0))),
         # live usage vs ceiling (statusResources is the reference
-        # NodePool's status.resources; "-" = unlimited axis)
+        # NodePool's status.resources; "-" = unlimited axis), both sides
+        # normalized to one unit (cores / common memory suffix) so
+        # "12000m/48" never renders as two different scales
         ("CPU", lambda o: "{}/{}".format(
-            o["spec"].get("statusResources", {}).get("cpu", "0"),
-            o["spec"].get("limits", {}).get("cpu", "-"))),
+            _cores(o["spec"].get("statusResources", {}).get("cpu", "0")),
+            _cores(o["spec"].get("limits", {}).get("cpu", "-")))),
         ("MEMORY", lambda o: "{}/{}".format(
-            o["spec"].get("statusResources", {}).get("memory", "0"),
-            o["spec"].get("limits", {}).get("memory", "-"))),
+            _mem(o["spec"].get("statusResources", {}).get("memory", "0")),
+            _mem(o["spec"].get("limits", {}).get("memory", "-")))),
     ),
     "events": (
         ("LAST SEEN", lambda o: _age(o["spec"].get("time"))),
@@ -164,12 +221,21 @@ def load_documents(path):
     return docs
 
 
+def _list(c: Client, kind: str):
+    """List a kind and adopt the server's clock for age rendering."""
+    global _SERVER_NOW
+    doc = c.request("GET", f"/apis/{kind}")
+    if "serverTime" in doc:
+        _SERVER_NOW = doc["serverTime"]
+    return doc["items"]
+
+
 def cmd_get(c: Client, args) -> int:
     if args.name:
         obj = c.request("GET", f"/apis/{args.kind}/{args.name}")
         objs = [obj]
     else:
-        objs = c.request("GET", f"/apis/{args.kind}")["items"]
+        objs = _list(c, args.kind)
     payload = objs if args.name is None else objs[0]
     if args.output == "json":
         print(json.dumps(payload, indent=2))
@@ -237,6 +303,14 @@ def cmd_describe(c: Client, args) -> int:
     """kubectl-describe analog: the object plus its recorded events
     (the `events` kind the control plane mirrors in API mode)."""
     obj = c.request("GET", f"/apis/{args.kind}/{args.name}")
+    # fetch events FIRST: the list response carries serverTime, so the
+    # Age lines below render on the server's clock, not ours
+    try:
+        events = _list(c, "events")
+    except urllib.error.HTTPError as e:
+        if e.code != 404:
+            raise   # auth/server failure must not read as "no events"
+        events = []   # pre-events server: describe still works
     md = obj["metadata"]
     print(f"Name:             {md['name']}")
     print(f"Kind:             {args.kind}")
@@ -251,12 +325,6 @@ def cmd_describe(c: Client, args) -> int:
     print("Spec:")
     for line in json.dumps(obj["spec"], indent=2).splitlines()[1:-1]:
         print(f" {line}")
-    try:
-        events = c.request("GET", "/apis/events")["items"]
-    except urllib.error.HTTPError as e:
-        if e.code != 404:
-            raise   # auth/server failure must not read as "no events"
-        events = []   # pre-events server: describe still works
 
     def _matches(spec) -> bool:
         # kubectl matches involvedObject kind+name; objectName alone

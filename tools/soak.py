@@ -19,6 +19,18 @@ Exits non-zero if any invariant fails (and prints a full control-plane
 dump). A 6-minute run churns ~20k pods. The run records a time-series
 artifact (pending/nodes/claims/cost per second — the reference's
 monitor.go + Timestream metrics-pipeline analog, debug.Monitor).
+
+``--fault-schedule`` drives the SOLVER degradation ladder
+(docs/concepts/degradation.md) mid-soak, on top of the cloud chaos:
+a comma-separated list of ``SECONDS:ACTION`` entries applied once the
+run clock passes each mark. Actions: ``device-error[=N]`` (inject N
+device failures, default 3), ``g-limit=N`` (fake group-bucket ceiling
+→ wave-split), ``b-limit=N`` (fake bin-table ceiling → host-FFD
+fallback), ``clear`` (drop all injected ceilings). Example:
+``--fault-schedule 30:device-error,60:g-limit=64,120:clear``. Faults
+are always cleared before convergence, and the run prints the
+solver's degraded counters so a soak can assert the ladder actually
+fired.
 """
 
 from __future__ import annotations
@@ -40,6 +52,43 @@ from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
 from karpenter_provider_aws_tpu.operator import Operator, Options
 from karpenter_provider_aws_tpu.operator.runtime import (ControllerRuntime,
                                                          operator_specs)
+from karpenter_provider_aws_tpu.solver import FaultInjector
+
+
+def parse_fault_schedule(spec: str):
+    """'30:device-error,60:g-limit=64' → sorted [(30.0, 'device-error',
+    None), (60.0, 'g-limit', 64)]."""
+    out = []
+    for entry in filter(None, (e.strip() for e in spec.split(","))):
+        at, _, action = entry.partition(":")
+        if not _:
+            raise SystemExit(f"fault entry {entry!r}: want SECONDS:ACTION")
+        name, _, val = action.partition("=")
+        name = name.strip()
+        if name not in ("device-error", "g-limit", "b-limit", "clear"):
+            raise SystemExit(f"unknown fault action {name!r}")
+        if name in ("g-limit", "b-limit") and not val:
+            raise SystemExit(f"fault action {name} needs =N")
+        out.append((float(at), name, int(val) if val else None))
+    return sorted(out)
+
+
+def apply_fault(solver, name: str, val):
+    """Apply one schedule entry to the solver's (possibly new) injector.
+    Mutations take the injector's own lock: the operator thread is
+    consuming device_errors concurrently via take_device_error."""
+    if name == "clear":
+        solver.inject_faults(None)
+        return
+    inj = solver.faults or FaultInjector()
+    with inj._lock:
+        if name == "device-error":
+            inj.device_errors += val if val is not None else 3
+        elif name == "g-limit":
+            inj.g_limit = val
+        elif name == "b-limit":
+            inj.b_limit = val
+    solver.inject_faults(inj)
 
 
 def main(argv=None) -> int:
@@ -53,7 +102,11 @@ def main(argv=None) -> int:
                     help="drive ALL churn through the fake apiserver "
                          "(watch/list protocol + ApiWriter controllers); "
                          "adds a server-vs-mirror agreement invariant")
+    ap.add_argument("--fault-schedule", default="",
+                    help="SECONDS:ACTION[,...] solver fault injections "
+                         "(device-error[=N], g-limit=N, b-limit=N, clear)")
     args = ap.parse_args(argv)
+    fault_schedule = parse_fault_schedule(args.fault_schedule)
 
     fams = tuple(args.families.split(","))
     lattice = build_lattice([s for s in build_catalog() if s.family in fams])
@@ -74,8 +127,10 @@ def main(argv=None) -> int:
     from karpenter_provider_aws_tpu.debug import Monitor, dump_state
     monitor = Monitor(op).start(interval=1.0)
     rng = random.Random(args.seed)
-    stop = time.monotonic() + args.minutes * 60.0
+    t_start = time.monotonic()
+    stop = t_start + args.minutes * 60.0
     i = 0
+    pending_faults = list(fault_schedule)
 
     def safe_instances():
         try:
@@ -85,6 +140,12 @@ def main(argv=None) -> int:
 
     try:
         while time.monotonic() < stop:
+            while pending_faults and \
+                    time.monotonic() - t_start >= pending_faults[0][0]:
+                _, fname, fval = pending_faults.pop(0)
+                apply_fault(op.solver, fname, fval)
+                print(f"soak: fault applied {fname}"
+                      f"{'' if fval is None else '=' + str(fval)}")
             r = rng.random()
             if r < 0.5:
                 for _ in range(rng.randint(1, 15)):
@@ -144,6 +205,8 @@ def main(argv=None) -> int:
     # loop settle PAST the GC grace window so every reapable leak is reaped
     op.cloud.next_error = None
     op.cloud.capacity_pools.clear()
+    solver_fired = dict(op.solver.faults.fired) if op.solver.faults else {}
+    op.solver.inject_faults(None)
     deadline = time.monotonic() + LEAK_GRACE_SECONDS + 15.0
     ticks = 0
     while time.monotonic() < deadline:
@@ -166,7 +229,16 @@ def main(argv=None) -> int:
     print(f"soak: pods_churned={i} pending={len(pending)} "
           f"nodes={len(op.cluster.nodes)} claims={len(op.cluster.claims)} "
           f"leaked={len(leaked)} orphan_leases={len(orphans)}")
+    if fault_schedule:
+        print(f"soak: solver degraded_counts={op.solver.degraded_counts} "
+              f"faults_fired={solver_fired}")
     ok = not pending and not leaked and not orphans
+    if fault_schedule and not (op.solver.degraded_counts or solver_fired):
+        # a schedule that never fired means the soak did not exercise the
+        # ladder it promised to — fail loudly rather than report a
+        # vacuous pass
+        print("soak: fault schedule applied but solver never degraded")
+        ok = False
     if client is not None:
         # server-vs-mirror agreement: after convergence the watch-fed
         # mirror and the apiserver's truth must be identical sets

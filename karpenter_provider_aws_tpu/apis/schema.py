@@ -136,8 +136,11 @@ NODEPOOL_SCHEMA = {
             },
             "additionalProperties": False,
         },
-        # controller-owned live usage (the reference NodePool's
-        # status.resources); quantity strings per axis
+        # LEGACY location of controller-owned live usage: current servers
+        # keep status.resources in the envelope's status sub-map
+        # (spec/status split, kube/apiserver.py), and admission
+        # normalization strips this key from any applied spec — it is
+        # accepted here only so old exported YAML still applies cleanly
         "statusResources": {"type": "object",
                             "additionalProperties": {
                                 "type": "string",

@@ -149,8 +149,28 @@ def nodepool_to_dict(p: NodePool) -> Dict:
         "kubelet": ({"maxPods": p.kubelet.max_pods,
                      "clusterDNS": p.kubelet.cluster_dns}
                     if p.kubelet is not None else None),
-        "statusResources": dict(p.status_resources),
+        # NOTE: status_resources deliberately does NOT ride the spec —
+        # it is controller-owned live usage (the reference NodePool's
+        # status.resources) and lives in the envelope's status sub-map
+        # (nodepool_status_to_dict), so a `kpctl get -o yaml | kpctl
+        # apply` round-trip can never re-submit stale controller status
+        # as user intent.
     }
+
+
+def nodepool_status_to_dict(p: NodePool) -> Dict:
+    """The controller-owned status sub-map of a NodePool envelope —
+    the reference's spec/status split. User applies never carry it; the
+    apiserver preserves the stored status across spec updates."""
+    return {"resources": dict(p.status_resources)}
+
+
+def nodepool_apply_status(p: NodePool, status: Optional[Mapping]) -> NodePool:
+    """Hydrate a deserialized NodePool with its envelope status (the
+    inverse of nodepool_status_to_dict); tolerates a missing map."""
+    if status:
+        p.status_resources = dict(status.get("resources", {}))
+    return p
 
 
 def nodepool_from_dict(d: Mapping) -> NodePool:
@@ -183,6 +203,8 @@ def nodepool_from_dict(d: Mapping) -> NodePool:
         kubelet=(KubeletSpec(max_pods=d["kubelet"].get("maxPods"),
                              cluster_dns=d["kubelet"].get("clusterDNS"))
                  if d.get("kubelet") else None),
+        # legacy payloads carried status in the spec; accept it on read
+        # (admission normalization strips it on the next write)
         status_resources=dict(d.get("statusResources", {})),
     )
 
@@ -272,6 +294,12 @@ def plan_to_dict(plan) -> Dict:
         "solverPath": plan.solver_path,
         "waves": plan.waves,
         "deviceRetries": plan.device_retries,
+        # per-stage wall-clock of the solve (solver/pipeline.py STAGES)
+        # and whether the overlapped path produced it — a sidecar client
+        # sees the same pipelining evidence as an in-process controller
+        "stageMs": {k: round(float(v), 3)
+                    for k, v in plan.stage_ms.items()},
+        "pipelined": plan.pipelined,
     }
 
 
@@ -300,6 +328,8 @@ def plan_from_dict(d: Mapping):
         solver_path=d.get("solverPath", "device"),
         waves=int(d.get("waves", 1)),
         device_retries=int(d.get("deviceRetries", 0)),
+        stage_ms={k: float(v) for k, v in d.get("stageMs", {}).items()},
+        pipelined=bool(d.get("pipelined", False)),
     )
 
 # ---- node / nodeclaim / nodeclass / pdb / lease (apiserver wire) -----------

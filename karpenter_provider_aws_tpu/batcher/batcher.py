@@ -34,6 +34,15 @@ class BatcherOptions:
 
 
 class _Bucket(Generic[T, U]):
+    """One hash bucket with a PERSISTENT worker thread.
+
+    A drained worker parks on the wakeup event with NO timeout — an idle
+    bucket costs zero periodic wakeups (the previous design timed out
+    every idle window regardless). The max-window clock (``started_at``)
+    starts at the batch's FIRST ARRIVAL (set by ``add`` when pending goes
+    empty → non-empty), not at batch execution, so the max_seconds bound
+    is measured from when the oldest caller started waiting."""
+
     def __init__(self, opts: BatcherOptions,
                  batch_fn: Callable[[List[T]], Sequence[U]]):
         self.opts = opts
@@ -44,37 +53,66 @@ class _Bucket(Generic[T, U]):
         self.thread: threading.Thread = None
         self.started_at: float = 0.0
 
+    def add(self, request: T, fut: Future) -> None:
+        import time
+        with self.lock:
+            if not self.pending:
+                # first arrival of this batch arms the max-window clock
+                self.started_at = time.monotonic()
+            self.pending.append((request, fut))
+            start = self.thread is None
+            if start:
+                self.thread = threading.Thread(target=self.run, daemon=True)
+        self.wakeup.set()
+        if start:
+            self.thread.start()
+
     def run(self):
         import time
         while True:
-            time_left = self.opts.max_seconds - (time.monotonic() - self.started_at)
-            self.wakeup.clear()
-            fired = self.wakeup.wait(timeout=min(self.opts.idle_seconds, max(time_left, 0.0)))
-            with self.lock:
-                if len(self.pending) >= self.opts.max_items:
-                    fired = False
-                    time_left = 0.0
-            if fired and time_left > 0:
-                continue  # new arrival inside the idle window: keep coalescing
-            with self.lock:
-                batch, self.pending = self.pending, []
-                self.thread = None
-            self._execute(batch)
-            return
+            # drained: park with no timeout until the next arrival
+            self.wakeup.wait()
+            while True:
+                self.wakeup.clear()
+                with self.lock:
+                    if not self.pending:
+                        break   # back to the park
+                    time_left = self.opts.max_seconds - (
+                        time.monotonic() - self.started_at)
+                    full = len(self.pending) >= self.opts.max_items
+                if not full and time_left > 0:
+                    fired = self.wakeup.wait(
+                        timeout=min(self.opts.idle_seconds, time_left))
+                    if fired:
+                        # new arrival inside the idle window: keep
+                        # coalescing (until the max window closes)
+                        continue
+                with self.lock:
+                    batch, self.pending = self.pending, []
+                if batch:
+                    try:
+                        self._execute(batch)
+                    except BaseException as e:
+                        # the worker is PERSISTENT now — a crash here
+                        # would orphan this bucket's future arrivals, so
+                        # fail this batch's callers and keep running
+                        for _, fut in batch:
+                            if not fut.done():
+                                fut.set_exception(e)
 
     def _execute(self, batch: List[Tuple[T, Future]]):
         inputs = [b[0] for b in batch]
         try:
-            results = self.batch_fn(inputs)
+            # materialize before the length check: a generator-returning
+            # batch_fn must fail its callers, not kill the worker
+            results = list(self.batch_fn(inputs))
+            if len(results) != len(batch):
+                raise RuntimeError(
+                    f"batch_fn returned {len(results)} results "
+                    f"for {len(batch)} requests")
         except BaseException as e:  # fan the failure out to every caller
             for _, fut in batch:
                 fut.set_exception(e)
-            return
-        if len(results) != len(batch):
-            err = RuntimeError(
-                f"batch_fn returned {len(results)} results for {len(batch)} requests")
-            for _, fut in batch:
-                fut.set_exception(err)
             return
         for (_, fut), res in zip(batch, results):
             if isinstance(res, BaseException):
@@ -98,23 +136,12 @@ class Batcher(Generic[T, U]):
 
     def add(self, request: T, timeout: float = 30.0) -> U:
         """Block until the fused call completes; return this request's result."""
-        import time
         fut: Future = Future()
         key = self.hasher(request)
         with self._lock:
             bucket = self._buckets.get(key)
-            if bucket is None or bucket.thread is None:
+            if bucket is None:
                 bucket = _Bucket(self.opts, self.batch_fn)
                 self._buckets[key] = bucket
-        with bucket.lock:
-            if bucket.thread is None:
-                bucket.started_at = time.monotonic()
-                bucket.thread = threading.Thread(target=bucket.run, daemon=True)
-                start = True
-            else:
-                start = False
-            bucket.pending.append((request, fut))
-            bucket.wakeup.set()
-        if start:
-            bucket.thread.start()
+        bucket.add(request, fut)
         return fut.result(timeout=timeout)

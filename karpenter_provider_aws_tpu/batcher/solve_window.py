@@ -1,0 +1,73 @@
+"""Admission window in front of Solve(): coalesce concurrent solves.
+
+The reference coalesces CreateFleet/DescribeInstances calls behind idle/
+max windows (batcher.go); the TPU-native analog is the SOLVE call — the
+operator's reconcile loop, the gRPC sidecar's RPC handlers, and any
+in-process controller can all reach the resident Solver concurrently,
+and each caller that misses the solver lock pays the tunneled link's
+round trip SERIALLY after the previous caller's solve. The window parks
+concurrent arrivals for a few milliseconds, then one worker drains the
+batch back-to-back under a SINGLE solver-lock acquisition:
+
+- callers that arrived together stop interleaving with unrelated device
+  work (no lock convoy, no re-warming another caller's resident state),
+- the drain runs on the solver's pipelined path, so request k+1's input
+  upload overlaps request k's decode — the batch pays the link once per
+  solve's compute, not once per caller wait-cycle,
+- the resident-input delta cache (solver/pipeline.py) sees consecutive
+  same-shaped problems, exactly the access pattern it is built for.
+
+Results (or per-request exceptions) fan back out positionally, like
+every other Batcher user.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+from .batcher import Batcher, BatcherOptions
+
+# a solve window is much tighter than the CreateFleet window: the point
+# is catching genuinely-concurrent callers, not delaying a lone one
+SOLVE_WINDOW_OPTIONS = BatcherOptions(idle_seconds=0.005, max_seconds=0.25,
+                                      max_items=64)
+
+
+class SolveWindow:
+    """Batcher-fronted entry to ``Solver.solve_relaxed``.
+
+    ``solve_relaxed(...)`` mirrors the Solver signature and blocks until
+    the fused drain completes; requests that arrive inside the window
+    execute back-to-back holding the solver lock once."""
+
+    def __init__(self, solver, options: Optional[BatcherOptions] = None,
+                 timeout: float = 300.0):
+        self.solver = solver
+        self.timeout = timeout
+        self._batcher: Batcher = Batcher(
+            self._drain, options or SOLVE_WINDOW_OPTIONS)
+        self._lock = threading.Lock()
+        # observability: how often the window actually fused callers
+        self.batches = 0
+        self.coalesced = 0      # requests that shared a drain with others
+
+    def solve_relaxed(self, *args, **kwargs):
+        return self._batcher.add((args, kwargs), timeout=self.timeout)
+
+    def _drain(self, requests: List[Tuple[tuple, dict]]) -> Sequence:
+        with self._lock:
+            self.batches += 1
+            if len(requests) > 1:
+                self.coalesced += len(requests)
+        out = []
+        # one lock acquisition for the whole batch: the drain owns the
+        # device until every coalesced request is served (re-entrant —
+        # solve_relaxed takes the same lock)
+        with self.solver._solve_lock:
+            for args, kwargs in requests:
+                try:
+                    out.append(self.solver.solve_relaxed(*args, **kwargs))
+                except BaseException as e:   # fail just this caller
+                    out.append(e)
+        return out

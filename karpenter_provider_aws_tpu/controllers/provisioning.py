@@ -129,6 +129,7 @@ class Provisioner:
         self._m_degraded = m["solver_degraded"]
         self._m_solver_retries = m["solver_device_retries"]
         self._m_waves = m["solver_waves"]
+        self._m_stage = m["solver_stage_duration"]
         self._claim_ids = itertools.count(1)
         self._batch_start: Optional[float] = None
         self._last_pod_seen: Optional[float] = None
@@ -327,6 +328,11 @@ class Provisioner:
         if plan.device_retries:
             self._m_solver_retries.inc(plan.device_retries)
         self._m_waves.observe(plan.waves)
+        # per-stage timings (seconds, like every duration series): the
+        # overlap evidence — on a pipelined solve "download" is only the
+        # residual wait after prefetch/decode-prep ran inside the window
+        for stage, ms in plan.stage_ms.items():
+            self._m_stage.observe(ms / 1000.0, stage=stage)
         if plan.degraded:
             reason = plan.degraded_reason or "unknown"
             self._m_degraded.inc(path=plan.solver_path, reason=reason)

@@ -236,6 +236,11 @@ class FakeAPIServer:
                     "finalizers": list(finalizers),
                 },
                 "spec": spec,
+                # controller-owned status sub-map (the k8s spec/status
+                # split): written only via patch(status_patch=...), and
+                # PRESERVED across user spec updates — `kpctl get -o yaml
+                # | kpctl apply` can never re-submit stale status
+                "status": {},
             }
             self._store[kind][name] = obj
             self._emit("ADDED", kind, obj)
@@ -267,7 +272,11 @@ class FakeAPIServer:
 
     def update(self, kind: str, obj: dict) -> dict:
         """Full-object update with optimistic concurrency: the caller's
-        metadata.resourceVersion must match the stored object's."""
+        metadata.resourceVersion must match the stored object's. The
+        envelope's ``status`` sub-map is controller-owned and EXCLUDED
+        from the write — the stored status survives a user apply
+        verbatim (spec/status split; write status via
+        ``patch(status_patch=...)``)."""
         self._check_kind(kind)
         name = obj["metadata"]["name"]
         with self._lock:
@@ -301,8 +310,13 @@ class FakeAPIServer:
         never clobber each other's entries), everything else replaces."""
         if v is None:
             target.pop(k, None)
-        elif isinstance(v, dict) and isinstance(target.get(k), dict):
-            sub = dict(target[k])
+        elif isinstance(v, dict):
+            # RFC 7386 §2: a non-object (or missing) target counts as {},
+            # so deletion markers inside the patch vanish instead of
+            # being stored verbatim as None values — status patches skip
+            # admission and would otherwise persist them
+            base = target.get(k)
+            sub = dict(base) if isinstance(base, dict) else {}
             for sk, sv in v.items():
                 FakeAPIServer._merge_value(sub, sk, sv)
             target[k] = sub
@@ -310,11 +324,14 @@ class FakeAPIServer:
             target[k] = copy.deepcopy(v)
 
     def patch(self, kind: str, name: str, spec_patch: Optional[dict] = None, *,
+              status_patch: Optional[dict] = None,
               finalizers: Optional[Sequence[str]] = None) -> dict:
         """JSON-merge-patch on the spec (RFC 7386: ``None`` values delete
-        keys, nested maps merge per-key) and/or replace the finalizer
-        list. No RV precondition — a patch applies to whatever is
-        current, like a server-side strategic merge."""
+        keys, nested maps merge per-key), the controller-owned envelope
+        ``status`` sub-map, and/or replace the finalizer list. No RV
+        precondition — a patch applies to whatever is current, like a
+        server-side strategic merge. Status patches skip spec admission:
+        they never contain user intent."""
         self._check_kind(kind)
         with self._lock:
             cur = self._store[kind].get(name)
@@ -325,6 +342,10 @@ class FakeAPIServer:
                 for k, v in spec_patch.items():
                     self._merge_value(new["spec"], k, v)
                 new["spec"] = self._admit(kind, name, new["spec"])
+            if status_patch:
+                status = new.setdefault("status", {})
+                for k, v in status_patch.items():
+                    self._merge_value(status, k, v)
             if finalizers is not None:
                 new["metadata"]["finalizers"] = list(finalizers)
             new["metadata"]["resourceVersion"] = self._next_rv()

@@ -168,7 +168,10 @@ class KubeClient:
 
     def list_nodepools(self) -> List[NodePool]:
         items, _ = self.server.list("nodepools")
-        return [serde.nodepool_from_dict(o["spec"]) for o in items]
+        # controller-owned live usage rides the envelope status sub-map
+        return [serde.nodepool_apply_status(
+                    serde.nodepool_from_dict(o["spec"]), o.get("status"))
+                for o in items]
 
     def update_nodepool(self, pool: NodePool) -> None:
         self.server.patch("nodepools", pool.name, serde.nodepool_to_dict(pool))

@@ -14,7 +14,8 @@ SURVEY §1 L0). Routes, mirroring the k8s path shapes:
     GET    /apis/{kind}/{name}             get → envelope
     POST   /apis/{kind}                    create (spec body) → envelope
     PUT    /apis/{kind}/{name}             update (full envelope body)
-    PATCH  /apis/{kind}/{name}             merge patch {spec?, finalizers?}
+    PATCH  /apis/{kind}/{name}             merge patch {spec?, status?,
+                                           finalizers?}
     DELETE /apis/{kind}/{name}[?force=1]   delete (finalizer-aware)
     POST   /apis/pods/{name}/binding       {"nodeName": ...}
     POST   /apis/pods/{name}/eviction[?force=1]
@@ -300,6 +301,7 @@ def serve(server: FakeAPIServer, port: int = 0,
                 body = self._body()
                 self._json(200, server.patch(
                     kind, name, body.get("spec"),
+                    status_patch=body.get("status"),
                     finalizers=body.get("finalizers")))
             except Exception as e:
                 self._error(e)

@@ -260,6 +260,17 @@ def wire_core_metrics(reg: Registry) -> Dict[str, _Metric]:
             "Waves per scheduling solve (1 = one device pass; >1 = the "
             "group axis wave-split).", (),
             buckets=(1, 2, 4, 8, 16, 32, 64)),
+        # per-stage share of the device solve (solver/pipeline.py STAGES)
+        # — the observable proof that the pipelined path overlaps host
+        # work with the in-flight device call: under overlap, "download"
+        # (the residual blocking wait) shrinks while "build"/"upload"
+        # stay constant (docs/concepts/performance.md "Pipelining & the
+        # tunnel link")
+        "solver_stage_duration": reg.histogram(
+            "karpenter_solver_stage_duration_seconds",
+            "Wall-clock share of one scheduling solve per pipeline stage "
+            "(stage: build | upload | compute | download | decode).",
+            ("stage",)),
         # reference metrics.md:62,16,19
         "pods_startup_time": reg.histogram(
             "karpenter_pods_startup_time_seconds",

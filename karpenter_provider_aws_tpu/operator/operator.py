@@ -364,12 +364,13 @@ class Operator:
             startup.observe(s)
         # per-pool committed usage + limits (reference metrics.md:16-22).
         # pool_usage() depends only on the node/claim capacity set —
-        # re-render on its revision, not on every per-second pass. A
-        # user `kpctl apply` replaces the wire spec (statusResources
-        # resets to {}) without touching capacity_rev, so a cheap
-        # dict-compare against the last computed status also re-arms
-        # the pass — otherwise the wire object would show zero usage
-        # until the next node/claim change.
+        # re-render on its revision, not on every per-second pass. The
+        # envelope status survives user applies (spec/status split), but
+        # a watch-delivered pool re-install can still lose the typed
+        # pool's hydrated status, so a cheap dict-compare against the
+        # last computed status also re-arms the pass — otherwise the
+        # wire object could show stale usage until the next node/claim
+        # change.
         # snapshot: the async runtime's statesync thread mutates
         # node_pools concurrently with this (metrics-thread) scan
         pools_now = list(self.node_pools.items())
@@ -406,7 +407,9 @@ class Operator:
                                     resource_type=ax)
                 # status.resources (the reference NodePool status): keep
                 # the typed pool current, and in API mode patch the wire
-                # object so `kpctl get nodepools` shows live usage
+                # object's STATUS sub-map — controller-owned, outside the
+                # user spec, so a user apply can neither wipe it for long
+                # nor accidentally re-submit it (spec/status split)
                 sr = vec_to_quantities(vec) if vec is not None else {}
                 self._pool_status_cache[name] = sr
                 if sr != pool.status_resources:
@@ -416,21 +419,12 @@ class Operator:
                                 if k not in sr}, **sr}
                     pool.status_resources = sr
                     if self.api_server is not None:
-                        from ..kube.apiserver import InvalidObjectError
                         try:
                             self.api_server.patch(
-                                "nodepools", name, {"statusResources": delta})
+                                "nodepools", name,
+                                status_patch={"resources": delta})
                         except NotFoundError:
                             pass   # pool deleted mid-pass; watch will prune
-                        except InvalidObjectError:
-                            # a hand-PUT spec without the statusResources
-                            # key can race this patch: RFC 7386 deletion
-                            # markers against a missing map fail admission.
-                            # The watch delivers the fresh (empty-status)
-                            # pool next pass and the dirty scan re-patches
-                            # with a marker-free delta — never abort the
-                            # gauge pass over a best-effort status write.
-                            pass
         # offering gauge surface: re-emit only when pricing or the ICE set
         # actually changed (both are versioned)
         gstate = (self.lattice.price_version, self.unavailable.seq_num)

@@ -148,7 +148,11 @@ class StateSync:
         if type_ == "DELETED":
             self.node_pools.pop(name, None)
             return
-        self._install_pool(serde.nodepool_from_dict(obj["spec"]))
+        # hydrate controller-owned status from the envelope (spec/status
+        # split) so a watch re-delivery doesn't zero the typed pool's
+        # live usage and trigger a spurious re-patch
+        self._install_pool(serde.nodepool_apply_status(
+            serde.nodepool_from_dict(obj["spec"]), obj.get("status")))
 
     def _on_nodeclass(self, type_, name, obj, old) -> None:
         if type_ == "DELETED":

@@ -35,13 +35,19 @@ _HEALTH = "/karpenter.solver.v1.Solver/Health"
 
 
 class SolverService:
-    """Server-side request handling around a resident Solver."""
+    """Server-side request handling around a resident Solver.
 
-    def __init__(self, solver: Solver):
+    ``window`` (batcher/solve_window.py SolveWindow) fronts the Solve
+    RPC with the device-batch admission window: concurrent RPCs coalesce
+    into one back-to-back drain under a single solver-lock acquisition
+    instead of paying the tunneled link serially, caller by caller."""
+
+    def __init__(self, solver: Solver, window=None):
         # Solver is thread-safe (its public entry points serialize on an
         # internal RLock), so RPCs and in-process controller solves on the
         # same instance interleave safely
         self.solver = solver
+        self.window = window
 
     def solve(self, payload: bytes) -> bytes:
         from ..solver.topology import BoundPod
@@ -67,7 +73,8 @@ class SolverService:
         headroom = {k: np.asarray([np.inf if x is None else x for x in v],
                                   np.float32)
                     for k, v in (req.get("poolHeadroom") or {}).items()} or None
-        plan = self.solver.solve_relaxed(
+        entry = self.window if self.window is not None else self.solver
+        plan = entry.solve_relaxed(
             pods, pools, existing=existing, daemonset_pods=ds,
             bound_pods=bound, pvcs=pvcs, storage_classes=scs,
             pool_headroom=headroom)
@@ -97,11 +104,21 @@ class _Handler(grpc.GenericRpcHandler):
 
 
 def serve(solver: Solver, address: str = "unix:/tmp/karpenter-solver.sock",
-          max_workers: int = 4) -> grpc.Server:
-    """Start the sidecar on ``address``; returns the running server."""
+          max_workers: int = 4, admission_window: bool = True) -> grpc.Server:
+    """Start the sidecar on ``address``; returns the running server.
+
+    ``admission_window`` fronts the Solve RPC with the device-batch
+    coalescing window (batcher/solve_window.py) so concurrent RPC
+    workers fuse into one device drain instead of serializing on the
+    link; disable it for single-caller latency tests."""
     from concurrent.futures import ThreadPoolExecutor
+    window = None
+    if admission_window:
+        from ..batcher import SolveWindow
+        window = SolveWindow(solver)
     server = grpc.server(ThreadPoolExecutor(max_workers=max_workers))
-    server.add_generic_rpc_handlers((_Handler(SolverService(solver)),))
+    server.add_generic_rpc_handlers(
+        (_Handler(SolverService(solver, window=window)),))
     # add_insecure_port signals bind failure by returning 0, not raising
     # (unix: sockets return 1 on success)
     if server.add_insecure_port(address) == 0:

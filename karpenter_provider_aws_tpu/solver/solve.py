@@ -41,6 +41,7 @@ from ..errors import (SolverCapacityError, SolverDeviceError, SolverError,
 from ..lattice.tensors import Lattice
 from ..ops import binpack
 from .faults import FaultInjector
+from .pipeline import ResidentInputCache, StageTimer, fetch_async
 from .problem import Problem
 
 _G_BUCKETS = (16, 32, 64, 96, 128, 192, 256, 512, 1024, 4096)
@@ -99,6 +100,14 @@ class NodePlan:
     solver_path: str = "device"                  # device | wave-split | host-ffd
     waves: int = 1
     device_retries: int = 0
+    # per-stage wall-clock (ms) of the device solve, keyed by
+    # solver/pipeline.py STAGES (build/upload/compute/download/decode).
+    # In pipelined mode "download" is the residual wait AFTER overlapped
+    # host work — the overlap evidence the bench and metrics surface.
+    stage_ms: Dict[str, float] = field(default_factory=dict)
+    # True when the overlapped path produced this plan (async dispatch /
+    # double-buffered waves); parity tests prove the bit-identical claim
+    pipelined: bool = False
 
     @property
     def num_new_nodes(self) -> int:
@@ -267,7 +276,7 @@ class Solver:
     Thread-safe: every public solve/probe entry point serializes on an
     internal RLock (see __init__)."""
 
-    def __init__(self, lattice: Lattice):
+    def __init__(self, lattice: Lattice, pipeline: bool = True):
         self.lattice = lattice
         # probe-gated Pallas finalization: on a TPU backend the streaming
         # cheapest-offering kernel replaces the [B,T,Z,C] XLA intermediate
@@ -301,6 +310,23 @@ class Solver:
         # these into the karpenter_solver_degraded_total metric family
         self.faults: Optional[FaultInjector] = None
         self.degraded_counts: Dict[str, int] = {}
+        # the overlapped solve path (docs/concepts/performance.md
+        # "Pipelining & the tunnel link"): async device dispatch with the
+        # result fetch deferred to the last decode moment, double-buffered
+        # wave uploads, and the resident-input delta cache. Off = the
+        # strictly sequential path the byte-parity tests compare against.
+        self.pipeline = pipeline
+        self._resident = ResidentInputCache()
+        # observability for soaks/benches: proof the overlap engaged
+        self.pipeline_stats: Dict[str, int] = {
+            "async_solves": 0,       # device solves that dispatched async
+            "prefetched_waves": 0,   # wave inputs uploaded during compute
+        }
+
+    def set_pipeline(self, enabled: bool) -> None:
+        """Toggle the overlapped solve path (thread-safe)."""
+        with self._solve_lock:
+            self.pipeline = bool(enabled)
 
     _EST_CACHE_MAX = 128
     _DEVICE_RETRIES = 1          # transient device failures retried this often
@@ -721,6 +747,8 @@ class Solver:
         path_order = {"device": 0, "wave-split": 1, "host-ffd": 2}
         worst_path, any_degraded, reasons = "device", False, []
         total_retries, max_waves = 0, 1
+        stage_total: Dict[str, float] = {}
+        any_pipelined = False
         for _ in range(max_rounds):
             eff = [p if relax.get(p.name, 0) == 0 else relax_pod(p, relax[p.name])
                    for p in pods]
@@ -734,6 +762,9 @@ class Solver:
             total_device += plan.device_seconds
             total_retries += plan.device_retries
             max_waves = max(max_waves, plan.waves)
+            any_pipelined = any_pipelined or plan.pipelined
+            for k, v in plan.stage_ms.items():
+                stage_total[k] = stage_total.get(k, 0.0) + v
             if plan.degraded:
                 any_degraded = True
                 if plan.degraded_reason and plan.degraded_reason not in reasons:
@@ -761,6 +792,8 @@ class Solver:
         best.solver_path = worst_path
         best.device_retries = total_retries
         best.waves = max_waves
+        best.stage_ms = stage_total
+        best.pipelined = any_pipelined
         return best
 
     @_locked
@@ -812,6 +845,13 @@ class Solver:
                 # deterministic host-side failure goes straight to the
                 # fallback so a programming error is never misreported as
                 # transient hardware trouble
+                if is_retryable_solver_error(e):
+                    # the failure may have taken the device-resident input
+                    # buffers with it (backend restart, OOM eviction); drop
+                    # the cache so the retry — and every later solve whose
+                    # unchanged inputs would otherwise delta-hit a dead
+                    # buffer — re-uploads instead
+                    self._resident.invalidate()
                 if is_retryable_solver_error(e) and retries < self._DEVICE_RETRIES:
                     retries += 1
                     self._count_degraded("device_retry")
@@ -833,14 +873,29 @@ class Solver:
         return plan
 
     def _solve_device(self, problem: Problem, mesh=None,
-                      t0: Optional[float] = None) -> NodePlan:
+                      t0: Optional[float] = None, gbuf=None,
+                      overlap=None) -> NodePlan:
         """The primary path: one bucketed device pack (or the pod-axis
         sharded variant when a multi-device mesh is supplied). Raises
         SolverCapacityError when the bin table cannot grow past its
-        ceiling; the ladder in solve() owns what happens next."""
+        ceiling; the ladder in solve() owns what happens next.
+
+        The pipelined variant (``self.pipeline``) overlaps host work with
+        the in-flight device call: the result fetch is non-blocking (the
+        device→host copy starts at dispatch, ``solver/pipeline.py
+        fetch_async``), the ``overlap`` callable — the wave planner's
+        "upload wave k+1 while wave k computes" hook — runs between
+        dispatch and fetch, and host decode prep (pool name/label
+        skeletons, existing-bin name table) fills the residual wait.
+        ``gbuf`` is an already-uploaded fused group+pool buffer; when
+        provided the build/upload stages were paid by the caller
+        (possibly inside a previous wave's compute window).
+        """
         t0 = time.perf_counter() if t0 is None else t0
         if mesh is not None and mesh.devices.size > 1:
             return self._solve_sharded(problem, mesh, t0)
+        pipelined = self.pipeline
+        stages = StageTimer()
         G = _bucket(problem.G, _G_BUCKETS)
         total_pods = int(problem.count.sum())
         b_needed = problem.E + min(total_pods, self._estimate_bins(problem) + 64)
@@ -854,35 +909,66 @@ class Solver:
             B = fresh
         B = min(B, self._b_ceiling())
 
-        fused_np = self._fused_inputs_np(problem, G)
-        fused = jnp.asarray(fused_np) if problem.E == 0 else None
+        fused_np = None
+        if gbuf is None:
+            with stages.span("build"):
+                fused_np = self._fused_inputs_np(problem, G)
+        # the combined one-upload form only serves the sequential E>0
+        # path; pipelined solves split group and init uploads so the big
+        # group buffer can ride the resident delta cache (or a wave's
+        # prefetch) while the small init buffer tracks carry state
+        use_efused = pipelined or gbuf is not None or problem.E == 0
+        if use_efused and gbuf is None:
+            with stages.span("upload"):
+                gbuf = (self._resident.upload(("g", G, fused_np.size),
+                                              fused_np)
+                        if pipelined else jnp.asarray(fused_np))
         avail, price = self._device_avail_price(problem)
 
         lat = self.lattice
+        overlap_pending = overlap
+        prep = None
         while True:
             self._maybe_inject_device_fault()
             td = time.perf_counter()
-            # exactly ONE fused input upload (existing bins ride the same
-            # buffer via pack_packed_combined) + one fused result transfer
-            # (sync included); lean layout: the plan decode never reads
-            # cum/alloc_cap/pm/po
+            # at most ONE group+pool upload and one small init upload
+            # (fused into a single combined transfer on the sequential
+            # E>0 path) + one fused result transfer; lean layout: the
+            # plan decode never reads cum/alloc_cap/pm/po
             try:
                 with self._trace_span("solver.pack"):
-                    if problem.E:
-                        init_np = self._fused_init_np(problem, B)
-                        combined = jnp.asarray(
-                            np.concatenate([fused_np, init_np]))
-                        buf = np.asarray(binpack.pack_packed_combined(
-                            self._alloc, avail, price, combined,
-                            len(fused_np), problem.E, B,
-                            G, lat.T, lat.Z, lat.C, max(problem.NP, 1),
-                            max(problem.A, 1), lean=True))
+                    if use_efused:
+                        init_dev = None
+                        if problem.E:
+                            with stages.span("build"):
+                                init_np = self._fused_init_np(problem, B)
+                            with stages.span("upload"):
+                                init_dev = (self._resident.upload(
+                                    ("i", B, init_np.size), init_np)
+                                    if pipelined else jnp.asarray(init_np))
+                        with stages.span("compute"):
+                            dev_buf = binpack.pack_packed_efused(
+                                self._alloc, avail, price, gbuf, init_dev,
+                                problem.E, B,
+                                G, lat.T, lat.Z, lat.C, max(problem.NP, 1),
+                                max(problem.A, 1), lean=True)
                     else:
-                        buf = np.asarray(binpack.pack_packed_efused(
-                            self._alloc, avail, price, fused, None,
-                            problem.E, B,
-                            G, lat.T, lat.Z, lat.C, max(problem.NP, 1),
-                            max(problem.A, 1), lean=True))
+                        with stages.span("build"):
+                            init_np = self._fused_init_np(problem, B)
+                        with stages.span("upload"):
+                            combined = jnp.asarray(
+                                np.concatenate([fused_np, init_np]))
+                        with stages.span("compute"):
+                            dev_buf = binpack.pack_packed_combined(
+                                self._alloc, avail, price, combined,
+                                len(fused_np), problem.E, B,
+                                G, lat.T, lat.Z, lat.C, max(problem.NP, 1),
+                                max(problem.A, 1), lean=True)
+                if pipelined:
+                    # start streaming the result the moment the kernel
+                    # finishes; the host fills the wait below instead of
+                    # paying a separate ready-wait + transfer leg
+                    fetch_async(dev_buf)
             except SolverError:
                 raise
             except Exception as e:
@@ -891,9 +977,30 @@ class Solver:
                 # bugs which must NOT earn a blind re-solve
                 raise SolverDeviceError(
                     f"{type(e).__name__}: {e}", cause=e) from e
+            # host-side overlap work OUTSIDE the device-error wrap: a
+            # deterministic bug in next-wave input building or decode
+            # prep must surface as internal-error (no blind re-solve),
+            # not masquerade as device weather. The device keeps
+            # computing the already-dispatched kernel meanwhile.
+            if overlap_pending is not None:
+                # the wave pipeline's prefetch: wave k+1's inputs
+                # build+upload while wave k computes
+                overlap_pending()
+                overlap_pending = None
+            if prep is None:
+                prep = self._decode_prep(problem)
+            try:
+                with stages.span("download"):
+                    buf = np.asarray(dev_buf)
+            except SolverError:
+                raise
+            except Exception as e:
+                raise SolverDeviceError(
+                    f"{type(e).__name__}: {e}", cause=e) from e
             device_s = time.perf_counter() - td
-            dec = _unpack_decode_set(buf, G, lat.T, lat.Z, lat.C,
-                                     max(problem.A, 1), lean=True)
+            with stages.span("decode"):
+                dec = _unpack_decode_set(buf, G, lat.T, lat.Z, lat.C,
+                                         max(problem.A, 1), lean=True)
             overflowed = (dec.leftover.sum() > 0) and dec.next_open >= B
             if overflowed:
                 nb, grew = _grow_bucket(B)
@@ -915,10 +1022,28 @@ class Solver:
         needed = _bucket(max(dec.next_open, problem.E + 1, 1), _B_BUCKETS,
                          clamp=True)
         self._b_hint[G] = (fresh, needed)
-        plan = self._decode(problem, dec, device_s)
+        with stages.span("decode"):
+            plan = self._decode(problem, dec, device_s, prep=prep)
         plan.solve_seconds = time.perf_counter() - t0
         plan.warnings = list(problem.warnings)
+        plan.stage_ms = stages.ms
+        plan.pipelined = pipelined
+        if pipelined:
+            # once per completed solve (not per overflow-regrow dispatch):
+            # this is the "overlap engaged" evidence soak/bench assert on
+            self.pipeline_stats["async_solves"] += 1
         return plan
+
+    def _decode_prep(self, problem: Problem) -> Dict[str, object]:
+        """Host decode work that does not depend on the device result —
+        pool name/label skeletons and the existing-bin name table — run
+        while the device computes so it is off the critical path. The
+        values feed _decode identically in both modes (the sequential
+        path just computes them after the fetch)."""
+        return {
+            "pool_out": [_pool_out(p) for p in problem.node_pools],
+            "existing_names": [b.name for b in problem.existing],
+        }
 
     # ---- wave-split planner (group-axis graceful degradation) ----
 
@@ -936,10 +1061,40 @@ class Solver:
         affinity-class presence counts), and placements onto REAL existing
         capacity update that capacity's remaining headroom — so packing
         quality stays within the host-FFD envelope instead of each wave
-        opening its own fresh fleet."""
+        opening its own fresh fleet.
+
+        Pipelined mode double-buffers the wave INPUTS: wave k+1's fused
+        group+pool buffer depends only on its group slice (never on carry
+        state), so it builds and uploads while wave k computes on device
+        — N waves stop paying one full upload leg each. The carry state
+        itself (the small init buffer) is inherently sequential: it is
+        derived at the stage boundary from wave k's decode, exactly as in
+        the sequential planner, which is why the two modes produce
+        byte-identical plans (tests/test_pipeline.py)."""
         ceiling = self._g_ceiling()
         wave = max(1, min(self._WAVE_G_TARGET, ceiling))
-        n_waves = -(-problem.G // wave)
+        bounds = [(lo, min(lo + wave, problem.G))
+                  for lo in range(0, problem.G, wave)]
+        n_waves = len(bounds)
+        # the pod-axis sharded path builds its own sharded uploads —
+        # pre-built single-device group buffers would just be wasted
+        sharded = mesh is not None and int(mesh.devices.size) > 1
+        pipelined = self.pipeline and not sharded
+        stages = StageTimer()
+
+        def wave_gbuf(i: int):
+            """Wave i's fused group+pool upload — carry-independent, so
+            the pipelined loop runs this inside wave i-1's compute window
+            (the _solve_device ``overlap`` hook)."""
+            lo_i, hi_i = bounds[i]
+            gp = self._wave_slice(problem, lo_i, hi_i)
+            Gw = _bucket(gp.G, _G_BUCKETS)
+            with stages.span("build"):
+                fnp = self._fused_inputs_np(gp, Gw)
+            with stages.span("upload"):
+                if pipelined:
+                    return self._resident.upload(("w", i, Gw, fnp.size), fnp)
+                return jnp.asarray(fnp)
 
         A = problem.A
         # pod name -> group index (req/match/owner lookups while carrying
@@ -982,13 +1137,33 @@ class Solver:
                 pm += problem.g_match[gi]
                 po |= problem.g_owner[gi]
 
-        for lo in range(0, problem.G, wave):
-            hi = min(lo + wave, problem.G)
+        # only the pipelined planner pre-builds wave inputs: the
+        # sequential path keeps the pre-pipeline single combined
+        # group+init upload inside _solve_device, so it stays the honest
+        # baseline the cfg8 overlap margin is measured against
+        next_gbuf = wave_gbuf(0) if pipelined else None
+        for i, (lo, hi) in enumerate(bounds):
             sub = self._wave_problem(problem, lo, hi, e_used, e_pm, e_po,
                                      pseudo_nodes, pseudo_used, pseudo_np,
                                      pseudo_pm, pseudo_po)
-            plan_w = self._solve_device(sub, mesh)
+            gbuf_i, next_gbuf = next_gbuf, None
+            holder: Dict[str, object] = {}
+            overlap = None
+            if pipelined and i + 1 < n_waves:
+                def overlap(j=i + 1):
+                    # runs between wave i's dispatch and its result
+                    # fetch: wave j's upload rides wave i's compute
+                    holder["gbuf"] = wave_gbuf(j)
+                    self.pipeline_stats["prefetched_waves"] += 1
+            plan_w = self._solve_device(sub, mesh, gbuf=gbuf_i,
+                                        overlap=overlap)
+            next_gbuf = holder.get("gbuf")
+            if pipelined and next_gbuf is None and i + 1 < n_waves:
+                # the prefetch hook did not run (e.g. the wave retried
+                # past it): upload synchronously rather than skip a wave
+                next_gbuf = wave_gbuf(i + 1)
             device_s += plan_w.device_seconds
+            stages.merge(plan_w.stage_ms)
             merged_unsched.update(plan_w.unschedulable)
             for node_name, pod_names in plan_w.existing_assignments.items():
                 pi = pseudo_by_name.get(node_name)
@@ -1031,7 +1206,27 @@ class Solver:
                 f"wave-split: G={problem.G} over ceiling {ceiling}, "
                 f"{n_waves} wave(s) of ≤{wave} groups"],
             degraded=True, degraded_reason="g-overflow",
-            solver_path="wave-split", waves=n_waves)
+            solver_path="wave-split", waves=n_waves,
+            stage_ms=stages.ms, pipelined=pipelined)
+
+    def _wave_slice(self, problem: Problem, lo: int, hi: int) -> Problem:
+        """Groups [lo, hi) with carry-INDEPENDENT fields only — exactly
+        what the wave's fused group+pool buffer reads
+        (ops/binpack.group_layout names no existing-bin field), so the
+        pipelined planner can build wave k+1's upload before wave k's
+        results exist. _wave_problem layers the carried bin state on
+        top of this at the stage boundary."""
+        sl = slice(lo, hi)
+        return replace(
+            problem,
+            groups=problem.groups[sl], unschedulable={}, warnings=[],
+            req=problem.req[sl], count=problem.count[sl],
+            g_type=problem.g_type[sl], g_zone=problem.g_zone[sl],
+            g_cap=problem.g_cap[sl], g_np=problem.g_np[sl],
+            max_per_bin=problem.max_per_bin[sl],
+            g_spread=problem.g_spread[sl], single_bin=problem.single_bin[sl],
+            g_match=problem.g_match[sl], g_owner=problem.g_owner[sl],
+            g_need=problem.g_need[sl], strict_custom=problem.strict_custom[sl])
 
     def _wave_problem(self, problem: Problem, lo: int, hi: int,
                       e_used: np.ndarray, e_pm: np.ndarray, e_po: np.ndarray,
@@ -1044,7 +1239,6 @@ class Solver:
         wave's planned node as a fixed pre-initialized bin."""
         lat = self.lattice
         from .problem import ExistingBin
-        sl = slice(lo, hi)
         existing = list(problem.existing)
         if pseudo_nodes:
             k = len(pseudo_nodes)
@@ -1085,15 +1279,7 @@ class Solver:
             e_cap2, e_np2 = problem.e_cap, problem.e_np
             e_pm2, e_po2 = e_pm, e_po
         return replace(
-            problem,
-            groups=problem.groups[sl], unschedulable={}, warnings=[],
-            req=problem.req[sl], count=problem.count[sl],
-            g_type=problem.g_type[sl], g_zone=problem.g_zone[sl],
-            g_cap=problem.g_cap[sl], g_np=problem.g_np[sl],
-            max_per_bin=problem.max_per_bin[sl],
-            g_spread=problem.g_spread[sl], single_bin=problem.single_bin[sl],
-            g_match=problem.g_match[sl], g_owner=problem.g_owner[sl],
-            g_need=problem.g_need[sl], strict_custom=problem.strict_custom[sl],
+            self._wave_slice(problem, lo, hi),
             existing=existing, e_used=e_used2, e_alloc=e_alloc2,
             e_type=e_type2, e_zone=e_zone2, e_cap=e_cap2, e_np=e_np2,
             e_pm=e_pm2, e_po=e_po2)
@@ -1144,7 +1330,12 @@ class Solver:
             solve_seconds=time.perf_counter() - t0, device_seconds=0.0,
             warnings=list(problem.warnings), solver_path="host-ffd")
 
-    def _decode(self, problem: Problem, dec: _DecodeSet, device_s: float) -> NodePlan:
+    def _decode(self, problem: Problem, dec: _DecodeSet, device_s: float,
+                prep: Optional[Dict[str, object]] = None) -> NodePlan:
+        if prep is None:
+            prep = self._decode_prep(problem)
+        pool_out = prep["pool_out"]
+        existing_names = prep["existing_names"]
         lat = self.lattice
         assign = dec.assign
         leftover = dec.leftover
@@ -1176,8 +1367,6 @@ class Solver:
         chosen_c = dec.chosen_c.tolist()
         chosen_price = dec.chosen_price.tolist()
         leftover_l = leftover.tolist()
-        existing = problem.existing
-        node_pools = problem.node_pools
 
         for gi, group in enumerate(problem.groups):
             names = group.pod_names
@@ -1190,14 +1379,14 @@ class Solver:
                 cursor += n
                 if fixed_l[b]:
                     existing_assignments.setdefault(
-                        existing[b].name, []).extend(pod_slice)
+                        existing_names[b], []).extend(pod_slice)
                 else:
                     node = new_bins.get(b)
                     if node is None:
                         ftypes, fzones, fcaps = feasible_for[b]
-                        pname, extra = _pool_out(node_pools[np_id_l[b]])
+                        pname, extra = pool_out[np_id_l[b]]
                         node = PlannedNode(
-                            node_pool=pname, extra_labels=extra,
+                            node_pool=pname, extra_labels=dict(extra),
                             instance_type=lat.names[chosen_t[b]],
                             zone=lat.zones[chosen_z[b]],
                             capacity_type=lat.capacity_types[chosen_c[b]],

@@ -363,8 +363,9 @@ class TestEventsThroughAPI:
 
 
 class TestNodePoolStatusResources:
-    """Live pool usage surfaces as the wire object's statusResources —
-    the reference NodePool's status.resources."""
+    """Live pool usage surfaces as the wire object's controller-owned
+    status sub-map (envelope status.resources — the reference NodePool's
+    status.resources), OUTSIDE the user-owned spec."""
 
     def test_usage_patched_onto_pool_object(self, lattice):
         clock, server, client, op = make_env(lattice)
@@ -372,7 +373,9 @@ class TestNodePoolStatusResources:
             client.create_pod(run_pod(f"sr-{i}"))
         op.settle()
         obj = server.get("nodepools", "default")
-        sr = obj["spec"]["statusResources"]
+        # the spec/status split: live usage never rides the user spec
+        assert "statusResources" not in obj["spec"]
+        sr = obj["status"]["resources"]
         assert sr.get("cpu", "").endswith("m")
         assert sr.get("memory", "").endswith("Mi")
         assert int(sr["pods"]) >= 3
@@ -395,28 +398,36 @@ class TestNodePoolStatusResources:
         # the node is gone; usage axes drop out of the status (the
         # merge-patch carries explicit deletes for zeroed axes)
         assert client.list_nodes() == []
-        sr = server.get("nodepools", "default")["spec"]["statusResources"]
+        sr = server.get("nodepools", "default")["status"]["resources"]
         assert not sr, sr
 
-    def test_user_apply_does_not_wipe_status_for_long(self, lattice):
-        """`kpctl apply` replaces the wire spec (statusResources resets);
-        the operator re-stamps live usage on the next pass even though
-        capacity never changed (review r5)."""
+    def test_user_apply_preserves_status(self, lattice):
+        """The spec/status split: a user apply (full-spec update) can
+        never touch the controller-owned status — a `kpctl get -o yaml |
+        kpctl apply` round-trip no longer re-submits stale usage (ADVICE
+        r5), and a legacy spec carrying statusResources has it stripped
+        by admission normalization."""
         from karpenter_provider_aws_tpu.apis import serde
         clock, server, client, op = make_env(lattice)
         client.create_pod(run_pod("sr-apply"))
         op.settle()
-        assert server.get("nodepools", "default")["spec"]["statusResources"]
-        # user-style apply: serde round-trip of a FRESH pool spec (no
-        # status), like kpctl apply -f would PUT
+        before = server.get("nodepools", "default")["status"]["resources"]
+        assert before
+        # user-style apply: serde round-trip of a FRESH pool spec, like
+        # kpctl apply -f would PUT — plus a stale legacy statusResources
+        # key as an old exported YAML would carry
         spec = serde.nodepool_to_dict(NodePool(name="default", weight=7))
+        spec["statusResources"] = {"cpu": "999"}
         obj = server.get("nodepools", "default")
         obj["spec"] = spec
         server.update("nodepools", obj)
-        assert not server.get("nodepools", "default")["spec"][
-            "statusResources"]
+        after = server.get("nodepools", "default")
+        assert after["status"]["resources"] == before
+        assert after["spec"].get("weight") == 7
+        # admission normalization strips the legacy in-spec status key
+        assert "statusResources" not in after["spec"]
         op.run_once()
-        sr = server.get("nodepools", "default")["spec"]["statusResources"]
+        sr = server.get("nodepools", "default")["status"]["resources"]
         assert sr.get("cpu", "").endswith("m"), sr
 
     def test_status_cache_pruned_on_pool_delete(self, lattice):

@@ -1,0 +1,473 @@
+"""Pipelined-solve tests (docs/concepts/performance.md "Pipelining &
+the tunnel link").
+
+The overlapped solve path exists to hide the tunneled link's ~100 ms
+round trip, and its entire safety argument is DETERMINISM: async
+dispatch, double-buffered wave uploads, and the resident-input delta
+cache may only move work off the critical path — never change a single
+byte of the resulting plan. These tests pin that contract:
+
+- pipelined vs sequential solves produce byte-identical NodePlans
+  (node placements, prices, feasible sets) on cfg5-shaped and
+  wave-split problems,
+- the degradation ladder still engages under FaultInjector device
+  failures mid-pipeline, with no half-decoded plan leaking,
+- the resident-input delta cache returns exactly the uploaded bytes
+  under deltas, bulk changes, layout growth, and key collisions,
+- the Solve admission window (batcher/solve_window.py) coalesces
+  concurrent callers and isolates per-caller failures,
+- an idle batcher bucket parks without periodic wakeups and measures
+  its max window from the FIRST arrival.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.apis import NodePool, Pod
+from karpenter_provider_aws_tpu.apis import serde
+from karpenter_provider_aws_tpu.batcher import (Batcher, BatcherOptions,
+                                                SolveWindow)
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+from karpenter_provider_aws_tpu.solver import (FaultInjector, Solver,
+                                               build_problem)
+from karpenter_provider_aws_tpu.solver.pipeline import (STAGES,
+                                                        ResidentInputCache,
+                                                        StageTimer)
+
+_FAMILIES = ("m5", "c5", "r5", "t3")
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    return build_lattice([s for s in build_catalog() if s.family in _FAMILIES])
+
+
+def diverse_pods(n, prefix="u"):
+    """n pods with n DISTINCT scheduling signatures."""
+    return [Pod(name=f"{prefix}{i}",
+                requests={"cpu": f"{100 + i}m",
+                          "memory": f"{256 + (i % 8) * 64}Mi"})
+            for i in range(n)]
+
+
+def cfg5_shaped_pods(n=3000):
+    """A scaled cfg5 shape: a few dozen signatures over many pods, with
+    selector variety — the north-star workload's structure without its
+    50k-pod bulk."""
+    from karpenter_provider_aws_tpu.apis import wellknown as wk
+    rng = np.random.default_rng(0)
+    shapes = []
+    for _ in range(30):
+        cpu = int(rng.choice([100, 250, 500, 1000, 2000]))
+        mem = int(rng.choice([256, 512, 1024, 2048, 4096]))
+        sel = {}
+        if rng.random() < 0.25:
+            sel[wk.LABEL_INSTANCE_CATEGORY] = str(rng.choice(["m", "c", "r"]))
+        shapes.append(({"cpu": f"{cpu}m", "memory": f"{mem}Mi"}, sel))
+    counts = rng.multinomial(n, np.ones(30) / 30)
+    pods = []
+    for s, ((req, sel), k) in enumerate(zip(shapes, counts)):
+        pods += [Pod(name=f"s{s}-{i}", requests=req, node_selector=sel)
+                 for i in range(k)]
+    return pods
+
+
+def canonical(plan) -> str:
+    """The plan's byte-comparable identity: everything except wall-clock
+    timings and pipelining provenance (which NAME the path taken and so
+    legitimately differ between the two modes)."""
+    d = serde.plan_to_dict(plan)
+    for k in ("solveSeconds", "deviceSeconds", "stageMs", "pipelined"):
+        d.pop(k)
+    return json.dumps(d, sort_keys=True)
+
+
+def assert_nothing_dropped(plan, n_pods):
+    scheduled = (sum(len(x.pods) for x in plan.new_nodes)
+                 + sum(len(v) for v in plan.existing_assignments.values()))
+    assert scheduled + len(plan.unschedulable) == n_pods
+
+
+class TestPlanParity:
+    """Pipelined and sequential solves are byte-identical — the overlap
+    moves work in time, never in effect."""
+
+    def test_cfg5_shaped_parity(self, lattice):
+        pods = cfg5_shaped_pods(3000)
+        pools = [NodePool(name="default")]
+        seq = Solver(lattice, pipeline=False)
+        pipe = Solver(lattice, pipeline=True)
+        p_seq = seq.solve(build_problem(pods, pools, lattice))
+        p_pipe = pipe.solve(build_problem(pods, pools, lattice))
+        assert not p_seq.pipelined and p_pipe.pipelined
+        assert canonical(p_seq) == canonical(p_pipe)
+        assert_nothing_dropped(p_pipe, len(pods))
+        assert pipe.pipeline_stats["async_solves"] >= 1
+
+    def test_wave_split_parity(self, lattice):
+        """The double-buffered wave pipeline prefetches wave k+1's inputs
+        inside wave k's compute window and still produces the sequential
+        planner's exact plan (carry state is handled at the stage
+        boundary)."""
+        pods = diverse_pods(200)
+        pools = [NodePool(name="default")]
+        seq = Solver(lattice, pipeline=False)
+        pipe = Solver(lattice, pipeline=True)
+        seq.inject_faults(FaultInjector(g_limit=64))
+        pipe.inject_faults(FaultInjector(g_limit=64))
+        p_seq = seq.solve(build_problem(pods, pools, lattice))
+        p_pipe = pipe.solve(build_problem(pods, pools, lattice))
+        assert p_seq.solver_path == p_pipe.solver_path == "wave-split"
+        assert p_seq.waves == p_pipe.waves == 4
+        assert canonical(p_seq) == canonical(p_pipe)
+        # every wave but the last was prefetched during its predecessor
+        assert pipe.pipeline_stats["prefetched_waves"] == p_pipe.waves - 1
+        assert seq.pipeline_stats["prefetched_waves"] == 0
+
+    def test_steady_state_delta_cache_engages(self, lattice):
+        """A reconcile-loop-shaped workload (the same problem re-solved)
+        re-uploads ZERO blocks after the first pass, and every pass still
+        yields the identical plan."""
+        pods = cfg5_shaped_pods(1500)
+        pools = [NodePool(name="default")]
+        pipe = Solver(lattice, pipeline=True)
+        first = pipe.solve(build_problem(pods, pools, lattice))
+        shipped_after_first = pipe._resident.blocks_shipped
+        for _ in range(2):
+            again = pipe.solve(build_problem(pods, pools, lattice))
+            assert canonical(again) == canonical(first)
+        stats = pipe._resident.stats()
+        assert stats["hits"] >= 2
+        # identical fused inputs → the delta shipped nothing new
+        assert stats["blocks_shipped"] == shipped_after_first
+        assert stats["blocks_resident"] > 0
+
+    def test_pipeline_toggle_runtime(self, lattice):
+        """set_pipeline flips the path live; both directions keep plan
+        identity."""
+        pods = diverse_pods(40)
+        pools = [NodePool(name="default")]
+        s = Solver(lattice, pipeline=True)
+        a = s.solve(build_problem(pods, pools, lattice))
+        s.set_pipeline(False)
+        b = s.solve(build_problem(pods, pools, lattice))
+        assert a.pipelined and not b.pipelined
+        assert canonical(a) == canonical(b)
+
+
+class TestFaultsMidPipeline:
+    """Device failures inside the overlapped path: the ladder engages
+    exactly as in sequential mode and no half-decoded plan leaks."""
+
+    def test_transient_device_error_parity(self, lattice):
+        pods = diverse_pods(24)
+        pools = [NodePool(name="default")]
+        seq = Solver(lattice, pipeline=False)
+        pipe = Solver(lattice, pipeline=True)
+        seq.inject_faults(FaultInjector(device_errors=1))
+        pipe.inject_faults(FaultInjector(device_errors=1))
+        p_seq = seq.solve(build_problem(pods, pools, lattice))
+        p_pipe = pipe.solve(build_problem(pods, pools, lattice))
+        assert p_pipe.device_retries == p_seq.device_retries == 1
+        assert p_pipe.solver_path == "device" and not p_pipe.degraded
+        assert canonical(p_seq) == canonical(p_pipe)
+
+    def test_wave_fault_mid_pipeline(self, lattice):
+        """A device error while waves are in flight: the whole solve
+        retries (the ladder), then the wave pipeline completes — nothing
+        dropped, parity intact."""
+        pods = diverse_pods(150)
+        pools = [NodePool(name="default")]
+        seq = Solver(lattice, pipeline=False)
+        pipe = Solver(lattice, pipeline=True)
+        seq.inject_faults(FaultInjector(g_limit=64, device_errors=1))
+        pipe.inject_faults(FaultInjector(g_limit=64, device_errors=1))
+        p_seq = seq.solve(build_problem(pods, pools, lattice))
+        p_pipe = pipe.solve(build_problem(pods, pools, lattice))
+        assert p_pipe.solver_path == "wave-split"
+        assert p_pipe.device_retries == 1
+        assert_nothing_dropped(p_pipe, 150)
+        assert canonical(p_seq) == canonical(p_pipe)
+
+    def test_persistent_failure_reaches_host_ffd(self, lattice):
+        """The bottom rung under pipelining: host FFD engages, the plan
+        is complete (not a torn pipeline state), and it matches the
+        sequential solver's fallback byte for byte."""
+        pods = diverse_pods(30)
+        pools = [NodePool(name="default")]
+        seq = Solver(lattice, pipeline=False)
+        pipe = Solver(lattice, pipeline=True)
+        seq.inject_faults(FaultInjector(device_errors=10))
+        pipe.inject_faults(FaultInjector(device_errors=10))
+        p_seq = seq.solve(build_problem(pods, pools, lattice))
+        p_pipe = pipe.solve(build_problem(pods, pools, lattice))
+        assert p_pipe.solver_path == "host-ffd"
+        assert p_pipe.degraded and p_pipe.degraded_reason == "device-error"
+        assert_nothing_dropped(p_pipe, 30)
+        assert not p_pipe.unschedulable
+        assert canonical(p_seq) == canonical(p_pipe)
+
+
+class TestStageTimings:
+    def test_plan_carries_stage_ms(self, lattice):
+        pods = diverse_pods(20)
+        plan = Solver(lattice, pipeline=True).solve(
+            build_problem(pods, [NodePool(name="default")], lattice))
+        assert plan.stage_ms
+        assert set(plan.stage_ms) <= set(STAGES)
+        for stage in ("compute", "download", "decode"):
+            assert plan.stage_ms[stage] >= 0.0
+        assert all(v >= 0.0 for v in plan.stage_ms.values())
+
+    def test_sequential_plan_also_timed(self, lattice):
+        plan = Solver(lattice, pipeline=False).solve(
+            build_problem(diverse_pods(20), [NodePool(name="default")],
+                          lattice))
+        assert plan.stage_ms and not plan.pipelined
+
+    def test_wave_split_accumulates_stages(self, lattice):
+        s = Solver(lattice, pipeline=True)
+        s.inject_faults(FaultInjector(g_limit=64))
+        plan = s.solve(build_problem(diverse_pods(200),
+                                     [NodePool(name="default")], lattice))
+        assert plan.waves == 4
+        # four waves' worth of compute accumulated into one plan record
+        assert plan.stage_ms["compute"] > 0.0
+        assert plan.stage_ms["upload"] >= 0.0
+
+    def test_serde_roundtrip_preserves_stages(self, lattice):
+        plan = Solver(lattice, pipeline=True).solve(
+            build_problem(diverse_pods(12), [NodePool(name="default")],
+                          lattice))
+        back = serde.plan_from_dict(
+            json.loads(json.dumps(serde.plan_to_dict(plan))))
+        assert back.pipelined == plan.pipelined is True
+        assert set(back.stage_ms) == set(plan.stage_ms)
+        for k, v in plan.stage_ms.items():
+            assert back.stage_ms[k] == pytest.approx(v, abs=1e-3)
+
+    def test_stage_timer_accumulates_and_merges(self):
+        t = StageTimer()
+        with t.span("upload"):
+            pass
+        with t.span("upload"):
+            pass
+        t.add("compute", 0.002)
+        other = StageTimer()
+        other.add("compute", 0.001)
+        other.add("decode", 0.004)
+        t.merge(other.ms)
+        assert t.ms["compute"] == pytest.approx(3.0)
+        assert t.ms["decode"] == pytest.approx(4.0)
+        assert t.ms["upload"] >= 0.0
+
+    def test_provisioner_observes_stage_metric(self, lattice):
+        from karpenter_provider_aws_tpu.operator import Operator, Options
+        from karpenter_provider_aws_tpu.cloud import FakeCloud
+        from karpenter_provider_aws_tpu.utils.clock import FakeClock
+        clock = FakeClock()
+        op = Operator(options=Options(registration_delay=1.0),
+                      lattice=lattice, cloud=FakeCloud(clock), clock=clock)
+        for p in diverse_pods(10):
+            op.cluster.add_pod(p)
+        op.provisioner.provision_once()
+        m = op.metrics.get("karpenter_solver_stage_duration_seconds")
+        assert m is not None
+        assert m.count(stage="compute") >= 1
+        assert m.count(stage="decode") >= 1
+
+
+class TestResidentInputCache:
+    def _roundtrip(self, cache, key, buf):
+        out = np.asarray(cache.upload(key, buf))
+        assert out.dtype == np.uint8 and out.shape == buf.shape
+        np.testing.assert_array_equal(out, buf)
+
+    def test_cold_then_delta(self):
+        cache = ResidentInputCache(block=64)
+        rng = np.random.default_rng(1)
+        buf = rng.integers(0, 255, 1000, dtype=np.uint8)
+        self._roundtrip(cache, ("k",), buf)
+        assert cache.misses == 1 and cache.hits == 0
+        buf2 = buf.copy()
+        buf2[130:140] ^= 0xFF    # one dirty block
+        self._roundtrip(cache, ("k",), buf2)
+        assert cache.hits == 1
+        assert 1 <= cache.blocks_shipped <= 2
+        assert cache.blocks_resident > 0
+
+    def test_identical_reupload_ships_nothing(self):
+        cache = ResidentInputCache(block=64)
+        buf = np.arange(500, dtype=np.uint8)
+        self._roundtrip(cache, ("k",), buf)
+        self._roundtrip(cache, ("k",), buf.copy())
+        assert cache.hits == 1 and cache.blocks_shipped == 0
+
+    def test_bulk_change_falls_back_to_full_upload(self):
+        cache = ResidentInputCache(block=64)
+        rng = np.random.default_rng(2)
+        buf = rng.integers(0, 255, 4096, dtype=np.uint8)
+        self._roundtrip(cache, ("k",), buf)
+        flipped = (buf ^ 0xFF)   # every block dirty
+        self._roundtrip(cache, ("k",), flipped)
+        assert cache.misses == 2 and cache.blocks_shipped == 0
+
+    def test_layout_growth_restores(self):
+        cache = ResidentInputCache(block=64)
+        self._roundtrip(cache, ("k",), np.zeros(100, np.uint8))
+        self._roundtrip(cache, ("k",), np.ones(5000, np.uint8))
+        assert cache.misses == 2
+
+    def test_key_collision_is_only_a_perf_event(self):
+        """Two different problems aliasing one key must still each read
+        back their own bytes — the diff runs against actual content."""
+        cache = ResidentInputCache(block=64)
+        a = np.full(300, 7, np.uint8)
+        b = np.full(300, 9, np.uint8)
+        self._roundtrip(cache, ("k",), a)
+        self._roundtrip(cache, ("k",), b)
+        self._roundtrip(cache, ("k",), a)
+
+    def test_eviction_bound(self):
+        cache = ResidentInputCache(max_entries=4, block=64)
+        for i in range(10):
+            self._roundtrip(cache, ("k", i), np.full(64, i, np.uint8))
+        assert len(cache._entries) <= 4
+
+
+class TestSolveWindow:
+    def test_concurrent_callers_coalesce_and_fan_out(self, lattice):
+        solver = Solver(lattice, pipeline=True)
+        window = SolveWindow(
+            solver, options=BatcherOptions(idle_seconds=0.05,
+                                           max_seconds=0.5, max_items=8))
+        pools = [NodePool(name="default")]
+        results = {}
+        barrier = threading.Barrier(4)
+
+        def call(i):
+            barrier.wait()
+            results[i] = window.solve_relaxed(
+                diverse_pods(10 + i, prefix=f"w{i}-"), pools)
+
+        threads = [threading.Thread(target=call, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert set(results) == {0, 1, 2, 3}
+        for i, plan in results.items():
+            # positional fan-out: each caller got ITS OWN problem's plan
+            assert_nothing_dropped(plan, 10 + i)
+            names = {n for node in plan.new_nodes for n in node.pods}
+            assert all(n.startswith(f"w{i}-") for n in names)
+        assert window.batches >= 1
+        assert window.coalesced >= 2   # at least one fused drain happened
+
+    def test_exception_isolated_to_its_caller(self, lattice):
+        solver = Solver(lattice, pipeline=True)
+        window = SolveWindow(
+            solver, options=BatcherOptions(idle_seconds=0.05,
+                                           max_seconds=0.5, max_items=8))
+        pools = [NodePool(name="default")]
+        outcomes = {}
+        barrier = threading.Barrier(2)
+
+        def good():
+            barrier.wait()
+            outcomes["good"] = window.solve_relaxed(diverse_pods(5), pools)
+
+        def bad():
+            barrier.wait()
+            try:
+                # not iterable pods → this caller's request fails
+                window.solve_relaxed(object(), pools)
+                outcomes["bad"] = None
+            except Exception as e:
+                outcomes["bad"] = e
+
+        ts = [threading.Thread(target=good), threading.Thread(target=bad)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        assert isinstance(outcomes["bad"], Exception)
+        assert_nothing_dropped(outcomes["good"], 5)
+
+    def test_sidecar_serves_through_window(self, lattice):
+        """serve(admission_window=True) wires the window in front of the
+        Solve RPC path."""
+        from karpenter_provider_aws_tpu.parallel.sidecar import SolverService
+        solver = Solver(lattice, pipeline=True)
+        svc = SolverService(solver, window=SolveWindow(solver))
+        req = {"pods": [serde.pod_to_dict(p) for p in diverse_pods(6)],
+               "nodePools": [serde.nodepool_to_dict(NodePool(name="default"))]}
+        out = json.loads(svc.solve(json.dumps(req).encode()).decode())
+        plan = serde.plan_from_dict(out)
+        assert_nothing_dropped(plan, 6)
+        assert svc.window.batches == 1
+
+
+class TestBatcherPark:
+    def test_idle_bucket_parks_without_wakeups(self):
+        calls = []
+        b = Batcher(lambda reqs: [calls.append(len(reqs)) or r for r in reqs],
+                    BatcherOptions(idle_seconds=0.01, max_seconds=0.2))
+        assert b.add("x") == "x"
+        bucket = next(iter(b._buckets.values()))
+        worker = bucket.thread
+        assert worker is not None and worker.is_alive()
+        # drained: the worker parks on the event — many idle windows
+        # later it has NOT cycled (no timeout wakeups), just waits
+        time.sleep(0.1)
+        assert worker.is_alive()
+        assert not bucket.wakeup.is_set()
+        assert not bucket.pending
+        # the SAME worker serves the next batch (persistent, reused)
+        assert b.add("y") == "y"
+        assert bucket.thread is worker
+        assert calls == [1, 1]
+
+    def test_max_window_measured_from_first_arrival(self):
+        """A steady drip of arrivals inside the idle window must not
+        extend the batch past max_seconds FROM THE FIRST ARRIVAL."""
+        executed = threading.Event()
+        b = Batcher(lambda reqs: [executed.set() or r for r in reqs],
+                    BatcherOptions(idle_seconds=0.05, max_seconds=0.15,
+                                   max_items=1000))
+        stop = time.monotonic() + 0.6
+
+        def drip():
+            while time.monotonic() < stop and not executed.is_set():
+                try:
+                    b.add("d", timeout=2.0)
+                    return
+                except Exception:
+                    return
+
+        t0 = time.monotonic()
+        threads = [threading.Thread(target=drip) for _ in range(3)]
+        threads[0].start()
+        time.sleep(0.04)
+        threads[1].start()
+        time.sleep(0.04)
+        threads[2].start()
+        assert executed.wait(timeout=1.0)
+        elapsed = time.monotonic() - t0
+        # 0.15 s max window + generous scheduling slack — far under the
+        # ~0.05*N unbounded extension the drip would otherwise cause
+        assert elapsed < 0.5
+        for t in threads:
+            t.join(5)
+
+    def test_max_items_still_flushes_immediately(self):
+        b = Batcher(lambda reqs: list(reqs),
+                    BatcherOptions(idle_seconds=5.0, max_seconds=30.0,
+                                   max_items=1))
+        t0 = time.monotonic()
+        assert b.add("x", timeout=5.0) == "x"
+        assert time.monotonic() - t0 < 2.0

@@ -131,6 +131,14 @@ def _mem(v):
     return f"{b / 2**20:g}Mi"
 
 
+def _np_status(o):
+    """A NodePool's live resource usage: the envelope's controller-owned
+    status sub-map (spec/status split); falls back to the legacy in-spec
+    location for objects written by an older server."""
+    return ((o.get("status") or {}).get("resources")
+            or o["spec"].get("statusResources", {}))
+
+
 # per-kind table columns: (header, spec-path extractor)
 _COLUMNS = {
     "nodeclaims": (
@@ -158,15 +166,16 @@ _COLUMNS = {
     "nodepools": (
         ("NAME", lambda o: o["metadata"]["name"]),
         ("WEIGHT", lambda o: str(o["spec"].get("weight", 0))),
-        # live usage vs ceiling (statusResources is the reference
-        # NodePool's status.resources; "-" = unlimited axis), both sides
-        # normalized to one unit (cores / common memory suffix) so
-        # "12000m/48" never renders as two different scales
+        # live usage vs ceiling (the controller-owned status.resources —
+        # the envelope's status sub-map, never the user spec; "-" =
+        # unlimited axis), both sides normalized to one unit (cores /
+        # common memory suffix) so "12000m/48" never renders as two
+        # different scales
         ("CPU", lambda o: "{}/{}".format(
-            _cores(o["spec"].get("statusResources", {}).get("cpu", "0")),
+            _cores(_np_status(o).get("cpu", "0")),
             _cores(o["spec"].get("limits", {}).get("cpu", "-")))),
         ("MEMORY", lambda o: "{}/{}".format(
-            _mem(o["spec"].get("statusResources", {}).get("memory", "0")),
+            _mem(_np_status(o).get("memory", "0")),
             _mem(o["spec"].get("limits", {}).get("memory", "-")))),
     ),
     "events": (

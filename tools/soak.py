@@ -31,6 +31,14 @@ fallback), ``clear`` (drop all injected ceilings). Example:
 are always cleared before convergence, and the run prints the
 solver's degraded counters so a soak can assert the ladder actually
 fired.
+
+``--pipeline`` exercises the overlapped solve path
+(docs/concepts/performance.md "Pipelining & the tunnel link") under the
+same sustained churn: the pipelined path is forced on, and the run
+FAILS unless it actually engaged — the solver's async-dispatch counter
+and the resident-input cache's hit/shipped counters are printed and
+asserted non-vacuous, so "pipelined soak passed" can never mean "soak
+quietly ran sequential".
 """
 
 from __future__ import annotations
@@ -105,6 +113,12 @@ def main(argv=None) -> int:
     ap.add_argument("--fault-schedule", default="",
                     help="SECONDS:ACTION[,...] solver fault injections "
                          "(device-error[=N], g-limit=N, b-limit=N, clear)")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="exercise the overlapped solve path "
+                         "(docs/concepts/performance.md 'Pipelining & the "
+                         "tunnel link') under sustained load: force the "
+                         "pipelined path on and FAIL the soak if it never "
+                         "engaged (async solves / resident-cache counters)")
     args = ap.parse_args(argv)
     fault_schedule = parse_fault_schedule(args.fault_schedule)
 
@@ -123,6 +137,8 @@ def main(argv=None) -> int:
                                   interruption_queue="soak-q"),
                   lattice=lattice, interruption_queue=q,
                   api_server=api_server)
+    if args.pipeline:
+        op.solver.set_pipeline(True)
     rt = ControllerRuntime(operator_specs(op)).start()
     from karpenter_provider_aws_tpu.debug import Monitor, dump_state
     monitor = Monitor(op).start(interval=1.0)
@@ -233,6 +249,17 @@ def main(argv=None) -> int:
         print(f"soak: solver degraded_counts={op.solver.degraded_counts} "
               f"faults_fired={solver_fired}")
     ok = not pending and not leaked and not orphans
+    if args.pipeline:
+        # the overlapped path must have actually carried the soak's
+        # solves — a flag that silently fell back to sequential would
+        # report a vacuous pass
+        pstats = dict(op.solver.pipeline_stats)
+        cstats = op.solver._resident.stats()
+        print(f"soak: pipeline stats={pstats} resident_cache={cstats}")
+        if pstats.get("async_solves", 0) == 0:
+            print("soak: --pipeline set but no solve took the "
+                  "overlapped path")
+            ok = False
     if fault_schedule and not (op.solver.degraded_counts or solver_fired):
         # a schedule that never fired means the soak did not exercise the
         # ladder it promised to — fail loudly rather than report a

@@ -241,6 +241,76 @@ def config7_highG_wave_split():
     return pods, _pools_default(), []
 
 
+def config9_sharded_16k():
+    """The multi-chip SCALE row (VERDICT: parallel/sharded.py was only
+    ever exercised at ≤2,400 pods). 16,500 mixed-shape pods — small,
+    mid, and category-selector waves — solved over the pod-axis sharded
+    mesh (shard_map DP + ICI psum reductions, tail-bin merge), refereed
+    for the ≤2% envelope against the SINGLE-device solve of the same
+    problem."""
+    from karpenter_provider_aws_tpu.apis import Pod
+    from karpenter_provider_aws_tpu.apis import wellknown as wk
+    n_each = 6600
+    pods = [Pod(name=f"ss{i}", requests={"cpu": "500m", "memory": "1Gi"})
+            for i in range(n_each)]
+    pods += [Pod(name=f"sm{i}", requests={"cpu": "2", "memory": "4Gi"})
+             for i in range(n_each)]
+    pods += [Pod(name=f"sl{i}", requests={"cpu": "4", "memory": "8Gi"},
+                 node_selector={wk.LABEL_INSTANCE_CATEGORY: "c"})
+             for i in range(n_each // 2)]
+    return pods, _pools_default(), []
+
+
+def run_sharded_config(make, lattice, solver, iters=5):
+    """The cfg9 sharded-scale row: Solver.solve(mesh=...) end to end.
+
+    Shards over every visible device (capped at 8, the virtual-mesh
+    size the tests pin); ``mesh_devices`` is recorded so a single-chip
+    run is legible as such rather than silently reading like a
+    multi-chip result. Parity referees against the single-device solve
+    of the SAME problem — the honest envelope for a partitioned pack."""
+    import jax
+
+    from karpenter_provider_aws_tpu.parallel import solver_mesh
+    from karpenter_provider_aws_tpu.solver import build_problem
+
+    pods, pools, existing = make()
+    n_pods = len(pods)
+    n_dev = min(8, len(jax.devices()))
+    mesh = solver_mesh(n_dev)
+    problem = build_problem(pods, pools, lattice, existing=existing)
+
+    single = solver.solve(problem)                    # referee + warmup
+    plan = solver.solve(problem, mesh=mesh)           # sharded warmup
+    placed = sum(len(x.pods) for x in plan.new_nodes) + \
+        sum(len(v) for v in plan.existing_assignments.values())
+    assert placed + len(plan.unschedulable) == n_pods
+
+    e2e_ms = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        problem = build_problem(pods, pools, lattice, existing=existing)
+        plan = solver.solve(problem, mesh=mesh)
+        e2e_ms.append((time.perf_counter() - t0) * 1000.0)
+    e2e_p50 = float(np.percentile(e2e_ms, 50))
+    ratio = (plan.new_node_cost / single.new_node_cost
+             if single.new_node_cost > 0 else 1.0)
+    detail = {
+        "pods": n_pods,
+        "groups": problem.G,
+        "mesh_devices": n_dev,
+        "new_nodes": plan.num_new_nodes,
+        "unschedulable": len(plan.unschedulable),
+        "e2e_p50_ms": round(e2e_p50, 3),
+        "pods_per_sec": round(n_pods / (e2e_p50 / 1000.0), 1),
+        "plan_cost_per_hour": round(plan.new_node_cost, 2),
+        "single_device_cost_per_hour": round(single.new_node_cost, 2),
+        "cost_vs_single_device": round(ratio, 4),
+        "within_envelope": ratio <= 1.02,
+    }
+    return e2e_p50, detail
+
+
 def build_bench_problem():
     """Back-compat hook (tests + driver round 1): the config-5 problem."""
     from karpenter_provider_aws_tpu.lattice import build_lattice
@@ -607,6 +677,11 @@ def main(argv=None):
                          "(lattice/realdata.py schema)")
     ap.add_argument("--no-continuity", action="store_true",
                     help="skip the cross-catalog cfg5 continuity row")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI smoke: ONE fast config (cfg1, 3 iters, "
+                         "synthetic catalog), no Pallas/continuity rows — "
+                         "proves the bench harness + solve path end to "
+                         "end in well under a minute (tools/ci.sh)")
     args = ap.parse_args(argv)
 
     from karpenter_provider_aws_tpu.lattice import build_lattice
@@ -620,6 +695,22 @@ def main(argv=None):
         specs = load_catalog(path, require_price=True)
         return (build_lattice(specs),
                 "real:" + (catalog if path else "reference"))
+
+    if args.smoke:
+        lattice, catalog_name = _make_lattice("synthetic")
+        solver = Solver(lattice)
+        e2e_p50, detail = run_config("cfg1_100pods_parity", config1_parity,
+                                     lattice, solver, iters=3)
+        detail["catalog"] = catalog_name
+        detail["smoke"] = True
+        print(json.dumps({
+            "metric": "e2e_p50_latency_cfg1_100pods_parity",
+            "value": round(e2e_p50, 3),
+            "unit": "ms",
+            "vs_baseline": round(TARGET_MS / e2e_p50, 3),
+            "detail": detail,
+        }), flush=True)
+        return
 
     lattice, catalog_name = _make_lattice(args.catalog)
     solver = Solver(lattice)
@@ -679,6 +770,22 @@ def main(argv=None):
         "unit": "ms",
         "vs_baseline": round(TARGET_MS / ov_p50, 3) if ov_p50 else 0.0,
         "detail": ov_detail,
+    }), flush=True)
+
+    # the multi-chip scale row: the pod-axis sharded solve at 16.5k pods
+    # (beyond the test suite's former 2,400-pod ceiling), refereed
+    # against the single-device solve; mesh_devices records the real
+    # device count so single-chip runs stay legible
+    sh_p50, sh_detail = run_sharded_config(config9_sharded_16k, lattice,
+                                           solver)
+    sh_detail["start_link_rtt_ms"] = link_rtt
+    sh_detail["catalog"] = catalog_name
+    print(json.dumps({
+        "metric": "e2e_p50_latency_cfg9_16k_sharded",
+        "value": round(sh_p50, 3),
+        "unit": "ms",
+        "vs_baseline": round(TARGET_MS / sh_p50, 3) if sh_p50 else 0.0,
+        "detail": sh_detail,
     }), flush=True)
 
     # cross-catalog continuity: the SAME cfg5 problem on the other

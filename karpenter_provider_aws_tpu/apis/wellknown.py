@@ -65,6 +65,20 @@ ANNOTATION_INSTANCE_TAGGED = f"{KARPENTER_PREFIX}/instance-tagged"
 # pod/node/NodePool opt-out from voluntary disruption (reference
 # website concepts/disruption.md:253,282,294)
 ANNOTATION_DO_NOT_DISRUPT = f"{KARPENTER_PREFIX}/do-not-disrupt"
+# Tracing & solver-provenance annotations (docs/reference/tracing.md).
+# The REST apiserver stamps an incoming request's W3C traceparent onto
+# created pods so the provisioning pass that later drains them can join
+# the SAME trace (tail of the REST→operator causal chain); the
+# provisioner stamps each NodeClaim with the traceparent of the pass
+# that planned it plus the solve's provenance (path / degradation /
+# per-stage ms / pipelined flag), which `kpctl describe nodeclaims`
+# renders so an operator sees WHY a claim's solve was slow or degraded.
+ANNOTATION_TRACEPARENT = f"{KARPENTER_PREFIX}/traceparent"
+ANNOTATION_SOLVER_PATH = f"{KARPENTER_PREFIX}/solver-path"
+ANNOTATION_SOLVER_DEGRADED_REASON = f"{KARPENTER_PREFIX}/solver-degraded-reason"
+ANNOTATION_SOLVER_PIPELINED = f"{KARPENTER_PREFIX}/solver-pipelined"
+ANNOTATION_SOLVER_WAVES = f"{KARPENTER_PREFIX}/solver-waves"
+ANNOTATION_SOLVER_STAGE_MS = f"{KARPENTER_PREFIX}/solver-stage-ms"
 TAG_NAME = "Name"
 TAG_NODECLAIM = f"{KARPENTER_PREFIX}/nodeclaim"
 

@@ -22,6 +22,8 @@ from concurrent.futures import Future
 from dataclasses import dataclass
 from typing import Callable, Dict, Generic, Hashable, List, Sequence, Tuple, TypeVar
 
+from .. import trace
+
 T = TypeVar("T")  # request
 U = TypeVar("U")  # response
 
@@ -47,7 +49,11 @@ class _Bucket(Generic[T, U]):
                  batch_fn: Callable[[List[T]], Sequence[U]]):
         self.opts = opts
         self.batch_fn = batch_fn
-        self.pending: List[Tuple[T, Future]] = []
+        # (request, future, producer traceparent-or-None): the producer's
+        # trace context rides the queue so the drain — which runs on the
+        # bucket's own worker thread, outside any caller's contextvars —
+        # can LINK its fused-call span back to every caller it served
+        self.pending: List[Tuple[T, Future, object]] = []
         self.wakeup = threading.Event()
         self.lock = threading.Lock()
         self.thread: threading.Thread = None
@@ -55,11 +61,12 @@ class _Bucket(Generic[T, U]):
 
     def add(self, request: T, fut: Future) -> None:
         import time
+        ctx = trace.capture()
         with self.lock:
             if not self.pending:
                 # first arrival of this batch arms the max-window clock
                 self.started_at = time.monotonic()
-            self.pending.append((request, fut))
+            self.pending.append((request, fut, ctx))
             start = self.thread is None
             if start:
                 self.thread = threading.Thread(target=self.run, daemon=True)
@@ -96,25 +103,36 @@ class _Bucket(Generic[T, U]):
                         # the worker is PERSISTENT now — a crash here
                         # would orphan this bucket's future arrivals, so
                         # fail this batch's callers and keep running
-                        for _, fut in batch:
+                        for _, fut, _ctx in batch:
                             if not fut.done():
                                 fut.set_exception(e)
 
-    def _execute(self, batch: List[Tuple[T, Future]]):
+    def _execute(self, batch: List[Tuple[T, Future, object]]):
         inputs = [b[0] for b in batch]
+        # the drain's span is a fresh root on the worker thread, LINKED to
+        # every producer that contributed a request — the flight-recorder
+        # view of "these N callers shared one fused call"
+        links = [c for _, _, c in batch if c]
+        # a single-caller drain JOINS its caller's trace; a fused drain is
+        # its own root linked to every producer (a span cannot have N
+        # parents — links are the standard answer)
+        parent = links[0] if len(links) == 1 else None
         try:
             # materialize before the length check: a generator-returning
             # batch_fn must fail its callers, not kill the worker
-            results = list(self.batch_fn(inputs))
+            with trace.span("batch.drain", parent=parent,
+                            links=links if len(links) > 1 else (),
+                            n=len(batch), coalesced=len(batch) > 1):
+                results = list(self.batch_fn(inputs))
             if len(results) != len(batch):
                 raise RuntimeError(
                     f"batch_fn returned {len(results)} results "
                     f"for {len(batch)} requests")
         except BaseException as e:  # fan the failure out to every caller
-            for _, fut in batch:
+            for _, fut, _ctx in batch:
                 fut.set_exception(e)
             return
-        for (_, fut), res in zip(batch, results):
+        for (_, fut, _ctx), res in zip(batch, results):
             if isinstance(res, BaseException):
                 fut.set_exception(res)
             else:

@@ -26,6 +26,7 @@ from __future__ import annotations
 import threading
 from typing import List, Optional, Sequence, Tuple
 
+from .. import trace
 from .batcher import Batcher, BatcherOptions
 
 # a solve window is much tighter than the CreateFleet window: the point
@@ -53,9 +54,14 @@ class SolveWindow:
         self.coalesced = 0      # requests that shared a drain with others
 
     def solve_relaxed(self, *args, **kwargs):
-        return self._batcher.add((args, kwargs), timeout=self.timeout)
+        # the caller's trace context rides the request tuple: the drain
+        # runs on the bucket worker (no ambient context), and each
+        # coalesced solve must land in ITS caller's trace — a sidecar RPC
+        # that waited out the window still yields one connected span tree
+        return self._batcher.add((args, kwargs, trace.capture()),
+                                 timeout=self.timeout)
 
-    def _drain(self, requests: List[Tuple[tuple, dict]]) -> Sequence:
+    def _drain(self, requests: List[Tuple[tuple, dict, object]]) -> Sequence:
         with self._lock:
             self.batches += 1
             if len(requests) > 1:
@@ -65,9 +71,15 @@ class SolveWindow:
         # device until every coalesced request is served (re-entrant —
         # solve_relaxed takes the same lock)
         with self.solver._solve_lock:
-            for args, kwargs in requests:
+            for args, kwargs, ctx in requests:
                 try:
-                    out.append(self.solver.solve_relaxed(*args, **kwargs))
+                    # re-parent onto the producer: the solver's span tree
+                    # (solve → waves → stages) nests under the caller's
+                    # trace, and the drain position records how long the
+                    # request queued behind its batch-mates
+                    with trace.span("solve.window", parent=ctx,
+                                    coalesced=len(requests)):
+                        out.append(self.solver.solve_relaxed(*args, **kwargs))
                 except BaseException as e:   # fail just this caller
                     out.append(e)
         return out

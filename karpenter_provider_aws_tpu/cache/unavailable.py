@@ -92,12 +92,22 @@ class UnavailableOfferings:
     def mask(self, lattice) -> np.ndarray:
         """[T,Z,C] bool: True where the offering is NOT ICE'd. AND with
         ``lattice.available`` before building/solving a problem."""
-        m = np.ones((lattice.T, lattice.Z, lattice.C), dtype=bool)
-        t_idx = lattice.name_to_idx
-        z_idx = {z: i for i, z in enumerate(lattice.zones)}
-        c_idx = {c: i for i, c in enumerate(lattice.capacity_types)}
-        for ct, it, z in self.entries():
-            ti, zi, ci = t_idx.get(it), z_idx.get(z), c_idx.get(ct)
-            if ti is not None and zi is not None and ci is not None:
-                m[ti, zi, ci] = False
-        return m
+        return mask_from_entries(lattice, self.entries())
+
+
+def mask_from_entries(lattice, entries) -> np.ndarray:
+    """[T,Z,C] bool mask from (capacity_type, instance_type, zone)
+    triples: True where the offering is NOT named. Shared by the ICE
+    cache above and the solver sidecar, which receives the operator's
+    triples over the Solve RPC and rebuilds the SAME mask against its
+    resident lattice (parallel/sidecar.py) — one implementation, so the
+    two processes can never disagree on skip-unknown semantics."""
+    m = np.ones((lattice.T, lattice.Z, lattice.C), dtype=bool)
+    t_idx = lattice.name_to_idx
+    z_idx = {z: i for i, z in enumerate(lattice.zones)}
+    c_idx = {c: i for i, c in enumerate(lattice.capacity_types)}
+    for ct, it, z in entries:
+        ti, zi, ci = t_idx.get(it), z_idx.get(z), c_idx.get(ct)
+        if ti is not None and zi is not None and ci is not None:
+            m[ti, zi, ci] = False
+    return m

@@ -98,11 +98,35 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir", default=None,
                    help="Write a JAX profiler (xprof) trace of every device "
                         "solve under this directory.")
+    p.add_argument("--trace", action="store_true",
+                   help="Enable request-scoped tracing + the flight "
+                        "recorder (docs/reference/tracing.md): causal "
+                        "spans from REST admission to the device solve, "
+                        "tail-sampled retention of degraded/slow/errored "
+                        "traces, served at /debug/traces (REST apiserver "
+                        "and metrics server) and exported by kpctl trace.")
+    p.add_argument("--trace-ring", type=int, default=256,
+                   help="Completed traces kept in the flight recorder's "
+                        "ring before the oldest unretained one drops.")
+    p.add_argument("--trace-retained", type=int, default=64,
+                   help="Tail-retained traces (errored / degraded / over "
+                        "budget) pinned past ring wrap-around.")
+    p.add_argument("--trace-latency-budget-ms", type=float, default=1000.0,
+                   help="End-to-end trace duration above which the flight "
+                        "recorder tail-retains the trace as 'slow'.")
     p.add_argument("--sidecar-address", default=None,
                    help="Also serve the solver as a gRPC sidecar on this "
                         "address (e.g. unix:/run/karpenter/solver.sock or "
                         ":50051) so external controllers can Solve() "
                         "against the resident lattice.")
+    p.add_argument("--solver-address", default=None,
+                   help="Delegate provisioning solves to a solver sidecar "
+                        "process at this gRPC address (python -m "
+                        "karpenter_provider_aws_tpu.parallel.sidecar; env "
+                        "SOLVER_ADDRESS). The lattice stays resident next "
+                        "to the accelerator; this process ships pod "
+                        "deltas + the ICE mask and falls back to its "
+                        "local solver if the sidecar is unreachable.")
     p.add_argument("--duration", type=float, default=0.0,
                    help="Run for this many seconds then exit "
                         "(0 = run until SIGINT/SIGTERM).")
@@ -171,6 +195,8 @@ def options_from_args(args: argparse.Namespace) -> Options:
         overrides["interruption_queue"] = args.interruption_queue
     if args.termination_grace_period is not None:
         overrides["termination_grace_period"] = args.termination_grace_period
+    if args.solver_address is not None:
+        overrides["solver_address"] = args.solver_address
     for gate in (args.feature_gates or "").split(","):
         gate = gate.strip()
         if not gate:
@@ -269,7 +295,24 @@ def start_server(op: Operator, port: int,
             self.wfile.write(body)
 
         def do_GET(self):
-            if self.path == "/metrics":
+            if self.path.startswith("/debug/traces"):
+                # the flight recorder's read surface, also mounted here so
+                # deployments without --api-port still reach their traces
+                import json as _json
+                from urllib.parse import parse_qs as _pq
+                from urllib.parse import urlparse as _up
+                from . import trace as _trace
+                url = _up(self.path)
+                rec = _trace.recorder()
+                doc = (rec.debug_doc(url.path, _pq(url.query))
+                       if rec is not None else None)
+                if doc is None:
+                    self.send_error(404, "no such trace (or tracing "
+                                         "disabled; pass --trace)")
+                    return
+                body = _json.dumps(doc).encode()
+                ctype = "application/json"
+            elif self.path == "/metrics":
                 body = op.metrics.render().encode()
                 ctype = "text/plain; version=0.0.4"
             elif self.path in ("/healthz", "/readyz"):
@@ -307,6 +350,14 @@ def main(argv: Optional[Sequence[str]] = None,
     from .utils.logging import configure as configure_logging
     configure_logging(args.log_level)
     opts = options_from_args(args)
+    if args.trace:
+        # before ANY server/operator construction so the first admitted
+        # request is already traceable
+        from . import trace
+        from .trace import FlightRecorder
+        trace.enable(FlightRecorder(
+            ring=args.trace_ring, retained=args.trace_retained,
+            latency_budget_ms=args.trace_latency_budget_ms))
     api_token = None
     if args.api_token_file:
         api_token = open(args.api_token_file).read().strip()

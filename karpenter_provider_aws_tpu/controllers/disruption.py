@@ -35,6 +35,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import trace
 from ..apis import wellknown as wk
 from ..apis.objects import NodeClaim, NodeClaimPhase, NodePool, Pod
 from ..cache.unavailable import UnavailableOfferings
@@ -455,30 +456,43 @@ class DisruptionController:
         )
 
     def reconcile(self) -> None:
+        # the pass is spanned so a disruption decision (probes, the
+        # replacement re-solve, cordons) shows up in the flight recorder
+        # as one causal tree; a pass that DECIDED NOTHING marks its root
+        # `discard` and the recorder drops it — an idle reconcile every
+        # step must not churn the trace ring
+        with trace.span("disruption.reconcile") as sp:
+            acted = self._reconcile_once()
+            if not acted:
+                sp.set(discard=True)
+
+    def _reconcile_once(self) -> bool:
         self._advance_in_flight()
         self._whatif_used = 0
         # one new disruption decision per pass, in method order (the core
         # serializes voluntary disruption the same way)
         if self._reconcile_expiration():
             self._last_failed_fingerprint = None
-            return
+            return True
         if self.drift_enabled and self._reconcile_drift():
             self._last_failed_fingerprint = None
-            return
+            return True
         if self._reconcile_emptiness():
             self._last_failed_fingerprint = None
-            return
+            return True
         consolidatable = self._consolidatable()
         fp = self._fingerprint(consolidatable)
         if fp == self._last_failed_fingerprint:
-            return  # nothing changed since the search last came up empty
+            return False  # nothing changed since the search came up empty
         if self._reconcile_consolidation(consolidatable):
             self._last_failed_fingerprint = None
-        elif self._whatif_used < self.max_whatif_per_pass:
+            return True
+        if self._whatif_used < self.max_whatif_per_pass:
             self._last_failed_fingerprint = fp
         # a pass truncated by the what-if budget proved nothing about the
         # remaining candidates — never negative-cache it; the next pass
         # resumes the search with a fresh budget
+        return False
 
     def _advance_in_flight(self) -> None:
         """Drain originals whose replacements have all registered."""

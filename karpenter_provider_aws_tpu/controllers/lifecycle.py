@@ -14,6 +14,8 @@ from __future__ import annotations
 import itertools
 from typing import List, Optional
 
+from .. import trace
+from ..apis import wellknown as wk
 from ..apis.objects import Lease, Node, NodeClaim, NodeClaimPhase
 from ..cloudprovider.cloudprovider import CloudProvider
 from ..errors import NotFoundError
@@ -63,7 +65,21 @@ class LifecycleController:
                 self._initialize(claim)
 
     def _register(self, claim: NodeClaim) -> "Node":
-        """Simulated kubelet joins the node and binds nominated pods."""
+        """Simulated kubelet joins the node and binds nominated pods.
+        The registration span re-joins the provisioning pass's trace via
+        the claim's traceparent annotation — the LAST hop of the causal
+        chain (REST write → batch → solve → CreateFleet → registration),
+        crossing the launch delay the claim spent in the cloud."""
+        tp = claim.annotations.get(wk.ANNOTATION_TRACEPARENT)
+        if tp is None:
+            # no originating trace: registering under a fresh root would
+            # only churn the recorder ring with single-span noise
+            return self._register_traced(claim)
+        with trace.span("nodeclaim.register", parent=tp,
+                        nodeclaim=claim.name, nodepool=claim.node_pool):
+            return self._register_traced(claim)
+
+    def _register_traced(self, claim: NodeClaim) -> "Node":
         node = Node(
             name=claim.name, provider_id=claim.provider_id or "",
             internal_ip=claim.internal_ip,

@@ -19,6 +19,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import trace
 from ..apis import wellknown as wk
 from ..apis.objects import NodeClaim, NodeClaimPhase, NodePool, Pod
 from ..apis.requirements import Operator, Requirement
@@ -170,10 +171,38 @@ class Provisioner:
 
     # ---- one scheduling pass --------------------------------------------
 
+    @staticmethod
+    def _batch_trace_context(pending: Sequence[Pod]):
+        """(parent, links) for the pass span. Pods created through the
+        REST surface carry the admission span's traceparent as an
+        annotation (kube/httpserver.py); the pass — which coalesced many
+        pods behind the batch window — JOINS the first such trace and
+        LINKS the rest, so one REST write's trace reaches all the way to
+        the device solve while the other writes stay causally attached."""
+        ctxs = []
+        for p in pending:
+            tp = p.annotations.get(wk.ANNOTATION_TRACEPARENT)
+            if tp:
+                ctxs.append(tp)
+        return (ctxs[0] if ctxs else None), ctxs[1:]
+
     def provision_once(self) -> ProvisionResult:
         pending = self.cluster.pending_pods()
         if not pending:
             return ProvisionResult(plan=None)
+        parent, links = (self._batch_trace_context(pending)
+                         if trace.enabled() else (None, ()))
+        with trace.span("provisioner.provision", parent=parent, links=links,
+                        pods=len(pending)) as sp:
+            result = self._provision(pending)
+            sp.set(degraded=result.degraded,
+                   reason=result.degraded_reason,
+                   launched=result.launched,
+                   scheduled=result.pods_scheduled,
+                   unschedulable=result.pods_unschedulable)
+            return result
+
+    def _provision(self, pending: Sequence[Pod]) -> ProvisionResult:
         # versioned memo: the SAME view object comes back while prices and
         # the ICE set are unchanged, so the solver's identity-keyed
         # narrowing cache hits across steady-state passes
@@ -236,12 +265,19 @@ class Provisioner:
         # first, overflow lands on the generic pool). The loop terminates:
         # each retry excludes at least one more saturated pool.
         planned: List[PlannedNode] = []
+        # each planned node remembers the PLAN that produced it (the
+        # limit-fallback loop can mix plans in one pass), so its claim is
+        # stamped with the right solve's provenance annotations
+        prov_by_node: Dict[int, Dict[str, str]] = {}
         current = plan
         excluded: set = set()
         for _ in range(len(self.node_pools) + 1):
             fitting, dropped = self._enforce_limits(current.new_nodes,
                                                     usage=pass_usage)
             planned += fitting
+            prov = self._provenance_annotations(current)
+            for n in fitting:
+                prov_by_node[id(n)] = prov
             if not dropped:
                 break
             excluded |= {n.node_pool for n in dropped}
@@ -277,6 +313,7 @@ class Provisioner:
             bind_existing(current)
         for node in planned:
             claim = self._make_claim(node)
+            claim.annotations.update(prov_by_node.get(id(node), {}))
             self.writer.create_claim(claim)
             self._m_created.inc(nodepool=claim.node_pool)
             result.created_claims.append(claim)
@@ -320,6 +357,30 @@ class Provisioner:
 
     # ---- degradation observation (docs/concepts/degradation.md) ----------
 
+    def _provenance_annotations(self, plan: NodePlan) -> Dict[str, str]:
+        """Solver provenance for a claim's annotations — the wire-visible
+        record of WHY this claim's solve was slow or degraded, which
+        `kpctl describe nodeclaims` renders for operators. The pass
+        span's traceparent rides along so a claim points straight at its
+        flight-recorder trace (and NodeClaim registration joins it)."""
+        import json as _json
+        ann = {
+            wk.ANNOTATION_SOLVER_PATH: plan.solver_path,
+            wk.ANNOTATION_SOLVER_PIPELINED:
+                "true" if plan.pipelined else "false",
+            wk.ANNOTATION_SOLVER_WAVES: str(plan.waves),
+        }
+        if plan.degraded_reason:
+            ann[wk.ANNOTATION_SOLVER_DEGRADED_REASON] = plan.degraded_reason
+        if plan.stage_ms:
+            ann[wk.ANNOTATION_SOLVER_STAGE_MS] = _json.dumps(
+                {k: round(float(v), 3) for k, v in plan.stage_ms.items()},
+                sort_keys=True, separators=(",", ":"))
+        tp = trace.capture()
+        if tp:
+            ann[wk.ANNOTATION_TRACEPARENT] = tp
+        return ann
+
     def _observe_solver_health(self, plan: NodePlan,
                                result: ProvisionResult) -> None:
         """Mirror a plan's degradation provenance into the metric surface
@@ -330,9 +391,15 @@ class Provisioner:
         self._m_waves.observe(plan.waves)
         # per-stage timings (seconds, like every duration series): the
         # overlap evidence — on a pipelined solve "download" is only the
-        # residual wait after prefetch/decode-prep ran inside the window
+        # residual wait after prefetch/decode-prep ran inside the window.
+        # The ambient pass span's trace id rides as an EXEMPLAR, so a
+        # dashboard's slow histogram bucket links to a concrete retained
+        # trace (`kpctl trace export <id>`).
+        sp = trace.current()
+        exemplar = sp.trace_id if sp is not None else None
         for stage, ms in plan.stage_ms.items():
-            self._m_stage.observe(ms / 1000.0, stage=stage)
+            self._m_stage.observe(ms / 1000.0, exemplar=exemplar,
+                                  stage=stage)
         if plan.degraded:
             reason = plan.degraded_reason or "unknown"
             self._m_degraded.inc(path=plan.solver_path, reason=reason)

@@ -43,10 +43,13 @@ import hmac
 import json
 import ssl
 import threading
+from contextlib import nullcontext
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+from .. import trace
+from ..apis import wellknown as wk
 from .apiserver import (
     KINDS, AlreadyExistsError, APIError, ConflictError,
     EvictionBlockedError, FakeAPIServer, InvalidObjectError, NotFoundError,
@@ -167,10 +170,32 @@ def serve(server: FakeAPIServer, port: int = 0,
             self.send_header("Content-Length", str(len(body)))
             # every response carries the server clock, so clients can
             # anchor age rendering even off single-object GETs (the
-            # list-body serverTime field covers only list responses)
-            self.send_header("X-Server-Time", repr(server.now()))
+            # list-body serverTime field covers only list responses).
+            # Plain numeric, NOT repr(): under a numpy-scalar clock
+            # repr() renders 'np.float64(…)' on numpy>=2, which no
+            # plain float() parser accepts (kpctl tolerates both forms
+            # for servers that predate this fix).
+            self.send_header("X-Server-Time", f"{float(server.now()):.6f}")
+            sp = trace.current()
+            if sp is not None:
+                # context injection: the response names the server span so
+                # a client can stitch its own spans to the handled request
+                self.send_header("traceparent", sp.traceparent())
             self.end_headers()
             self.wfile.write(body)
+
+        def _req_span(self, verb: str, path: str):
+            """A server span for this request. Only a request that CARRIES
+            context (traceparent header) or can START a causal chain (a
+            write verb) gets one — read-only polling without context would
+            churn the flight-recorder ring with single-span noise."""
+            if not trace.enabled():
+                return nullcontext()
+            tp = self.headers.get("traceparent")
+            if tp is None and verb == "GET":
+                return nullcontext()
+            return trace.span(f"http {verb} {path}", parent=tp,
+                              http_method=verb)
 
         def _error(self, e: Exception) -> None:
             code = (404 if isinstance(e, NotFoundError) else
@@ -203,22 +228,42 @@ def serve(server: FakeAPIServer, port: int = 0,
                 if url.path.rstrip("/") == "/apis":
                     self._json(200, {"kinds": list(KINDS)})
                     return
+                # the flight recorder's read surface (kpctl trace):
+                # list / get / Chrome-export retained + ring traces
+                if url.path.startswith("/debug/traces"):
+                    rec = trace.recorder()
+                    doc = (rec.debug_doc(url.path, parse_qs(url.query))
+                           if rec is not None else None)
+                    if doc is None:
+                        raise NotFoundError(
+                            f"no trace at {url.path}" if rec is not None
+                            else "tracing is not enabled (--trace)")
+                    self._json(200, doc)
+                    return
                 kind, name, sub = _route(url.path)
                 if sub is not None:
                     raise NotFoundError(f"no route {url.path}")
                 q = parse_qs(url.query)
-                if name is not None:
-                    self._json(200, server.get(kind, name))
-                    return
-                if q.get("watch", ["0"])[0] in ("1", "true"):
+                # the name check stays FIRST: a named GET with a stray
+                # watch=1 param returns the object (the pre-tracing
+                # contract), never silently discards the name into a
+                # kind-wide stream
+                if name is None and q.get("watch", ["0"])[0] in ("1",
+                                                                 "true"):
+                    # never span a watch: the stream outlives any request
+                    # scope and would pin its trace open
                     self._watch(kind, int(q.get("resourceVersion", ["0"])[0]))
                     return
-                items, rv = server.list(kind)
-                # serverTime lets clients (kpctl) anchor AGE/LAST SEEN
-                # columns to the clock that stamped the timestamps,
-                # instead of their own wall clock
-                self._json(200, {"items": items, "resourceVersion": rv,
-                                 "serverTime": server.now()})
+                with self._req_span("GET", url.path):
+                    if name is not None:
+                        self._json(200, server.get(kind, name))
+                        return
+                    items, rv = server.list(kind)
+                    # serverTime lets clients (kpctl) anchor AGE/LAST
+                    # SEEN columns to the clock that stamped the
+                    # timestamps, instead of their own wall clock
+                    self._json(200, {"items": items, "resourceVersion": rv,
+                                     "serverTime": float(server.now())})
             except Exception as e:
                 self._error(e)
 
@@ -252,74 +297,93 @@ def serve(server: FakeAPIServer, port: int = 0,
             finally:
                 server.stop_watch(w)
 
+        # every write verb nests try OUTSIDE the span (like do_GET): the
+        # span must SEE a handler exception on exit — status=error is
+        # what the flight recorder's tail sampler keys retention on —
+        # and only then does the outer except send the error response
+
         def do_POST(self):
             try:
-                url = urlparse(self.path)
-                if url.path == "/queue/messages":
-                    if queue is None:
-                        raise NotFoundError("no interruption queue served")
-                    mid = queue.send(self._body())
-                    self._json(201, {"messageId": mid})
-                    return
-                kind, name, sub = _route(url.path)
-                q = parse_qs(url.query)
-                if kind == "pods" and name is not None and sub == "binding":
-                    body = self._body()
-                    self._json(200, server.bind(name, body["nodeName"]))
-                    return
-                if kind == "pods" and name is not None and sub == "eviction":
-                    force = q.get("force", ["0"])[0] in ("1", "true")
-                    self._json(200, server.evict(name, force=force))
-                    return
-                if name is not None:
-                    raise NotFoundError(f"no route {url.path}")
-                self._json(201, server.create(kind, self._body()))
+                with self._req_span("POST", urlparse(self.path).path):
+                    url = urlparse(self.path)
+                    if url.path == "/queue/messages":
+                        if queue is None:
+                            raise NotFoundError("no interruption queue served")
+                        mid = queue.send(self._body())
+                        self._json(201, {"messageId": mid})
+                        return
+                    kind, name, sub = _route(url.path)
+                    q = parse_qs(url.query)
+                    if kind == "pods" and name is not None and sub == "binding":
+                        body = self._body()
+                        self._json(200, server.bind(name, body["nodeName"]))
+                        return
+                    if kind == "pods" and name is not None and sub == "eviction":
+                        force = q.get("force", ["0"])[0] in ("1", "true")
+                        self._json(200, server.evict(name, force=force))
+                        return
+                    if name is not None:
+                        raise NotFoundError(f"no route {url.path}")
+                    spec = self._body()
+                    sp = trace.current()
+                    if kind == "pods" and sp is not None:
+                        # stamp the admission span onto the pod: the
+                        # informer delivers it to the mirror, and the
+                        # provisioning pass that drains this pod JOINS
+                        # this trace (REST → operator causal chain)
+                        spec.setdefault("annotations", {}).setdefault(
+                            wk.ANNOTATION_TRACEPARENT, sp.traceparent())
+                    self._json(201, server.create(kind, spec))
             except Exception as e:
                 self._error(e)
 
         def do_PUT(self):
             try:
-                kind, name, sub = _route(urlparse(self.path).path)
-                if sub is not None:
-                    raise NotFoundError(f"no route {self.path}")
-                if name is None:
-                    raise NotFoundError("PUT needs a name")
-                obj = self._body()
-                if obj.get("metadata", {}).get("name") != name:
-                    raise ValueError("metadata.name must match the URL")
-                self._json(200, server.update(kind, obj))
+                with self._req_span("PUT", urlparse(self.path).path):
+                    kind, name, sub = _route(urlparse(self.path).path)
+                    if sub is not None:
+                        raise NotFoundError(f"no route {self.path}")
+                    if name is None:
+                        raise NotFoundError("PUT needs a name")
+                    obj = self._body()
+                    if obj.get("metadata", {}).get("name") != name:
+                        raise ValueError("metadata.name must match the URL")
+                    self._json(200, server.update(kind, obj))
             except Exception as e:
                 self._error(e)
 
         def do_PATCH(self):
             try:
-                kind, name, sub = _route(urlparse(self.path).path)
-                if sub is not None:
-                    raise NotFoundError(f"no route {self.path}")
-                if name is None:
-                    raise NotFoundError("PATCH needs a name")
-                body = self._body()
-                self._json(200, server.patch(
-                    kind, name, body.get("spec"),
-                    status_patch=body.get("status"),
-                    finalizers=body.get("finalizers")))
+                with self._req_span("PATCH", urlparse(self.path).path):
+                    kind, name, sub = _route(urlparse(self.path).path)
+                    if sub is not None:
+                        raise NotFoundError(f"no route {self.path}")
+                    if name is None:
+                        raise NotFoundError("PATCH needs a name")
+                    body = self._body()
+                    self._json(200, server.patch(
+                        kind, name, body.get("spec"),
+                        status_patch=body.get("status"),
+                        finalizers=body.get("finalizers")))
             except Exception as e:
                 self._error(e)
 
         def do_DELETE(self):
             try:
-                url = urlparse(self.path)
-                kind, name, sub = _route(url.path)
-                if sub is not None:
-                    # e.g. DELETE /apis/pods/p0/eviction — the wrong verb
-                    # must NEVER fall through to deleting the parent
-                    raise NotFoundError(f"no route {url.path}")
-                if name is None:
-                    raise NotFoundError("DELETE needs a name")
-                q = parse_qs(url.query)
-                force = q.get("force", ["0"])[0] in ("1", "true")
-                server.delete(kind, name, force=force)
-                self._json(200, {"status": "ok"})
+                with self._req_span("DELETE", urlparse(self.path).path):
+                    url = urlparse(self.path)
+                    kind, name, sub = _route(url.path)
+                    if sub is not None:
+                        # e.g. DELETE /apis/pods/p0/eviction — the wrong
+                        # verb must NEVER fall through to deleting the
+                        # parent
+                        raise NotFoundError(f"no route {url.path}")
+                    if name is None:
+                        raise NotFoundError("DELETE needs a name")
+                    q = parse_qs(url.query)
+                    force = q.get("force", ["0"])[0] in ("1", "true")
+                    server.delete(kind, name, force=force)
+                    self._json(200, {"status": "ok"})
             except Exception as e:
                 self._error(e)
 

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
+from .. import trace
 from ..apis.objects import Lease, Node, NodeClaim, NodeClaimPhase, Pod
 from ..state.cluster import ClusterState
 from ..utils.clock import Clock
@@ -109,7 +110,13 @@ class ApiWriter:
     # ---- claims ------------------------------------------------------------
 
     def create_claim(self, claim: NodeClaim) -> None:
-        self.kube.create_nodeclaim(claim)
+        # the write seam's spans name the k8s-object mutations inside the
+        # ambient trace (a provisioning pass shows claim-create / pod-bind
+        # legs between solve and CreateFleet); contextvars carry the trace
+        # across this in-process hop — the httpserver carries it when the
+        # same seam is driven over the wire
+        with trace.span("kube.create_nodeclaim", claim=claim.name):
+            self.kube.create_nodeclaim(claim)
 
     def update_claim_status(self, claim: NodeClaim) -> None:
         try:
@@ -190,7 +197,8 @@ class ApiWriter:
         count the pod as scheduled (karpenter_pods_scheduled_total would
         overcount)."""
         try:
-            self.kube.bind_pod(pod_name, node_name)
+            with trace.span("kube.bind_pod", pod=pod_name, node=node_name):
+                self.kube.bind_pod(pod_name, node_name)
             return True
         except (ConflictError, NotFoundError):
             return False

@@ -108,8 +108,15 @@ class Histogram(_Metric):
         self._counts: Dict[Tuple[str, ...], List[int]] = {}
         self._sums: Dict[Tuple[str, ...], float] = {}
         self._totals: Dict[Tuple[str, ...], int] = {}
+        # last exemplar per series: (trace_id, observed value). The
+        # OpenMetrics bridge between a histogram's aggregate shape and
+        # ONE concrete retained trace in the flight recorder
+        # (docs/reference/tracing.md) — a dashboard's slow bucket links
+        # to `kpctl trace export <trace_id>`.
+        self._exemplars: Dict[Tuple[str, ...], Tuple[str, float]] = {}
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None,
+                **labels) -> None:
         k = self._key(labels)
         with self._lock:
             counts = self._counts.setdefault(k, [0] * len(self.buckets))
@@ -118,6 +125,13 @@ class Histogram(_Metric):
                 counts[j] += 1
             self._sums[k] = self._sums.get(k, 0.0) + value
             self._totals[k] = self._totals.get(k, 0) + 1
+            if exemplar is not None:
+                self._exemplars[k] = (str(exemplar), float(value))
+
+    def exemplar(self, **labels) -> Optional[Tuple[str, float]]:
+        """The series' last (trace_id, value) exemplar, if any."""
+        with self._lock:
+            return self._exemplars.get(self._key(labels))
 
     def count(self, **labels) -> int:
         with self._lock:
@@ -150,6 +164,16 @@ class Histogram(_Metric):
                     out.append(f"{self.name}_bucket{lbl} {self._counts[k][j]}")
                 lbl = _fmt(self.labelnames + ("le",), k + ("+Inf",))
                 out.append(f"{self.name}_bucket{lbl} {self._totals[k]}")
+                # exemplar as a COMMENT line: this surface serves the
+                # classic text format (text/plain; version=0.0.4), where
+                # an OpenMetrics `# {...}` suffix on the sample line
+                # would fail the whole scrape — comment lines are
+                # ignored by every classic parser, and series without
+                # an exemplar render byte-identically to before
+                ex = self._exemplars.get(k)
+                if ex is not None:
+                    out.append(f'# exemplar {self.name}_bucket{lbl} '
+                               f'{{trace_id="{ex[0]}"}} {ex[1]}')
                 out.append(f"{self.name}_sum{_fmt(self.labelnames, k)} {self._sums[k]}")
                 out.append(f"{self.name}_count{_fmt(self.labelnames, k)} {self._totals[k]}")
         return out

@@ -202,7 +202,15 @@ class Operator:
             subnets=self.subnet_provider,
             launch_templates=self.launch_template_provider,
             version=self.version_provider), self.metrics)
-        self.solver = Solver(self.lattice)
+        if self.options.solver_address:
+            # delegate provisioning solves to the accelerator-resident
+            # sidecar process; probe_batch and the degradation ladder's
+            # local fallback stay on this (fully functional) local Solver
+            from ..parallel.sidecar import RemoteSolver
+            self.solver = RemoteSolver(self.lattice,
+                                       self.options.solver_address)
+        else:
+            self.solver = Solver(self.lattice)
         self.provisioner = Provisioner(
             self.cluster, self.solver, self.node_pools, self.cloud_provider,
             self.unavailable, self.recorder, self.clock,

@@ -68,6 +68,11 @@ class Options:
     termination_grace_period: Optional[float] = None
     # sim-only knob: seconds between launch and (fake) kubelet registration
     registration_delay: float = 5.0
+    # gRPC address of a solver SIDECAR process (parallel/sidecar.py main).
+    # Set, the operator's provisioning solves ship over the Solve RPC to
+    # the accelerator-resident sidecar (parallel/sidecar.py RemoteSolver)
+    # instead of running in-process; empty = resident in-process solver
+    solver_address: str = ""
 
     def validate(self) -> None:
         if not self.cluster_name:
@@ -98,6 +103,7 @@ class Options:
             drift_enabled=_env_bool("FEATURE_GATE_DRIFT", True),
             spot_to_spot_consolidation=_env_bool("FEATURE_GATE_SPOT_TO_SPOT", False),
             termination_grace_period=_env("TERMINATION_GRACE_PERIOD", None, float),
+            solver_address=_env("SOLVER_ADDRESS", "", str),
         )
         for k, v in overrides.items():
             setattr(opts, k, v)

@@ -1,11 +1,11 @@
 from .mesh import solver_mesh
 from .sharded import ShardedPack, sharded_pack, split_counts
 
-__all__ = ["ShardedPack", "SolverClient", "SolverService", "serve_sidecar",
-           "solver_mesh", "sharded_pack", "split_counts"]
+__all__ = ["RemoteSolver", "ShardedPack", "SolverClient", "SolverService",
+           "serve_sidecar", "solver_mesh", "sharded_pack", "split_counts"]
 
-_SIDECAR = {"SolverClient": "SolverClient", "SolverService": "SolverService",
-            "serve_sidecar": "serve"}
+_SIDECAR = {"RemoteSolver": "RemoteSolver", "SolverClient": "SolverClient",
+            "SolverService": "SolverService", "serve_sidecar": "serve"}
 
 
 def __getattr__(name):

@@ -27,6 +27,7 @@ from typing import Dict, List, Optional, Sequence
 import grpc
 import numpy as np
 
+from .. import trace
 from ..apis import serde
 from ..solver.solve import NodePlan, Solver
 
@@ -48,11 +49,35 @@ class SolverService:
         # same instance interleave safely
         self.solver = solver
         self.window = window
+        self._mask_memo = None  # (key, view) — see _masked_lattice
 
     def solve(self, payload: bytes) -> bytes:
+        req = json.loads(payload.decode())
+        # trace context crosses the process boundary as a field in the
+        # JSON body (the wire stays plain cross-language JSON — no gRPC
+        # metadata dependency); the handler's span is the remote child of
+        # the caller's span, marked svc=sidecar so a merged Perfetto
+        # export shows which process ran what
+        tc = req.get("traceContext")
+        sp = trace.span("sidecar.solve", parent=tc, svc="sidecar",
+                        pods=len(req.get("pods", ())))
+        with sp:
+            doc = self._solve(req)
+        if tc and isinstance(sp, trace.Span):
+            # ship this process's completed spans back in the response:
+            # the CALLER's flight recorder then holds one connected tree
+            # across the process boundary (SolverClient ingests them,
+            # deduped by span id) — the sidecar is a leaf service with no
+            # query surface of its own in the operator's deployment
+            rec = trace.recorder()
+            spans = rec.get(sp.trace_id) if rec is not None else None
+            if spans:
+                doc["traceSpans"] = [s.to_dict() for s in spans]
+        return json.dumps(doc).encode()
+
+    def _solve(self, req: dict) -> dict:
         from ..solver.topology import BoundPod
 
-        req = json.loads(payload.decode())
         pods = [serde.pod_from_dict(p) for p in req.get("pods", ())]
         pools = [serde.nodepool_from_dict(p)
                  for p in req.get("nodePools", ())]
@@ -73,12 +98,36 @@ class SolverService:
         headroom = {k: np.asarray([np.inf if x is None else x for x in v],
                                   np.float32)
                     for k, v in (req.get("poolHeadroom") or {}).items()} or None
+        view = self._masked_lattice(req.get("unavailable"))
         entry = self.window if self.window is not None else self.solver
         plan = entry.solve_relaxed(
-            pods, pools, existing=existing, daemonset_pods=ds,
+            pods, pools, lattice=view, existing=existing, daemonset_pods=ds,
             bound_pods=bound, pvcs=pvcs, storage_classes=scs,
             pool_headroom=headroom)
-        return json.dumps(serde.plan_to_dict(plan)).encode()
+        return serde.plan_to_dict(plan)
+
+    def _masked_lattice(self, unavailable):
+        """Apply the caller's ICE'd offerings to the RESIDENT lattice.
+
+        A remote caller (RemoteSolver) cannot ship its masked lattice view
+        — the whole point of the sidecar is that the lattice never crosses
+        the wire — so it ships the unavailable (capacityType, instanceType,
+        zone) triples instead and the mask is rebuilt here. None/empty =
+        the unmasked resident lattice (and solve_relaxed's ``lattice=None``
+        default path)."""
+        if not unavailable:
+            return None
+        from ..cache.unavailable import mask_from_entries
+        from ..lattice.tensors import masked_view
+        lat = self.solver.lattice
+        key = (lat.price_version, tuple(sorted(map(tuple, unavailable))))
+        if self._mask_memo is not None and self._mask_memo[0] == key:
+            return self._mask_memo[1]
+        view = masked_view(lat, mask_from_entries(lat, unavailable))
+        # memoize ONE view: a steady operator re-sends the same ICE set
+        # every pass, and view identity keys the solver's narrowing cache
+        self._mask_memo = (key, view)
+        return view
 
     def health(self, payload: bytes) -> bytes:
         lat = self.solver.lattice
@@ -133,6 +182,7 @@ class SolverClient:
 
     def __init__(self, address: str = "unix:/tmp/karpenter-solver.sock",
                  timeout: float = 60.0):
+        self.address = address
         self._channel = grpc.insecure_channel(address)
         self._solve = self._channel.unary_unary(_SOLVE)
         self._health = self._channel.unary_unary(_HEALTH)
@@ -142,7 +192,8 @@ class SolverClient:
               existing: Sequence = (), daemonset_pods: Sequence = (),
               bound_pods: Sequence = (), pvcs: Optional[Dict] = None,
               storage_classes: Optional[Dict] = None,
-              pool_headroom: Optional[Dict] = None) -> NodePlan:
+              pool_headroom: Optional[Dict] = None,
+              unavailable: Sequence = ()) -> NodePlan:
         req = {
             "pods": [serde.pod_to_dict(p) for p in pods],
             "nodePools": [serde.nodepool_to_dict(p) for p in node_pools],
@@ -162,11 +213,155 @@ class SolverClient:
                               for k, v in pool_headroom.items()}
                              if pool_headroom else None),
         }
+        if unavailable:
+            # the caller's ICE'd offerings, as (capacityType,
+            # instanceType, zone) triples — the sidecar rebuilds the mask
+            # over ITS resident lattice (SolverService._masked_lattice)
+            req["unavailable"] = [list(o) for o in unavailable]
+        tc = trace.capture()
+        if tc:
+            # propagate the caller's span as the RPC's remote parent so
+            # the sidecar's device solve joins this trace across the
+            # process boundary (docs/reference/tracing.md wire format)
+            req["traceContext"] = tc
         resp = self._solve(json.dumps(req).encode(), timeout=self.timeout)
-        return serde.plan_from_dict(json.loads(resp.decode()))
+        doc = json.loads(resp.decode())
+        remote_spans = doc.pop("traceSpans", None)
+        if remote_spans and tc:
+            # the sidecar shipped its completed spans back: land them in
+            # THIS process's flight recorder so /debug/traces serves one
+            # connected tree across the process boundary
+            rec = trace.recorder()
+            if rec is not None:
+                rec.ingest(remote_spans)
+        return serde.plan_from_dict(doc)
 
     def health(self) -> Dict:
         return json.loads(self._health(b"{}", timeout=self.timeout).decode())
 
     def close(self) -> None:
         self._channel.close()
+
+
+class RemoteSolver(Solver):
+    """A Solver whose provisioning solves run in the solver SIDECAR
+    process (``--solver-address``): the operator ships pod deltas + the
+    ICE mask over the Solve RPC and the lattice stays resident next to
+    the accelerator. Everything else — probe_batch (the disruption
+    controller's vmapped what-ifs), lattice queries, warmup — stays on
+    the LOCAL Solver this subclasses, so a sidecar outage degrades to the
+    in-process ladder instead of stalling the control plane."""
+
+    def __init__(self, lattice, address: str, timeout: float = 60.0,
+                 pipeline: bool = True):
+        super().__init__(lattice, pipeline=pipeline)
+        self.client = SolverClient(address, timeout=timeout)
+
+    def _unavailable_entries(self, view) -> List:
+        """Recover the ICE'd offerings from a masked lattice view by
+        diffing availability against the base lattice — the provisioner
+        hands solve_relaxed a VIEW (lattice/tensors.py masked_view), and
+        the triples are what crosses the wire."""
+        base = self.lattice
+        if view is None or view is base:
+            return []
+        diff = base.available & ~view.available
+        if not diff.any():
+            return []
+        return [(base.capacity_types[ci], base.names[ti], base.zones[zi])
+                for ti, zi, ci in np.argwhere(diff)]
+
+    def solve_relaxed(self, pods, node_pools, lattice=None, existing=(),
+                      daemonset_pods=(), bound_pods=(), pvcs=None,
+                      storage_classes=None, mesh=None,
+                      pool_headroom=None) -> NodePlan:
+        with trace.span("solver.remote", pods=len(pods),
+                        address=self.client.address) as sp:
+            try:
+                plan = self.client.solve(
+                    pods, node_pools, existing=existing,
+                    daemonset_pods=daemonset_pods, bound_pods=bound_pods,
+                    pvcs=pvcs, storage_classes=storage_classes,
+                    pool_headroom=pool_headroom,
+                    unavailable=self._unavailable_entries(lattice))
+                sp.set(path=plan.solver_path, degraded=plan.degraded,
+                       reason=plan.degraded_reason)
+                return plan
+            except grpc.RpcError as e:
+                # the sidecar is down/unreachable: the local solver this
+                # subclasses is fully functional — degrade to it (one more
+                # rung under the device ladder) rather than failing the
+                # pass; provenance marks the plan so the flight recorder
+                # tail-retains the trace and operators see WHY
+                sp.set(degraded=True, reason="sidecar-unreachable",
+                       error=f"{type(e).__name__}: {e.code() if hasattr(e, 'code') else e}")
+        plan = super().solve_relaxed(
+            pods, node_pools, lattice=lattice, existing=existing,
+            daemonset_pods=daemonset_pods, bound_pods=bound_pods,
+            pvcs=pvcs, storage_classes=storage_classes, mesh=mesh,
+            pool_headroom=pool_headroom)
+        plan.degraded = True
+        plan.degraded_reason = plan.degraded_reason or "sidecar-unreachable"
+        return plan
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """Standalone solver sidecar: ``python -m
+    karpenter_provider_aws_tpu.parallel.sidecar --address ADDR``.
+
+    The deployment shape the paper's architecture implies — the device
+    solver as its own accelerator-resident process, the operator's
+    control loop elsewhere pointing at it via ``--solver-address``."""
+    import argparse
+    import signal
+    import threading
+
+    p = argparse.ArgumentParser(
+        prog="karpenter-solver-sidecar", description=main.__doc__)
+    p.add_argument("--address", default="unix:/tmp/karpenter-solver.sock",
+                   help="gRPC bind address (unix:/path or host:port)")
+    p.add_argument("--catalog", default=None,
+                   help="path to a real-data catalog JSON "
+                        "(lattice/realdata.py schema); default = the "
+                        "bundled reference catalog")
+    p.add_argument("--synthetic-catalog", action="store_true",
+                   help="use the generated synthetic catalog instead of "
+                        "the bundled reference data")
+    p.add_argument("--no-admission-window", action="store_true",
+                   help="serve without the solve-coalescing window")
+    p.add_argument("--trace", action="store_true",
+                   help="enable tracing: the Solve handler's span tree "
+                        "ships back to callers in the RPC response")
+    args = p.parse_args(argv)
+
+    if args.trace:
+        from .. import trace as _trace
+        _trace.enable()
+        # every span this process opens is the sidecar's (a merged
+        # Perfetto export renders it as its own process row)
+        _trace.get_tracer().service = "sidecar"
+    from ..lattice import build_lattice
+    if args.synthetic_catalog:
+        lattice = build_lattice()
+    else:
+        from ..lattice.realdata import load_catalog
+        lattice = build_lattice(load_catalog(args.catalog,
+                                             require_price=True))
+    solver = Solver(lattice)
+    server = serve(solver, args.address,
+                   admission_window=not args.no_admission_window)
+    print(f"solver sidecar serving on {args.address} "
+          f"(T={lattice.T} Z={lattice.Z} C={lattice.C})", flush=True)
+    stop = threading.Event()
+    try:
+        signal.signal(signal.SIGINT, lambda *_: stop.set())
+        signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    except ValueError:
+        pass
+    stop.wait()
+    server.stop(grace=None)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -38,6 +38,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import trace
+
 # the five stages of a device solve, in pipeline order; NodePlan.stage_ms
 # and the stage-duration metric use exactly these names
 STAGES = ("build", "upload", "compute", "download", "decode")
@@ -68,18 +70,24 @@ class StageTimer:
 
 
 class _Span:
-    __slots__ = ("_timer", "_stage", "_t0")
+    __slots__ = ("_timer", "_stage", "_t0", "_ts")
 
     def __init__(self, timer: StageTimer, stage: str):
         self._timer = timer
         self._stage = stage
 
     def __enter__(self):
+        # when tracing is on, every stage interval doubles as a REAL
+        # trace span nested under the ambient solve span — the stage_ms
+        # aggregate becomes a causal span tree (docs/reference/tracing.md);
+        # disabled, this is the shared no-op singleton (no allocation)
+        self._ts = trace.span("stage." + self._stage).__enter__()
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
         self._timer.add(self._stage, time.perf_counter() - self._t0)
+        self._ts.__exit__(*exc)
         return False
 
 

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import trace
 from ..apis.resources import R
 from ..errors import (SolverCapacityError, SolverDeviceError, SolverError,
                       is_retryable_solver_error)
@@ -716,6 +717,27 @@ class Solver:
                       daemonset_pods=(), bound_pods=(), pvcs=None,
                       storage_classes=None, mesh=None,
                       pool_headroom=None) -> NodePlan:
+        """Tracing shim over :meth:`_solve_relaxed`: the whole relaxation
+        loop (every round's solve, wave, and stage spans nest underneath)
+        is one span carrying the plan's degradation provenance — which is
+        what the flight recorder's tail sampler keys retention on."""
+        with trace.span("solver.solve_relaxed", pods=len(pods)) as sp:
+            plan = self._solve_relaxed(
+                pods, node_pools, lattice=lattice, existing=existing,
+                daemonset_pods=daemonset_pods, bound_pods=bound_pods,
+                pvcs=pvcs, storage_classes=storage_classes, mesh=mesh,
+                pool_headroom=pool_headroom)
+            sp.set(path=plan.solver_path, degraded=plan.degraded,
+                   reason=plan.degraded_reason, waves=plan.waves,
+                   pipelined=plan.pipelined,
+                   new_nodes=len(plan.new_nodes),
+                   unschedulable=len(plan.unschedulable))
+            return plan
+
+    def _solve_relaxed(self, pods, node_pools, lattice=None, existing=(),
+                       daemonset_pods=(), bound_pods=(), pvcs=None,
+                       storage_classes=None, mesh=None,
+                       pool_headroom=None) -> NodePlan:
         """Solve with preferred-rule relaxation (reference
         scheduling.md:203-206, 322-334).
 
@@ -798,6 +820,15 @@ class Solver:
 
     @_locked
     def solve(self, problem: Problem, mesh=None) -> NodePlan:
+        """Tracing shim over :meth:`_solve_problem` — one span per solve
+        round with the ladder's outcome attached."""
+        with trace.span("solver.solve", groups=problem.G) as sp:
+            plan = self._solve_problem(problem, mesh=mesh)
+            sp.set(path=plan.solver_path, degraded=plan.degraded,
+                   reason=plan.degraded_reason, retries=plan.device_retries)
+            return plan
+
+    def _solve_problem(self, problem: Problem, mesh=None) -> NodePlan:
         """Solve a problem into a NodePlan, degrading gracefully.
 
         ``mesh`` (a 1-D ``jax.sharding.Mesh`` over a 'pods' axis) shards the
@@ -862,7 +893,8 @@ class Solver:
                 detail = f"{type(e).__name__}: {e}"
                 break
         self._count_degraded("host_ffd")
-        plan = self.solve_host_ffd(problem)
+        with trace.span("solver.host_ffd", reason=reason, degraded=True):
+            plan = self.solve_host_ffd(problem)
         plan.solve_seconds = time.perf_counter() - t0
         plan.degraded = True
         plan.degraded_reason = reason
@@ -1155,8 +1187,10 @@ class Solver:
                     # fetch: wave j's upload rides wave i's compute
                     holder["gbuf"] = wave_gbuf(j)
                     self.pipeline_stats["prefetched_waves"] += 1
-            plan_w = self._solve_device(sub, mesh, gbuf=gbuf_i,
-                                        overlap=overlap)
+            with trace.span("solver.wave", wave=i, groups=hi - lo,
+                            prefetched=gbuf_i is not None and i > 0):
+                plan_w = self._solve_device(sub, mesh, gbuf=gbuf_i,
+                                            overlap=overlap)
             next_gbuf = holder.get("gbuf")
             if pipelined and next_gbuf is None and i + 1 < n_waves:
                 # the prefetch hook did not run (e.g. the wave retried

@@ -209,6 +209,166 @@ def test_provision_interrupt_converge_over_the_wire(control_plane,
     assert "Launched" in desc
 
 
+@pytest.fixture(scope="module")
+def traced_control_plane(tmp_path_factory):
+    """TWO spawned processes — a standalone solver sidecar and an operator
+    whose provisioning solves ship to it (--solver-address) — both with
+    tracing on. The deployment shape the tracing acceptance names: one
+    connected span tree crossing the REST boundary (client → apiserver)
+    AND the gRPC boundary (operator → sidecar device solve)."""
+    tmp = tmp_path_factory.mktemp("traced")
+    sock = f"unix:{tmp}/solver.sock"
+    port = _free_port()
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        CLUSTER_NAME="traced-e2e",
+    )
+    side_log = open(tmp / "sidecar.log", "w")
+    side = subprocess.Popen(
+        [sys.executable, "-m",
+         "karpenter_provider_aws_tpu.parallel.sidecar",
+         "--address", sock, "--synthetic-catalog", "--trace"],
+        cwd=str(REPO), env=env, stdout=side_log,
+        stderr=subprocess.STDOUT, text=True)
+    op_log = open(tmp / "operator.log", "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "karpenter_provider_aws_tpu",
+         "--api-port", str(port), "--metrics-port", "0",
+         "--step", "0.2", "--trace", "--solver-address", sock,
+         "--log-level", "WARNING"],
+        cwd=str(REPO), env=env, stdout=op_log,
+        stderr=subprocess.STDOUT, text=True)
+    base = f"http://127.0.0.1:{port}"
+    client = kpctl.Client(base)
+    deadline = time.monotonic() + STARTUP_TIMEOUT
+    while time.monotonic() < deadline:
+        if side.poll() is not None or proc.poll() is not None:
+            side.kill(), proc.kill()
+            raise RuntimeError(
+                f"spawn failed: sidecar rc={side.poll()} "
+                f"operator rc={proc.poll()}\n"
+                + open(tmp / "sidecar.log").read()[-2000:]
+                + open(tmp / "operator.log").read()[-2000:])
+        try:
+            client.request("GET", "/apis/nodepools")
+            break
+        except (urllib.error.URLError, ConnectionError, OSError):
+            time.sleep(0.5)
+    else:
+        side.kill(), proc.kill()
+        raise RuntimeError("traced REST surface never came up")
+    yield client, base
+    for p in (proc, side):
+        p.terminate()
+    for p in (proc, side):
+        try:
+            p.wait(15)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+@pytest.mark.slow
+def test_one_connected_trace_across_both_process_boundaries(
+        traced_control_plane, tmp_path):
+    """REST admission → informer → batch → REMOTE device solve (gRPC
+    sidecar process) → CreateFleet → NodeClaim registration, all under
+    ONE trace id, exported as valid Chrome trace-event JSON by kpctl."""
+    client, base = traced_control_plane
+    trace_id = os.urandom(16).hex()
+    traceparent = f"00-{trace_id}-{os.urandom(8).hex()}-01"
+
+    client.request("POST", "/apis/nodepools",
+                   {"name": "traced-pool", "weight": 50})
+    for i in range(4):
+        r = urllib.request.Request(
+            f"{base}/apis/pods", method="POST",
+            data=json.dumps({"name": f"tr-{i}",
+                             "requests": {"cpu": "1",
+                                          "memory": "2Gi"}}).encode(),
+            headers={"Content-Type": "application/json",
+                     "traceparent": traceparent})
+        urllib.request.urlopen(r)
+
+    def all_bound():
+        pods = [p for p in client.request("GET", "/apis/pods")["items"]
+                if p["metadata"]["name"].startswith("tr-")]
+        return pods if pods and all(p["spec"].get("nodeName")
+                                    for p in pods) else None
+
+    poll(all_bound, desc="traced pods bound")
+
+    # ---- the span tree: one trace, two services, fully connected ------
+    def full_tree():
+        try:
+            doc = client.request("GET", f"/debug/traces/{trace_id}")
+        except urllib.error.HTTPError:
+            return None
+        spans = doc["spans"]
+        svcs = {s["svc"] for s in spans}
+        names = {s["name"] for s in spans}
+        # registration happens a few steps after binding; poll until the
+        # whole causal chain is in the tree
+        if "sidecar" not in svcs or "nodeclaim.register" not in names:
+            return None
+        return spans
+
+    spans = poll(full_tree, desc="operator+sidecar spans in one trace")
+    assert all(s["traceId"] == trace_id for s in spans)
+    names = {s["name"] for s in spans}
+    # the causal chain, stratum by stratum
+    for expected in ("http POST /apis/pods", "provisioner.provision",
+                     "solver.remote", "sidecar.solve",
+                     "solver.solve_relaxed", "stage.compute",
+                     "kube.create_nodeclaim", "nodeclaim.register"):
+        assert expected in names, f"missing span {expected}: {sorted(names)}"
+    # the device solve ran in the SIDECAR process
+    by_svc = {}
+    for s in spans:
+        by_svc.setdefault(s["svc"], set()).add(s["name"])
+    assert "sidecar.solve" in by_svc["sidecar"]
+    assert "stage.compute" in by_svc["sidecar"]
+    assert "provisioner.provision" in by_svc["operator"]
+    # connectivity: every span's parent resolves inside the trace or to
+    # the client's (remote) root — no orphaned subtrees
+    ids = {s["spanId"] for s in spans}
+    client_root = traceparent.split("-")[2]
+    for s in spans:
+        assert s["parentId"] is None or s["parentId"] in ids \
+            or s["parentId"] == client_root, s
+
+    # ---- kpctl trace: list names it, export is valid Chrome JSON ------
+    out = kpctl_cli(base, "trace", "list")
+    assert trace_id in out
+    chrome_path = tmp_path / "trace.json"
+    kpctl_cli(base, "trace", "export", trace_id, "-o", str(chrome_path))
+    doc = json.loads(chrome_path.read_text())
+    events = doc["traceEvents"]
+    assert events and doc["displayTimeUnit"] == "ms"
+    for e in events:
+        assert {"name", "ph", "pid", "tid"} <= set(e)
+        if e["ph"] == "X":
+            assert e["dur"] >= 0 and {"ts", "args"} <= set(e)
+    # two process rows: operator + sidecar
+    metas = {e["args"]["name"] for e in events if e["ph"] == "M"}
+    assert metas == {"operator", "sidecar"}
+    show = kpctl_cli(base, "trace", "show", trace_id)
+    assert "sidecar.solve" in show and "[sidecar]" in show
+
+    # ---- solver provenance on the claim, rendered by describe ---------
+    claims = client.request("GET", "/apis/nodeclaims")["items"]
+    mine = [c for c in claims
+            if c["spec"].get("annotations", {}).get(
+                "karpenter.sh/traceparent", "").find(trace_id) >= 0]
+    assert mine, "no claim carries the pass's traceparent annotation"
+    desc = kpctl_cli(base, "describe", "nodeclaims",
+                     mine[0]["metadata"]["name"])
+    assert "Solver:" in desc
+    assert "Path:" in desc and "Stages:" in desc
+    assert trace_id in desc
+
+
 @pytest.mark.slow
 def test_kpctl_watch_and_delete_over_the_wire(control_plane, tmp_path):
     client, base = control_plane

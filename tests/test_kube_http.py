@@ -55,6 +55,17 @@ class TestRestVerbs:
         assert len(listed["items"]) == 1
         assert listed["resourceVersion"] >= 1
 
+    def test_named_get_with_watch_param_returns_object(self, api):
+        """A stray watch=1 on a NAMED path must return the object, not
+        silently discard the name into a kind-wide stream."""
+        _, base = api
+        spec = serde.pod_to_dict(Pod(name="p0",
+                                     requests={"cpu": "1",
+                                               "memory": "1Gi"}))
+        req("POST", f"{base}/apis/pods", spec)
+        code, got = req("GET", f"{base}/apis/pods/p0?watch=1")
+        assert code == 200 and got["metadata"]["name"] == "p0"
+
     def test_update_conflict_409(self, api):
         _, base = api
         spec = serde.pod_to_dict(Pod(name="p0",

@@ -1,0 +1,454 @@
+"""Tracing & flight recorder (trace/, docs/reference/tracing.md).
+
+Covers the span library (contextvars propagation, W3C traceparent wire
+format, the disabled fast path's zero-allocation contract), the flight
+recorder's TAIL sampling (errored / degraded / over-budget traces pinned
+past ring wrap-around — including a real injected-fault degraded device
+solve), the Chrome trace-event export, cross-process span ingestion
+(the sidecar ships its spans back in the Solve RPC response), and the
+/debug/traces read surface.
+"""
+
+import json
+import threading
+
+import pytest
+
+from karpenter_provider_aws_tpu import trace
+from karpenter_provider_aws_tpu.trace import FlightRecorder
+from karpenter_provider_aws_tpu.trace.span import NOOP_SPAN, Span
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture()
+def recorder():
+    """Tracing enabled with a tiny ring; always restored to disabled."""
+    rec = FlightRecorder(ring=8, retained=4, latency_budget_ms=1000.0)
+    trace.enable(rec)
+    yield rec
+    trace.disable()
+    trace.get_tracer().recorder = None
+
+
+@pytest.fixture()
+def fake_clock():
+    clk = FakeClock(start=1_000.0)
+    rec = FlightRecorder(ring=8, retained=4, latency_budget_ms=1000.0)
+    trace.enable(rec, clock=clk)
+    yield clk, rec
+    trace.disable()
+    tr = trace.get_tracer()
+    tr.recorder = None
+    from karpenter_provider_aws_tpu.utils.clock import Clock
+    tr.clock = Clock()
+
+
+class TestTraceparent:
+    def test_round_trip(self):
+        tid, sid = "ab" * 16, "cd" * 8
+        hdr = trace.format_traceparent(tid, sid)
+        assert hdr == f"00-{tid}-{sid}-01"
+        assert trace.parse_traceparent(hdr) == (tid, sid, True)
+
+    def test_unsampled_flag(self):
+        hdr = trace.format_traceparent("ab" * 16, "cd" * 8, sampled=False)
+        assert trace.parse_traceparent(hdr) == ("ab" * 16, "cd" * 8, False)
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "garbage", "00-short-cdcdcdcdcdcdcdcd-01",
+        "00-" + "ab" * 16 + "-" + "cd" * 8,            # missing flags
+        "zz-" + "ab" * 16 + "-" + "cd" * 8 + "-01",    # non-hex version
+        "ff-" + "ab" * 16 + "-" + "cd" * 8 + "-01",    # forbidden version
+        "00-" + "00" * 16 + "-" + "cd" * 8 + "-01",    # all-zero trace
+        "00-" + "ab" * 16 + "-" + "00" * 8 + "-01",    # all-zero span
+        "00-" + "xy" * 16 + "-" + "cd" * 8 + "-01",    # non-hex trace
+    ])
+    def test_malformed_headers_never_raise(self, bad):
+        assert trace.parse_traceparent(bad) is None
+
+
+class TestSpans:
+    def test_nesting_via_contextvars(self, recorder):
+        with trace.span("outer") as outer:
+            assert trace.current() is outer
+            with trace.span("inner") as inner:
+                assert inner.trace_id == outer.trace_id
+                assert inner.parent_id == outer.span_id
+            assert trace.current() is outer
+        assert trace.current() is None
+
+    def test_remote_parent_from_header(self, recorder):
+        hdr = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        with trace.span("child", parent=hdr) as sp:
+            assert sp.trace_id == "ab" * 16
+            assert sp.parent_id == "cd" * 8
+
+    def test_parent_none_forces_new_root(self, recorder):
+        with trace.span("outer") as outer:
+            with trace.span("root2", parent=None) as sp:
+                assert sp.trace_id != outer.trace_id
+                assert sp.parent_id is None
+
+    def test_links_accept_spans_headers_and_pairs(self, recorder):
+        with trace.span("a") as a:
+            pass
+        hdr = trace.format_traceparent("ef" * 16, "ab" * 8)
+        with trace.span("b", links=[a, hdr, ("12" * 16, "34" * 8)]) as b:
+            assert (a.trace_id, a.span_id) in b.links
+            assert ("ef" * 16, "ab" * 8) in b.links
+            assert ("12" * 16, "34" * 8) in b.links
+
+    def test_capture_and_annotate(self, recorder):
+        assert trace.capture() is None
+        with trace.span("op") as sp:
+            hdr = trace.capture()
+            assert hdr == sp.traceparent()
+            trace.annotate(flavor="x")
+        assert sp.attrs["flavor"] == "x"
+
+    def test_exception_marks_error_status(self, recorder):
+        with pytest.raises(ValueError):
+            with trace.span("boom") as sp:
+                raise ValueError("nope")
+        assert sp.status == "error"
+        assert "ValueError" in sp.attrs["error"]
+
+    def test_thread_handoff_via_traceparent(self, recorder):
+        """The batching seams' hand-off: capture() in the producer,
+        parent= in the worker yields one connected trace."""
+        out = {}
+
+        def worker(ctx):
+            with trace.span("worker", parent=ctx) as sp:
+                out["span"] = sp
+
+        with trace.span("producer") as prod:
+            t = threading.Thread(target=worker, args=(trace.capture(),))
+            t.start()
+            t.join()
+        assert out["span"].trace_id == prod.trace_id
+        assert out["span"].parent_id == prod.span_id
+
+    def test_fake_clock_durations_and_wall_anchor(self, fake_clock):
+        clk, rec = fake_clock
+        with trace.span("timed") as sp:
+            clk.step(0.25)
+        assert sp.duration == pytest.approx(0.25)
+        assert sp.start == pytest.approx(1_000.0)
+
+
+class TestDisabledFastPath:
+    def test_span_is_shared_noop_singleton(self):
+        assert not trace.enabled()
+        assert trace.span("a") is NOOP_SPAN
+        assert trace.span("b", parent=None, pods=9) is NOOP_SPAN
+        with trace.span("c") as sp:
+            assert sp is NOOP_SPAN
+            assert sp.set(x=1) is NOOP_SPAN
+            assert sp.traceparent() is None
+        assert trace.current() is None
+        assert trace.capture() is None
+        trace.annotate(k="v")  # no ambient span: must be a no-op
+
+    def test_no_span_objects_allocated_when_disabled(self):
+        """The acceptance contract: tracing disabled, call sites allocate
+        NO Span objects (one attribute read + the shared singleton)."""
+        import gc
+        assert not trace.enabled()
+        gc.collect()
+        before = len([o for o in gc.get_objects() if isinstance(o, Span)])
+        for _ in range(100):
+            with trace.span("hot.path", pods=3):
+                trace.annotate(deep=True)
+        gc.collect()
+        after = len([o for o in gc.get_objects() if isinstance(o, Span)])
+        assert after == before
+
+    def test_contextvar_untouched_when_disabled(self):
+        with trace.span("noop"):
+            assert trace.current() is None
+
+
+class TestTailSampling:
+    def _trace(self, name="op", **attrs):
+        with trace.span(name, **attrs):
+            pass
+
+    def test_boring_traces_evicted_on_ring_wrap(self, recorder):
+        for i in range(20):
+            self._trace(f"boring{i}")
+        assert len(recorder.summaries()) <= recorder.ring_size
+        assert recorder.stats["completed"] == 20
+
+    def test_degraded_trace_survives_ring_wrap(self, recorder):
+        with trace.span("solve") as sp:
+            sp.set(degraded=True)
+        pinned = sp.trace_id
+        for i in range(3 * recorder.ring_size):
+            self._trace(f"boring{i}")
+        assert recorder.get(pinned) is not None
+        summary = [t for t in recorder.summaries()
+                   if t["traceId"] == pinned]
+        assert summary and summary[0]["retained"] == "degraded"
+
+    def test_errored_trace_retained(self, recorder):
+        with pytest.raises(RuntimeError):
+            with trace.span("fail") as sp:
+                raise RuntimeError("x")
+        for i in range(2 * recorder.ring_size):
+            self._trace(f"boring{i}")
+        got = [t for t in recorder.summaries()
+               if t["traceId"] == sp.trace_id]
+        assert got and got[0]["retained"] == "error"
+
+    def test_error_outranks_degraded(self, recorder):
+        with pytest.raises(RuntimeError):
+            with trace.span("both") as sp:
+                sp.set(degraded=True)
+                raise RuntimeError("x")
+        got = [t for t in recorder.summaries()
+               if t["traceId"] == sp.trace_id]
+        assert got[0]["retained"] == "error"
+
+    def test_slow_trace_retained_by_latency_budget(self, fake_clock):
+        clk, rec = fake_clock
+        with trace.span("slowpoke") as sp:
+            clk.step(1.5)   # budget is 1000 ms
+        got = [t for t in rec.summaries() if t["traceId"] == sp.trace_id]
+        assert got and got[0]["retained"] == "slow"
+        with trace.span("fast") as sp2:
+            clk.step(0.01)
+        got2 = [t for t in rec.summaries() if t["traceId"] == sp2.trace_id]
+        assert got2 and got2[0]["retained"] is None
+
+    def test_discard_root_drops_trace(self, recorder):
+        """An idle reconcile (disruption found nothing) must not churn
+        the ring: its root marks discard and the trace vanishes."""
+        with trace.span("idle.reconcile") as sp:
+            sp.set(discard=True)
+        assert recorder.get(sp.trace_id) is None
+        assert recorder.stats["discarded"] == 1
+
+    def test_retained_set_bounded(self, recorder):
+        """Evidence is bounded: after the ring wraps with fresh traffic,
+        only the NEWEST retained_size incidents stay pinned."""
+        for i in range(3 * recorder.retained_size):
+            with trace.span(f"bad{i}") as sp:
+                sp.set(degraded=True)
+        for i in range(2 * recorder.ring_size):
+            self._trace(f"boring{i}")
+        retained = [t for t in recorder.summaries() if t["retained"]]
+        assert len(retained) == recorder.retained_size
+        newest = {f"bad{i}" for i in range(2 * recorder.retained_size,
+                                           3 * recorder.retained_size)}
+        assert {t["root"] for t in retained} == newest
+
+    def test_degraded_device_solve_trace_retained_after_wrap(self, recorder):
+        """The acceptance scenario end-to-end at the solver layer: an
+        INJECTED-FAULT degraded solve's trace survives ring wrap."""
+        from karpenter_provider_aws_tpu.apis import NodePool, Pod
+        from karpenter_provider_aws_tpu.lattice import (build_catalog,
+                                                        build_lattice)
+        from karpenter_provider_aws_tpu.solver import (FaultInjector,
+                                                       Solver,
+                                                       build_problem)
+        lattice = build_lattice(
+            [s for s in build_catalog() if s.family in ("m5", "c5")])
+        solver = Solver(lattice)
+        solver.inject_faults(FaultInjector(device_errors=8))
+        pods = [Pod(name=f"p{i}", requests={"cpu": "1", "memory": "2Gi"})
+                for i in range(8)]
+        with trace.span("provision.pass") as root:
+            plan = solver.solve(build_problem(
+                pods, [NodePool(name="default")], lattice))
+        assert plan.degraded and plan.solver_path == "host-ffd"
+        for i in range(3 * recorder.ring_size):
+            with trace.span(f"boring{i}"):
+                pass
+        spans = recorder.get(root.trace_id)
+        assert spans is not None, "degraded solve trace fell out of the ring"
+        names = {s.name for s in spans}
+        assert "solver.host_ffd" in names
+        got = [t for t in recorder.summaries()
+               if t["traceId"] == root.trace_id]
+        assert got[0]["retained"] == "degraded"
+
+
+class TestChromeExport:
+    def test_export_shape_and_process_rows(self, recorder):
+        with trace.span("root", pods=4) as root:
+            with trace.span("child"):
+                pass
+            with trace.span("remote", svc="sidecar"):
+                pass
+        doc = recorder.to_chrome(root.trace_id)
+        assert doc["displayTimeUnit"] == "ms"
+        events = doc["traceEvents"]
+        xs = [e for e in events if e["ph"] == "X"]
+        assert len(xs) == 3
+        for e in xs:
+            assert {"name", "ph", "cat", "ts", "dur", "pid", "tid",
+                    "args"} <= set(e)
+            assert e["args"]["traceId"] == root.trace_id
+        # one process_name metadata row per service
+        metas = {e["args"]["name"] for e in events if e["ph"] == "M"}
+        assert metas == {"operator", "sidecar"}
+        # valid JSON end to end
+        assert json.loads(json.dumps(doc)) == doc
+
+    def test_export_unknown_trace_is_none(self, recorder):
+        assert recorder.to_chrome("ff" * 16) is None
+
+    def test_links_and_scalar_attrs_exported(self, recorder):
+        with trace.span("a") as a:
+            pass
+        with trace.span("b", links=[a], n=3, deep=True,
+                        blob={"not": "scalar"}) as b:
+            pass
+        doc = recorder.to_chrome(b.trace_id)
+        (ev,) = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert ev["args"]["n"] == 3 and ev["args"]["deep"] is True
+        assert "blob" not in ev["args"]          # non-scalar dropped
+        assert ev["args"]["links"] == [f"{a.trace_id}:{a.span_id}"]
+
+
+class TestIngest:
+    def _wire_span(self, trace_id, span_id, parent_id=None, name="remote",
+                   **attrs):
+        return {"name": name, "traceId": trace_id, "spanId": span_id,
+                "parentId": parent_id, "svc": "sidecar", "thread": 7,
+                "start": 1000.0, "durationMs": 12.5, "status": "ok",
+                "attrs": attrs, "links": []}
+
+    def test_ingest_joins_open_trace(self, recorder):
+        with trace.span("local.root") as root:
+            n = recorder.ingest([self._wire_span(
+                root.trace_id, "aa" * 8, parent_id=root.span_id)])
+            assert n == 1
+        spans = recorder.get(root.trace_id)
+        assert {s.svc for s in spans} == {"operator", "sidecar"}
+        remote = [s for s in spans if s.svc == "sidecar"][0]
+        assert remote.parent_id == root.span_id
+        assert remote.duration == pytest.approx(0.0125)
+
+    def test_ingest_dedupes_by_span_id(self, recorder):
+        """The in-process sidecar shares the recorder: its spans arrive
+        once locally and once over the wire — they must not double."""
+        with trace.span("local.root") as root:
+            w = self._wire_span(root.trace_id, "aa" * 8)
+            assert recorder.ingest([w, w]) == 1
+            assert recorder.ingest([w]) == 0
+        assert len(recorder.get(root.trace_id)) == 2
+
+    def test_remote_degraded_span_pins_trace(self, recorder):
+        """Tail sampling sees the ingested subtree: a solve that degraded
+        only in the SIDECAR still pins the whole trace."""
+        with trace.span("local.root") as root:
+            recorder.ingest([self._wire_span(
+                root.trace_id, "aa" * 8, degraded=True)])
+        for i in range(3 * recorder.ring_size):
+            with trace.span(f"boring{i}"):
+                pass
+        got = [t for t in recorder.summaries()
+               if t["traceId"] == root.trace_id]
+        assert got and got[0]["retained"] == "degraded"
+
+    def test_ingest_standalone_trace_finalizes(self, recorder):
+        n = recorder.ingest([self._wire_span("ab" * 16, "aa" * 8)])
+        assert n == 1
+        assert recorder.get("ab" * 16) is not None
+
+    def test_imported_span_round_trips(self):
+        from karpenter_provider_aws_tpu.trace import ImportedSpan
+        d = self._wire_span("ab" * 16, "aa" * 8, parent_id="cd" * 8, n=3)
+        assert ImportedSpan(d).to_dict() == d
+
+
+class TestDebugDoc:
+    def test_list_and_get_routes(self, recorder):
+        with trace.span("served") as sp:
+            pass
+        doc = recorder.debug_doc("/debug/traces", {})
+        assert doc["ring"] == recorder.ring_size
+        assert any(t["traceId"] == sp.trace_id for t in doc["traces"])
+        one = recorder.debug_doc(f"/debug/traces/{sp.trace_id}", {})
+        assert one["traceId"] == sp.trace_id
+        assert one["spans"][0]["name"] == "served"
+
+    def test_chrome_format_and_misses(self, recorder):
+        with trace.span("served") as sp:
+            pass
+        chrome = recorder.debug_doc(f"/debug/traces/{sp.trace_id}",
+                                    {"format": ["chrome"]})
+        assert "traceEvents" in chrome
+        assert recorder.debug_doc("/debug/traces/" + "ff" * 16, {}) is None
+        assert recorder.debug_doc("/debug/other", {}) is None
+
+    def test_failed_write_is_recorded_as_error(self, recorder):
+        """A failed POST's span must finish status=error (the 3 a.m.
+        evidence): the handler's except runs OUTSIDE the span, so the
+        exception is seen at span exit before the error response."""
+        import urllib.error
+        import urllib.request
+
+        from karpenter_provider_aws_tpu.kube.apiserver import FakeAPIServer
+        from karpenter_provider_aws_tpu.kube.httpserver import serve
+
+        httpd = serve(FakeAPIServer(), port=0)
+        try:
+            port = httpd.server_address[1]
+            tid = "ab" * 16
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/apis/pods", method="POST",
+                data=b'{"no": "name"}',
+                headers={"Content-Type": "application/json",
+                         "traceparent": f"00-{tid}-{'cd' * 8}-01"})
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(req)
+        finally:
+            httpd.shutdown()
+        spans = recorder.get(tid)
+        assert spans and spans[0].status == "error"
+        got = [t for t in recorder.summaries() if t["traceId"] == tid]
+        assert got and got[0]["retained"] == "error"
+
+    def test_exemplar_renders_as_scrape_safe_comment(self, recorder):
+        """Classic text-format scrapes must survive exemplars: the trace
+        id rides a COMMENT line, never the sample line itself."""
+        from karpenter_provider_aws_tpu.metrics import Histogram
+        h = Histogram("t_hist", "h", buckets=(1.0,), labelnames=("stage",))
+        h.observe(0.5, exemplar="ab" * 16, stage="compute")
+        lines = h._render()
+        samples = [l for l in lines if not l.startswith("#")]
+        assert all("#" not in l for l in samples), samples
+        comments = [l for l in lines if l.startswith("# exemplar")]
+        assert len(comments) == 1 and "ab" * 16 in comments[0]
+        assert h.exemplar(stage="compute") == ("ab" * 16, 0.5)
+        # no exemplar observed → byte-identical classic rendering
+        h2 = Histogram("t_hist2", "h", buckets=(1.0,))
+        h2.observe(0.5)
+        assert not [l for l in h2._render() if l.startswith("# exemplar")]
+
+    def test_served_over_http(self, recorder):
+        """The kube httpserver mounts the same doc at /debug/traces."""
+        import urllib.request
+
+        from karpenter_provider_aws_tpu.kube.apiserver import FakeAPIServer
+        from karpenter_provider_aws_tpu.kube.httpserver import serve
+
+        with trace.span("wire.visible") as sp:
+            pass
+        httpd = serve(FakeAPIServer(), port=0)
+        try:
+            port = httpd.server_address[1]
+            base = f"http://127.0.0.1:{port}"
+            with urllib.request.urlopen(f"{base}/debug/traces") as r:
+                listing = json.loads(r.read())
+            assert any(t["traceId"] == sp.trace_id
+                       for t in listing["traces"])
+            url = f"{base}/debug/traces/{sp.trace_id}?format=chrome"
+            with urllib.request.urlopen(url) as r:
+                chrome = json.loads(r.read())
+            assert chrome["traceEvents"]
+        finally:
+            httpd.shutdown()

@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# One-command CI gate: generated-artifact drift, tier-1 tests, bench smoke.
+#
+#     bash tools/ci.sh            # the full gate (exit != 0 on any failure)
+#     bash tools/ci.sh --fast     # drift check + tier-1 only (skip bench)
+#
+# Mirrors what the reference's `make presubmit` (verify + test) gates:
+#
+#   1. drift  — deploy/crds/*.yaml and docs/reference/*.md must match what
+#               tools/gen_crds.py / tools/gen_docs.py generate from code
+#               (the codegen-lockstep contract tests/test_schema.py and
+#               tests/test_tools.py also assert, surfaced here as its own
+#               gate so a red run names the stale file directly)
+#   2. tier-1 — the full non-slow test suite on the CPU backend
+#   3. bench  — `bench.py --smoke`: one fast config through the real
+#               harness, so a broken solve path can never ride in on a
+#               green unit-test run
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+PY=${PYTHON:-python}
+FAST=0
+[ "${1:-}" = "--fast" ] && FAST=1
+
+echo "=== ci [1/3] generated-artifact drift ==="
+$PY tools/gen_crds.py --check
+tmp=$(mktemp -d)
+trap 'rm -rf "$tmp"' EXIT
+$PY tools/gen_docs.py --out-dir "$tmp" >/dev/null
+stale=0
+for f in instance-types.md metrics.md settings.md compatibility.md; do
+    if ! diff -u "docs/reference/$f" "$tmp/$f"; then
+        echo "STALE docs/reference/$f — run: $PY tools/gen_docs.py"
+        stale=1
+    fi
+done
+[ "$stale" = 0 ] || exit 1
+echo "drift: clean"
+
+echo "=== ci [2/3] tier-1 tests ==="
+$PY -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider
+
+if [ "$FAST" = 1 ]; then
+    echo "=== ci [3/3] bench smoke: SKIPPED (--fast) ==="
+else
+    echo "=== ci [3/3] bench smoke ==="
+    $PY bench.py --smoke
+fi
+
+echo "ci gate: OK"

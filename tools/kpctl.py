@@ -58,17 +58,29 @@ class Client:
                         if self.token else {})})
         resp = urllib.request.urlopen(r, timeout=None if stream else 30,
                                       context=self.ctx)
-        st = resp.headers.get("X-Server-Time")
+        st = _parse_server_time(resp.headers.get("X-Server-Time"))
         if st is not None:
             global _SERVER_NOW
-            try:
-                _SERVER_NOW = float(st)
-            except ValueError:
-                pass
+            _SERVER_NOW = st
         if stream:
             return resp
         with resp:
             return json.loads(resp.read() or b"{}")
+
+
+def _parse_server_time(st):
+    """X-Server-Time → float epoch seconds, tolerating BOTH wire forms:
+    the current plain numeric ('1234.567890') and the legacy repr() a
+    pre-fix server emits under a numpy-scalar clock ('np.float64(1234.5)'
+    on numpy>=2) — an old control plane must not break age rendering."""
+    if st is None:
+        return None
+    try:
+        return float(st)
+    except ValueError:
+        import re
+        m = re.search(r"[-+]?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?", st)
+        return float(m.group(0)) if m else None
 
 
 # the reference clock for AGE/LAST SEEN columns: the SERVER's clock as
@@ -334,6 +346,7 @@ def cmd_describe(c: Client, args) -> int:
     print("Spec:")
     for line in json.dumps(obj["spec"], indent=2).splitlines()[1:-1]:
         print(f" {line}")
+    _print_solver_provenance(obj)
 
     def _matches(spec) -> bool:
         # kubectl matches involvedObject kind+name; objectName alone
@@ -352,6 +365,117 @@ def cmd_describe(c: Client, args) -> int:
               _age(e.get("time")), e.get("message", "")] for e in mine]
     _print_rows(rows, indent="  ")
     return 0
+
+
+_SOLVER_ANN = "karpenter.sh/"   # apis/wellknown.py KARPENTER_PREFIX
+
+
+def _print_solver_provenance(obj) -> None:
+    """The solver-provenance block of `kpctl describe nodeclaims`: the
+    annotations the provisioner stamped on the claim (apis/wellknown.py)
+    so an operator sees WHY this claim's solve was slow or degraded —
+    path taken, degradation reason, per-stage ms, and the trace id to
+    pull from the flight recorder (`kpctl trace show <id>`)."""
+    ann = (obj.get("spec", {}).get("annotations")
+           or obj.get("metadata", {}).get("annotations") or {})
+    path = ann.get(_SOLVER_ANN + "solver-path")
+    if path is None:
+        return
+    print("Solver:")
+    print(f"  Path:           {path}")
+    pipelined = ann.get(_SOLVER_ANN + "solver-pipelined")
+    if pipelined is not None:
+        print(f"  Pipelined:      {pipelined}")
+    waves = ann.get(_SOLVER_ANN + "solver-waves")
+    if waves is not None:
+        print(f"  Waves:          {waves}")
+    reason = ann.get(_SOLVER_ANN + "solver-degraded-reason")
+    print(f"  Degraded:       {reason if reason else 'false'}")
+    stage_ms = ann.get(_SOLVER_ANN + "solver-stage-ms")
+    if stage_ms:
+        try:
+            stages = json.loads(stage_ms)
+            rendered = "  ".join(f"{k}={v:g}ms" for k, v in stages.items())
+        except ValueError:
+            rendered = stage_ms
+        print(f"  Stages:         {rendered}")
+    tp = ann.get(_SOLVER_ANN + "traceparent")
+    if tp:
+        parts = tp.split("-")
+        if len(parts) == 4:
+            print(f"  Trace:          {parts[1]}  "
+                  "(kpctl trace show <id>)")
+
+
+def cmd_trace(c: Client, args) -> int:
+    """The flight recorder's CLI surface (docs/reference/tracing.md):
+
+        kpctl trace list           retained + ring traces, newest first
+        kpctl trace show ID        the span tree, durations + attrs
+        kpctl trace export ID      Chrome trace-event JSON (Perfetto /
+                                   chrome://tracing, loadable next to an
+                                   xprof device trace) to -o or stdout
+    """
+    if args.action in ("show", "export") and not args.id:
+        raise SystemExit(f"kpctl trace {args.action} needs a trace id "
+                         "(see `kpctl trace list`)")
+    if args.action == "list":
+        doc = c.request("GET", "/debug/traces")
+        rows = [["TRACE", "ROOT", "SVC", "SPANS", "DURATION", "RETAINED",
+                 "AGE"]]
+        for t in doc.get("traces", []):
+            rows.append([
+                t["traceId"], t["root"], ",".join(t.get("svc", [])),
+                str(t["spans"]), f"{t['durationMs']:.1f}ms",
+                t.get("retained") or "-", _age(t.get("start"))])
+        if len(rows) == 1:
+            print("No traces retained.")
+            stats = doc.get("stats", {})
+            if stats:
+                print(f"(started={stats.get('started', 0)} "
+                      f"completed={stats.get('completed', 0)} "
+                      f"retained={stats.get('retained', 0)})")
+            return 0
+        _print_rows(rows)
+        return 0
+    if args.action == "show":
+        doc = c.request("GET", f"/debug/traces/{args.id}")
+        spans = doc.get("spans", [])
+        by_parent = {}
+        by_id = {s["spanId"]: s for s in spans}
+        for s in spans:
+            pid = s.get("parentId")
+            key = pid if pid in by_id else None   # remote/absent parent → root
+            by_parent.setdefault(key, []).append(s)
+
+        def walk(parent, depth):
+            for s in sorted(by_parent.get(parent, []),
+                            key=lambda x: x["start"]):
+                attrs = {k: v for k, v in s.get("attrs", {}).items()
+                         if k not in ("discard",)}
+                extra = ("  " + " ".join(f"{k}={v}" for k, v in attrs.items())
+                         if attrs else "")
+                mark = " !" if s.get("status") == "error" else ""
+                print(f"{'  ' * depth}{s['name']}  "
+                      f"[{s.get('svc', '?')}] {s['durationMs']:.2f}ms"
+                      f"{mark}{extra}")
+                walk(s["spanId"], depth + 1)
+
+        print(f"Trace:  {args.id}  ({len(spans)} spans)")
+        walk(None, 0)
+        return 0
+    if args.action == "export":
+        doc = c.request("GET", f"/debug/traces/{args.id}?format=chrome")
+        text = json.dumps(doc, indent=1)
+        if args.output_file:
+            with open(args.output_file, "w") as f:
+                f.write(text)
+            print(f"wrote {len(doc.get('traceEvents', []))} events to "
+                  f"{args.output_file}")
+        else:
+            print(text)
+        return 0
+    raise SystemExit(f"unknown trace action {args.action!r}")
 
 
 def cmd_evict(c: Client, args) -> int:
@@ -419,6 +543,18 @@ def main(argv=None) -> int:
 
     ar = sub.add_parser("api-resources")
     ar.set_defaults(fn=cmd_api_resources)
+
+    tr = sub.add_parser(
+        "trace", help="flight-recorder traces (requires --trace on the "
+                      "control plane; docs/reference/tracing.md)")
+    tr.add_argument("action", nargs="?", default="list",
+                    choices=("list", "show", "export"))
+    tr.add_argument("id", nargs="?", default=None,
+                    help="trace id (show/export)")
+    tr.add_argument("-o", "--output-file", default=None,
+                    help="export: write Chrome trace-event JSON here "
+                         "(default stdout)")
+    tr.set_defaults(fn=cmd_trace)
 
     args = p.parse_args(argv)
     if not args.server:

@@ -58,6 +58,11 @@ class _Bucket(Generic[T, U]):
         self.lock = threading.Lock()
         self.thread: threading.Thread = None
         self.started_at: float = 0.0
+        # occupancy counters (introspect/ providers read these through
+        # Batcher.stats(); mutated only under self.lock)
+        self.batches = 0        # drains executed
+        self.items = 0          # requests served
+        self.max_batch = 0      # largest single drain
 
     def add(self, request: T, fut: Future) -> None:
         import time
@@ -96,6 +101,10 @@ class _Bucket(Generic[T, U]):
                         continue
                 with self.lock:
                     batch, self.pending = self.pending, []
+                    if batch:
+                        self.batches += 1
+                        self.items += len(batch)
+                        self.max_batch = max(self.max_batch, len(batch))
                 if batch:
                     try:
                         self._execute(batch)
@@ -163,3 +172,20 @@ class Batcher(Generic[T, U]):
                 self._buckets[key] = bucket
         bucket.add(request, fut)
         return fut.result(timeout=timeout)
+
+    def stats(self) -> Dict[str, int]:
+        """Occupancy snapshot for the introspection registry: bucket
+        count, queued depth, drain counters. Cheap — per-bucket counter
+        reads under each bucket's own lock, never blocking a drain."""
+        with self._lock:
+            buckets = list(self._buckets.values())
+        pending = batches = items = 0
+        max_batch = 0
+        for b in buckets:
+            with b.lock:
+                pending += len(b.pending)
+                batches += b.batches
+                items += b.items
+                max_batch = max(max_batch, b.max_batch)
+        return {"buckets": len(buckets), "pending": pending,
+                "batches": batches, "items": items, "max_batch": max_batch}

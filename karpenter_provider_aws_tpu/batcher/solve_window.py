@@ -61,6 +61,15 @@ class SolveWindow:
         return self._batcher.add((args, kwargs, trace.capture()),
                                  timeout=self.timeout)
 
+    def stats(self) -> dict:
+        """Introspection provider: how often the window actually fused
+        concurrent callers, plus the underlying batcher's occupancy."""
+        with self._lock:
+            out = {"batches": self.batches, "coalesced": self.coalesced}
+        for k, v in self._batcher.stats().items():
+            out["batcher_" + k] = v
+        return out
+
     def _drain(self, requests: List[Tuple[tuple, dict, object]]) -> Sequence:
         with self._lock:
             self.batches += 1

@@ -81,6 +81,16 @@ class TTLCache:
                 self._on_evict(k, v)
         return len(evicted)
 
+    def stats(self) -> Dict[str, float]:
+        """Introspection snapshot: stored entries (including not-yet-swept
+        expired ones — the ``live`` count pays the expiry scan) and the
+        configured TTL."""
+        now = self._clock.now()
+        with self._lock:
+            stored = len(self._data)
+            live = sum(1 for _, exp in self._data.values() if exp > now)
+        return {"entries": stored, "live": live, "ttl_seconds": self.ttl}
+
     def items(self) -> Iterator[Tuple[str, Any]]:
         now = self._clock.now()
         with self._lock:

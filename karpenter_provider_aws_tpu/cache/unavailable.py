@@ -84,6 +84,13 @@ class UnavailableOfferings:
         itself rides the evict hook (see __init__), once per entry."""
         return self._cache.cleanup()
 
+    def stats(self) -> dict:
+        """Introspection snapshot: ICE'd offering count + the sequence
+        number downstream version-keyed caches invalidate on."""
+        out = self._cache.stats()
+        out["seq"] = self.seq_num
+        return out
+
     def entries(self) -> Iterable[Offering]:
         for key, _ in self._cache.items():
             ct, it, z = key.split(":", 2)

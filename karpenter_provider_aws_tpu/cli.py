@@ -295,7 +295,21 @@ def start_server(op: Operator, port: int,
             self.wfile.write(body)
 
         def do_GET(self):
-            if self.path.startswith("/debug/traces"):
+            if self.path.startswith("/debug/statusz") or \
+                    self.path.startswith("/debug/vars"):
+                # the introspection surfaces (docs/reference/
+                # introspection.md), mounted here like /debug/traces so
+                # deployments without --api-port still reach them
+                from urllib.parse import parse_qs as _pq
+                from urllib.parse import urlparse as _up
+                from . import introspect as _introspect
+                url = _up(self.path)
+                rendered = _introspect.debug_doc(url.path, _pq(url.query))
+                if rendered is None:
+                    self.send_error(404)
+                    return
+                body, ctype = rendered
+            elif self.path.startswith("/debug/traces"):
                 # the flight recorder's read surface, also mounted here so
                 # deployments without --api-port still reach their traces
                 import json as _json
@@ -407,6 +421,10 @@ def main(argv: Optional[Sequence[str]] = None,
             tls=bool(args.api_tls_cert), auth=bool(api_token))
     op = Operator(options=opts, api_server=api_server,
                   interruption_queue=queue)
+    # the introspection sampler (docs/reference/introspection.md): 1 Hz
+    # ring series behind /debug/vars?series=1 and kpctl top. One provider
+    # fan-out per second — off every hot path by construction.
+    op.sampler.start(interval=1.0)
 
     stop = stop_event or threading.Event()
 
@@ -466,6 +484,7 @@ def main(argv: Optional[Sequence[str]] = None,
                     break
                 stop.wait(args.step)
     finally:
+        op.sampler.stop()
         if runtime is not None:
             runtime.stop()
         if args.profile_dir:

@@ -27,13 +27,19 @@ def _committed_cost_per_hour(op) -> float:
     claims (the bill the cluster is running up right now)."""
     lat = op.lattice
     total = 0.0
+    # index maps built once per snapshot: the per-node list.index()
+    # linear scans this replaces ran inside EVERY Monitor sample — at
+    # soak scale that is O(nodes x zones) per second for a lookup the
+    # lattice answers in O(1)
+    z_idx = {z: i for i, z in enumerate(lat.zones)}
+    c_idx = {c: i for i, c in enumerate(lat.capacity_types)}
 
     def price(itype, zone, cap):
         ti = lat.name_to_idx.get(itype)
-        if ti is None or zone not in lat.zones:
+        zi = z_idx.get(zone)
+        if ti is None or zi is None:
             return 0.0
-        zi = lat.zones.index(zone)
-        ci = lat.capacity_types.index(cap) if cap in lat.capacity_types else 0
+        ci = c_idx.get(cap, 0)
         p = float(lat.price[ti, zi, ci])
         return p if p == p and p != float("inf") else 0.0
 
@@ -55,10 +61,16 @@ def _committed_cost_per_hour(op) -> float:
 
 
 def snapshot(op) -> Dict:
-    """One structured control-plane sample (cheap: locked snapshots)."""
+    """One structured control-plane sample (cheap: locked snapshots).
+
+    ``subsystems`` rides the introspection registry (introspect/): the
+    same per-subsystem stats /debug/vars serves, so soak artifacts carry
+    batcher occupancy, cache residency, writer throughput, watch
+    fan-out, and SLO burn as first-class series instead of the handful
+    of ad-hoc counters this module used to hand-pick."""
     cluster = op.cluster
     claims = cluster.snapshot_claims()
-    return {
+    s = {
         "t": round(time.time(), 3),
         "sim_t": round(op.clock.now(), 3),
         "pending_pods": len(cluster.pending_pods()),
@@ -70,6 +82,12 @@ def snapshot(op) -> Dict:
         "cost_per_hour": _committed_cost_per_hour(op),
         "ice_entries": sum(1 for _ in op.unavailable.entries()),
     }
+    try:
+        from . import introspect
+        s["subsystems"] = introspect.registry().collect()
+    except Exception:
+        pass   # observability must never kill the monitor
+    return s
 
 
 class Monitor:
@@ -119,7 +137,7 @@ class Monitor:
             peak_nodes = max(s["nodes"] for s in self.samples)
             peak_pending = max(s["pending_pods"] for s in self.samples)
             peak_cost = max(s["cost_per_hour"] for s in self.samples)
-            return {
+            out = {
                 "samples": len(self.samples),
                 "wall_seconds": round(self.samples[-1]["t"]
                                       - self.samples[0]["t"], 3),
@@ -128,6 +146,16 @@ class Monitor:
                 "peak_cost_per_hour": peak_cost,
                 "final": self.samples[-1],
             }
+            # the SLO burn envelope over the run (introspect/slo.py):
+            # peak burn is what a soak asserts the paper's bars against
+            burns = [s["subsystems"]["slo"] for s in self.samples
+                     if "slo" in s.get("subsystems", {})]
+            if burns:
+                out["peak_latency_burn"] = max(
+                    b.get("latency_burn", 0.0) for b in burns)
+                out["peak_cost_burn"] = max(
+                    b.get("cost_burn", 0.0) for b in burns)
+            return out
 
     def write(self, path: str) -> None:
         with self._lock:

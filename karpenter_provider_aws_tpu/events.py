@@ -35,6 +35,8 @@ class Recorder:
         self._clock = clock or Clock()
         self._events: Deque[Event] = deque(maxlen=MAX_EVENTS)
         self._lock = threading.Lock()
+        self.published = 0      # lifetime count (the ring forgets; this doesn't)
+        self.warnings = 0
         # optional mirror (kube.eventsink.ApiEventSink in API mode):
         # called per event, under the lock, so the mirrored stream keeps
         # publish order. A sink failure must never break the publishing
@@ -46,6 +48,9 @@ class Recorder:
         ev = Event(self._clock.now(), type, reason, object_kind, object_name, message)
         with self._lock:
             self._events.append(ev)
+            self.published += 1
+            if type == "Warning":
+                self.warnings += 1
             if self.sink is not None:
                 try:
                     self.sink(ev)
@@ -61,6 +66,12 @@ class Recorder:
         if object_name is not None:
             out = [e for e in out if e.object_name == object_name]
         return out
+
+    def stats(self) -> dict:
+        """Introspection snapshot: ring occupancy + lifetime counters."""
+        with self._lock:
+            return {"ring": len(self._events), "published": self.published,
+                    "warnings": self.warnings}
 
     def reset(self) -> None:
         with self._lock:

@@ -1,0 +1,123 @@
+"""Cluster introspection layer (docs/reference/introspection.md).
+
+A process-wide provider registry every stateful subsystem reports cheap
+``stats()`` into, a bounded-ring sampler off the hot path, rolling SLO
+burn tracking against the paper's 200 ms / 2% bars, and two debug
+surfaces rendered by both the metrics server and the REST apiserver:
+
+    GET /debug/statusz            human-readable subsystem state
+    GET /debug/vars[?series=1]    machine-readable JSON (+ ring series)
+
+``kpctl top`` renders /debug/vars as a live terminal view; tools/soak.py
+and debug.Monitor persist the same snapshots as per-subsystem
+time-series in soak artifacts.
+
+Usage (subsystem side):
+
+    from karpenter_provider_aws_tpu import introspect
+    introspect.registry().register("my_subsystem", my_obj.stats)
+
+The registry is process-wide and replace-by-name (a rebuilt Operator
+re-registers over its predecessor); the sampler and SLO tracker are
+per-Operator, with the most recent one published here for the HTTP
+surfaces (`set_sampler`), mirroring how trace.enable() publishes the
+flight recorder.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from .registry import IntrospectRegistry, StatsProvider
+from .sampler import Sampler
+from .slo import SloTracker
+
+__all__ = [
+    "IntrospectRegistry", "Sampler", "SloTracker", "StatsProvider",
+    "registry", "sampler", "set_sampler", "statusz_text", "vars_doc",
+    "debug_doc",
+]
+
+_REGISTRY = IntrospectRegistry()
+_SAMPLER: Optional[Sampler] = None
+_STARTED_AT = time.time()
+
+
+def registry() -> IntrospectRegistry:
+    """The process-wide provider registry."""
+    return _REGISTRY
+
+
+def sampler() -> Optional[Sampler]:
+    """The most recently published Sampler (None before any Operator)."""
+    return _SAMPLER
+
+
+def set_sampler(s: Optional[Sampler]) -> None:
+    global _SAMPLER
+    _SAMPLER = s
+
+
+# ---- the two debug documents ---------------------------------------------
+
+def vars_doc(include_series: bool = False) -> Dict:
+    """The /debug/vars JSON document: current stats per provider, plus
+    (on request) the sampler's bounded ring series. Machine-readable —
+    the backbone of kpctl top and the soak artifact."""
+    doc: Dict = {
+        "now": round(time.time(), 3),
+        "uptimeSeconds": round(time.time() - _STARTED_AT, 1),
+        "providers": _REGISTRY.collect(),
+    }
+    s = _SAMPLER
+    if s is not None:
+        doc["sampler"] = {"samples": s.samples_taken, "ring": s.ring}
+        if include_series:
+            doc["series"] = s.series()
+    return doc
+
+
+def statusz_text() -> str:
+    """The /debug/statusz page: the same snapshot, for humans. Plain
+    text — readable in a terminal (`curl .../debug/statusz`) without
+    any tooling."""
+    snap = _REGISTRY.collect()
+    lines: List[str] = [
+        "karpenter-tpu statusz",
+        f"uptime: {time.time() - _STARTED_AT:.0f}s   "
+        f"providers: {len(snap)}",
+        "",
+    ]
+    if not snap:
+        lines.append("(no providers registered yet — operator still "
+                     "constructing)")
+    for name in sorted(snap):
+        stats = snap[name]
+        lines.append(f"== {name} ==")
+        if not stats:
+            lines.append("  (empty)")
+        for k in sorted(stats):
+            v = stats[k]
+            if isinstance(v, float):
+                v = f"{v:g}"
+            lines.append(f"  {k}: {v}")
+        lines.append("")
+    return "\n".join(lines) + "\n"
+
+
+def debug_doc(path: str, query: Dict[str, List[str]]):
+    """Route /debug/statusz and /debug/vars for an HTTP handler.
+
+    Returns ``(body_bytes, content_type)`` or None when the path is not
+    ours — the same shape both kube/httpserver.py and cli.py mount next
+    to the flight recorder's /debug/traces."""
+    import json
+    p = path.rstrip("/")
+    if p == "/debug/statusz":
+        return statusz_text().encode(), "text/plain; charset=utf-8"
+    if p == "/debug/vars":
+        series = query.get("series", ["0"])[0] in ("1", "true")
+        return (json.dumps(vars_doc(include_series=series)).encode(),
+                "application/json")
+    return None

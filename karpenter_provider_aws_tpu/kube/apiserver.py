@@ -151,6 +151,19 @@ class FakeAPIServer:
         self._defaulters: Dict[str, List[Callable[[dict], dict]]] = {}
         self._uid = itertools.count(1)
         self.last_rv = 0
+        self.events_emitted = 0   # watch fan-out: deliveries pushed, total
+
+    def stats(self) -> Dict[str, int]:
+        """Introspection snapshot of the watch hub: subscriber fan-out,
+        queued (undelivered) events, store occupancy, write sequence."""
+        with self._lock:
+            watchers = sum(len(ws) for ws in self._watches.values())
+            queued = sum(len(w._events) for ws in self._watches.values()
+                         for w in ws)
+            objects = sum(len(s) for s in self._store.values())
+            return {"watchers": watchers, "watch_queue_depth": queued,
+                    "objects": objects, "events_emitted": self.events_emitted,
+                    "last_rv": self.last_rv}
 
     # ---- admission (webhook seam) -----------------------------------------
 
@@ -205,6 +218,7 @@ class FakeAPIServer:
             w._push(WatchEvent(type=type_, kind=kind,
                                object=copy.deepcopy(obj),
                                resource_version=rv))
+            self.events_emitted += 1
 
     def _next_rv(self) -> int:
         self.last_rv = next(self._rv)

@@ -48,7 +48,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
-from .. import trace
+from .. import introspect, trace
 from ..apis import wellknown as wk
 from .apiserver import (
     KINDS, AlreadyExistsError, APIError, ConflictError,
@@ -227,6 +227,24 @@ def serve(server: FakeAPIServer, port: int = 0,
                 # apiserver serves its group/resource lists under /apis)
                 if url.path.rstrip("/") == "/apis":
                     self._json(200, {"kinds": list(KINDS)})
+                    return
+                # the introspection surfaces (docs/reference/
+                # introspection.md): /debug/statusz (human) and
+                # /debug/vars (JSON; kpctl top + soak backbone)
+                rendered = introspect.debug_doc(url.path,
+                                                parse_qs(url.query))
+                if rendered is not None:
+                    body, ctype = rendered
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    # every response carries the server clock (the PR 2
+                    # invariant _json enforces): a kpctl session that
+                    # only polls /debug/vars still anchors age rendering
+                    self.send_header("X-Server-Time",
+                                     f"{float(server.now()):.6f}")
+                    self.end_headers()
+                    self.wfile.write(body)
                     return
                 # the flight recorder's read surface (kpctl trace):
                 # list / get / Chrome-export retained + ring traces

@@ -19,7 +19,8 @@ suites (pkg/test/environment.go:83-162).
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+import threading
+from typing import Dict, List, Optional, Tuple
 
 from .. import trace
 from ..apis.objects import Lease, Node, NodeClaim, NodeClaimPhase, Pod
@@ -31,24 +32,47 @@ from .apiserver import (
 from .client import KubeClient
 
 
-class DirectWriter:
+class WriterCounts:
+    """Per-verb write-throughput counters shared by both writer
+    implementations — the introspection registry's ``writer`` provider,
+    and the input the round-5 verdict's write-path profiling item needs
+    (API-stratum throughput DEGRADES 1k→15k; these counters put per-verb
+    rates next to the apiserver's own watch/event stats)."""
+
+    def _init_counts(self) -> None:
+        self.counts: Dict[str, int] = {}
+        self._counts_lock = threading.Lock()
+
+    def _count(self, verb: str, n: int = 1) -> None:
+        with self._counts_lock:
+            self.counts[verb] = self.counts.get(verb, 0) + n
+
+    def stats(self) -> Dict[str, int]:
+        with self._counts_lock:
+            return dict(self.counts)
+
+
+class DirectWriter(WriterCounts):
     """Write-through to the ClusterState mirror (simulation stratum)."""
 
     def __init__(self, cluster: ClusterState, clock: Clock):
         self.cluster = cluster
         self.clock = clock
+        self._init_counts()
 
     # ---- claims ------------------------------------------------------------
 
     def create_claim(self, claim: NodeClaim) -> None:
+        self._count("create_claim")
         self.cluster.add_claim(claim)
 
     def update_claim_status(self, claim: NodeClaim) -> None:
         # in-place mutation is already visible through the mirror
-        pass
+        self._count("update_claim_status")
 
     def mark_claim_deleting(self, name: str) -> None:
         """The k8s delete that starts the finalizer/termination flow."""
+        self._count("mark_claim_deleting")
         claim = self.cluster.claims.get(name)
         if claim is None:
             return
@@ -61,51 +85,61 @@ class DirectWriter:
     def rollback_claim(self, name: str) -> None:
         """Hard delete of a claim whose instance never materialized (or is
         already gone) — no drain, no finalizer round."""
+        self._count("rollback_claim")
         self.cluster.delete_claim(name)
 
     def finalize_claim(self, claim: NodeClaim) -> None:
         """Termination complete: remove the claim object."""
+        self._count("finalize_claim")
         self.cluster.delete_claim(claim.name)
 
     # ---- nodes -------------------------------------------------------------
 
     def register_node(self, node: Node, lease: Optional[Lease] = None) -> None:
+        self._count("register_node")
         self.cluster.add_node(node)
         if lease is not None:
             self.cluster.add_lease(lease)
 
     def cordon(self, node: Node, taint) -> bool:
         if all(t.key != taint.key for t in node.taints):
+            self._count("cordon")
             node.taints.append(taint)
             return True
         return False
 
     def drain_node(self, node_name: str) -> Tuple[List[Pod], List[Pod]]:
+        self._count("drain_node")
         return self.cluster.drain_node(node_name)
 
     def teardown_node(self, node_name: str) -> None:
+        self._count("teardown_node")
         self.cluster.evict_node(node_name)
 
     # ---- pods / volumes / leases ------------------------------------------
 
     def bind_pod(self, pod_name: str, node_name: str) -> bool:
+        self._count("bind_pod")
         self.cluster.bind_pod(pod_name, node_name)
         return True
 
     def bind_volumes(self, pod_name: str, zone: Optional[str]) -> None:
+        self._count("bind_volumes")
         self.cluster.bind_volumes(pod_name, zone)
 
     def delete_lease(self, name: str) -> None:
+        self._count("delete_lease")
         self.cluster.delete_lease(name)
 
 
-class ApiWriter:
+class ApiWriter(WriterCounts):
     """Write-through to the apiserver; the mirror follows via informers."""
 
     def __init__(self, kube: KubeClient, cluster: ClusterState, clock: Clock):
         self.kube = kube
         self.cluster = cluster
         self.clock = clock
+        self._init_counts()
 
     # ---- claims ------------------------------------------------------------
 
@@ -115,16 +149,19 @@ class ApiWriter:
         # legs between solve and CreateFleet); contextvars carry the trace
         # across this in-process hop — the httpserver carries it when the
         # same seam is driven over the wire
+        self._count("create_claim")
         with trace.span("kube.create_nodeclaim", claim=claim.name):
             self.kube.create_nodeclaim(claim)
 
     def update_claim_status(self, claim: NodeClaim) -> None:
+        self._count("update_claim_status")
         try:
             self.kube.update_nodeclaim(claim)
         except NotFoundError:
             pass  # deleted out from under us; the next reconcile observes it
 
     def mark_claim_deleting(self, name: str) -> None:
+        self._count("mark_claim_deleting")
         try:
             self.kube.delete_nodeclaim(name, now=self.clock.now())
         except NotFoundError:
@@ -133,24 +170,30 @@ class ApiWriter:
         # lands; gauges re-render then
 
     def rollback_claim(self, name: str) -> None:
+        self._count("rollback_claim")
         try:
             self.kube.delete_nodeclaim_now(name)
         except NotFoundError:
             pass
 
     def finalize_claim(self, claim: NodeClaim) -> None:
+        self._count("finalize_claim")
         self.kube.remove_nodeclaim_finalizer(claim.name)
 
     # ---- nodes -------------------------------------------------------------
 
     def register_node(self, node: Node, lease: Optional[Lease] = None) -> None:
+        self._count("register_node")
         self.kube.create_node(node)
         if lease is not None:
             self.kube.create_lease(lease)
 
     def cordon(self, node: Node, taint) -> bool:
         try:
-            return self.kube.taint_node(node.name, taint)
+            if self.kube.taint_node(node.name, taint):
+                self._count("cordon")
+                return True
+            return False
         except NotFoundError:
             return False
 
@@ -159,6 +202,7 @@ class ApiWriter:
         server enforces budgets (the real Eviction API contract); we
         report (evicted, blocked) from its verdicts. Pod set comes from
         the mirror — the same information a real drainer lists."""
+        self._count("drain_node")
         evicted: List[Pod] = []
         blocked: List[Pod] = []
         for pod in self.cluster.pods_by_node().get(node_name, []):
@@ -176,6 +220,7 @@ class ApiWriter:
     def teardown_node(self, node_name: str) -> None:
         """Final teardown: force-evict stragglers (grace-zero delete
         analog), remove daemonset pods with the node, delete the node."""
+        self._count("teardown_node")
         for pod in self.cluster.pods_by_node().get(node_name, []):
             try:
                 if pod.is_daemonset:
@@ -199,6 +244,7 @@ class ApiWriter:
         try:
             with trace.span("kube.bind_pod", pod=pod_name, node=node_name):
                 self.kube.bind_pod(pod_name, node_name)
+            self._count("bind_pod")
             return True
         except (ConflictError, NotFoundError):
             return False
@@ -208,6 +254,7 @@ class ApiWriter:
         controller analog); the mirror converges via the pvcs informer."""
         if not zone:
             return
+        self._count("bind_volumes")
         pod = self.cluster.pods.get(pod_name)
         if pod is None:
             return
@@ -220,4 +267,5 @@ class ApiWriter:
                     pass
 
     def delete_lease(self, name: str) -> None:
+        self._count("delete_lease")
         self.kube.delete_lease(name)

@@ -264,6 +264,31 @@ def wire_core_metrics(reg: Registry) -> Dict[str, _Metric]:
         "pods_unschedulable": reg.gauge(
             "karpenter_pods_unschedulable",
             "Pods the last scheduling pass could not place.", ()),
+        # every pod in exactly one phase (state/cluster.py
+        # pod_phase_counts): bound | pending | nominated | deleting —
+        # refreshed by the state sync pump and after every provisioning
+        # pass, so the /metrics view of pod state matches /debug/statusz
+        "pods_state": reg.gauge(
+            "karpenter_pods_state",
+            "Pods tracked by cluster state, by phase (bound | pending | "
+            "nominated | deleting).", ("phase",)),
+        # info-style gauge (value always 1; the payload is the labels) —
+        # the standard *_build_info pattern dashboards join on
+        "build_info": reg.gauge(
+            "karpenter_build_info",
+            "Build/runtime info (constant 1; labels carry the payload).",
+            ("version", "jax_version", "backend")),
+        # rolling SLO burn against the paper's bars
+        # (introspect/slo.py): >1.0 means the window is violating
+        # the 200 ms p50 latency / 2% FFD-referee cost budget
+        "slo_latency_burn": reg.gauge(
+            "karpenter_slo_latency_budget_burn",
+            "Rolling-window p50 end-to-end provision latency over the "
+            "200 ms budget (burn > 1.0 = out of SLO).", ()),
+        "slo_cost_burn": reg.gauge(
+            "karpenter_slo_cost_budget_burn",
+            "Rolling-window solve cost regression vs the FFD referee "
+            "over the 2% budget (burn > 1.0 = out of SLO).", ()),
         # the solver degradation ladder (docs/concepts/degradation.md):
         # device solve → wave-split → host FFD. Operators alarm on the
         # degraded counter; the wave histogram shows how often the group
@@ -368,6 +393,175 @@ def wire_lattice_metrics(reg: Registry) -> Dict[str, Gauge]:
             "instance type, capacity type, and zone.",
             ("instance_type", "capacity_type", "zone")),
     }
+
+
+# ---- wire-format lint (promtool-style) ------------------------------------
+
+_METRIC_NAME_RE = None   # compiled lazily in lint_exposition
+_SAMPLE_RE = None
+_LABEL_RE = None
+
+
+def lint_exposition(text: str) -> List[str]:
+    """Promtool-style lint of a classic text-format exposition.
+
+    Returns a list of problem strings (empty = clean). Enforced, in the
+    spirit of `promtool check metrics` plus the scrape-safety rules this
+    repo's exemplar-comment rendering depends on:
+
+    - every sample's family declares ``# HELP`` then ``# TYPE`` (in that
+      order, once each) BEFORE its first sample; TYPE is a known kind
+    - family sample blocks are contiguous (no interleaving) — the
+      ordering real scrapers rely on for streaming parses
+    - sample lines parse: valid metric/label names, correctly escaped
+      label values, a float-parseable value; no duplicate series
+    - histogram families: ``le`` upper bounds strictly increase, bucket
+      counts are monotonically non-decreasing, the ``+Inf`` bucket exists
+      and AGREES with ``_count``, and ``_sum``/``_count`` are present
+    - comment lines other than HELP/TYPE (e.g. the ``# exemplar`` lines
+      tracing attaches after ``+Inf``) must stay scrape-safe: they start
+      with ``# `` and never shadow a HELP/TYPE declaration
+    """
+    import re
+    global _METRIC_NAME_RE, _SAMPLE_RE, _LABEL_RE
+    if _METRIC_NAME_RE is None:
+        _METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+        _SAMPLE_RE = re.compile(
+            r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)(?:\s+(-?\d+))?$")
+        _LABEL_RE = re.compile(
+            r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\["\\n])*)"')
+    problems: List[str] = []
+    helps: Dict[str, str] = {}
+    types: Dict[str, str] = {}
+    seen_series: set = set()
+    block_order: List[str] = []   # family per contiguous sample block
+    # family -> {series key -> (labels, value)} for histogram agreement
+    hist_samples: Dict[str, List[Tuple[str, Dict[str, str], float]]] = {}
+
+    def family_of(name: str) -> str:
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in types:
+                return name[: -len(suffix)]
+        return name
+
+    for ln, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] in ("HELP", "TYPE"):
+                name = parts[2]
+                if not _METRIC_NAME_RE.match(name):
+                    problems.append(f"line {ln}: bad metric name {name!r}")
+                    continue
+                if parts[1] == "HELP":
+                    if name in helps:
+                        problems.append(f"line {ln}: duplicate HELP {name}")
+                    if name in types:
+                        problems.append(
+                            f"line {ln}: HELP {name} after its TYPE")
+                    helps[name] = parts[3] if len(parts) > 3 else ""
+                else:
+                    kind = parts[3].strip() if len(parts) > 3 else ""
+                    if kind not in ("counter", "gauge", "histogram",
+                                    "summary", "untyped"):
+                        problems.append(
+                            f"line {ln}: TYPE {name} unknown kind {kind!r}")
+                    if name in types:
+                        problems.append(f"line {ln}: duplicate TYPE {name}")
+                    if name not in helps:
+                        problems.append(f"line {ln}: TYPE {name} has no "
+                                        "preceding HELP")
+                    types[name] = kind
+            elif not line.startswith("# "):
+                problems.append(f"line {ln}: comment without '# ' prefix "
+                                "is not scrape-safe")
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            problems.append(f"line {ln}: unparseable sample {line!r}")
+            continue
+        name, labelstr, value = m.group(1), m.group(2), m.group(3)
+        labels: Dict[str, str] = {}
+        if labelstr:
+            matched = _LABEL_RE.findall(labelstr)
+            # reconstruction check: every byte of the label block must be
+            # consumed by well-formed pairs (catches unescaped quotes /
+            # backslashes that a lenient findall would silently skip)
+            rebuilt = ",".join(f'{k}="{v}"' for k, v in matched)
+            if rebuilt != labelstr.rstrip(","):
+                problems.append(
+                    f"line {ln}: malformed/unescaped labels {labelstr!r}")
+                continue
+            labels = dict(matched)
+        try:
+            val = float(value)
+        except ValueError:
+            problems.append(f"line {ln}: unparseable value {value!r}")
+            continue
+        fam = family_of(name)
+        if fam not in types:
+            problems.append(f"line {ln}: sample {name} has no TYPE")
+        elif types[fam] == "histogram":
+            if name == fam:
+                problems.append(f"line {ln}: histogram {fam} exposes a "
+                                "bare sample (want _bucket/_sum/_count)")
+            hist_samples.setdefault(fam, []).append((name, labels, val))
+        series = (name, tuple(sorted(labels.items())))
+        if series in seen_series:
+            problems.append(f"line {ln}: duplicate series {name}"
+                            f"{dict(labels)}")
+        seen_series.add(series)
+        if not block_order or block_order[-1] != fam:
+            block_order.append(fam)
+    for i, fam in enumerate(block_order):
+        if fam in block_order[:i]:
+            problems.append(f"family {fam}: sample block is not contiguous")
+            break
+    # histogram agreement per series (labels minus le)
+    for fam, samples in hist_samples.items():
+        groups: Dict[Tuple, Dict[str, object]] = {}
+        for name, labels, val in samples:
+            key = tuple(sorted((k, v) for k, v in labels.items()
+                               if k != "le"))
+            g = groups.setdefault(key, {"buckets": [], "sum": None,
+                                        "count": None})
+            if name.endswith("_bucket"):
+                le = labels.get("le")
+                if le is None:
+                    problems.append(f"{fam}: bucket without le {labels}")
+                    continue
+                g["buckets"].append((float(le), val))
+            elif name.endswith("_sum"):
+                g["sum"] = val
+            elif name.endswith("_count"):
+                g["count"] = val
+        for key, g in groups.items():
+            buckets = g["buckets"]
+            lbl = dict(key)
+            if not buckets:
+                continue
+            les = [le for le, _ in buckets]
+            if les != sorted(les):
+                problems.append(f"{fam}{lbl}: le bounds out of order")
+            if len(set(les)) != len(les):
+                problems.append(f"{fam}{lbl}: duplicate le bounds")
+            counts = [c for _, c in sorted(buckets)]
+            if any(b > a for a, b in zip(counts[1:], counts)):
+                problems.append(f"{fam}{lbl}: bucket counts decrease")
+            if not any(le == float("inf") for le in les):
+                problems.append(f"{fam}{lbl}: missing +Inf bucket")
+            else:
+                inf_count = dict(buckets)[float("inf")]
+                if g["count"] is not None and inf_count != g["count"]:
+                    problems.append(
+                        f"{fam}{lbl}: +Inf bucket {inf_count} != _count "
+                        f"{g['count']}")
+            if g["sum"] is None:
+                problems.append(f"{fam}{lbl}: missing _sum")
+            if g["count"] is None:
+                problems.append(f"{fam}{lbl}: missing _count")
+    return problems
 
 
 def emit_lattice_gauges(gauges: Dict[str, Gauge], lattice,

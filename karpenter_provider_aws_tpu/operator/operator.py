@@ -125,6 +125,12 @@ class Operator:
         self._pool_status_cache: Dict[str, Dict[str, str]] = {}
         self.unavailable = UnavailableOfferings(self.clock)
         self.cluster = ClusterState(self.clock)
+        # SLO burn tracking against the paper's bars (introspect/slo.py):
+        # the provisioner records pass latencies + sampled FFD-referee
+        # cost ratios; emit_gauges drives the rolling-window decision
+        from ..introspect import SloTracker
+        self.slo = SloTracker(self.clock, recorder=self.recorder,
+                              metrics=self.metrics)
         self.node_pools: Dict[str, NodePool] = {p.name: p for p in pool_list}
         # cross-object config validation (single-valued os, os-vs-ami-
         # family, storage-config-vs-lattice): programmatically-passed
@@ -173,7 +179,8 @@ class Operator:
                 synced_gauge=self.metrics.gauge(
                     "karpenter_cluster_state_synced"),
                 config_guard=self._validate_pool_config,
-                recorder=self.recorder)
+                recorder=self.recorder,
+                pods_state_gauge=self.metrics.get("karpenter_pods_state"))
             self.sync.sync_once()   # initial list: config + state hydrated
         else:
             from ..kube.writer import DirectWriter
@@ -216,7 +223,7 @@ class Operator:
             self.unavailable, self.recorder, self.clock,
             batch_idle_seconds=self.options.batch_idle_duration,
             batch_max_seconds=self.options.batch_max_duration,
-            metrics=self.metrics, writer=self.writer)
+            metrics=self.metrics, writer=self.writer, slo=self.slo)
         self.lifecycle = LifecycleController(
             self.cluster, self.cloud_provider, self.recorder, self.clock,
             registration_delay=self.options.registration_delay,
@@ -268,6 +275,64 @@ class Operator:
                 self.interruption_queue, self.cluster, self.termination,
                 self.unavailable, self.recorder, self.clock, self.metrics)
         self._last_cache_cleanup = 0.0
+        self._wire_introspection()
+
+    def _wire_introspection(self) -> None:
+        """Register every stateful subsystem's stats() with the
+        process-wide introspection registry (docs/reference/
+        introspection.md) and publish this operator's sampler for the
+        /debug/statusz + /debug/vars surfaces. Registration is
+        replace-by-name, so rebuilding an Operator in the same process
+        (every test does) swaps the providers instead of leaking them."""
+        from .. import introspect, trace
+        reg = introspect.registry()
+        reg.register("cluster", self.cluster.stats)
+        reg.register("solver", self.solver.stats)
+        reg.register("provisioner", self.provisioner.stats)
+        reg.register("ice_cache", self.unavailable.stats)
+        reg.register("writer", self.writer.stats)
+        reg.register("events", self.recorder.stats)
+        cp = self.cloud_provider
+        reg.register("cloud_batcher", lambda: {
+            **{"launch_" + k: v
+               for k, v in cp._launch_batcher.stats().items()},
+            **{"terminate_" + k: v
+               for k, v in cp._terminate_batcher.stats().items()}})
+        # the domain providers' TTL caches, one combined residency view
+        caches = {
+            "subnet": self.subnet_provider._cache,
+            "security_group": self.security_group_provider._cache,
+            "instance_profile": self.instance_profile_provider._cache,
+            "ami": self.ami_provider._cache,
+            "launch_template": self.launch_template_provider._cache,
+            "version": self.version_provider._cache,
+        }
+        reg.register("provider_caches", lambda: {
+            f"{name}_{k}": v
+            for name, c in caches.items()
+            for k, v in c.stats().items() if k != "ttl_seconds"})
+        if self.api_server is not None:
+            reg.register("watch_hub", self.api_server.stats)
+        reg.register("flight_recorder", lambda: (
+            trace.recorder().introspect_stats()
+            if trace.recorder() is not None else {"enabled": False}))
+        reg.register("slo", self.slo.stats)
+        # build info: the constant-1 info gauge dashboards join on
+        try:
+            import jax
+            self.metrics.get("karpenter_build_info").set(
+                1.0, version=__import__(
+                    "karpenter_provider_aws_tpu").__version__,
+                jax_version=jax.__version__,
+                backend=jax.default_backend())
+        except Exception:
+            pass   # an uninitializable backend must not fail construction
+        # wall-clock sampler (not the sim clock): the rings feed soak
+        # artifacts and kpctl top, both wall-time consumers. Started by
+        # the CLI / soak harness; sample_once() serves the deterministic
+        # stratum.
+        self.sampler = introspect.Sampler(reg)
+        introspect.set_sampler(self.sampler)
 
     def _validate_pool_config(self, pool: NodePool,
                               node_classes: Dict[str, NodeClass]):
@@ -366,6 +431,12 @@ class Operator:
         self.metrics.gauge("karpenter_cluster_state_pod_count").set(len(self.cluster.pods))
         self.metrics.gauge("karpenter_ice_cache_size").set(
             sum(1 for _ in self.unavailable.entries()))
+        # pods by phase (the state pump and the provisioner also refresh
+        # this between metrics passes) + the rolling SLO burn decision
+        self.metrics.get("karpenter_pods_state").replace(
+            {(k,): float(v)
+             for k, v in self.cluster.pod_phase_counts().items()})
+        self.slo.update()
         # pod startup latency samples observed since the last pass
         startup = self.metrics.get("karpenter_pods_startup_time_seconds")
         for s in self.cluster.drain_startup_samples():

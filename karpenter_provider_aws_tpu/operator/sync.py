@@ -32,7 +32,8 @@ class StateSync:
     def __init__(self, server: FakeAPIServer, cluster: ClusterState,
                  node_pools: Dict[str, NodePool],
                  node_classes: Dict[str, object],
-                 synced_gauge=None, config_guard=None, recorder=None):
+                 synced_gauge=None, config_guard=None, recorder=None,
+                 pods_state_gauge=None):
         """``config_guard(pool, node_classes) -> Optional[str]`` runs the
         operator's CROSS-object config validations (os-vs-amiFamily,
         storage-config-vs-lattice) on watch-delivered NodePools — per-
@@ -46,6 +47,8 @@ class StateSync:
         self._synced_gauge = synced_gauge
         self._config_guard = config_guard
         self._recorder = recorder
+        self._pods_state_gauge = pods_state_gauge
+        self._pods_state_last = float("-inf")   # wall-clock throttle
         self.informers = InformerSet(server)
         # referents before dependents: config kinds, then volumes/budgets,
         # then claims/nodes, then PODS LAST — apply_pod_spec replays
@@ -69,6 +72,17 @@ class StateSync:
         n = self.informers.sync_once()
         if self._synced_gauge is not None and self.informers.has_synced:
             self._synced_gauge.set(1.0)
+        if n and self._pods_state_gauge is not None:
+            # pod phases just moved through the watch stream: re-render
+            # karpenter_pods_state. Throttled on WALL time (the pump runs
+            # at 20 Hz in the async runtime; the phase scan is O(pods))
+            import time as _time
+            now = _time.monotonic()
+            if now - self._pods_state_last >= 0.5:
+                self._pods_state_last = now
+                self._pods_state_gauge.replace(
+                    {(k,): float(v)
+                     for k, v in self.cluster.pod_phase_counts().items()})
         return n
 
     def start(self) -> "StateSync":

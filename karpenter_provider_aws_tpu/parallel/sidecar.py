@@ -165,6 +165,11 @@ def serve(solver: Solver, address: str = "unix:/tmp/karpenter-solver.sock",
     if admission_window:
         from ..batcher import SolveWindow
         window = SolveWindow(solver)
+        # the coalescing window reports occupancy/fusion counters to the
+        # process's introspection registry (docs/reference/introspection.md)
+        from .. import introspect
+        introspect.registry().register("solve_window", window.stats)
+        introspect.registry().register("solver", solver.stats)
     server = grpc.server(ThreadPoolExecutor(max_workers=max_workers))
     server.add_generic_rpc_handlers(
         (_Handler(SolverService(solver, window=window)),))

@@ -329,6 +329,24 @@ class Solver:
         with self._solve_lock:
             self.pipeline = bool(enabled)
 
+    def stats(self) -> Dict[str, object]:
+        """Introspection snapshot (counter reads only — NEVER takes the
+        solve lock: a snapshot must not queue behind an in-flight device
+        solve, and every field is an independently-consistent counter)."""
+        out: Dict[str, object] = {
+            "pipeline": bool(self.pipeline),
+            "est_cache_entries": len(self._est_cache),
+            "b_hint_entries": len(self._b_hint),
+            "faults_injected": self.faults is not None,
+        }
+        for k, v in self.pipeline_stats.items():
+            out[k] = v
+        for k, v in self.degraded_counts.items():
+            out["degraded_" + k.replace("-", "_")] = v
+        for k, v in self._resident.stats().items():
+            out["resident_" + k] = v
+        return out
+
     _EST_CACHE_MAX = 128
     _DEVICE_RETRIES = 1          # transient device failures retried this often
     _RETRY_BACKOFF_SECONDS = 0.05

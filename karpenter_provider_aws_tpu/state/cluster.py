@@ -306,6 +306,49 @@ class ClusterState:
         with self._lock:
             return [p for p in self.pods.values() if p.is_daemonset]
 
+    def pod_phase_counts(self) -> Dict[str, int]:
+        """Every pod classified into exactly ONE phase — the
+        karpenter_pods_state{phase} gauge surface: bound (on a node),
+        deleting (unbound with a deletion timestamp), nominated (awaiting
+        a pending claim's registration), pending (awaiting capacity)."""
+        now = self._clock.now()
+        counts = {"bound": 0, "pending": 0, "nominated": 0, "deleting": 0}
+        with self._lock:
+            for pod in self.pods.values():
+                if pod.node_name is not None:
+                    counts["bound"] += 1
+                elif pod.deletion_timestamp:
+                    counts["deleting"] += 1
+                else:
+                    nom = self._nominations.get(pod.name)
+                    if nom is not None and nom.expires > now:
+                        counts["nominated"] += 1
+                    else:
+                        counts["pending"] += 1
+        return counts
+
+    def stats(self) -> Dict[str, int]:
+        """Introspection snapshot of the mirror (one lock hold, counter
+        reads + one pod scan for the phase split)."""
+        phases = self.pod_phase_counts()
+        with self._lock:
+            claims_deleting = sum(1 for c in self.claims.values()
+                                  if c.deletion_timestamp)
+            return {
+                "pods": len(self.pods),
+                "pods_bound": phases["bound"],
+                "pods_pending": phases["pending"],
+                "pods_nominated": phases["nominated"],
+                "pods_deleting": phases["deleting"],
+                "nodes": len(self.nodes),
+                "claims": len(self.claims),
+                "claims_deleting": claims_deleting,
+                "pvcs": len(self.pvcs),
+                "leases": len(self.leases),
+                "pdbs": len(self.pdbs),
+                "capacity_rev": self.capacity_rev,
+            }
+
     # ---- nodes / claims ---------------------------------------------------
 
     def touch_capacity(self) -> None:

@@ -90,6 +90,17 @@ class FlightRecorder:
         self.stats = {"started": 0, "completed": 0, "retained": 0,
                       "dropped": 0, "discarded": 0}
 
+    def introspect_stats(self) -> Dict:
+        """Introspection snapshot (``stats`` is already the raw counter
+        dict attribute): counters + live ring/retained occupancy."""
+        with self._lock:
+            out: Dict = dict(self.stats)
+            out.update({"active": len(self._active),
+                        "ring": len(self._ring),
+                        "retained_pinned": len(self._retained),
+                        "latency_budget_ms": self.latency_budget_ms})
+            return out
+
     # ---- span lifecycle (called by the tracer) ----------------------------
 
     def on_start(self, trace_id: str) -> None:

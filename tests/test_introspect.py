@@ -1,0 +1,416 @@
+"""Introspection layer tests (docs/reference/introspection.md).
+
+Covers the tentpole contracts of introspect/:
+
+- registry semantics: replace-by-name, error isolation, and the
+  lock-discipline pin — NO lock held across the stats() fan-out, and a
+  provider snapshot is O(1) work per collect (called exactly once).
+- sampler: bounded rings, numeric-only series, late-key backfill.
+- SLO tracker: burn math against the 200 ms / 2% budgets, the sustained
+  SloBudgetBurn event (fire once per episode, re-arm on recovery), and
+  the cadence-gated FFD cost referee.
+- operator wiring: every registered provider reports after a real
+  provisioning pass; pods_state/build_info/slo gauges render; statusz +
+  vars serve over live HTTP on BOTH the metrics server and the REST
+  apiserver; `kpctl top --once` renders against the live surface.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from karpenter_provider_aws_tpu import introspect
+from karpenter_provider_aws_tpu.apis import Pod
+from karpenter_provider_aws_tpu.cloud import FakeCloud
+from karpenter_provider_aws_tpu.events import Recorder
+from karpenter_provider_aws_tpu.introspect import (IntrospectRegistry,
+                                                   Sampler, SloTracker)
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+from karpenter_provider_aws_tpu.metrics import Registry, wire_core_metrics
+from karpenter_provider_aws_tpu.operator import Operator, Options
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+_FAMILIES = ("m5", "c5")
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    return build_lattice([s for s in build_catalog()
+                          if s.family in _FAMILIES])
+
+
+@pytest.fixture()
+def env(lattice):
+    clock = FakeClock()
+    return Operator(options=Options(registration_delay=1.0),
+                    lattice=lattice, cloud=FakeCloud(clock), clock=clock)
+
+
+def pods(n, cpu="500m", mem="1Gi", prefix="pod"):
+    return [Pod(name=f"{prefix}-{i}", requests={"cpu": cpu, "memory": mem})
+            for i in range(n)]
+
+
+class TestRegistry:
+    def test_replace_by_name_and_unregister(self):
+        reg = IntrospectRegistry()
+        reg.register("x", lambda: {"v": 1})
+        reg.register("x", lambda: {"v": 2})
+        assert reg.names() == ["x"]
+        assert reg.collect() == {"x": {"v": 2}}
+        reg.unregister("x")
+        assert reg.collect() == {}
+
+    def test_broken_provider_is_isolated(self):
+        reg = IntrospectRegistry()
+        reg.register("good", lambda: {"v": 1})
+        reg.register("bad", lambda: 1 / 0)
+        snap = reg.collect()
+        assert snap["good"] == {"v": 1}
+        assert "ZeroDivisionError" in snap["bad"]["error"]
+
+    def test_non_dict_stats_wrap(self):
+        reg = IntrospectRegistry()
+        reg.register("scalar", lambda: 42)
+        assert reg.collect() == {"scalar": {"value": 42}}
+
+    def test_provider_called_exactly_once_per_collect(self):
+        # the O(1)-snapshot pin: one collect = one stats() call per
+        # provider, never a retry/double-render
+        calls = []
+        reg = IntrospectRegistry()
+        reg.register("counted", lambda: calls.append(1) or {"n": len(calls)})
+        reg.collect()
+        reg.collect()
+        assert len(calls) == 2
+
+    def test_no_lock_held_across_stats_fanout(self):
+        """The lock-discipline pin: while one provider's stats() is
+        BLOCKED mid-collect, register() (and the registry lock) must
+        stay available — the fan-out runs outside the lock."""
+        reg = IntrospectRegistry()
+        entered = threading.Event()
+        release = threading.Event()
+
+        def blocking_stats():
+            entered.set()
+            assert release.wait(5.0)
+            return {"ok": 1}
+
+        reg.register("blocker", blocking_stats)
+        result = {}
+        t = threading.Thread(target=lambda: result.update(reg.collect()),
+                             daemon=True)
+        t.start()
+        assert entered.wait(5.0)
+        # mid-fan-out: registration must not deadlock behind the
+        # blocked provider
+        done = threading.Event()
+
+        def try_register():
+            reg.register("late", lambda: {"late": 1})
+            done.set()
+        threading.Thread(target=try_register, daemon=True).start()
+        assert done.wait(1.0), "register() blocked during stats() fan-out"
+        release.set()
+        t.join(5.0)
+        assert result["blocker"] == {"ok": 1}
+        # the provider registered mid-collect reports from the NEXT one
+        assert "late" in reg.collect()
+
+    def test_solver_stats_never_takes_the_solve_lock(self, env):
+        """A stats() snapshot must not queue behind an in-flight device
+        solve: hold the solver lock and assert stats() still returns."""
+        got = {}
+        with env.solver._solve_lock:
+            t = threading.Thread(
+                target=lambda: got.update(env.solver.stats()), daemon=True)
+            t.start()
+            t.join(2.0)
+            assert not t.is_alive(), "Solver.stats() blocked on the " \
+                                     "solve lock"
+        assert "pipeline" in got
+
+
+class TestSampler:
+    def test_ring_bounded_and_series_aligned(self):
+        reg = IntrospectRegistry()
+        n = [0]
+
+        def stats():
+            n[0] += 1
+            return {"count": n[0], "label": "str-excluded",
+                    "flag": True}
+        reg.register("p", stats)
+        s = Sampler(reg, ring=4)
+        for _ in range(10):
+            s.sample_once()
+        series = s.series()["p"]
+        assert len(series["t"]) == 4
+        # only numerics ride the ring (bools are flags, not series)
+        assert set(series["series"]) == {"count"}
+        assert series["series"]["count"] == [7.0, 8.0, 9.0, 10.0]
+        assert s.samples_taken == 10
+
+    def test_late_key_backfills_zero(self):
+        reg = IntrospectRegistry()
+        stats = {"a": 1}
+        reg.register("p", lambda: dict(stats))
+        s = Sampler(reg, ring=8)
+        s.sample_once()
+        stats["b"] = 5
+        s.sample_once()
+        series = s.series()["p"]["series"]
+        assert series["b"] == [0.0, 5.0]
+
+    def test_thread_lifecycle(self):
+        reg = IntrospectRegistry()
+        reg.register("p", lambda: {"v": 1})
+        s = Sampler(reg, ring=16).start(interval=0.01)
+        deadline = time.monotonic() + 5.0
+        while s.samples_taken < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        s.stop()
+        assert s.samples_taken >= 3
+
+
+class TestSloTracker:
+    def _tracker(self, **kw):
+        clock = FakeClock()
+        rec = Recorder(clock)
+        reg = Registry()
+        wire_core_metrics(reg)
+        t = SloTracker(clock, recorder=rec, metrics=reg, **kw)
+        return t, clock, rec, reg
+
+    def test_latency_burn_math_and_gauges(self):
+        t, clock, _, reg = self._tracker()
+        for _ in range(10):
+            t.record_latency(0.1)     # p50 100 ms of a 200 ms budget
+        out = t.update()
+        assert out["latency_burn"] == pytest.approx(0.5)
+        assert out["latency_p50_ms"] == pytest.approx(100.0)
+        assert reg.get("karpenter_slo_latency_budget_burn").value() \
+            == pytest.approx(0.5)
+
+    def test_cost_burn_math(self):
+        t, clock, _, reg = self._tracker()
+        t.record_cost_ratio(1.04)     # 4% regression of a 2% budget
+        out = t.update()
+        assert out["cost_burn"] == pytest.approx(2.0)
+        assert reg.get("karpenter_slo_cost_budget_burn").value() \
+            == pytest.approx(2.0)
+        # a BETTER-than-referee plan (<1.0 ratio) burns nothing
+        t2, _, _, _ = self._tracker()
+        t2.record_cost_ratio(0.98)
+        assert t2.update()["cost_burn"] == 0.0
+
+    def test_window_prunes_old_samples(self):
+        t, clock, _, _ = self._tracker(window_seconds=60.0)
+        t.record_latency(1.0)
+        assert t.update()["latency_burn"] > 1.0
+        clock.step(61)
+        assert t.update()["latency_burn"] == 0.0
+
+    def test_sustained_burn_fires_once_then_rearms(self):
+        t, clock, rec, _ = self._tracker(window_seconds=1000.0,
+                                         sustain_seconds=30.0)
+        t.record_latency(0.5)         # burn 2.5
+        t.update()                    # burn starts; not yet sustained
+        assert rec.events(reason="SloBudgetBurn") == []
+        clock.step(31)
+        t.record_latency(0.5)
+        t.update()
+        events = rec.events(reason="SloBudgetBurn")
+        assert len(events) == 1
+        assert "latency" in events[0].message
+        # still burning: no re-fire within the episode
+        clock.step(31)
+        t.update()
+        assert len(rec.events(reason="SloBudgetBurn")) == 1
+        # recovery re-arms: a NEW sustained episode fires again
+        clock.step(2000)              # window empties -> burn 0
+        t.update()
+        t.record_latency(0.5)
+        t.update()
+        clock.step(31)
+        t.record_latency(0.5)
+        t.update()
+        assert len(rec.events(reason="SloBudgetBurn")) == 2
+
+    def test_cost_referee_cadence_gated(self, env):
+        """maybe_cost_referee runs the host FFD re-pack at most once per
+        referee_interval, and records a sane ratio."""
+        built = []
+        env.cluster.pods.clear()
+        for p in pods(4, prefix="ref"):
+            env.cluster.add_pod(p)
+        pending = env.cluster.pending_pods()
+        from karpenter_provider_aws_tpu.lattice.tensors import \
+            masked_view_versioned
+        lattice = masked_view_versioned(env.solver.lattice, env.unavailable)
+        plan = env.solver.solve_relaxed(pending,
+                                        list(env.node_pools.values()),
+                                        lattice)
+        assert plan.new_nodes
+
+        def builder():
+            from karpenter_provider_aws_tpu.solver.problem import \
+                build_problem
+            built.append(1)
+            return build_problem(pending, list(env.node_pools.values()),
+                                 lattice)
+        ratio = env.slo.maybe_cost_referee(plan, builder)
+        assert ratio is not None and 0.5 < ratio < 2.0
+        # within the interval: gated, the builder is never invoked
+        assert env.slo.maybe_cost_referee(plan, builder) is None
+        assert len(built) == 1
+        env.clock.step(env.slo.referee_interval + 1)
+        assert env.slo.maybe_cost_referee(plan, builder) is not None
+        assert len(built) == 2
+
+    def test_referee_failure_is_contained(self):
+        t, clock, _, _ = self._tracker()
+
+        class FakePlan:
+            new_nodes = [object()]
+            new_node_cost = 1.0
+        assert t.maybe_cost_referee(FakePlan(), lambda: 1 / 0) is None
+        assert t.referee_errors == 1
+
+
+class TestOperatorWiring:
+    def test_every_provider_reports_after_a_pass(self, env):
+        for p in pods(6):
+            env.cluster.add_pod(p)
+        env.settle(max_rounds=20)
+        snap = introspect.registry().collect()
+        for name in ("cluster", "solver", "provisioner", "ice_cache",
+                     "writer", "events", "cloud_batcher",
+                     "provider_caches", "slo", "flight_recorder"):
+            assert name in snap, f"provider {name} not registered"
+            assert "error" not in snap[name], snap[name]
+        assert snap["cluster"]["nodes"] >= 1
+        assert snap["provisioner"]["passes"] >= 1
+        assert snap["provisioner"]["last_pass_pods"] == 6
+        assert snap["writer"]["create_claim"] >= 1
+        assert snap["writer"]["bind_pod"] >= 1
+        assert snap["slo"]["latency_samples"] >= 1
+
+    def test_pods_state_and_build_info_gauges(self, env):
+        for p in pods(4, prefix="gauge"):
+            env.cluster.add_pod(p)
+        env.settle(max_rounds=20)
+        text = env.metrics.render()
+        assert 'karpenter_pods_state{phase="bound"} 4.0' in text
+        assert 'karpenter_pods_state{phase="pending"} 0.0' in text
+        assert "karpenter_build_info{" in text
+        assert 'version="' in text
+        assert "karpenter_slo_latency_budget_burn" in text
+
+    def test_statusz_and_vars_render(self, env):
+        env.sampler.sample_once()
+        sz = introspect.statusz_text()
+        assert sz.startswith("karpenter-tpu statusz")
+        assert "== cluster ==" in sz
+        doc = introspect.vars_doc(include_series=True)
+        json.dumps(doc)   # must be JSON-serializable end to end
+        assert "cluster" in doc["providers"]
+        assert "cluster" in doc["series"]
+        assert doc["sampler"]["samples"] >= 1
+
+    def test_slo_latency_recorded_by_provision_pass(self, env):
+        for p in pods(3, prefix="slo"):
+            env.cluster.add_pod(p)
+        env.provisioner.provision_once()
+        stats = env.slo.stats()
+        assert stats["latency_samples"] >= 1
+        assert stats["latency_p50_ms"] > 0
+
+
+class TestHttpSurfaces:
+    @pytest.fixture()
+    def served(self, env):
+        from karpenter_provider_aws_tpu.cli import start_server
+        server = start_server(env, 0)
+        yield env, f"http://127.0.0.1:{server.server_address[1]}"
+        server.shutdown()
+
+    def test_metrics_server_serves_statusz_and_vars(self, served):
+        env, base = served
+        env.sampler.sample_once()
+        sz = urllib.request.urlopen(base + "/debug/statusz",
+                                    timeout=10).read().decode()
+        assert "== solver ==" in sz
+        doc = json.loads(urllib.request.urlopen(
+            base + "/debug/vars?series=1", timeout=10).read())
+        assert set(introspect.registry().names()) <= set(doc["providers"])
+        assert "series" in doc
+        lean = json.loads(urllib.request.urlopen(
+            base + "/debug/vars", timeout=10).read())
+        assert "series" not in lean   # rings only on request
+
+    def test_rest_apiserver_serves_debug_routes(self, lattice):
+        from karpenter_provider_aws_tpu.kube import FakeAPIServer
+        from karpenter_provider_aws_tpu.kube.httpserver import serve
+        clock = FakeClock()
+        api = FakeAPIServer()
+        op = Operator(options=Options(registration_delay=1.0),
+                      lattice=lattice, cloud=FakeCloud(clock), clock=clock,
+                      api_server=api)
+        httpd = serve(api, 0)
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            sz = urllib.request.urlopen(base + "/debug/statusz",
+                                        timeout=10).read().decode()
+            assert "== watch_hub ==" in sz   # API mode registers the hub
+            doc = json.loads(urllib.request.urlopen(
+                base + "/debug/vars", timeout=10).read())
+            assert doc["providers"]["watch_hub"]["watchers"] >= 0
+        finally:
+            httpd.shutdown()
+
+    def test_kpctl_top_tolerates_errored_provider(self, monkeypatch):
+        """A provider reporting the registry's {"error": ...} shape drops
+        its row's details instead of crashing the view."""
+        import pathlib
+        monkeypatch.syspath_prepend(str(
+            pathlib.Path(__file__).resolve().parent.parent / "tools"))
+        import kpctl
+        doc = {"providers": {"writer": {"error": "RuntimeError: boom"},
+                             "cluster": {"error": "RuntimeError: boom"}}}
+        lines = kpctl._render_top(doc, "srv")
+        assert any(line.startswith("WRITER") for line in lines)
+
+    def test_debug_routes_carry_server_time(self, lattice):
+        """The PR 2 invariant holds on the new mounts: every apiserver
+        response — /debug/vars included — carries X-Server-Time."""
+        from karpenter_provider_aws_tpu.kube import FakeAPIServer
+        from karpenter_provider_aws_tpu.kube.httpserver import serve
+        api = FakeAPIServer()
+        httpd = serve(api, 0)
+        try:
+            resp = urllib.request.urlopen(
+                f"http://127.0.0.1:{httpd.server_address[1]}/debug/vars",
+                timeout=10)
+            assert float(resp.headers["X-Server-Time"]) > 0
+        finally:
+            httpd.shutdown()
+
+    def test_kpctl_top_once_renders(self, served, capsys, monkeypatch):
+        import pathlib
+        monkeypatch.syspath_prepend(str(
+            pathlib.Path(__file__).resolve().parent.parent / "tools"))
+        import kpctl
+        env, base = served
+        for p in pods(2, prefix="top"):
+            env.cluster.add_pod(p)
+        env.settle(max_rounds=20)
+        rc = kpctl.main(["--server", base, "top", "--once"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "CLUSTER" in out and "SOLVER" in out and "SLO" in out
+        assert "latency burn" in out

@@ -1,0 +1,192 @@
+"""Metrics wire-format conformance (promtool-style lint).
+
+Two layers:
+
+- the linter itself (metrics.lint_exposition) catches each class of
+  corruption the classic text format can suffer: HELP/TYPE pairing and
+  ordering, unknown kinds, label escaping, duplicate series,
+  non-contiguous family blocks, histogram bucket monotonicity, missing
+  +Inf, +Inf/_count disagreement, missing _sum/_count;
+- a LIVE scrape of a running operator's /metrics — exercised through
+  real provisioning activity, with tracing exemplars attached — passes
+  the lint clean, including the `# exemplar` comment lines staying
+  scrape-safe.
+"""
+
+import urllib.request
+
+import pytest
+
+from karpenter_provider_aws_tpu import trace
+from karpenter_provider_aws_tpu.apis import Pod
+from karpenter_provider_aws_tpu.cloud import FakeCloud
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+from karpenter_provider_aws_tpu.metrics import (Registry, lint_exposition,
+                                                wire_core_metrics)
+from karpenter_provider_aws_tpu.operator import Operator, Options
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+_FAMILIES = ("m5", "c5")
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    return build_lattice([s for s in build_catalog()
+                          if s.family in _FAMILIES])
+
+
+class TestLinter:
+    def test_clean_document_passes(self):
+        doc = "\n".join([
+            "# HELP my_counter_total A counter.",
+            "# TYPE my_counter_total counter",
+            'my_counter_total{op="a"} 3.0',
+            'my_counter_total{op="b"} 1.0',
+            "# HELP my_hist A histogram.",
+            "# TYPE my_hist histogram",
+            'my_hist_bucket{le="0.1"} 1',
+            'my_hist_bucket{le="1.0"} 3',
+            'my_hist_bucket{le="+Inf"} 4',
+            "my_hist_sum 2.5",
+            "my_hist_count 4",
+        ]) + "\n"
+        assert lint_exposition(doc) == []
+
+    def test_sample_without_type(self):
+        assert any("no TYPE" in p
+                   for p in lint_exposition("orphan_series 1.0\n"))
+
+    def test_help_after_type_and_duplicates(self):
+        doc = ("# TYPE m gauge\n"
+               "# HELP m late help\n"
+               "# TYPE m gauge\n"
+               "m 1\n")
+        probs = lint_exposition(doc)
+        assert any("no preceding HELP" in p for p in probs)
+        assert any("after its TYPE" in p for p in probs)
+        assert any("duplicate TYPE" in p for p in probs)
+
+    def test_unknown_kind(self):
+        doc = "# HELP m x\n# TYPE m enum\nm 1\n"
+        assert any("unknown kind" in p for p in lint_exposition(doc))
+
+    def test_unescaped_label_value(self):
+        doc = ("# HELP m x\n# TYPE m gauge\n"
+               'm{l="a"b"} 1\n')
+        assert any("malformed/unescaped" in p for p in lint_exposition(doc))
+
+    def test_escaped_label_value_is_fine(self):
+        doc = ("# HELP m x\n# TYPE m gauge\n"
+               'm{l="a\\"b",m="c\\\\d"} 1\n')
+        assert lint_exposition(doc) == []
+
+    def test_duplicate_series(self):
+        doc = ("# HELP m x\n# TYPE m gauge\n"
+               'm{l="a"} 1\nm{l="a"} 2\n')
+        assert any("duplicate series" in p for p in lint_exposition(doc))
+
+    def test_non_contiguous_family_blocks(self):
+        doc = ("# HELP a x\n# TYPE a gauge\n"
+               "# HELP b x\n# TYPE b gauge\n"
+               "a 1\nb 1\na 2\n")
+        probs = lint_exposition(doc)
+        assert any("not contiguous" in p for p in probs)
+
+    def test_histogram_bucket_counts_decrease(self):
+        doc = ("# HELP h x\n# TYPE h histogram\n"
+               'h_bucket{le="0.1"} 5\n'
+               'h_bucket{le="1.0"} 3\n'
+               'h_bucket{le="+Inf"} 5\n'
+               "h_sum 1\nh_count 5\n")
+        assert any("counts decrease" in p for p in lint_exposition(doc))
+
+    def test_histogram_missing_inf(self):
+        doc = ("# HELP h x\n# TYPE h histogram\n"
+               'h_bucket{le="0.1"} 1\n'
+               "h_sum 1\nh_count 1\n")
+        assert any("+Inf" in p for p in lint_exposition(doc))
+
+    def test_histogram_inf_count_disagreement(self):
+        doc = ("# HELP h x\n# TYPE h histogram\n"
+               'h_bucket{le="+Inf"} 4\n'
+               "h_sum 1\nh_count 5\n")
+        assert any("!= _count" in p for p in lint_exposition(doc))
+
+    def test_histogram_missing_sum_count(self):
+        doc = ("# HELP h x\n# TYPE h histogram\n"
+               'h_bucket{le="+Inf"} 4\n')
+        probs = lint_exposition(doc)
+        assert any("missing _sum" in p for p in probs)
+        assert any("missing _count" in p for p in probs)
+
+    def test_bare_histogram_sample(self):
+        doc = ("# HELP h x\n# TYPE h histogram\n"
+               "h 4\n"
+               'h_bucket{le="+Inf"} 4\nh_sum 1\nh_count 4\n')
+        assert any("bare sample" in p for p in lint_exposition(doc))
+
+    def test_unparseable_value_and_line(self):
+        doc = ("# HELP m x\n# TYPE m gauge\n"
+               "m notanumber\n"
+               "!!garbage!!\n")
+        probs = lint_exposition(doc)
+        assert any("unparseable value" in p for p in probs)
+        assert any("unparseable sample" in p for p in probs)
+
+    def test_comment_without_space_flagged(self):
+        doc = "#HELPish something\n"
+        assert any("scrape-safe" in p for p in lint_exposition(doc))
+
+    def test_exemplar_comment_lines_are_scrape_safe(self):
+        """The tracing exemplar rendering: a `# exemplar ...` line after
+        +Inf is a comment, invisible to the lint's sample parser."""
+        reg = Registry()
+        m = wire_core_metrics(reg)
+        m["solver_stage_duration"].observe(0.01, exemplar="deadbeef",
+                                           stage="compute")
+        text = reg.render()
+        assert "# exemplar" in text
+        assert lint_exposition(text) == []
+
+
+class TestLiveScrape:
+    def test_live_operator_scrape_is_clean(self, lattice):
+        """promtool-style lint over a REAL /metrics scrape: operator with
+        tracing on (exemplar comment lines included), pods provisioned,
+        served over live HTTP."""
+        from karpenter_provider_aws_tpu.cli import start_server
+        clock = FakeClock()
+        op = Operator(options=Options(registration_delay=1.0),
+                      lattice=lattice, cloud=FakeCloud(clock), clock=clock)
+        trace.enable()
+        try:
+            for i in range(5):
+                op.cluster.add_pod(Pod(name=f"lint-{i}",
+                                       requests={"cpu": "500m",
+                                                 "memory": "1Gi"}))
+            op.settle(max_rounds=20)
+            server = start_server(op, 0)
+            try:
+                text = urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.server_address[1]}/metrics",
+                    timeout=10).read().decode()
+            finally:
+                server.shutdown()
+        finally:
+            trace.disable()
+        assert "karpenter_solver_stage_duration_seconds_bucket" in text
+        assert "# exemplar" in text      # tracing attached one
+        assert lint_exposition(text) == []
+
+    def test_registry_render_always_lints_clean(self, lattice):
+        """The renderer/linter pair is a standing contract: whatever the
+        full wired registry renders must pass its own lint."""
+        clock = FakeClock()
+        op = Operator(options=Options(registration_delay=1.0),
+                      lattice=lattice, cloud=FakeCloud(clock), clock=clock)
+        for i in range(3):
+            op.cluster.add_pod(Pod(name=f"rr-{i}",
+                                   requests={"cpu": "250m",
+                                             "memory": "512Mi"}))
+        op.settle(max_rounds=20)
+        assert lint_exposition(op.metrics.render()) == []

@@ -1,8 +1,9 @@
 #!/usr/bin/env bash
-# One-command CI gate: generated-artifact drift, tier-1 tests, bench smoke.
+# One-command CI gate: generated-artifact drift, introspection smoke,
+# tier-1 tests, bench smoke.
 #
 #     bash tools/ci.sh            # the full gate (exit != 0 on any failure)
-#     bash tools/ci.sh --fast     # drift check + tier-1 only (skip bench)
+#     bash tools/ci.sh --fast     # drift + smoke + tier-1 only (skip bench)
 #
 # Mirrors what the reference's `make presubmit` (verify + test) gates:
 #
@@ -11,8 +12,13 @@
 #               (the codegen-lockstep contract tests/test_schema.py and
 #               tests/test_tools.py also assert, surfaced here as its own
 #               gate so a red run names the stale file directly)
-#   2. tier-1 — the full non-slow test suite on the CPU backend
-#   3. bench  — `bench.py --smoke`: one fast config through the real
+#   2. smoke  — introspection + metrics wire format: start an operator,
+#               assert /debug/statusz and /debug/vars parse with every
+#               registered provider reporting, and run the promtool-style
+#               lint over the live /metrics scrape
+#               (tools/smoke_introspect.py)
+#   3. tier-1 — the full non-slow test suite on the CPU backend
+#   4. bench  — `bench.py --smoke`: one fast config through the real
 #               harness, so a broken solve path can never ride in on a
 #               green unit-test run
 
@@ -24,7 +30,7 @@ PY=${PYTHON:-python}
 FAST=0
 [ "${1:-}" = "--fast" ] && FAST=1
 
-echo "=== ci [1/3] generated-artifact drift ==="
+echo "=== ci [1/4] generated-artifact drift ==="
 $PY tools/gen_crds.py --check
 tmp=$(mktemp -d)
 trap 'rm -rf "$tmp"' EXIT
@@ -39,14 +45,17 @@ done
 [ "$stale" = 0 ] || exit 1
 echo "drift: clean"
 
-echo "=== ci [2/3] tier-1 tests ==="
+echo "=== ci [2/4] introspection smoke + metrics lint ==="
+$PY tools/smoke_introspect.py
+
+echo "=== ci [3/4] tier-1 tests ==="
 $PY -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider
 
 if [ "$FAST" = 1 ]; then
-    echo "=== ci [3/3] bench smoke: SKIPPED (--fast) ==="
+    echo "=== ci [4/4] bench smoke: SKIPPED (--fast) ==="
 else
-    echo "=== ci [3/3] bench smoke ==="
+    echo "=== ci [4/4] bench smoke ==="
     $PY bench.py --smoke
 fi
 

@@ -478,6 +478,109 @@ def cmd_trace(c: Client, args) -> int:
     raise SystemExit(f"unknown trace action {args.action!r}")
 
 
+def _fmt_ms(ms) -> str:
+    try:
+        return f"{float(ms):.1f}ms"
+    except (TypeError, ValueError):
+        return "-"
+
+
+def _render_top(doc, server: str):
+    """One frame of `kpctl top` from a /debug/vars document. Providers
+    the control plane hasn't registered (direct mode has no watch hub;
+    tracing may be off) simply drop their row's details."""
+    p = doc.get("providers", {})
+
+    def g(provider, key, default=0):
+        return p.get(provider, {}).get(key, default)
+
+    lines = [f"kpctl top — {server}   uptime "
+             f"{doc.get('uptimeSeconds', 0):.0f}s   "
+             f"providers {len(p)}", ""]
+    lines.append(
+        f"CLUSTER   nodes {g('cluster', 'nodes'):g}   "
+        f"pods {g('cluster', 'pods'):g} "
+        f"({g('cluster', 'pods_pending'):g} pending, "
+        f"{g('cluster', 'pods_nominated'):g} nominated)   "
+        f"claims {g('cluster', 'claims'):g} "
+        f"({g('cluster', 'claims_deleting'):g} deleting)")
+    degraded = sum(v for k, v in p.get("solver", {}).items()
+                   if k.startswith("degraded_") and isinstance(v, (int, float)))
+    lines.append(
+        f"SOLVER    passes {g('provisioner', 'passes'):g}   "
+        f"last {_fmt_ms(g('provisioner', 'last_pass_solve_ms', None))} "
+        f"({g('provisioner', 'last_pass_pods'):g} pods)   "
+        f"pipeline {'on' if g('solver', 'pipeline') else 'off'}   "
+        f"async {g('solver', 'async_solves'):g}   "
+        f"degraded {degraded:g}")
+    rh, rm = g("solver", "resident_hits"), g("solver", "resident_misses")
+    hitpct = 100.0 * rh / (rh + rm) if (rh + rm) else 0.0
+    lines.append(
+        f"CACHES    resident {hitpct:.0f}% hit ({rh:g}/{rh + rm:g})   "
+        f"ICE {g('ice_cache', 'live'):g}   "
+        f"est-cache {g('solver', 'est_cache_entries'):g}")
+    lines.append(
+        f"BATCH     window {g('provisioner', 'batch_pending'):g} pods "
+        f"({g('provisioner', 'batch_age_seconds'):g}s)   "
+        f"cloud drains {g('cloud_batcher', 'launch_batches'):g} launch / "
+        f"{g('cloud_batcher', 'terminate_batches'):g} terminate")
+    writer = p.get("writer", {})
+    # numeric values only: a provider that errored reports {"error": str}
+    # and must drop its row's details, not crash the view
+    top_verbs = sorted(((k, v) for k, v in writer.items()
+                        if isinstance(v, (int, float))),
+                       key=lambda kv: -kv[1])[:4]
+    lines.append("WRITER    " + ("   ".join(f"{k} {v:g}"
+                                            for k, v in top_verbs)
+                                 or "(no writes yet)"))
+    if "watch_hub" in p:
+        lines.append(
+            f"WATCHES   {g('watch_hub', 'watchers'):g} watchers   "
+            f"queue {g('watch_hub', 'watch_queue_depth'):g}   "
+            f"delivered {g('watch_hub', 'events_emitted'):g}")
+    lines.append(
+        f"EVENTS    {g('events', 'published'):g} published "
+        f"({g('events', 'warnings'):g} warnings)")
+    slo = p.get("slo", {})
+    lines.append(
+        f"SLO       latency burn {slo.get('latency_burn', 0):.2f} "
+        f"(p50 {_fmt_ms(slo.get('latency_p50_ms'))} / "
+        f"{slo.get('latency_budget_ms', 200):g}ms)   "
+        f"cost burn {slo.get('cost_burn', 0):.2f} "
+        f"(ratio {slo.get('cost_ratio_p50', 0):.4f})")
+    fr = p.get("flight_recorder", {})
+    if fr.get("enabled", True) is not False:
+        lines.append(
+            f"TRACES    started {fr.get('started', 0):g}   "
+            f"retained {fr.get('retained', 0):g}")
+    return lines
+
+
+def cmd_top(c: Client, args) -> int:
+    """Live terminal view of /debug/vars (docs/reference/introspection.md):
+    nodes / pending pods / solver cadence / queue depths / cache hit
+    rates, refreshed in place. ``--once`` prints a single frame (tests,
+    scripting, piping)."""
+    import time
+    while True:
+        # Ctrl-C can land mid-request just as easily as mid-sleep: the
+        # whole iteration exits cleanly, never a traceback over the
+        # cleared screen
+        try:
+            doc = c.request("GET", "/debug/vars")
+            frame = "\n".join(_render_top(doc, c.server))
+            if args.once:
+                print(frame)
+                return 0
+            # clear + home, then one frame — flicker-free enough for a
+            # status view without a curses dependency
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
 def cmd_evict(c: Client, args) -> int:
     force = "?force=1" if args.force else ""
     try:
@@ -543,6 +646,15 @@ def main(argv=None) -> int:
 
     ar = sub.add_parser("api-resources")
     ar.set_defaults(fn=cmd_api_resources)
+
+    tp = sub.add_parser(
+        "top", help="live subsystem view against /debug/vars "
+                    "(docs/reference/introspection.md)")
+    tp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period in seconds (default 2)")
+    tp.add_argument("--once", action="store_true",
+                    help="print one frame and exit (scripting/tests)")
+    tp.set_defaults(fn=cmd_top)
 
     tr = sub.add_parser(
         "trace", help="flight-recorder traces (requires --trace on the "

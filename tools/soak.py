@@ -278,12 +278,20 @@ def main(argv=None) -> int:
               f"{'OK' if agree else 'VIOLATED'} "
               f"(pods {len(server_pods)}, nodes {len(server_nodes)})")
         ok = ok and agree
+    # the SLO burn verdict over the whole run (introspect/slo.py — the
+    # same gauges /metrics exports and the Monitor artifact carries)
+    slo = op.slo.update()
+    print(f"soak: slo latency_burn={slo['latency_burn']} "
+          f"(p50 {slo['latency_p50_ms']}ms / 200ms) "
+          f"cost_burn={slo['cost_burn']} "
+          f"(ratio_p50 {slo['cost_ratio_p50']})")
     if args.out:
         monitor.write(args.out)
         print(f"soak: time series -> {args.out} "
               f"({len(monitor.samples)} samples, "
               f"peak_nodes={monitor.summary().get('peak_nodes')}, "
-              f"peak_cost/hr={monitor.summary().get('peak_cost_per_hour')})")
+              f"peak_cost/hr={monitor.summary().get('peak_cost_per_hour')}, "
+              f"peak_latency_burn={monitor.summary().get('peak_latency_burn')})")
     print("soak: INVARIANTS " + ("OK" if ok else "VIOLATED"))
     if not ok:
         print(dump_state(op))

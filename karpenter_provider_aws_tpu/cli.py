@@ -88,13 +88,29 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Port serving /metrics, /healthz, /readyz "
                         "(0 disables).")
     p.add_argument("--warm-start", action="store_true",
-                   help="Precompile the warm (G,B) solver bucket set on a "
+                   help="Compile the boot (G,B) solver bucket ladder on a "
                         "background thread at startup (XLA charges 20-40s "
                         "per shape on first trace; without this the first "
-                        "pending-pod batch pays it). Covers the configured "
-                        "pool count with no affinity classes; workloads "
-                        "that add hostname-affinity classes or custom-label "
-                        "virtual pools compile their shapes on first use")
+                        "pending-pod batch pays it). With "
+                        "--compile-cache-dir set, shapes are AOT-lowered "
+                        "and compiled without executing (the first real "
+                        "solve loads them from the persistent cache); "
+                        "otherwise each shape executes once to warm jit's "
+                        "dispatch cache. The SLO tracker holds its warmup "
+                        "window open until the ladder finishes so a cold "
+                        "first pass cannot fire a SloBudgetBurn. Covers "
+                        "the configured pool count with no affinity "
+                        "classes; workloads that add hostname-affinity "
+                        "classes or custom-label virtual pools compile "
+                        "their shapes on first use")
+    p.add_argument("--compile-cache-dir", default=None,
+                   help="Directory for JAX's persistent compilation cache "
+                        "(env COMPILE_CACHE_DIR): compiled bucket-ladder "
+                        "executables survive operator restarts, so a "
+                        "SECOND boot pays no fresh XLA compile at all — "
+                        "pair with --warm-start to also keep the FIRST "
+                        "boot's compiles off the serving path. Empty "
+                        "disables (in-memory jit cache only).")
     p.add_argument("--profile-dir", default=None,
                    help="Write a JAX profiler (xprof) trace of every device "
                         "solve under this directory.")
@@ -197,6 +213,8 @@ def options_from_args(args: argparse.Namespace) -> Options:
         overrides["termination_grace_period"] = args.termination_grace_period
     if args.solver_address is not None:
         overrides["solver_address"] = args.solver_address
+    if args.compile_cache_dir is not None:
+        overrides["compile_cache_dir"] = args.compile_cache_dir
     for gate in (args.feature_gates or "").split(","):
         gate = gate.strip()
         if not gate:
@@ -446,8 +464,20 @@ def main(argv: Optional[Sequence[str]] = None,
         from .parallel.sidecar import serve as serve_sidecar
         sidecar = serve_sidecar(op.solver, args.sidecar_address)
     if args.warm_start:
+        # the SLO warmup window opens NOW and closes when the AOT ladder
+        # finishes: latency recorded while shapes still compile is boot
+        # cost, not steady-state burn (introspect/slo.py)
+        op.slo.begin_warmup()
+        # AOT (compile-without-execute) ONLY pays off when the compiled
+        # executables land somewhere the first real solve can load them
+        # — the persistent cache; without it the executing path is what
+        # actually warms jit's dispatch cache
         op.solver.warmup(node_pools_count=len(op.node_pools),
-                         probes=True, background=True)
+                         g_buckets=op.solver.BOOT_G_BUCKETS,
+                         b_buckets=op.solver.BOOT_B_BUCKETS,
+                         probes=True, background=True,
+                         aot=bool(opts.compile_cache_dir),
+                         on_done=op.slo.end_warmup)
     if args.profile_dir:
         op.solver.start_profiling(args.profile_dir)
     deadline = (time.monotonic() + args.duration) if args.duration > 0 else None

@@ -119,6 +119,14 @@ class Provisioner:
         self.recorder = recorder or Recorder(self.clock)
         self.batch_idle_seconds = batch_idle_seconds
         self.batch_max_seconds = batch_max_seconds
+        from ..solver.incremental import IncrementalProblemBuilder
+        # the steady-state incremental path: one builder per provisioner
+        # retains the previous pass's Problem keyed at the cluster-state
+        # revision; eligible small-churn passes delta-solve instead of
+        # re-tensorizing from scratch (docs/concepts/performance.md
+        # "Steady-state reconciles & the compile cache")
+        self.inc_builder = IncrementalProblemBuilder()
+        self._delta_enabled = bool(getattr(solver, "supports_delta", False))
         m = wire_core_metrics(metrics or Registry())  # single source of truth
         self._m_sched = m["scheduling_duration"]
         self._m_sim = m["scheduling_simulation_duration"]
@@ -131,6 +139,8 @@ class Provisioner:
         self._m_solver_retries = m["solver_device_retries"]
         self._m_waves = m["solver_waves"]
         self._m_stage = m["solver_stage_duration"]
+        self._m_delta = m["solver_delta_solves"]
+        self._m_dirty_groups = m["solver_dirty_groups"]
         self._m_pods_state = m["pods_state"]
         # SLO burn tracking (introspect/slo.py): every pass records its
         # end-to-end solve latency; a sampled FFD-referee re-pack records
@@ -195,6 +205,11 @@ class Provisioner:
         return (ctxs[0] if ctxs else None), ctxs[1:]
 
     def provision_once(self) -> ProvisionResult:
+        # the revision is read BEFORE the pending snapshot: the build is
+        # keyed at rev0, so any mutation racing the snapshot (threaded
+        # stratum) lands at a rev > rev0 and is re-examined by the next
+        # pass's dirty read instead of silently falling between passes
+        rev0 = self.cluster.state_rev
         pending = self.cluster.pending_pods()
         if not pending:
             return ProvisionResult(plan=None)
@@ -202,7 +217,7 @@ class Provisioner:
                          if trace.enabled() else (None, ()))
         with trace.span("provisioner.provision", parent=parent, links=links,
                         pods=len(pending)) as sp:
-            result = self._provision(pending)
+            result = self._provision(pending, rev0)
             sp.set(degraded=result.degraded,
                    reason=result.degraded_reason,
                    launched=result.launched,
@@ -210,7 +225,8 @@ class Provisioner:
                    unschedulable=result.pods_unschedulable)
             return result
 
-    def _provision(self, pending: Sequence[Pod]) -> ProvisionResult:
+    def _provision(self, pending: Sequence[Pod],
+                   rev0: Optional[int] = None) -> ProvisionResult:
         # versioned memo: the SAME view object comes back while prices and
         # the ICE set are unchanged, so the solver's identity-keyed
         # narrowing cache hits across steady-state passes
@@ -219,14 +235,63 @@ class Provisioner:
         # one usage snapshot serves the whole pass: the initial solve's
         # headroom, every _enforce_limits round, and every retry's headroom
         pass_usage = self.cluster.pool_usage()
+        headroom = self._pool_headroom(pass_usage)
+        pools = list(self.node_pools.values())
+        # memoized thunks: the O(pods) cluster scans resolve at most once
+        # per pass, and NOT AT ALL when the incremental builder proves
+        # from the dirty journal that their inputs did not change
+        resolved: Dict[str, object] = {}
+
+        def _existing():
+            if "existing" not in resolved:
+                resolved["existing"] = self.cluster.existing_bins(lattice)
+            return resolved["existing"]
+
+        def _ds():
+            if "ds" not in resolved:
+                resolved["ds"] = self.cluster.daemonset_pods()
+            return resolved["ds"]
+
+        def _bound():
+            if "bound" not in resolved:
+                resolved["bound"] = self.cluster.bound_pods()
+            return resolved["bound"]
+
         try:
-            plan = self.solver.solve_relaxed(
-                pending, list(self.node_pools.values()), lattice,
-                existing=self.cluster.existing_bins(lattice),
-                daemonset_pods=self.cluster.daemonset_pods(),
-                bound_pods=self.cluster.bound_pods(),
-                pvcs=pvcs, storage_classes=storage_classes,
-                pool_headroom=self._pool_headroom(pass_usage))
+            if self._delta_enabled:
+                dirty = self.cluster.dirty_since(self.inc_builder.rev)
+                if rev0 is not None:
+                    # key the build at the pre-snapshot revision: journal
+                    # entries racing the pending snapshot stay > rev0 and
+                    # are re-read (idempotently) next pass
+                    dirty.rev = rev0
+                touched = (self.cluster.touched_pods(dirty.pods)
+                           if dirty.pods and not dirty.full else {})
+                build = self.inc_builder.build(
+                    pending, pools, lattice, existing=_existing,
+                    daemonset_pods=_ds, bound_pods=_bound, pvcs=pvcs,
+                    storage_classes=storage_classes,
+                    pool_headroom=headroom, dirty=dirty, touched=touched)
+                if build.incremental:
+                    # the steady-state fast path: patched problem, device-
+                    # resident inputs, dirty blocks only over the link
+                    plan = self.solver.solve_delta(
+                        build.problem, dirty_groups=build.dirty_groups)
+                    self._m_delta.inc()
+                    self._m_dirty_groups.observe(len(build.dirty_groups))
+                else:
+                    # full path; round 0 reuses the problem already built
+                    plan = self.solver.solve_relaxed(
+                        pending, pools, lattice, existing=_existing(),
+                        daemonset_pods=_ds(), bound_pods=_bound(),
+                        pvcs=pvcs, storage_classes=storage_classes,
+                        pool_headroom=headroom, problem0=build.problem)
+            else:
+                plan = self.solver.solve_relaxed(
+                    pending, pools, lattice, existing=_existing(),
+                    daemonset_pods=_ds(), bound_pods=_bound(),
+                    pvcs=pvcs, storage_classes=storage_classes,
+                    pool_headroom=headroom)
         except Exception as e:
             # the solve ladder already absorbs device failures; anything
             # that still escapes must not kill the reconcile loop. Report a
@@ -413,6 +478,11 @@ class Provisioner:
                                       if self._batch_start is not None
                                       else 0.0),
                 "passes": self.passes,
+                # the incremental problem builder's build split
+                # (solver/incremental.py; the delta-SOLVE counters ride
+                # the solver provider)
+                "incremental_builds": self.inc_builder.incremental_builds,
+                "full_builds": self.inc_builder.full_builds,
             }
             out.update({"last_pass_" + k: v
                         for k, v in self._last_pass.items()})

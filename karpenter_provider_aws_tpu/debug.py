@@ -158,11 +158,34 @@ class Monitor:
             return out
 
     def write(self, path: str) -> None:
+        """Write the artifact; a ``.gz`` suffix gzips it. Soak series
+        grew to hundreds of KB per run (SOAK_r06 is ~18k lines each) —
+        compressed artifacts keep the repo and CI uploads sane, and
+        every reader goes through load_timeseries, which takes both."""
         with self._lock:
             doc = {"samples": list(self.samples)}
         doc["summary"] = self.summary()
-        with open(path, "w") as f:
-            json.dump(doc, f, indent=1)
+        if path.endswith(".gz"):
+            import gzip
+            with gzip.open(path, "wt") as f:
+                json.dump(doc, f, separators=(",", ":"))
+        else:
+            with open(path, "w") as f:
+                json.dump(doc, f, indent=1)
+
+
+def load_timeseries(path: str) -> Dict:
+    """Read a Monitor artifact, gzipped or plain. Sniffs the gzip magic
+    rather than trusting the suffix, so renamed/downloaded artifacts
+    still load; kpctl and the analysis tooling route through here."""
+    with open(path, "rb") as f:
+        head = f.read(2)
+    if head == b"\x1f\x8b":
+        import gzip
+        with gzip.open(path, "rt") as f:
+            return json.load(f)
+    with open(path, "r") as f:
+        return json.load(f)
 
 
 def dump_state(op, max_events: int = 40) -> str:

@@ -80,12 +80,43 @@ class SloTracker:
         self._last_referee = float("-inf")
         self.referee_runs = 0
         self.referee_errors = 0
+        # explicit boot warmup window: while open, latency samples are
+        # DROPPED — a cold-compile first pass is boot cost, not steady-
+        # state SLO signal, and must not fire a SloBudgetBurn episode
+        # (SOAK_r06 recorded peak burn ~8 from exactly this). Opened by
+        # the operator when AOT warmup starts, closed by the warmup
+        # thread's on_done (with max_seconds as the crash backstop).
+        self._warmup_until = float("-inf")
+        self.warmup_dropped = 0
+
+    # ---- boot warmup window ----------------------------------------------
+
+    def begin_warmup(self, max_seconds: float = 600.0) -> None:
+        """Open the warmup window: latency recorded before end_warmup()
+        (or ``max_seconds`` from now, whichever first) is boot compile
+        cost and stays out of the burn windows."""
+        with self._lock:
+            self._warmup_until = self._clock.now() + max_seconds
+
+    def end_warmup(self) -> None:
+        """Close the warmup window (idempotent; safe from the warmup
+        thread)."""
+        with self._lock:
+            self._warmup_until = min(self._warmup_until, self._clock.now())
+
+    def warmup_active(self) -> bool:
+        return self._clock.now() < self._warmup_until
 
     # ---- recording (hot path: O(1) appends) -------------------------------
 
     def record_latency(self, seconds: float) -> None:
         with self._lock:
-            self._lat.append((self._clock.now(), float(seconds)))
+            now = self._clock.now()
+            if now < self._warmup_until:
+                # boot warmup: cold-compile passes are not SLO signal
+                self.warmup_dropped += 1
+                return
+            self._lat.append((now, float(seconds)))
 
     def record_cost_ratio(self, ratio: float) -> None:
         with self._lock:
@@ -198,5 +229,8 @@ class SloTracker:
                 "referee_errors": self.referee_errors,
                 "latency_budget_ms": self.latency_budget_seconds * 1000.0,
                 "cost_budget_pct": self.cost_budget_ratio * 100.0,
+                "warmup_active": (1.0 if self._clock.now()
+                                  < self._warmup_until else 0.0),
+                "warmup_dropped": self.warmup_dropped,
             })
         return burns

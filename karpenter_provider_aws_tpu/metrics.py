@@ -304,6 +304,20 @@ def wire_core_metrics(reg: Registry) -> Dict[str, _Metric]:
             "karpenter_solver_device_retries_total",
             "Transient device-solve failures retried before any fallback "
             "engaged.", ()),
+        # the steady-state incremental path (solver/incremental.py +
+        # Solver.solve_delta): passes whose problem was patched from the
+        # previous build and solved against device-resident input state
+        # instead of a from-scratch rebuild + full upload
+        "solver_delta_solves": reg.counter(
+            "karpenter_solver_delta_solves_total",
+            "Provisioning passes carried by the steady-state delta-solve "
+            "path (incremental problem build + device-resident input "
+            "delta).", ()),
+        "solver_dirty_groups": reg.histogram(
+            "karpenter_solver_dirty_group_count",
+            "Signature groups whose membership changed per delta solve "
+            "(the re-tensorized share of the problem).", (),
+            buckets=(0, 1, 2, 4, 8, 16, 32, 64)),
         "solver_waves": reg.histogram(
             "karpenter_solver_wave_count",
             "Waves per scheduling solve (1 = one device pass; >1 = the "

@@ -68,6 +68,15 @@ class Operator:
         (watch-driven config)."""
         self.options = options or Options()
         self.options.validate()
+        if self.options.compile_cache_dir:
+            # BEFORE any jit tracing (the Solver's Pallas probe below is
+            # the first): a restarted operator loads its bucket-ladder
+            # executables from the on-disk cache instead of re-paying
+            # first-trace XLA compilation — the cold-start burn killer
+            # (docs/concepts/performance.md "Steady-state reconciles &
+            # the compile cache")
+            from ..solver.solve import enable_persistent_compile_cache
+            enable_persistent_compile_cache(self.options.compile_cache_dir)
         self.clock = clock or Clock()
         self.node_classes: Dict[str, NodeClass] = node_classes or {
             "default": NodeClass(name="default",

@@ -73,6 +73,12 @@ class Options:
     # the accelerator-resident sidecar (parallel/sidecar.py RemoteSolver)
     # instead of running in-process; empty = resident in-process solver
     solver_address: str = ""
+    # directory for JAX's persistent compilation cache (solver/solve.py
+    # enable_persistent_compile_cache): a RESTARTED operator loads its
+    # bucket-ladder executables from disk instead of re-paying 20-40 s
+    # of XLA compile per shape on its first real pass — the cold-start
+    # SLO burn spike SOAK_r06 recorded. Empty = in-memory jit cache only
+    compile_cache_dir: str = ""
 
     def validate(self) -> None:
         if not self.cluster_name:
@@ -104,6 +110,7 @@ class Options:
             spot_to_spot_consolidation=_env_bool("FEATURE_GATE_SPOT_TO_SPOT", False),
             termination_grace_period=_env("TERMINATION_GRACE_PERIOD", None, float),
             solver_address=_env("SOLVER_ADDRESS", "", str),
+            compile_cache_dir=_env("COMPILE_CACHE_DIR", "", str),
         )
         for k, v in overrides.items():
             setattr(opts, k, v)

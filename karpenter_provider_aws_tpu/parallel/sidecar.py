@@ -257,6 +257,11 @@ class RemoteSolver(Solver):
     the LOCAL Solver this subclasses, so a sidecar outage degrades to the
     in-process ladder instead of stalling the control plane."""
 
+    # provisioning solves belong to the sidecar: the provisioner's
+    # steady-state delta path (an in-process resident-cache fast path)
+    # would silently bypass the delegation, so it stays off here
+    supports_delta = False
+
     def __init__(self, lattice, address: str, timeout: float = 60.0,
                  pipeline: bool = True):
         super().__init__(lattice, pipeline=pipeline)
@@ -279,7 +284,10 @@ class RemoteSolver(Solver):
     def solve_relaxed(self, pods, node_pools, lattice=None, existing=(),
                       daemonset_pods=(), bound_pods=(), pvcs=None,
                       storage_classes=None, mesh=None,
-                      pool_headroom=None) -> NodePlan:
+                      pool_headroom=None, problem0=None) -> NodePlan:
+        # problem0 is a LOCAL-build shortcut; the remote path ships pods
+        # and rebuilds sidecar-side, so it is meaningful only for the
+        # unreachable-fallback local solve below
         with trace.span("solver.remote", pods=len(pods),
                         address=self.client.address) as sp:
             try:
@@ -304,7 +312,7 @@ class RemoteSolver(Solver):
             pods, node_pools, lattice=lattice, existing=existing,
             daemonset_pods=daemonset_pods, bound_pods=bound_pods,
             pvcs=pvcs, storage_classes=storage_classes, mesh=mesh,
-            pool_headroom=pool_headroom)
+            pool_headroom=pool_headroom, problem0=problem0)
         plan.degraded = True
         plan.degraded_reason = plan.degraded_reason or "sidecar-unreachable"
         return plan

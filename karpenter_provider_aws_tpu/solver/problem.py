@@ -250,21 +250,30 @@ _WAVE_PRICE_SLACK = 1.05
 # one 50k wave, and a per-group bound cannot see that.
 _WAVE_MAX_BINS = 1024
 
-# narrowing results memoized by CONTENT (every array input's bytes +
-# the scalar knobs) plus lattice identity: the numpy reductions in
-# _accel_bin_cap/_wave_bin_cap are ~0.5 ms per group, and a steady
-# controller rebuilds the same groups every batch. price/availability
-# moves invalidate via price_version in the key and the `is` check on
-# the stored lattice ref (pricing mutates price[...] in place but bumps
-# the version; ICE produces a NEW masked_view lattice object — holding
-# the ref strongly means a dead lattice's id can never alias a live
-# key). Two-level: at most _NARROW_LATS lattices are retained (an
-# ICE-churning controller mints a masked_view per cycle; an unbounded
-# flat map would pin every dead one), each with at most _NARROW_MAX
-# per-group entries. Guarded by build_problem's _INTERN_LOCK.
+# narrowing results memoized by CONTENT (every array input's bytes)
+# plus lattice identity: the numpy reductions in
+# _accel_bin_cap/_wave_candidates are ~0.5 ms per group, and a steady
+# controller rebuilds the same groups every batch. The cached value is
+# COUNT-INDEPENDENT — the accel mask plus the wave candidate table
+# (idx, per-bin fit K, cheapest price pmin); the cheap floor/gain
+# decision that DOES depend on the group's count and the batch's total
+# pending (_wave_mask_from_table) re-runs on every call. This is what
+# lets a steady-state reconcile whose pod counts drift a little reuse
+# the expensive reductions for every untouched group (the incremental
+# build path, solver/incremental.py) while staying bit-identical to a
+# from-scratch rebuild. price/availability moves invalidate via
+# price_version in the key and the `is` check on the stored lattice ref
+# (pricing mutates price[...] in place but bumps the version; ICE
+# produces a NEW masked_view lattice object — holding the ref strongly
+# means a dead lattice's id can never alias a live key). Two-level: at
+# most _NARROW_LATS lattices are retained (an ICE-churning controller
+# mints a masked_view per cycle; an unbounded flat map would pin every
+# dead one), each with at most _NARROW_MAX per-group entries. Guarded
+# by build_problem's _INTERN_LOCK.
 _NARROW_MAX = 4096
 _NARROW_LATS = 4
-_NARROW_CACHE: Dict[int, tuple] = {}   # id(lat) -> (lattice, {key: mask|None})
+_NARROW_CACHE: Dict[int, tuple] = {}   # id(lat) -> (lattice, {key: entry})
+_WAVE_UNSET = object()   # wave candidate table not computed yet (lazy)
 
 
 def _wave_bin_cap(vec: np.ndarray, count: int, type_mask: np.ndarray,
@@ -302,8 +311,26 @@ def _wave_bin_cap(vec: np.ndarray, count: int, type_mask: np.ndarray,
     30% of optimal — only genuinely pods-axis-bound shapes trigger.
     Never applied to accelerator groups (_accel_bin_cap owns those).
     """
-    if count < _WAVE_MIN_PODS:
+    table = _wave_candidates(vec, type_mask, zone_mask, cap_mask,
+                             pool_tmask, ds_vec, lattice)
+    if table is None:
         return None
+    return _wave_mask_from_table(table, count, type_mask, existing_tmask,
+                                 max_per_bin, total_pending)
+
+
+def _wave_candidates(vec: np.ndarray, type_mask: np.ndarray,
+                     zone_mask: np.ndarray, cap_mask: np.ndarray,
+                     pool_tmask: np.ndarray, ds_vec: np.ndarray,
+                     lattice: Lattice) -> Optional[tuple]:
+    """The COUNT-INDEPENDENT half of the wave narrowing: the expensive
+    per-candidate reductions — how many of this group's pods fit an empty
+    bin of each candidate type (K, pre-spread-clamp) and the cheapest
+    offering price within the group's own zone/captype masks (pmin).
+    Everything here depends only on the group's content and the lattice,
+    so the narrowing cache can reuse it across passes whose pod counts
+    drifted; _wave_mask_from_table applies the count/total-dependent
+    floor and gain gates per call."""
     if not zone_mask.any() or not cap_mask.any():
         return None
     cand = type_mask & pool_tmask
@@ -316,10 +343,9 @@ def _wave_bin_cap(vec: np.ndarray, count: int, type_mask: np.ndarray,
     with np.errstate(divide="ignore", invalid="ignore"):
         per_axis = np.where(need > 0, free / np.maximum(need, 1e-9), np.inf)
     K = np.floor(per_axis.min(axis=1))
-    if max_per_bin:
-        # hostname-spread groups seal bins early; rank at the density
-        # the bins will actually reach
-        K = np.minimum(K, max_per_bin)
+    # the K >= 1 feasibility filter commutes with the spread clamp
+    # (min(K, mpb) >= 1 ⇔ K >= 1 whenever mpb >= 1, and mpb == 0 means
+    # no clamp), so filtering pre-clamp keeps the table count-free
     fits = K >= 1
     if not fits.any():
         return None
@@ -336,9 +362,25 @@ def _wave_bin_cap(vec: np.ndarray, count: int, type_mask: np.ndarray,
                              np.nonzero(cap_mask)[0])],
         np.inf)
     pmin = prices.reshape(len(idx), -1).min(axis=1)
-    priced = np.isfinite(pmin)
-    if not priced.any():
+    if not np.isfinite(pmin).any():
         return None
+    return idx, K, pmin
+
+
+def _wave_mask_from_table(table: tuple, count: int, type_mask: np.ndarray,
+                          existing_tmask: np.ndarray, max_per_bin: int,
+                          total_pending: int) -> Optional[np.ndarray]:
+    """The cheap per-call half of the wave narrowing: the density floor
+    and the gain gate over an already-computed candidate table. O(|cand|)
+    numpy over a handful of candidates — safe to re-run on every build."""
+    if count < _WAVE_MIN_PODS:
+        return None
+    idx, K, pmin = table
+    if max_per_bin:
+        # hostname-spread groups seal bins early; rank at the density
+        # the bins will actually reach
+        K = np.minimum(K, max_per_bin)
+    priced = np.isfinite(pmin)
     # density floor (see _WAVE_MAX_BINS): candidates must carry the
     # batch-wide density that keeps the whole plan bounded — relaxed to
     # the densest PRICED candidate when nothing meets it (a t-family-only
@@ -622,6 +664,86 @@ _SIG_IDS: Dict[tuple, int] = {}
 _SIG_TUPLES: List[tuple] = []        # sig_id -> sig (for the id->key map)
 _BAD_SIDS: Dict[int, str] = {}       # sig_id -> unknown-resource reason
                                      # (depends only on the sig's requests)
+
+
+def signature_of(pod: Pod, relevant_keys: frozenset = frozenset()
+                 ) -> Tuple[str, Optional[str]]:
+    """(signature repr, unknown-resource reason) of one pod under the
+    given relevant label keys — the SAME interned signature machinery
+    build_problem groups with, so solver/incremental.py can match a
+    churned pod to the previous build's groups without a full regroup.
+    Serializes on the intern lock; the per-pod cache makes repeat calls
+    one dict hit."""
+    with _INTERN_LOCK:
+        rk = _RK_INTERN.setdefault(relevant_keys, relevant_keys)
+        cache = pod.__dict__.get("_kpat_sig")
+        if cache is not None and cache[0] is rk:
+            sid = cache[1]
+        else:
+            sig = _group_key(pod, rk, {})
+            sid = _SIG_IDS.get(sig)
+            if sid is None:
+                sid = len(_SIG_TUPLES)
+                _SIG_IDS[sig] = sid
+                _SIG_TUPLES.append(sig)
+                _, unknown = resources_to_vec_checked(pod.requests,
+                                                      implicit_pod=True)
+                if unknown:
+                    _BAD_SIDS[sid] = (
+                        f"unknown resource(s): {', '.join(unknown)}")
+            pod.__dict__["_kpat_sig"] = (rk, sid)
+        return repr(_SIG_TUPLES[sid]), _BAD_SIDS.get(sid)
+
+
+def recheck_narrow(group: PodGroup, count: int, total_pending: int,
+                   lattice: Lattice) -> bool:
+    """Would a from-scratch build reach the SAME narrowing decision for
+    ``group`` at the new (count, total_pending)? The incremental builder
+    (solver/incremental.py) calls this for every retained group — the
+    expensive candidate reductions are content-cached, so the replay is
+    one dict hit plus the cheap floor/gain step. False means the drifted
+    counts flipped a narrowing decision and the caller must rebuild from
+    scratch (parity over speed, always)."""
+    ctx = getattr(group, "_narrow_ctx", None)
+    if ctx is None:
+        # narrowing never ran for this group (narrow=False build);
+        # nothing count-dependent to flip
+        return True
+    (nkey, vec, tmask, zm, cm, pool_tmask, ds_max, existing_tmask,
+     prev_raw) = ctx
+    with _INTERN_LOCK:
+        slot = _NARROW_CACHE.get(id(lattice))
+        if slot is not None and slot[0] is not lattice:
+            slot = None
+        entry = slot[1].get(nkey) if slot is not None else None
+        if entry is None:
+            a_accel = _accel_bin_cap(vec, tmask, zm, cm, pool_tmask,
+                                     existing_tmask, lattice)
+            entry = [a_accel, _WAVE_UNSET]
+            if slot is None:
+                if len(_NARROW_CACHE) >= _NARROW_LATS:
+                    _NARROW_CACHE.clear()
+                slot = (lattice, {})
+                _NARROW_CACHE[id(lattice)] = slot
+            if len(slot[1]) >= _NARROW_MAX:
+                slot[1].clear()
+            slot[1][nkey] = entry
+        if entry[0] is not None:
+            new_raw = entry[0]
+        elif (count >= _WAVE_MIN_PODS and ds_max is not None
+                and pool_tmask.any()):
+            if entry[1] is _WAVE_UNSET:
+                entry[1] = _wave_candidates(vec, tmask, zm, cm, pool_tmask,
+                                            ds_max, lattice)
+            new_raw = (None if entry[1] is None
+                       else _wave_mask_from_table(
+                           entry[1], count, tmask, existing_tmask,
+                           group.max_per_bin, total_pending))
+        else:
+            new_raw = None
+    if prev_raw is None or new_raw is None:
+        return prev_raw is None and new_raw is None
+    return bool(np.array_equal(prev_raw, new_raw))
 
 
 def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: Lattice,
@@ -1097,6 +1219,7 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
                      for eff in pool_eff_labels], dtype=bool)
             g_tmask = masks.type_mask
             unnarrowed = None
+            narrow_ctx = None
             if narrow and not topo.single_bin:
                 # accelerator bin-splitting (see _accel_bin_cap) — never
                 # applied over hostname self-affinity's one-bin contract.
@@ -1120,30 +1243,28 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
                 # with it keeps small types from being over-favored
                 ds_max = (ds_overhead[np_ok_s].max(axis=0)
                           if any_pool else None)
+                # the cached entry is COUNT-INDEPENDENT (accel mask +
+                # wave candidate table); the cheap floor/gain decision
+                # below re-runs per call so pod-count drift between
+                # steady-state passes neither misses the cache nor
+                # diverges from a from-scratch rebuild
                 nkey = (lattice.price_version, vec.tobytes(),
                         masks.type_mask.tobytes(), zm.tobytes(),
                         cm.tobytes(), pool_tmask.tobytes(),
                         existing_tmask.tobytes(),
-                        ds_max.tobytes() if ds_max is not None else b"",
-                        len(sub_names), topo.max_per_bin, len(pods))
+                        ds_max.tobytes() if ds_max is not None else b"")
                 slot = _NARROW_CACHE.get(id(lattice))
                 if slot is not None and slot[0] is not lattice:
                     slot = None                     # id reuse: stale slot
-                if slot is not None and nkey in slot[1]:
-                    a_mask = slot[1][nkey]
-                else:
-                    a_mask = _accel_bin_cap(
+                entry = slot[1].get(nkey) if slot is not None else None
+                if entry is None:
+                    a_accel = _accel_bin_cap(
                         vec, masks.type_mask, zm, cm, pool_tmask,
                         existing_tmask, lattice)
-                    if a_mask is None and any_pool:
-                        # pods-axis-bound wave narrowing (generic groups
-                        # only — accel groups are _accel_bin_cap's)
-                        a_mask = _wave_bin_cap(
-                            vec, len(sub_names), masks.type_mask,
-                            zm, cm, pool_tmask, existing_tmask,
-                            ds_max, lattice,
-                            max_per_bin=topo.max_per_bin,
-                            total_pending=len(pods))
+                    # the wave table fills LAZILY (below): a batch of
+                    # thousands of sub-threshold singleton groups must
+                    # not pay the candidate reductions it will never use
+                    entry = [a_accel, _WAVE_UNSET]
                     if slot is None:
                         if len(_NARROW_CACHE) >= _NARROW_LATS:
                             _NARROW_CACHE.clear()
@@ -1151,7 +1272,30 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
                         _NARROW_CACHE[id(lattice)] = slot
                     if len(slot[1]) >= _NARROW_MAX:
                         slot[1].clear()
-                    slot[1][nkey] = a_mask
+                    slot[1][nkey] = entry
+                a_accel = entry[0]
+                if a_accel is not None:
+                    a_mask = a_accel
+                elif (len(sub_names) >= _WAVE_MIN_PODS and any_pool
+                        and ds_max is not None):
+                    # pods-axis-bound wave narrowing (generic groups
+                    # only — accel groups are _accel_bin_cap's)
+                    if entry[1] is _WAVE_UNSET:
+                        entry[1] = _wave_candidates(
+                            vec, masks.type_mask, zm, cm, pool_tmask,
+                            ds_max, lattice)
+                    a_mask = (None if entry[1] is None
+                              else _wave_mask_from_table(
+                                  entry[1], len(sub_names),
+                                  masks.type_mask, existing_tmask,
+                                  topo.max_per_bin, len(pods)))
+                else:
+                    a_mask = None
+                # retained for solver/incremental.py recheck_narrow: the
+                # raw (pre-feasibility-fallback) decision plus every
+                # input needed to replay it at a drifted count
+                narrow_ctx = (nkey, vec, masks.type_mask, zm, cm,
+                              pool_tmask, ds_max, existing_tmask, a_mask)
                 if a_mask is not None and a_mask.any():
                     unnarrowed = masks.type_mask
                     g_tmask = a_mask
@@ -1164,6 +1308,7 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
                 strict_custom=strict,
                 unnarrowed_type_mask=unnarrowed,
             )
+            g._narrow_ctx = narrow_ctx
             groups.append(g)
             pending_topo.append((g, rep, topo.owner, topo.need))
 

@@ -49,6 +49,29 @@ _G_BUCKETS = (16, 32, 64, 96, 128, 192, 256, 512, 1024, 4096)
 _B_BUCKETS = (32, 128, 512, 1024, 2048, 8192)
 
 
+def enable_persistent_compile_cache(cache_dir: str) -> bool:
+    """Point JAX's persistent compilation cache at ``cache_dir`` so a
+    RESTARTED operator never re-pays XLA compilation for bucket shapes
+    it has compiled in any previous life (the cold-start SLO burn spike
+    SOAK_r06 recorded — peak burn ~8 from the first-pass compile — comes
+    from exactly this). The thresholds drop to zero: every kernel in the
+    bucketed ladder is worth caching, and the cache key already covers
+    jaxlib/backend versions so stale entries can never serve. Safe to
+    call more than once; returns False (and leaves the process usable)
+    on a JAX too old to support the knobs."""
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        try:
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              -1)
+        except Exception:
+            pass   # older jaxlib: size threshold keeps its default
+        return True
+    except Exception:
+        return False
+
+
 @dataclass
 class PlannedNode:
     node_pool: str
@@ -277,6 +300,12 @@ class Solver:
     Thread-safe: every public solve/probe entry point serializes on an
     internal RLock (see __init__)."""
 
+    # the provisioner's steady-state delta path targets the IN-PROCESS
+    # device pipeline (resident input cache + solve_delta); RemoteSolver
+    # flips this off — a delta solved locally would silently bypass the
+    # operator's --solver-address delegation
+    supports_delta = True
+
     def __init__(self, lattice: Lattice, pipeline: bool = True):
         self.lattice = lattice
         # probe-gated Pallas finalization: on a TPU backend the streaming
@@ -301,10 +330,11 @@ class Solver:
         # trip); a smaller estimate ignores the hint, so one big wave never
         # pins later small solves to a huge padded bin table.
         self._b_hint: Dict[int, Tuple[int, int]] = {}
-        # content-keyed memo of _estimate_bins: steady-state clusters re-solve
-        # the same pending set every pass (bench: every iteration), and the
-        # [G,T,R] fit scan costs ~10 ms host time per 80-group problem
-        self._est_cache: Dict[bytes, int] = {}
+        # content-keyed memo of _estimate_bins' per-group fit caps (count-
+        # independent, see _estimate_caps_uncached): steady-state clusters
+        # re-solve near-identical pending sets every pass, and the [G,T,R]
+        # fit scan costs ~10 ms host time per 80-group problem
+        self._est_cache: Dict[bytes, np.ndarray] = {}
         # degradation ladder state: an optional FaultInjector (tests/soaks
         # force each failure mode deterministically) and plain counters of
         # every off-primary-path event — the provisioning controller mirrors
@@ -322,6 +352,14 @@ class Solver:
         self.pipeline_stats: Dict[str, int] = {
             "async_solves": 0,       # device solves that dispatched async
             "prefetched_waves": 0,   # wave inputs uploaded during compute
+            # the steady-state delta path (solver/incremental.py +
+            # solve_delta): how often it carried a pass, how many group
+            # rows it re-tensorized, and whether the whole-problem
+            # device-resident entry was warm
+            "delta_solves": 0,
+            "delta_dirty_groups": 0,
+            "resident_problem_hits": 0,
+            "resident_problem_misses": 0,
         }
 
     def set_pipeline(self, enabled: bool) -> None:
@@ -388,35 +426,37 @@ class Solver:
             raise SolverDeviceError("injected device fault")
 
     def _estimate_bins(self, problem: Problem) -> int:
-        key = None
-        if problem.G:
-            h = hashlib.blake2b(digest_size=16)
-            for a in (problem.req, problem.count, problem.g_type,
-                      problem.max_per_bin):
-                h.update(a.tobytes())
-            key = h.digest()
-            hit = self._est_cache.get(key)
-            if hit is not None:
-                return hit
-        est = self._estimate_bins_uncached(problem)
-        if key is not None:
-            if len(self._est_cache) >= self._EST_CACHE_MAX:
-                self._est_cache.clear()
-            self._est_cache[key] = est
-        return est
-
-    def _estimate_bins_uncached(self, problem: Problem) -> int:
-        """Lower-bound estimate of bins the pack will open: each group needs
-        at least count / (best-case per-node fit) bins, and never packs more
-        than max_per_bin per node (hostname spread / anti-affinity).
-
-        Fit is the joint vector fit of the best type the group's type mask
-        actually allows (not per-resource maxima across different types,
-        which systematically underestimates B for constrained workloads and
-        forces a guaranteed overflow retry — one extra device round trip).
-        The retry stays as the backstop."""
+        """Lower-bound estimate of bins the pack will open: each group
+        needs at least count / (best-case per-node fit) bins, and never
+        packs more than max_per_bin per node (hostname spread /
+        anti-affinity). The expensive [G,T,R] fit scan is COUNT-
+        INDEPENDENT and content-cached; the final count division re-runs
+        per call, so steady-state passes whose pod counts drifted (the
+        incremental build path) still hit the cache (~10 ms per 80-group
+        problem otherwise)."""
         if problem.G == 0:
             return 0
+        h = hashlib.blake2b(digest_size=16)
+        for a in (problem.req, problem.g_type):
+            h.update(a.tobytes())
+        key = h.digest()
+        caps = self._est_cache.get(key)
+        if caps is None:
+            caps = self._estimate_caps_uncached(problem)
+            if len(self._est_cache) >= self._EST_CACHE_MAX:
+                self._est_cache.clear()
+            self._est_cache[key] = caps
+        capped = np.minimum(np.maximum(caps, 1.0),
+                            problem.max_per_bin.astype(np.float64))
+        return int(np.ceil(problem.count / np.maximum(capped, 1.0)).sum())
+
+    def _estimate_caps_uncached(self, problem: Problem) -> np.ndarray:
+        """Per-group best-case per-node pod fit [G] (pre max_per_bin
+        clamp). Fit is the joint vector fit of the best type the group's
+        type mask actually allows (not per-resource maxima across
+        different types, which systematically underestimates B for
+        constrained workloads and forces a guaranteed overflow retry —
+        one extra device round trip). The retry stays as the backstop."""
         alloc = self.lattice.alloc.astype(np.float64)               # [T,R]
         req = problem.req.astype(np.float64)                        # [G,R]
         caps = np.zeros((problem.G,), np.float64)
@@ -429,9 +469,7 @@ class Solver:
                              / np.where(pos, r[:, None, :], 1.0), np.inf)
             fit_t = np.floor(np.nan_to_num(ratio.min(axis=2), posinf=1e9))
             caps[s: s + CH] = np.where(m, fit_t, 0.0).max(axis=1, initial=0.0)
-        caps = np.minimum(np.maximum(caps, 1.0),
-                          problem.max_per_bin.astype(np.float64))
-        return int(np.ceil(problem.count / np.maximum(caps, 1.0)).sum())
+        return caps
 
     def _device_avail_price(self, problem: Problem):
         """A problem built over a masked lattice view (ICE cache applied,
@@ -567,11 +605,22 @@ class Solver:
 
     # ---- warmup (precompile the warm bucket set) ----
 
+    # the boot warmup ladder: the shapes a production operator's FIRST
+    # real passes actually hit. G=16..128 covers batches up to ~128
+    # scheduling signatures (a 50k-pod wave of 30 deployment shapes is
+    # G≈31 → bucket 32); B up to 2048 covers plans up to ~2k nodes.
+    WARM_G_BUCKETS: Sequence[int] = (16, 32, 64)
+    WARM_B_BUCKETS: Sequence[int] = (32, 128, 512)
+    BOOT_G_BUCKETS: Sequence[int] = (16, 32, 64, 96, 128)
+    BOOT_B_BUCKETS: Sequence[int] = (32, 128, 512, 1024, 2048)
+
     def warmup(self, node_pools_count: int = 1, affinity_classes: int = 1,
-               g_buckets: Sequence[int] = (16, 32, 64),
-               b_buckets: Sequence[int] = (32, 128, 512),
+               g_buckets: Sequence[int] = WARM_G_BUCKETS,
+               b_buckets: Sequence[int] = WARM_B_BUCKETS,
                probes: bool = False,
-               background: bool = False):
+               background: bool = False,
+               aot: bool = False,
+               on_done=None):
         """Precompile the solve kernels for the warm (G, B) bucket set.
 
         The reference's Go scheduler has zero compile latency; XLA charges
@@ -585,9 +634,22 @@ class Solver:
         demand — the warm set covers the affinity-free common case, not
         every workload shape.
 
+        ``aot=True`` AOT-LOWERS each shape and compiles it without
+        executing the kernel. CAVEAT: ``.lower().compile()`` does NOT
+        populate jit's dispatch cache — the first real call re-traces
+        and re-compiles unless ``enable_persistent_compile_cache`` is
+        wired, in which case it loads the executable from disk instead
+        of re-paying XLA. So: pass ``aot=True`` only alongside a
+        persistent cache dir (the CLI does exactly this); the default
+        EXECUTING path warms the real dispatch cache directly and is the
+        right call everywhere else.
+
         ``background=True`` runs on a daemon thread and returns it —
         operator startup proceeds while shapes compile; a real solve
         arriving mid-warmup just serializes on the solver lock.
+        ``on_done`` (no-arg callable) fires when the ladder finishes,
+        successfully or not — the operator uses it to close the SLO
+        warmup window (introspect/slo.py).
         """
         if background:
             t = threading.Thread(
@@ -595,33 +657,56 @@ class Solver:
                 kwargs=dict(node_pools_count=node_pools_count,
                             affinity_classes=affinity_classes,
                             g_buckets=g_buckets, b_buckets=b_buckets,
-                            probes=probes))
+                            probes=probes, aot=aot, on_done=on_done))
             t.start()
             return t
-        lat = self.lattice
-        NP = max(node_pools_count, 1)
-        A = max(affinity_classes, 1)
-        for G in g_buckets:
-            _, g_total = binpack.group_layout(G, lat.T, lat.Z, lat.C, NP, A, R)
-            gbuf = jnp.asarray(np.zeros((g_total,), np.uint8))
-            for B in b_buckets:
-                _, i_total = binpack.init_layout(B, R, A)
-                ibuf = jnp.asarray(np.zeros((i_total,), np.uint8))
-                for init in (None, ibuf):
-                    with self._solve_lock:
-                        np.asarray(binpack.pack_packed_efused(
-                            self._alloc, self._avail, self._price, gbuf,
-                            init, 0, B, G, lat.T, lat.Z, lat.C, NP, A,
-                            lean=True))
-                if probes:
-                    for K in self._K_BUCKETS[:2]:
+        try:
+            lat = self.lattice
+            NP = max(node_pools_count, 1)
+            A = max(affinity_classes, 1)
+
+            def compile_only(fn, *args, **static):
+                """Compile without running: .lower().compile() populates
+                the SAME jit cache (and the persistent on-disk cache) the
+                real solve hits, minus the kernel execution."""
+                if aot:
+                    try:
+                        fn.lower(*args, **static).compile()
+                        return
+                    except Exception:
+                        pass   # fall through to the executing path
+                np.asarray(fn(*args, **static))
+
+            for G in g_buckets:
+                _, g_total = binpack.group_layout(G, lat.T, lat.Z, lat.C,
+                                                  NP, A, R)
+                gbuf = jnp.asarray(np.zeros((g_total,), np.uint8))
+                for B in b_buckets:
+                    _, i_total = binpack.init_layout(B, R, A)
+                    ibuf = jnp.asarray(np.zeros((i_total,), np.uint8))
+                    for init in (None, ibuf):
                         with self._solve_lock:
-                            np.asarray(binpack.pack_probe_fused(
+                            compile_only(
+                                binpack.pack_packed_efused,
                                 self._alloc, self._avail, self._price,
-                                jnp.tile(gbuf, (K, 1)),
-                                jnp.tile(ibuf, (K, 1)),
-                                jnp.zeros((K,), jnp.int32),
-                                B, G, lat.T, lat.Z, lat.C, NP, A))
+                                gbuf, init, 0, B, G, lat.T, lat.Z, lat.C,
+                                NP, A, lean=True)
+                    if probes:
+                        for K in self._K_BUCKETS[:2]:
+                            with self._solve_lock:
+                                compile_only(
+                                    binpack.pack_probe_fused,
+                                    self._alloc, self._avail, self._price,
+                                    jnp.tile(gbuf, (K, 1)),
+                                    jnp.tile(ibuf, (K, 1)),
+                                    jnp.zeros((K,), jnp.int32),
+                                    B, G, lat.T, lat.Z, lat.C, NP, A)
+        finally:
+            if on_done is not None:
+                try:
+                    on_done()
+                except Exception:
+                    pass   # a callback bug must not kill the warmup thread
         return None
 
     # ---- profiling (xprof hook) ----
@@ -734,17 +819,22 @@ class Solver:
     def solve_relaxed(self, pods, node_pools, lattice=None, existing=(),
                       daemonset_pods=(), bound_pods=(), pvcs=None,
                       storage_classes=None, mesh=None,
-                      pool_headroom=None) -> NodePlan:
+                      pool_headroom=None, problem0=None) -> NodePlan:
         """Tracing shim over :meth:`_solve_relaxed`: the whole relaxation
         loop (every round's solve, wave, and stage spans nest underneath)
         is one span carrying the plan's degradation provenance — which is
-        what the flight recorder's tail sampler keys retention on."""
+        what the flight recorder's tail sampler keys retention on.
+
+        ``problem0`` is an already-built round-0 problem for exactly
+        these inputs (the provisioner's incremental builder produces one
+        whether or not its delta path engaged) — round 0 reuses it
+        instead of re-tensorizing; relaxation rounds always rebuild."""
         with trace.span("solver.solve_relaxed", pods=len(pods)) as sp:
             plan = self._solve_relaxed(
                 pods, node_pools, lattice=lattice, existing=existing,
                 daemonset_pods=daemonset_pods, bound_pods=bound_pods,
                 pvcs=pvcs, storage_classes=storage_classes, mesh=mesh,
-                pool_headroom=pool_headroom)
+                pool_headroom=pool_headroom, problem0=problem0)
             sp.set(path=plan.solver_path, degraded=plan.degraded,
                    reason=plan.degraded_reason, waves=plan.waves,
                    pipelined=plan.pipelined,
@@ -755,7 +845,7 @@ class Solver:
     def _solve_relaxed(self, pods, node_pools, lattice=None, existing=(),
                        daemonset_pods=(), bound_pods=(), pvcs=None,
                        storage_classes=None, mesh=None,
-                       pool_headroom=None) -> NodePlan:
+                       pool_headroom=None, problem0=None) -> NodePlan:
         """Solve with preferred-rule relaxation (reference
         scheduling.md:203-206, 322-334).
 
@@ -790,13 +880,19 @@ class Solver:
         stage_total: Dict[str, float] = {}
         any_pipelined = False
         for _ in range(max_rounds):
-            eff = [p if relax.get(p.name, 0) == 0 else relax_pod(p, relax[p.name])
-                   for p in pods]
-            problem = build_problem(eff, node_pools, lattice, existing=existing,
-                                    daemonset_pods=daemonset_pods,
-                                    bound_pods=bound_pods, pvcs=pvcs,
-                                    storage_classes=storage_classes,
-                                    pool_headroom=pool_headroom)
+            if problem0 is not None and not relax:
+                # round 0 over unrelaxed pods: the caller already built
+                # exactly this problem (provisioner incremental builder)
+                problem = problem0
+            else:
+                eff = [p if relax.get(p.name, 0) == 0
+                       else relax_pod(p, relax[p.name]) for p in pods]
+                problem = build_problem(eff, node_pools, lattice,
+                                        existing=existing,
+                                        daemonset_pods=daemonset_pods,
+                                        bound_pods=bound_pods, pvcs=pvcs,
+                                        storage_classes=storage_classes,
+                                        pool_headroom=pool_headroom)
             plan = self.solve(problem, mesh=mesh)
             total_solve += plan.solve_seconds
             total_device += plan.device_seconds
@@ -844,6 +940,39 @@ class Solver:
             plan = self._solve_problem(problem, mesh=mesh)
             sp.set(path=plan.solver_path, degraded=plan.degraded,
                    reason=plan.degraded_reason, retries=plan.device_retries)
+            return plan
+
+    @_locked
+    def solve_delta(self, problem: Problem, dirty_groups: Sequence[int] = (),
+                    mesh=None) -> NodePlan:
+        """The steady-state delta-solve entry point (ROADMAP item 2,
+        docs/concepts/performance.md "Steady-state reconciles"). The
+        problem arrived via solver/incremental.py, so the fused input
+        buffers differ from the previous pass only in the dirty-group
+        blocks: the pipelined path's resident-input cache ships just
+        those blocks and the device solve seeds from the resident carry
+        state. Forces the pipelined path for the duration of the call
+        (delta semantics REQUIRE the resident cache) and records the
+        delta evidence counters soaks/benches/`kpctl top` assert on.
+        Plans are identical to :meth:`solve` of the same problem — the
+        delta is in bytes moved, never in the answer."""
+        with trace.span("solver.solve_delta", groups=problem.G,
+                        dirty=len(dirty_groups)) as sp:
+            pre_hits = self._resident.hits
+            was_pipelined = self.pipeline
+            self.pipeline = True
+            try:
+                plan = self._solve_problem(problem, mesh=mesh)
+            finally:
+                self.pipeline = was_pipelined
+            self.pipeline_stats["delta_solves"] += 1
+            self.pipeline_stats["delta_dirty_groups"] += len(dirty_groups)
+            if self._resident.hits > pre_hits:
+                self.pipeline_stats["resident_problem_hits"] += 1
+            else:
+                self.pipeline_stats["resident_problem_misses"] += 1
+            sp.set(path=plan.solver_path, degraded=plan.degraded,
+                   resident_hit=self._resident.hits > pre_hits)
             return plan
 
     def _solve_problem(self, problem: Problem, mesh=None) -> NodePlan:
@@ -970,6 +1099,10 @@ class Solver:
         use_efused = pipelined or gbuf is not None or problem.E == 0
         if use_efused and gbuf is None:
             with stages.span("upload"):
+                # ("g", G, size) is the whole-problem resident entry's
+                # identity: a steady-state reconcile landing on the same
+                # layout bucket delta-refreshes it (solve_delta counts
+                # hit/miss via the cache's own counters)
                 gbuf = (self._resident.upload(("g", G, fused_np.size),
                                               fused_np)
                         if pipelined else jnp.asarray(fused_np))

@@ -17,8 +17,9 @@ same way).
 from __future__ import annotations
 
 import threading
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Deque, Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
@@ -41,6 +42,31 @@ class _Nomination:
     expires: float
 
 
+# dirty-journal entry kinds (see ClusterState.dirty_since): "pod" names a
+# pod whose pending-relevance may have changed; "bin" marks any mutation
+# that can move existing-bin rows (node/claim add/delete/refresh, binds);
+# "volume" and "other" poison the incremental path entirely — PVC zone
+# pins and untracked mutations have non-local effects on the problem.
+_JOURNAL_MAX = 65536
+
+
+@dataclass
+class DirtySet:
+    """What changed between two cluster-state revisions (the provisioner
+    feeds this to solver/incremental.py). ``full`` means the journal
+    could not answer (overflowed past ``since``) and the caller must
+    rebuild from scratch — the always-correct fallback."""
+
+    since: int
+    rev: int
+    full: bool = False
+    pods: Set[str] = field(default_factory=set)   # names to re-examine
+    bins: bool = False         # existing-bin inputs changed
+    volumes: bool = False      # PVC / StorageClass mutations
+    daemonsets: bool = False   # daemonset pod set changed (ds_overhead)
+    other: bool = False        # anything the journal cannot localize
+
+
 class ClusterState:
     def __init__(self, clock: Optional[Clock] = None):
         self._clock = clock or Clock()
@@ -60,12 +86,94 @@ class ClusterState:
         # TERMINATING leaves pool_usage immediately); gauge emitters
         # re-render on a rev change instead of rebuilding vectors per pass
         self.capacity_rev = 0
+        # the per-pass dirty journal (docs/concepts/performance.md
+        # "Steady-state reconciles"): every mutation that can change the
+        # next provisioning pass's problem appends one (rev, kind, name)
+        # entry, so the incremental problem builder re-examines only what
+        # actually moved since the revision it last built at. Entries
+        # carry CONSECUTIVE revisions; a reader asking further back than
+        # the ring retains gets DirtySet(full=True) — the always-correct
+        # rebuild path, never a silently-partial answer.
+        self.state_rev = 0
+        self._journal: Deque[Tuple[int, str, str]] = deque(maxlen=_JOURNAL_MAX)
+
+    # ---- dirty journal ----------------------------------------------------
+
+    def _note(self, kind: str, name: str = "") -> None:
+        """Append one journal entry (caller holds the lock)."""
+        self.state_rev += 1
+        self._journal.append((self.state_rev, kind, name))
+
+    def dirty_since(self, since: int) -> DirtySet:
+        """What changed in (``since``, ``state_rev``]. ``full=True`` when
+        the journal cannot answer (ring overflowed past ``since``, or
+        ``since`` is from another life of this mirror). Pods with LIVE
+        nominations are always included: a nomination expiring between
+        passes re-pends its pod with no mutation to journal."""
+        with self._lock:
+            rev = self.state_rev
+            out = DirtySet(since=since, rev=rev)
+            if since > rev or since < rev - len(self._journal):
+                out.full = True
+                return out
+            for erev, kind, name in reversed(self._journal):
+                if erev <= since:
+                    break
+                if kind == "pod":
+                    out.pods.add(name)
+                elif kind == "bin":
+                    out.bins = True
+                elif kind == "volume":
+                    out.volumes = True
+                elif kind == "dspod":
+                    out.daemonsets = True
+                else:
+                    out.other = True
+            # nominations expire on the clock, silently re-pending their
+            # pods — treat every nominated pod as touched (the set is
+            # small and self-cleans on bind/delete), and their usage on
+            # unregistered claims' bins as movable
+            if self._nominations:
+                out.pods.update(self._nominations.keys())
+                out.bins = True
+            return out
+
+    def touched_pods(self, names) -> Dict[str, Tuple[str, Optional[Pod]]]:
+        """Classify journal-touched pods for the incremental problem
+        builder: name -> (state, pod) with state one of "pending" (pod is
+        schedulable input right now), "gone", "bound", "nominated",
+        "deleting", "daemonset". One lock hold for the whole set."""
+        now = self._clock.now()
+        out: Dict[str, Tuple[str, Optional[Pod]]] = {}
+        with self._lock:
+            for n in names:
+                pod = self.pods.get(n)
+                if pod is None:
+                    out[n] = ("gone", None)
+                elif pod.is_daemonset:
+                    out[n] = ("daemonset", pod)
+                elif pod.node_name is not None:
+                    out[n] = ("bound", pod)
+                elif pod.deletion_timestamp:
+                    out[n] = ("deleting", pod)
+                else:
+                    nom = self._nominations.get(n)
+                    if nom is not None and nom.expires > now:
+                        out[n] = ("nominated", pod)
+                    else:
+                        out[n] = ("pending", pod)
+        return out
 
     # ---- pods ------------------------------------------------------------
 
     def add_pod(self, pod: Pod) -> None:
         with self._lock:
             self.pods[pod.name] = pod
+            self._note("dspod" if pod.is_daemonset else "pod", pod.name)
+            if pod.node_name is not None:
+                # first seen ALREADY BOUND (sync relist, external
+                # scheduler): its node's used vector just grew
+                self._note("bin")
             # arrival stamp for the pods_startup_time metric (reference
             # karpenter_pods_startup_time_seconds: created → scheduled).
             # Already-bound pods (operator resync) are NOT arrivals — a
@@ -76,9 +184,14 @@ class ClusterState:
 
     def delete_pod(self, name: str) -> None:
         with self._lock:
-            self.pods.pop(name, None)
+            pod = self.pods.pop(name, None)
             self._nominations.pop(name, None)
             self._pod_added.pop(name, None)
+            self._note("dspod" if pod is not None and pod.is_daemonset
+                       else "pod", name)
+            if pod is not None and pod.node_name is not None:
+                # a bound pod leaving frees its node's used vector
+                self._note("bin")
 
     def drain_startup_samples(self) -> List[float]:
         """Newly-observed pod startup latencies (arrival → first bind)
@@ -92,6 +205,10 @@ class ClusterState:
         with self._lock:
             pod = self.pods.get(pod_name)
             if pod is not None:
+                # a bind changes BOTH the pending set and the target
+                # bin's used vector
+                self._note("pod", pod_name)
+                self._note("bin")
                 if pod.node_name is None:
                     added = self._pod_added.pop(pod_name, None)
                     if added is not None:
@@ -127,6 +244,8 @@ class ClusterState:
             pod = self.pods.get(pod_name)
             if pod is None:
                 return
+            if pod.volume_claims:
+                self._note("volume")
             for c in pod.volume_claims:
                 pvc = self.pvcs.get(c)
                 if pvc is not None and pvc.bound_zone is None:
@@ -135,6 +254,7 @@ class ClusterState:
     def add_storage_class(self, sc) -> None:
         with self._lock:
             self.storage_classes[sc.name] = sc
+            self._note("volume")
 
     def add_pvc(self, pvc) -> None:
         with self._lock:
@@ -146,6 +266,7 @@ class ClusterState:
                     # it (the inverse of WaitForFirstConsumer)
                     pvc.bound_zone = sc.zones[0]
             self.pvcs[pvc.name] = pvc
+            self._note("volume")
 
     def volume_state(self):
         """Locked snapshot of (pvcs, storage_classes) for one solve: the
@@ -162,7 +283,10 @@ class ClusterState:
             for pod in self.pods.values():
                 if pod.node_name == node_name:
                     pod.node_name = None
+                    self._note("pod", pod.name)
                     out.append(pod)
+            if out:
+                self._note("bin")
             return out
 
     # ---- node leases (kube-node-lease mirror) -----------------------------
@@ -273,6 +397,8 @@ class ClusterState:
                     for n in holders:
                         allowance[n] -= 1
                     pod.node_name = None
+                    self._note("pod", pod.name)
+                    self._note("bin")
                     evicted.append(pod)
                 else:
                     blocked.append(pod)
@@ -281,6 +407,10 @@ class ClusterState:
     def nominate(self, pod_name: str, target: str, ttl: float = NOMINATION_TTL) -> None:
         with self._lock:
             self._nominations[pod_name] = _Nomination(target, self._clock.now() + ttl)
+            # nominated pods charge their unregistered claim's bin
+            # (existing_bins sums nominated usage)
+            self._note("pod", pod_name)
+            self._note("bin")
 
     def nominated_pods(self, target: str) -> List[Pod]:
         now = self._clock.now()
@@ -357,29 +487,35 @@ class ClusterState:
         excludes it from capacity)."""
         with self._lock:
             self.capacity_rev += 1
+            self._note("bin")
 
     def add_node(self, node: Node) -> None:
         with self._lock:
             self.nodes[node.name] = node
             self.capacity_rev += 1
+            self._note("bin")
 
     def delete_node(self, name: str) -> None:
         with self._lock:
             self.nodes.pop(name, None)
             self.capacity_rev += 1
+            self._note("bin")
 
     def add_claim(self, claim: NodeClaim) -> None:
         with self._lock:
             self.claims[claim.name] = claim
             self.capacity_rev += 1
+            self._note("bin")
 
     def delete_claim(self, name: str) -> None:
         with self._lock:
             self.claims.pop(name, None)
             self.capacity_rev += 1
+            self._note("bin")
             stale = [p for p, n in self._nominations.items() if n.target == name]
             for p in stale:
                 del self._nominations[p]
+                self._note("pod", p)
 
     def node_for_claim(self, claim_name: str) -> Optional[Node]:
         with self._lock:
@@ -562,6 +698,11 @@ class ClusterState:
                 self.bind_pod(pod.name, new_node)
             else:
                 self.pods[pod.name] = pod
+                self._note("dspod" if pod.is_daemonset else "pod", pod.name)
+                if new_node is not None or old_node is not None:
+                    # a refresh of a bound pod can change its requests —
+                    # its node's used vector moves with it
+                    self._note("bin")
 
     def apply_node(self, node: Node) -> None:
         with self._lock:
@@ -570,6 +711,7 @@ class ClusterState:
                 # semantics without an add/delete
                 self.nodes[node.name] = node
                 self.capacity_rev += 1
+                self._note("bin")
             else:
                 self.add_node(node)
 
@@ -580,6 +722,7 @@ class ClusterState:
                 self.add_claim(claim)
                 return
             self.claims[claim.name] = claim
+            self._note("bin")
             if (bool(prev.deletion_timestamp) != bool(claim.deletion_timestamp)
                     or prev.phase != claim.phase):
                 # deletion stamp / phase flips change pool_usage() without
@@ -589,10 +732,12 @@ class ClusterState:
     def delete_pvc(self, name: str) -> None:
         with self._lock:
             self.pvcs.pop(name, None)
+            self._note("volume")
 
     def delete_storage_class(self, name: str) -> None:
         with self._lock:
             self.storage_classes.pop(name, None)
+            self._note("volume")
 
     def apply_pvc(self, pvc) -> None:
         with self._lock:
@@ -615,3 +760,7 @@ class ClusterState:
             self._nominations.clear()
             self._pod_added.clear()
             self._startup_samples.clear()
+            # a reset is another life of the mirror: drop the journal and
+            # advance the revision so any held revision reads as stale
+            self._journal.clear()
+            self.state_rev += 1

@@ -1,0 +1,516 @@
+"""Incremental steady-state solve: dirty journal, incremental problem
+builder, delta-solve parity, SLO warmup window, gz soak artifacts.
+
+The contract under test (docs/concepts/performance.md "Steady-state
+reconciles & the compile cache"): the incremental path is a pure
+OPTIMIZATION — every problem it produces must be plan-equivalent to a
+from-scratch build_problem of the same inputs (cost-exact, same nodes),
+and any input it cannot localize must fall back to the full build.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.apis import NodePool, Pod
+from karpenter_provider_aws_tpu.apis import wellknown as wk
+from karpenter_provider_aws_tpu.cache.unavailable import UnavailableOfferings
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+from karpenter_provider_aws_tpu.lattice.tensors import masked_view_versioned
+from karpenter_provider_aws_tpu.solver import Solver, build_problem
+from karpenter_provider_aws_tpu.solver.incremental import (
+    IncrementalProblemBuilder)
+from karpenter_provider_aws_tpu.solver.oracle import ffd_oracle
+from karpenter_provider_aws_tpu.state.cluster import ClusterState, DirtySet
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    return build_lattice([s for s in build_catalog()
+                          if s.family in ("m5", "c5")])
+
+
+@pytest.fixture(scope="module")
+def solver(lattice):
+    return Solver(lattice)
+
+
+SHAPES = [{"cpu": "250m", "memory": "512Mi"},
+          {"cpu": "500m", "memory": "1Gi"},
+          {"cpu": "1", "memory": "2Gi"},
+          {"cpu": "2", "memory": "4Gi"}]
+
+
+def _pod(i, shape=None):
+    return Pod(name=f"p{i}", requests=shape or SHAPES[i % len(SHAPES)])
+
+
+# ---------------------------------------------------------------------------
+# dirty journal
+
+
+class TestDirtyJournal:
+    def test_mutations_journal_and_localize(self):
+        c = ClusterState(FakeClock())
+        rev0 = c.state_rev
+        c.add_pod(_pod(1))
+        c.add_pod(_pod(2))
+        d = c.dirty_since(rev0)
+        assert not d.full and d.pods == {"p1", "p2"}
+        assert not d.bins and not d.volumes and not d.other
+
+    def test_bind_marks_pod_and_bin(self):
+        c = ClusterState(FakeClock())
+        c.add_pod(_pod(1))
+        rev = c.state_rev
+        c.bind_pod("p1", "node-a")
+        d = c.dirty_since(rev)
+        assert "p1" in d.pods and d.bins
+
+    def test_volume_and_daemonset_kinds(self):
+        c = ClusterState(FakeClock())
+        rev = c.state_rev
+
+        class SC:
+            name = "gp3"
+            binding_mode = "WaitForFirstConsumer"
+            zones = ()
+            provisioner = "ebs.csi.aws.com"
+        c.add_storage_class(SC())
+        assert c.dirty_since(rev).volumes
+        rev = c.state_rev
+        ds = Pod(name="ds1", requests={"cpu": "100m"}, is_daemonset=True)
+        c.add_pod(ds)
+        d = c.dirty_since(rev)
+        assert d.daemonsets and "ds1" not in d.pods
+
+    def test_stale_and_future_revisions_read_full(self):
+        c = ClusterState(FakeClock())
+        assert c.dirty_since(c.state_rev + 5).full
+        # reset = another life: any held revision reads full
+        c.add_pod(_pod(1))
+        rev = c.state_rev
+        c.reset()
+        assert c.dirty_since(rev).full
+
+    def test_add_pod_already_bound_marks_bin(self):
+        """A pod first seen ALREADY BOUND (sync relist, external
+        scheduler) grows its node's used vector — the journal must mark
+        bins or a delta pass reuses stale existing-bin arrays (review
+        finding)."""
+        c = ClusterState(FakeClock())
+        rev = c.state_rev
+        c.add_pod(Pod(name="pb", requests={"cpu": "1"}, node_name="node-a"))
+        d = c.dirty_since(rev)
+        assert "pb" in d.pods and d.bins
+
+    def test_nominated_pods_always_dirty(self):
+        clock = FakeClock()
+        c = ClusterState(clock)
+        c.add_pod(_pod(1))
+        c.nominate("p1", "claim-a", ttl=5.0)
+        rev = c.state_rev
+        # no mutation at all, but the nomination can expire silently
+        d = c.dirty_since(rev)
+        assert "p1" in d.pods
+
+    def test_touched_pods_classification(self):
+        clock = FakeClock()
+        c = ClusterState(clock)
+        c.add_pod(_pod(1))
+        c.add_pod(_pod(2))
+        c.bind_pod("p2", "node-a")
+        c.add_pod(_pod(3))
+        c.nominate("p3", "claim-a", ttl=5.0)
+        st = c.touched_pods(["p1", "p2", "p3", "nope"])
+        assert st["p1"][0] == "pending"
+        assert st["p2"][0] == "bound"
+        assert st["p3"][0] == "nominated"
+        assert st["nope"][0] == "gone"
+        clock.step(10.0)   # nomination expires → pending again
+        assert c.touched_pods(["p3"])["p3"][0] == "pending"
+
+
+# ---------------------------------------------------------------------------
+# incremental builder: gates
+
+
+class TestBuilderGates:
+    def _full(self, builder, pods, pools, lattice, existing=()):
+        return builder.build(pods, pools, lattice, existing=list(existing),
+                             dirty=DirtySet(since=-1, rev=0, full=True))
+
+    def test_cold_then_delta(self, lattice):
+        b = IncrementalProblemBuilder()
+        pods = [_pod(i) for i in range(200)]
+        pools = [NodePool(name="default")]
+        res = self._full(b, pods, pools, lattice)
+        assert not res.incremental and res.reason == "cold"
+        new = Pod(name="new1", requests=SHAPES[0])
+        res2 = b.build(pods + [new], pools, lattice,
+                       dirty=DirtySet(since=0, rev=1, pods={"new1"}),
+                       touched={"new1": ("pending", new)})
+        assert res2.incremental
+        assert res2.problem.count.sum() == 201
+
+    def test_new_signature_rebuilds(self, lattice):
+        b = IncrementalProblemBuilder()
+        pods = [_pod(i) for i in range(50)]
+        pools = [NodePool(name="default")]
+        self._full(b, pods, pools, lattice)
+        odd = Pod(name="odd", requests={"cpu": "7777m", "memory": "3Gi"})
+        res = b.build(pods + [odd], pools, lattice,
+                      dirty=DirtySet(since=0, rev=1, pods={"odd"}),
+                      touched={"odd": ("pending", odd)})
+        assert not res.incremental and res.reason == "new-signature"
+        # the rebuild compiled the new shape: the NEXT churn of that
+        # signature rides the delta path
+        odd2 = Pod(name="odd2", requests={"cpu": "7777m", "memory": "3Gi"})
+        res2 = b.build(pods + [odd, odd2], pools, lattice,
+                       dirty=DirtySet(since=1, rev=2, pods={"odd2"}),
+                       touched={"odd2": ("pending", odd2)})
+        assert res2.incremental
+
+    def test_volume_daemonset_pool_lattice_gates(self, lattice):
+        b = IncrementalProblemBuilder()
+        pods = [_pod(i) for i in range(40)]
+        pools = [NodePool(name="default")]
+        self._full(b, pods, pools, lattice)
+        d = DirtySet(since=0, rev=1)
+        assert not b.build(pods, pools, lattice,
+                           dirty=DirtySet(since=0, rev=1, volumes=True)
+                           ).incremental
+        self._full(b, pods, pools, lattice)
+        assert not b.build(pods, pools, lattice,
+                           dirty=DirtySet(since=0, rev=1, daemonsets=True)
+                           ).incremental
+        self._full(b, pods, pools, lattice)
+        changed = [NodePool(name="default", labels={"rev": "2"})]
+        res = b.build(pods, changed, lattice, dirty=d)
+        assert not res.incremental and res.reason == "pools-changed"
+        self._full(b, pods, pools, lattice)
+        other = build_lattice([s for s in build_catalog()
+                               if s.family in ("m5",)])
+        assert not b.build(pods, pools, other, dirty=d).incremental
+
+    def test_complex_pods_ineligible(self, lattice):
+        from karpenter_provider_aws_tpu.apis.objects import (
+            TopologySpreadConstraint)
+        b = IncrementalProblemBuilder()
+        pods = [_pod(i) for i in range(10)]
+        pods.append(Pod(
+            name="spread", requests={"cpu": "1"}, labels={"app": "w"},
+            topology_spread=[TopologySpreadConstraint(
+                max_skew=1, topology_key=wk.LABEL_ZONE,
+                label_selector=(("app", "w"),))]))
+        pools = [NodePool(name="default")]
+        self._full(b, pods, pools, lattice)
+        res = b.build(pods, pools, lattice, dirty=DirtySet(since=0, rev=1))
+        assert not res.incremental
+
+    def test_bound_pod_selectors_make_ineligible(self, lattice):
+        """A BOUND pod's spread/affinity selector changes how labels
+        project into signatures even when no pending pod has one — the
+        delta path must stand down (review finding: signature_of matches
+        with the empty projection only)."""
+        from karpenter_provider_aws_tpu.apis.objects import (
+            TopologySpreadConstraint)
+        from karpenter_provider_aws_tpu.solver.topology import BoundPod
+        b = IncrementalProblemBuilder()
+        pods = [_pod(i) for i in range(20)]
+        pools = [NodePool(name="default")]
+        spreader = Pod(name="bound-sp", requests={"cpu": "1"},
+                       labels={"app": "w"},
+                       topology_spread=[TopologySpreadConstraint(
+                           max_skew=1, topology_key=wk.LABEL_ZONE,
+                           label_selector=(("app", "w"),))])
+        bound = [BoundPod(pod=spreader, node_name="n1", zone="us-east-1a",
+                          capacity_type="on-demand", node_labels={})]
+        b.build(pods, pools, lattice, bound_pods=bound,
+                dirty=DirtySet(since=-1, rev=0, full=True))
+        res = b.build(pods, pools, lattice, bound_pods=bound,
+                      dirty=DirtySet(since=0, rev=1))
+        assert not res.incremental
+
+    def test_touched_bound_pod_with_affinity_rebuilds(self, lattice):
+        """A pod first seen BOUND carrying anti-affinity must force a
+        full rebuild: only the rebuild compiles bound pods' terms into
+        classes that repel matching pending pods (the k8s symmetry rule;
+        review finding)."""
+        from karpenter_provider_aws_tpu.apis.objects import PodAffinityTerm
+        b = IncrementalProblemBuilder()
+        pods = [_pod(i) for i in range(20)]
+        pools = [NodePool(name="default")]
+        self._full(b, pods, pools, lattice)
+        anti = Pod(name="anti", requests={"cpu": "1"},
+                   labels={"app": "solo"}, node_name="node-1",
+                   pod_affinity=[PodAffinityTerm(
+                       topology_key=wk.LABEL_HOSTNAME, anti=True,
+                       label_selector=(("app", "solo"),))])
+        res = b.build(pods, pools, lattice,
+                      dirty=DirtySet(since=0, rev=1, pods={"anti"},
+                                     bins=True),
+                      touched={"anti": ("bound", anti)})
+        assert not res.incremental and res.reason == "complex-pod-churn"
+
+    def test_count_mismatch_rebuilds(self, lattice):
+        b = IncrementalProblemBuilder()
+        pods = [_pod(i) for i in range(30)]
+        pools = [NodePool(name="default")]
+        self._full(b, pods, pools, lattice)
+        # a pod vanished from pending with NO journal entry (simulated
+        # race): the builder must refuse the delta
+        res = b.build(pods[:-1], pools, lattice,
+                      dirty=DirtySet(since=0, rev=1))
+        assert not res.incremental and res.reason == "count-mismatch"
+
+
+# ---------------------------------------------------------------------------
+# the randomized churn-sequence parity test (the PR's pinned contract)
+
+
+def _plan_key(oracle):
+    """Node-level equivalence key of an ffd_oracle pack: per-bin
+    (type, zone, captype, pod count) multiset + existing-bin loads."""
+    new = sorted((b.tmask.tobytes(), b.zmask.tobytes(), len(b.pods))
+                 for b in oracle.bins if b.pods and not b.is_existing)
+    ex = sorted((b.existing_idx, len(b.pods))
+                for b in oracle.bins if b.pods and b.is_existing)
+    return new, ex, round(oracle.new_node_cost, 6)
+
+
+class TestChurnSequenceParity:
+    @pytest.mark.slow
+    def test_200_random_mutations_parity(self, lattice, solver):
+        self._run_churn(lattice, solver, steps=200, device_every=40)
+
+    def test_60_random_mutations_parity(self, lattice, solver):
+        self._run_churn(lattice, solver, steps=60, device_every=30)
+
+    def _run_churn(self, lattice, solver, steps, device_every):
+        rng = np.random.default_rng(42)
+        clock = FakeClock()
+        cluster = ClusterState(clock)
+        pools = {"default": NodePool(name="default")}
+        unavailable = UnavailableOfferings(clock)
+        from karpenter_provider_aws_tpu.apis.objects import Node
+        # a few registered nodes so existing bins participate
+        types = [n for n in ("m5.xlarge", "m5.2xlarge", "c5.xlarge")
+                 if n in lattice.name_to_idx]
+        for i, t in enumerate(types * 2):
+            cluster.add_node(Node(
+                name=f"node-{i}", provider_id=f"i-{i}", ready=True,
+                node_pool="default",
+                labels={wk.LABEL_INSTANCE_TYPE: t,
+                        wk.LABEL_ZONE: lattice.zones[i % len(lattice.zones)],
+                        wk.LABEL_CAPACITY_TYPE: "on-demand"}))
+        serial = 0
+        for i in range(240):
+            serial += 1
+            cluster.add_pod(_pod(serial))
+        builder = IncrementalProblemBuilder()
+        last_rev = -1
+        incremental_seen = 0
+        for step in range(steps):
+            r = rng.random()
+            if r < 0.45:
+                for _ in range(int(rng.integers(1, 6))):
+                    serial += 1
+                    cluster.add_pod(_pod(serial))
+            elif r < 0.70:
+                pending = cluster.pending_pods()
+                if pending:
+                    victim = pending[int(rng.integers(len(pending)))]
+                    if rng.random() < 0.5:
+                        cluster.delete_pod(victim.name)
+                    else:
+                        cluster.bind_pod(victim.name,
+                                         f"node-{int(rng.integers(6))}")
+            elif r < 0.80:
+                bound = [p for p in cluster.snapshot_pods()
+                         if p.node_name is not None]
+                if bound:
+                    cluster.delete_pod(
+                        bound[int(rng.integers(len(bound)))].name)
+            elif r < 0.90:
+                # ICE churn: a new masked view → lattice-changed gate
+                t = types[int(rng.integers(len(types)))]
+                unavailable.mark_unavailable("ice", "on-demand", t,
+                                             lattice.zones[0])
+            else:
+                # pool template churn → pools-changed gate
+                pools["default"].labels["rev"] = f"r{step}"
+
+            view = masked_view_versioned(lattice, unavailable)
+            dirty = cluster.dirty_since(last_rev)
+            touched = cluster.touched_pods(dirty.pods)
+            pending = cluster.pending_pods()
+            pool_list = list(pools.values())
+            res = builder.build(
+                pending, pool_list, view,
+                existing=lambda: cluster.existing_bins(view),
+                daemonset_pods=cluster.daemonset_pods,
+                bound_pods=cluster.bound_pods,
+                dirty=dirty, touched=touched)
+            last_rev = builder.rev
+            if res.incremental:
+                incremental_seen += 1
+
+            # the pinned contract: plan-equivalent to a from-scratch
+            # rebuild at EVERY step (host FFD referee: deterministic,
+            # cost-exact, node-level)
+            scratch = build_problem(
+                pending, pool_list, view,
+                existing=cluster.existing_bins(view),
+                daemonset_pods=cluster.daemonset_pods(),
+                bound_pods=cluster.bound_pods())
+            assert _plan_key(ffd_oracle(res.problem)) == \
+                _plan_key(ffd_oracle(scratch)), \
+                f"step {step}: incremental problem diverged " \
+                f"(incremental={res.incremental}, reason={res.reason!r})"
+
+            if step and step % device_every == 0:
+                # device-solve parity on sampled steps: same nodes, same
+                # cost through the real solve path
+                p1 = (solver.solve_delta(res.problem,
+                                         dirty_groups=res.dirty_groups)
+                      if res.incremental else solver.solve(res.problem))
+                p2 = solver.solve(scratch)
+                assert abs(p1.new_node_cost - p2.new_node_cost) < 1e-6
+                assert sorted((n.instance_type, n.zone, len(n.pods))
+                              for n in p1.new_nodes) == \
+                    sorted((n.instance_type, n.zone, len(n.pods))
+                           for n in p2.new_nodes)
+        # non-vacuous: the delta path must actually have carried steps
+        assert incremental_seen > steps // 4, \
+            f"only {incremental_seen}/{steps} steps took the delta path"
+
+
+# ---------------------------------------------------------------------------
+# solve_delta counters
+
+
+class TestSolveDelta:
+    def test_counters_and_parity(self, lattice, solver):
+        pods = [_pod(i) for i in range(300)]
+        pools = [NodePool(name="default")]
+        b = IncrementalProblemBuilder()
+        res = b.build(pods, pools, lattice,
+                      dirty=DirtySet(since=-1, rev=0, full=True))
+        solver.solve(res.problem)
+        new = Pod(name="d1", requests=SHAPES[1])
+        res2 = b.build(pods + [new], pools, lattice,
+                       dirty=DirtySet(since=0, rev=1, pods={"d1"}),
+                       touched={"d1": ("pending", new)})
+        assert res2.incremental
+        pre = dict(solver.pipeline_stats)
+        plan = solver.solve_delta(res2.problem,
+                                  dirty_groups=res2.dirty_groups)
+        assert solver.pipeline_stats["delta_solves"] == \
+            pre["delta_solves"] + 1
+        assert solver.pipeline_stats["delta_dirty_groups"] >= \
+            pre["delta_dirty_groups"] + 1
+        ref = solver.solve(build_problem(pods + [new], pools, lattice))
+        assert abs(plan.new_node_cost - ref.new_node_cost) < 1e-6
+        stats = solver.stats()
+        assert "delta_solves" in stats
+        assert "resident_problem_hits" in stats
+
+    def test_solve_delta_restores_pipeline_flag(self, lattice):
+        s = Solver(lattice, pipeline=False)
+        pods = [_pod(i) for i in range(20)]
+        problem = build_problem(pods, [NodePool(name="default")], lattice)
+        s.solve_delta(problem)
+        assert s.pipeline is False
+
+
+# ---------------------------------------------------------------------------
+# SLO warmup window (the cold-compile burn regression)
+
+
+class TestSloWarmupWindow:
+    def test_warmup_drops_cold_samples(self):
+        from karpenter_provider_aws_tpu.events import Recorder
+        from karpenter_provider_aws_tpu.introspect import SloTracker
+        clock = FakeClock()
+        rec = Recorder(clock)
+        slo = SloTracker(clock, recorder=rec, sustain_seconds=0.0)
+        slo.begin_warmup(max_seconds=60.0)
+        # the cold-compile first pass: 1.6 s against the 200 ms budget —
+        # burn ~8, exactly SOAK_r06's spike
+        slo.record_latency(1.6)
+        out = slo.update()
+        assert out["latency_burn"] < 2.0
+        assert not any(e.reason == "SloBudgetBurn" for e in rec.events())
+        slo.end_warmup()
+        slo.record_latency(1.6)
+        clock.step(1.0)
+        out = slo.update()
+        assert out["latency_burn"] > 2.0   # real signal records again
+
+    def test_warmup_window_expires_on_its_own(self):
+        from karpenter_provider_aws_tpu.introspect import SloTracker
+        clock = FakeClock()
+        slo = SloTracker(clock)
+        slo.begin_warmup(max_seconds=10.0)
+        assert slo.warmup_active()
+        clock.step(11.0)
+        assert not slo.warmup_active()
+        slo.record_latency(1.6)
+        assert slo.update()["latency_burn"] > 2.0
+
+    def test_solver_warmup_on_done_fires(self, lattice):
+        s = Solver(lattice)
+        fired = []
+        t = s.warmup(g_buckets=(16,), b_buckets=(32,), background=True,
+                     on_done=lambda: fired.append(True))
+        t.join(timeout=120)
+        assert fired == [True]
+
+
+# ---------------------------------------------------------------------------
+# persistent compile cache + gz artifacts
+
+
+class TestBootSatellites:
+    def test_enable_persistent_compile_cache(self, tmp_path):
+        from karpenter_provider_aws_tpu.solver.solve import (
+            enable_persistent_compile_cache)
+        assert enable_persistent_compile_cache(str(tmp_path))
+        import jax
+        assert jax.config.jax_compilation_cache_dir == str(tmp_path)
+
+    def test_compile_cache_dir_option_env(self, monkeypatch):
+        from karpenter_provider_aws_tpu.operator.options import Options
+        monkeypatch.setenv("COMPILE_CACHE_DIR", "/tmp/kpat-cache")
+        assert Options.from_env().compile_cache_dir == "/tmp/kpat-cache"
+
+    def test_monitor_gz_roundtrip(self, tmp_path):
+        from karpenter_provider_aws_tpu.debug import load_timeseries
+
+        class _FakeMon:
+            pass
+        # go through the real Monitor against a minimal operator-shaped
+        # object is heavy; exercise write/load directly instead
+        from karpenter_provider_aws_tpu.debug import Monitor
+        mon = Monitor.__new__(Monitor)
+        import threading
+        mon.samples = [{"t": 1.0, "nodes": 2, "pending_pods": 0,
+                        "cost_per_hour": 1.5}]
+        mon._lock = threading.Lock()
+        gz = tmp_path / "series.json.gz"
+        plain = tmp_path / "series.json"
+        mon.write(str(gz))
+        mon.write(str(plain))
+        # gz really is gzipped
+        assert gz.read_bytes()[:2] == b"\x1f\x8b"
+        for p in (gz, plain):
+            doc = load_timeseries(str(p))
+            assert doc["samples"][0]["nodes"] == 2
+            assert doc["summary"]["peak_nodes"] == 2
+        # suffix lies → sniffing still loads it
+        renamed = tmp_path / "renamed.json"
+        renamed.write_bytes(gz.read_bytes())
+        assert load_timeseries(str(renamed))["samples"]

@@ -3,7 +3,7 @@
 # tier-1 tests, bench smoke.
 #
 #     bash tools/ci.sh            # the full gate (exit != 0 on any failure)
-#     bash tools/ci.sh --fast     # drift + smoke + tier-1 only (skip bench)
+#     bash tools/ci.sh --fast     # drift + smokes + tier-1 only (skip bench)
 #
 # Mirrors what the reference's `make presubmit` (verify + test) gates:
 #
@@ -17,8 +17,13 @@
 #               registered provider reporting, and run the promtool-style
 #               lint over the live /metrics scrape
 #               (tools/smoke_introspect.py)
-#   3. tier-1 — the full non-slow test suite on the CPU backend
-#   4. bench  — `bench.py --smoke`: one fast config through the real
+#   3. churn  — steady-state delta-solve gate (tools/smoke_delta.py):
+#               boots an operator, drives a full pass + 20 small-churn
+#               passes, asserts the incremental build + delta solve
+#               actually engaged (counter > 0) and the plans match the
+#               full-rebuild referee
+#   4. tier-1 — the full non-slow test suite on the CPU backend
+#   5. bench  — `bench.py --smoke`: one fast config through the real
 #               harness, so a broken solve path can never ride in on a
 #               green unit-test run
 
@@ -30,7 +35,7 @@ PY=${PYTHON:-python}
 FAST=0
 [ "${1:-}" = "--fast" ] && FAST=1
 
-echo "=== ci [1/4] generated-artifact drift ==="
+echo "=== ci [1/5] generated-artifact drift ==="
 $PY tools/gen_crds.py --check
 tmp=$(mktemp -d)
 trap 'rm -rf "$tmp"' EXIT
@@ -45,17 +50,20 @@ done
 [ "$stale" = 0 ] || exit 1
 echo "drift: clean"
 
-echo "=== ci [2/4] introspection smoke + metrics lint ==="
+echo "=== ci [2/5] introspection smoke + metrics lint ==="
 $PY tools/smoke_introspect.py
 
-echo "=== ci [3/4] tier-1 tests ==="
+echo "=== ci [3/5] steady-state delta churn smoke ==="
+$PY tools/smoke_delta.py
+
+echo "=== ci [4/5] tier-1 tests ==="
 $PY -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider
 
 if [ "$FAST" = 1 ]; then
-    echo "=== ci [4/4] bench smoke: SKIPPED (--fast) ==="
+    echo "=== ci [5/5] bench smoke: SKIPPED (--fast) ==="
 else
-    echo "=== ci [4/4] bench smoke ==="
+    echo "=== ci [5/5] bench smoke ==="
     $PY bench.py --smoke
 fi
 

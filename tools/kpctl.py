@@ -512,11 +512,16 @@ def _render_top(doc, server: str):
         f"({g('provisioner', 'last_pass_pods'):g} pods)   "
         f"pipeline {'on' if g('solver', 'pipeline') else 'off'}   "
         f"async {g('solver', 'async_solves'):g}   "
+        f"delta {g('solver', 'delta_solves'):g} "
+        f"({g('solver', 'delta_dirty_groups'):g} dirty grp)   "
         f"degraded {degraded:g}")
     rh, rm = g("solver", "resident_hits"), g("solver", "resident_misses")
     hitpct = 100.0 * rh / (rh + rm) if (rh + rm) else 0.0
+    ph = g("solver", "resident_problem_hits")
+    pm = g("solver", "resident_problem_misses")
     lines.append(
         f"CACHES    resident {hitpct:.0f}% hit ({rh:g}/{rh + rm:g})   "
+        f"problem {ph:g}/{ph + pm:g}   "
         f"ICE {g('ice_cache', 'live'):g}   "
         f"est-cache {g('solver', 'est_cache_entries'):g}")
     lines.append(
@@ -579,6 +584,39 @@ def cmd_top(c: Client, args) -> int:
             time.sleep(args.interval)
         except KeyboardInterrupt:
             return 0
+
+
+def cmd_soak(c, args) -> int:
+    """Summarize a soak/monitor time-series artifact — a LOCAL file, no
+    server needed. Reads both plain ``.json`` and gzipped ``.json.gz``
+    forms (debug.load_timeseries sniffs the magic, not the suffix)."""
+    import pathlib
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    from karpenter_provider_aws_tpu.debug import load_timeseries
+    doc = load_timeseries(args.path)
+    summ = doc.get("summary", {})
+    samples = doc.get("samples", [])
+    print(f"soak artifact {args.path}")
+    print(f"  samples {len(samples)}   wall "
+          f"{summ.get('wall_seconds', 0):g}s")
+    print(f"  peak nodes {summ.get('peak_nodes', 0):g}   "
+          f"peak pending {summ.get('peak_pending_pods', 0):g}   "
+          f"peak cost/hr {summ.get('peak_cost_per_hour', 0):g}")
+    if "peak_latency_burn" in summ:
+        print(f"  peak latency burn {summ['peak_latency_burn']:g}   "
+              f"peak cost burn {summ.get('peak_cost_burn', 0):g}")
+    final = summ.get("final", {})
+    slo = final.get("subsystems", {}).get("slo", {})
+    if slo:
+        print(f"  final latency burn {slo.get('latency_burn', 0):g} "
+              f"(p50 {slo.get('latency_p50_ms', 0):g}ms)   "
+              f"warmup dropped {slo.get('warmup_dropped', 0):g}")
+    solver = final.get("subsystems", {}).get("solver", {})
+    if solver:
+        print(f"  final delta solves {solver.get('delta_solves', 0):g}   "
+              f"resident-problem hits "
+              f"{solver.get('resident_problem_hits', 0):g}")
+    return 0
 
 
 def cmd_evict(c: Client, args) -> int:
@@ -668,14 +706,22 @@ def main(argv=None) -> int:
                          "(default stdout)")
     tr.set_defaults(fn=cmd_trace)
 
+    sk = sub.add_parser(
+        "soak", help="summarize a soak time-series artifact (local file, "
+                     ".json or .json.gz — no server needed)")
+    sk.add_argument("path")
+    sk.set_defaults(fn=cmd_soak, local=True)
+
     args = p.parse_args(argv)
-    if not args.server:
-        raise SystemExit("--server (or KPCTL_SERVER) is required")
-    token = args.token
-    if args.token_file:
-        token = open(args.token_file).read().strip()
-    c = Client(args.server, token=token, cacert=args.cacert,
-               insecure=args.insecure_skip_tls_verify)
+    c = None
+    if not getattr(args, "local", False):
+        if not args.server:
+            raise SystemExit("--server (or KPCTL_SERVER) is required")
+        token = args.token
+        if args.token_file:
+            token = open(args.token_file).read().strip()
+        c = Client(args.server, token=token, cacert=args.cacert,
+                   insecure=args.insecure_skip_tls_verify)
     try:
         rc = args.fn(c, args)
         # flush INSIDE the try: for outputs under the pipe buffer the

@@ -55,7 +55,10 @@ class _Bucket(Generic[T, U]):
         # can LINK its fused-call span back to every caller it served
         self.pending: List[Tuple[T, Future, object]] = []
         self.wakeup = threading.Event()
-        self.lock = threading.Lock()
+        # instrumented (introspect/contention.py): producer-vs-drain
+        # contention on the bucket queue
+        from ..introspect import contention
+        self.lock = contention.lock("batcher_bucket")
         self.thread: threading.Thread = None
         self.started_at: float = 0.0
         # occupancy counters (introspect/ providers read these through

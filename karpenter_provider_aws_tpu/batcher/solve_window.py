@@ -48,7 +48,8 @@ class SolveWindow:
         self.timeout = timeout
         self._batcher: Batcher = Batcher(
             self._drain, options or SOLVE_WINDOW_OPTIONS)
-        self._lock = threading.Lock()
+        from ..introspect import contention
+        self._lock = contention.lock("solve_window")
         # observability: how often the window actually fused callers
         self.batches = 0
         self.coalesced = 0      # requests that shared a drain with others

@@ -114,6 +114,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir", default=None,
                    help="Write a JAX profiler (xprof) trace of every device "
                         "solve under this directory.")
+    p.add_argument("--profile", action="store_true",
+                   help="Run the whole-process wall-clock sampling "
+                        "profiler (docs/reference/profiling.md): a daemon "
+                        "thread samples every thread's stack at "
+                        "--profile-hz into a bounded folded-stack store, "
+                        "served at /debug/pprof/profile (folded / "
+                        "Chrome-trace forms) on both the metrics server "
+                        "and the REST apiserver; kpctl profile "
+                        "capture|top|diff is the CLI. Lock/queue "
+                        "contention accounting and the device cost model "
+                        "report regardless; this flag adds the stack "
+                        "sampler (<5%% overhead measured, zero when off).")
+    p.add_argument("--profile-hz", type=float, default=50.0,
+                   help="Sampling frequency for --profile (default 50).")
+    p.add_argument("--profile-captures", type=int, default=8,
+                   help="Burn-triggered profile+contention snapshots "
+                        "retained (flight-recorder-style ring): a "
+                        "sustained SLO burn or a grossly over-budget "
+                        "pass captures evidence at /debug/pprof/captures.")
     p.add_argument("--trace", action="store_true",
                    help="Enable request-scoped tracing + the flight "
                         "recorder (docs/reference/tracing.md): causal "
@@ -313,8 +332,10 @@ def start_server(op: Operator, port: int,
             self.wfile.write(body)
 
         def do_GET(self):
+            encoding = None
             if self.path.startswith("/debug/statusz") or \
-                    self.path.startswith("/debug/vars"):
+                    self.path.startswith("/debug/vars") or \
+                    self.path.startswith("/debug/pprof"):
                 # the introspection surfaces (docs/reference/
                 # introspection.md), mounted here like /debug/traces so
                 # deployments without --api-port still reach them
@@ -327,6 +348,9 @@ def start_server(op: Operator, port: int,
                     self.send_error(404)
                     return
                 body, ctype = rendered
+                from .kube.httpserver import maybe_gzip
+                body, encoding = maybe_gzip(
+                    body, self.headers.get("Accept-Encoding"))
             elif self.path.startswith("/debug/traces"):
                 # the flight recorder's read surface, also mounted here so
                 # deployments without --api-port still reach their traces
@@ -347,6 +371,12 @@ def start_server(op: Operator, port: int,
             elif self.path == "/metrics":
                 body = op.metrics.render().encode()
                 ctype = "text/plain; version=0.0.4"
+                # the scrape grew with the per-offering gauge surface and
+                # the new lock-wait histogram; Prometheus sends
+                # Accept-Encoding: gzip on every scrape
+                from .kube.httpserver import maybe_gzip
+                body, encoding = maybe_gzip(
+                    body, self.headers.get("Accept-Encoding"))
             elif self.path in ("/healthz", "/readyz"):
                 # the reference's liveness probe is the cloud connectivity
                 # check (main.go:44 cloud-provider healthz)
@@ -361,6 +391,8 @@ def start_server(op: Operator, port: int,
                 return
             self.send_response(200)
             self.send_header("Content-Type", ctype)
+            if encoding:
+                self.send_header("Content-Encoding", encoding)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -390,6 +422,11 @@ def main(argv: Optional[Sequence[str]] = None,
         trace.enable(FlightRecorder(
             ring=args.trace_ring, retained=args.trace_retained,
             latency_budget_ms=args.trace_latency_budget_ms))
+    if args.profile:
+        # likewise before the operator build: boot compile cost is
+        # usually exactly what a profile is for
+        from . import introspect
+        introspect.enable_profiling(hz=args.profile_hz)
     api_token = None
     if args.api_token_file:
         api_token = open(args.api_token_file).read().strip()
@@ -443,6 +480,19 @@ def main(argv: Optional[Sequence[str]] = None,
     # ring series behind /debug/vars?series=1 and kpctl top. One provider
     # fan-out per second — off every hot path by construction.
     op.sampler.start(interval=1.0)
+    op.burn_capture.resize(args.profile_captures)
+    if args.profile:
+        # the device cost model fills from a lowering-only trace of the
+        # warm ladder (no XLA compile, no execution) so measured-vs-
+        # modeled attribution works from the first real solve; the AOT
+        # warmup path below records the same analyses from its compiled
+        # handles
+        capture_fn = getattr(op.solver, "capture_cost_model", None)
+        if capture_fn is not None:   # RemoteSolver solves out-of-process
+            threading.Thread(
+                target=lambda: capture_fn(
+                    node_pools_count=len(op.node_pools)),
+                name="costmodel-capture", daemon=True).start()
 
     stop = stop_event or threading.Event()
 
@@ -515,6 +565,11 @@ def main(argv: Optional[Sequence[str]] = None,
                 stop.wait(args.step)
     finally:
         op.sampler.stop()
+        if args.profile:
+            from . import introspect
+            prof = introspect.profiler_instance()
+            if prof is not None:
+                prof.stop()
         if runtime is not None:
             runtime.stop()
         if args.profile_dir:

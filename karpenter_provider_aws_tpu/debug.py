@@ -155,6 +155,20 @@ class Monitor:
                     b.get("latency_burn", 0.0) for b in burns)
                 out["peak_cost_burn"] = max(
                     b.get("cost_burn", 0.0) for b in burns)
+            # the contention envelope (introspect/contention.py): the
+            # worst lock wait any sample saw, and which lock —
+            # `kpctl soak` prints it next to the burn peaks
+            peak_lock, peak_wait = None, 0.0
+            for s in self.samples:
+                cont = s.get("subsystems", {}).get("contention", {})
+                for k, v in cont.items():
+                    if k.endswith("_max_wait_ms") and isinstance(
+                            v, (int, float)) and v > peak_wait:
+                        peak_wait = v
+                        peak_lock = k[: -len("_max_wait_ms")]
+            if peak_lock is not None:
+                out["peak_lock_wait_ms"] = round(peak_wait, 3)
+                out["peak_lock_wait_lock"] = peak_lock
             return out
 
     def write(self, path: str) -> None:

@@ -29,18 +29,24 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional
 
+from . import contention
+from .profiler import BurnCapture, SamplingProfiler
 from .registry import IntrospectRegistry, StatsProvider
 from .sampler import Sampler
 from .slo import SloTracker
 
 __all__ = [
     "IntrospectRegistry", "Sampler", "SloTracker", "StatsProvider",
+    "SamplingProfiler", "BurnCapture", "contention",
     "registry", "sampler", "set_sampler", "statusz_text", "vars_doc",
-    "debug_doc",
+    "debug_doc", "profiler_instance", "set_profiler", "enable_profiling",
+    "profiler_stats", "burn_capture", "set_burn_capture",
 ]
 
 _REGISTRY = IntrospectRegistry()
 _SAMPLER: Optional[Sampler] = None
+_PROFILER: Optional[SamplingProfiler] = None
+_BURN_CAPTURE: Optional[BurnCapture] = None
 _STARTED_AT = time.time()
 
 
@@ -57,6 +63,47 @@ def sampler() -> Optional[Sampler]:
 def set_sampler(s: Optional[Sampler]) -> None:
     global _SAMPLER
     _SAMPLER = s
+
+
+# ---- the sampling profiler (docs/reference/profiling.md) ------------------
+
+def profiler_instance() -> Optional[SamplingProfiler]:
+    """The published whole-process sampling profiler, or None when
+    profiling is off (the default — nothing is constructed, sampled, or
+    allocated until ``enable_profiling``/``set_profiler``)."""
+    return _PROFILER
+
+
+def set_profiler(p: Optional[SamplingProfiler]) -> None:
+    global _PROFILER
+    _PROFILER = p
+
+
+def enable_profiling(hz: float = 50.0) -> SamplingProfiler:
+    """Construct, publish, and start the daemon sampler (the CLI's
+    ``--profile``). Idempotent-ish: an already-published profiler is
+    restarted rather than replaced (its aggregate survives)."""
+    global _PROFILER
+    if _PROFILER is None:
+        _PROFILER = SamplingProfiler(hz=hz)
+    return _PROFILER.start()
+
+
+def profiler_stats() -> Dict:
+    """The ``profiler`` introspection provider: stats when running, the
+    explicit disabled marker otherwise (a provider must never be
+    empty)."""
+    p = _PROFILER
+    return p.stats() if p is not None else {"enabled": 0.0}
+
+
+def burn_capture() -> Optional[BurnCapture]:
+    return _BURN_CAPTURE
+
+
+def set_burn_capture(bc: Optional[BurnCapture]) -> None:
+    global _BURN_CAPTURE
+    _BURN_CAPTURE = bc
 
 
 # ---- the two debug documents ---------------------------------------------
@@ -107,7 +154,8 @@ def statusz_text() -> str:
 
 
 def debug_doc(path: str, query: Dict[str, List[str]]):
-    """Route /debug/statusz and /debug/vars for an HTTP handler.
+    """Route /debug/statusz, /debug/vars, and /debug/pprof/* for an
+    HTTP handler.
 
     Returns ``(body_bytes, content_type)`` or None when the path is not
     ours — the same shape both kube/httpserver.py and cli.py mount next
@@ -120,4 +168,50 @@ def debug_doc(path: str, query: Dict[str, List[str]]):
         series = query.get("series", ["0"])[0] in ("1", "true")
         return (json.dumps(vars_doc(include_series=series)).encode(),
                 "application/json")
+    if p.startswith("/debug/pprof"):
+        return _pprof_doc(p, query)
+    return None
+
+
+def _pprof_doc(p: str, query: Dict[str, List[str]]):
+    """The profiling read surface (docs/reference/profiling.md):
+
+        /debug/pprof/profile                folded collapsed stacks (text;
+                                            the flamegraph.pl/speedscope
+                                            input), ?format=json|chrome
+        /debug/pprof/contention             lock/queue accounting (JSON)
+        /debug/pprof/device                 device cost model (JSON)
+        /debug/pprof/captures               burn-triggered snapshots (JSON)
+    """
+    import json
+
+    def _json(doc):
+        return json.dumps(doc).encode(), "application/json"
+
+    if p == "/debug/pprof/profile":
+        fmt = query.get("format", ["folded"])[0]
+        prof = _PROFILER
+        if prof is None:
+            if fmt == "folded":
+                return (b"# profiler disabled (--profile)\n",
+                        "text/plain; charset=utf-8")
+            return _json({"enabled": False})
+        if fmt == "chrome":
+            return _json(prof.to_chrome())
+        if fmt == "json":
+            try:
+                n = min(max(int(query.get("n", ["40"])[0]), 1), 1000)
+            except ValueError:
+                n = 40
+            return _json({**prof.stats(), "top": prof.top(n)})
+        return prof.folded().encode(), "text/plain; charset=utf-8"
+    if p == "/debug/pprof/contention":
+        return _json(contention.detail())
+    if p == "/debug/pprof/device":
+        from ..solver import costmodel
+        return _json(costmodel.model().summary())
+    if p == "/debug/pprof/captures":
+        bc = _BURN_CAPTURE
+        return _json(bc.doc() if bc is not None else
+                     {"captures": [], "total": 0})
     return None

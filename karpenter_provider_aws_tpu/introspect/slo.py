@@ -88,6 +88,23 @@ class SloTracker:
         # thread's on_done (with max_seconds as the crash backstop).
         self._warmup_until = float("-inf")
         self.warmup_dropped = 0
+        # observers of the sustained-burn edge. ``on_sustained(kind,
+        # burn, detail)`` fires EXACTLY where the SloBudgetBurn event
+        # does — once per episode, re-armed on recovery — so a
+        # burn-triggered profile capture (introspect/profiler.py
+        # BurnCapture) inherits the episode semantics for free.
+        # ``_capture`` additionally sees every recorded pass latency
+        # (its own slow-pass trigger).
+        self.on_sustained: Optional[Callable[[str, float, str], None]] = None
+        self._capture = None
+
+    def attach_capture(self, capture) -> None:
+        """Wire a BurnCapture: sustained episodes AND grossly
+        over-budget single passes snapshot profile+contention evidence
+        (docs/reference/profiling.md)."""
+        self._capture = capture
+        if capture is not None:
+            self.on_sustained = capture.on_sustained_burn
 
     # ---- boot warmup window ----------------------------------------------
 
@@ -117,6 +134,14 @@ class SloTracker:
                 self.warmup_dropped += 1
                 return
             self._lat.append((now, float(seconds)))
+        cap = self._capture
+        if cap is not None:
+            # outside the lock: a capture walks profiler/contention
+            # state and must never serialize the recording hot path
+            try:
+                cap.note_latency(float(seconds))
+            except Exception:
+                pass   # evidence collection must not fail provisioning
 
     def record_cost_ratio(self, ratio: float) -> None:
         with self._lock:
@@ -211,11 +236,18 @@ class SloTracker:
                     and now - self._over_since[kind] >= self.sustain_seconds):
                 self._fired[kind] = True
                 fire = True
-        if fire and self._recorder is not None:
-            self._recorder.publish(
-                "Warning", "SloBudgetBurn", "Provisioner", "default",
-                f"{kind} budget burn {burn:.2f} sustained "
-                f">{self.sustain_seconds:.0f}s ({detail})")
+        if fire:
+            if self._recorder is not None:
+                self._recorder.publish(
+                    "Warning", "SloBudgetBurn", "Provisioner", "default",
+                    f"{kind} budget burn {burn:.2f} sustained "
+                    f">{self.sustain_seconds:.0f}s ({detail})")
+            cb = self.on_sustained
+            if cb is not None:
+                try:
+                    cb(kind, burn, detail)
+                except Exception:
+                    pass   # a capture bug must not break burn tracking
 
     # ---- introspection provider -------------------------------------------
 

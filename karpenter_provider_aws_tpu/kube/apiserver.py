@@ -106,7 +106,11 @@ class Watch:
     def __init__(self, kind: str):
         self.kind = kind
         self._events: deque = deque()
-        self._cond = threading.Condition()
+        # instrumented (introspect/contention.py): lock-wait on the
+        # condition is fan-out contention; wait() time is accounted
+        # separately as QUEUE wait (a parked watcher is not contention)
+        from ..introspect import contention
+        self._cond = contention.condition("watch_event")
         self._stopped = False
 
     def _push(self, ev: WatchEvent) -> None:
@@ -140,7 +144,10 @@ class FakeAPIServer:
         deletionTimestamp on finalizer-gated deletes, like the real
         apiserver stamps deletion times itself. Defaults to wall clock."""
         self._clock = clock
-        self._lock = threading.RLock()
+        # instrumented (introspect/contention.py): EVERY verb and every
+        # watch push serializes here — the watch fan-out's convoy lock
+        from ..introspect import contention
+        self._lock = contention.rlock("api_server")
         self._rv = itertools.count(1)
         self._store: Dict[str, Dict[str, dict]] = {k: {} for k in KINDS}
         self._history: Dict[str, deque] = {

@@ -100,6 +100,23 @@ class TLSThreadingHTTPServer(ThreadingHTTPServer):
         super().finish_request(request, client_address)
 
 
+def maybe_gzip(body: bytes, accept_encoding: Optional[str],
+               min_bytes: int = 256) -> Tuple[bytes, Optional[str]]:
+    """Gzip a response body when the client advertised support.
+
+    Returns ``(body, content_encoding-or-None)``. The introspection
+    payloads this serves grew real: /debug/vars?series=1 carries 600-
+    sample rings x per-subsystem series (hundreds of KB) and a kpctl
+    top session polls it every 2 s — so both debug surfaces and /metrics
+    honor ``Accept-Encoding: gzip``. Tiny bodies pass through (the
+    header costs more than it saves)."""
+    if not accept_encoding or "gzip" not in accept_encoding.lower() \
+            or len(body) < min_bytes:
+        return body, None
+    import gzip
+    return gzip.compress(body, compresslevel=6), "gzip"
+
+
 def make_http_server(addr, handler, certfile: Optional[str] = None,
                      keyfile: Optional[str] = None) -> ThreadingHTTPServer:
     """The one place HTTP(S) servers are built (REST apiserver + the
@@ -235,8 +252,12 @@ def serve(server: FakeAPIServer, port: int = 0,
                                                 parse_qs(url.query))
                 if rendered is not None:
                     body, ctype = rendered
+                    body, enc = maybe_gzip(
+                        body, self.headers.get("Accept-Encoding"))
                     self.send_response(200)
                     self.send_header("Content-Type", ctype)
+                    if enc:
+                        self.send_header("Content-Encoding", enc)
                     self.send_header("Content-Length", str(len(body)))
                     # every response carries the server clock (the PR 2
                     # invariant _json enforces): a kpctl session that

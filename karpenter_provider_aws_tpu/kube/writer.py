@@ -41,7 +41,11 @@ class WriterCounts:
 
     def _init_counts(self) -> None:
         self.counts: Dict[str, int] = {}
-        self._counts_lock = threading.Lock()
+        # instrumented (introspect/contention.py): every write verb
+        # passes through here — contention means the write path itself
+        # is the serializer
+        from ..introspect import contention
+        self._counts_lock = contention.lock("writer")
 
     def _count(self, verb: str, n: int = 1) -> None:
         with self._counts_lock:

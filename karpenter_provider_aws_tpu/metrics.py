@@ -334,6 +334,16 @@ def wire_core_metrics(reg: Registry) -> Dict[str, _Metric]:
             "Wall-clock share of one scheduling solve per pipeline stage "
             "(stage: build | upload | compute | download | decode).",
             ("stage",)),
+        # lock contention accounting (introspect/contention.py): wait to
+        # acquire a hot control-plane lock, observed ONLY on contention
+        # (the uncontended path records nothing). Labeled by lock name —
+        # cluster_state, solver_solve, api_server, batcher_bucket,
+        # solve_window, writer, flight_recorder, watch_event.
+        "lock_wait": reg.histogram(
+            "karpenter_lock_wait_seconds",
+            "Time a thread blocked acquiring a contended control-plane "
+            "lock, by lock.", ("lock",),
+            buckets=(0.00005, 0.0002, 0.001, 0.005, 0.02, 0.1, 0.5, 2.0)),
         # reference metrics.md:62,16,19
         "pods_startup_time": reg.histogram(
             "karpenter_pods_startup_time_seconds",

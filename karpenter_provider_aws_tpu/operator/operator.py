@@ -326,6 +326,26 @@ class Operator:
             trace.recorder().introspect_stats()
             if trace.recorder() is not None else {"enabled": False}))
         reg.register("slo", self.slo.stats)
+        # the attribution layer (docs/reference/profiling.md): lock/queue
+        # contention accounting, the whole-process sampling profiler
+        # (a disabled marker until --profile publishes one), the device
+        # cost model, and burn-triggered capture retention
+        from ..introspect import contention
+        from ..solver import costmodel
+        contention.attach_metrics(
+            self.metrics.get("karpenter_lock_wait_seconds"))
+        reg.register("contention", contention.stats)
+        reg.register("profiler", introspect.profiler_stats)
+        reg.register("device", costmodel.model().stats)
+        # burn-triggered capture: the SLO tracker's exactly-once-per-
+        # episode sustained edge (and its per-pass slow-pass trigger)
+        # snapshot profile+contention+device evidence into a bounded ring
+        self.burn_capture = introspect.BurnCapture(
+            self.clock,
+            latency_budget_seconds=self.slo.latency_budget_seconds)
+        self.slo.attach_capture(self.burn_capture)
+        introspect.set_burn_capture(self.burn_capture)
+        reg.register("burn_captures", self.burn_capture.stats)
         # build info: the constant-1 info gauge dashboards join on
         try:
             import jax

@@ -41,6 +41,7 @@ from ..errors import (SolverCapacityError, SolverDeviceError, SolverError,
                       is_retryable_solver_error)
 from ..lattice.tensors import Lattice
 from ..ops import binpack
+from . import costmodel
 from .faults import FaultInjector
 from .pipeline import ResidentInputCache, StageTimer, fetch_async
 from .problem import Problem
@@ -323,7 +324,10 @@ class Solver:
         # sidecar, and in-process controllers can all reach this Solver
         # concurrently, and solve/probe mutate shared caches (_b_hint, the
         # price-version re-upload). Serialize every public entry point.
-        self._solve_lock = threading.RLock()
+        # Instrumented (introspect/contention.py): solve-lock wait is
+        # exactly "how long a caller queued behind another solve".
+        from ..introspect import contention
+        self._solve_lock = contention.rlock("solver_solve")
         # per group-bucket: (fresh-estimate bucket, bucket actually needed)
         # of the last solve. A same-or-larger fresh estimate starts at the
         # size that worked (each overflow retry costs a full device round
@@ -665,13 +669,18 @@ class Solver:
             NP = max(node_pools_count, 1)
             A = max(affinity_classes, 1)
 
-            def compile_only(fn, *args, **static):
+            def compile_only(fn, *args, key=None, **static):
                 """Compile without running: .lower().compile() populates
                 the SAME jit cache (and the persistent on-disk cache) the
-                real solve hits, minus the kernel execution."""
+                real solve hits, minus the kernel execution. ``key``
+                names the shape in the device cost model — the compiled
+                handle already carries XLA's FLOPs/bytes/peak-HBM
+                analysis, so warmup is where the model fills for free."""
                 if aot:
                     try:
-                        fn.lower(*args, **static).compile()
+                        compiled = fn.lower(*args, **static).compile()
+                        if key is not None:
+                            costmodel.model().record_compiled(key, compiled)
                         return
                     except Exception:
                         pass   # fall through to the executing path
@@ -690,7 +699,8 @@ class Solver:
                                 binpack.pack_packed_efused,
                                 self._alloc, self._avail, self._price,
                                 gbuf, init, 0, B, G, lat.T, lat.Z, lat.C,
-                                NP, A, lean=True)
+                                NP, A, key=costmodel.shape_key(G, B),
+                                lean=True)
                     if probes:
                         for K in self._K_BUCKETS[:2]:
                             with self._solve_lock:
@@ -708,6 +718,38 @@ class Solver:
                 except Exception:
                     pass   # a callback bug must not kill the warmup thread
         return None
+
+    def capture_cost_model(self, node_pools_count: int = 1,
+                           affinity_classes: int = 1,
+                           g_buckets: Sequence[int] = WARM_G_BUCKETS,
+                           b_buckets: Sequence[int] = WARM_B_BUCKETS) -> int:
+        """Fill the device cost model (solver/costmodel.py) for the
+        given bucket ladder by LOWERING each shape — tracing only, no
+        XLA compile, no kernel execution — and recording XLA's
+        FLOPs/bytes analysis. Cheap enough to run at boot even without
+        ``--warm-start``; the AOT warmup path records the same analyses
+        from its compiled handles. Returns shapes captured."""
+        lat = self.lattice
+        NP = max(node_pools_count, 1)
+        A = max(affinity_classes, 1)
+        captured = 0
+        for G in g_buckets:
+            _, g_total = binpack.group_layout(G, lat.T, lat.Z, lat.C,
+                                              NP, A, R)
+            gbuf = jnp.asarray(np.zeros((g_total,), np.uint8))
+            for B in b_buckets:
+                try:
+                    with self._solve_lock:
+                        lowered = binpack.pack_packed_efused.lower(
+                            self._alloc, self._avail, self._price,
+                            gbuf, None, 0, B, G, lat.T, lat.Z, lat.C,
+                            NP, A, lean=True)
+                    if costmodel.model().record_compiled(
+                            costmodel.shape_key(G, B), lowered):
+                        captured += 1
+                except Exception:
+                    continue   # a shape that cannot lower has no model
+        return captured
 
     # ---- profiling (xprof hook) ----
 
@@ -1113,6 +1155,12 @@ class Solver:
         prep = None
         while True:
             self._maybe_inject_device_fault()
+            # per-DISPATCH compute baseline: StageTimer accumulates
+            # across overflow-regrow retries, but the cost model must
+            # attribute only the FINAL dispatch's compute to the final
+            # (G,B) shape — a retried solve is not "the device ran 2x
+            # slower than its demonstrated best"
+            compute_ms0 = stages.ms.get("compute", 0.0)
             td = time.perf_counter()
             # at most ONE group+pool upload and one small init upload
             # (fused into a single combined transfer on the sequential
@@ -1211,6 +1259,13 @@ class Solver:
         plan.warnings = list(problem.warnings)
         plan.stage_ms = stages.ms
         plan.pipelined = pipelined
+        # attribute the FINAL dispatch's measured compute to this (G,B)
+        # shape's cost model: last-vs-best per shape is the "was the
+        # DEVICE slow, or was it everything around it" signal kpctl top
+        # and burn captures render (solver/costmodel.py)
+        costmodel.model().observe_solve(
+            costmodel.shape_key(G, B),
+            stages.ms.get("compute", 0.0) - compute_ms0)
         if pipelined:
             # once per completed solve (not per overflow-regrow dispatch):
             # this is the "overlap engaged" evidence soak/bench assert on

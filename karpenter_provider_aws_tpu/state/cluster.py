@@ -70,7 +70,11 @@ class DirtySet:
 class ClusterState:
     def __init__(self, clock: Optional[Clock] = None):
         self._clock = clock or Clock()
-        self._lock = threading.RLock()
+        # instrumented (introspect/contention.py): the mirror's lock is
+        # the most-acquired lock in the process — wait/hold accounting
+        # shows when API-mode churn turns it into a convoy
+        from ..introspect import contention
+        self._lock = contention.rlock("cluster_state")
         self.pods: Dict[str, Pod] = {}
         self.nodes: Dict[str, Node] = {}
         self.claims: Dict[str, NodeClaim] = {}

@@ -79,7 +79,10 @@ class ImportedSpan:
 class FlightRecorder:
     def __init__(self, ring: int = 256, retained: int = 64,
                  latency_budget_ms: float = 1000.0):
-        self._lock = threading.Lock()
+        # instrumented (introspect/contention.py): every span end takes
+        # this lock; contention here means tracing itself is a bottleneck
+        from ..introspect import contention
+        self._lock = contention.lock("flight_recorder")
         self.ring_size = max(int(ring), 1)
         self.retained_size = max(int(retained), 1)
         self.latency_budget_ms = float(latency_budget_ms)

@@ -57,14 +57,40 @@ def get_logger(component: str) -> "StructuredLogger":
     return StructuredLogger(logging.getLogger(f"{_ROOT}.{component}"))
 
 
+def _current_span():
+    """The ambient trace span, or None. Imported lazily (and cached) so
+    this module stays importable before/without the trace package."""
+    global _trace_current
+    if _trace_current is None:
+        try:
+            from ..trace import current as _trace_current
+        except Exception:
+            def _trace_current():
+                return None
+    return _trace_current()
+
+
+_trace_current = None
+
+
 class StructuredLogger:
-    """Thin facade adding key=value fields: log.info("msg", key=val)."""
+    """Thin facade adding key=value fields: log.info("msg", key=val).
+
+    A line emitted inside an active trace span carries ``trace=<id>``
+    automatically, so grep output correlates with ``/debug/traces``
+    (`kpctl trace show <id>`) and with burn-triggered profile captures —
+    the log line, the span tree, and the profile snapshot of one slow
+    pass all share the id. Free when tracing is off (one attribute
+    read, trace/span.py's disabled fast path)."""
 
     def __init__(self, logger: logging.Logger):
         self._logger = logger
 
     def _log(self, level: int, msg: str, kv: dict) -> None:
         if self._logger.isEnabledFor(level):
+            sp = _current_span()
+            if sp is not None and "trace" not in kv:
+                kv["trace"] = sp.trace_id
             self._logger.log(level, msg, extra={"kv": kv})
 
     def debug(self, msg: str, **kv) -> None:

@@ -1,0 +1,829 @@
+"""Continuous-profiling layer tests (docs/reference/profiling.md).
+
+Covers the tentpole contracts of introspect/profiler.py,
+introspect/contention.py, and solver/costmodel.py:
+
+- sampling profiler: folded-stack capture of live threads, bounded
+  store, Chrome export, FakeClock stamping, the disabled path (nothing
+  constructed, nothing allocated, endpoints report the marker),
+- contention accounting: uncontended fast path records NO samples,
+  contended acquires record wait + owner-at-contention tag, re-entrant
+  hold spans, condition queue-wait kept apart from lock-wait, the
+  karpenter_lock_wait_seconds histogram, the set_enabled(False)
+  pass-through,
+- device cost model: compile-time analysis capture (both jax return
+  shapes), measured-vs-modeled attribution, bounded shape set,
+- burn-triggered capture lifecycle (FakeClock, no sleeps): sustained
+  burn -> exactly one retained capture per episode, re-armed on
+  recovery, bounded retention under repeated episodes; the slow-pass
+  trigger's arm/cooldown; warmup-window passes never trigger,
+- operator wiring + both HTTP mounts (/debug/pprof/*), the gzip
+  negotiation satellite, log-line trace correlation, and the kpctl
+  profile/top surfaces.
+"""
+
+import gzip
+import json
+import logging
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from karpenter_provider_aws_tpu import introspect, trace
+from karpenter_provider_aws_tpu.apis import Pod
+from karpenter_provider_aws_tpu.cloud import FakeCloud
+from karpenter_provider_aws_tpu.introspect import (BurnCapture,
+                                                   SamplingProfiler,
+                                                   SloTracker, contention)
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+from karpenter_provider_aws_tpu.operator import Operator, Options
+from karpenter_provider_aws_tpu.solver.costmodel import (DeviceCostModel,
+                                                         shape_key)
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+_FAMILIES = ("m5", "c5")
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    return build_lattice([s for s in build_catalog()
+                          if s.family in _FAMILIES])
+
+
+@pytest.fixture()
+def env(lattice):
+    clock = FakeClock()
+    return Operator(options=Options(registration_delay=1.0),
+                    lattice=lattice, cloud=FakeCloud(clock), clock=clock)
+
+
+def _parked_thread(name="parked-worker"):
+    """A thread parked in a recognizably-named function, for sampling."""
+    ev = threading.Event()
+
+    def distinctive_parking_spot():
+        ev.wait(10.0)
+
+    t = threading.Thread(target=distinctive_parking_spot, name=name,
+                         daemon=True)
+    t.start()
+    time.sleep(0.02)   # let it reach the wait
+    return t, ev
+
+
+class TestSamplingProfiler:
+    def test_folded_capture_of_live_threads(self):
+        prof = SamplingProfiler(hz=100)
+        t, ev = _parked_thread()
+        try:
+            for _ in range(3):
+                prof.sample_once()
+        finally:
+            ev.set()
+            t.join()
+        folded = prof.folded()
+        assert "distinctive_parking_spot" in folded
+        # thread prefix, root-first order, trailing count
+        line = next(ln for ln in folded.splitlines()
+                    if "distinctive_parking_spot" in ln)
+        assert line.startswith("parked-worker;")
+        stack, _, count = line.rpartition(" ")
+        assert int(count) >= 3
+        frames = stack.split(";")
+        # the leaf is the innermost wait, the named fn sits above it
+        assert frames.index(next(
+            f for f in frames if "distinctive_parking_spot" in f)) \
+            < len(frames) - 1
+
+    def test_thread_name_cardinality_normalized(self):
+        prof = SamplingProfiler()
+        t, ev = _parked_thread(name="Thread-123 (run)")
+        try:
+            prof.sample_once()
+        finally:
+            ev.set()
+            t.join()
+        assert any(k.startswith("Thread-NNN (run);")
+                   for k in prof.folded().splitlines())
+
+    def test_bounded_store_drops_overflow(self):
+        prof = SamplingProfiler(max_stacks=2)
+        with prof._lock:
+            prof._counts = {"a;b 1": 1, "c;d 1": 1}
+        t, ev = _parked_thread(name="overflow-w")
+        try:
+            prof.sample_once()
+        finally:
+            ev.set()
+            t.join()
+        assert prof.dropped_stacks >= 1
+        assert len(prof._counts) == 2
+
+    def test_top_inclusive_and_self(self):
+        prof = SamplingProfiler()
+        with prof._lock:
+            prof._counts = {"t;a;b": 3, "t;a;c": 2, "t;a": 1}
+        top = {d["frame"]: d for d in prof.top(10)}
+        assert top["a"]["inclusive"] == 6
+        assert top["a"]["self"] == 1
+        assert top["b"]["inclusive"] == 3 and top["b"]["self"] == 3
+
+    def test_chrome_export_merges_consecutive_samples(self):
+        prof = SamplingProfiler(hz=10)
+        with prof._lock:
+            prof._raw.extend([
+                (1.0, "w", ("a", "b")),
+                (1.1, "w", ("a", "b")),
+                (1.2, "w", ("a", "c")),
+            ])
+        doc = prof.to_chrome()
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        by_name = {}
+        for e in xs:
+            by_name.setdefault(e["name"], []).append(e)
+        # 'a' spans all three samples as ONE merged event
+        assert len(by_name["a"]) == 1
+        assert by_name["a"][0]["dur"] >= 0.2 * 1e6
+        # 'b' closed when the stack diverged; 'c' opened after
+        assert len(by_name["b"]) == 1 and len(by_name["c"]) == 1
+        metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        assert any(e["name"] == "thread_name" for e in metas)
+
+    def test_fakeclock_stamps_sample_times(self):
+        clock = FakeClock(start=500.0)
+        prof = SamplingProfiler(hz=10, clock=clock)
+        t, ev = _parked_thread(name="clocked-w")
+        try:
+            prof.sample_once()
+            clock.step(5.0)
+            prof.sample_once()
+        finally:
+            ev.set()
+            t.join()
+        with prof._lock:
+            times = sorted({t for t, _, _ in prof._raw})
+        assert times == [500.0, 505.0]
+
+    def test_daemon_lifecycle_and_self_measured_overhead(self):
+        prof = SamplingProfiler(hz=200).start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while prof.samples < 5 and time.monotonic() < deadline:
+                time.sleep(0.01)
+        finally:
+            prof.stop()
+        assert prof.samples >= 5
+        stats = prof.stats()
+        assert stats["avg_sample_ms"] > 0
+        assert stats["running"] == 0.0   # stopped
+
+    def test_disabled_path_allocates_nothing(self):
+        """The zero-overhead-when-disabled pin: no published profiler,
+        no sampler thread, the provider reports the marker, and the
+        endpoint serves the disabled body."""
+        assert introspect.profiler_instance() is None
+        assert not any(t.name == "sampling-profiler"
+                       for t in threading.enumerate())
+        assert introspect.profiler_stats() == {"enabled": 0.0}
+        body, ctype = introspect.debug_doc("/debug/pprof/profile", {})
+        assert b"disabled" in body
+        doc = json.loads(introspect.debug_doc(
+            "/debug/pprof/profile", {"format": ["json"]})[0])
+        assert doc == {"enabled": False}
+
+    def test_reset(self):
+        prof = SamplingProfiler()
+        with prof._lock:
+            prof._counts["x;y"] = 1
+        prof.samples = 3
+        prof.reset()
+        assert prof.folded() == "" and prof.samples == 0
+
+
+class TestContention:
+    def test_uncontended_fast_path_records_no_waits(self):
+        lk = contention.lock("t_uncontended")
+        for _ in range(5):
+            with lk:
+                pass
+        st = lk.stats
+        assert st.acquisitions == 5
+        assert st.contended == 0
+        assert st.wait_total_s == 0.0
+        assert st.owner_tags == {}
+
+    def test_contended_acquire_records_wait_and_owner_tag(self):
+        lk = contention.lock("t_contended")
+        entered = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with lk:
+                entered.set()
+                release.wait(5.0)
+
+        t = threading.Thread(target=holder, daemon=True)
+        t.start()
+        assert entered.wait(5.0)
+        waited = threading.Event()
+
+        def waiter():
+            with lk:
+                waited.set()
+
+        w = threading.Thread(target=waiter, daemon=True)
+        w.start()
+        time.sleep(0.05)   # let the waiter actually block
+        release.set()
+        assert waited.wait(5.0)
+        t.join(5.0)
+        w.join(5.0)
+        st = lk.stats
+        assert st.contended >= 1
+        assert st.wait_total_s > 0
+        assert st.max_wait_s > 0
+        # the waiter resolved the holder's frame at contention time
+        assert st.owner_tags
+        assert any(":" in tag for tag in st.owner_tags)
+        # the holder's hold time (covering the blocked window) recorded
+        assert st.max_hold_s > 0
+
+    def test_reentrant_hold_is_one_span(self):
+        lk = contention.rlock("t_reentrant")
+        with lk:
+            with lk:
+                pass
+        st = lk.stats
+        assert st.acquisitions == 2
+        assert st.holds == 1   # first-acquire -> last-release
+
+    def test_condition_queue_wait_separate_from_lock_wait(self):
+        cond = contention.condition("t_cond")
+        got = []
+
+        def consumer():
+            with cond:
+                while not got:
+                    cond.wait(5.0)
+
+        t = threading.Thread(target=consumer, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        with cond:
+            got.append(1)
+            cond.notify_all()
+        t.join(5.0)
+        st = contention._stats_for("t_cond")
+        assert st.qwaits >= 1
+        assert st.qwait_total_s > 0
+        # parked wait() time is NOT lock contention
+        flat = st.flat()
+        assert flat["t_cond_qwait_total_ms"] > 0
+
+    def test_set_enabled_false_is_pass_through(self):
+        lk = contention.lock("t_disabled")
+        contention.set_enabled(False)
+        try:
+            with lk:
+                pass
+            assert lk.stats.acquisitions == 0
+        finally:
+            contention.set_enabled(True)
+        with lk:
+            pass
+        assert lk.stats.acquisitions == 1
+
+    def test_nonblocking_probe_and_is_owned(self):
+        lk = contention.lock("t_probe")
+        assert lk.acquire(blocking=False)
+        assert lk._is_owned()
+        assert not lk.acquire(blocking=False)   # held; probe fails clean
+        lk.release()
+        assert not lk._is_owned()
+
+    def test_metric_histogram_observes_on_contention(self):
+        from karpenter_provider_aws_tpu.metrics import (Registry,
+                                                        lint_exposition,
+                                                        wire_core_metrics)
+        reg = Registry()
+        wire_core_metrics(reg)
+        hist = reg.get("karpenter_lock_wait_seconds")
+        contention.attach_metrics(hist)
+        try:
+            lk = contention.lock("t_metric")
+            entered, release = threading.Event(), threading.Event()
+
+            def holder():
+                with lk:
+                    entered.set()
+                    release.wait(5.0)
+
+            t = threading.Thread(target=holder, daemon=True)
+            t.start()
+            assert entered.wait(5.0)
+            w = threading.Thread(target=lambda: lk.acquire() and
+                                 lk.release(), daemon=True)
+            w.start()
+            time.sleep(0.05)
+            release.set()
+            t.join(5.0)
+            w.join(5.0)
+            assert hist.count(lock="t_metric") >= 1
+            assert not lint_exposition(reg.render())
+        finally:
+            contention.attach_metrics(None)
+
+    def test_stats_flat_and_top_waits(self):
+        lk = contention.lock("t_flat")
+        with lk:
+            pass
+        flat = contention.stats()
+        assert flat["t_flat_acquisitions"] >= 1
+        assert "t_flat_wait_p99_ms" in flat
+        doc = contention.detail()
+        assert "t_flat" in doc["locks"]
+        assert doc["locks"]["t_flat"]["acquisitions"] >= 1
+        # top_waits only ranks locks that actually contended
+        assert all(n != "t_flat" for n, _, _ in contention.top_waits(50)) \
+            or contention._stats_for("t_flat").contended > 0
+
+
+class TestDeviceCostModel:
+    def test_observe_solve_calibrates_best(self):
+        m = DeviceCostModel()
+        key = shape_key(64, 512)
+        m.observe_solve(key, 10.0)
+        m.observe_solve(key, 4.0)
+        m.observe_solve(key, 8.0)
+        s = m.stats()
+        assert s["last_compute_ms"] == 8.0
+        assert s["last_model_ms"] == 4.0
+        assert s["last_vs_model"] == 2.0
+        assert m.summary()["shapes"][key]["solves"] == 3
+
+    def test_record_compiled_handles_both_jax_shapes(self):
+        class CompiledDict:
+            def cost_analysis(self):
+                return {"flops": 100.0, "bytes accessed": 200.0}
+
+            def memory_analysis(self):
+                class MA:
+                    temp_size_in_bytes = 10
+                    output_size_in_bytes = 20
+                    argument_size_in_bytes = 30
+                return MA()
+
+        class CompiledList(CompiledDict):
+            def cost_analysis(self):
+                return [{"flops": 7.0, "bytes accessed": 9.0}]
+
+        m = DeviceCostModel()
+        assert m.record_compiled("k1", CompiledDict())
+        assert m.record_compiled("k2", CompiledList())
+        s = m.summary()["shapes"]
+        assert s["k1"]["flops"] == 100.0
+        assert s["k1"]["peak_bytes"] == 60.0
+        assert s["k2"]["flops"] == 7.0
+
+    def test_analysis_failure_is_contained(self):
+        class Broken:
+            def cost_analysis(self):
+                raise RuntimeError("backend says no")
+
+            def memory_analysis(self):
+                raise RuntimeError("no")
+
+        m = DeviceCostModel()
+        assert not m.record_compiled("k", Broken())
+        assert m.capture_errors == 1
+        assert m.stats()["shapes"] == 0
+
+    def test_shape_set_bounded(self):
+        import karpenter_provider_aws_tpu.solver.costmodel as cm
+        m = DeviceCostModel()
+        for i in range(cm._MAX_SHAPES + 10):
+            m.observe_solve(f"G{i}_B1", 1.0)
+        assert len(m._shapes) == cm._MAX_SHAPES
+
+    def test_solver_lowering_capture_fills_model(self, env):
+        """capture_cost_model lowers (no compile, no execute) one warm
+        shape and records XLA's real analysis."""
+        from karpenter_provider_aws_tpu.solver import costmodel
+        costmodel.model().reset()
+        n = env.solver.capture_cost_model(g_buckets=(16,), b_buckets=(32,))
+        assert n == 1
+        rec = costmodel.model().summary()["shapes"][shape_key(16, 32)]
+        assert rec["flops"] > 0 or rec["bytes_accessed"] > 0
+
+
+class TestBurnCaptureLifecycle:
+    def _rig(self, retain=8):
+        clock = FakeClock()
+        slo = SloTracker(clock)
+        bc = BurnCapture(clock, retain=retain,
+                         latency_budget_seconds=slo.latency_budget_seconds)
+        slo.attach_capture(bc)
+        return clock, slo, bc
+
+    def _burn_episode(self, clock, slo):
+        """Drive one sustained latency-burn episode to its firing edge."""
+        for _ in range(8):
+            slo.record_latency(1.0)   # 5x the 200 ms budget, under the
+            clock.step(1.0)           # slow-pass threshold (2 s)
+        slo.update()                  # episode opens
+        clock.step(slo.sustain_seconds + 1.0)
+        for _ in range(3):
+            slo.record_latency(1.0)   # keep the window hot
+        slo.update()                  # sustained -> fires
+
+    def _recover(self, clock, slo):
+        clock.step(slo.window_seconds + 1.0)   # window empties
+        slo.update()                           # burn 0 -> re-arm
+
+    def test_one_capture_per_episode_rearmed_on_recovery(self):
+        clock, slo, bc = self._rig()
+        self._burn_episode(clock, slo)
+        assert bc.capture_count == 1
+        assert bc.captures[0]["reason"] == "slo-latency-burn"
+        assert bc.captures[0]["burn"] > 1.0
+        # still burning: the episode must not fire again
+        for _ in range(5):
+            slo.record_latency(1.0)
+            clock.step(1.0)
+            slo.update()
+        assert bc.capture_count == 1
+        # recovery re-arms; the next episode captures again
+        self._recover(clock, slo)
+        self._burn_episode(clock, slo)
+        assert bc.capture_count == 2
+
+    def test_bounded_retention_under_repeated_episodes(self):
+        clock, slo, bc = self._rig(retain=3)
+        for _ in range(7):
+            self._burn_episode(clock, slo)
+            self._recover(clock, slo)
+        assert bc.capture_count == 7
+        assert len(bc.captures) == 3           # flight-recorder bound
+        episodes = [c["episode"] for c in bc.captures]
+        assert episodes == [5, 6, 7]           # newest retained
+
+    def test_slow_pass_trigger_arm_and_cooldown(self):
+        clock, slo, bc = self._rig()
+        slo.record_latency(3.0)        # grossly over (10x budget = 2 s)
+        assert bc.capture_count == 1
+        assert bc.captures[-1]["reason"] == "slow-pass"
+        slo.record_latency(3.0)        # disarmed: no capture storm
+        assert bc.capture_count == 1
+        slo.record_latency(0.05)       # within budget, but cooldown holds
+        slo.record_latency(3.0)
+        assert bc.capture_count == 1
+        clock.step(bc.cooldown_seconds + 1.0)
+        slo.record_latency(0.05)       # within budget AFTER cooldown
+        slo.record_latency(3.0)        # re-armed
+        assert bc.capture_count == 2
+
+    def test_warmup_passes_never_trigger(self):
+        clock, slo, bc = self._rig()
+        slo.begin_warmup()
+        slo.record_latency(30.0)       # cold compile
+        assert bc.capture_count == 0
+        assert slo.warmup_dropped == 1
+
+    def test_capture_embeds_profile_contention_device_evidence(self):
+        clock, _, bc = self._rig()
+        prof = SamplingProfiler(hz=100)
+        t, ev = _parked_thread(name="evidence-w")
+        try:
+            prof.sample_once()
+        finally:
+            ev.set()
+            t.join()
+        introspect.set_profiler(prof)
+        try:
+            lk = contention.lock("t_evidence")
+            with lk:
+                pass
+            snap = bc.capture("manual")
+        finally:
+            introspect.set_profiler(None)
+        assert snap["profile"]["samples"] == 1
+        assert any("distinctive_parking_spot" in d["frame"]
+                   for d in snap["profile"]["top"])
+        assert "contention" in snap and "device" in snap
+        assert snap["episode"] == 1
+
+    def test_capture_bug_never_breaks_burn_tracking(self):
+        clock, slo, _ = self._rig()
+
+        class Exploding:
+            def on_sustained_burn(self, *a):
+                raise RuntimeError("boom")
+
+            def note_latency(self, *a):
+                raise RuntimeError("boom")
+
+        slo.attach_capture(Exploding())
+        self._burn_episode(clock, slo)   # must not raise
+        assert slo.update()["latency_burn"] > 1.0
+
+
+class TestOperatorWiringAndHttp:
+    def test_providers_registered_and_capture_attached(self, env):
+        names = introspect.registry().names()
+        for n in ("contention", "profiler", "device", "burn_captures"):
+            assert n in names
+        assert env.slo._capture is env.burn_capture
+        assert env.slo.on_sustained == env.burn_capture.on_sustained_burn
+        assert introspect.burn_capture() is env.burn_capture
+        # hot locks report from the first mirror mutation
+        env.cluster.add_pod(Pod(name="wire-0",
+                                requests={"cpu": "100m", "memory": "1Gi"}))
+        flat = contention.stats()
+        assert flat["cluster_state_acquisitions"] > 0
+
+    def test_solve_observes_cost_model(self, env):
+        from karpenter_provider_aws_tpu.solver import costmodel
+        before = dict(costmodel.model()._shapes)
+        for i in range(3):
+            env.cluster.add_pod(Pod(name=f"cm-{i}",
+                                    requests={"cpu": "500m",
+                                              "memory": "1Gi"}))
+        env.settle(max_rounds=20)
+        stats = costmodel.model().stats()
+        assert stats["shapes"] >= max(len(before), 1)
+        assert stats.get("last_compute_ms", 0) > 0
+
+    @pytest.fixture()
+    def served(self, env):
+        from karpenter_provider_aws_tpu.cli import start_server
+        prof = introspect.enable_profiling(hz=100)
+        server = start_server(env, 0)
+        yield env, f"http://127.0.0.1:{server.server_address[1]}"
+        server.shutdown()
+        prof.stop()
+        introspect.set_profiler(None)
+
+    def test_pprof_routes_on_metrics_server(self, served):
+        env, base = served
+        deadline = time.monotonic() + 5.0
+        prof = introspect.profiler_instance()
+        while prof.samples < 3 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        folded = urllib.request.urlopen(
+            base + "/debug/pprof/profile", timeout=10).read().decode()
+        assert folded.strip()
+        cont = json.loads(urllib.request.urlopen(
+            base + "/debug/pprof/contention", timeout=10).read())
+        assert "cluster_state" in cont["locks"]
+        dev = json.loads(urllib.request.urlopen(
+            base + "/debug/pprof/device", timeout=10).read())
+        assert "shapes" in dev
+        caps = json.loads(urllib.request.urlopen(
+            base + "/debug/pprof/captures", timeout=10).read())
+        assert "captures" in caps
+
+    def test_pprof_routes_on_rest_apiserver(self, lattice):
+        from karpenter_provider_aws_tpu.kube import FakeAPIServer
+        from karpenter_provider_aws_tpu.kube.httpserver import serve
+        clock = FakeClock()
+        api = FakeAPIServer()
+        Operator(options=Options(registration_delay=1.0), lattice=lattice,
+                 cloud=FakeCloud(clock), clock=clock, api_server=api)
+        httpd = serve(api, 0)
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            cont = json.loads(urllib.request.urlopen(
+                base + "/debug/pprof/contention", timeout=10).read())
+            assert "api_server" in cont["locks"]
+            # the PR 2 invariant: the new mounts carry X-Server-Time too
+            resp = urllib.request.urlopen(
+                base + "/debug/pprof/device", timeout=10)
+            assert float(resp.headers["X-Server-Time"]) > 0
+        finally:
+            httpd.shutdown()
+
+    def test_gzip_negotiation_on_vars_and_metrics(self, served):
+        env, base = served
+        env.sampler.sample_once()
+        for path, parse in (("/debug/vars?series=1", json.loads),
+                            ("/metrics", lambda b: b)):
+            req = urllib.request.Request(
+                base + path, headers={"Accept-Encoding": "gzip"})
+            resp = urllib.request.urlopen(req, timeout=10)
+            assert resp.headers.get("Content-Encoding") == "gzip", path
+            parse(gzip.decompress(resp.read()))
+            # a client that did NOT opt in gets identity, untouched
+            plain = urllib.request.urlopen(base + path, timeout=10)
+            assert plain.headers.get("Content-Encoding") is None
+            parse(plain.read())
+
+    def test_gzip_on_rest_apiserver_vars(self, lattice):
+        from karpenter_provider_aws_tpu.kube import FakeAPIServer
+        from karpenter_provider_aws_tpu.kube.httpserver import serve
+        clock = FakeClock()
+        op = Operator(options=Options(registration_delay=1.0),
+                      lattice=lattice, cloud=FakeCloud(clock), clock=clock,
+                      api_server=FakeAPIServer())
+        op.sampler.sample_once()
+        httpd = serve(op.api_server, 0)
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{httpd.server_address[1]}"
+                "/debug/vars?series=1",
+                headers={"Accept-Encoding": "gzip"})
+            resp = urllib.request.urlopen(req, timeout=10)
+            assert resp.headers.get("Content-Encoding") == "gzip"
+            json.loads(gzip.decompress(resp.read()))
+        finally:
+            httpd.shutdown()
+
+    def test_tiny_bodies_skip_gzip(self):
+        from karpenter_provider_aws_tpu.kube.httpserver import maybe_gzip
+        body, enc = maybe_gzip(b"ok", "gzip")
+        assert body == b"ok" and enc is None
+        big = b"x" * 4096
+        zipped, enc = maybe_gzip(big, "gzip, deflate")
+        assert enc == "gzip" and gzip.decompress(zipped) == big
+        assert maybe_gzip(big, None) == (big, None)
+
+
+class TestLogTraceCorrelation:
+    def _capture_logs(self, fn):
+        from karpenter_provider_aws_tpu.utils.logging import (_KVFormatter,
+                                                              get_logger)
+        log = get_logger("test_profiler")
+        records = []
+        h = logging.Handler()
+        h.emit = records.append
+        h.setFormatter(_KVFormatter())
+        log._logger.addHandler(h)
+        log._logger.setLevel(logging.INFO)
+        log._logger.propagate = False
+        try:
+            fn(log)
+        finally:
+            log._logger.removeHandler(h)
+        return [_KVFormatter().format(r) for r in records]
+
+    def test_log_inside_span_carries_trace_id(self):
+        from karpenter_provider_aws_tpu.trace import FlightRecorder
+        trace.enable(FlightRecorder())
+        try:
+            out = {}
+
+            def go(log):
+                with trace.span("corr.test") as sp:
+                    out["tid"] = sp.trace_id
+                    log.info("inside", k=1)
+                log.info("outside")
+
+            lines = self._capture_logs(go)
+        finally:
+            trace.disable()
+        assert f"trace={out['tid']}" in lines[0]
+        assert "k=1" in lines[0]
+        assert "trace=" not in lines[1]
+
+    def test_log_without_tracing_unchanged(self):
+        lines = self._capture_logs(lambda log: log.info("plain", a=2))
+        assert "trace=" not in lines[0]
+        assert "a=2" in lines[0]
+
+    def test_explicit_trace_kv_wins(self):
+        from karpenter_provider_aws_tpu.trace import FlightRecorder
+        trace.enable(FlightRecorder())
+        try:
+            lines = self._capture_logs(
+                lambda log: log.info("x", trace="mine"))
+        finally:
+            trace.disable()
+        assert "trace=mine" in lines[0]
+
+
+class TestKpctlSurfaces:
+    @pytest.fixture()
+    def kpctl(self, monkeypatch):
+        import pathlib
+        monkeypatch.syspath_prepend(str(
+            pathlib.Path(__file__).resolve().parent.parent / "tools"))
+        import kpctl
+        return kpctl
+
+    def test_top_renders_contention_device_profiler_rows(self, kpctl):
+        doc = {"providers": {
+            "contention": {"locks": 3,
+                           "api_server_wait_p99_ms": 12.0,
+                           "api_server_contended": 40,
+                           "cluster_state_wait_p99_ms": 5.0,
+                           "cluster_state_contended": 10,
+                           "writer_wait_p99_ms": 0.0,
+                           "writer_contended": 0},
+            "device": {"last_compute_ms": 12.5, "last_model_ms": 10.0,
+                       "last_vs_model": 1.25, "shapes": 4,
+                       "bytes_in_use": 0},
+            "profiler": {"enabled": 1.0, "samples": 500, "hz": 50,
+                         "unique_stacks": 42, "overhead_pct": 1.2},
+            "burn_captures": {"retained": 2, "total": 5},
+        }}
+        lines = kpctl._render_top(doc, "srv")
+        cont = next(l for l in lines if l.startswith("CONTENTION"))
+        assert "api_server p99 12.0ms (40x)" in cont
+        # ranked by p99, zero-wait locks dropped
+        assert cont.index("api_server") < cont.index("cluster_state")
+        assert "writer" not in cont
+        dev = next(l for l in lines if l.startswith("DEVICE"))
+        assert "1.25x" in dev
+        prof = next(l for l in lines if l.startswith("PROFILER"))
+        assert "overhead 1.2%" in prof
+        slo = next(l for l in lines if l.startswith("SLO"))
+        assert "captures 2" in slo
+
+    def test_profile_top_and_capture_live(self, kpctl, env, capsys,
+                                          tmp_path):
+        from karpenter_provider_aws_tpu.cli import start_server
+        prof = introspect.enable_profiling(hz=200)
+        server = start_server(env, 0)
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            deadline = time.monotonic() + 5.0
+            while prof.samples < 5 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert kpctl.main(["--server", base, "profile", "top"]) == 0
+            out = capsys.readouterr().out
+            assert "samples" in out and "FRAME" in out
+            dest = tmp_path / "prof.folded"
+            assert kpctl.main(["--server", base, "profile", "capture",
+                               "-o", str(dest)]) == 0
+            assert dest.read_text().strip()
+        finally:
+            server.shutdown()
+            prof.stop()
+            introspect.set_profiler(None)
+
+    def test_profile_capture_reports_disabled(self, kpctl, env, capsys,
+                                              tmp_path):
+        from karpenter_provider_aws_tpu.cli import start_server
+        assert introspect.profiler_instance() is None
+        server = start_server(env, 0)
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            assert kpctl.main(["--server", base, "profile",
+                               "capture"]) == 1
+            assert "not running" in capsys.readouterr().err
+            # every FORMAT detects the disabled marker — a chrome
+            # capture must never write a useless {"enabled": false} stub
+            # and exit 0 (regression)
+            dest = tmp_path / "stub.json"
+            assert kpctl.main(["--server", base, "profile", "capture",
+                               "--format", "chrome",
+                               "-o", str(dest)]) == 1
+            assert "not running" in capsys.readouterr().err
+            assert not dest.exists()
+        finally:
+            server.shutdown()
+
+    def test_profile_diff(self, kpctl, tmp_path, capsys):
+        a = tmp_path / "a.folded"
+        b = tmp_path / "b.folded"
+        a.write_text("t;main;slow_fn 10\nt;main;ok_fn 5\n")
+        b.write_text("t;main;slow_fn 2\nt;main;ok_fn 5\n")
+        assert kpctl.main(["profile", "diff", str(a), str(b)]) == 0
+        out = capsys.readouterr().out
+        assert "slow_fn" in out and "-8" in out
+        assert "ok_fn" not in out   # unchanged frames dropped
+
+    def test_soak_summary_prints_peak_lock_wait(self, kpctl, tmp_path,
+                                                capsys):
+        art = tmp_path / "soak.json"
+        art.write_text(json.dumps({
+            "samples": [{"t": 1.0, "nodes": 1, "pending_pods": 0,
+                         "cost_per_hour": 0.1, "subsystems": {}}],
+            "summary": {"wall_seconds": 60, "peak_nodes": 5,
+                        "peak_pending_pods": 2, "peak_cost_per_hour": 1.0,
+                        "peak_latency_burn": 0.5, "peak_cost_burn": 0.0,
+                        "peak_lock_wait_ms": 42.5,
+                        "peak_lock_wait_lock": "api_server",
+                        "final": {"subsystems": {"burn_captures": {
+                            "total": 3, "retained": 2,
+                            "last_reason": "slo-latency-burn"}}}},
+        }))
+        assert kpctl.main(["soak", str(art)]) == 0
+        out = capsys.readouterr().out
+        assert "peak lock wait 42.5ms (api_server)" in out
+        assert "burn captures 3" in out
+
+    def test_monitor_summary_computes_lock_peak(self, env):
+        from karpenter_provider_aws_tpu.debug import Monitor
+        mon = Monitor(env)
+        mon.samples = [
+            {"t": 1.0, "nodes": 0, "pending_pods": 0, "cost_per_hour": 0,
+             "subsystems": {"contention": {"a_max_wait_ms": 5.0}}},
+            {"t": 2.0, "nodes": 0, "pending_pods": 0, "cost_per_hour": 0,
+             "subsystems": {"contention": {"a_max_wait_ms": 9.0,
+                                           "b_max_wait_ms": 3.0}}},
+        ]
+        summ = mon.summary()
+        assert summ["peak_lock_wait_ms"] == 9.0
+        assert summ["peak_lock_wait_lock"] == "a"

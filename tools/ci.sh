@@ -22,8 +22,14 @@
 #               passes, asserts the incremental build + delta solve
 #               actually engaged (counter > 0) and the plans match the
 #               full-rebuild referee
-#   4. tier-1 — the full non-slow test suite on the CPU backend
-#   5. bench  — `bench.py --smoke`: one fast config through the real
+#   4. prof   — continuous-profiling gate (tools/smoke_profile.py):
+#               boots an operator with the sampling profiler on, drives
+#               a pass over live HTTP, asserts non-empty folded stacks,
+#               contention counters for every instrumented hot lock,
+#               the gzip negotiation, and the live scrape (with the new
+#               karpenter_lock_wait_seconds family) linting clean
+#   5. tier-1 — the full non-slow test suite on the CPU backend
+#   6. bench  — `bench.py --smoke`: one fast config through the real
 #               harness, so a broken solve path can never ride in on a
 #               green unit-test run
 
@@ -35,7 +41,7 @@ PY=${PYTHON:-python}
 FAST=0
 [ "${1:-}" = "--fast" ] && FAST=1
 
-echo "=== ci [1/5] generated-artifact drift ==="
+echo "=== ci [1/6] generated-artifact drift ==="
 $PY tools/gen_crds.py --check
 tmp=$(mktemp -d)
 trap 'rm -rf "$tmp"' EXIT
@@ -50,20 +56,23 @@ done
 [ "$stale" = 0 ] || exit 1
 echo "drift: clean"
 
-echo "=== ci [2/5] introspection smoke + metrics lint ==="
+echo "=== ci [2/6] introspection smoke + metrics lint ==="
 $PY tools/smoke_introspect.py
 
-echo "=== ci [3/5] steady-state delta churn smoke ==="
+echo "=== ci [3/6] steady-state delta churn smoke ==="
 $PY tools/smoke_delta.py
 
-echo "=== ci [4/5] tier-1 tests ==="
+echo "=== ci [4/6] continuous-profiling smoke ==="
+$PY tools/smoke_profile.py
+
+echo "=== ci [5/6] tier-1 tests ==="
 $PY -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider
 
 if [ "$FAST" = 1 ]; then
-    echo "=== ci [5/5] bench smoke: SKIPPED (--fast) ==="
+    echo "=== ci [6/6] bench smoke: SKIPPED (--fast) ==="
 else
-    echo "=== ci [5/5] bench smoke ==="
+    echo "=== ci [6/6] bench smoke ==="
     $PY bench.py --smoke
 fi
 

@@ -49,11 +49,17 @@ class Client:
         else:
             self.ctx = None
 
-    def request(self, method: str, path: str, doc=None, stream=False):
+    def request(self, method: str, path: str, doc=None, stream=False,
+                raw=False):
+        # Accept-Encoding: gzip on non-streaming requests — the series
+        # payloads a `kpctl top`/`profile` session polls are large
+        # (600-sample rings x subsystems) and the server compresses them
+        # ~20x (kube/httpserver.py maybe_gzip)
         r = urllib.request.Request(
             f"{self.server}{path}", method=method,
             data=None if doc is None else json.dumps(doc).encode(),
             headers={"Content-Type": "application/json",
+                     **({} if stream else {"Accept-Encoding": "gzip"}),
                      **({"Authorization": f"Bearer {self.token}"}
                         if self.token else {})})
         resp = urllib.request.urlopen(r, timeout=None if stream else 30,
@@ -65,7 +71,13 @@ class Client:
         if stream:
             return resp
         with resp:
-            return json.loads(resp.read() or b"{}")
+            body = resp.read()
+            if resp.headers.get("Content-Encoding") == "gzip":
+                import gzip
+                body = gzip.decompress(body)
+            if raw:
+                return body
+            return json.loads(body or b"{}")
 
 
 def _parse_server_time(st):
@@ -546,13 +558,44 @@ def _render_top(doc, server: str):
     lines.append(
         f"EVENTS    {g('events', 'published'):g} published "
         f"({g('events', 'warnings'):g} warnings)")
+    # top-3 contended locks by wait p99 (the contention provider's
+    # flattened `<lock>_wait_p99_ms` keys; introspect/contention.py)
+    cont = p.get("contention", {})
+    ranked = sorted(
+        ((k[:-len("_wait_p99_ms")], v, cont.get(
+            k[:-len("_wait_p99_ms")] + "_contended", 0))
+         for k, v in cont.items()
+         if k.endswith("_wait_p99_ms") and isinstance(v, (int, float))
+         and v > 0),
+        key=lambda t: -t[1])[:3]
+    if cont:
+        lines.append("CONTENTION " + ("   ".join(
+            f"{name} p99 {_fmt_ms(p99)} ({int(n):d}x)"
+            for name, p99, n in ranked) or "(no contended locks)"))
+    # measured-vs-modeled device attribution (solver/costmodel.py)
+    dev = p.get("device", {})
+    if dev.get("last_compute_ms"):
+        lines.append(
+            f"DEVICE    compute {_fmt_ms(dev.get('last_compute_ms'))} "
+            f"(model {_fmt_ms(dev.get('last_model_ms'))}, "
+            f"{dev.get('last_vs_model', 0):.2f}x)   "
+            f"shapes {dev.get('shapes', 0):g}   "
+            f"hbm {dev.get('bytes_in_use', 0) / 2**20:.0f}MiB")
+    prof = p.get("profiler", {})
+    if prof.get("enabled"):
+        lines.append(
+            f"PROFILER  {prof.get('samples', 0):g} samples @ "
+            f"{prof.get('hz', 0):g}Hz   "
+            f"{prof.get('unique_stacks', 0):g} stacks   "
+            f"overhead {prof.get('overhead_pct', 0):.1f}%")
     slo = p.get("slo", {})
     lines.append(
         f"SLO       latency burn {slo.get('latency_burn', 0):.2f} "
         f"(p50 {_fmt_ms(slo.get('latency_p50_ms'))} / "
         f"{slo.get('latency_budget_ms', 200):g}ms)   "
         f"cost burn {slo.get('cost_burn', 0):.2f} "
-        f"(ratio {slo.get('cost_ratio_p50', 0):.4f})")
+        f"(ratio {slo.get('cost_ratio_p50', 0):.4f})   "
+        f"captures {p.get('burn_captures', {}).get('retained', 0):g}")
     fr = p.get("flight_recorder", {})
     if fr.get("enabled", True) is not False:
         lines.append(
@@ -586,6 +629,119 @@ def cmd_top(c: Client, args) -> int:
             return 0
 
 
+def _load_folded(path) -> dict:
+    """A collapsed-stack file → {folded_stack: count} (comment lines and
+    blanks skipped)."""
+    out = {}
+    raw = sys.stdin.read() if path == "-" else open(path).read()
+    for line in raw.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        stack, _, count = line.rpartition(" ")
+        try:
+            out[stack] = out.get(stack, 0) + int(count)
+        except ValueError:
+            continue
+    return out
+
+
+def cmd_profile(c, args) -> int:
+    """The sampling profiler's CLI (docs/reference/profiling.md):
+
+        kpctl profile capture [-o FILE] [--format folded|chrome|json]
+                                  snapshot the live profile — folded
+                                  collapsed stacks (flamegraph.pl /
+                                  speedscope input) or Chrome trace JSON
+        kpctl profile top [-n N]  top frames by inclusive samples
+        kpctl profile diff A B    frame-level delta of two folded files
+                                  (before/after a fix; local, no server)
+    """
+    if args.action in ("capture", "top") and args.files:
+        # stray positionals would be silently ignored — a user who
+        # forgot `-o` must not get "exit 0, no file written"
+        raise SystemExit(
+            f"kpctl profile {args.action} takes no positional arguments "
+            f"(got {args.files}); use -o FILE for capture output")
+    if args.action == "capture":
+        fmt = args.format
+        path = ("/debug/pprof/profile" if fmt == "folded"
+                else f"/debug/pprof/profile?format={fmt}")
+        body = c.request("GET", path, raw=True)
+        # the disabled marker differs by form: folded is a comment line,
+        # chrome/json serve {"enabled": false} — both must exit 1, never
+        # write a useless stub file
+        disabled = body.startswith(b"# profiler disabled")
+        if not disabled and fmt != "folded":
+            try:
+                doc = json.loads(body)
+                disabled = (isinstance(doc, dict)
+                            and doc.get("enabled") is False)
+            except ValueError:
+                pass
+        if disabled:
+            print("profiler is not running (start the control plane "
+                  "with --profile)", file=sys.stderr)
+            return 1
+        if args.output_file:
+            with open(args.output_file, "wb") as f:
+                f.write(body)
+            n = len(body.splitlines()) if fmt == "folded" else len(body)
+            unit = "stacks" if fmt == "folded" else "bytes"
+            print(f"wrote {n} {unit} to {args.output_file}")
+        else:
+            sys.stdout.write(body.decode())
+        return 0
+    if args.action == "top":
+        doc = c.request("GET",
+                        f"/debug/pprof/profile?format=json&n={args.n}")
+        if not doc.get("enabled", True):
+            print("profiler is not running (start the control plane "
+                  "with --profile)", file=sys.stderr)
+            return 1
+        # % of all sampled THREAD-STACKS (a frame on every thread of an
+        # N-thread process tops out at 100%, not N x 100%)
+        total = max(doc.get("stack_samples", doc.get("samples", 0)), 1)
+        rows = [["FRAME", "INCL", "SELF", "INCL%"]]
+        for fr in doc.get("top", [])[: args.n]:
+            rows.append([fr["frame"], str(fr["inclusive"]),
+                         str(fr["self"]),
+                         f"{100.0 * fr['inclusive'] / total:.1f}%"])
+        print(f"profile: {doc.get('samples', 0)} samples @ "
+              f"{doc.get('hz', 0):g}Hz, {doc.get('unique_stacks', 0)} "
+              f"unique stacks, overhead {doc.get('overhead_pct', 0):.2f}%")
+        _print_rows(rows)
+        return 0
+    if args.action == "diff":
+        if len(args.files) != 2:
+            raise SystemExit("kpctl profile diff needs exactly two "
+                             "folded files (before after)")
+        a, b = (_load_folded(p) for p in args.files)
+        # per-frame inclusive deltas (a frame's count = sum of stacks
+        # containing it, deduped per stack like the server's top())
+        def incl(folded):
+            out = {}
+            for stack, n in folded.items():
+                for fr in set(stack.split(";")[1:]):
+                    out[fr] = out.get(fr, 0) + n
+            return out
+        ia, ib = incl(a), incl(b)
+        deltas = sorted(((ib.get(f, 0) - ia.get(f, 0), f)
+                         for f in set(ia) | set(ib)),
+                        key=lambda t: -abs(t[0]))
+        rows = [["DELTA", "BEFORE", "AFTER", "FRAME"]]
+        for d, f in deltas[: args.n]:
+            if d == 0:
+                continue
+            rows.append([f"{d:+d}", str(ia.get(f, 0)), str(ib.get(f, 0)), f])
+        if len(rows) == 1:
+            print("no frame-level differences")
+            return 0
+        _print_rows(rows)
+        return 0
+    raise SystemExit(f"unknown profile action {args.action!r}")
+
+
 def cmd_soak(c, args) -> int:
     """Summarize a soak/monitor time-series artifact — a LOCAL file, no
     server needed. Reads both plain ``.json`` and gzipped ``.json.gz``
@@ -605,6 +761,17 @@ def cmd_soak(c, args) -> int:
     if "peak_latency_burn" in summ:
         print(f"  peak latency burn {summ['peak_latency_burn']:g}   "
               f"peak cost burn {summ.get('peak_cost_burn', 0):g}")
+    if "peak_lock_wait_ms" in summ:
+        # the contention provider's series envelope (debug.Monitor):
+        # the worst lock wait the run ever saw, next to the burn peaks
+        print(f"  peak lock wait {summ['peak_lock_wait_ms']:g}ms "
+              f"({summ.get('peak_lock_wait_lock', '?')})")
+    caps = (summ.get("final", {}).get("subsystems", {})
+            .get("burn_captures", {}))
+    if caps.get("total"):
+        print(f"  burn captures {caps.get('total', 0):g} "
+              f"(retained {caps.get('retained', 0):g}, "
+              f"last {caps.get('last_reason', '?')})")
     final = summ.get("final", {})
     slo = final.get("subsystems", {}).get("slo", {})
     if slo:
@@ -712,7 +879,26 @@ def main(argv=None) -> int:
     sk.add_argument("path")
     sk.set_defaults(fn=cmd_soak, local=True)
 
+    pf = sub.add_parser(
+        "profile", help="sampling-profiler surface (requires --profile on "
+                        "the control plane; docs/reference/profiling.md)")
+    pf.add_argument("action", choices=("capture", "top", "diff"))
+    pf.add_argument("files", nargs="*", default=[],
+                    help="diff: two folded files (before after)")
+    pf.add_argument("-o", "--output-file", default=None,
+                    help="capture: write here instead of stdout")
+    pf.add_argument("--format", choices=("folded", "chrome", "json"),
+                    default="folded",
+                    help="capture format: folded collapsed stacks "
+                         "(flamegraph.pl/speedscope), Chrome trace JSON "
+                         "(Perfetto), or the top-frames JSON")
+    pf.add_argument("-n", type=int, default=25,
+                    help="top/diff: rows to show")
+    pf.set_defaults(fn=cmd_profile)
+
     args = p.parse_args(argv)
+    if getattr(args, "verb", "") == "profile" and args.action == "diff":
+        args.local = True   # diff compares two local files, no server
     c = None
     if not getattr(args, "local", False):
         if not args.server:

@@ -1,0 +1,345 @@
+#!/usr/bin/env python
+"""profile_run — record the continuous-profiling acceptance artifact.
+
+Drives the SAME 15k-pod API-mode workload twice — once with the
+sampling profiler on (50 Hz), once as an unprofiled control — through
+the full protocol path (KubeClient writes -> admission -> informers ->
+provisioning passes -> watch fan-out), and records PROF_r08.json:
+
+- the top write-path / watch-fan-out frames (profile filtered to
+  kube/writer.py, kube/apiserver.py, operator/sync.py, kube/informer.py)
+  and the overall top frames,
+- the top contended locks (wait p99 + owner-at-contention tags),
+- the device cost model's measured-vs-modeled per shape,
+- profiler overhead: wall-time delta vs the control run AND the
+  sampler's self-measured cost — the ISSUE 7 "<5% enabled" bound,
+- any burn-triggered captures the run produced.
+
+Usage: python tools/profile_run.py [--pods 15000] [--out PROF_r08.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+WRITE_PATH_FILES = ("writer.py", "apiserver.py", "sync.py", "informer.py",
+                    "client.py", "httpserver.py")
+
+
+_LATTICE = None
+
+
+def _lattice():
+    global _LATTICE
+    if _LATTICE is None:
+        from karpenter_provider_aws_tpu.lattice import build_lattice
+        from karpenter_provider_aws_tpu.lattice.realdata import load_catalog
+        _LATTICE = build_lattice(load_catalog(require_price=True))
+    return _LATTICE
+
+
+def run_workload(pods: int, profile: bool, hz: float = 50.0,
+                 label: str = ""):
+    """One THREADED API-mode churn run (every controller on its own
+    cadence, the soak stratum): pod waves through the protocol
+    (admission -> store -> watch -> informer thread -> mirror) while the
+    provisioner/lifecycle/metrics threads run concurrently — real lock
+    contention, the round-5 "API-mode degrades 1k->15k" shape this
+    layer exists to explain. Returns (wall_seconds, op, profiler)."""
+    from karpenter_provider_aws_tpu import introspect
+    from karpenter_provider_aws_tpu.apis import Pod
+    from karpenter_provider_aws_tpu.introspect import SamplingProfiler
+    from karpenter_provider_aws_tpu.kube import FakeAPIServer, KubeClient
+    from karpenter_provider_aws_tpu.operator import Operator, Options
+    from karpenter_provider_aws_tpu.operator.runtime import (
+        ControllerRuntime, operator_specs)
+
+    api = FakeAPIServer()
+    client = KubeClient(api)
+    op = Operator(options=Options(registration_delay=0.1,
+                                  batch_idle_duration=0.05,
+                                  batch_max_duration=0.5),
+                  lattice=_lattice(), api_server=api)
+    prof = None
+    if profile:
+        prof = SamplingProfiler(hz=hz).start()
+        introspect.set_profiler(prof)
+    rt = ControllerRuntime(operator_specs(op)).start()
+    sizes = [(250, 512), (500, 1024), (1000, 2048), (2000, 4096)]
+    t0 = time.perf_counter()
+    created = 0
+    wave = 0
+    try:
+        while created < pods:
+            wave += 1
+            n = min(1500, pods - created)
+            for i in range(n):
+                cpu, mem = sizes[(created + i) % len(sizes)]
+                client.create_pod(Pod(
+                    name=f"prof-{label}w{wave}-{i}",
+                    requests={"cpu": f"{cpu}m", "memory": f"{mem}Mi"}))
+            created += n
+            # let the threaded control plane mostly drain this wave
+            # before the next (bounded): sustained back-to-back passes,
+            # not one 15k mega-batch
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                if len(op.cluster.pending_pods()) < 200:
+                    break
+                time.sleep(0.05)
+        # full drain
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            if not op.cluster.pending_pods():
+                break
+            time.sleep(0.1)
+    finally:
+        wall = time.perf_counter() - t0
+        # pending at RUN END: read later, nomination expiry against
+        # stopped controllers re-pends pods and lies about the drain
+        op.final_pending = len(op.cluster.pending_pods())
+        while not rt.stop():
+            print("profile_run: waiting for a blocked controller...")
+        if prof is not None:
+            prof.stop()
+    return wall, op, prof
+
+
+def run_deterministic(pods: int, profile: bool, hz: float = 50.0,
+                      label: str = ""):
+    """The overhead-measurement stratum: the SAME single-threaded
+    API-mode pump (sync -> provision -> lifecycle -> sync, no sleeps, no
+    thread scheduling) executes an IDENTICAL operation sequence with and
+    without the profiler daemon sampling over it — so the wall-clock
+    ratio measures the profiler, not workload scatter (the threaded
+    churn run's wall time varies >5% between identical configs, which
+    is larger than the signal)."""
+    from karpenter_provider_aws_tpu import introspect
+    from karpenter_provider_aws_tpu.apis import Pod
+    from karpenter_provider_aws_tpu.introspect import SamplingProfiler
+    from karpenter_provider_aws_tpu.kube import FakeAPIServer, KubeClient
+    from karpenter_provider_aws_tpu.operator import Operator, Options
+
+    api = FakeAPIServer()
+    client = KubeClient(api)
+    op = Operator(options=Options(registration_delay=0.0,
+                                  batch_idle_duration=0.05,
+                                  batch_max_duration=0.5),
+                  lattice=_lattice(), api_server=api)
+    prof = None
+    if profile:
+        prof = SamplingProfiler(hz=hz).start()
+        introspect.set_profiler(prof)
+    sizes = [(250, 512), (500, 1024), (1000, 2048), (2000, 4096)]
+    # GC symmetry: constructing this Operator replaced the previous
+    # run's introspection providers (the last references to its 15k-pod
+    # object graph) — collect it NOW and disable the collector for the
+    # measured window, otherwise whichever run goes second drags the
+    # bigger live heap through every gen-2 pass and the comparison
+    # measures GC, not the profiler (observed at ±10%)
+    import gc
+    gc.collect()
+    gc.disable()
+    try:
+        t0 = time.perf_counter()
+        created = 0
+        wave = 0
+        while created < pods:
+            wave += 1
+            n = min(1500, pods - created)
+            for i in range(n):
+                cpu, mem = sizes[(created + i) % len(sizes)]
+                client.create_pod(Pod(
+                    name=f"det-{label}w{wave}-{i}",
+                    requests={"cpu": f"{cpu}m", "memory": f"{mem}Mi"}))
+            created += n
+            for _ in range(60):
+                op.sync_once()
+                if op.cluster.pending_pods():
+                    op.provisioner.provision_once()
+                op.lifecycle.reconcile()
+                op.sync_once()
+                if not op.cluster.pending_pods():
+                    break
+            op.emit_gauges()
+        wall = time.perf_counter() - t0
+    finally:
+        gc.enable()
+    op.final_pending = len(op.cluster.pending_pods())
+    if prof is not None:
+        prof.stop()
+    return wall, op, prof
+
+
+def filtered_top(prof, files, n=10):
+    """Top frames restricted to the given source files."""
+    return [d for d in prof.top(400)
+            if any(d["frame"].startswith(f + ":") for f in files)][:n]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pods", type=int, default=15000)
+    ap.add_argument("--hz", type=float, default=50.0)
+    ap.add_argument("--out", default="PROF_r08.json")
+    args = ap.parse_args(argv)
+
+    from karpenter_provider_aws_tpu import introspect
+    from karpenter_provider_aws_tpu.introspect import contention
+    from karpenter_provider_aws_tpu.solver import costmodel
+
+    # shared warmup: the process-global jit cache means whichever run
+    # goes FIRST would otherwise pay XLA compiles the other reuses and
+    # the overhead comparison would be fiction in either direction. A
+    # small churn run warms the protocol path, then the solver's OWN
+    # bucket ladder is compiled explicitly up to the B buckets a
+    # 15k-pod run's growing existing-bin table reaches (the first
+    # attempt skipped this and recorded a -25% "overhead" — pure
+    # compile asymmetry).
+    print("profile_run: warmup run (1000 pods + solver ladder)...")
+    warm_wall, warm_op, _ = run_deterministic(1000, profile=False,
+                                              label="warm")
+    warm_op.solver.warmup(node_pools_count=len(warm_op.node_pools),
+                          g_buckets=(16, 32),
+                          b_buckets=(32, 128, 512, 1024, 2048))
+    warm_op.solver.capture_cost_model(
+        node_pools_count=len(warm_op.node_pools))
+    print(f"profile_run: warmup {warm_wall:.1f}s (compiles paid)")
+
+    # ---- overhead stratum: deterministic pair, identical op sequence.
+    # PROFILED first: compile residue the warmup still missed lands on
+    # the profiled side — the measurement is an upper bound.
+    print(f"profile_run: deterministic profiled run ({args.pods} pods, "
+          f"{args.hz} Hz)...")
+    det_p_wall, det_p_op, det_prof = run_deterministic(
+        args.pods, profile=True, hz=args.hz, label="p")
+    det_p_pass_p50 = det_p_op.slo.latency_percentiles()[0]
+    det_p_nodes = len(det_p_op.cluster.nodes)
+    det_p_pending = det_p_op.final_pending
+    det_pstats = det_prof.stats()
+    det_samples = det_prof.samples
+    print(f"profile_run: det profiled {det_p_wall:.1f}s, "
+          f"nodes={det_p_nodes}, samples={det_samples}")
+    # drop this run's 15k-pod graph BEFORE the control run (the next
+    # Operator's provider registration releases the last references)
+    del det_p_op, det_prof
+    print(f"profile_run: deterministic control run ({args.pods} pods)...")
+    det_c_wall, det_c_op, _ = run_deterministic(args.pods, profile=False,
+                                                label="c")
+    det_c_pass_p50 = det_c_op.slo.latency_percentiles()[0]
+    det_c_nodes = len(det_c_op.cluster.nodes)
+    print(f"profile_run: det control {det_c_wall:.1f}s, "
+          f"nodes={det_c_nodes}")
+    del det_c_op
+    if det_p_nodes != det_c_nodes:
+        print(f"profile_run: WARNING det runs diverged ({det_p_nodes} vs "
+              f"{det_c_nodes} nodes) — overhead comparison weakened")
+    control_wall, wall = det_c_wall, det_p_wall
+    control_pass_p50, prof_pass_p50 = det_c_pass_p50, det_p_pass_p50
+
+    # ---- attribution stratum: the THREADED runtime (real concurrency,
+    # real lock contention, the soak shape) with the profiler on
+    print(f"profile_run: threaded attribution run ({args.pods} pods, "
+          f"{args.hz} Hz)...")
+    # fresh contention accounting: the artifact's lock table must
+    # describe THIS run, not the warmup/deterministic residue
+    contention.reset()
+    thr_wall, op, prof = run_workload(args.pods, profile=True, hz=args.hz,
+                                      label="t")
+    print(f"profile_run: threaded {thr_wall:.1f}s, "
+          f"nodes={len(op.cluster.nodes)}, samples={prof.samples}, "
+          f"pending_at_end={op.final_pending}")
+
+    overhead_pct = 100.0 * (wall - control_wall) / control_wall
+    pstats = prof.stats()
+    top_locks = [
+        {"lock": name, "waitP99Ms": round(p99 * 1e3, 3), "contended": n,
+         "owners": contention._stats_for(name).owner_tags}
+        for name, p99, n in contention.top_waits(5)]
+    bc = introspect.burn_capture()
+    doc = {
+        "artifact": "PROF_r08",
+        "what": "15k-pod API-mode churn with the continuous-profiling "
+                "layer on: write-path/watch-fan-out frame attribution, "
+                "lock contention, device cost model, and measured "
+                "profiler overhead vs an unprofiled control run "
+                "(ISSUE 7 acceptance)",
+        "pods": args.pods,
+        "api_mode": True,
+        "backend_note": "CPU backend (jax_platforms=cpu): device-solve "
+                        "frames are XLA-on-host; the attribution "
+                        "machinery is identical on TPU",
+        "profiler": {
+            "hz": args.hz,
+            "threaded_run_samples": prof.samples,
+            "threaded_unique_stacks": pstats["unique_stacks"],
+            "dropped_stacks": pstats["dropped_stacks"],
+            "self_measured_overhead_pct": pstats["overhead_pct"],
+            "avg_sample_ms": pstats["avg_sample_ms"],
+        },
+        "overhead": {
+            "methodology": "deterministic single-threaded API-mode pump "
+                           "executing an IDENTICAL operation sequence "
+                           "with/without the sampler (the threaded churn "
+                           "run's wall scatter exceeds the signal); "
+                           "profiled run FIRST after a shared jit-cache "
+                           "warmup (churn + explicit solver bucket "
+                           "ladder), so compile residue, if any, lands "
+                           "on the profiled side — an upper bound",
+            "control_wall_seconds": round(control_wall, 2),
+            "profiled_wall_seconds": round(wall, 2),
+            "e2e_overhead_pct": round(overhead_pct, 2),
+            "control_pass_p50_ms": round(control_pass_p50 * 1e3, 2),
+            "profiled_pass_p50_ms": round(prof_pass_p50 * 1e3, 2),
+            "pass_p50_overhead_pct": round(
+                100.0 * (prof_pass_p50 - control_pass_p50)
+                / control_pass_p50, 2) if control_pass_p50 else None,
+            "det_runs_node_parity": det_p_nodes == det_c_nodes,
+            "det_profiler_samples": det_samples,
+            "det_self_measured_overhead_pct": det_pstats["overhead_pct"],
+            "bound_pct": 5.0,
+            "within_bound": overhead_pct < 5.0,
+        },
+        "top_frames_overall": prof.top(15),
+        "top_frames_write_path": filtered_top(prof, WRITE_PATH_FILES),
+        "top_contended_locks": top_locks,
+        "contention": {k: v for k, v in contention.stats().items()
+                       if not k.endswith("_acquisitions")},
+        "device_cost_model": costmodel.model().summary(),
+        "burn_captures": bc.doc() if bc is not None else {},
+        "parity": {
+            "det_control_nodes": det_c_nodes,
+            "det_profiled_nodes": det_p_nodes,
+            "det_pending_at_end": det_p_pending,
+            "threaded_nodes": len(op.cluster.nodes),
+            "threaded_wall_seconds": round(thr_wall, 2),
+            "threaded_pending_at_end": op.final_pending,
+        },
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"profile_run: wrote {args.out}")
+    print(f"  e2e overhead {overhead_pct:+.2f}% (bound 5%), "
+          f"self-measured {pstats['overhead_pct']:.2f}%")
+    print("  top write-path frames:")
+    for d in doc["top_frames_write_path"][:3]:
+        print(f"    {d['frame']}  incl={d['inclusive']} self={d['self']}")
+    print("  top contended locks:")
+    for d in top_locks[:3]:
+        print(f"    {d['lock']}  p99={d['waitP99Ms']}ms "
+              f"contended={d['contended']}")
+    introspect.set_profiler(None)
+    ok = (overhead_pct < 5.0 and det_p_pending == 0
+          and op.final_pending == 0)
+    print(f"profile_run: {'OK' if ok else 'VIOLATED'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -308,13 +308,20 @@ def main(argv=None) -> int:
           f"(p50 {slo['latency_p50_ms']}ms / 200ms) "
           f"cost_burn={slo['cost_burn']} "
           f"(ratio_p50 {slo['cost_ratio_p50']})")
+    # ONE summary pass serves every exit print below (summary() rescans
+    # all retained samples, including the per-sample contention sweep)
+    summ = monitor.summary()
     print(f"soak: incremental builds="
           f"{op.provisioner.inc_builder.incremental_builds} "
           f"full={op.provisioner.inc_builder.full_builds} "
           f"delta_solves={op.solver.pipeline_stats['delta_solves']} "
-          f"peak_latency_burn={monitor.summary().get('peak_latency_burn')}")
+          f"peak_latency_burn={summ.get('peak_latency_burn')}")
+    if "peak_lock_wait_ms" in summ:
+        print(f"soak: peak lock wait {summ['peak_lock_wait_ms']}ms "
+              f"({summ.get('peak_lock_wait_lock')}) "
+              f"burn_captures={op.burn_capture.stats().get('total', 0)}")
     if args.warm_start:
-        peak = monitor.summary().get("peak_latency_burn", 0.0) or 0.0
+        peak = summ.get("peak_latency_burn", 0.0) or 0.0
         if peak >= 2.0:
             # the satellite's regression bar: with AOT warmup active a
             # cold-compile first pass must not read as an SLO burn spike
@@ -326,9 +333,9 @@ def main(argv=None) -> int:
         monitor.write(args.out)
         print(f"soak: time series -> {args.out} "
               f"({len(monitor.samples)} samples, "
-              f"peak_nodes={monitor.summary().get('peak_nodes')}, "
-              f"peak_cost/hr={monitor.summary().get('peak_cost_per_hour')}, "
-              f"peak_latency_burn={monitor.summary().get('peak_latency_burn')})")
+              f"peak_nodes={summ.get('peak_nodes')}, "
+              f"peak_cost/hr={summ.get('peak_cost_per_hour')}, "
+              f"peak_latency_burn={summ.get('peak_latency_burn')})")
     print("soak: INVARIANTS " + ("OK" if ok else "VIOLATED"))
     if not ok:
         print(dump_state(op))

@@ -875,6 +875,124 @@ def run_steady_state_config(lattice, solver):
 CFG5_ALGO_BUDGET_MS = 100.0
 
 
+# ---- the API-stratum write path (kube/apiserver.py) ------------------------
+# Per-pod write+deliver cost at 1k/15k/50k stored pods x 1/32/256 watchers.
+# The row's gates: cost flat within WRITEPATH_FLAT_PCT from 1k->50k at every
+# fan-out (nothing O(store) may ride the write path), and watch delivery
+# allocates ZERO per-watcher envelope copies (the server's
+# fanout_envelope_copies counter pins the shared-frozen-event design).
+WRITEPATH_SIZES = (1000, 15000, 50000)
+WRITEPATH_WATCHERS = (1, 32, 256)
+WRITEPATH_OPS = 2000
+WRITEPATH_FLAT_PCT = 25.0
+
+
+def run_writepath_bench(out_path="BENCH_r07_writepath.json"):
+    """The write-path row: measures one write verb (patch) end to end —
+    store mutation + RV allocation + history append + fan-out delivery
+    to every subscriber queue + consumer drain — per pod, as the store
+    and the watcher population scale. No jax, no solver: this is the
+    API stratum alone, the layer PROF_r08 blamed."""
+    import tracemalloc
+    from karpenter_provider_aws_tpu.kube.apiserver import FakeAPIServer
+
+    def build_server(n_pods: int) -> FakeAPIServer:
+        s = FakeAPIServer()
+        for lo in range(0, n_pods, 5000):
+            s.bulk([("create", "pods",
+                     {"name": f"p{i}", "namespace": "default",
+                      "requests": {"cpu": "100m", "memory": "128Mi"}})
+                    for i in range(lo, min(lo + 5000, n_pods))])
+        return s
+
+    rows = []
+    for n_pods in WRITEPATH_SIZES:
+        server = build_server(n_pods)
+        for n_watch in WRITEPATH_WATCHERS:
+            watches = [server.watch("pods", server.last_rv)
+                       for _ in range(n_watch)]
+            copies0 = server.fanout_envelope_copies
+            t0 = time.perf_counter()
+            for i in range(WRITEPATH_OPS):
+                server.patch("pods", f"p{i % n_pods}", {"priority": i})
+            delivered = [sum(1 for ev in w.pop_pending()
+                             if ev.type != "BOOKMARK") for w in watches]
+            elapsed = time.perf_counter() - t0
+            # the same churn COALESCED through the bulk verb (one lock
+            # acquisition + one delivery flush per 200-op batch)
+            t1 = time.perf_counter()
+            for lo in range(0, WRITEPATH_OPS, 200):
+                server.bulk([("patch", "pods", f"p{i % n_pods}",
+                              {"priority": -i})
+                             for i in range(lo, lo + 200)])
+            for w in watches:
+                w.pop_pending()
+            bulk_elapsed = time.perf_counter() - t1
+            for w in watches:
+                server.stop_watch(w)
+            assert all(d == WRITEPATH_OPS for d in delivered), (
+                f"watch fan-out lost events: {set(delivered)}")
+            rows.append({
+                "pods": n_pods, "watchers": n_watch,
+                "per_op_us": round(elapsed / WRITEPATH_OPS * 1e6, 2),
+                "bulk_per_op_us": round(
+                    bulk_elapsed / WRITEPATH_OPS * 1e6, 2),
+                "events_delivered": WRITEPATH_OPS * n_watch,
+                "fanout_envelope_copies":
+                    server.fanout_envelope_copies - copies0,
+            })
+            print(json.dumps({"metric": "writepath_per_op_us",
+                              **rows[-1]}), flush=True)
+
+    # allocation pin: bytes the fan-out allocates per delivery at max
+    # fan-out — shared frozen events mean pointer appends, not copies
+    # (an envelope deepcopy alone is ~10 KB; the bar is two orders
+    # under that)
+    server = build_server(1000)
+    watches = [server.watch("pods", server.last_rv) for _ in range(256)]
+    tracemalloc.start()
+    for i in range(200):
+        server.patch("pods", f"p{i}", {"priority": i})
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    for w in watches:
+        w.pop_pending()
+        server.stop_watch(w)
+    alloc_bytes_per_delivery = round(peak / (200 * 256), 1)
+
+    # flatness gate: per-op cost from 1k to 50k pods, per fan-out level
+    flatness = {}
+    ok = True
+    for n_watch in WRITEPATH_WATCHERS:
+        costs = {r["pods"]: r["per_op_us"] for r in rows
+                 if r["watchers"] == n_watch}
+        delta_pct = round(
+            (costs[WRITEPATH_SIZES[-1]] - costs[WRITEPATH_SIZES[0]])
+            / costs[WRITEPATH_SIZES[0]] * 100.0, 1)
+        flatness[str(n_watch)] = delta_pct
+        if abs(delta_pct) > WRITEPATH_FLAT_PCT:
+            ok = False
+    copies = sum(r["fanout_envelope_copies"] for r in rows)
+    if copies:
+        ok = False
+    doc = {
+        "metric": "writepath_write_deliver_cost",
+        "unit": "us/op",
+        "rows": rows,
+        "flat_1k_to_50k_pct": flatness,
+        "flat_budget_pct": WRITEPATH_FLAT_PCT,
+        "fanout_envelope_copies_total": copies,
+        "alloc_bytes_per_delivery": alloc_bytes_per_delivery,
+        "pass": ok,
+    }
+    print(json.dumps(doc), flush=True)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        print(f"writepath: -> {out_path} (pass={ok})", flush=True)
+    return 0 if ok else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--catalog", default="real",
@@ -889,7 +1007,16 @@ def main(argv=None):
                          "synthetic catalog), no Pallas/continuity rows — "
                          "proves the bench harness + solve path end to "
                          "end in well under a minute (tools/ci.sh)")
+    ap.add_argument("--writepath", action="store_true",
+                    help="API-stratum write-path row ONLY: per-pod "
+                         "write+deliver cost at 1k/15k/50k stored pods x "
+                         "1/32/256 watchers (flat-within-25%% gate, "
+                         "zero-fan-out-copy pin) -> "
+                         "BENCH_r07_writepath.json. No solver, no jax.")
     args = ap.parse_args(argv)
+
+    if args.writepath:
+        raise SystemExit(run_writepath_bench())
 
     from karpenter_provider_aws_tpu.lattice import build_lattice
     from karpenter_provider_aws_tpu.solver import Solver

@@ -188,6 +188,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "(deploy/gen_certs.sh mints self-signed material).")
     p.add_argument("--api-tls-key", default=None,
                    help="PEM private key matching --api-tls-cert.")
+    p.add_argument("--api-watch-queue-bound", type=int, default=None,
+                   help="Per-watcher event queue bound on the REST "
+                        "apiserver's watch hub (env API_WATCH_QUEUE_BOUND, "
+                        "default 8192): a subscriber that overruns it is "
+                        "dropped to 410/relist instead of growing an "
+                        "unbounded queue (docs/reference/watch.md)")
+    p.add_argument("--api-bookmark-every", type=int, default=None,
+                   help="Deliveries between per-watcher BOOKMARK events "
+                        "carrying the current resourceVersion (env "
+                        "API_BOOKMARK_EVERY, default 256; 0 disables) — "
+                        "keeps idle watchers' resume points fresh")
     p.add_argument("--api-insecure", action="store_true",
                    help="Explicitly allow serving the write-capable REST "
                         "surface beyond loopback WITHOUT TLS + token.")
@@ -234,6 +245,10 @@ def options_from_args(args: argparse.Namespace) -> Options:
         overrides["solver_address"] = args.solver_address
     if args.compile_cache_dir is not None:
         overrides["compile_cache_dir"] = args.compile_cache_dir
+    if args.api_watch_queue_bound is not None:
+        overrides["api_watch_queue_bound"] = args.api_watch_queue_bound
+    if args.api_bookmark_every is not None:
+        overrides["api_bookmark_every"] = args.api_bookmark_every
     for gate in (args.feature_gates or "").split(","):
         gate = gate.strip()
         if not gate:
@@ -452,7 +467,12 @@ def main(argv: Optional[Sequence[str]] = None,
         from .kube import (FakeAPIServer, install_admission,
                            install_default_indexes)
         from .kube.httpserver import serve as serve_api
-        api_server = FakeAPIServer()
+        # watch tuning rides the CONSTRUCTOR: this surface serves (and
+        # accepts watch subscriptions, whose queue bound is frozen at
+        # subscribe time) before the slow Operator build applies options
+        api_server = FakeAPIServer(
+            watch_queue_bound=opts.api_watch_queue_bound,
+            bookmark_every=opts.api_bookmark_every)
         # admission/indexes are wired BEFORE the first byte is served:
         # objects written during the (slow) operator build face the same
         # 422-with-causes contract as every later write — and the

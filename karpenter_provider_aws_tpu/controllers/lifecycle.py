@@ -92,8 +92,11 @@ class LifecycleController:
         self.writer.register_node(node, Lease(
             name=node.name, owner_node=node.name,
             created_at=self.clock.now()))
-        for pod in self.cluster.nominated_pods(claim.name):
-            self.writer.bind_pod(pod.name, node.name)
+        # all of the claim's nominated pods bind as ONE coalesced write
+        # (the apiserver bulk verb in API mode): registration of a
+        # full node used to pay lock + watch fan-out per pod
+        self.writer.bind_pods([(pod.name, node.name)
+                               for pod in self.cluster.nominated_pods(claim.name)])
         claim.phase = NodeClaimPhase.REGISTERED
         claim.registered_at = self.clock.now()
         self.writer.update_claim_status(claim)

@@ -44,8 +44,13 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 # wait/hold bucket upper bounds, SECONDS (percentile estimates mirror
-# metrics.Histogram: first bucket whose cumulative count crosses q)
-BUCKETS = (0.00005, 0.0002, 0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0)
+# metrics.Histogram: first bucket whose cumulative count crosses q).
+# 50 ms sits between the old 20 ms and 100 ms bounds: scheduler-noise
+# tails (a preempted lock holder under CPU saturation) and genuine
+# convoy waits straddle exactly that range, and a p99 quantized to one
+# shared 100 ms bucket could not rank them (the SOAK_r08 contention
+# acceptance needed the resolution).
+BUCKETS = (0.00005, 0.0002, 0.001, 0.005, 0.02, 0.05, 0.1, 0.5, 2.0, 10.0)
 HOLD_RECORD_SECONDS = 0.0001   # holds under 100 µs: totals only, no bucket
 OWNER_TAGS_MAX = 8             # distinct owner-at-contention sites kept
 
@@ -373,10 +378,13 @@ def detail() -> Dict:
 
 
 def top_waits(n: int = 3) -> List[Tuple[str, float, int]]:
-    """Top-N locks by wait p99: (name, p99_seconds, contended)."""
+    """Top-N locks by wait p99: (name, p99_seconds, contended).
+    Bucketed p99s tie often; contended count breaks the tie (at equal
+    p99 the lock more threads actually blocked on ranks worse) — the
+    ordering is deterministic instead of registry-insertion order."""
     with _reg_lock:
         entries = list(_registry.values())
     ranked = sorted(((ls.name, ls.wait_p99_s(), ls.contended)
                      for ls in entries if ls.contended),
-                    key=lambda t: -t[1])
+                    key=lambda t: (-t[1], -t[2]))
     return ranked[:n]

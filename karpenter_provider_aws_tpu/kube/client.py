@@ -28,7 +28,7 @@ from ..apis.objects import (
     Lease, Node, NodeClaim, NodePool, PersistentVolumeClaim, Pod,
     PodDisruptionBudget, StorageClass,
 )
-from .apiserver import FakeAPIServer, NotFoundError, Watch
+from .apiserver import BulkOp, FakeAPIServer, NotFoundError, Watch
 
 TERMINATION_FINALIZER = "karpenter.tpu/termination"
 
@@ -41,6 +41,28 @@ class KubeClient:
 
     def create_pod(self, pod: Pod) -> None:
         self.server.create("pods", serde.pod_to_dict(pod))
+
+    def create_pods(self, pods: Sequence[Pod]) -> List[Optional[Exception]]:
+        """Batched create through the bulk verb: one lock acquisition,
+        one admission sweep, per-pod events. Returns a per-pod slot —
+        None on success, the APIError on a captured failure."""
+        res = self.server.bulk([("create", "pods", serde.pod_to_dict(p))
+                                for p in pods])
+        return [r if isinstance(r, Exception) else None for r in res]
+
+    def delete_pods(self, names: Sequence[str]) -> int:
+        """Batched delete (bulk verb); NotFound slots (raced teardowns)
+        are ignored. Returns how many deletes landed."""
+        res = self.server.bulk([("delete", "pods", n) for n in names])
+        return sum(1 for r in res if not isinstance(r, Exception))
+
+    def bind_pods(self, pairs: Sequence[Tuple[str, str]]) -> List[bool]:
+        """Batched pods/binding: one lock acquisition and one watch
+        flush for the whole list. Per-pair verdicts — a bind that raced
+        an eviction/delete (Conflict/NotFound) reports False instead of
+        failing the batch."""
+        res = self.server.bulk([("bind", p, n) for p, n in pairs])
+        return [not isinstance(r, Exception) for r in res]
 
     def get_pod(self, name: str) -> Pod:
         return serde.pod_from_dict(self.server.get("pods", name)["spec"])
@@ -219,6 +241,12 @@ class KubeClient:
 
     def list_raw(self, kind: str) -> Tuple[List[dict], int]:
         return self.server.list(kind)
+
+    def bulk(self, ops: Sequence[BulkOp]) -> List:
+        """Raw batched apply (apiserver.bulk): many writes, one lock
+        acquisition + admission sweep + watch flush per kind touched;
+        per-op results/errors aligned with ``ops``."""
+        return self.server.bulk(ops)
 
     def watch(self, kind: str, resource_version: int = 0) -> Watch:
         return self.server.watch(kind, resource_version)

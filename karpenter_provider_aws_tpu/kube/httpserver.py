@@ -323,7 +323,20 @@ def serve(server: FakeAPIServer, port: int = 0,
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
                 while True:
-                    ev = w.get(timeout=WATCH_HEARTBEAT_SECONDS)
+                    try:
+                        ev = w.get(timeout=WATCH_HEARTBEAT_SECONDS)
+                    except TooOldError as e:
+                        # the watcher overran its bounded server-side
+                        # queue: emit the protocol's ERROR event (the
+                        # 410-Gone-mid-stream analog) and end the stream
+                        # — the client relists, like a reflector
+                        chunk(json.dumps({
+                            "type": "ERROR", "code": 410,
+                            "reason": "Expired", "message": str(e),
+                        }).encode() + b"\n")
+                        self.wfile.write(b"0\r\n\r\n")
+                        self.wfile.flush()
+                        break
                     if ev is None:
                         chunk(b'{"type":"HEARTBEAT"}\n')
                         continue

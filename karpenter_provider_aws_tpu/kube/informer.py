@@ -97,6 +97,13 @@ class Informer:
                 continue
 
     def _apply(self, ev: WatchEvent) -> None:
+        if ev.type == "BOOKMARK":
+            # no object change — just advance the resume point, so a
+            # relist after a 410 starts from a fresh RV (the client-go
+            # allowWatchBookmarks contract)
+            with self._lock:
+                self._rv = ev.resource_version
+            return
         name = ev.object["metadata"]["name"]
         with self._lock:
             old = self.store.get(name)
@@ -113,12 +120,21 @@ class Informer:
 
     def sync_once(self) -> int:
         """Deterministic pump: list on first call, then drain every pending
-        watch event. Returns the number of events applied."""
+        watch event. Returns the number of events applied. A watcher the
+        server dropped for overrunning its bounded queue (TooOldError —
+        the in-process 410) recovers by RELISTING, exactly like the
+        ring-expiry path."""
         if not self._synced or self._watch is None:
             self._relist()
             return len(self.store)
+        try:
+            pending = self._watch.pop_pending()
+        except TooOldError:
+            self._synced = False
+            self._relist()
+            return len(self.store)
         n = 0
-        for ev in self._watch.pop_pending():
+        for ev in pending:
             self._apply(ev)
             n += 1
         return n
@@ -129,7 +145,13 @@ class Informer:
         while not self._stop.is_set():
             if not self._synced or self._watch is None:
                 self._relist()
-            ev = self._watch.get(timeout=0.2)
+            try:
+                ev = self._watch.get(timeout=0.2)
+            except TooOldError:
+                # overran the bounded per-watcher queue: relist on the
+                # next loop turn (_relist unsubscribes the dead watch)
+                self._synced = False
+                continue
             if ev is not None:
                 self._apply(ev)
 

@@ -20,7 +20,7 @@ suites (pkg/test/environment.go:83-162).
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from .. import trace
 from ..apis.objects import Lease, Node, NodeClaim, NodeClaimPhase, Pod
@@ -127,6 +127,11 @@ class DirectWriter(WriterCounts):
         self.cluster.bind_pod(pod_name, node_name)
         return True
 
+    def bind_pods(self, pairs: Sequence[Tuple[str, str]]) -> List[bool]:
+        """Batched bind: the mirror path has no lock to amortize, so it
+        is the per-pod verb in a loop (same contract as ApiWriter's)."""
+        return [self.bind_pod(p, n) for p, n in pairs]
+
     def bind_volumes(self, pod_name: str, zone: Optional[str]) -> None:
         self._count("bind_volumes")
         self.cluster.bind_volumes(pod_name, zone)
@@ -205,38 +210,43 @@ class ApiWriter(WriterCounts):
         """PDB-respecting drain THROUGH the eviction subresource: the
         server enforces budgets (the real Eviction API contract); we
         report (evicted, blocked) from its verdicts. Pod set comes from
-        the mirror — the same information a real drainer lists."""
+        the mirror — the same information a real drainer lists. The
+        evictions go as ONE bulk batch (one lock acquisition, one watch
+        flush); the server evaluates each pod's PDB allowance in order
+        inside the batch, so verdicts match the per-call sequence
+        exactly."""
         self._count("drain_node")
+        pods = [p for p in self.cluster.pods_by_node().get(node_name, [])
+                if not p.is_daemonset]
+        if not pods:
+            return [], []
+        results = self.kube.bulk([("evict", p.name) for p in pods])
         evicted: List[Pod] = []
         blocked: List[Pod] = []
-        for pod in self.cluster.pods_by_node().get(node_name, []):
-            if pod.is_daemonset:
-                continue
-            try:
-                self.kube.evict_pod(pod.name)
-                evicted.append(pod)
-            except EvictionBlockedError:
+        for pod, r in zip(pods, results):
+            if isinstance(r, EvictionBlockedError):
                 blocked.append(pod)
-            except NotFoundError:
+            elif isinstance(r, NotFoundError):
                 continue
+            elif isinstance(r, Exception):
+                raise r
+            else:
+                evicted.append(pod)
         return evicted, blocked
 
     def teardown_node(self, node_name: str) -> None:
         """Final teardown: force-evict stragglers (grace-zero delete
-        analog), remove daemonset pods with the node, delete the node."""
+        analog), remove daemonset pods with the node, delete the node —
+        all one bulk batch (NotFound slots are raced teardowns)."""
         self._count("teardown_node")
+        ops = []
         for pod in self.cluster.pods_by_node().get(node_name, []):
-            try:
-                if pod.is_daemonset:
-                    self.kube.delete_pod(pod.name)
-                else:
-                    self.kube.evict_pod(pod.name, force=True)
-            except NotFoundError:
-                continue
-        try:
-            self.kube.delete_node(node_name)
-        except NotFoundError:
-            pass
+            if pod.is_daemonset:
+                ops.append(("delete", "pods", pod.name))
+            else:
+                ops.append(("evict", pod.name, True))
+        ops.append(("delete", "nodes", node_name))
+        self.kube.bulk(ops)
 
     # ---- pods / volumes / leases ------------------------------------------
 
@@ -252,6 +262,22 @@ class ApiWriter(WriterCounts):
             return True
         except (ConflictError, NotFoundError):
             return False
+
+    def bind_pods(self, pairs: Sequence[Tuple[str, str]]) -> List[bool]:
+        """A provisioning pass's binds as ONE coalesced write: the bulk
+        verb pays one lock acquisition + one watch flush for the whole
+        list — bind_pod was the profiled #1 write-path frame paying
+        lock+copy+fan-out per pod. Per-pair verdicts keep the raced-bind
+        contract (False = not scheduled)."""
+        if not pairs:
+            return []
+        with trace.span("kube.bind_pods", pods=len(pairs)):
+            oks = self.kube.bind_pods(pairs)
+        n = sum(oks)
+        if n:
+            self._count("bind_pod", n)
+        self._count("bulk_binds")
+        return oks
 
     def bind_volumes(self, pod_name: str, zone: Optional[str]) -> None:
         """Persist WaitForFirstConsumer zone pins server-side (the CSI

@@ -334,6 +334,42 @@ def wire_core_metrics(reg: Registry) -> Dict[str, _Metric]:
             "Wall-clock share of one scheduling solve per pipeline stage "
             "(stage: build | upload | compute | download | decode).",
             ("stage",)),
+        # the API stratum's write/fan-out surface (kube/apiserver.py;
+        # docs/reference/watch.md) — set from FakeAPIServer.stats() each
+        # gauge pass in API mode. Cumulative values are exposed as
+        # gauges because they mirror a snapshot counter, like the other
+        # stats()-backed series.
+        "api_watchers": reg.gauge(
+            "karpenter_api_watchers",
+            "Active watch subscriptions on the apiserver's watch hub.", ()),
+        "api_watch_queue_depth": reg.gauge(
+            "karpenter_api_watch_queue_depth",
+            "Queued (undelivered) watch events across all subscribers.", ()),
+        "api_watch_max_depth": reg.gauge(
+            "karpenter_api_watch_max_queue_depth",
+            "Deepest single watcher queue at the last snapshot (the "
+            "slow-consumer early-warning before the bound drops it).", ()),
+        "api_watch_delivered": reg.gauge(
+            "karpenter_api_watch_events_delivered",
+            "Watch events delivered to subscriber queues (cumulative; "
+            "shared-envelope delivery — no per-watcher copies).", ()),
+        "api_watch_bookmarks": reg.gauge(
+            "karpenter_api_watch_bookmarks",
+            "BOOKMARK events sent to keep idle watchers' resume RVs "
+            "fresh (cumulative).", ()),
+        "api_watch_drops": reg.gauge(
+            "karpenter_api_watch_drops",
+            "Watch events discarded because a subscriber overran its "
+            "bounded queue and was dropped to 410/relist (cumulative).",
+            ()),
+        "api_bulk_ops": reg.gauge(
+            "karpenter_api_bulk_ops",
+            "Write operations applied through the coalescing bulk verb "
+            "(cumulative; one lock acquisition per kind per batch).", ()),
+        "api_fanout_copies": reg.gauge(
+            "karpenter_api_fanout_envelope_copies",
+            "Per-watcher envelope copies made on the watch fan-out path "
+            "(pinned 0: delivery shares one frozen envelope per RV).", ()),
         # lock contention accounting (introspect/contention.py): wait to
         # acquire a hot control-plane lock, observed ONLY on contention
         # (the uncontended path records nothing). Labeled by lock name —
@@ -343,7 +379,8 @@ def wire_core_metrics(reg: Registry) -> Dict[str, _Metric]:
             "karpenter_lock_wait_seconds",
             "Time a thread blocked acquiring a contended control-plane "
             "lock, by lock.", ("lock",),
-            buckets=(0.00005, 0.0002, 0.001, 0.005, 0.02, 0.1, 0.5, 2.0)),
+            buckets=(0.00005, 0.0002, 0.001, 0.005, 0.02, 0.05, 0.1, 0.5,
+                     2.0)),
         # reference metrics.md:62,16,19
         "pods_startup_time": reg.histogram(
             "karpenter_pods_startup_time_seconds",

@@ -199,6 +199,10 @@ class ApiLeaseStore:
                 return False   # lost the creation race
         if obj["spec"].get("holder") != expect_holder:
             return False
+        # get() returns the frozen shared envelope (kube/apiserver.py
+        # copy-on-read discipline) — deepcopy thaws a mutable CAS copy
+        import copy
+        obj = copy.deepcopy(obj)
         if lease is None:
             # release: clear the holder (keep the object — its RV history
             # stays useful and re-creation races disappear)

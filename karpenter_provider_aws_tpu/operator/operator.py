@@ -165,6 +165,17 @@ class Operator:
             install_admission(api_server)
             if api_server._clock is None:
                 api_server._clock = self.clock
+            # watch hub tuning from options (bounded subscriber queues +
+            # bookmark cadence; docs/reference/watch.md). Constructor
+            # wins: a caller that built FakeAPIServer(watch_queue_bound=
+            # ...) already tuned it (cli.py does — its surface serves
+            # before this build), so options only fill defaults.
+            from ..kube.apiserver import BOOKMARK_EVERY, WATCH_QUEUE_BOUND
+            if api_server.watch_queue_bound == WATCH_QUEUE_BOUND:
+                api_server.watch_queue_bound = \
+                    self.options.api_watch_queue_bound
+            if api_server.bookmark_every == BOOKMARK_EVERY:
+                api_server.bookmark_every = self.options.api_bookmark_every
             self.kube = KubeClient(api_server)
             # seed programmatically-passed config into the server (tests
             # may also have pre-created objects there — first write wins)
@@ -533,6 +544,22 @@ class Operator:
                                 status_patch={"resources": delta})
                         except NotFoundError:
                             pass   # pool deleted mid-pass; watch will prune
+        # the API stratum's write/fan-out series (karpenter_api_*):
+        # straight from the watch hub's stats snapshot, so /metrics and
+        # /debug/statusz tell one story about watcher load
+        if self.api_server is not None:
+            api = self.api_server.stats()
+            for key, gname in (
+                    ("watchers", "karpenter_api_watchers"),
+                    ("watch_queue_depth", "karpenter_api_watch_queue_depth"),
+                    ("watch_max_depth", "karpenter_api_watch_max_queue_depth"),
+                    ("events_emitted", "karpenter_api_watch_events_delivered"),
+                    ("bookmarks", "karpenter_api_watch_bookmarks"),
+                    ("watch_drops", "karpenter_api_watch_drops"),
+                    ("bulk_ops", "karpenter_api_bulk_ops"),
+                    ("fanout_envelope_copies",
+                     "karpenter_api_fanout_envelope_copies")):
+                self.metrics.gauge(gname).set(float(api.get(key, 0)))
         # offering gauge surface: re-emit only when pricing or the ICE set
         # actually changed (both are versioned)
         gstate = (self.lattice.price_version, self.unavailable.seq_num)

@@ -79,6 +79,14 @@ class Options:
     # of XLA compile per shape on its first real pass — the cold-start
     # SLO burn spike SOAK_r06 recorded. Empty = in-memory jit cache only
     compile_cache_dir: str = ""
+    # API-mode watch hub tuning (kube/apiserver.py; docs/reference/
+    # watch.md): a subscriber whose queue exceeds the bound is dropped
+    # to 410/relist instead of growing without limit, and a BOOKMARK
+    # event (current RV, no object) goes to each watcher after this many
+    # deliveries so idle watchers' resume points stay fresh. 0 bookmarks
+    # disables them.
+    api_watch_queue_bound: int = 8192
+    api_bookmark_every: int = 256
 
     def validate(self) -> None:
         if not self.cluster_name:
@@ -93,6 +101,10 @@ class Options:
             raise ValueError("vm_memory_overhead_percent must be in [0, 1)")
         if self.batch_idle_duration < 0 or self.batch_max_duration < self.batch_idle_duration:
             raise ValueError("batch windows: need 0 <= idle <= max")
+        if self.api_watch_queue_bound < 1:
+            raise ValueError("api_watch_queue_bound must be >= 1")
+        if self.api_bookmark_every < 0:
+            raise ValueError("api_bookmark_every must be >= 0 (0 disables)")
 
     @staticmethod
     def from_env(**overrides) -> "Options":
@@ -111,6 +123,8 @@ class Options:
             termination_grace_period=_env("TERMINATION_GRACE_PERIOD", None, float),
             solver_address=_env("SOLVER_ADDRESS", "", str),
             compile_cache_dir=_env("COMPILE_CACHE_DIR", "", str),
+            api_watch_queue_bound=_env("API_WATCH_QUEUE_BOUND", 8192, int),
+            api_bookmark_every=_env("API_BOOKMARK_EVERY", 256, int),
         )
         for k, v in overrides.items():
             setattr(opts, k, v)

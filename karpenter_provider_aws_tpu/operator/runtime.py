@@ -73,6 +73,20 @@ class ControllerRuntime:
             self._stop.wait(spec.interval)
 
     def start(self) -> "ControllerRuntime":
+        # the threaded control plane is many short critical sections
+        # under one GIL: at the default 5 ms switch interval, a lock
+        # holder needing a few µs of interpreter time can be starved for
+        # whole scheduling ROUNDS (15 threads × 5 ms ≈ 75 ms) under CPU
+        # saturation — which reads as 100 ms+ lock waits on µs-scale
+        # locks. A 1 ms interval trades a few percent of pure-Python
+        # throughput for 5× tighter lock-wait tails (the SOAK_r08
+        # contention acceptance measured exactly this). Restored by
+        # stop(): the cost is for the threaded control plane's lifetime,
+        # not the embedding process's.
+        import sys
+        if sys.getswitchinterval() > 0.001:
+            self._prev_switch_interval = sys.getswitchinterval()
+            sys.setswitchinterval(0.001)
         self._stop.clear()
         self._threads = [
             threading.Thread(target=self._run, args=(s,),
@@ -98,6 +112,13 @@ class ControllerRuntime:
         # lease duration instead of taking over immediately)
         if self.elector is not None:
             self.elector.release()
+        if not self._threads and getattr(self, "_prev_switch_interval",
+                                         None) is not None:
+            # the control plane's tightened GIL switch interval must not
+            # outlive it in the embedding process
+            import sys
+            sys.setswitchinterval(self._prev_switch_interval)
+            self._prev_switch_interval = None
         return not self._threads
 
     @property

@@ -418,7 +418,8 @@ class TestNodePoolStatusResources:
         # key as an old exported YAML would carry
         spec = serde.nodepool_to_dict(NodePool(name="default", weight=7))
         spec["statusResources"] = {"cpu": "999"}
-        obj = server.get("nodepools", "default")
+        import copy
+        obj = copy.deepcopy(server.get("nodepools", "default"))
         obj["spec"] = spec
         server.update("nodepools", obj)
         after = server.get("nodepools", "default")

@@ -58,14 +58,17 @@ class TestVerbs:
         assert rvs == sorted(rvs) and len(set(rvs)) == 3
 
     def test_update_conflict_on_stale_rv(self):
+        import copy
         s = FakeAPIServer()
-        obj = s.create("pods", serde.pod_to_dict(pod("p0")))
+        # read verbs hand out FROZEN shared envelopes (copy-on-read):
+        # deepcopy thaws a private mutable copy for the CAS flow
+        obj = copy.deepcopy(s.create("pods", serde.pod_to_dict(pod("p0"))))
         s.patch("pods", "p0", {"priority": 1})   # bumps RV behind our back
         obj["spec"]["priority"] = 2
         with pytest.raises(ConflictError):
             s.update("pods", obj)
         # refetch-and-retry succeeds (the client-go retry contract)
-        fresh = s.get("pods", "p0")
+        fresh = copy.deepcopy(s.get("pods", "p0"))
         fresh["spec"]["priority"] = 2
         s.update("pods", fresh)
         assert s.get("pods", "p0")["spec"]["priority"] == 2
@@ -482,17 +485,25 @@ class TestReviewRegressions:
         assert hits and hits[0].deletion_timestamp == 7.0
 
     def test_watch_subscribers_are_isolated(self):
-        """A handler mutating its delivered envelope corrupts neither the
-        history replay nor sibling watchers."""
+        """A handler cannot corrupt sibling watchers or the history
+        replay: delivered envelopes are FROZEN shared objects, so the
+        mutation that used to rely on per-watcher deepcopy isolation
+        now raises outright — structural isolation, zero copies."""
         s = FakeAPIServer()
         w1 = s.watch("pods")
         w2 = s.watch("pods")
         s.create("pods", serde.pod_to_dict(pod("a")))
         ev1 = w1.pop_pending()[0]
-        ev1.object["spec"]["name"] = "CORRUPTED"
+        with pytest.raises(TypeError):
+            ev1.object["spec"]["name"] = "CORRUPTED"
         assert w2.pop_pending()[0].object["spec"]["name"] == "a"
         w3 = s.watch("pods", resource_version=0)  # replays from history
         assert w3.pop_pending()[0].object["spec"]["name"] == "a"
+        # a handler that NEEDS a mutable view thaws its own copy
+        import copy
+        mine = copy.deepcopy(ev1.object)
+        mine["spec"]["name"] = "mine"
+        assert s.get("pods", "a")["spec"]["name"] == "a"
 
 
 class TestEventSink:

@@ -1,0 +1,564 @@
+"""Watch fan-out & write-batching contract (kube/apiserver.py).
+
+The scaled write path's pins (docs/reference/watch.md):
+
+- envelopes freeze at write time: reads, watch delivery, and history
+  replay share ONE object per RV — zero per-watcher copies, and a
+  handler mutating a delivered envelope raises instead of corrupting
+  siblings (the isolation the old per-watcher deepcopy bought),
+- per-watcher queues are bounded: overrun drops the watcher to the
+  TooOldError/relist path, and the informer recovers by relisting,
+- BOOKMARK events keep idle watchers' resume RVs fresh,
+- the bulk verb coalesces many writes into one lock acquisition with
+  per-object events and captured per-op errors,
+- field indexes are real inverted maps (lookups touch only matches),
+  and the PDB allowance math rides the namespace index with verdicts
+  unchanged,
+- per-kind locks + fan-out outside the store lock keep multi-writer/
+  multi-watcher runs linearizable per kind.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import serde
+from karpenter_provider_aws_tpu.apis.objects import (
+    Pod, PodDisruptionBudget,
+)
+from karpenter_provider_aws_tpu.kube.apiserver import (
+    AlreadyExistsError, ConflictError, EvictionBlockedError, FakeAPIServer,
+    FrozenDict, FrozenList, InvalidObjectError, NotFoundError, TooOldError,
+    freeze,
+)
+from karpenter_provider_aws_tpu.kube.client import KubeClient
+from karpenter_provider_aws_tpu.kube.informer import Informer
+from karpenter_provider_aws_tpu.kube.writer import ApiWriter
+from karpenter_provider_aws_tpu.state.cluster import ClusterState
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+
+def pod(name: str, node_name=None, namespace="default", labels=None) -> Pod:
+    return Pod(name=name, namespace=namespace, labels=labels or {},
+               requests={"cpu": "1", "memory": "1Gi"}, node_name=node_name)
+
+
+def pod_spec(name: str, **kw) -> dict:
+    return serde.pod_to_dict(pod(name, **kw))
+
+
+class TestFrozenEnvelopes:
+    """One canonical immutable copy per RV, shared everywhere."""
+
+    def test_delivery_shares_one_event_object(self):
+        s = FakeAPIServer()
+        w1 = s.watch("pods")
+        w2 = s.watch("pods")
+        s.create("pods", pod_spec("a"))
+        ev1 = w1.pop_pending()[0]
+        ev2 = w2.pop_pending()[0]
+        # the SAME WatchEvent and the SAME envelope — delivery copied
+        # nothing, to either subscriber or the history ring
+        assert ev1 is ev2
+        assert ev1.object is s._history["pods"][-1].object
+        assert s.fanout_envelope_copies == 0
+        # a late subscriber's replay shares it too
+        w3 = s.watch("pods", resource_version=0)
+        assert w3.pop_pending()[0].object is ev1.object
+
+    def test_reads_share_the_stored_envelope(self):
+        s = FakeAPIServer()
+        created = s.create("pods", pod_spec("a"))
+        got = s.get("pods", "a")
+        listed, _ = s.list("pods")
+        assert created is got is listed[0]
+
+    def test_envelopes_are_frozen_at_every_level(self):
+        s = FakeAPIServer()
+        obj = s.create("pods", pod_spec("a"))
+        assert isinstance(obj, FrozenDict)
+        with pytest.raises(TypeError):
+            obj["extra"] = 1
+        with pytest.raises(TypeError):
+            obj["spec"]["nodeName"] = "hijack"
+        with pytest.raises(TypeError):
+            obj["metadata"]["finalizers"].append("x")
+        with pytest.raises(TypeError):
+            del obj["status"]
+        with pytest.raises(TypeError):
+            obj["spec"].update({"a": 1})
+        assert isinstance(obj["metadata"]["finalizers"], FrozenList)
+
+    def test_deepcopy_thaws_to_plain_mutable(self):
+        s = FakeAPIServer()
+        obj = s.create("pods", pod_spec("a"))
+        mine = copy.deepcopy(obj)
+        assert type(mine) is dict
+        assert type(mine["metadata"]["finalizers"]) is list
+        mine["spec"]["nodeName"] = "n0"   # no raise
+        # the store is untouched by the private copy
+        assert s.get("pods", "a")["spec"].get("nodeName") is None
+
+    def test_frozen_survives_json_roundtrip(self):
+        import json
+        s = FakeAPIServer()
+        obj = s.create("pods", pod_spec("a"))
+        doc = json.loads(json.dumps(obj))
+        assert doc["spec"]["name"] == "a"
+        # freeze() itself round-trips nested shapes
+        f = freeze({"a": [{"b": 1}], "c": (2, 3)})
+        assert isinstance(f["a"], FrozenList)
+        assert isinstance(f["a"][0], FrozenDict)
+        assert json.dumps(f)
+
+    def test_get_by_index_returns_frozen_shared(self):
+        s = FakeAPIServer()
+        s.add_index("pods", "nodeName", lambda spec: spec.get("nodeName"))
+        s.create("pods", pod_spec("a", node_name="n0"))
+        hits = s.get_by_index("pods", "nodeName", "n0")
+        assert hits and hits[0] is s.get("pods", "a")
+
+
+class TestBookmarks:
+    def test_bookmark_after_every_n_deliveries(self):
+        s = FakeAPIServer(bookmark_every=3)
+        w = s.watch("pods")
+        for i in range(3):
+            s.create("pods", pod_spec(f"p{i}"))
+        evs = w.pop_pending()
+        assert [e.type for e in evs] == ["ADDED", "ADDED", "ADDED",
+                                        "BOOKMARK"]
+        # the bookmark carries the kind's current RV — a resume point
+        assert evs[-1].resource_version == evs[-2].resource_version
+        assert s.stats()["bookmarks"] == 1
+
+    def test_delivered_rvs_are_monotonic(self):
+        s = FakeAPIServer(bookmark_every=2)
+        w = s.watch("pods")
+        for i in range(7):
+            s.create("pods", pod_spec(f"p{i}"))
+        rvs = [e.resource_version for e in w.pop_pending()]
+        assert rvs == sorted(rvs)
+
+    def test_informer_applies_bookmark_without_handler_call(self):
+        s = FakeAPIServer(bookmark_every=2)
+        calls = []
+        inf = Informer(s, "pods",
+                       lambda t, n, o, old: calls.append((t, n)))
+        inf.sync_once()   # initial list
+        s.create("pods", pod_spec("a"))
+        s.create("pods", pod_spec("b"))
+        inf.sync_once()
+        assert [t for t, _ in calls] == ["ADDED", "ADDED"]
+        assert set(inf.store) == {"a", "b"}
+        # the bookmark advanced the resume point to the kind high-water
+        assert inf._rv == s.last_rv
+
+    def test_zero_disables_bookmarks(self):
+        s = FakeAPIServer(bookmark_every=0)
+        w = s.watch("pods")
+        for i in range(10):
+            s.create("pods", pod_spec(f"p{i}"))
+        assert all(e.type == "ADDED" for e in w.pop_pending())
+        assert s.stats()["bookmarks"] == 0
+
+
+class TestBoundedQueues:
+    def test_overflow_drops_watcher_to_410(self):
+        s = FakeAPIServer(watch_queue_bound=4)
+        w = s.watch("pods")
+        for i in range(6):
+            s.create("pods", pod_spec(f"p{i}"))
+        with pytest.raises(TooOldError):
+            w.pop_pending()
+        # and keeps raising: the watcher is dead until it relists
+        with pytest.raises(TooOldError):
+            w.get(timeout=0)
+        assert s.stats()["watch_drops"] >= 5
+
+    def test_overflow_never_convoys_the_writer(self):
+        """The write path stays up while a dead-slow watcher overflows —
+        writes succeed and OTHER watchers keep receiving."""
+        s = FakeAPIServer(watch_queue_bound=4)
+        slow = s.watch("pods")
+        for i in range(20):
+            s.create("pods", pod_spec(f"p{i}"))
+        live = s.watch("pods", resource_version=0)   # replays history
+        assert len(s._store["pods"]) == 20
+        assert len(live.pop_pending()) == 20
+        with pytest.raises(TooOldError):
+            slow.pop_pending()
+
+    def test_informer_relists_after_overflow(self):
+        s = FakeAPIServer(watch_queue_bound=4)
+        calls = []
+        inf = Informer(s, "pods",
+                       lambda t, n, o, old: calls.append((t, n)))
+        inf.sync_once()
+        for i in range(10):
+            s.create("pods", pod_spec(f"p{i}"))
+        s.delete("pods", "p0")
+        # the watcher overran its bound; the pump recovers by RELISTING
+        inf.sync_once()
+        assert set(inf.store) == set(s._store["pods"])
+        # the relist synthesized ADDs for the survivors (p0 came and
+        # went entirely inside the blackout — it never surfaces)
+        assert ("ADDED", "p1") in calls
+        assert all(n != "p0" for _, n in calls)
+        # and the informer is live again afterwards
+        s.create("pods", pod_spec("late"))
+        inf.sync_once()
+        assert "late" in inf.store
+
+    def test_threaded_informer_recovers_from_overflow(self):
+        s = FakeAPIServer(watch_queue_bound=8)
+        inf = Informer(s, "pods").start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while not inf.has_synced and time.monotonic() < deadline:
+                time.sleep(0.01)
+            for i in range(200):
+                s.create("pods", pod_spec(f"p{i}"))
+            while time.monotonic() < deadline:
+                if set(inf.store) == set(s._store["pods"]):
+                    break
+                time.sleep(0.02)
+            assert set(inf.store) == set(s._store["pods"])
+        finally:
+            inf.stop()
+
+
+class TestBulkVerb:
+    def test_bulk_coalesces_creates_with_per_object_events(self):
+        s = FakeAPIServer()
+        w = s.watch("pods")
+        res = s.bulk([("create", "pods", pod_spec(f"p{i}"))
+                      for i in range(5)])
+        assert all(isinstance(r, dict) for r in res)
+        rvs = [r["metadata"]["resourceVersion"] for r in res]
+        assert rvs == sorted(rvs)            # one ordered RV range
+        evs = w.pop_pending()
+        assert [e.type for e in evs] == ["ADDED"] * 5
+        assert s.bulk_calls == 1 and s.bulk_ops == 5
+
+    def test_bulk_captures_per_op_errors(self):
+        s = FakeAPIServer()
+        s.create("pods", pod_spec("dup"))
+        res = s.bulk([
+            ("create", "pods", pod_spec("dup")),       # AlreadyExists
+            ("create", "pods", pod_spec("ok")),
+            ("bind", "missing", "n0"),                 # NotFound
+            ("bind", "ok", "n0"),
+        ])
+        assert isinstance(res[0], AlreadyExistsError)
+        assert isinstance(res[1], dict)
+        assert isinstance(res[2], NotFoundError)
+        assert res[3]["spec"]["nodeName"] == "n0"
+
+    def test_bulk_runs_admission(self):
+        s = FakeAPIServer()
+        s.register_admission(
+            "pods", validate=lambda spec: (["rejected by test"]
+                                           if spec.get("labels", {}).get("bad")
+                                           else []))
+        res = s.bulk([("create", "pods", pod_spec("fine")),
+                      ("create", "pods", pod_spec("bad", labels={"bad": "1"}))])
+        assert isinstance(res[0], dict)
+        assert isinstance(res[1], InvalidObjectError)
+        assert "bad" not in s._store["pods"]
+
+    def test_bulk_mixed_kinds_and_delete(self):
+        s = FakeAPIServer()
+        res = s.bulk([
+            ("create", "pods", pod_spec("p0")),
+            ("create", "nodes", {"name": "n0"}),
+            ("bind", "p0", "n0"),
+            ("delete", "pods", "p0"),
+        ])
+        assert not any(isinstance(r, Exception) for r in res)
+        assert "p0" not in s._store["pods"]
+        assert "n0" in s._store["nodes"]
+
+    def test_client_bind_pods_verdicts(self):
+        s = FakeAPIServer()
+        c = KubeClient(s)
+        c.create_pod(pod("a"))
+        c.create_pod(pod("b", node_name="taken"))   # already bound
+        oks = c.bind_pods([("a", "n0"), ("b", "n0"), ("ghost", "n0")])
+        assert oks == [True, False, False]
+        assert s.get("pods", "a")["spec"]["nodeName"] == "n0"
+
+    def test_client_create_pods_bulk(self):
+        s = FakeAPIServer()
+        c = KubeClient(s)
+        errs = c.create_pods([pod(f"p{i}") for i in range(4)])
+        assert errs == [None] * 4
+        errs = c.create_pods([pod("p0")])
+        assert isinstance(errs[0], AlreadyExistsError)
+
+
+class TestWriterBatching:
+    def _writer(self):
+        clock = FakeClock()
+        s = FakeAPIServer(clock=clock)
+        cluster = ClusterState(clock)
+        return s, KubeClient(s), ApiWriter(KubeClient(s), cluster, clock)
+
+    def test_apiwriter_bind_pods_is_one_bulk_call(self):
+        s, c, w = self._writer()
+        for i in range(6):
+            c.create_pod(pod(f"p{i}"))
+        before = s.bulk_calls
+        oks = w.bind_pods([(f"p{i}", "n0") for i in range(6)])
+        assert oks == [True] * 6
+        assert s.bulk_calls == before + 1
+        assert w.stats()["bind_pod"] == 6
+        assert w.stats()["bulk_binds"] == 1
+
+    def test_apiwriter_drain_verdicts_ride_bulk(self):
+        clock = FakeClock()
+        s = FakeAPIServer(clock=clock)
+        cluster = ClusterState(clock)
+        c = KubeClient(s)
+        w = ApiWriter(c, cluster, clock)
+        # two app pods behind a minAvailable=1 PDB, both on n0 — exactly
+        # one eviction is allowed; the pre-index sequential verdicts
+        for i in range(2):
+            p = pod(f"app-{i}", node_name="n0", labels={"app": "web"})
+            c.create_pod(p)
+            cluster.add_pod(p)
+        free = pod("free", node_name="n0")
+        c.create_pod(free)
+        cluster.add_pod(free)
+        c.create_pdb(PodDisruptionBudget(
+            name="web-pdb", label_selector={"app": "web"}, min_available=1))
+        before = s.bulk_calls
+        evicted, blocked = w.drain_node("n0")
+        assert s.bulk_calls == before + 1
+        names = {p.name for p in evicted}
+        assert "free" in names                      # un-budgeted pod evicts
+        assert len([p for p in evicted if p.name.startswith("app-")]) == 1
+        assert len(blocked) == 1                    # the PDB held one back
+
+
+class TestIndexes:
+    def test_lookup_never_scans_the_store(self):
+        s = FakeAPIServer()
+        calls = []
+
+        def key_fn(spec):
+            calls.append(spec["name"])
+            return spec.get("nodeName")
+
+        s.add_index("pods", "nodeName", key_fn)
+        for i in range(50):
+            s.create("pods", pod_spec(f"p{i}",
+                                      node_name="n0" if i < 3 else "n1"))
+        calls.clear()
+        hits = s.get_by_index("pods", "nodeName", "n0")
+        # the inverted map answered — the key_fn saw NO object on read
+        assert calls == []
+        assert sorted(o["spec"]["name"] for o in hits) == ["p0", "p1", "p2"]
+
+    def test_index_follows_updates_and_deletes(self):
+        s = FakeAPIServer()
+        s.add_index("pods", "nodeName", lambda spec: spec.get("nodeName"))
+        s.create("pods", pod_spec("a", node_name="n0"))
+        s.patch("pods", "a", {"nodeName": "n1"})
+        assert s.get_by_index("pods", "nodeName", "n0") == []
+        assert len(s.get_by_index("pods", "nodeName", "n1")) == 1
+        s.delete("pods", "a")
+        assert s.get_by_index("pods", "nodeName", "n1") == []
+
+    def test_index_registered_late_backfills(self):
+        s = FakeAPIServer()
+        s.create("pods", pod_spec("a", node_name="n0"))
+        s.add_index("pods", "nodeName", lambda spec: spec.get("nodeName"))
+        assert len(s.get_by_index("pods", "nodeName", "n0")) == 1
+
+    def test_namespace_index_feeds_pdb_allowance(self):
+        s = FakeAPIServer()
+        # same labels, different namespaces: the allowance for ns-a's
+        # PDB must count ONLY ns-a pods (and via the ns index bucket)
+        for ns in ("ns-a", "ns-b"):
+            for i in range(3):
+                s.create("pods", pod_spec(f"{ns}-{i}", node_name="n0",
+                                          namespace=ns,
+                                          labels={"app": "web"}))
+        allowance = s._pdb_allowance({
+            "labelSelector": {"app": "web"}, "namespace": "ns-a",
+            "minAvailable": 1})
+        assert allowance == 2   # 3 healthy in ns-a, minAvailable 1
+        bucket = s._index_maps[("pods", "namespace")]["ns-a"]
+        assert len(bucket) == 3
+
+
+class TestLinearizability:
+    """Multi-writer / multi-watcher race: per-kind order and convergence
+    survive the lock decomposition + out-of-lock fan-out."""
+
+    def test_multi_writer_multi_watcher_race(self):
+        s = FakeAPIServer()
+        n_writers, per_writer = 4, 60
+        watchers = [s.watch("pods") for _ in range(3)]
+        nodes_w = s.watch("nodes")
+        errors = []
+
+        def writer(wid: int):
+            try:
+                for i in range(per_writer):
+                    s.create("pods", pod_spec(f"w{wid}-p{i}"))
+                    if i % 3 == 0:
+                        s.create("nodes", {"name": f"w{wid}-n{i}"})
+                    if i % 5 == 0:
+                        s.patch("pods", f"w{wid}-p{i}", {"priority": i})
+            except Exception as e:   # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(w,))
+                   for w in range(n_writers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert len(s._store["pods"]) == n_writers * per_writer
+        # every watcher saw every pod event exactly once, in RV order
+        expect_events = (n_writers * per_writer               # ADDED
+                         + n_writers * ((per_writer + 4) // 5))  # MODIFIED
+        for w in watchers:
+            evs = [e for e in w.pop_pending() if e.type != "BOOKMARK"]
+            rvs = [e.resource_version for e in evs]
+            assert rvs == sorted(rvs)
+            assert len(rvs) == len(set(rvs))
+            assert len(evs) == expect_events
+        node_evs = [e for e in nodes_w.pop_pending()
+                    if e.type != "BOOKMARK"]
+        assert len(node_evs) == len(s._store["nodes"])
+
+    def test_watch_stream_replays_to_exact_store_state(self):
+        """Lost-event regression (the SOAK_r08 agreement catch): under
+        concurrent writers + interleaved flushers, applying a watcher's
+        full event stream must reconstruct the server's exact final
+        store — one lost DELETE leaves a phantom the mirror never heals
+        from. (The original bug: the flusher drained the publish queue
+        with list()+clear() under the publish mutex while writers append
+        under the STORE lock — an append racing the gap was cleared
+        undelivered.)"""
+        s = FakeAPIServer(bookmark_every=0)
+        w = s.watch("pods")
+        n_threads, rounds = 8, 120
+        errors = []
+
+        def churn(tid: int):
+            try:
+                for i in range(rounds):
+                    name = f"t{tid}-{i}"
+                    s.create("pods", pod_spec(name))
+                    if i % 2 == 0:
+                        s.patch("pods", name, {"priority": i})
+                    if i % 3 == 0:
+                        s.delete("pods", name)
+            except Exception as e:   # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=churn, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        replayed = {}
+        for ev in w.pop_pending():
+            if ev.type == "DELETED":
+                replayed.pop(ev.object["metadata"]["name"], None)
+            else:
+                replayed[ev.object["metadata"]["name"]] = ev.object
+        assert set(replayed) == set(s._store["pods"])
+        # and the surviving objects are at their final revisions
+        for name, obj in replayed.items():
+            assert (obj["metadata"]["resourceVersion"]
+                    == s._store["pods"][name]["metadata"]["resourceVersion"])
+
+    def test_rv_monotonic_per_kind_across_concurrent_kinds(self):
+        s = FakeAPIServer()
+        done = []
+
+        def churn(kind: str, count: int):
+            for i in range(count):
+                s.create(kind, {"name": f"{kind}-{i}"})
+            done.append(kind)
+
+        ts = [threading.Thread(target=churn, args=(k, 100))
+              for k in ("nodes", "leases")]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert sorted(done) == ["leases", "nodes"]
+        for kind in ("nodes", "leases"):
+            rvs = [o["metadata"]["resourceVersion"]
+                   for o in s._store[kind].values()]
+            assert len(set(rvs)) == len(rvs)
+        # the global high-water covers both kinds' allocations
+        assert s.last_rv >= 200
+
+
+class TestStats:
+    def test_stats_reports_depth_via_locked_accessor(self):
+        s = FakeAPIServer()
+        w = s.watch("pods")
+        w2 = s.watch("pods")
+        for i in range(4):
+            s.create("pods", pod_spec(f"p{i}"))
+        st = s.stats()
+        assert st["watchers"] == 2
+        assert st["watch_queue_depth"] == 8
+        assert st["watch_max_depth"] == 4
+        assert w.depth() == 4 and w2.depth() == 4
+        assert st["fanout_envelope_copies"] == 0
+        assert st["events_emitted"] == 8
+        w.pop_pending()
+        assert s.stats()["watch_queue_depth"] == 4
+
+    def test_bulk_counters_surface(self):
+        s = FakeAPIServer()
+        s.bulk([("create", "pods", pod_spec("a")),
+                ("create", "nodes", {"name": "n0"})])
+        st = s.stats()
+        assert st["bulk_calls"] == 1
+        assert st["bulk_ops"] == 2
+
+    def test_gc_re_enabled_after_every_verb(self):
+        """The collector-deferral guard (a gc pause inside a store lock
+        would convoy that kind's writers) must always restore automatic
+        collection — including across a multi-chunk bulk."""
+        import gc
+        assert gc.isenabled()
+        s = FakeAPIServer()
+        s.bulk([("create", "pods", pod_spec(f"p{i}")) for i in range(300)])
+        assert gc.isenabled()
+        s.patch("pods", "p0", {"priority": 1})
+        s.bind("p1", "n0")
+        s.delete("pods", "p2")
+        assert gc.isenabled()
+
+    def test_bulk_chunks_preserve_order_and_flush_once(self):
+        """A bulk bigger than the per-acquisition chunk still delivers
+        every event, in RV order, through ONE flush epoch."""
+        from karpenter_provider_aws_tpu.kube.apiserver import BULK_CHUNK
+        s = FakeAPIServer(bookmark_every=0)
+        w = s.watch("pods")
+        n = BULK_CHUNK * 2 + 17
+        res = s.bulk([("create", "pods", pod_spec(f"p{i}"))
+                      for i in range(n)])
+        assert all(isinstance(r, dict) for r in res)
+        evs = w.pop_pending()
+        assert len(evs) == n
+        rvs = [e.resource_version for e in evs]
+        assert rvs == sorted(rvs)

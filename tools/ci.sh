@@ -28,8 +28,14 @@
 #               contention counters for every instrumented hot lock,
 #               the gzip negotiation, and the live scrape (with the new
 #               karpenter_lock_wait_seconds family) linting clean
-#   5. tier-1 — the full non-slow test suite on the CPU backend
-#   6. bench  — `bench.py --smoke`: one fast config through the real
+#   5. write  — API-stratum write-path gate (tools/smoke_writepath.py):
+#               boots an API-mode operator, drives a churn burst through
+#               ApiWriter, asserts the bulk/coalesced write path engaged
+#               (counters > 0), zero fan-out envelope copies, the
+#               watch-fed mirror converging to the store, and the live
+#               /metrics scrape (karpenter_api_* series) linting clean
+#   6. tier-1 — the full non-slow test suite on the CPU backend
+#   7. bench  — `bench.py --smoke`: one fast config through the real
 #               harness, so a broken solve path can never ride in on a
 #               green unit-test run
 
@@ -41,7 +47,7 @@ PY=${PYTHON:-python}
 FAST=0
 [ "${1:-}" = "--fast" ] && FAST=1
 
-echo "=== ci [1/6] generated-artifact drift ==="
+echo "=== ci [1/7] generated-artifact drift ==="
 $PY tools/gen_crds.py --check
 tmp=$(mktemp -d)
 trap 'rm -rf "$tmp"' EXIT
@@ -56,23 +62,26 @@ done
 [ "$stale" = 0 ] || exit 1
 echo "drift: clean"
 
-echo "=== ci [2/6] introspection smoke + metrics lint ==="
+echo "=== ci [2/7] introspection smoke + metrics lint ==="
 $PY tools/smoke_introspect.py
 
-echo "=== ci [3/6] steady-state delta churn smoke ==="
+echo "=== ci [3/7] steady-state delta churn smoke ==="
 $PY tools/smoke_delta.py
 
-echo "=== ci [4/6] continuous-profiling smoke ==="
+echo "=== ci [4/7] continuous-profiling smoke ==="
 $PY tools/smoke_profile.py
 
-echo "=== ci [5/6] tier-1 tests ==="
+echo "=== ci [5/7] write-path smoke ==="
+$PY tools/smoke_writepath.py
+
+echo "=== ci [6/7] tier-1 tests ==="
 $PY -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider
 
 if [ "$FAST" = 1 ]; then
-    echo "=== ci [6/6] bench smoke: SKIPPED (--fast) ==="
+    echo "=== ci [7/7] bench smoke: SKIPPED (--fast) ==="
 else
-    echo "=== ci [6/6] bench smoke ==="
+    echo "=== ci [7/7] bench smoke ==="
     $PY bench.py --smoke
 fi
 

@@ -317,6 +317,19 @@ def cmd_watch(c: Client, args) -> int:
         ev = json.loads(line)
         if ev["type"] == "HEARTBEAT":
             continue
+        if ev["type"] == "BOOKMARK":
+            # RV checkpoint, no object payload — remember the resume
+            # point silently (docs/reference/watch.md)
+            rv = ev.get("resourceVersion", rv)
+            continue
+        if ev["type"] == "ERROR":
+            # the server dropped this watcher (410-mid-stream: queue
+            # overrun or history expiry) — report and stop; re-running
+            # `kpctl watch` relists, like a reflector
+            print(f"ERROR\t{ev.get('code', '')} {ev.get('reason', '')}: "
+                  f"{ev.get('message', '')} (re-run to relist)",
+                  flush=True)
+            return 1
         name = ev["object"]["metadata"]["name"]
         print(f"{ev['type']}\t{args.kind}/{name}\trv={ev['resourceVersion']}",
               flush=True)
@@ -553,8 +566,11 @@ def _render_top(doc, server: str):
     if "watch_hub" in p:
         lines.append(
             f"WATCHES   {g('watch_hub', 'watchers'):g} watchers   "
-            f"queue {g('watch_hub', 'watch_queue_depth'):g}   "
-            f"delivered {g('watch_hub', 'events_emitted'):g}")
+            f"queue {g('watch_hub', 'watch_queue_depth'):g} "
+            f"(max {g('watch_hub', 'watch_max_depth'):g})   "
+            f"delivered {g('watch_hub', 'events_emitted'):g}   "
+            f"bulk {g('watch_hub', 'bulk_ops'):g}   "
+            f"drops {g('watch_hub', 'watch_drops'):g}")
     lines.append(
         f"EVENTS    {g('events', 'published'):g} published "
         f"({g('events', 'warnings'):g} warnings)")

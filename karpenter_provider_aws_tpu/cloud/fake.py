@@ -110,6 +110,13 @@ class FakeCloud:
         with self._lock:
             self.capacity_pools[(capacity_type, instance_type, zone)] = remaining
 
+    def clear_capacity(self, capacity_type: str, instance_type: str,
+                       zone: str) -> None:
+        """Drop a pool's limit entirely (absent = unlimited) — how the
+        weather simulator thaws an ICE'd offering back to fair weather."""
+        with self._lock:
+            self.capacity_pools.pop((capacity_type, instance_type, zone), None)
+
     def inject_error(self, err: BaseException) -> None:
         with self._lock:
             self.next_error = err
@@ -177,6 +184,16 @@ class FakeCloud:
             self._maybe_raise()
             return [i for i in self.instances.values()
                     if include_terminated or i.state not in ("terminated",)]
+
+    def peek_instances(self) -> List[CloudInstance]:
+        """Side-effect-free running-instance snapshot for observers (the
+        weather simulator's storm targeting): no call recording and no
+        injected-error consumption — a chaos observer must never race a
+        controller for a test-injected fault (same contract as
+        liveness_probe)."""
+        with self._lock:
+            return [i for i in self.instances.values()
+                    if i.state == "running"]
 
     def liveness_probe(self) -> None:
         """Side-effect-free connectivity check for health endpoints: no

@@ -28,11 +28,15 @@ from ..events import Recorder
 from ..metrics import Registry, wire_core_metrics
 from ..state.cluster import ClusterState
 from ..utils.clock import Clock
-from .messages import InterruptionMessage, MessageKind, parse_message
+from .messages import (InterruptionMessage, KIND_LABELS, MessageKind,
+                       parse_message)
 from .queue import FakeQueue
 
 _ACTIONABLE = {MessageKind.SPOT_INTERRUPTION, MessageKind.SCHEDULED_CHANGE,
                MessageKind.STATE_CHANGE}
+# kinds whose handler runs at all (rebalance publishes an event; noop and
+# malformed bodies are counted + deleted without touching the cluster)
+_HANDLED = _ACTIONABLE | {MessageKind.REBALANCE_RECOMMENDATION}
 
 
 class InterruptionController:
@@ -51,8 +55,29 @@ class InterruptionController:
         self._m_received = m["interruption_received"]
         self._m_deleted = m["interruption_deleted"]
         self._m_actions = m["interruption_actions"]
+        self._m_messages = m["interruption_messages"]
+        self._m_qdepth = m["interruption_queue_depth"]
+        # plain counters mirrored into stats() (the introspection
+        # registry's "interruption" provider): per-kind totals plus the
+        # two robustness signals a storm soak asserts on
+        import threading
+        self._stats_lock = threading.Lock()
+        self._kind_counts: Dict[str, int] = {}
+        self.handler_errors = 0
+        self.poison_dropped = 0
+        # per-message handler-failure counts (the SQS
+        # ApproximateReceiveCount analog): a TRANSIENT handler failure
+        # leaves the message in the queue for redelivery (at-least-once
+        # holds — a 2-minute spot notice must not be lost to one cloud
+        # hiccup), while a message that fails HANDLER_RETRY_LIMIT times
+        # is a poison pill: counted and dropped so it can neither crash
+        # nor wedge the loop. Entries are removed on delete, so the map
+        # is bounded by live queue depth.
+        self._attempts: Dict[str, int] = {}
         from ..utils.fanout import LazyPool
         self._pool = LazyPool(self.MESSAGE_WORKERS, "interruption-msg")
+
+    HANDLER_RETRY_LIMIT = 3
 
     def _claims_by_instance_id(self) -> Dict[str, NodeClaim]:
         out: Dict[str, NodeClaim] = {}
@@ -68,22 +93,64 @@ class InterruptionController:
         """One receive→handle→delete pass (10-way parallel like
         workqueue.ParallelizeUntil, controller.go:104). Returns messages
         handled; the at-least-once contract holds — a message is deleted
-        only after its handler ran."""
+        only after its handler ran, and a handler blow-up leaves it in
+        the queue for redelivery. Malformed/unknown bodies and messages
+        whose handler keeps failing (HANDLER_RETRY_LIMIT) are COUNTED
+        and dropped: one poison pill can neither crash the controller
+        loop nor wedge it via endless redelivery while a storm rages."""
         msgs = self.queue.receive()
         if not msgs:
+            self._m_qdepth.set(float(len(self.queue)))
             return 0
         claims_by_id = self._claims_by_instance_id()
 
         def one(qm) -> int:
-            msg = parse_message(qm.body)
+            msg = parse_message(qm.body)   # never raises (messages.py)
+            # the legacy received counter keeps true receive semantics
+            # (one inc per delivery, redeliveries included)
             self._m_received.inc(message_type=msg.kind.value)
-            if msg.kind != MessageKind.NOOP:
-                self._handle(msg, claims_by_id)
+            if msg.kind in _HANDLED:
+                try:
+                    self._handle(msg, claims_by_id)
+                except Exception:
+                    with self._stats_lock:
+                        self.handler_errors += 1
+                        attempts = self._attempts.get(qm.id, 0) + 1
+                        self._attempts[qm.id] = attempts
+                    if attempts < self.HANDLER_RETRY_LIMIT:
+                        # transient until proven otherwise: leave the
+                        # message for redelivery (at-least-once)
+                        return 0
+                    with self._stats_lock:
+                        self.poison_dropped += 1
+            # the per-kind processed counters count on DISPOSAL (exactly
+            # once per message), never per delivery — a transiently
+            # retried message must not pad them (the soak's >100
+            # interruptions-handled evidence sums these)
+            label = KIND_LABELS[msg.kind]
+            self._m_messages.inc(kind=label)
+            with self._stats_lock:
+                self._kind_counts[label] = \
+                    self._kind_counts.get(label, 0) + 1
+                self._attempts.pop(qm.id, None)
             self.queue.delete(qm.receipt_handle)
             self._m_deleted.inc()
             return 1
 
-        return sum(self._pool.run(msgs, one))
+        n = sum(self._pool.run(msgs, one))
+        self._m_qdepth.set(float(len(self.queue)))
+        return n
+
+    def stats(self) -> Dict:
+        """Introspection provider (docs/reference/introspection.md): queue
+        depth plus per-kind message totals and the robustness counters."""
+        with self._stats_lock:
+            out: Dict = {f"received_{k.replace('-', '_')}": v
+                         for k, v in self._kind_counts.items()}
+            out["handler_errors"] = self.handler_errors
+            out["poison_dropped"] = self.poison_dropped
+        out["queue_depth"] = len(self.queue)
+        return out
 
     def _handle(self, msg: InterruptionMessage, claims_by_id: Dict[str, NodeClaim]) -> None:
         for iid in msg.instance_ids:

@@ -26,6 +26,23 @@ class MessageKind(str, enum.Enum):
     SCHEDULED_CHANGE = "ScheduledChangeKind"
     STATE_CHANGE = "StateChangeKind"
     NOOP = "NoOpKind"
+    # a body that is not a dict, or that matched a registered parser but
+    # blew it up (missing/mistyped detail fields): counted and dropped —
+    # distinct from NOOP (a well-formed message we deliberately ignore)
+    # so the karpenter_interruption_messages_total{kind="malformed"}
+    # series can alarm on a misconfigured event rule
+    MALFORMED = "MalformedKind"
+
+
+# metric label values per kind (karpenter_interruption_messages_total)
+KIND_LABELS = {
+    MessageKind.SPOT_INTERRUPTION: "spot-interruption",
+    MessageKind.REBALANCE_RECOMMENDATION: "rebalance-recommendation",
+    MessageKind.SCHEDULED_CHANGE: "scheduled-change",
+    MessageKind.STATE_CHANGE: "state-change",
+    MessageKind.NOOP: "noop",
+    MessageKind.MALFORMED: "malformed",
+}
 
 
 @dataclass(frozen=True)
@@ -125,17 +142,26 @@ _PARSERS = {
 
 
 def parse_message(body: Dict) -> InterruptionMessage:
-    noop = InterruptionMessage(kind=MessageKind.NOOP, instance_ids=(),
-                               source=str(body.get("source", "")),
-                               detail_type=str(body.get("detail-type", "")))
+    """Never raises. A non-dict body (the isinstance check runs BEFORE any
+    ``body.get`` — a list/str body used to crash the noop construction
+    itself) and a registered parser blowing up both classify as MALFORMED;
+    an unknown (source, detail-type) pair is a well-formed NOOP, like the
+    reference's default parser."""
     if not isinstance(body, dict):
-        return noop
+        return InterruptionMessage(kind=MessageKind.MALFORMED, instance_ids=())
     parser = _PARSERS.get((body.get("source", ""), body.get("detail-type", "")))
     if parser is None:
-        return noop
+        return InterruptionMessage(
+            kind=MessageKind.NOOP, instance_ids=(),
+            source=str(body.get("source", "")),
+            detail_type=str(body.get("detail-type", "")))
     try:
         return parser(body)
-    except (KeyError, TypeError, AttributeError):
-        # a malformed body must never poison the queue: treat as NoOp so the
-        # controller deletes it (the reference's parsers degrade the same way)
-        return noop
+    except Exception:
+        # a malformed body must never poison the queue: classify it so the
+        # controller counts + deletes it (the reference's parsers degrade
+        # to a drop the same way)
+        return InterruptionMessage(
+            kind=MessageKind.MALFORMED, instance_ids=(),
+            source=str(body.get("source", "")),
+            detail_type=str(body.get("detail-type", "")))

@@ -419,6 +419,48 @@ def wire_core_metrics(reg: Registry) -> Dict[str, _Metric]:
         "interruption_actions": reg.counter(
             "karpenter_interruption_actions_performed_total",
             "Node drain actions taken for interruption messages.", ("action",)),
+        # robustness surface (interruption/controller.py): every body the
+        # controller pulled, by parsed kind — malformed/unknown bodies are
+        # counted and dropped, never crash the controller loop (kind:
+        # spot-interruption | rebalance-recommendation | scheduled-change |
+        # state-change | noop | malformed)
+        "interruption_messages": reg.counter(
+            "karpenter_interruption_messages_total",
+            "Interruption queue messages processed, by parsed kind "
+            "(malformed bodies count under kind=\"malformed\" and are "
+            "dropped without crashing the controller).", ("kind",)),
+        "interruption_queue_depth": reg.gauge(
+            "karpenter_interruption_queue_depth",
+            "Messages currently in the interruption queue (sent, not yet "
+            "deleted) at the last reconcile.", ()),
+        # the adversarial weather simulator (weather/; docs/reference/
+        # weather.md): live scenario state while a --weather soak or the
+        # CI squall smoke drives the control plane
+        "weather_storm_active": reg.gauge(
+            "karpenter_weather_storm_active",
+            "Interruption storms currently active in the weather "
+            "scenario (0 = fair weather).", ()),
+        "weather_ice_pools": reg.gauge(
+            "karpenter_weather_ice_pools",
+            "Offerings currently held out of capacity by the weather "
+            "simulator's ICE field.", ()),
+        "weather_spot_mult_mean": reg.gauge(
+            "karpenter_weather_spot_price_multiplier_mean",
+            "Mean spot-price multiplier over the base market across all "
+            "(family, zone) walks.", ()),
+        "weather_spot_mult_max": reg.gauge(
+            "karpenter_weather_spot_price_multiplier_max",
+            "Worst-case spot-price multiplier over the base market "
+            "across all (family, zone) walks.", ()),
+        "weather_ticks": reg.gauge(
+            "karpenter_weather_ticks",
+            "Weather ticks simulated so far (the deterministic timeline "
+            "index).", ()),
+        "weather_events": reg.counter(
+            "karpenter_weather_events_total",
+            "Weather timeline events applied, by kind (reprice | regime | "
+            "storm-begin | storm-burst | storm-end | ice | ice-thaw | "
+            "device).", ("kind",)),
         "cluster_state_synced": reg.gauge(
             "karpenter_cluster_state_synced",
             "1 when cluster state has synced with the cloud (reference "

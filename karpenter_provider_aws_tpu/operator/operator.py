@@ -333,6 +333,8 @@ class Operator:
             for k, v in c.stats().items() if k != "ttl_seconds"})
         if self.api_server is not None:
             reg.register("watch_hub", self.api_server.stats)
+        if self.interruption is not None:
+            reg.register("interruption", self.interruption.stats)
         reg.register("flight_recorder", lambda: (
             trace.recorder().introspect_stats()
             if trace.recorder() is not None else {"enabled": False}))

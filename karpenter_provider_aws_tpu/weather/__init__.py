@@ -1,0 +1,12 @@
+"""Adversarial scenario weather (docs/reference/weather.md): replayable
+spot-market + interruption-storm chaos driving the degradation ladder."""
+
+from .fields import IceField, SpotMarketField
+from .scenario import (IceSpell, NAMED_SCENARIOS, Regime, Storm,
+                       WeatherScenario, load_scenario, named)
+from .simulator import WeatherSimulator, inject_device_errors
+
+__all__ = ["WeatherScenario", "Regime", "Storm", "IceSpell",
+           "NAMED_SCENARIOS", "named", "load_scenario",
+           "SpotMarketField", "IceField",
+           "WeatherSimulator", "inject_device_errors"]

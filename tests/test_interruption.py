@@ -69,6 +69,15 @@ class TestParsers:
     def test_unknown_detail_type_is_noop(self):
         assert parse_message({"source": "x", "detail-type": "y"}).kind == MessageKind.NOOP
 
+    def test_non_dict_and_broken_bodies_are_malformed(self):
+        # never raises: the controller loop counts + drops these
+        # (tests/test_weather.py pins the full burst behavior)
+        assert parse_message("junk").kind == MessageKind.MALFORMED
+        assert parse_message(["junk"]).kind == MessageKind.MALFORMED
+        body = spot_interruption("i-1")
+        body["detail"] = {}
+        assert parse_message(body).kind == MessageKind.MALFORMED
+
 
 class TestInterruptionController:
     def test_spot_interruption_drains_and_marks_ice(self, env):

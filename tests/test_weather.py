@@ -1,0 +1,620 @@
+"""Adversarial weather suite tests (weather/; docs/reference/weather.md).
+
+Behavioral spec: ISSUE 9 / ROADMAP item 5 — a replayable spot-market +
+interruption-storm chaos system driving the degradation ladder. Pins:
+
+- scenarios serialize round-trip and the named library parses,
+- the weather timeline is a pure function of (scenario, seed, ticks):
+  same-seed replays are byte-identical, different seeds diverge,
+- the simulator's side effects land through the REAL seams: spot prices
+  via PricingProvider (price_version bumps), ICE via FakeCloud capacity
+  + UnavailableOfferings, storms via the interruption queue (all four
+  EventBridge schemas + junk), device weather via FaultInjector — and
+  stop() restores fair weather,
+- --fault-schedule and --weather compose on one injector, and a `clear`
+  mark fully restores the un-faulted solver (regression),
+- storm bursts round-trip through the interruption controller: dedup,
+  cordon→teardown ordering, no lost messages at queue bounds, malformed
+  bodies counted and dropped.
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from karpenter_provider_aws_tpu.apis import Pod
+from karpenter_provider_aws_tpu.cloud import FakeCloud
+from karpenter_provider_aws_tpu.interruption.messages import MessageKind
+from karpenter_provider_aws_tpu.interruption.queue import FakeQueue
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+from karpenter_provider_aws_tpu.operator import Operator, Options
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+from karpenter_provider_aws_tpu.weather import (
+    IceSpell, Regime, Storm, WeatherScenario, WeatherSimulator,
+    inject_device_errors, load_scenario, named, NAMED_SCENARIOS,
+)
+
+_FAMILIES = ("m5", "c5", "t3")
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    return build_lattice([s for s in build_catalog()
+                          if s.family in _FAMILIES])
+
+
+def make_env(lattice, **opt):
+    clock = FakeClock()
+    queue = FakeQueue("weather-test")
+    op = Operator(options=Options(registration_delay=0.5, **opt),
+                  lattice=lattice, cloud=FakeCloud(clock), clock=clock,
+                  interruption_queue=queue)
+    return op, clock, queue
+
+
+def attach(op, clock, queue, scenario, lattice, seed=None):
+    return WeatherSimulator(
+        scenario, lattice, seed=seed, clock=clock,
+        pricing=op.pricing_provider, cloud=op.cloud,
+        unavailable=op.unavailable, queue=queue, solver=op.solver,
+        metrics=op.metrics).start()
+
+
+class TestScenario:
+    def test_named_library_round_trips(self):
+        for name in NAMED_SCENARIOS:
+            sc = named(name)
+            assert sc.name == name
+            assert WeatherScenario.from_json(sc.to_json()) == sc
+
+    def test_load_scenario_name_file_and_error(self, tmp_path):
+        assert load_scenario("squall") == named("squall")
+        p = tmp_path / "custom.json"
+        sc = WeatherScenario(name="mine", seed=7, storms=(
+            Storm(at=1.0, duration=2.0, zones=("us-west-2a",)),))
+        p.write_text(sc.to_json())
+        assert load_scenario(str(p)) == sc
+        with pytest.raises(ValueError):
+            load_scenario("hurricane-noexist")
+
+    def test_unknown_fields_rejected(self):
+        with pytest.raises(ValueError):
+            WeatherScenario.from_dict({"name": "x", "tornado": True})
+
+
+class TestDeterminism:
+    def test_same_seed_identical_different_seed_diverges(self, lattice):
+        sc = named("storm-front")
+        a = WeatherSimulator.replay(sc, lattice, 120)
+        b = WeatherSimulator.replay(sc, lattice, 120)
+        c = WeatherSimulator.replay(sc, lattice, 120, seed=123)
+        assert a == b
+        assert a != c
+        assert len(a) > 50
+
+    def test_live_run_matches_noop_replay(self, lattice):
+        """The timeline a sim records WITH a control plane attached (live
+        instance counts, queue sends, price pushes) is identical to the
+        detached derivation — runtime state never leaks into it."""
+        op, clock, queue = make_env(lattice)
+        for i in range(4):
+            op.cluster.add_pod(Pod(name=f"p{i}",
+                                   requests={"cpu": "500m",
+                                             "memory": "1Gi"}))
+        op.settle()
+        sc = named("squall")
+        sim = attach(op, clock, queue, sc, lattice)
+        for _ in range(40):
+            op.run_once()
+            clock.step(sc.tick_seconds)
+            sim.advance()
+        assert sim.ticks == 40
+        assert WeatherSimulator.replay(sc, lattice, 40) == sim.timeline
+        sim.stop()   # restore the shared fixture's market
+
+    def test_subtick_storm_pairs_begin_burst_end(self, lattice):
+        """A storm shorter than tick_seconds still runs begin → one
+        burst → end on the tick it slips past — never an unpaired
+        storm-end in the timeline."""
+        sc = WeatherScenario(
+            name="t", tick_seconds=2.0,
+            storms=(Storm(at=1.0, duration=0.5, intensity=0.5),))
+        tl = WeatherSimulator.replay(sc, lattice, 3)
+        kinds = [e["kind"] for e in tl if e["kind"].startswith("storm")]
+        assert kinds == ["storm-begin", "storm-burst", "storm-end"]
+
+    def test_regime_matching_nothing_never_activates(self, lattice):
+        """A regime whose families/zones name nothing the lattice
+        carries must not count as a shift (the soak's regime
+        non-vacuity gate would otherwise pass on a price drill that
+        never happened)."""
+        sc = WeatherScenario(
+            name="t", regimes=(Regime(at=0.0, mu=1.0,
+                                      families=("zz99",)),))
+        sim = WeatherSimulator(sc, lattice)
+        sim.step(10)
+        assert sim.counters["regime_shifts"] == 0
+        assert not any(e["kind"] == "regime" for e in sim.timeline)
+
+    def test_advance_catches_up_missed_ticks(self, lattice):
+        sc = named("calm")
+        clock = FakeClock()
+        sim = WeatherSimulator(sc, lattice, clock=clock).start()
+        clock.step(sc.tick_seconds * 7)
+        assert sim.advance() == 7
+        assert sim.ticks == 7
+        assert sim.advance() == 0
+
+
+class TestMarketField:
+    def test_mean_reversion_keeps_multipliers_bounded(self, lattice):
+        sc = WeatherScenario(name="t", market_sigma=0.04)
+        sim = WeatherSimulator(sc, lattice)
+        sim.step(500)
+        mean, mx = sim.market.multiplier_stats()
+        # OU stationary sd = sigma/sqrt(2*theta) ≈ 0.073 in log space:
+        # a runaway walk (no reversion) would drift far past this
+        assert 0.6 < mean < 1.6
+        assert mx < 3.0
+
+    def test_regime_shift_moves_the_mean(self, lattice):
+        sc = WeatherScenario(
+            name="t", market_sigma=0.01,
+            regimes=(Regime(at=0.0, mu=0.7),))   # e^0.7 ≈ 2x
+        sim = WeatherSimulator(sc, lattice)
+        sim.step(100)
+        mean, _ = sim.market.multiplier_stats()
+        assert mean > 1.6
+        assert any(e["kind"] == "regime" for e in sim.timeline)
+
+    def test_reprice_pushes_through_pricing_provider(self):
+        import numpy as np
+        # a PRIVATE lattice: this test compares against the pristine
+        # static tensor, which the shared module fixture cannot
+        # guarantee (other tests weather it through the same in-place
+        # pricing seam production uses)
+        lattice = build_lattice([s for s in build_catalog()
+                                 if s.family in _FAMILIES])
+        op, clock, queue = make_env(lattice)
+        before = lattice.price.copy()
+        v0 = lattice.price_version
+        sc = WeatherScenario(name="t", market_sigma=0.2, seed=3)
+        sim = attach(op, clock, queue, sc, lattice)
+        sim.step(5)
+        assert lattice.price_version > v0
+        ci = lattice.capacity_types.index("spot")
+        assert not np.allclose(before[:, :, ci], lattice.price[:, :, ci],
+                               equal_nan=True)
+        # on-demand prices are not weather's to move
+        oci = lattice.capacity_types.index("on-demand")
+        assert np.allclose(before[:, :, oci], lattice.price[:, :, oci],
+                           equal_nan=True)
+        # stop() restores the base market (one more version bump)
+        v1 = lattice.price_version
+        sim.stop()
+        assert lattice.price_version > v1
+        assert np.allclose(before[:, :, ci], lattice.price[:, :, ci],
+                           equal_nan=True)
+
+
+class TestIceField:
+    def test_spell_holds_and_thaws_pools(self, lattice):
+        op, clock, queue = make_env(lattice)
+        sc = WeatherScenario(
+            name="t", tick_seconds=1.0,
+            ice=(IceSpell(at=0.0, duration=5.0, rate=2.0,
+                          hold_seconds=4.0),))
+        sim = attach(op, clock, queue, sc, lattice)
+        sim.step(4)
+        assert sim.stats()["ice_pools"] > 0
+        held = [o for o, _ in sim._held.items()]
+        for ct, it, z in held:
+            assert op.cloud.capacity_pools[(ct, it, z)] == 0
+            assert op.unavailable.is_unavailable(ct, it, z)
+        # march past every hold: spells end at 5 s, max hold 6 ticks
+        sim.step(15)
+        assert sim.stats()["ice_pools"] == 0
+        assert any(e["kind"] == "ice-thaw" for e in sim.timeline)
+        for ct, it, z in held:
+            assert (ct, it, z) not in op.cloud.capacity_pools
+            assert not op.unavailable.is_unavailable(ct, it, z)
+
+    def test_stop_thaws_everything(self, lattice):
+        op, clock, queue = make_env(lattice)
+        sc = WeatherScenario(
+            name="t", tick_seconds=1.0,
+            ice=(IceSpell(at=0.0, duration=50.0, rate=3.0,
+                          hold_seconds=100.0),),
+            storms=(Storm(at=0.0, duration=50.0, intensity=0.1),))
+        sim = attach(op, clock, queue, sc, lattice)
+        sim.step(5)
+        assert len(sim._held) > 0
+        assert sim.stats()["storms_active"] == 1
+        sim.stop()
+        assert len(sim._held) == 0
+        assert not op.cloud.capacity_pools
+        assert sum(1 for _ in op.unavailable.entries()) == 0
+        # every live surface agrees after stop(): the stats provider and
+        # the gauges both read fair weather, counters stay as evidence
+        st = sim.stats()
+        assert st["storms_active"] == 0
+        assert st["spot_mult_mean"] == 1.0 and st["spot_mult_max"] == 1.0
+        assert st["ice_marks"] > 0
+        assert op.metrics.get(
+            "karpenter_weather_spot_price_multiplier_max").value() == 1.0
+
+    def test_weather_hold_survives_capacity_handback(self, lattice):
+        """terminate_instances hands +1 capacity back to a limited pool —
+        the next tick must re-assert the hold at 0 (cloud/fake.py)."""
+        op, clock, queue = make_env(lattice)
+        sc = WeatherScenario(
+            name="t", tick_seconds=1.0,
+            ice=(IceSpell(at=0.0, duration=60.0, rate=2.0,
+                          hold_seconds=100.0),))
+        sim = attach(op, clock, queue, sc, lattice)
+        sim.step(3)
+        (ct, it, z) = next(iter(sim._held))
+        op.cloud.capacity_pools[(ct, it, z)] = 1   # the hand-back race
+        sim.step(1)
+        assert op.cloud.capacity_pools[(ct, it, z)] == 0
+
+
+class TestDeviceWeather:
+    def test_faults_merge_with_operator_injector(self, lattice):
+        """--fault-schedule and --weather share one FaultInjector: weather
+        device errors must MERGE into an operator-applied injector, never
+        clobber its g/b ceilings."""
+        from karpenter_provider_aws_tpu.solver import FaultInjector
+        op, clock, queue = make_env(lattice)
+        inj = FaultInjector(g_limit=64)
+        op.solver.inject_faults(inj)
+        inject_device_errors(op.solver, 3)
+        assert op.solver.faults is inj
+        assert op.solver.faults.g_limit == 64
+        assert op.solver.faults.device_errors == 3
+        inject_device_errors(op.solver, 2)
+        assert op.solver.faults.device_errors == 5
+
+    def test_storm_injects_and_ladder_engages(self, lattice):
+        op, clock, queue = make_env(lattice)
+        sc = WeatherScenario(
+            name="t", tick_seconds=1.0,
+            storms=(Storm(at=0.0, duration=30.0, intensity=0.0,
+                          device_error_rate=1.0, device_errors=3),))
+        sim = attach(op, clock, queue, sc, lattice)
+        sim.step(2)
+        assert op.solver.faults is not None
+        assert sim.counters["device_errors"] >= 6
+        for i in range(3):
+            op.cluster.add_pod(Pod(name=f"d{i}",
+                                   requests={"cpu": "500m",
+                                             "memory": "1Gi"}))
+        op.settle()
+        # 3 pending errors >= retry budget: the host-FFD rung engaged and
+        # every pod still scheduled (degrade latency, never availability)
+        assert sum(op.solver.degraded_counts.values()) > 0
+        assert not op.cluster.pending_pods()
+
+
+class TestSoakCompose:
+    def test_clear_fully_restores_unfaulted_solver(self, lattice):
+        """Regression for the soak's `clear` semantics: after g-limit +
+        weather device errors, a `clear` mark drops the injector entirely
+        and the next solve runs the primary path (no wave-split, no new
+        degradation)."""
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                               / "tools"))
+        from soak import apply_fault, parse_fault_schedule
+        sched = parse_fault_schedule("1:g-limit=8,2:device-error=2,9:clear")
+        assert [(s[1], s[2]) for s in sched] == [
+            ("g-limit", 8), ("device-error", 2), ("clear", None)]
+        op, clock, queue = make_env(lattice)
+        apply_fault(op.solver, "g-limit", 8)
+        inject_device_errors(op.solver, 2)       # weather composing on top
+        assert op.solver.faults.g_limit == 8
+        assert op.solver.faults.device_errors == 2
+        apply_fault(op.solver, "clear", None)
+        assert op.solver.faults is None
+        degraded_before = dict(op.solver.degraded_counts)
+        for i in range(3):
+            op.cluster.add_pod(Pod(name=f"c{i}",
+                                   requests={"cpu": "500m",
+                                             "memory": "1Gi"}))
+        op.settle()
+        assert not op.cluster.pending_pods()
+        assert op.solver.degraded_counts == degraded_before
+        assert op.solver.faults is None
+
+
+class TestStormBurst:
+    """All four EventBridge schemas round-tripped through
+    interruption/controller.py in one burst under FakeClock (ISSUE 9
+    satellite): dedup, cordon→teardown ordering, no lost messages at
+    queue bounds."""
+
+    def _settled_env(self, lattice, pods=6):
+        op, clock, queue = make_env(lattice)
+        for i in range(pods):
+            op.cluster.add_pod(Pod(name=f"b{i}",
+                                   requests={"cpu": "2", "memory": "4Gi"}))
+        op.settle()
+        assert not op.cluster.pending_pods()
+        return op, clock, queue
+
+    def test_burst_all_schemas_dedup_ordering_no_loss(self, lattice):
+        from karpenter_provider_aws_tpu.cloud.fake import parse_instance_id
+        from karpenter_provider_aws_tpu.interruption.messages import (
+            rebalance_recommendation, scheduled_change, spot_interruption,
+            state_change)
+        op, clock, queue = self._settled_env(lattice)
+        claims = {parse_instance_id(c.provider_id): c
+                  for c in op.cluster.claims.values() if c.provider_id}
+        iid = next(iter(claims))
+        # one burst: duplicates of every schema for ONE instance, plus
+        # junk, all beyond the MAX_MESSAGES=10 receive bound
+        sent = 0
+        for _ in range(4):
+            queue.send(spot_interruption(iid)); sent += 1
+            queue.send(rebalance_recommendation(iid)); sent += 1
+            queue.send(scheduled_change(iid)); sent += 1
+            queue.send(state_change(iid, "stopping")); sent += 1
+        for j in range(20):
+            queue.send(["junk", j] if j % 2 else
+                       {"source": "chaos", "detail-type": "??"})
+            sent += 1
+        assert sent > 10   # multiple receive batches required
+        deleted0 = op.metrics.get(
+            "karpenter_interruption_deleted_messages_total").value()
+        handled = 0
+        for _ in range(20):
+            handled += op.interruption.reconcile()
+            if len(queue) == 0:
+                break
+        # no lost messages at queue bounds: every send was received,
+        # handled, and deleted exactly once
+        assert handled == sent
+        assert len(queue) == 0
+        deleted = op.metrics.get(
+            "karpenter_interruption_deleted_messages_total").value()
+        assert deleted - deleted0 == sent
+        stats = op.interruption.stats()
+        assert stats["handler_errors"] == 0
+        assert stats["received_spot_interruption"] == 4
+        assert stats["received_rebalance_recommendation"] == 4
+        assert stats["received_scheduled_change"] == 4
+        assert stats["received_state_change"] == 4
+        assert stats["received_malformed"] == 10
+        assert stats["received_noop"] == 10   # well-formed unknown bodies
+        assert stats["queue_depth"] == 0
+        # dedup: 12 actionable messages for one instance → ONE deleting
+        # claim, every other claim untouched
+        target = claims[iid]
+        assert op.cluster.claims[target.name].deletion_timestamp
+        others = [c for i2, c in claims.items() if i2 != iid]
+        for c in others:
+            assert not op.cluster.claims[c.name].deletion_timestamp
+        # cordon → teardown ordering: drive termination to completion and
+        # check the event order for the drained node
+        node = op.cluster.node_for_claim(target.name)
+        assert node is not None
+        op.settle(max_rounds=60)
+        events = [(e.reason, e.object_name) for e in op.recorder.events()]
+        cordon_i = events.index(("Cordoned", node.name))
+        term_i = events.index(("Terminated", target.name))
+        assert cordon_i < term_i
+        # the interruption counter surface saw the whole burst
+        m = op.metrics.get("karpenter_interruption_messages_total")
+        assert m.value(kind="spot-interruption") == 4
+        assert m.value(kind="malformed") == 10
+        assert m.value(kind="noop") == 10
+        assert op.metrics.get(
+            "karpenter_interruption_queue_depth").value() == 0
+
+    def test_simulator_storm_targets_matching_spot_instances(self, lattice):
+        op, clock, queue = self._settled_env(lattice, pods=8)
+        spot = [i for i in op.cloud.peek_instances()
+                if i.capacity_type == "spot"]
+        assert spot, "settled env launched no spot capacity"
+        zones = sorted({i.zone for i in spot})
+        sc = WeatherScenario(
+            name="t", tick_seconds=1.0,
+            storms=(Storm(at=0.0, duration=10.0, zones=(zones[0],),
+                          intensity=1.0, junk_rate=1.0),))
+        sim = attach(op, clock, queue, sc, lattice)
+        sim.step(3)
+        assert sim.counters["messages_sent"] > 0
+        assert sim.counters["junk_sent"] == 3
+        # every targeted body names an instance in the storm zone
+        from karpenter_provider_aws_tpu.interruption.messages import \
+            parse_message
+        by_id = {i.id: i for i in op.cloud.peek_instances()}
+        for qm in queue.receive(max_messages=1000):
+            msg = parse_message(qm.body)
+            for iid in msg.instance_ids:
+                assert by_id[iid].zone == zones[0]
+                assert by_id[iid].capacity_type == "spot"
+        # the controller drains the storm without crashing
+        for _ in range(30):
+            if op.interruption.reconcile() == 0 and len(queue) == 0:
+                break
+        assert len(queue) == 0
+        assert op.interruption.stats()["handler_errors"] == 0
+
+
+class TestHandlerRetrySemantics:
+    """A handler blow-up must NOT cost the message (at-least-once: a
+    2-minute spot notice survives a transient cloud hiccup), but a
+    message that keeps failing is a poison pill — counted and dropped
+    after HANDLER_RETRY_LIMIT attempts."""
+
+    def _env_with_claim(self, lattice):
+        from karpenter_provider_aws_tpu.cloud.fake import parse_instance_id
+        op, clock, queue = make_env(lattice)
+        op.cluster.add_pod(Pod(name="h0",
+                               requests={"cpu": "500m", "memory": "1Gi"}))
+        op.settle()
+        claim = next(iter(op.cluster.claims.values()))
+        return op, queue, parse_instance_id(claim.provider_id), claim
+
+    def test_transient_failure_redelivers_then_succeeds(self, lattice):
+        from karpenter_provider_aws_tpu.interruption.messages import \
+            spot_interruption
+        op, queue, iid, claim = self._env_with_claim(lattice)
+        real = op.interruption.termination.delete_claim
+        calls = {"n": 0}
+
+        def flaky(name):
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                raise RuntimeError("transient cloud hiccup")
+            return real(name)
+
+        op.interruption.termination = type(
+            "T", (), {"delete_claim": staticmethod(flaky)})()
+        queue.send(spot_interruption(iid))
+        assert op.interruption.reconcile() == 0     # attempt 1: kept
+        assert len(queue) == 1
+        assert op.interruption.reconcile() == 0     # attempt 2: kept
+        assert len(queue) == 1
+        assert op.interruption.reconcile() == 1     # attempt 3: handled
+        assert len(queue) == 0
+        stats = op.interruption.stats()
+        assert stats["handler_errors"] == 2
+        assert stats["poison_dropped"] == 0
+        # processed-by-kind counts on DISPOSAL: three deliveries of one
+        # message count ONCE (the soak's evidence gate sums these)
+        assert stats["received_spot_interruption"] == 1
+        assert op.metrics.get(
+            "karpenter_interruption_messages_total").value(
+                kind="spot-interruption") == 1
+        # the legacy received counter keeps per-delivery semantics
+        assert op.metrics.get(
+            "karpenter_interruption_received_messages_total").value(
+                message_type="SpotInterruptionKind") == 3
+        assert op.cluster.claims[claim.name].deletion_timestamp
+
+    def test_poison_pill_dropped_after_retry_limit(self, lattice):
+        from karpenter_provider_aws_tpu.interruption.controller import \
+            InterruptionController
+        from karpenter_provider_aws_tpu.interruption.messages import \
+            spot_interruption
+        op, queue, iid, claim = self._env_with_claim(lattice)
+
+        def always_broken(name):
+            raise RuntimeError("deterministic handler bug")
+
+        op.interruption.termination = type(
+            "T", (), {"delete_claim": staticmethod(always_broken)})()
+        queue.send(spot_interruption(iid))
+        limit = InterruptionController.HANDLER_RETRY_LIMIT
+        for attempt in range(limit - 1):
+            assert op.interruption.reconcile() == 0
+            assert len(queue) == 1                  # still redelivering
+        assert op.interruption.reconcile() == 1     # final attempt: drop
+        assert len(queue) == 0
+        stats = op.interruption.stats()
+        assert stats["handler_errors"] == limit
+        assert stats["poison_dropped"] == 1
+        assert op.interruption._attempts == {}      # bounded bookkeeping
+
+
+class TestIntrospectionSurface:
+    def test_weather_provider_and_gauges(self, lattice):
+        from karpenter_provider_aws_tpu import introspect
+        op, clock, queue = make_env(lattice)
+        sc = named("squall")
+        sim = attach(op, clock, queue, sc, lattice)
+        introspect.registry().register("weather", sim.stats)
+        sim.step(25)   # into the squall
+        doc = introspect.registry().collect()
+        w = doc["weather"]
+        assert w["scenario"] == "squall"
+        assert w["ticks"] == 25
+        assert w["storms_active"] == 1
+        assert op.metrics.get("karpenter_weather_ticks").value() == 25
+        assert op.metrics.get("karpenter_weather_storm_active").value() == 1
+        assert op.metrics.get(
+            "karpenter_weather_events_total").value(kind="reprice") == 25
+        introspect.registry().unregister("weather")
+
+    def test_interruption_provider_registered(self, lattice):
+        from karpenter_provider_aws_tpu import introspect
+        op, clock, queue = make_env(lattice)
+        doc = introspect.registry().collect()
+        assert "interruption" in doc
+        assert doc["interruption"]["queue_depth"] == 0
+
+    def test_metrics_render_lints_clean(self, lattice):
+        from karpenter_provider_aws_tpu.metrics import lint_exposition
+        op, clock, queue = make_env(lattice)
+        sim = attach(op, clock, queue, named("squall"), lattice)
+        sim.step(25)
+        queue_drained = 0
+        for _ in range(10):
+            queue_drained += op.interruption.reconcile()
+        assert lint_exposition(op.metrics.render()) == []
+
+    def test_kpctl_weather_and_interrupt_rows(self, lattice):
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                               / "tools"))
+        import kpctl
+        doc = {"uptimeSeconds": 5.0, "providers": {
+            "weather": {"scenario": "squall", "ticks": 30,
+                        "storms_active": 1, "ice_pools": 2,
+                        "spot_mult_mean": 1.12, "spot_mult_max": 1.8,
+                        "messages_sent": 40, "junk_sent": 5},
+            "interruption": {"queue_depth": 3,
+                             "received_spot_interruption": 7,
+                             "received_malformed": 2,
+                             "handler_errors": 1},
+        }}
+        frame = "\n".join(kpctl._render_top(doc, "test"))
+        assert "WEATHER   squall tick 30" in frame
+        assert "spot x1.12 (max x1.80)" in frame
+        assert "INTERRUPT queue 3" in frame
+        assert "spot-interruption 7" in frame
+        assert "handler-errors 1" in frame
+
+    def test_rows_absent_without_providers(self, lattice):
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                               / "tools"))
+        import kpctl
+        frame = "\n".join(kpctl._render_top(
+            {"uptimeSeconds": 1.0, "providers": {}}, "test"))
+        assert "WEATHER" not in frame
+        assert "INTERRUPT" not in frame
+
+
+class TestParserRobustness:
+    """parse_message must NEVER raise (the controller loop depends on
+    it): non-dict bodies and parser blow-ups classify MALFORMED, unknown
+    (source, detail-type) stays NOOP."""
+
+    def test_non_dict_bodies(self):
+        from karpenter_provider_aws_tpu.interruption.messages import \
+            parse_message
+        for body in (None, 42, "junk", ["a"], ("b",)):
+            assert parse_message(body).kind == MessageKind.MALFORMED
+
+    def test_registered_parser_blowup_is_malformed(self):
+        from karpenter_provider_aws_tpu.interruption.messages import \
+            parse_message
+        bodies = [
+            {"source": "aws.ec2", "detail-type":
+             "EC2 Spot Instance Interruption Warning", "detail": {}},
+            {"source": "aws.ec2", "detail-type":
+             "EC2 Spot Instance Interruption Warning", "detail": None},
+            {"source": "aws.health", "detail-type": "AWS Health Event",
+             "detail": {"service": "EC2", "affectedEntities": 17}},
+        ]
+        for b in bodies:
+            assert parse_message(b).kind == MessageKind.MALFORMED, b
+
+    def test_unknown_is_noop_not_malformed(self):
+        from karpenter_provider_aws_tpu.interruption.messages import \
+            parse_message
+        m = parse_message({"source": "x", "detail-type": "y"})
+        assert m.kind == MessageKind.NOOP

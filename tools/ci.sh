@@ -34,8 +34,15 @@
 #               (counters > 0), zero fan-out envelope copies, the
 #               watch-fed mirror converging to the store, and the live
 #               /metrics scrape (karpenter_api_* series) linting clean
-#   6. tier-1 — the full non-slow test suite on the CPU backend
-#   7. bench  — `bench.py --smoke`: one fast config through the real
+#   6. weather— adversarial-weather gate (tools/smoke_weather.py): the
+#               60 s `squall` scenario on FakeClock — the degradation
+#               ladder must engage (degraded_total > 0), the SLO burn
+#               must recover below 1.0 after the storm, invariants hold
+#               (no pending pods / leaks / stranded messages, junk
+#               bodies counted as malformed), and two runs with the
+#               same seed must record identical weather timelines
+#   7. tier-1 — the full non-slow test suite on the CPU backend
+#   8. bench  — `bench.py --smoke`: one fast config through the real
 #               harness, so a broken solve path can never ride in on a
 #               green unit-test run
 
@@ -47,7 +54,7 @@ PY=${PYTHON:-python}
 FAST=0
 [ "${1:-}" = "--fast" ] && FAST=1
 
-echo "=== ci [1/7] generated-artifact drift ==="
+echo "=== ci [1/8] generated-artifact drift ==="
 $PY tools/gen_crds.py --check
 tmp=$(mktemp -d)
 trap 'rm -rf "$tmp"' EXIT
@@ -62,26 +69,29 @@ done
 [ "$stale" = 0 ] || exit 1
 echo "drift: clean"
 
-echo "=== ci [2/7] introspection smoke + metrics lint ==="
+echo "=== ci [2/8] introspection smoke + metrics lint ==="
 $PY tools/smoke_introspect.py
 
-echo "=== ci [3/7] steady-state delta churn smoke ==="
+echo "=== ci [3/8] steady-state delta churn smoke ==="
 $PY tools/smoke_delta.py
 
-echo "=== ci [4/7] continuous-profiling smoke ==="
+echo "=== ci [4/8] continuous-profiling smoke ==="
 $PY tools/smoke_profile.py
 
-echo "=== ci [5/7] write-path smoke ==="
+echo "=== ci [5/8] write-path smoke ==="
 $PY tools/smoke_writepath.py
 
-echo "=== ci [6/7] tier-1 tests ==="
+echo "=== ci [6/8] adversarial-weather smoke ==="
+$PY tools/smoke_weather.py
+
+echo "=== ci [7/8] tier-1 tests ==="
 $PY -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider
 
 if [ "$FAST" = 1 ]; then
-    echo "=== ci [7/7] bench smoke: SKIPPED (--fast) ==="
+    echo "=== ci [8/8] bench smoke: SKIPPED (--fast) ==="
 else
-    echo "=== ci [7/7] bench smoke ==="
+    echo "=== ci [8/8] bench smoke ==="
     $PY bench.py --smoke
 fi
 

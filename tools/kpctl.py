@@ -574,6 +574,27 @@ def _render_top(doc, server: str):
     lines.append(
         f"EVENTS    {g('events', 'published'):g} published "
         f"({g('events', 'warnings'):g} warnings)")
+    if "weather" in p:
+        w = p["weather"]
+        lines.append(
+            f"WEATHER   {w.get('scenario', '?')} tick {w.get('ticks', 0):g}  "
+            f" storms {w.get('storms_active', 0):g} active   "
+            f"ICE {w.get('ice_pools', 0):g} pools   "
+            f"spot x{w.get('spot_mult_mean', 1.0):.2f} "
+            f"(max x{w.get('spot_mult_max', 1.0):.2f})   "
+            f"msgs {w.get('messages_sent', 0):g} "
+            f"({w.get('junk_sent', 0):g} junk)")
+    if "interruption" in p:
+        intr = p["interruption"]
+        kinds = "   ".join(
+            f"{k[len('received_'):].replace('_', '-')} {v:g}"
+            for k, v in sorted(intr.items())
+            if k.startswith("received_") and isinstance(v, (int, float)))
+        lines.append(
+            f"INTERRUPT queue {intr.get('queue_depth', 0):g}   "
+            + (kinds or "(no messages)")
+            + (f"   handler-errors {intr.get('handler_errors', 0):g}"
+               if intr.get("handler_errors") else ""))
     # top-3 contended locks by wait p99 (the contention provider's
     # flattened `<lock>_wait_p99_ms` keys; introspect/contention.py)
     cont = p.get("contention", {})

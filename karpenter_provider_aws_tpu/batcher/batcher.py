@@ -23,6 +23,7 @@ from dataclasses import dataclass
 from typing import Callable, Dict, Generic, Hashable, List, Sequence, Tuple, TypeVar
 
 from .. import trace
+from ..utils.clock import Clock, WALL
 
 T = TypeVar("T")  # request
 U = TypeVar("U")  # response
@@ -46,9 +47,14 @@ class _Bucket(Generic[T, U]):
     is measured from when the oldest caller started waiting."""
 
     def __init__(self, opts: BatcherOptions,
-                 batch_fn: Callable[[List[T]], Sequence[U]]):
+                 batch_fn: Callable[[List[T]], Sequence[U]],
+                 clock: Clock = None):
         self.opts = opts
         self.batch_fn = batch_fn
+        # the max-window clock reads the INJECTED clock (FakeClock in the
+        # deterministic stratum; the shared wall instance otherwise) —
+        # the idle-window park below stays a real Event wait either way
+        self._clock = clock if clock is not None else WALL
         # (request, future, producer traceparent-or-None): the producer's
         # trace context rides the queue so the drain — which runs on the
         # bucket's own worker thread, outside any caller's contextvars —
@@ -68,12 +74,11 @@ class _Bucket(Generic[T, U]):
         self.max_batch = 0      # largest single drain
 
     def add(self, request: T, fut: Future) -> None:
-        import time
         ctx = trace.capture()
         with self.lock:
             if not self.pending:
                 # first arrival of this batch arms the max-window clock
-                self.started_at = time.monotonic()
+                self.started_at = self._clock.monotonic()
             self.pending.append((request, fut, ctx))
             start = self.thread is None
             if start:
@@ -83,7 +88,6 @@ class _Bucket(Generic[T, U]):
             self.thread.start()
 
     def run(self):
-        import time
         while True:
             # drained: park with no timeout until the next arrival
             self.wakeup.wait()
@@ -93,7 +97,7 @@ class _Bucket(Generic[T, U]):
                     if not self.pending:
                         break   # back to the park
                     time_left = self.opts.max_seconds - (
-                        time.monotonic() - self.started_at)
+                        self._clock.monotonic() - self.started_at)
                     full = len(self.pending) >= self.opts.max_items
                 if not full and time_left > 0:
                     fired = self.wakeup.wait(
@@ -157,10 +161,12 @@ class Batcher(Generic[T, U]):
 
     def __init__(self, batch_fn: Callable[[List[T]], Sequence[U]],
                  options: BatcherOptions = None,
-                 hasher: Callable[[T], Hashable] = None):
+                 hasher: Callable[[T], Hashable] = None,
+                 clock: Clock = None):
         self.batch_fn = batch_fn
         self.opts = options or BatcherOptions()
         self.hasher = hasher or (lambda _req: 0)
+        self._clock = clock
         self._buckets: Dict[Hashable, _Bucket] = {}
         self._lock = threading.Lock()
 
@@ -171,7 +177,7 @@ class Batcher(Generic[T, U]):
         with self._lock:
             bucket = self._buckets.get(key)
             if bucket is None:
-                bucket = _Bucket(self.opts, self.batch_fn)
+                bucket = _Bucket(self.opts, self.batch_fn, self._clock)
                 self._buckets[key] = bucket
         bucket.add(request, fut)
         return fut.result(timeout=timeout)

@@ -118,9 +118,13 @@ class CloudProvider:
         self.launch_templates = launch_templates
         self.version = version
         self._launch_batcher: Batcher = Batcher(
-            self._launch_batch, batch_options or BatcherOptions(idle_seconds=0.005))
+            self._launch_batch,
+            batch_options or BatcherOptions(idle_seconds=0.005),
+            clock=self.clock)
         self._terminate_batcher: Batcher = Batcher(
-            self._terminate_batch, batch_options or BatcherOptions(idle_seconds=0.005))
+            self._terminate_batch,
+            batch_options or BatcherOptions(idle_seconds=0.005),
+            clock=self.clock)
         self._lock = threading.Lock()
 
     # ---- Create ----------------------------------------------------------

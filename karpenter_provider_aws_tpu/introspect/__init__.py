@@ -180,6 +180,9 @@ def _pprof_doc(p: str, query: Dict[str, List[str]]):
                                             the flamegraph.pl/speedscope
                                             input), ?format=json|chrome
         /debug/pprof/contention             lock/queue accounting (JSON)
+        /debug/pprof/lockorder              lock acquisition-order graph +
+                                            deadlock cycles w/ witness
+                                            stacks (JSON)
         /debug/pprof/device                 device cost model (JSON)
         /debug/pprof/captures               burn-triggered snapshots (JSON)
     """
@@ -207,6 +210,8 @@ def _pprof_doc(p: str, query: Dict[str, List[str]]):
         return prof.folded().encode(), "text/plain; charset=utf-8"
     if p == "/debug/pprof/contention":
         return _json(contention.detail())
+    if p == "/debug/pprof/lockorder":
+        return _json(contention.lockorder_detail())
     if p == "/debug/pprof/device":
         from ..solver import costmodel
         return _json(costmodel.model().summary())

@@ -34,6 +34,21 @@ is attached.
 ``set_enabled(False)`` turns every wrapper into a raw pass-through
 (no counters, no clock reads) — the zero-overhead-when-disabled
 contract tests/test_profiler.py pins.
+
+**Lock-order witness** (docs/reference/linting.md): every FIRST
+acquire also records, per thread, the set of instrumented locks
+already held, feeding a process-wide acquisition-order graph — the
+edge ``A -> B`` means "some thread held A while acquiring B", with the
+acquiring thread's stack captured the first time the edge appears.
+Any cycle in that graph is a POTENTIAL DEADLOCK (two threads can
+interleave the two orders and wait on each other forever), reported
+with every member edge's witness stack via ``lockorder_stats()`` (the
+``lockorder`` introspection provider), ``lockorder_detail()``
+(``/debug/pprof/lockorder``), and asserted empty as a standing
+invariant by the threaded tier-1 tests, ``tools/soak.py``, and the
+weather smoke. Edges are keyed by lock NAME (the same aggregation the
+wait stats use): two locks sharing a name cannot witness an ordering
+between themselves.
 """
 
 from __future__ import annotations
@@ -41,6 +56,7 @@ from __future__ import annotations
 import sys
 import threading
 import time
+import traceback
 from typing import Dict, List, Optional, Tuple
 
 # wait/hold bucket upper bounds, SECONDS (percentile estimates mirror
@@ -58,6 +74,14 @@ _enabled = True
 _reg_lock = threading.Lock()
 _registry: Dict[str, "LockStats"] = {}
 _metric_hist = None            # karpenter_lock_wait_seconds, when attached
+
+# ---- lock-order witness state ----
+_WITNESS_STACK_LIMIT = 18      # frames kept per edge witness
+_tls = threading.local()       # .held: this thread's held lock names,
+                               # in acquisition order
+_order_lock = threading.Lock()
+# (held_name, acquired_name) -> {"count": int, "stack": [str, ...]}
+_order_edges: Dict[Tuple[str, str], Dict] = {}
 
 
 def set_enabled(flag: bool) -> None:
@@ -83,6 +107,7 @@ def reset() -> None:
     """Drop all accumulated stats (test isolation)."""
     with _reg_lock:
         _registry.clear()
+    lockorder_reset()
 
 
 def _stats_for(name: str) -> "LockStats":
@@ -213,6 +238,141 @@ class LockStats:
         }
 
 
+# ---- lock-order witness ----------------------------------------------------
+
+
+def _held_list() -> List[str]:
+    lst = getattr(_tls, "held", None)
+    if lst is None:
+        lst = _tls.held = []
+    return lst
+
+
+def _witness_stack() -> List[str]:
+    """The acquiring thread's stack as ``file.py:line:func`` frames —
+    captured ONCE per distinct edge, never on the steady path."""
+    frames = traceback.extract_stack(limit=_WITNESS_STACK_LIMIT + 3)
+    out = []
+    for fr in frames:
+        fname = fr.filename.rsplit("/", 1)[-1]
+        if fname == "contention.py":
+            continue   # the witness's own frames add no evidence
+        out.append(f"{fname}:{fr.lineno}:{fr.name}")
+    return out[-_WITNESS_STACK_LIMIT:]
+
+
+def _note_first_acquire(name: str) -> None:
+    """Record ordering edges held->name for every lock this thread
+    already holds, then push name onto the thread's held list. Fast
+    path per acquire: one thread-local read + a loop over the (almost
+    always 0-2 entry) held list + dict membership checks; the stack
+    capture and graph lock are paid only the first time an edge is
+    seen process-wide."""
+    held = _held_list()
+    for h in held:
+        if h == name:
+            continue   # same-name pair (e.g. two per-kind store locks)
+        pair = (h, name)
+        e = _order_edges.get(pair)
+        if e is not None:
+            e["count"] += 1    # GIL-atomic enough for diagnostics
+            continue
+        stack = _witness_stack()
+        with _order_lock:
+            e = _order_edges.get(pair)
+            if e is None:
+                _order_edges[pair] = {"count": 1, "stack": stack}
+            else:
+                e["count"] += 1
+    held.append(name)
+
+
+def _note_last_release(name: str) -> None:
+    held = getattr(_tls, "held", None)
+    if held:
+        # LIFO in the common case; tolerate out-of-order releases (and
+        # entries stranded by an enable-toggle mid-hold) by scanning
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == name:
+                del held[i]
+                return
+
+
+def lockorder_reset() -> None:
+    """Drop the acquisition-order graph (test isolation — the
+    deliberate lock-inversion test must not poison later no-cycle
+    assertions)."""
+    with _order_lock:
+        _order_edges.clear()
+
+
+def lockorder_cycles() -> List[List[str]]:
+    """Elementary cycles in the acquisition-order graph, each as the
+    list of lock names in order (first repeated implicitly). Empty =
+    no potential deadlock witnessed. Each cycle is enumerated once,
+    anchored at its lexicographically-smallest member."""
+    with _order_lock:
+        edges = list(_order_edges.keys())
+    adj: Dict[str, List[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+    for vs in adj.values():
+        vs.sort()
+    cycles: List[List[str]] = []
+    for start in sorted(adj):
+        # DFS restricted to nodes >= start: every elementary cycle is
+        # found exactly once, rooted at its smallest node
+        path = [start]
+        on_path = {start}
+
+        def dfs(node: str) -> None:
+            for nxt in adj.get(node, ()):
+                if nxt == start:
+                    cycles.append(list(path))
+                elif nxt > start and nxt not in on_path:
+                    path.append(nxt)
+                    on_path.add(nxt)
+                    dfs(nxt)
+                    on_path.discard(nxt)
+                    path.pop()
+
+        dfs(start)
+    return cycles
+
+
+def lockorder_stats() -> Dict[str, float]:
+    """The ``lockorder`` introspection provider: flat numeric keys for
+    the sampler rings and the kpctl top LOCKORDER cell."""
+    with _order_lock:
+        edges = len(_order_edges)
+        acquisitions = sum(e["count"] for e in _order_edges.values())
+    return {"edges": float(edges),
+            "cycles": float(len(lockorder_cycles())),
+            "ordered_acquires": float(acquisitions),
+            "enabled": 1.0 if _enabled else 0.0}
+
+
+def lockorder_detail() -> Dict:
+    """The /debug/pprof/lockorder document: the full acquisition-order
+    graph with per-edge counts and first-witness stacks, plus every
+    cycle with ALL of its member edges' witness stacks — the two (or
+    more) code paths that can deadlock each other, named."""
+    with _order_lock:
+        edges = {f"{a} -> {b}": {"count": e["count"], "stack": e["stack"]}
+                 for (a, b), e in sorted(_order_edges.items())}
+        raw = dict(_order_edges)
+    cycles = []
+    for cyc in lockorder_cycles():
+        members = []
+        for i, a in enumerate(cyc):
+            b = cyc[(i + 1) % len(cyc)]
+            e = raw.get((a, b), {"count": 0, "stack": []})
+            members.append({"edge": f"{a} -> {b}", "count": e["count"],
+                            "stack": e["stack"]})
+        cycles.append({"locks": cyc, "edges": members})
+    return {"enabled": _enabled, "edges": edges, "cycles": cycles}
+
+
 def _owner_frame_tag(tid: Optional[int]) -> Optional[str]:
     """The owner thread's top frame, ``file.py:func`` — resolved ONLY on
     contention (sys._current_frames walks every thread)."""
@@ -274,6 +434,10 @@ class InstrumentedLock:
             self._owner = me
             self._depth = 1
             self._t_acq = time.perf_counter()
+            # lock-order witness: a FIRST acquire while other locks are
+            # held records an ordering edge (re-entrant re-acquires are
+            # not an ordering event)
+            _note_first_acquire(st.name)
         st.acquisitions += 1
         return True
 
@@ -286,6 +450,7 @@ class InstrumentedLock:
             self._stats.note_hold(time.perf_counter() - self._t_acq)
             self._owner = None
             self._depth = 0
+            _note_last_release(self._stats.name)
         elif self._depth > 0:
             self._depth -= 1
         self._raw.release()

@@ -43,6 +43,7 @@ import time
 from collections import deque
 from typing import Deque, Dict, List, Optional, Tuple
 
+from ..utils.clock import WALL
 from . import contention
 
 DEFAULT_HZ = 50.0
@@ -96,7 +97,8 @@ class SamplingProfiler:
         self._thread: Optional[threading.Thread] = None
 
     def _now(self) -> float:
-        return self._clock.now() if self._clock is not None else time.time()
+        return (self._clock.now() if self._clock is not None
+                else WALL.now())
 
     # ---- sampling ---------------------------------------------------------
 

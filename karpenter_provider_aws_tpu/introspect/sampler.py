@@ -14,10 +14,10 @@ deques, and a sampling failure is recorded, never raised.
 from __future__ import annotations
 
 import threading
-import time
 from collections import deque
 from typing import Deque, Dict, List, Optional, Tuple
 
+from ..utils.clock import WALL
 from .registry import IntrospectRegistry
 
 DEFAULT_RING = 600   # 10 min of 1 Hz samples per provider
@@ -39,7 +39,8 @@ class Sampler:
         self.started_at = self._now()
 
     def _now(self) -> float:
-        return self._clock.now() if self._clock is not None else time.time()
+        return (self._clock.now() if self._clock is not None
+                else WALL.now())
 
     # ---- sampling ---------------------------------------------------------
 

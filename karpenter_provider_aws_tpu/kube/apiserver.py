@@ -81,11 +81,12 @@ import copy
 import gc as _gc
 import itertools
 import threading
-import time as _time
 from collections import deque
 from dataclasses import dataclass
 from typing import (Callable, Dict, List, Optional, Sequence, Set, Tuple,
                     Union)
+
+from ..utils.clock import WALL
 
 # kinds are plural lowercase, like REST resource paths
 KINDS = ("pods", "nodes", "nodeclaims", "nodepools", "nodeclasses",
@@ -732,7 +733,8 @@ class FakeAPIServer:
         lives on. Clients rendering ages must anchor to THIS, not their
         own wall clock: under a FakeClock (or plain clock skew) the two
         can differ arbitrarily."""
-        return self._clock.now() if self._clock is not None else _time.time()
+        return (self._clock.now() if self._clock is not None
+                else WALL.now())
 
     def list(self, kind: str) -> Tuple[List[dict], int]:
         """Returns (items, listResourceVersion) — watch from the returned
@@ -921,7 +923,7 @@ class FakeAPIServer:
                 # consumer truth-tests deletion_timestamp
                 if now is None:
                     now = (self._clock.now() if self._clock is not None
-                           else _time.time())
+                           else WALL.now())
                 new["metadata"]["deletionTimestamp"] = now or 1e-9
                 new["metadata"]["resourceVersion"] = self._next_rv(kind)
                 new = freeze(new)

@@ -200,7 +200,8 @@ class Operator:
                     "karpenter_cluster_state_synced"),
                 config_guard=self._validate_pool_config,
                 recorder=self.recorder,
-                pods_state_gauge=self.metrics.get("karpenter_pods_state"))
+                pods_state_gauge=self.metrics.get("karpenter_pods_state"),
+                clock=self.clock)
             self.sync.sync_once()   # initial list: config + state hydrated
         else:
             from ..kube.writer import DirectWriter
@@ -237,7 +238,7 @@ class Operator:
             self.solver = RemoteSolver(self.lattice,
                                        self.options.solver_address)
         else:
-            self.solver = Solver(self.lattice)
+            self.solver = Solver(self.lattice, clock=self.clock)
         self.provisioner = Provisioner(
             self.cluster, self.solver, self.node_pools, self.cloud_provider,
             self.unavailable, self.recorder, self.clock,
@@ -348,6 +349,10 @@ class Operator:
         contention.attach_metrics(
             self.metrics.get("karpenter_lock_wait_seconds"))
         reg.register("contention", contention.stats)
+        # the lock-order witness (docs/reference/linting.md): the
+        # acquisition-order graph's edge/cycle counts — cycles must stay
+        # 0 (a standing invariant soak + the weather smoke assert)
+        reg.register("lockorder", contention.lockorder_stats)
         reg.register("profiler", introspect.profiler_stats)
         reg.register("device", costmodel.model().stats)
         # burn-triggered capture: the SLO tracker's exactly-once-per-

@@ -26,6 +26,7 @@ from ..kube.apiserver import FakeAPIServer
 from ..kube.client import KubeClient
 from ..kube.informer import InformerSet
 from ..state.cluster import ClusterState
+from ..utils.clock import Clock, WALL
 
 
 class StateSync:
@@ -33,7 +34,7 @@ class StateSync:
                  node_pools: Dict[str, NodePool],
                  node_classes: Dict[str, object],
                  synced_gauge=None, config_guard=None, recorder=None,
-                 pods_state_gauge=None):
+                 pods_state_gauge=None, clock: Clock = None):
         """``config_guard(pool, node_classes) -> Optional[str]`` runs the
         operator's CROSS-object config validations (os-vs-amiFamily,
         storage-config-vs-lattice) on watch-delivered NodePools — per-
@@ -48,7 +49,8 @@ class StateSync:
         self._config_guard = config_guard
         self._recorder = recorder
         self._pods_state_gauge = pods_state_gauge
-        self._pods_state_last = float("-inf")   # wall-clock throttle
+        self._clock = clock if clock is not None else WALL
+        self._pods_state_last = float("-inf")   # clock-driven throttle
         self.informers = InformerSet(server)
         # referents before dependents: config kinds, then volumes/budgets,
         # then claims/nodes, then PODS LAST — apply_pod_spec replays
@@ -74,10 +76,11 @@ class StateSync:
             self._synced_gauge.set(1.0)
         if n and self._pods_state_gauge is not None:
             # pod phases just moved through the watch stream: re-render
-            # karpenter_pods_state. Throttled on WALL time (the pump runs
-            # at 20 Hz in the async runtime; the phase scan is O(pods))
-            import time as _time
-            now = _time.monotonic()
+            # karpenter_pods_state. Throttled on the INJECTED clock (the
+            # pump runs at 20 Hz in the async runtime; the phase scan is
+            # O(pods)) — under FakeClock the refresh cadence is
+            # deterministic instead of leaking wall time
+            now = self._clock.monotonic()
             if now - self._pods_state_last >= 0.5:
                 self._pods_state_last = now
                 self._pods_state_gauge.replace(

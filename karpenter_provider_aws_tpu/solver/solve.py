@@ -307,8 +307,13 @@ class Solver:
     # operator's --solver-address delegation
     supports_delta = True
 
-    def __init__(self, lattice: Lattice, pipeline: bool = True):
+    def __init__(self, lattice: Lattice, pipeline: bool = True, clock=None):
         self.lattice = lattice
+        # the device-retry backoff sleeps on the INJECTED clock: under
+        # FakeClock a weather-driven retry steps simulated time instead
+        # of stalling the deterministic stratum on a real sleep
+        from ..utils.clock import WALL
+        self._clock = clock if clock is not None else WALL
         # probe-gated Pallas finalization: on a TPU backend the streaming
         # cheapest-offering kernel replaces the [B,T,Z,C] XLA intermediate
         # (ops/offering_argmin.py); anywhere it cannot lower, the probe
@@ -1075,7 +1080,7 @@ class Solver:
                 if is_retryable_solver_error(e) and retries < self._DEVICE_RETRIES:
                     retries += 1
                     self._count_degraded("device_retry")
-                    time.sleep(self._RETRY_BACKOFF_SECONDS * retries)
+                    self._clock.sleep(self._RETRY_BACKOFF_SECONDS * retries)
                     continue
                 reason = ("device-error" if isinstance(e, SolverDeviceError)
                           else "internal-error")

@@ -23,6 +23,14 @@ class Clock:
         time.sleep(seconds)
 
 
+# The shared wall-clock instance for fallback paths: subsystems that
+# accept an injected clock but default to wall time (sampler, profiler,
+# apiserver) fall back to THIS rather than calling time.time() raw, so
+# the clock-discipline lint (tools/lint, docs/reference/linting.md) can
+# verify every time read in the package flows through a Clock.
+WALL = Clock()
+
+
 class FakeClock(Clock):
     """Deterministic clock for tests: time moves only via step()."""
 

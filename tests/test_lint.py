@@ -1,0 +1,644 @@
+"""graftlint + lock-order witness tests (docs/reference/linting.md).
+
+Fixture-driven cases per rule (violating and clean snippets compiled
+from strings), the baseline add/remove round-trip, the standing "repo
+lints clean against the committed baseline" tier-1 gate, the pinned
+"re-introducing any rule violation in a scratch file exits non-zero",
+and the deliberate lock-inversion thread test pinning that the runtime
+witness reports exactly one cycle with both witness stacks.
+"""
+
+import ast
+import json
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+from lint import baseline as baseline_mod                    # noqa: E402
+from lint.rules import (ClockRule, DeterminismRule,          # noqa: E402
+                        FrozenEnvelopeRule, LockRule, MetricsRule,
+                        PACKAGE, Violation, default_rules)
+from lint.run import run_checks                              # noqa: E402
+import lint.run as lint_run                                  # noqa: E402
+
+from karpenter_provider_aws_tpu.introspect import contention  # noqa: E402
+
+
+def check(rule, source, relpath=f"{PACKAGE}/scratch.py"):
+    return rule.check_module(ast.parse(source), relpath, source)
+
+
+# ---- rule 1: clock discipline ---------------------------------------------
+
+class TestClockRule:
+    def test_raw_calls_flagged_including_aliases(self):
+        src = (
+            "import time\n"
+            "import time as _t\n"
+            "from time import sleep\n"
+            "from datetime import datetime\n"
+            "def f():\n"
+            "    a = time.time()\n"
+            "    b = _t.monotonic()\n"
+            "    sleep(1)\n"
+            "    d = datetime.now()\n")
+        vs = check(ClockRule(), src)
+        assert {v.call for v in vs} == {
+            "time.time", "time.monotonic", "time.sleep",
+            "datetime.datetime.now"}
+        assert all(v.rule == "clock-discipline" for v in vs)
+        assert all(v.context == "f" for v in vs)
+
+    def test_clock_routed_and_perf_counter_clean(self):
+        src = (
+            "import time\n"
+            "def f(clock):\n"
+            "    t0 = time.perf_counter()   # interval self-measurement\n"
+            "    now = clock.now()\n"
+            "    clock.sleep(0.1)\n"
+            "    return clock.monotonic() - t0\n")
+        assert check(ClockRule(), src) == []
+
+    def test_utils_clock_is_exempt(self):
+        src = "import time\ndef now():\n    return time.time()\n"
+        rule = ClockRule()
+        assert not rule.applies_to(f"{PACKAGE}/utils/clock.py")
+        assert rule.applies_to(f"{PACKAGE}/cli.py")
+        assert not rule.applies_to("tools/soak.py")
+
+
+# ---- rule 2: lock discipline ----------------------------------------------
+
+class TestLockRule:
+    def test_blocking_calls_under_lock_flagged(self):
+        src = (
+            "import time\n"
+            "def f(self, fut):\n"
+            "    with self._lock:\n"
+            "        time.sleep(1)\n"
+            "        fut.result()\n"
+            "        x.block_until_ready()\n")
+        vs = check(LockRule(), src)
+        assert {v.call for v in vs} == {
+            "time.sleep", "fut.result", "x.block_until_ready"}
+
+    def test_clock_sleep_under_lock_flagged(self):
+        src = ("def f(self):\n"
+               "    with self._solve_lock:\n"
+               "        self._clock.sleep(0.05)\n")
+        vs = check(LockRule(), src)
+        assert len(vs) == 1 and vs[0].call == "self._clock.sleep"
+
+    def test_subscripted_store_lock_counts(self):
+        src = ("import time\n"
+               "def f(self, kind):\n"
+               "    with self._locks[kind]:\n"
+               "        time.sleep(0.1)\n")
+        assert len(check(LockRule(), src)) == 1
+
+    def test_outside_lock_and_nested_def_clean(self):
+        src = (
+            "import time\n"
+            "def f(self, fut):\n"
+            "    with self._lock:\n"
+            "        def later():\n"
+            "            time.sleep(1)   # runs outside the hold\n"
+            "        cb = lambda: fut.result()\n"
+            "    time.sleep(1)\n"
+            "    return fut.result()\n")
+        assert check(LockRule(), src) == []
+
+    def test_string_join_and_condition_wait_clean(self):
+        src = (
+            "def f(self, items):\n"
+            "    with self._cond:\n"
+            "        s = ','.join(items)\n"
+            "        self._cond.wait(timeout=0.1)\n")
+        assert check(LockRule(), src) == []
+
+    def test_stats_taking_solve_lock_flagged(self):
+        src = ("class Solver:\n"
+               "    def stats(self):\n"
+               "        with self._solve_lock:\n"
+               "            return {}\n")
+        vs = check(LockRule(), src)
+        assert len(vs) == 1
+        assert vs[0].call == "stats:_solve_lock"
+        assert "solve lock" in vs[0].message
+
+    def test_stats_without_solve_lock_clean(self):
+        src = ("class Solver:\n"
+               "    def stats(self):\n"
+               "        with self._stats_lock:\n"
+               "            return {}\n"
+               "    def solve(self):\n"
+               "        with self._solve_lock:\n"
+               "            return 1\n")
+        assert check(LockRule(), src) == []
+
+
+# ---- rule 3: determinism --------------------------------------------------
+
+class TestDeterminismRule:
+    def scoped(self):
+        return DeterminismRule()
+
+    def test_global_rng_and_unseeded_random_flagged(self):
+        src = (
+            "import random\n"
+            "import numpy as np\n"
+            "def f():\n"
+            "    a = random.random()\n"
+            "    b = random.Random()\n"
+            "    c = np.random.rand(3)\n")
+        vs = check(self.scoped(), src, f"{PACKAGE}/weather/scratch.py")
+        assert {v.call for v in vs} == {
+            "random.random", "random.Random", "numpy.random.rand"}
+
+    def test_seeded_random_and_datetime_scope(self):
+        src = (
+            "import random\n"
+            "from datetime import datetime\n"
+            "def f(seed, t):\n"
+            "    rng = random.Random(f'{seed}:{t}')\n"
+            "    when = datetime.now()\n")
+        vs = check(self.scoped(), src, f"{PACKAGE}/solver/scratch.py")
+        assert [v.call for v in vs] == ["datetime.datetime.now"]
+
+    def test_scoping_is_weather_and_solver_only(self):
+        rule = self.scoped()
+        assert rule.applies_to(f"{PACKAGE}/weather/simulator.py")
+        assert rule.applies_to(f"{PACKAGE}/solver/solve.py")
+        assert not rule.applies_to(f"{PACKAGE}/cli.py")
+
+
+# ---- rule 4: frozen-envelope discipline -----------------------------------
+
+class TestFrozenEnvelopeRule:
+    def scoped(self):
+        return FrozenEnvelopeRule(scopes=(f"{PACKAGE}/scratch.py",))
+
+    def test_mutators_on_envelope_flagged(self):
+        src = (
+            "def _on_pod(self, type_, name, obj, old):\n"
+            "    obj['metadata']['finalizers'].append('x')\n"
+            "    obj['spec']['nodeName'] = 'n1'\n"
+            "    meta = obj['metadata']\n"
+            "    meta.update({'a': 1})\n"
+            "    del old['spec']['x']\n")
+        vs = check(self.scoped(), src)
+        assert {v.call for v in vs} == {
+            "obj.append", "obj[...]=", "meta.update", "del old[...]"}
+        assert all(v.rule == "frozen-envelope" for v in vs)
+
+    def test_deepcopy_thaw_clean(self):
+        src = (
+            "import copy\n"
+            "def _on_pod(self, type_, name, obj, old):\n"
+            "    mine = copy.deepcopy(obj)\n"
+            "    mine['spec']['nodeName'] = 'n1'\n"
+            "    mine['metadata']['finalizers'].append('x')\n")
+        assert check(self.scoped(), src) == []
+
+    def test_rebind_after_nested_mutation_still_flagged(self):
+        """Taint transfer runs in SOURCE order: a later rebind of a
+        derived name must not retroactively launder a mutation nested
+        earlier in a branch (the ast.walk breadth-first bug)."""
+        src = (
+            "def _on_pod(self, type_, name, obj, old):\n"
+            "    spec = obj['spec']\n"
+            "    if name:\n"
+            "        spec['nodeName'] = 'x'\n"
+            "    spec = {}\n")
+        vs = check(self.scoped(), src)
+        assert [v.call for v in vs] == ["spec[...]="]
+
+    def test_mutation_before_taint_is_clean(self):
+        """The mirror image: mutating a private name BEFORE it is later
+        re-bound to envelope state must not flag."""
+        src = (
+            "def _on_pod(self, type_, name, obj, old):\n"
+            "    acc = {}\n"
+            "    if name:\n"
+            "        acc['n'] = 1\n"
+            "    acc = obj['spec']\n"
+            "    return acc\n")
+        assert check(self.scoped(), src) == []
+
+    def test_mutator_inside_statement_expression_flagged(self):
+        """Mutator calls embedded in a statement's own expressions (an
+        if-test, a return value) are caught, in order."""
+        src = (
+            "def _on_pod(self, type_, name, obj, old):\n"
+            "    if obj['metadata']['finalizers'].pop():\n"
+            "        return old.setdefault('x', 1)\n")
+        vs = check(self.scoped(), src)
+        assert {v.call for v in vs} == {"obj.pop", "old.setdefault"}
+
+    def test_reads_and_nonhandlers_clean(self):
+        src = (
+            "def _on_pod(self, type_, name, obj, old):\n"
+            "    spec = obj['spec']\n"
+            "    return spec.get('nodeName')\n"
+            "def helper(self, obj):\n"
+            "    obj['x'] = 1   # not a handler: no old param, no _on_\n")
+        assert check(self.scoped(), src) == []
+
+
+# ---- rule 5: metrics discipline -------------------------------------------
+
+class TestMetricsRule:
+    DECLARED = {"karpenter_pods_scheduled_total"}
+    DOCS = "...karpenter_pods_scheduled_total..."
+
+    def rule(self):
+        return MetricsRule(declared=set(self.DECLARED),
+                           docs_text=self.DOCS)
+
+    def test_undeclared_series_flagged(self):
+        src = "def f(reg):\n    reg.counter('karpenter_bogus_total')\n"
+        vs = check(self.rule(), src)
+        assert len(vs) == 1 and vs[0].call == "karpenter_bogus_total"
+        assert "not declared" in vs[0].message
+
+    def test_declared_but_undocumented_flagged(self):
+        rule = MetricsRule(declared={"karpenter_x_total"},
+                           docs_text="other stuff")
+        src = "def f(reg):\n    reg.counter('karpenter_x_total')\n"
+        vs = check(rule, src)
+        assert len(vs) == 1 and "missing from docs" in vs[0].message
+
+    def test_declared_and_documented_clean(self):
+        src = ("def f(reg, m):\n"
+               "    reg.counter('karpenter_pods_scheduled_total')\n"
+               "    m.get('karpenter_pods_scheduled_total')\n"
+               "    m.get('not_a_metric')\n")
+        assert check(self.rule(), src) == []
+
+    def test_collect_declared_reads_metrics_py(self):
+        declared = MetricsRule.collect_declared(
+            (REPO / PACKAGE / "metrics.py").read_text())
+        assert "karpenter_pods_scheduled_total" in declared
+        assert "karpenter_lock_wait_seconds" in declared
+        # the lattice gauge surface comes from wire_lattice_metrics
+        assert ("karpenter_cloudprovider_instance_type_offering_available"
+                in declared)
+
+
+# ---- baseline round-trip ---------------------------------------------------
+
+class TestBaseline:
+    V = Violation("clock-discipline", f"{PACKAGE}/cli.py", 553, "main",
+                  "time.monotonic", "raw wall-clock call")
+
+    def test_entry_suppresses_and_removal_resurfaces(self):
+        entry = {"rule": "clock-discipline", "file": f"{PACKAGE}/cli.py",
+                 "call": "time.monotonic", "reason": "serve deadline"}
+        un, used, stale = baseline_mod.apply([self.V], [entry])
+        assert un == [] and used == [entry] and stale == []
+        # remove the entry: the violation resurfaces
+        un, used, stale = baseline_mod.apply([self.V], [])
+        assert un == [self.V]
+
+    def test_context_wildcard_and_mismatch(self):
+        wrong_call = {"rule": "clock-discipline",
+                      "file": f"{PACKAGE}/cli.py",
+                      "call": "time.sleep", "reason": "x"}
+        un, used, stale = baseline_mod.apply([self.V], [wrong_call])
+        assert un == [self.V] and stale == [wrong_call]
+        pinned_ctx = {"rule": "clock-discipline",
+                      "file": f"{PACKAGE}/cli.py",
+                      "call": "time.monotonic", "context": "main",
+                      "reason": "x"}
+        un, _, _ = baseline_mod.apply([self.V], [pinned_ctx])
+        assert un == []
+
+    def test_stale_and_reasonless_entries_are_problems(self):
+        stale_e = {"rule": "determinism", "file": "nope.py", "call": "x",
+                   "reason": "y"}
+        noreason = {"rule": "clock-discipline", "file": f"{PACKAGE}/cli.py",
+                    "call": "time.monotonic", "reason": "  "}
+        un, used, stale = baseline_mod.apply([self.V], [stale_e, noreason])
+        probs = baseline_mod.problems([stale_e, noreason], stale)
+        assert any("stale" in p for p in probs)
+        assert any("no reason" in p for p in probs)
+
+    def test_save_load_round_trip(self, tmp_path):
+        p = tmp_path / "baseline.json"
+        entries = [{"rule": "r", "file": "f.py", "call": "c",
+                    "reason": "because"}]
+        baseline_mod.save(p, entries)
+        assert baseline_mod.load(p) == entries
+        # version guard
+        p.write_text(json.dumps({"version": 99, "entries": []}))
+        with pytest.raises(ValueError):
+            baseline_mod.load(p)
+
+
+# ---- the standing repo gate ------------------------------------------------
+
+SCRATCH_VIOLATIONS = {
+    "clock-discipline":
+        "import time\ndef f():\n    return time.time()\n",
+    "lock-discipline":
+        "import time\ndef f(self):\n"
+        "    with self._lock:\n        time.sleep(1)\n",
+    "determinism": None,   # needs a scoped path; handled below
+    "frozen-envelope": None,
+    "metrics-discipline":
+        "def f(reg):\n    reg.counter('karpenter_never_declared_total')\n",
+}
+
+
+class TestRepoGate:
+    def test_repo_lints_clean_against_committed_baseline(self):
+        """The standing tier-1 twin of ci.sh gate 2: every violation in
+        the tree is either fixed or baselined with a reason."""
+        violations, errors = run_checks(REPO)
+        assert errors == []
+        entries = baseline_mod.load(REPO / "tools" / "lint" /
+                                    "baseline.json")
+        assert len(entries) <= 10, "baseline budget is 10 entries"
+        un, used, stale = baseline_mod.apply(violations, entries)
+        assert un == [], "\n".join(str(v) for v in un)
+        assert baseline_mod.problems(entries, stale) == []
+
+    @pytest.mark.parametrize("rule,rel,src", [
+        ("clock-discipline", "scratch.py",
+         SCRATCH_VIOLATIONS["clock-discipline"]),
+        ("lock-discipline", "scratch.py",
+         SCRATCH_VIOLATIONS["lock-discipline"]),
+        ("determinism", "weather/scratch.py",
+         "import random\ndef f():\n    return random.random()\n"),
+        ("frozen-envelope", "kube/informer.py",
+         "def _on_x(self, type_, name, obj, old):\n"
+         "    obj['spec']['x'] = 1\n"),
+        ("metrics-discipline", "scratch.py",
+         SCRATCH_VIOLATIONS["metrics-discipline"]),
+    ])
+    def test_scratch_violation_fails_the_gate(self, tmp_path, rule, rel,
+                                              src):
+        """Re-introducing any of the five rule violations in a scratch
+        file makes run.py exit non-zero (the acceptance pin)."""
+        pkg = tmp_path / PACKAGE
+        (pkg / Path(rel).parent).mkdir(parents=True, exist_ok=True)
+        (pkg / rel).write_text(src)
+        # a metrics catalog so metrics-discipline has a declared set
+        (pkg / "metrics.py").write_text(
+            "def wire(reg):\n"
+            "    reg.counter('karpenter_pods_scheduled_total', 'h')\n")
+        rc = lint_run.main(["--check", "--root", str(tmp_path),
+                            "--baseline", str(tmp_path / "baseline.json")])
+        assert rc == 1
+        violations, _ = run_checks(tmp_path)
+        assert any(v.rule == rule for v in violations), violations
+
+    def test_clean_scratch_tree_passes(self, tmp_path):
+        pkg = tmp_path / PACKAGE
+        pkg.mkdir(parents=True)
+        (pkg / "ok.py").write_text(
+            "def f(clock):\n    return clock.now()\n")
+        rc = lint_run.main(["--check", "--root", str(tmp_path),
+                            "--baseline", str(tmp_path / "baseline.json")])
+        assert rc == 0
+
+    def test_update_baseline_round_trip(self, tmp_path, capsys):
+        """--update-baseline accepts current violations but writes EMPTY
+        reasons, so --check stays red until a human justifies them; with
+        reasons filled in, the gate goes green; fixing the violation then
+        turns the entry stale and the gate red again."""
+        pkg = tmp_path / PACKAGE
+        pkg.mkdir(parents=True)
+        bad = pkg / "scratch.py"
+        bad.write_text(SCRATCH_VIOLATIONS["clock-discipline"])
+        bl = tmp_path / "baseline.json"
+        assert lint_run.main(["--check", "--root", str(tmp_path),
+                              "--baseline", str(bl)]) == 1
+        assert lint_run.main(["--update-baseline", "--root", str(tmp_path),
+                              "--baseline", str(bl)]) == 0
+        # reasonless entries keep the gate red
+        assert lint_run.main(["--check", "--root", str(tmp_path),
+                              "--baseline", str(bl)]) == 1
+        entries = baseline_mod.load(bl)
+        for e in entries:
+            e["reason"] = "fixture: wall-clock-only"
+        baseline_mod.save(bl, entries)
+        assert lint_run.main(["--check", "--root", str(tmp_path),
+                              "--baseline", str(bl)]) == 0
+        # fix the violation: the entry is now stale and the gate is red
+        bad.write_text("def f(clock):\n    return clock.now()\n")
+        assert lint_run.main(["--check", "--root", str(tmp_path),
+                              "--baseline", str(bl)]) == 1
+        out = capsys.readouterr().out
+        assert "stale baseline entry" in out
+
+
+# ---- the lock-order witness ------------------------------------------------
+
+class TestLockOrderWitness:
+    def setup_method(self):
+        contention.lockorder_reset()
+
+    def teardown_method(self):
+        # the inversion test records a REAL cycle: it must never poison
+        # the standing no-cycle assertions later tests make
+        contention.lockorder_reset()
+
+    def test_nested_acquire_records_edge_no_cycle(self):
+        a, b = contention.lock("low_a_lock"), contention.lock("low_b_lock")
+        with a:
+            with b:
+                pass
+        st = contention.lockorder_stats()
+        assert st["edges"] == 1 and st["cycles"] == 0
+        d = contention.lockorder_detail()
+        assert "low_a_lock -> low_b_lock" in d["edges"]
+        assert d["edges"]["low_a_lock -> low_b_lock"]["stack"]
+
+    def test_sequential_acquires_record_no_edge(self):
+        a, b = contention.lock("seq_a_lock"), contention.lock("seq_b_lock")
+        with a:
+            pass
+        with b:
+            pass
+        assert contention.lockorder_stats()["edges"] == 0
+
+    def test_reentrant_rlock_records_no_self_edge(self):
+        r = contention.rlock("reent_lock")
+        with r:
+            with r:
+                pass
+        assert contention.lockorder_stats()["edges"] == 0
+
+    def test_deliberate_inversion_reports_exactly_one_cycle_with_both_stacks(self):
+        """Two threads, opposite acquisition order (serialized so the
+        test never actually deadlocks): the witness must report EXACTLY
+        one cycle, carrying both edges' witness stacks."""
+        a = contention.lock("inv_a_lock")
+        b = contention.lock("inv_b_lock")
+
+        def order_ab():
+            with a:
+                with b:
+                    pass
+
+        def order_ba():
+            with b:
+                with a:
+                    pass
+
+        t1 = threading.Thread(target=order_ab)
+        t1.start()
+        t1.join()
+        t2 = threading.Thread(target=order_ba)
+        t2.start()
+        t2.join()
+
+        cycles = contention.lockorder_cycles()
+        assert cycles == [["inv_a_lock", "inv_b_lock"]]
+        st = contention.lockorder_stats()
+        assert st["edges"] == 2 and st["cycles"] == 1
+        d = contention.lockorder_detail()
+        assert len(d["cycles"]) == 1
+        members = d["cycles"][0]["edges"]
+        assert [m["edge"] for m in members] == [
+            "inv_a_lock -> inv_b_lock", "inv_b_lock -> inv_a_lock"]
+        for m in members:
+            assert m["stack"], "each cycle edge must carry a witness stack"
+            assert any("test_lint.py" in fr for fr in m["stack"]), m["stack"]
+
+    def test_condition_wait_reacquire_keeps_edges_sane(self):
+        """Condition.wait releases and re-acquires through the wrapper:
+        the held-set stays balanced (no phantom edges accumulate)."""
+        outer = contention.lock("cw_outer_lock")
+        cond = contention.condition("cw_cond")
+        with outer:
+            with cond:
+                cond.wait(timeout=0.01)
+        st = contention.lockorder_stats()
+        # outer->cond witnessed (twice: entry + wait re-acquire); never
+        # the reverse, never a cycle
+        d = contention.lockorder_detail()["edges"]
+        assert "cw_outer_lock -> cw_cond" in d
+        assert "cw_cond -> cw_outer_lock" not in d
+        assert st["cycles"] == 0
+
+    def test_stats_provider_shape_and_disabled_flag(self):
+        st = contention.lockorder_stats()
+        assert set(st) == {"edges", "cycles", "ordered_acquires",
+                           "enabled"}
+        assert all(isinstance(v, float) for v in st.values())
+
+    def test_pprof_route_serves_lockorder(self):
+        from karpenter_provider_aws_tpu import introspect
+        a = contention.lock("route_a_lock")
+        b = contention.lock("route_b_lock")
+        with a:
+            with b:
+                pass
+        body, ctype = introspect.debug_doc("/debug/pprof/lockorder", {})
+        assert ctype == "application/json"
+        doc = json.loads(body)
+        assert "route_a_lock -> route_b_lock" in doc["edges"]
+        assert doc["cycles"] == []
+
+
+# ---- kpctl surfaces --------------------------------------------------------
+
+class TestKpctlLockorder:
+    @pytest.fixture()
+    def kpctl(self):
+        import kpctl
+        return kpctl
+
+    def test_top_contention_row_gains_lockorder_cell(self, kpctl):
+        doc = {"providers": {
+            "contention": {"locks": 1, "a_wait_p99_ms": 1.0,
+                           "a_contended": 2},
+            "lockorder": {"edges": 3.0, "cycles": 0.0,
+                          "ordered_acquires": 9.0, "enabled": 1.0},
+        }}
+        lines = kpctl._render_top(doc, "srv")
+        cont = next(l for l in lines if l.startswith("CONTENTION"))
+        assert "LOCKORDER 3 edges / 0 cycles" in cont
+        assert "DEADLOCK" not in cont
+        doc["providers"]["lockorder"]["cycles"] = 1.0
+        cont = next(l for l in kpctl._render_top(doc, "srv")
+                    if l.startswith("CONTENTION"))
+        assert "DEADLOCK RISK" in cont
+
+    def test_top_tolerates_error_provider_shape(self, kpctl):
+        """The registry's {"error"} provider shape drops the LOCKORDER
+        cell, not the view (the PR 5 WRITER-row contract)."""
+        doc = {"providers": {
+            "contention": {"locks": 1, "a_wait_p99_ms": 1.0,
+                           "a_contended": 2},
+            "lockorder": {"error": "boom"},
+        }}
+        lines = kpctl._render_top(doc, "srv")
+        cont = next(l for l in lines if l.startswith("CONTENTION"))
+        assert "LOCKORDER" not in cont and "a p99" in cont
+
+    def test_cmd_lockorder_renders_graph_and_cycles(self, kpctl, capsys):
+        class FakeClient:
+            def __init__(self, doc):
+                self.doc = doc
+
+            def request(self, method, path):
+                assert path == "/debug/pprof/lockorder"
+                return self.doc
+
+        class Args:
+            stacks = False
+
+        doc = {"enabled": True,
+               "edges": {"a -> b": {"count": 4, "stack": ["f.py:1:g"]}},
+               "cycles": []}
+        rc = kpctl.cmd_lockorder(FakeClient(doc), Args())
+        out = capsys.readouterr().out
+        assert rc == 0 and "1 edges, 0 cycles" in out and "a -> b" in out
+        doc["cycles"] = [{"locks": ["a", "b"], "edges": [
+            {"edge": "a -> b", "count": 4, "stack": ["f.py:1:g"]},
+            {"edge": "b -> a", "count": 1, "stack": ["h.py:2:k"]}]}]
+        rc = kpctl.cmd_lockorder(FakeClient(doc), Args())
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "CYCLE (potential deadlock): a -> b -> a" in out
+        assert "f.py:1:g" in out and "h.py:2:k" in out
+
+    def test_cmd_lockorder_tolerates_error_shape(self, kpctl, capsys):
+        class FakeClient:
+            def request(self, method, path):
+                return {"error": "provider blew up"}
+
+        class Args:
+            stacks = False
+
+        rc = kpctl.cmd_lockorder(FakeClient(), Args())
+        assert rc == 1
+        assert "unavailable" in capsys.readouterr().out
+
+
+class TestOperatorWiring:
+    def test_lockorder_provider_registered(self, request):
+        """Operator._wire_introspection registers the lockorder provider
+        (the kpctl top cell and sampler rings read it)."""
+        from karpenter_provider_aws_tpu import introspect
+        from karpenter_provider_aws_tpu.lattice import (build_catalog,
+                                                        build_lattice)
+        from karpenter_provider_aws_tpu.operator import Operator, Options
+        from karpenter_provider_aws_tpu.utils.clock import FakeClock
+        from karpenter_provider_aws_tpu.cloud import FakeCloud
+        clock = FakeClock()
+        lattice = build_lattice([s for s in build_catalog()
+                                 if s.family == "m5"][:4])
+        Operator(options=Options(), lattice=lattice,
+                 cloud=FakeCloud(clock), clock=clock)
+        snap = introspect.registry().collect()
+        assert "lockorder" in snap
+        assert set(snap["lockorder"]) >= {"edges", "cycles"}

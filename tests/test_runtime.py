@@ -93,6 +93,8 @@ class TestControllerRuntime:
         """The production loop: every controller on its own cadence over
         the locked cluster mirror; pending pods get capacity without the
         deterministic run_once sequencing."""
+        from karpenter_provider_aws_tpu.introspect import contention
+        contention.lockorder_reset()   # scope the witness to this run
         clock = Clock()  # real wall clock — the runtime sleeps for real
         op = Operator(options=Options(registration_delay=0.05),
                       lattice=lattice, cloud=FakeCloud(clock), clock=clock,
@@ -115,6 +117,10 @@ class TestControllerRuntime:
         assert all(p.node_name for p in op.cluster.pods.values()), \
             "async runtime failed to bind pods"
         assert not runtime.error_counts, runtime.error_counts
+        # the standing lock-order invariant (docs/reference/linting.md):
+        # a threaded run must never witness an acquisition-order cycle
+        assert contention.lockorder_cycles() == [], \
+            contention.lockorder_detail()
 
 
 class TestLeaderElection:
@@ -341,6 +347,8 @@ class TestAsyncApiMode:
         through the client get capacity with the informer pump running as
         its own controller thread."""
         from karpenter_provider_aws_tpu.kube import FakeAPIServer, KubeClient
+        from karpenter_provider_aws_tpu.introspect import contention
+        contention.lockorder_reset()   # scope the witness to this run
         clock = Clock()
         server = FakeAPIServer(clock=clock)
         op = Operator(options=Options(registration_delay=0.05,
@@ -370,6 +378,10 @@ class TestAsyncApiMode:
             "async API mode failed to bind pods"
         assert client.list_nodes()
         assert not runtime.error_counts, runtime.error_counts
+        # the standing lock-order invariant: the API-mode fan-out path
+        # (api_fanout -> watch_event nesting) records edges, never a cycle
+        assert contention.lockorder_cycles() == [], \
+            contention.lockorder_detail()
 
 
 class TestClusterEndpointOverride:

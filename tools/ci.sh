@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
-# One-command CI gate: generated-artifact drift, introspection smoke,
-# tier-1 tests, bench smoke.
+# One-command CI gate: generated-artifact drift, graftlint, introspection
+# smoke, tier-1 tests, bench smoke.
 #
 #     bash tools/ci.sh            # the full gate (exit != 0 on any failure)
 #     bash tools/ci.sh --fast     # drift + smokes + tier-1 only (skip bench)
@@ -12,37 +12,43 @@
 #               (the codegen-lockstep contract tests/test_schema.py and
 #               tests/test_tools.py also assert, surfaced here as its own
 #               gate so a red run names the stale file directly)
-#   2. smoke  — introspection + metrics wire format: start an operator,
+#   2. lint   — graftlint (tools/lint/run.py --check): the project-
+#               invariant static-analysis suite (docs/reference/
+#               linting.md) — clock/lock/determinism/frozen-envelope/
+#               metrics discipline; fails on any unbaselined violation
+#               or stale/reasonless baseline entry
+#   3. smoke  — introspection + metrics wire format: start an operator,
 #               assert /debug/statusz and /debug/vars parse with every
 #               registered provider reporting, and run the promtool-style
 #               lint over the live /metrics scrape
 #               (tools/smoke_introspect.py)
-#   3. churn  — steady-state delta-solve gate (tools/smoke_delta.py):
+#   4. churn  — steady-state delta-solve gate (tools/smoke_delta.py):
 #               boots an operator, drives a full pass + 20 small-churn
 #               passes, asserts the incremental build + delta solve
 #               actually engaged (counter > 0) and the plans match the
 #               full-rebuild referee
-#   4. prof   — continuous-profiling gate (tools/smoke_profile.py):
+#   5. prof   — continuous-profiling gate (tools/smoke_profile.py):
 #               boots an operator with the sampling profiler on, drives
 #               a pass over live HTTP, asserts non-empty folded stacks,
 #               contention counters for every instrumented hot lock,
 #               the gzip negotiation, and the live scrape (with the new
 #               karpenter_lock_wait_seconds family) linting clean
-#   5. write  — API-stratum write-path gate (tools/smoke_writepath.py):
+#   6. write  — API-stratum write-path gate (tools/smoke_writepath.py):
 #               boots an API-mode operator, drives a churn burst through
 #               ApiWriter, asserts the bulk/coalesced write path engaged
 #               (counters > 0), zero fan-out envelope copies, the
 #               watch-fed mirror converging to the store, and the live
 #               /metrics scrape (karpenter_api_* series) linting clean
-#   6. weather— adversarial-weather gate (tools/smoke_weather.py): the
+#   7. weather— adversarial-weather gate (tools/smoke_weather.py): the
 #               60 s `squall` scenario on FakeClock — the degradation
 #               ladder must engage (degraded_total > 0), the SLO burn
 #               must recover below 1.0 after the storm, invariants hold
 #               (no pending pods / leaks / stranded messages, junk
 #               bodies counted as malformed), and two runs with the
-#               same seed must record identical weather timelines
-#   7. tier-1 — the full non-slow test suite on the CPU backend
-#   8. bench  — `bench.py --smoke`: one fast config through the real
+#               same seed must record identical weather timelines (and
+#               the lock-order witness reports zero cycles at exit)
+#   8. tier-1 — the full non-slow test suite on the CPU backend
+#   9. bench  — `bench.py --smoke`: one fast config through the real
 #               harness, so a broken solve path can never ride in on a
 #               green unit-test run
 
@@ -54,7 +60,7 @@ PY=${PYTHON:-python}
 FAST=0
 [ "${1:-}" = "--fast" ] && FAST=1
 
-echo "=== ci [1/8] generated-artifact drift ==="
+echo "=== ci [1/9] generated-artifact drift ==="
 $PY tools/gen_crds.py --check
 tmp=$(mktemp -d)
 trap 'rm -rf "$tmp"' EXIT
@@ -69,29 +75,32 @@ done
 [ "$stale" = 0 ] || exit 1
 echo "drift: clean"
 
-echo "=== ci [2/8] introspection smoke + metrics lint ==="
+echo "=== ci [2/9] graftlint (project-invariant static analysis) ==="
+$PY tools/lint/run.py --check
+
+echo "=== ci [3/9] introspection smoke + metrics lint ==="
 $PY tools/smoke_introspect.py
 
-echo "=== ci [3/8] steady-state delta churn smoke ==="
+echo "=== ci [4/9] steady-state delta churn smoke ==="
 $PY tools/smoke_delta.py
 
-echo "=== ci [4/8] continuous-profiling smoke ==="
+echo "=== ci [5/9] continuous-profiling smoke ==="
 $PY tools/smoke_profile.py
 
-echo "=== ci [5/8] write-path smoke ==="
+echo "=== ci [6/9] write-path smoke ==="
 $PY tools/smoke_writepath.py
 
-echo "=== ci [6/8] adversarial-weather smoke ==="
+echo "=== ci [7/9] adversarial-weather smoke ==="
 $PY tools/smoke_weather.py
 
-echo "=== ci [7/8] tier-1 tests ==="
+echo "=== ci [8/9] tier-1 tests ==="
 $PY -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider
 
 if [ "$FAST" = 1 ]; then
-    echo "=== ci [8/8] bench smoke: SKIPPED (--fast) ==="
+    echo "=== ci [9/9] bench smoke: SKIPPED (--fast) ==="
 else
-    echo "=== ci [8/8] bench smoke ==="
+    echo "=== ci [9/9] bench smoke ==="
     $PY bench.py --smoke
 fi
 

@@ -606,9 +606,22 @@ def _render_top(doc, server: str):
          and v > 0),
         key=lambda t: -t[1])[:3]
     if cont:
+        # LOCKORDER cell: the acquisition-order witness's edge/cycle
+        # counts (introspect/contention.py; /debug/pprof/lockorder).
+        # Numeric values only — a provider reporting the registry's
+        # {"error"} shape drops the cell, not the view
+        lo = p.get("lockorder", {})
+        lo_cell = ""
+        if isinstance(lo.get("edges"), (int, float)) \
+                and isinstance(lo.get("cycles"), (int, float)):
+            cyc = lo["cycles"]
+            lo_cell = (f"   LOCKORDER {lo['edges']:g} edges / "
+                       f"{cyc:g} cycles"
+                       + (" !!DEADLOCK RISK" if cyc else ""))
         lines.append("CONTENTION " + ("   ".join(
             f"{name} p99 {_fmt_ms(p99)} ({int(n):d}x)"
-            for name, p99, n in ranked) or "(no contended locks)"))
+            for name, p99, n in ranked) or "(no contended locks)")
+            + lo_cell)
     # measured-vs-modeled device attribution (solver/costmodel.py)
     dev = p.get("device", {})
     if dev.get("last_compute_ms"):
@@ -823,6 +836,40 @@ def cmd_soak(c, args) -> int:
     return 0
 
 
+def cmd_lockorder(c: Client, args) -> int:
+    """Dump the lock-order witness (docs/reference/linting.md): the
+    acquisition-order graph InstrumentedLock records, every edge's
+    hold count, and any cycles — each cycle printed with ALL member
+    edges' witness stacks (the code paths that can deadlock)."""
+    doc = c.request("GET", "/debug/pprof/lockorder")
+    if not isinstance(doc, dict) or "edges" not in doc:
+        # tolerate the registry's {"error"} provider shape (and any
+        # other malformed body) like the WRITER-row fix
+        print(f"lockorder: unavailable ({doc.get('error', 'bad response')})"
+              if isinstance(doc, dict) else "lockorder: bad response")
+        return 1
+    edges = doc.get("edges", {})
+    cycles = doc.get("cycles", [])
+    print(f"lockorder: {len(edges)} edges, {len(cycles)} cycles"
+          f"{'' if doc.get('enabled', True) else '   (accounting DISABLED)'}")
+    for name in sorted(edges):
+        e = edges[name]
+        count = e.get("count", 0) if isinstance(e, dict) else 0
+        print(f"  {name}   ({count:g}x)")
+        if args.stacks and isinstance(e, dict):
+            for fr in e.get("stack", []):
+                print(f"      {fr}")
+    for cyc in cycles:
+        locks = cyc.get("locks", []) if isinstance(cyc, dict) else []
+        print(f"CYCLE (potential deadlock): {' -> '.join(locks)} -> "
+              f"{locks[0] if locks else '?'}")
+        for m in (cyc.get("edges", []) if isinstance(cyc, dict) else []):
+            print(f"  witness {m.get('edge')}   ({m.get('count', 0):g}x)")
+            for fr in m.get("stack", []):
+                print(f"      {fr}")
+    return 1 if cycles else 0
+
+
 def cmd_evict(c: Client, args) -> int:
     force = "?force=1" if args.force else ""
     try:
@@ -909,6 +956,15 @@ def main(argv=None) -> int:
                     help="export: write Chrome trace-event JSON here "
                          "(default stdout)")
     tr.set_defaults(fn=cmd_trace)
+
+    lo = sub.add_parser(
+        "lockorder", help="dump the lock acquisition-order witness graph "
+                          "(/debug/pprof/lockorder; docs/reference/"
+                          "linting.md) — edges, cycles, witness stacks")
+    lo.add_argument("--stacks", action="store_true",
+                    help="also print each edge's first-witness stack "
+                         "(cycle edges always print theirs)")
+    lo.set_defaults(fn=cmd_lockorder)
 
     sk = sub.add_parser(
         "soak", help="summarize a soak time-series artifact (local file, "

@@ -41,6 +41,14 @@ def main() -> int:
     from karpenter_provider_aws_tpu.weather import WeatherSimulator, named
 
     failures = []
+    # arm-check the lock-order witness before the run (the soak does the
+    # same): the production locks are deliberately flat, so "0 cycles"
+    # from an empty graph would be ambiguous between "no deadlock" and
+    # "witness never armed"
+    from karpenter_provider_aws_tpu.introspect import contention
+    with contention.lock("smoke_witness_outer"):
+        with contention.lock("smoke_witness_inner"):
+            pass
     clock = FakeClock()
     lattice = build_lattice([s for s in build_catalog()
                              if s.family in ("m5", "c5")])
@@ -129,6 +137,20 @@ def main() -> int:
         failures.append("same-seed replay diverged from the recorded "
                         "timeline")
 
+    # the lock-order witness must be armed (>= the arm-check edge) and
+    # cycle-free at exit (introspect/contention.py; docs/reference/
+    # linting.md) — a cycle found even on this single-threaded
+    # deterministic run is a deadlock two threads can complete in
+    # production
+    lo_cycles = contention.lockorder_cycles()
+    lo_edges = contention.lockorder_stats()["edges"]
+    if lo_edges < 1:
+        failures.append("lock-order witness lost even its arm-check edge "
+                        "(witness disarmed mid-run?)")
+    if lo_cycles:
+        failures.append(f"lock-order witness found cycles: {lo_cycles} "
+                        "(see /debug/pprof/lockorder)")
+
     if failures:
         print("weather smoke: FAIL")
         for f in failures:
@@ -140,6 +162,7 @@ def main() -> int:
           f"messages={wstats['messages_sent']} "
           f"(junk {wstats['junk_sent']}), "
           f"recovered latency_burn={slo['latency_burn']}, "
+          f"lockorder {lo_edges:g} edges / 0 cycles, "
           f"replay identical)")
     return 0
 

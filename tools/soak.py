@@ -277,6 +277,21 @@ def main(argv=None) -> int:
         except Exception:
             return []
 
+    # arm-check the lock-order witness (introspect/contention.py;
+    # docs/reference/linting.md): one deliberate benign nesting on
+    # dedicated names proves the witness is recording BEFORE the run —
+    # the production locks are kept deliberately flat by the out-of-lock
+    # discipline, so "0 edges at exit" would otherwise be ambiguous
+    # between "nothing nested" and "witness never armed"
+    from karpenter_provider_aws_tpu.introspect import contention as _cont
+    with _cont.lock("soak_witness_outer"):
+        with _cont.lock("soak_witness_inner"):
+            pass
+    assert _cont.lockorder_stats()["edges"] >= 1, \
+        "lock-order witness failed its arm-check"
+    print("soak: lock-order witness armed "
+          "(soak_witness_outer -> soak_witness_inner recorded)")
+
     if weather_sim is not None:
         weather_sim.start()
     try:
@@ -582,6 +597,32 @@ def main(argv=None) -> int:
           + (", ".join(f"{n} p99={p * 1e3:.2f}ms ({c}x)"
                        for n, p, c in contention.top_waits(10))
              or "(none)"))
+    # the lock-order witness verdict (introspect/contention.py;
+    # docs/reference/linting.md): a threaded run must have WITNESSED
+    # orderings (edges > 0 — a zero-edge run means the witness never
+    # armed, a vacuous pass) and found NO cycle (a cycle is a potential
+    # deadlock two threads can complete any day)
+    lo = contention.lockorder_stats()
+    lo_cycles = contention.lockorder_cycles()
+    lo_edges = contention.lockorder_detail()["edges"]
+    prod_edges = [e for e in lo_edges
+                  if not e.startswith("soak_witness")]
+    print(f"soak: lockorder edges={lo['edges']:g} "
+          f"(production {len(prod_edges)}: {sorted(prod_edges)}) "
+          f"cycles={len(lo_cycles)} "
+          f"ordered_acquires={lo['ordered_acquires']:g}")
+    if lo["edges"] == 0:
+        # the arm-check edge alone guarantees >= 1: zero means the
+        # witness machinery itself stopped recording mid-run
+        print("soak: lock-order witness recorded no edges — witness "
+              "disarmed (even the arm-check edge is gone)")
+        ok = False
+    if lo_cycles:
+        import json as _json
+        print("soak: LOCK-ORDER CYCLES (potential deadlock):")
+        for cyc in contention.lockorder_detail()["cycles"]:
+            print(_json.dumps(cyc, indent=1))
+        ok = False
     if client is not None:
         api_ranked = any(n == "api_server" for n, _, _ in top3)
         print(f"soak: api_server in contention top-3: "

@@ -350,7 +350,8 @@ def start_server(op: Operator, port: int,
             encoding = None
             if self.path.startswith("/debug/statusz") or \
                     self.path.startswith("/debug/vars") or \
-                    self.path.startswith("/debug/pprof"):
+                    self.path.startswith("/debug/pprof") or \
+                    self.path.startswith("/debug/explain"):
                 # the introspection surfaces (docs/reference/
                 # introspection.md), mounted here like /debug/traces so
                 # deployments without --api-port still reach them

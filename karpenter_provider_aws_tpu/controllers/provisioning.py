@@ -30,6 +30,9 @@ from ..errors import UnfulfillableCapacityError
 from ..events import Recorder
 from ..lattice.tensors import Lattice, masked_view_versioned
 from ..metrics import Registry, wire_core_metrics
+from ..solver import explain as explain_mod
+from ..solver import taxonomy
+from ..solver.explain import DecisionAuditRing
 from ..solver.solve import NodePlan, PlannedNode, Solver
 from ..state.cluster import ClusterState
 from ..utils.clock import Clock
@@ -142,11 +145,27 @@ class Provisioner:
         self._m_delta = m["solver_delta_solves"]
         self._m_dirty_groups = m["solver_dirty_groups"]
         self._m_pods_state = m["pods_state"]
+        self._m_unsched_reasons = m["pods_unschedulable_reasons"]
+        self._m_eliminations = m["explain_eliminations"]
         # SLO burn tracking (introspect/slo.py): every pass records its
         # end-to-end solve latency; a sampled FFD-referee re-pack records
         # the cost ratio. None = untracked (bare Provisioner in tests).
         self.slo = slo
         self._claim_ids = itertools.count(1)
+        # the decision-audit ring (solver/explain.py): one explanation
+        # per pass, served via /debug/explain + `kpctl explain`; the
+        # operator registers .stats as the "explain" provider
+        self.explain = DecisionAuditRing()
+        self._pass_seq = itertools.count(1)
+        # FailedScheduling dedup: pod -> (last published reason CODE,
+        # the Pod OBJECT it was published for). A stuck pod publishes
+        # ONCE per (pod, reason-code); the entry re-arms when the
+        # reason changes, the pod makes progress (binds, or is deleted
+        # — it leaves the unschedulable set), or the NAME is reused by
+        # a recreated pod (cluster state hands the same object every
+        # pass, so a new object under an old name is a new pod and its
+        # failure deserves its own event)
+        self._failed_pub: Dict[str, Tuple[str, object]] = {}
         self._batch_start: Optional[float] = None
         self._last_pod_seen: Optional[float] = None
         self._known_pending: frozenset = frozenset()
@@ -257,6 +276,7 @@ class Provisioner:
                 resolved["bound"] = self.cluster.bound_pods()
             return resolved["bound"]
 
+        problem0 = None   # the round-0 problem (carries the ledgers)
         try:
             if self._delta_enabled:
                 dirty = self.cluster.dirty_since(self.inc_builder.rev)
@@ -272,6 +292,7 @@ class Provisioner:
                     daemonset_pods=_ds, bound_pods=_bound, pvcs=pvcs,
                     storage_classes=storage_classes,
                     pool_headroom=headroom, dirty=dirty, touched=touched)
+                problem0 = build.problem
                 if build.incremental:
                     # the steady-state fast path: patched problem, device-
                     # resident inputs, dirty blocks only over the link
@@ -321,10 +342,30 @@ class Provisioner:
         result = ProvisionResult(plan=plan)
         self._observe_solver_health(plan, result)
 
-        def surface_unschedulable(p: NodePlan) -> None:
+        # the pass explanation: ledgers from the round-0 problem + the
+        # plan's outcome; limit-fallback drops and claim rationale fold
+        # in below, and the finished record lands in the audit ring at
+        # pass end. RemoteSolver passes (no local problem) still record
+        # outcome + reason codes, just without the waterfall.
+        sp_now = trace.current()
+        expl = explain_mod.explain_pass(
+            problem0, plan, next(self._pass_seq),
+            sp_now.trace_id if sp_now is not None else "",
+            self.clock.now())
+        # every unschedulable reason seen THIS pass (all plans + limit
+        # drops): the dedup map re-arms from it at pass end
+        seen_unsched: Dict[str, str] = {}
+        pod_by_name: Dict[str, Pod] = {}
+
+        def surface_unschedulable(p: NodePlan, first: bool = False) -> None:
+            if p.unschedulable and not pod_by_name:
+                # built only when a pass actually has unschedulable pods
+                pod_by_name.update({q.name: q for q in pending})
             for name, reason in p.unschedulable.items():
-                self.recorder.publish("Warning", "FailedScheduling", "Pod",
-                                      name, reason)
+                self._publish_failed(name, reason, seen_unsched,
+                                     pod=pod_by_name.get(name))
+                if not first:
+                    explain_mod.add_unschedulable(expl, name, reason)
             result.pods_unschedulable += len(p.unschedulable)
 
         def bind_existing(p: NodePlan) -> None:
@@ -352,7 +393,7 @@ class Provisioner:
                 # API mode) report False and don't count as scheduled
                 result.pods_scheduled += sum(self.writer.bind_pods(to_bind))
 
-        surface_unschedulable(plan)
+        surface_unschedulable(plan, first=True)
         bind_existing(plan)
 
         # limits + fallback (scheduling.md:488): a node the pool's limits
@@ -384,10 +425,13 @@ class Provisioner:
             if not pools_left or not retry_pods:
                 for n in dropped:
                     live = [pn for pn in n.pods if pn in self.cluster.pods]
+                    msg = taxonomy.reason(
+                        taxonomy.POOL_LIMITS,
+                        f"nodepool {n.node_pool} limit exceeded")
                     for pn in live:
-                        self.recorder.publish(
-                            "Warning", "FailedScheduling", "Pod", pn,
-                            f"nodepool {n.node_pool} limit exceeded")
+                        self._publish_failed(pn, msg, seen_unsched,
+                                             pod=self.cluster.pods.get(pn))
+                        explain_mod.add_unschedulable(expl, pn, msg)
                     result.pods_unschedulable += len(live)
                 break
             try:
@@ -407,6 +451,9 @@ class Provisioner:
             self._observe_solver_health(current, result)
             surface_unschedulable(current)
             bind_existing(current)
+            # retry-round existing-capacity placements reach the audit
+            # ring too (round 0's came in with explain_pass)
+            explain_mod.add_placements(expl, current)
         for node in planned:
             claim = self._make_claim(node)
             claim.annotations.update(prov_by_node.get(id(node), {}))
@@ -431,6 +478,10 @@ class Provisioner:
                 self.recorder.publish("Normal", "Launched", "NodeClaim", claim.name,
                                       f"{claim.instance_type}/{claim.zone}/{claim.capacity_type} "
                                       f"for {len(node.pods)} pod(s)")
+                # placement rationale (chosen offering, runner-up type +
+                # price delta) for `kpctl explain nodeclaim`
+                explain_mod.add_claim(expl, claim.name, node,
+                                      runner_up=self._runner_up(node))
             except UnfulfillableCapacityError:
                 # offerings already marked unavailable by the provider; the
                 # pods return to pending and the next pass re-solves with the
@@ -449,18 +500,72 @@ class Provisioner:
                 result.created_claims.pop()
         self._m_sched_pods.inc(result.pods_scheduled)
         self._m_unsched_pods.set(result.pods_unschedulable)
+        # the explain surfaces: reason-code counters (rate-able per
+        # pass, like FailedScheduling events pre-dedup), per-stage
+        # elimination counters, and the audit-ring record
+        for code, n in expl.reason_counts.items():
+            self._m_unsched_reasons.inc(n, code=code)
+        for stage, n in expl.eliminations.items():
+            self._m_eliminations.inc(n, stage=stage)
+        self.explain.record(expl)
         self._finish_pass(result, len(pending),
-                          solve_ms=plan.solve_seconds * 1000.0)
+                          solve_ms=plan.solve_seconds * 1000.0,
+                          seen_unsched=seen_unsched)
         return result
 
+    def _publish_failed(self, name: str, reason: str,
+                        seen: Dict[str, str], pod=None) -> None:
+        """Publish FailedScheduling deduped per (pod, reason-code): the
+        same stuck pod re-surfacing with the same code on every pass
+        publishes ONCE; a changed code, a renewed failure after
+        progress, or a same-name RECREATED pod (different object — see
+        _failed_pub) re-publishes. ``seen`` collects this pass's
+        unschedulable set for the re-arm sweep in _finish_pass."""
+        seen[name] = reason
+        code = taxonomy.code_of(reason)
+        prev = self._failed_pub.get(name)
+        if prev is not None and prev[0] == code \
+                and (pod is None or prev[1] is pod):
+            return
+        self._failed_pub[name] = (code, pod)
+        self.recorder.publish("Warning", "FailedScheduling", "Pod",
+                              name, reason)
+
+    def _runner_up(self, node: PlannedNode):
+        """(type, cheapest offering price) of the bin's second-cheapest
+        feasible type — the price delta `kpctl explain nodeclaim`
+        renders next to the chosen offering. Priced against the MASKED
+        lattice (the one the pass solved against): an ICE'd-out
+        offering must never present as the viable alternative. None
+        when the bin had no (currently available) flexibility."""
+        alts = [t for t in node.feasible_types if t != node.instance_type]
+        if not alts:
+            return None
+        import dataclasses
+        probe = dataclasses.replace(node, instance_type=alts[0], pods=[])
+        price = self._offering_price(
+            probe, lat=masked_view_versioned(self.solver.lattice,
+                                             self.unavailable))
+        return (alts[0], price) if np.isfinite(price) else None
+
     def _finish_pass(self, result: ProvisionResult, n_pending: int,
-                     solve_ms: float = 0.0) -> None:
+                     solve_ms: float = 0.0,
+                     seen_unsched: Optional[Dict[str, str]] = None) -> None:
         """End-of-pass bookkeeping: the pods_state gauge re-renders from
         the mirror (binds/nominations just changed the phase split) and
         the introspection record captures the pass's outcome."""
         counts = self.cluster.pod_phase_counts()
         self._m_pods_state.replace({(k,): float(v)
                                     for k, v in counts.items()})
+        if seen_unsched is not None:
+            # re-arm the FailedScheduling dedup for pods that made
+            # progress: anything no longer unschedulable this pass
+            # (bound, nominated, deleted) drops out, so a LATER failure
+            # publishes again. A solve-error pass passes None — the
+            # batch never got examined, nothing re-arms.
+            for gone in [n for n in self._failed_pub
+                         if n not in seen_unsched]:
+                del self._failed_pub[gone]
         with self._lock:
             self.passes += 1
             self._last_pass = {
@@ -565,6 +670,20 @@ class Provisioner:
         # blast radius instead of freezing at the previous pass's value
         result.pods_unschedulable = n_pending
         self._m_unsched_pods.set(n_pending)
+        # the audit ring records the outage pass too: the whole batch is
+        # pending for reason solve-error (partial-result guard), so
+        # `kpctl explain pass` answers "why is everything stuck" during
+        # a solver outage
+        sp_now = trace.current()
+        expl = explain_mod.PassExplanation(
+            pass_id=next(self._pass_seq),
+            trace_id=sp_now.trace_id if sp_now is not None else "",
+            t=self.clock.now(), pods=n_pending,
+            note=f"solve failed: {type(e).__name__}: {e}")
+        expl.unschedulable_total = n_pending
+        expl.reason_counts[taxonomy.SOLVE_ERROR] = n_pending
+        self._m_unsched_reasons.inc(n_pending, code=taxonomy.SOLVE_ERROR)
+        self.explain.record(expl)
         self._finish_pass(result, n_pending)
         return result
 
@@ -602,10 +721,13 @@ class Provisioner:
                 out[name] = rem
         return out
 
-    def _offering_price(self, node: PlannedNode) -> float:
+    def _offering_price(self, node: PlannedNode,
+                        lat: Optional[Lattice] = None) -> float:
         """Cheapest available offering price for the node's instance type
-        within its feasible zone/capacity-type sets."""
-        lat = self.solver.lattice
+        within its feasible zone/capacity-type sets (``lat`` overrides
+        the base lattice — the runner-up rationale prices against the
+        ICE-masked view)."""
+        lat = lat if lat is not None else self.solver.lattice
         ti = lat.name_to_idx.get(node.instance_type)
         if ti is None:
             return float("inf")

@@ -41,12 +41,14 @@ __all__ = [
     "registry", "sampler", "set_sampler", "statusz_text", "vars_doc",
     "debug_doc", "profiler_instance", "set_profiler", "enable_profiling",
     "profiler_stats", "burn_capture", "set_burn_capture",
+    "explain_ring", "set_explain_ring",
 ]
 
 _REGISTRY = IntrospectRegistry()
 _SAMPLER: Optional[Sampler] = None
 _PROFILER: Optional[SamplingProfiler] = None
 _BURN_CAPTURE: Optional[BurnCapture] = None
+_EXPLAIN = None   # solver/explain.py DecisionAuditRing
 _STARTED_AT = time.time()
 
 
@@ -104,6 +106,18 @@ def burn_capture() -> Optional[BurnCapture]:
 def set_burn_capture(bc: Optional[BurnCapture]) -> None:
     global _BURN_CAPTURE
     _BURN_CAPTURE = bc
+
+
+def explain_ring():
+    """The published decision-audit ring (solver/explain.py
+    DecisionAuditRing), or None before any Operator wired one — the
+    store behind /debug/explain and `kpctl explain`."""
+    return _EXPLAIN
+
+
+def set_explain_ring(ring) -> None:
+    global _EXPLAIN
+    _EXPLAIN = ring
 
 
 # ---- the two debug documents ---------------------------------------------
@@ -168,6 +182,16 @@ def debug_doc(path: str, query: Dict[str, List[str]]):
         series = query.get("series", ["0"])[0] in ("1", "true")
         return (json.dumps(vars_doc(include_series=series)).encode(),
                 "application/json")
+    if p == "/debug/explain":
+        # the decision-audit surface (docs/reference/explain.md):
+        # ?pod= / ?nodeclaim= / ?pass= look one decision up; bare GET
+        # lists the ring. Served on BOTH HTTP servers like the rest.
+        ring = _EXPLAIN
+        doc = (ring.doc(query) if ring is not None
+               else {"enabled": False,
+                     "message": "no decision-audit ring published "
+                                "(operator still constructing?)"})
+        return json.dumps(doc).encode(), "application/json"
     if p.startswith("/debug/pprof"):
         return _pprof_doc(p, query)
     return None

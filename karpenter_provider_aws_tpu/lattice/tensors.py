@@ -152,6 +152,13 @@ class Lattice:
     # bumped whenever price is rewritten in place (pricing refresh) so
     # device-resident copies know to re-upload
     price_version: int = 0
+    # the UNMASKED availability this view derives from (None on a base
+    # lattice): masked_view records it so the explain engine
+    # (solver/explain.py) can attribute eliminations to the ICE /
+    # unavailable mask specifically — "was offered, currently held out"
+    # vs "never offered at all"
+    base_available: Optional[np.ndarray] = field(
+        default=None, repr=False, compare=False)
     # key_values_present memo (labels are static per lattice); carried
     # through masked_view's replace() too, which is correct — masked
     # views share the same labels
@@ -194,7 +201,10 @@ def masked_view(lattice: Lattice, offering_mask: np.ndarray) -> Lattice:
 
     available = lattice.available & offering_mask
     price = np.where(available, lattice.price, np.inf).astype(np.float32)
-    return replace(lattice, available=available, price=price)
+    base = (lattice.base_available if lattice.base_available is not None
+            else lattice.available)
+    return replace(lattice, available=available, price=price,
+                   base_available=base)
 
 
 # masked_view memoized per BASE lattice on (price_version, ICE seq_num):

@@ -268,6 +268,23 @@ def wire_core_metrics(reg: Registry) -> Dict[str, _Metric]:
         # pod_phase_counts): bound | pending | nominated | deleting —
         # refreshed by the state sync pump and after every provisioning
         # pass, so the /metrics view of pod state matches /debug/statusz
+        # the decision-explainability surface (solver/explain.py,
+        # docs/reference/explain.md): WHY pods are pending, as bounded
+        # taxonomy codes (solver/taxonomy.py), and how many offerings
+        # each constraint stage eliminated per pass
+        "pods_unschedulable_reasons": reg.counter(
+            "karpenter_pods_unschedulable_reasons_total",
+            "Unschedulable pod observations per scheduling pass, by "
+            "structured reason code (unknown-resource | no-offering | "
+            "ice-hold | zone-anti-affinity | no-fit | no-existing-fit | "
+            "no-new-node-shape | single-bin-full | affinity-presence | "
+            "pool-limits | solve-error | uncoded).", ("code",)),
+        "explain_eliminations": reg.counter(
+            "karpenter_explain_offering_eliminations_total",
+            "Offerings removed from signature groups' candidate sets by "
+            "each constraint-elimination stage, summed per pass (stage: "
+            "resource-fit | requirements | pools | ice | narrowing).",
+            ("stage",)),
         "pods_state": reg.gauge(
             "karpenter_pods_state",
             "Pods tracked by cluster state, by phase (bound | pending | "

@@ -310,6 +310,12 @@ class Operator:
         reg.register("cluster", self.cluster.stats)
         reg.register("solver", self.solver.stats)
         reg.register("provisioner", self.provisioner.stats)
+        # the decision-audit ring (solver/explain.py; docs/reference/
+        # explain.md): per-pass reason-code histogram + elimination
+        # counters ride the sampler into soak artifacts, and the ring
+        # itself serves /debug/explain on both HTTP servers
+        reg.register("explain", self.provisioner.explain.stats)
+        introspect.set_explain_ring(self.provisioner.explain)
         reg.register("ice_cache", self.unavailable.stats)
         reg.register("writer", self.writer.stats)
         reg.register("events", self.recorder.stats)

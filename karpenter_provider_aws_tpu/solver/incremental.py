@@ -100,7 +100,10 @@ class IncrementalProblemBuilder:
     builder itself keeps no locks.
     """
 
-    def __init__(self):
+    def __init__(self, explain: bool = True):
+        # capture constraint-elimination ledgers on every full build
+        # (solver/explain.py); the delta path patches them copy-on-write
+        self._explain = explain
         self._prev: Optional[Problem] = None
         self._rev: int = -1
         self._lattice: Optional[Lattice] = None
@@ -110,6 +113,7 @@ class IncrementalProblemBuilder:
         self._simple = False        # prev build eligible for deltas at all
         self._sig_to_gi: Dict[str, int] = {}
         self._pod_to_gi: Optional[Dict[str, int]] = None   # lazy
+        self._dropped_pods: frozenset = frozenset()
         self._bin_types: frozenset = frozenset()
         # observability (Solver.stats folds the solve-side counters; the
         # provisioner provider folds these)
@@ -248,7 +252,7 @@ class IncrementalProblemBuilder:
             daemonset_pods=_resolve(daemonset_pods) or (),
             bound_pods=bound,
             pvcs=_resolve(pvcs), storage_classes=_resolve(storage_classes),
-            pool_headroom=headroom)
+            pool_headroom=headroom, explain=self._explain)
         self.full_builds += 1
         self.last_reason = reason
         self._prev = problem
@@ -258,6 +262,8 @@ class IncrementalProblemBuilder:
         self._pool_fp = _pool_fingerprint(node_pools)
         self._headroom_fp = _headroom_fingerprint(headroom)
         self._pod_to_gi = None   # rebuilt lazily on the first delta
+        self._dropped_pods = frozenset(
+            n for g in problem.dropped_groups for n in g.pod_names)
         self._bin_types = frozenset(b.instance_type for b in existing)
         blocker = self._eligibility(problem, pods, bound)
         # a signature appearing in TWO groups (topology split slipped the
@@ -305,6 +311,13 @@ class IncrementalProblemBuilder:
         removed: Dict[int, set] = {}
         adds: List[Tuple[str, Pod]] = []
         for name in (dirty.pods if dirty is not None else ()):
+            if name in self._dropped_pods:
+                # a build-time-dropped group's membership changed: the
+                # retained dropped_groups (and their ledgers) would go
+                # stale and explain differently from a full rebuild —
+                # parity over speed, always
+                self.last_reason = "dropped-group-churn"
+                return None
             state, pod = (touched.get(name, ("gone", None))
                           if touched is not None else ("gone", None))
             gi = pod_map.get(name)
@@ -401,6 +414,13 @@ class IncrementalProblemBuilder:
             for gi in dirty_gis:
                 g = replace(prev.groups[gi], pod_names=new_names[gi])
                 g._narrow_ctx = getattr(prev.groups[gi], "_narrow_ctx", None)
+                if g.ledger is not None:
+                    # ledger copy-on-write: the stage counts are count-
+                    # independent (recheck_narrow above proved the one
+                    # count-dependent decision unchanged), so only the
+                    # pods field moves — a delta-built pass explains
+                    # identically to a full rebuild (parity-pinned)
+                    g.ledger = g.ledger.with_count(len(new_names[gi]))
                 groups[gi] = g
         problem = replace(
             prev, groups=groups, count=count,

@@ -19,6 +19,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from . import taxonomy
+from .explain import unplaced_reason
 from .problem import Problem
 
 
@@ -152,12 +154,20 @@ def ffd_oracle(problem: Problem) -> OraclePlan:
                     break
             if placed:
                 continue
+            # distinct taxonomy codes per cause (solver/taxonomy.py):
+            # the single generic string hid three different triages
             if group.single_bin and gi in single_bin_home:
-                unschedulable[pod_name] = "does not fit any existing node or new-node shape"
+                unschedulable[pod_name] = taxonomy.reason(
+                    taxonomy.SINGLE_BIN_FULL,
+                    "hostname self-affinity pins the group to one node "
+                    "and it cannot hold more pods")
                 continue
             # a fresh bin satisfies presence needs only by self-seeding
             if A and not np.all(group.match | ~group.need):
-                unschedulable[pod_name] = "does not fit any existing node or new-node shape"
+                unschedulable[pod_name] = taxonomy.reason(
+                    taxonomy.AFFINITY_PRESENCE,
+                    "required affinity class present on no node and the "
+                    "group cannot self-seed it")
                 continue
             # open a new node: highest-weight compatible pool with a feasible type
             for pi in np.nonzero(group.np_ok)[0]:
@@ -179,7 +189,16 @@ def ffd_oracle(problem: Problem) -> OraclePlan:
                     placed = True
                     break
             if not placed:
-                unschedulable[pod_name] = "does not fit any existing node or new-node shape"
+                # no-existing-fit: no compatible pool can open a node at
+                # all, so only existing capacity could have hosted it;
+                # no-new-node-shape: pools exist but no empty node of any
+                # feasible type holds the pod. The group's ledger refines
+                # further (an ICE-zeroed group reads ice-hold).
+                unschedulable[pod_name] = unplaced_reason(
+                    group,
+                    fallback=(taxonomy.NO_EXISTING_FIT
+                              if not group.np_ok.any()
+                              else taxonomy.NO_NEW_NODE_SHAPE))
 
     # finalize: cheapest available offering per new bin
     cost = 0.0

@@ -40,6 +40,7 @@ from ..apis.requirements import Operator, Requirement, Requirements
 from ..apis.resources import R, axis as res_axis, resources_to_vec_checked
 from ..lattice.tensors import Lattice
 from ..ops.masks import _AXIS_KEYS, _CAT_KEY_INDEX, _NUM_KEY_INDEX, compile_masks
+from . import taxonomy
 from .topology import _BIG, BoundPod, ClassRegistry, resolve_group_topology
 
 
@@ -80,6 +81,9 @@ class PodGroup:
     unnarrowed_type_mask: Optional[np.ndarray] = None  # pre-accel-narrowing
                                    # mask; the feasibility gate falls back to
                                    # it if narrowing made the group infeasible
+    ledger: Optional[object] = None  # solver/explain.py GroupLedger — the
+                                   # group's constraint-elimination record
+                                   # (None when the build ran explain=False)
 
 
 @dataclass
@@ -120,6 +124,10 @@ class Problem:
     e_pm: np.ndarray               # [E,A] i32 count of bound pods matching class a
     e_po: np.ndarray               # [E,A] bool bin holds a bound pod owning anti-term a
     warnings: List[str] = field(default_factory=list)  # unsupported-constraint notices
+    # groups eliminated entirely at build (no feasible offering, no
+    # existing capacity): kept so the explain surface can render their
+    # elimination waterfall for the pods now in ``unschedulable``
+    dropped_groups: List[PodGroup] = field(default_factory=list)
 
     @property
     def G(self) -> int:
@@ -689,7 +697,8 @@ def signature_of(pod: Pod, relevant_keys: frozenset = frozenset()
                 _, unknown = resources_to_vec_checked(pod.requests,
                                                       implicit_pod=True)
                 if unknown:
-                    _BAD_SIDS[sid] = (
+                    _BAD_SIDS[sid] = taxonomy.reason(
+                        taxonomy.UNKNOWN_RESOURCE,
                         f"unknown resource(s): {', '.join(unknown)}")
             pod.__dict__["_kpat_sig"] = (rk, sid)
         return repr(_SIG_TUPLES[sid]), _BAD_SIDS.get(sid)
@@ -746,6 +755,42 @@ def recheck_narrow(group: PodGroup, count: int, total_pending: int,
     return bool(np.array_equal(prev_raw, new_raw))
 
 
+def _group_ledger(cap, g: PodGroup, np_type: np.ndarray,
+                  np_zone: np.ndarray, np_cap: np.ndarray, NP: int):
+    """One group's constraint-elimination ledger (solver/explain.py).
+    O(stages) dot products over [T] per group — the per-pattern offering
+    counts are memoized inside ``cap``, so same-shaped groups share
+    every reduction."""
+    vec, req_tmask, zm, cm = g._explain_ctx
+    lattice = cap.lattice
+    fits_t = (lattice.alloc >= vec[None, :]).all(axis=1)
+    if g.np_ok.any():
+        ptm = np_type[g.np_ok].any(axis=0)
+        pzm = np_zone[g.np_ok].any(axis=0)
+        pcm = np_cap[g.np_ok].any(axis=0)
+    else:
+        ptm = np.zeros(np_type.shape[1], dtype=bool)
+        pzm = np.zeros(np_zone.shape[1], dtype=bool)
+        pcm = np.zeros(np_cap.shape[1], dtype=bool)
+    final = g.type_mask if g.unnarrowed_type_mask is not None else None
+    notes: List[str] = []
+    if g.single_bin:
+        notes.append("hostname self-affinity: all replicas co-locate")
+    if g.spread_class >= 0:
+        notes.append(f"hostname spread: at most {g.max_per_bin} per node")
+    elif g.max_per_bin < _BIG:
+        notes.append(f"per-node cap: at most {g.max_per_bin}")
+    if g.strict_custom:
+        notes.append("strict custom-key constraints")
+    if g.need is not None and g.need.any():
+        notes.append("requires a co-located affinity class")
+    if g.owner is not None and g.owner.any():
+        notes.append("owns a hostname anti-affinity term")
+    return cap.ledger(vec, fits_t, req_tmask, zm, cm, ptm, pzm, pcm,
+                      final, g.signature, len(g.pod_names),
+                      int(g.np_ok.sum()), NP, notes)
+
+
 def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: Lattice,
                   existing: Sequence[ExistingBin] = (),
                   daemonset_pods: Sequence[Pod] = (),
@@ -753,7 +798,7 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
                   pvcs: Optional[Mapping] = None,
                   storage_classes: Optional[Mapping] = None,
                   pool_headroom: Optional[Mapping[str, np.ndarray]] = None,
-                  narrow: bool = True) -> Problem:
+                  narrow: bool = True, explain: bool = False) -> Problem:
     with _INTERN_LOCK:
         if len(_SIG_TUPLES) >= _INTERN_MAX:
             _RK_INTERN.clear()
@@ -762,7 +807,8 @@ def build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: 
             _BAD_SIDS.clear()
         return _build_problem(pods, node_pools, lattice, existing,
                               daemonset_pods, bound_pods, pvcs,
-                              storage_classes, pool_headroom, narrow)
+                              storage_classes, pool_headroom, narrow,
+                              explain)
 
 
 def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice: Lattice,
@@ -772,7 +818,7 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
                    pvcs: Optional[Mapping] = None,
                    storage_classes: Optional[Mapping] = None,
                    pool_headroom: Optional[Mapping[str, np.ndarray]] = None,
-                   narrow: bool = True) -> Problem:
+                   narrow: bool = True, explain: bool = False) -> Problem:
     real_pools = sorted(node_pools, key=lambda p: (-p.weight, p.name))
     T, Z, C = lattice.T, lattice.Z, lattice.C
     key_values = lattice.key_values_present()
@@ -884,7 +930,9 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
             _SIG_TUPLES.append(sig)
             _, unknown = resources_to_vec_checked(pod.requests, implicit_pod=True)
             if unknown:
-                _BAD_SIDS[sid] = f"unknown resource(s): {', '.join(unknown)}"
+                _BAD_SIDS[sid] = taxonomy.reason(
+                    taxonomy.UNKNOWN_RESOURCE,
+                    f"unknown resource(s): {', '.join(unknown)}")
         pod.__dict__[_SIG] = (relevant_keys, sid)
         entry = raw_groups.get(sid)
         if entry is not None:
@@ -1200,7 +1248,9 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
             custom_domains=custom_domains)
         if cut > 0:
             for name in names[len(names) - cut:]:
-                unschedulable[name] = "zone anti-affinity: more replicas than eligible zones"
+                unschedulable[name] = taxonomy.reason(
+                    taxonomy.ZONE_ANTI_AFFINITY,
+                    "more replicas than eligible zones")
             names = names[: len(names) - cut]
         cursor = 0
         for s in splits:
@@ -1309,6 +1359,12 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
                 unnarrowed_type_mask=unnarrowed,
             )
             g._narrow_ctx = narrow_ctx
+            if explain:
+                # the inputs the ledger build (below, after the
+                # feasibility gate settles type masks) needs: the request
+                # vector and the PRE-narrowing compiled masks
+                g._explain_ctx = (vec, masks.type_mask,
+                                  s.zone_mask, s.cap_mask)
             groups.append(g)
             pending_topo.append((g, rep, topo.owner, topo.need))
 
@@ -1348,7 +1404,12 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
                 return True
         return False
 
+    ledger_cap = None
+    if explain:
+        from .explain import LedgerCapture
+        ledger_cap = LedgerCapture(lattice)
     schedulable_groups: List[PodGroup] = []
+    dropped_groups: List[PodGroup] = []
     for g in groups:
         feasible = _has_offering(g)
         if not feasible and g.unnarrowed_type_mask is not None:
@@ -1359,12 +1420,25 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
             g.type_mask = g.unnarrowed_type_mask
             g.unnarrowed_type_mask = None
             feasible = _has_offering(g)
+        if ledger_cap is not None:
+            g.ledger = _group_ledger(ledger_cap, g, np_type, np_zone,
+                                     np_cap, NP)
         if feasible or len(existing) > 0:
             # groups infeasible for new nodes may still fit existing capacity
             schedulable_groups.append(g)
         else:
+            # the ledger refines the code: every compatible offering
+            # eliminated by the ICE/unavailable mask is weather-caused
+            # pending (ice-hold), not genuine infeasibility
+            code = (g.ledger.blame_code() if g.ledger is not None
+                    else "") or taxonomy.NO_OFFERING
+            msg = taxonomy.reason(
+                code, "all compatible offerings currently unavailable"
+                if code == taxonomy.ICE_HOLD
+                else "no compatible nodepool/instance-type offering")
             for name in g.pod_names:
-                unschedulable[name] = "no compatible nodepool/instance-type offering"
+                unschedulable[name] = msg
+            dropped_groups.append(g)
     groups = schedulable_groups
 
     # --- FFD order: dominant normalized request, descending (the grouped
@@ -1462,6 +1536,7 @@ def _build_problem(pods: Sequence[Pod], node_pools: Sequence[NodePool], lattice:
         single_bin=single_bin,
         g_match=g_match, g_owner=g_owner, g_need=g_need, strict_custom=strict_custom,
         warnings=list(dict.fromkeys(warnings)),  # distinct notices once each
+        dropped_groups=dropped_groups,
         np_type=np_type, np_zone=np_zone, np_cap=np_cap, ds_overhead=ds_overhead,
         np_alloc_cap=np_alloc_cap,
         e_used=e_used, e_alloc=e_alloc, e_type=e_type, e_zone=e_zone, e_cap=e_cap,

@@ -41,7 +41,8 @@ from ..errors import (SolverCapacityError, SolverDeviceError, SolverError,
                       is_retryable_solver_error)
 from ..lattice.tensors import Lattice
 from ..ops import binpack
-from . import costmodel
+from . import costmodel, taxonomy
+from .explain import unplaced_reason
 from .faults import FaultInjector
 from .pipeline import ResidentInputCache, StageTimer, fetch_async
 from .problem import Problem
@@ -962,7 +963,12 @@ class Solver:
             improvable = [n for n, reason in plan.unschedulable.items()
                           if relax.get(n, 0) < depth.get(n, 0)
                           # pre-solve failures (unknown resource names) are
-                          # not fixable by dropping preferences — no rounds
+                          # not fixable by dropping preferences — no rounds.
+                          # The legacy free-text prefix stays recognized:
+                          # a pre-taxonomy reason string must not burn
+                          # relaxation rounds either
+                          and taxonomy.code_of(reason)
+                          != taxonomy.UNKNOWN_RESOURCE
                           and not reason.startswith("unknown resource")]
             if not improvable:
                 break
@@ -1641,8 +1647,10 @@ class Solver:
                         )
                         new_bins[b] = node
                     node.pods.extend(pod_slice)
-            for name in names[cursor: cursor + int(leftover_l[gi])]:
-                unschedulable[name] = "does not fit any existing node or new-node shape"
+            if leftover_l[gi]:
+                msg = unplaced_reason(group)
+                for name in names[cursor: cursor + int(leftover_l[gi])]:
+                    unschedulable[name] = msg
 
         new_nodes = [new_bins[b] for b in sorted(new_bins)]
         cost = float(sum(n.price_per_hour for n in new_nodes))
@@ -1896,9 +1904,10 @@ class Solver:
                 for _, pod_names in content:
                     node.pods.extend(pod_names)
                 nodes.append(node)
-            for pool in spill_names.values():
+            for gi, pool in spill_names.items():
+                msg = unplaced_reason(problem.groups[gi])
                 for name in pool:
-                    unsched[name] = "does not fit any existing node or new-node shape"
+                    unsched[name] = msg
             cost = float(sum(n.price_per_hour for n in nodes))
             return NodePlan(new_nodes=nodes, existing_assignments=assigns,
                             unschedulable=unsched, new_node_cost=cost,
@@ -2071,8 +2080,10 @@ class Solver:
                     assigns.setdefault(problem.existing[b].name, []).extend(pod_slice)
                 else:
                     node_at(int(b)).pods.extend(pod_slice)
-            for name in pool[cursor: cursor + int(leftover2[gi])]:
-                unsched[name] = "does not fit any existing node or new-node shape"
+            if int(leftover2[gi]):
+                msg = unplaced_reason(problem.groups[gi])
+                for name in pool[cursor: cursor + int(leftover2[gi])]:
+                    unsched[name] = msg
 
         # any remaining open new bin that took merge pods (kept bins already
         # materialized above; the lean buffer has no npods, but merge-added

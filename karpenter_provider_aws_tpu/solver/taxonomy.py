@@ -1,0 +1,92 @@
+"""The structured unschedulable-reason taxonomy.
+
+The reference answers "why is this pod pending" with `FailedScheduling`
+events and nodeclaim status conditions; until this module the repo
+answered it with free-text strings — `solver/oracle.py` emitted ONE
+generic "does not fit any existing node or new-node shape" for three
+distinct causes, and nothing machine-readable survived to the metric or
+event surface. Every unschedulable reason is now a bounded enum CODE
+plus a human detail, carried as ``"<code>: <detail>"`` on
+``NodePlan.unschedulable`` (and therefore across the sidecar wire's
+``unschedulable`` map unchanged), on `FailedScheduling` events, and as
+the ``code`` label of ``karpenter_pods_unschedulable_reasons_total``.
+
+Codes are DECLARED here and nowhere else: the graftlint ``reason-code``
+rule (tools/lint/rules.py ReasonRule) fails any ``reason(...)`` call or
+``code=`` label literal not in :data:`CODES` — the same
+declaration-lockstep discipline the metrics rule enforces for series
+names. Add a code by adding a constant; the lint, the docs table
+(docs/reference/explain.md), and every consumer stay in step.
+"""
+
+from __future__ import annotations
+
+# ---- the bounded code set -------------------------------------------------
+
+# pre-solve: the pod's requests name a resource axis the lattice does
+# not model; no amount of capacity helps
+UNKNOWN_RESOURCE = "unknown-resource"
+# problem build: no (nodepool, instance-type, zone, capacity-type)
+# offering is compatible with the pod's requirements at all
+NO_OFFERING = "no-offering"
+# problem build: every compatible offering exists in the catalog but is
+# currently held out of the market (ICE / unavailable mask) — weather-
+# caused pending, distinct from genuine infeasibility
+ICE_HOLD = "ice-hold"
+# problem build: zone anti-affinity demands more zones than are eligible
+ZONE_ANTI_AFFINITY = "zone-anti-affinity"
+# pack: the pod fits neither existing capacity nor any new-node shape
+# (the device decode's generic leftover; the host-FFD rung refines it)
+NO_FIT = "no-fit"
+# host FFD: only existing capacity could host this pod (no compatible
+# pool can open a node for it) and none of it fits
+NO_EXISTING_FIT = "no-existing-fit"
+# host FFD: compatible pools exist but no empty node of any feasible
+# type can hold the pod (+ daemonset overhead)
+NO_NEW_NODE_SHAPE = "no-new-node-shape"
+# host FFD: hostname self-affinity pinned the group to one bin and that
+# bin is full
+SINGLE_BIN_FULL = "single-bin-full"
+# host FFD: a hostname-affinity presence requirement no bin satisfies
+# and the group cannot self-seed
+AFFINITY_PRESENCE = "affinity-presence"
+# provisioning: the plan's node was dropped by NodePool spec.limits and
+# no fallback pool could take the pods
+POOL_LIMITS = "pool-limits"
+# provisioning: the solve itself failed; the whole batch stays pending
+# for the next pass (partial-result guard)
+SOLVE_ERROR = "solve-error"
+
+CODES = frozenset({
+    UNKNOWN_RESOURCE, NO_OFFERING, ICE_HOLD, ZONE_ANTI_AFFINITY,
+    NO_FIT, NO_EXISTING_FIT, NO_NEW_NODE_SHAPE, SINGLE_BIN_FULL,
+    AFFINITY_PRESENCE, POOL_LIMITS, SOLVE_ERROR,
+})
+
+# the parse-failure sentinel for strings minted before the taxonomy (or
+# by an older sidecar across the wire) — NOT a member of CODES, so the
+# lint can never accept it as a declared literal
+UNCODED = "uncoded"
+
+
+def reason(code: str, detail: str = "") -> str:
+    """Render a coded unschedulable reason: ``"<code>: <detail>"`` (or
+    the bare code with no detail). The inverse of :func:`code_of`."""
+    assert code in CODES, f"undeclared reason code {code!r}"
+    return f"{code}: {detail}" if detail else code
+
+
+def code_of(reason_str: str) -> str:
+    """The taxonomy code of a reason string; :data:`UNCODED` for
+    free-text strings minted before the taxonomy (an old sidecar across
+    the wire must not crash the metric/event path)."""
+    head = reason_str.split(":", 1)[0].strip()
+    return head if head in CODES else UNCODED
+
+
+def detail_of(reason_str: str) -> str:
+    """The human detail of a coded reason ("" when none)."""
+    if code_of(reason_str) == UNCODED:
+        return reason_str
+    parts = reason_str.split(":", 1)
+    return parts[1].strip() if len(parts) > 1 else ""

@@ -1,0 +1,683 @@
+"""Decision explainability: the reason taxonomy, constraint-elimination
+ledgers, the decision-audit ring, FailedScheduling dedup, and the
+delta-vs-full explanation parity contract (docs/reference/explain.md).
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.apis import NodePool, Pod
+from karpenter_provider_aws_tpu.apis import wellknown as wk
+from karpenter_provider_aws_tpu.apis.objects import (NodeClass,
+                                                     PodAffinityTerm, Taint)
+from karpenter_provider_aws_tpu.cache.unavailable import UnavailableOfferings
+from karpenter_provider_aws_tpu.cloud import FakeCloud
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+from karpenter_provider_aws_tpu.lattice.tensors import (masked_view,
+                                                        masked_view_versioned)
+from karpenter_provider_aws_tpu.solver import Solver, build_problem
+from karpenter_provider_aws_tpu.solver import explain as ex
+from karpenter_provider_aws_tpu.solver import taxonomy as tx
+from karpenter_provider_aws_tpu.solver.incremental import (
+    IncrementalProblemBuilder)
+from karpenter_provider_aws_tpu.solver.oracle import ffd_oracle
+from karpenter_provider_aws_tpu.solver.problem import ExistingBin
+from karpenter_provider_aws_tpu.state.cluster import ClusterState
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    return build_lattice([s for s in build_catalog()
+                          if s.family in ("m5", "c5")])
+
+
+@pytest.fixture(scope="module")
+def solver(lattice):
+    return Solver(lattice)
+
+
+def _pod(i, shape=None, **kw):
+    return Pod(name=f"p{i}",
+               requests=shape or {"cpu": "500m", "memory": "1Gi"}, **kw)
+
+
+# ---------------------------------------------------------------------------
+# taxonomy
+
+
+class TestTaxonomy:
+    def test_round_trip_every_code(self):
+        for code in tx.CODES:
+            assert tx.code_of(tx.reason(code, "some detail")) == code
+            assert tx.code_of(tx.reason(code)) == code
+            assert tx.detail_of(tx.reason(code, "some detail")) == \
+                "some detail"
+
+    def test_legacy_free_text_parses_uncoded(self):
+        legacy = "does not fit any existing node or new-node shape"
+        assert tx.code_of(legacy) == tx.UNCODED
+        assert tx.detail_of(legacy) == legacy
+
+    def test_undeclared_code_asserts(self):
+        with pytest.raises(AssertionError):
+            tx.reason("not-a-code", "x")
+
+    def test_uncoded_is_not_a_member(self):
+        assert tx.UNCODED not in tx.CODES
+
+
+# ---------------------------------------------------------------------------
+# ledger capture
+
+
+class TestLedgerCapture:
+    def test_explain_off_attaches_no_ledger(self, lattice):
+        p = build_problem([_pod(1)], [NodePool(name="default")], lattice)
+        assert p.groups[0].ledger is None
+
+    def test_waterfall_monotone_and_consistent(self, lattice):
+        p = build_problem(
+            [_pod(1, node_selector={wk.LABEL_INSTANCE_TYPE: "m5.large"})],
+            [NodePool(name="default")], lattice, explain=True)
+        led = p.groups[0].ledger
+        rows = led.stages
+        assert [r.stage for r in rows[:1]] == ["offered"]
+        for prev, cur in zip(rows, rows[1:]):
+            assert cur.remaining <= prev.remaining
+            assert cur.eliminated == prev.remaining - cur.remaining
+        # the selector eliminated every non-m5.large offering
+        req = next(r for r in rows if r.stage == "requirements")
+        assert req.eliminated > 0 and req.remaining > 0
+        assert led.blame() == "" and led.blame_code() == ""
+        assert "m5.large" in led.label or "cpu=" in led.label
+
+    def test_ice_attribution_with_examples(self, lattice):
+        view = masked_view(lattice, np.zeros_like(lattice.available))
+        p = build_problem(
+            [_pod(1, node_selector={wk.LABEL_INSTANCE_TYPE: "m5.large"})],
+            [NodePool(name="default")], view, explain=True)
+        assert not p.groups and p.dropped_groups
+        led = p.dropped_groups[0].ledger
+        assert led.blame() == "ice"
+        assert led.blame_code() == tx.ICE_HOLD
+        ice = next(r for r in led.stages if r.stage == "ice")
+        assert ice.eliminated > 0 and ice.remaining == 0
+        assert ice.examples and "m5.large/" in ice.examples[0]
+        assert tx.code_of(p.unschedulable["p1"]) == tx.ICE_HOLD
+
+    def test_impossible_selector_blames_requirements(self, lattice):
+        p = build_problem(
+            [_pod(1, node_selector={wk.LABEL_INSTANCE_TYPE: "nope.xl"})],
+            [NodePool(name="default")], lattice, explain=True)
+        assert p.dropped_groups
+        led = p.dropped_groups[0].ledger
+        assert led.blame() == "requirements"
+        assert led.blame_code() == tx.NO_OFFERING
+        assert tx.code_of(p.unschedulable["p1"]) == tx.NO_OFFERING
+
+    def test_resource_fit_stage_zeroes_impossible_request(self, lattice):
+        # no m5/c5 type carries a GPU: resource-fit eliminates everything
+        p = build_problem(
+            [_pod(1, shape={"cpu": "500m", "nvidia.com/gpu": "1"})],
+            [NodePool(name="default")], lattice, explain=True)
+        group = (p.groups + p.dropped_groups)[0]
+        fit = next(r for r in group.ledger.stages
+                   if r.stage == "resource-fit")
+        assert fit.remaining == 0
+
+    def test_accel_narrowing_records_a_recoverable_row(self):
+        lat = build_lattice([s for s in build_catalog()
+                             if s.family in ("g5", "m5")])
+        pods = [Pod(name=f"g{i}", requests={"cpu": "500m",
+                                            "nvidia.com/gpu": "1"})
+                for i in range(4)]
+        p = build_problem(pods, [NodePool(name="default")], lat,
+                          explain=True)
+        led = p.groups[0].ledger
+        nar = [r for r in led.stages if r.stage == "narrowing"]
+        assert nar and nar[0].examples  # eliminated type names
+        assert led.remaining > 0       # narrowing never zeroes (fallback)
+
+    def test_with_count_copy_on_write(self, lattice):
+        p = build_problem([_pod(1), _pod(2)], [NodePool(name="default")],
+                          lattice, explain=True)
+        led = p.groups[0].ledger
+        assert led.with_count(led.pods) is led
+        led2 = led.with_count(7)
+        assert led2.pods == 7 and led2.stages == led.stages
+        assert led.pods == 2   # original untouched
+
+    def test_pool_stage_counts_pools(self, lattice):
+        tainted = NodePool(name="t", taints=[
+            Taint(key="team", value="a", effect="NoSchedule")])
+        p = build_problem([_pod(1)], [NodePool(name="default"), tainted],
+                          lattice, explain=True)
+        led = p.groups[0].ledger
+        assert led.pools_total == 2 and led.pools_ok == 1
+
+
+# ---------------------------------------------------------------------------
+# taxonomy codes out of the solve paths
+
+
+class TestSolveCodes:
+    def test_oracle_no_new_node_shape(self, lattice):
+        # fits no type at all; without ledgers the FFD rung's own
+        # distinction applies (compatible pools exist, no shape fits)
+        p = build_problem([_pod(1, shape={"cpu": "10000"})],
+                          [NodePool(name="default")], lattice)
+        plan = ffd_oracle(p)
+        assert tx.code_of(plan.unschedulable["p1"]) == tx.NO_NEW_NODE_SHAPE
+
+    def test_oracle_ledger_refines_to_no_offering(self, lattice):
+        # WITH ledgers the same pod reads no-offering: the resource-fit
+        # stage already proved no offering can ever hold it
+        p = build_problem([_pod(1, shape={"cpu": "10000"})],
+                          [NodePool(name="default")], lattice,
+                          explain=True)
+        plan = ffd_oracle(p)
+        assert tx.code_of(plan.unschedulable["p1"]) == tx.NO_OFFERING
+
+    def test_oracle_no_existing_fit(self, lattice):
+        # no compatible pool (untolerated taint) + an existing bin with
+        # no room: only existing capacity could host, none fits
+        pool = NodePool(name="t", taints=[
+            Taint(key="team", value="a", effect="NoSchedule")])
+        ti = lattice.name_to_idx["m5.large"]
+        full = lattice.alloc[ti].copy()
+        p = build_problem(
+            [_pod(1)], [pool], lattice,
+            existing=[ExistingBin(
+                name="n1", node_pool="t", instance_type="m5.large",
+                zone=lattice.zones[0], capacity_type="on-demand",
+                used=full)])
+        plan = ffd_oracle(p)
+        assert tx.code_of(plan.unschedulable["p1"]) == tx.NO_EXISTING_FIT
+
+    def test_oracle_single_bin_full(self, lattice):
+        # hostname self-affinity co-locates every replica; more replicas
+        # than the biggest node holds ⇒ overflow is single-bin-full
+        pods = [Pod(name=f"s{i}", labels={"app": "a"},
+                    requests={"cpu": "16", "memory": "4Gi"},
+                    pod_affinity=[PodAffinityTerm(
+                        topology_key=wk.LABEL_HOSTNAME,
+                        label_selector=(("app", "a"),))])
+                for i in range(12)]
+        p = build_problem(pods, [NodePool(name="default")], lattice)
+        plan = ffd_oracle(p)
+        assert plan.unschedulable
+        assert {tx.code_of(r) for r in plan.unschedulable.values()} == \
+            {tx.SINGLE_BIN_FULL}
+
+    def test_device_decode_leftover_is_coded(self, solver, lattice):
+        p = build_problem([_pod(1, shape={"cpu": "10000"})],
+                          [NodePool(name="default")], lattice)
+        plan = solver.solve(p)
+        code = tx.code_of(plan.unschedulable["p1"])
+        assert code in (tx.NO_FIT, tx.NO_NEW_NODE_SHAPE)
+
+    def test_relaxation_skips_unknown_resource_rounds(self, solver,
+                                                      lattice):
+        plan = solver.solve_relaxed(
+            [_pod(1, shape={"cpu": "1", "bogus.io/widget": "1"})],
+            [NodePool(name="default")], lattice)
+        assert tx.code_of(plan.unschedulable["p1"]) == tx.UNKNOWN_RESOURCE
+
+
+# ---------------------------------------------------------------------------
+# pass explanation + audit ring
+
+
+class TestAuditRing:
+    def _pass(self, solver, lattice, pods, pass_id=1):
+        p = build_problem(pods, [NodePool(name="default")], lattice,
+                          explain=True)
+        plan = solver.solve(p)
+        return ex.explain_pass(p, plan, pass_id, f"trace{pass_id}", 123.0)
+
+    def test_outcomes_and_eliminations(self, solver, lattice):
+        expl = self._pass(solver, lattice, [
+            _pod(1, node_selector={wk.LABEL_INSTANCE_TYPE: "m5.large"}),
+            _pod(2, shape={"cpu": "10000"})])
+        assert expl.pods == 2 and expl.groups_total == 2
+        assert expl.unschedulable_total == 1
+        assert tx.code_of(expl.unschedulable["p2"]) == tx.NO_OFFERING
+        assert expl.reason_counts == {tx.NO_OFFERING: 1}
+        assert expl.eliminations.get("requirements", 0) > 0
+        # the unplaced group sorts first and the pod maps to it
+        gi = expl.pod_group["p2"]
+        assert expl.groups[gi].unplaced == 1
+        assert expl.groups[gi].code == tx.NO_OFFERING
+
+    def test_ring_lookups_and_stats(self, solver, lattice):
+        ring = ex.DecisionAuditRing(size=2)
+        for i in range(3):
+            ring.record(self._pass(
+                solver, lattice,
+                [_pod(1, shape={"cpu": "10000"})], pass_id=i + 1))
+        assert ring.passes_recorded == 3
+        st = ring.stats()
+        assert st["ring"] == 2.0 and st["last_pass"] == 3.0
+        assert st["reason_no_offering"] == 3.0
+        assert any(k.startswith("elim_") for k in st)
+        # pod lookup renders the newest pass's ledger
+        doc = ring.find_pod("p1")
+        assert doc["pass"] == 3 and doc["code"] == tx.NO_OFFERING
+        assert doc["group"]["stages"][0]["stage"] == "offered"
+        assert ring.find_pass(2).trace_id == "trace2"
+        assert ring.find_pass() is ring.find_pass(3)
+        assert ring.find_pod("nobody") is None
+
+    def test_claim_rationale_and_placements(self, solver, lattice):
+        p = build_problem([_pod(1)], [NodePool(name="default")], lattice,
+                          explain=True)
+        plan = solver.solve(p)
+        expl = ex.explain_pass(p, plan, 1, "t", 0.0)
+        node = plan.new_nodes[0]
+        ex.add_claim(expl, "default-00001", node,
+                     runner_up=("m5.xlarge", node.price_per_hour + 0.5))
+        ring = ex.DecisionAuditRing()
+        ring.record(expl)
+        doc = ring.find_claim("default-00001")
+        r = doc["rationale"]
+        assert r["instanceType"] == node.instance_type
+        assert r["runnerUpType"] == "m5.xlarge"
+        assert r["runnerUpPriceDelta"] == pytest.approx(0.5)
+        pod_doc = ring.find_pod("p1")
+        assert pod_doc["outcome"] == "scheduled"
+        assert pod_doc["node"] == "default-00001"
+        assert pod_doc["rationale"]["instanceType"] == node.instance_type
+
+    def test_split_groups_sharing_a_signature_attribute_correctly(self):
+        """Topology splits produce multiple PodGroups with ONE signature;
+        pod→group attribution must key on group index, never signature
+        (review regression: the ICE'd split's pod rendered the healthy
+        split's waterfall)."""
+        rows = (ex.StageRow("offered", 10, 0),)
+        led_ok = ex.GroupLedger(label="a", signature="SIG", pods=2,
+                                stages=rows)
+        led_bad = ex.GroupLedger(
+            label="a", signature="SIG", pods=1,
+            stages=(ex.StageRow("offered", 10, 0),
+                    ex.StageRow("ice", 0, 10)))
+
+        class G:
+            def __init__(self, names, led):
+                self.pod_names = names
+                self.ledger = led
+
+        class P:
+            groups = [G(["a1", "a2"], led_ok), G(["b1"], led_bad)]
+            dropped_groups = []
+
+        class Plan:
+            unschedulable = {"b1": tx.reason(tx.ICE_HOLD)}
+            existing_assignments = {"n1": ["a1", "a2"]}
+            degraded_reason = ""
+
+        expl = ex.explain_pass(P(), Plan(), 1, "t", 0.0)
+        entry = expl.groups[expl.pod_group["b1"]]
+        assert entry.ledger is led_bad and entry.unplaced == 1
+        assert entry.ledger.blame() == "ice"
+
+    def test_add_placements_folds_retry_rounds(self, solver, lattice):
+        p = build_problem([_pod(1)], [NodePool(name="default")], lattice,
+                          explain=True)
+        plan = solver.solve(p)
+        expl = ex.explain_pass(p, plan, 1, "t", 0.0)
+
+        class Retry:
+            existing_assignments = {"node-9": ["late-pod"]}
+
+        ex.add_placements(expl, Retry())
+        assert expl.placements["late-pod"] == "node-9"
+        # idempotent: re-folding the same plan double-counts nothing
+        n = expl.placements_total
+        ex.add_placements(expl, Retry())
+        assert expl.placements_total == n
+
+    def test_doc_query_shapes(self, solver, lattice):
+        ring = ex.DecisionAuditRing()
+        ring.record(self._pass(solver, lattice,
+                               [_pod(1, shape={"cpu": "10000"})]))
+        base = ring.doc({})
+        assert base["recorded"] == 1 and len(base["passes"]) == 1
+        assert base["reasons"] == {tx.NO_OFFERING: 1}
+        assert ring.doc({"pod": ["p1"]})["code"] == tx.NO_OFFERING
+        assert ring.doc({"pod": ["ghost"]})["found"] is False
+        assert ring.doc({"pass": ["1"]})["groupDetails"]
+        assert ring.doc({"pass": ["99"]})["found"] is False
+        assert ring.doc({"nodeclaim": ["x"]})["found"] is False
+
+
+# ---------------------------------------------------------------------------
+# delta-vs-full explanation parity (the tentpole's pinned contract)
+
+
+class TestExplanationParity:
+    def test_delta_ledgers_match_full_rebuild(self, lattice):
+        rng = np.random.default_rng(7)
+        cluster = ClusterState(FakeClock())
+        pools = [NodePool(name="default")]
+        serial = 0
+        for _ in range(60):
+            serial += 1
+            cluster.add_pod(_pod(serial, shape={
+                "cpu": ["250m", "500m", "1"][serial % 3],
+                "memory": "512Mi"}))
+        builder = IncrementalProblemBuilder(explain=True)
+        last_rev = -1
+        incremental_seen = 0
+        for step in range(25):
+            r = rng.random()
+            if r < 0.5:
+                for _ in range(int(rng.integers(1, 4))):
+                    serial += 1
+                    cluster.add_pod(_pod(serial, shape={
+                        "cpu": ["250m", "500m", "1"][serial % 3],
+                        "memory": "512Mi"}))
+            else:
+                pending = cluster.pending_pods()
+                if pending:
+                    cluster.delete_pod(
+                        pending[int(rng.integers(len(pending)))].name)
+            dirty = cluster.dirty_since(last_rev)
+            touched = cluster.touched_pods(dirty.pods)
+            pending = cluster.pending_pods()
+            res = builder.build(pending, pools, lattice,
+                                existing=lambda: [], dirty=dirty,
+                                touched=touched)
+            last_rev = builder.rev
+            incremental_seen += bool(res.incremental)
+            scratch = build_problem(pending, pools, lattice,
+                                    explain=True)
+            got = {g.signature: g.ledger.to_doc()
+                   for g in res.problem.groups + res.problem.dropped_groups}
+            want = {g.signature: g.ledger.to_doc()
+                    for g in scratch.groups + scratch.dropped_groups}
+            assert got == want, f"step {step}: explanation diverged " \
+                                f"(incremental={res.incremental})"
+        assert incremental_seen > 5, \
+            f"only {incremental_seen}/25 steps took the delta path"
+
+    def test_dropped_group_churn_forces_full_rebuild(self, lattice):
+        """A build-time-dropped group's membership changing would leave
+        the retained dropped_groups (and their ledgers) stale — the
+        delta path must stand down (review regression)."""
+        cluster = ClusterState(FakeClock())
+        pools = [NodePool(name="default")]
+        for i in range(5):
+            cluster.add_pod(_pod(i + 1))
+        # two pods in a dropped group (impossible selector)
+        for n in ("drop-1", "drop-2"):
+            cluster.add_pod(Pod(name=n, requests={"cpu": "250m"},
+                                node_selector={
+                                    wk.LABEL_INSTANCE_TYPE: "nope.xl"}))
+        builder = IncrementalProblemBuilder(explain=True)
+        dirty = cluster.dirty_since(-1)
+        res = builder.build(cluster.pending_pods(), pools, lattice,
+                            existing=lambda: [], dirty=dirty,
+                            touched=cluster.touched_pods(dirty.pods))
+        assert res.problem.dropped_groups
+        rev = builder.rev
+        # plain churn still deltas
+        cluster.add_pod(_pod(100))
+        dirty = cluster.dirty_since(rev)
+        res = builder.build(cluster.pending_pods(), pools, lattice,
+                            existing=lambda: [], dirty=dirty,
+                            touched=cluster.touched_pods(dirty.pods))
+        assert res.incremental
+        rev = builder.rev
+        # deleting a dropped-group pod forces the full rebuild
+        cluster.delete_pod("drop-1")
+        dirty = cluster.dirty_since(rev)
+        res = builder.build(cluster.pending_pods(), pools, lattice,
+                            existing=lambda: [], dirty=dirty,
+                            touched=cluster.touched_pods(dirty.pods))
+        assert not res.incremental
+        assert res.reason == "dropped-group-churn"
+        # and the rebuilt dropped ledger reflects the new membership
+        assert [len(g.pod_names)
+                for g in res.problem.dropped_groups] == [1]
+
+
+# ---------------------------------------------------------------------------
+# the provisioning controller: dedup + metrics + ring wiring
+
+
+class TestProvisionerExplain:
+    def _op(self, lattice):
+        from karpenter_provider_aws_tpu.operator import Operator, Options
+        clock = FakeClock()
+        return Operator(options=Options(registration_delay=0.5),
+                        lattice=lattice, cloud=FakeCloud(clock),
+                        clock=clock), clock
+
+    def _ice_family(self, op, lattice, family="c5."):
+        for z in lattice.zones:
+            for ct in lattice.capacity_types:
+                for t in [n for n in lattice.names
+                          if n.startswith(family)]:
+                    op.unavailable.mark_unavailable("test", ct, t, z)
+
+    def test_failed_scheduling_dedup_and_metric(self, lattice):
+        op, clock = self._op(lattice)
+        self._ice_family(op, lattice)
+        op.cluster.add_pod(Pod(
+            name="stuck", requests={"cpu": "500m"},
+            node_selector={"karpenter.k8s.aws/instance-family": "c5"}))
+        for _ in range(3):
+            op.run_once(force_provision=True)
+            clock.step(1.0)
+        evs = [e for e in op.recorder.events(reason="FailedScheduling")
+               if e.object_name == "stuck"]
+        assert len(evs) == 1, [e.message for e in evs]
+        assert tx.code_of(evs[0].message) == tx.ICE_HOLD
+        m = op.metrics.get("karpenter_pods_unschedulable_reasons_total")
+        assert m.value(code=tx.ICE_HOLD) == 3.0   # per-pass, rate-able
+        elim = op.metrics.get(
+            "karpenter_explain_offering_eliminations_total")
+        assert elim.value(stage="ice") > 0
+
+    def test_dedup_rearms_on_code_change_and_progress(self, lattice):
+        op, _ = self._op(lattice)
+        prov = op.provisioner
+        seen = {}
+        prov._publish_failed("x", tx.reason(tx.ICE_HOLD), seen)
+        prov._publish_failed("x", tx.reason(tx.ICE_HOLD), seen)
+        assert len(op.recorder.events(reason="FailedScheduling")) == 1
+        # reason change publishes again
+        prov._publish_failed("x", tx.reason(tx.NO_OFFERING), seen)
+        assert len(op.recorder.events(reason="FailedScheduling")) == 2
+        # progress (not unschedulable this pass) re-arms the pair
+        from karpenter_provider_aws_tpu.controllers.provisioning import (
+            ProvisionResult)
+        prov._finish_pass(ProvisionResult(plan=None), 0, seen_unsched={})
+        prov._publish_failed("x", tx.reason(tx.NO_OFFERING), {})
+        assert len(op.recorder.events(reason="FailedScheduling")) == 3
+
+    def test_recreated_pod_republishes(self, lattice):
+        """A same-name RECREATED pod is a new pod: its failure gets its
+        own event even when the reason code never changed (review
+        regression — object identity re-arms the dedup)."""
+        op, _ = self._op(lattice)
+        prov = op.provisioner
+        pod_a = _pod(1)
+        seen = {}
+        prov._publish_failed("p1", tx.reason(tx.ICE_HOLD), seen, pod=pod_a)
+        prov._publish_failed("p1", tx.reason(tx.ICE_HOLD), seen, pod=pod_a)
+        assert len(op.recorder.events(reason="FailedScheduling")) == 1
+        pod_b = _pod(1)   # recreated: new object, same name
+        prov._publish_failed("p1", tx.reason(tx.ICE_HOLD), seen, pod=pod_b)
+        assert len(op.recorder.events(reason="FailedScheduling")) == 2
+
+    def test_runner_up_prices_against_the_ice_mask(self, lattice):
+        """The claim rationale must never present an ICE'd-out offering
+        as the viable alternative (review regression)."""
+        from karpenter_provider_aws_tpu.solver.solve import PlannedNode
+        op, _ = self._op(lattice)
+        node = PlannedNode(
+            node_pool="default", instance_type="m5.large",
+            zone=lattice.zones[0], capacity_type="on-demand",
+            price_per_hour=0.1, pods=["p1"],
+            feasible_types=("m5.large", "c5.large"),
+            feasible_zones=(lattice.zones[0],),
+            feasible_capacity_types=("on-demand",))
+        ru = op.provisioner._runner_up(node)
+        assert ru is not None and ru[0] == "c5.large"
+        # ICE the runner-up's every offering: no alternative to present
+        for z in lattice.zones:
+            for ct in lattice.capacity_types:
+                op.unavailable.mark_unavailable("t", ct, "c5.large", z)
+        assert op.provisioner._runner_up(node) is None
+
+    def test_ring_records_passes_and_serves_debug_doc(self, lattice):
+        from karpenter_provider_aws_tpu import introspect
+        op, clock = self._op(lattice)
+        self._ice_family(op, lattice)
+        op.cluster.add_pod(Pod(
+            name="stuck", requests={"cpu": "500m"},
+            node_selector={"karpenter.k8s.aws/instance-family": "c5"}))
+        op.cluster.add_pod(Pod(name="fine",
+                               requests={"cpu": "500m", "memory": "1Gi"}))
+        op.run_once(force_provision=True)
+        assert "explain" in introspect.registry().names()
+        assert introspect.explain_ring() is op.provisioner.explain
+        body, ctype = introspect.debug_doc("/debug/explain",
+                                           {"pod": ["stuck"]})
+        doc = json.loads(body)
+        assert ctype == "application/json"
+        assert doc["code"] == tx.ICE_HOLD
+        assert doc["group"]["blame"] == "ice"
+        # the created claim carries a placement rationale
+        claims = op.provisioner.explain.find_pass().claims
+        assert claims and all("instanceType" in r for r in claims.values())
+
+    def test_solve_error_pass_recorded(self, lattice):
+        op, _ = self._op(lattice)
+        op.cluster.add_pod(_pod(1))
+
+        def boom(*a, **kw):
+            raise RuntimeError("device gone")
+        op.provisioner.solver = type("S", (), {
+            "supports_delta": False,
+            "solve_relaxed": staticmethod(boom),
+            "lattice": lattice, "stats": staticmethod(lambda: {})})()
+        op.provisioner._delta_enabled = False
+        res = op.provisioner.provision_once()
+        assert res.degraded and res.pods_unschedulable == 1
+        e = op.provisioner.explain.find_pass()
+        assert e.reason_counts == {tx.SOLVE_ERROR: 1}
+        assert "device gone" in e.note
+
+
+# ---------------------------------------------------------------------------
+# kpctl surfaces
+
+
+class FakeClient:
+    def __init__(self, routes):
+        self.routes = routes
+
+    def request(self, method, path, doc=None, stream=False, raw=False):
+        for prefix, payload in self.routes.items():
+            if path.startswith(prefix):
+                return payload
+        raise AssertionError(f"unexpected request {path}")
+
+
+class TestKpctl:
+    @pytest.fixture(autouse=True)
+    def _tools_path(self, monkeypatch):
+        monkeypatch.syspath_prepend(str(
+            pathlib.Path(__file__).resolve().parent.parent / "tools"))
+
+    def _pod_doc(self):
+        return {
+            "pod": "w3", "pass": 7, "traceId": "abc",
+            "outcome": "unschedulable", "code": "ice-hold",
+            "reason": "ice-hold: all compatible offerings currently "
+                      "unavailable",
+            "group": {"label": "cpu=500m", "pods": 12, "poolsOk": 1,
+                      "poolsTotal": 1, "remaining": 0, "blame": "ice",
+                      "stages": [
+                          {"stage": "offered", "remaining": 150,
+                           "eliminated": 0},
+                          {"stage": "ice", "remaining": 0,
+                           "eliminated": 12,
+                           "examples": ["m5.large/us-east-1a/spot"]}]},
+        }
+
+    def test_explain_pod_renders_waterfall(self, capsys):
+        import kpctl
+        c = FakeClient({"/debug/explain?pod=w3": self._pod_doc()})
+        args = type("A", (), {"what": "pod", "name": "w3"})
+        assert kpctl.cmd_explain(c, args) == 0
+        out = capsys.readouterr().out
+        assert "eliminated by ice: 12 offerings" in out
+        assert "m5.large/us-east-1a/spot" in out
+        assert "ice-hold" in out
+
+    def test_explain_nodeclaim_renders_rationale(self, capsys):
+        import kpctl
+        doc = {"nodeclaim": "default-00001", "pass": 3,
+               "rationale": {"instanceType": "m5.large",
+                             "zone": "us-east-1a",
+                             "capacityType": "spot",
+                             "pricePerHour": 0.03, "pods": 4,
+                             "flexibleTypes": 12,
+                             "runnerUpType": "m5.xlarge",
+                             "runnerUpPricePerHour": 0.05,
+                             "runnerUpPriceDelta": 0.02}}
+        c = FakeClient({"/debug/explain?nodeclaim=": doc})
+        args = type("A", (), {"what": "nodeclaim", "name": "default-00001"})
+        assert kpctl.cmd_explain(c, args) == 0
+        out = capsys.readouterr().out
+        assert "m5.large/us-east-1a/spot" in out
+        assert "Runner-up: m5.xlarge" in out
+
+    def test_explain_missing_pod_exits_1(self, capsys):
+        import kpctl
+        c = FakeClient({"/debug/explain?pod=": {"found": False,
+                                                "message": "not seen"}})
+        args = type("A", (), {"what": "pod", "name": "ghost"})
+        assert kpctl.cmd_explain(c, args) == 1
+
+    def test_top_renders_explain_row(self):
+        import kpctl
+        doc = {"providers": {"explain": {
+            "passes": 12.0, "ring": 12.0, "last_unschedulable": 3.0,
+            "reason_ice_hold": 9.0, "reason_no_fit": 2.0}}}
+        lines = kpctl._render_top(doc, "srv")
+        row = next(line for line in lines if line.startswith("EXPLAIN"))
+        assert "passes 12" in row and "ice-hold 9" in row
+
+    def test_top_without_explain_provider_has_no_row(self):
+        import kpctl
+        lines = kpctl._render_top({"providers": {}}, "srv")
+        assert not any(line.startswith("EXPLAIN") for line in lines)
+
+    def test_describe_pod_reasons_block(self, capsys):
+        import kpctl
+        c = FakeClient({"/debug/explain?pod=w3": self._pod_doc()})
+        kpctl._print_pod_reasons(c, "w3")
+        out = capsys.readouterr().out
+        assert "Reasons:" in out
+        assert "ice-hold" in out
+        assert "Eliminated by:  ice: 12 offerings" in out
+
+    def test_describe_pod_reasons_quiet_on_missing(self, capsys):
+        import kpctl
+        c = FakeClient({"/debug/explain?pod=": {"found": False}})
+        kpctl._print_pod_reasons(c, "ghost")
+        assert capsys.readouterr().out == ""
+
+
+# ---------------------------------------------------------------------------
+# graftlint reason-code rule fixtures live in tests/test_lint.py

@@ -22,7 +22,7 @@ sys.path.insert(0, str(REPO / "tools"))
 from lint import baseline as baseline_mod                    # noqa: E402
 from lint.rules import (ClockRule, DeterminismRule,          # noqa: E402
                         FrozenEnvelopeRule, LockRule, MetricsRule,
-                        PACKAGE, Violation, default_rules)
+                        PACKAGE, ReasonRule, Violation, default_rules)
 from lint.run import run_checks                              # noqa: E402
 import lint.run as lint_run                                  # noqa: E402
 
@@ -290,6 +290,82 @@ class TestMetricsRule:
                 in declared)
 
 
+# ---- rule 6: reason-code discipline ----------------------------------------
+
+class TestReasonRule:
+    DECLARED = {"ice-hold", "no-offering"}
+
+    def rule(self):
+        return ReasonRule(declared=set(self.DECLARED))
+
+    def test_undeclared_reason_literal_flagged(self):
+        src = ("from karpenter_provider_aws_tpu.solver.taxonomy "
+               "import reason\n"
+               "def f():\n"
+               "    return reason('made-up-code', 'detail')\n")
+        vs = check(self.rule(), src)
+        assert len(vs) == 1 and vs[0].call == "made-up-code"
+        assert vs[0].rule == "reason-code"
+        assert "not declared in solver/taxonomy.py" in vs[0].message
+
+    def test_undeclared_code_label_flagged(self):
+        src = "def f(m):\n    m.inc(1, code='bogus')\n"
+        vs = check(self.rule(), src)
+        assert len(vs) == 1 and vs[0].call == "bogus"
+
+    def test_declared_literals_and_variables_clean(self):
+        src = ("from karpenter_provider_aws_tpu.solver import taxonomy\n"
+               "def f(m, c):\n"
+               "    taxonomy.reason('ice-hold', 'x')\n"
+               "    m.inc(1, code='no-offering')\n"
+               "    m.inc(1, code=c)\n")   # dynamic: the runtime assert owns it
+        assert check(self.rule(), src) == []
+
+    def test_alias_cannot_dodge(self):
+        src = ("from karpenter_provider_aws_tpu.solver.taxonomy "
+               "import reason as _r\n"
+               "def f():\n"
+               "    return _r('sneaky', 'x')\n")
+        vs = check(self.rule(), src)
+        assert len(vs) == 1 and vs[0].call == "sneaky"
+
+    def test_taxonomy_module_itself_exempt(self):
+        assert not self.rule().applies_to(
+            f"{PACKAGE}/solver/taxonomy.py")
+
+    def test_collect_declared_reads_taxonomy_py(self):
+        declared = ReasonRule.collect_declared(
+            (REPO / PACKAGE / "solver" / "taxonomy.py").read_text())
+        from karpenter_provider_aws_tpu.solver import taxonomy as tx
+        assert tx.CODES <= declared
+
+    def test_uncoded_sentinel_is_not_declared(self):
+        """The UNCODED parse-failure sentinel must stay a lint error:
+        reason('uncoded', ...) passes the lint only to crash the runtime
+        assert (review regression)."""
+        declared = ReasonRule.collect_declared(
+            (REPO / PACKAGE / "solver" / "taxonomy.py").read_text())
+        assert "uncoded" not in declared
+        rule = ReasonRule(declared=declared)
+        src = ("from karpenter_provider_aws_tpu.solver.taxonomy "
+               "import reason\n"
+               "def f():\n    return reason('uncoded')\n")
+        assert len(check(rule, src)) == 1
+
+    def test_repo_reason_literals_all_declared(self):
+        """Every reason()/code= literal in the package is declared —
+        the standing lockstep gate, rule-scoped (no baseline traffic)."""
+        rule = [r for r in default_rules(REPO)
+                if r.name == "reason-code"][0]
+        vs = []
+        for py in (REPO / PACKAGE).rglob("*.py"):
+            rel = py.relative_to(REPO).as_posix()
+            if rule.applies_to(rel):
+                src = py.read_text()
+                vs += rule.check_module(ast.parse(src), rel, src)
+        assert vs == [], [str(v) for v in vs]
+
+
 # ---- baseline round-trip ---------------------------------------------------
 
 class TestBaseline:
@@ -380,10 +456,12 @@ class TestRepoGate:
          "    obj['spec']['x'] = 1\n"),
         ("metrics-discipline", "scratch.py",
          SCRATCH_VIOLATIONS["metrics-discipline"]),
+        ("reason-code", "scratch.py",
+         "def f(m):\n    m.inc(1, code='bogus-code')\n"),
     ])
     def test_scratch_violation_fails_the_gate(self, tmp_path, rule, rel,
                                               src):
-        """Re-introducing any of the five rule violations in a scratch
+        """Re-introducing any of the six rule violations in a scratch
         file makes run.py exit non-zero (the acceptance pin)."""
         pkg = tmp_path / PACKAGE
         (pkg / Path(rel).parent).mkdir(parents=True, exist_ok=True)

@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Explain-capture overhead bench (docs/reference/explain.md).
+
+Runs the SAME operator churn loop twice — once with the provisioner's
+incremental builder capturing constraint-elimination ledgers
+(explain=True, the production default) and once with capture off — and
+records the end-to-end per-pass p50 delta. The acceptance bar is the
+PR 7 profiler's bound: < 1% e2e p50 regression from explain capture.
+
+    python tools/bench_explain.py [--pods 4000] [--passes 30] \
+           [--out EXPLAIN_r11_overhead.json]
+
+Both runs share one process and warm JAX compile caches; the measured
+window starts AFTER a warmup pass, and the capture-ON run goes FIRST so
+any residual warm-up cost lands on the explain side (overhead reads as
+an upper bound, the PROF_r08 discipline).
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def run_loop(explain: bool, n_pods: int, n_passes: int) -> dict:
+    from karpenter_provider_aws_tpu.apis import Pod
+    from karpenter_provider_aws_tpu.cloud import FakeCloud
+    from karpenter_provider_aws_tpu.lattice import build_lattice
+    from karpenter_provider_aws_tpu.operator import Operator, Options
+    from karpenter_provider_aws_tpu.solver.incremental import (
+        IncrementalProblemBuilder)
+    from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    op = Operator(options=Options(registration_delay=0.5),
+                  lattice=build_lattice(), cloud=FakeCloud(clock),
+                  clock=clock)
+    op.provisioner.inc_builder = IncrementalProblemBuilder(explain=explain)
+    serial = 0
+    for _ in range(n_pods):
+        serial += 1
+        op.cluster.add_pod(Pod(name=f"b{serial}",
+                               requests={"cpu": "250m", "memory": "512Mi"}))
+    # warmup: the first pass pays compile + cold caches on both sides
+    op.provisioner.provision_once()
+    clock.step(1.0)
+    times = []
+    for i in range(n_passes):
+        # ~1% churn per pass: the steady-state shape the delta path and
+        # the ledger copy-on-write patching actually serve
+        for _ in range(max(n_pods // 100, 1)):
+            serial += 1
+            op.cluster.add_pod(Pod(name=f"b{serial}",
+                                   requests={"cpu": "250m",
+                                             "memory": "512Mi"}))
+        gc.collect()
+        t0 = time.perf_counter()
+        op.provisioner.provision_once()
+        times.append(time.perf_counter() - t0)
+        clock.step(1.0)
+    times.sort()
+    stats = op.provisioner.explain.stats()
+    return {
+        "explain": explain,
+        "passes": n_passes,
+        "e2e_p50_ms": round(times[len(times) // 2] * 1000.0, 3),
+        "e2e_p90_ms": round(times[int(len(times) * 0.9)] * 1000.0, 3),
+        "ring_passes": stats.get("passes", 0),
+        "incremental_builds": op.provisioner.inc_builder.incremental_builds,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pods", type=int, default=4000)
+    ap.add_argument("--passes", type=int, default=30)
+    ap.add_argument("--out", default="EXPLAIN_r11_overhead.json")
+    args = ap.parse_args()
+
+    on = run_loop(True, args.pods, args.passes)
+    off = run_loop(False, args.pods, args.passes)
+    delta_pct = (100.0 * (on["e2e_p50_ms"] - off["e2e_p50_ms"])
+                 / max(off["e2e_p50_ms"], 1e-9))
+    doc = {
+        "bench": "explain_capture_overhead",
+        "pods": args.pods,
+        "capture_on": on, "capture_off": off,
+        "e2e_p50_delta_pct": round(delta_pct, 3),
+        "bound_pct": 1.0,
+        "within_bound": delta_pct < 1.0,
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"explain overhead: on={on['e2e_p50_ms']}ms "
+          f"off={off['e2e_p50_ms']}ms delta={delta_pct:+.2f}% "
+          f"(bound <1%) -> {args.out}")
+    return 0 if doc["within_bound"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

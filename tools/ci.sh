@@ -47,8 +47,15 @@
 #               bodies counted as malformed), and two runs with the
 #               same seed must record identical weather timelines (and
 #               the lock-order witness reports zero cycles at exit)
-#   8. tier-1 — the full non-slow test suite on the CPU backend
-#   9. bench  — `bench.py --smoke`: one fast config through the real
+#   8. explain— decision-explainability gate (tools/smoke_explain.py):
+#               an operator under a short squall with one deliberately
+#               ICE'd-out pod — /debug/explain over live HTTP must
+#               attribute the pending pod to the ice elimination stage,
+#               `kpctl explain pod` must render the waterfall, the
+#               FailedScheduling dedup must hold, and the explain
+#               provider's reason-code histogram must report
+#   9. tier-1 — the full non-slow test suite on the CPU backend
+#  10. bench  — `bench.py --smoke`: one fast config through the real
 #               harness, so a broken solve path can never ride in on a
 #               green unit-test run
 
@@ -60,7 +67,7 @@ PY=${PYTHON:-python}
 FAST=0
 [ "${1:-}" = "--fast" ] && FAST=1
 
-echo "=== ci [1/9] generated-artifact drift ==="
+echo "=== ci [1/10] generated-artifact drift ==="
 $PY tools/gen_crds.py --check
 tmp=$(mktemp -d)
 trap 'rm -rf "$tmp"' EXIT
@@ -75,32 +82,35 @@ done
 [ "$stale" = 0 ] || exit 1
 echo "drift: clean"
 
-echo "=== ci [2/9] graftlint (project-invariant static analysis) ==="
+echo "=== ci [2/10] graftlint (project-invariant static analysis) ==="
 $PY tools/lint/run.py --check
 
-echo "=== ci [3/9] introspection smoke + metrics lint ==="
+echo "=== ci [3/10] introspection smoke + metrics lint ==="
 $PY tools/smoke_introspect.py
 
-echo "=== ci [4/9] steady-state delta churn smoke ==="
+echo "=== ci [4/10] steady-state delta churn smoke ==="
 $PY tools/smoke_delta.py
 
-echo "=== ci [5/9] continuous-profiling smoke ==="
+echo "=== ci [5/10] continuous-profiling smoke ==="
 $PY tools/smoke_profile.py
 
-echo "=== ci [6/9] write-path smoke ==="
+echo "=== ci [6/10] write-path smoke ==="
 $PY tools/smoke_writepath.py
 
-echo "=== ci [7/9] adversarial-weather smoke ==="
+echo "=== ci [7/10] adversarial-weather smoke ==="
 $PY tools/smoke_weather.py
 
-echo "=== ci [8/9] tier-1 tests ==="
+echo "=== ci [8/10] decision-explainability smoke ==="
+$PY tools/smoke_explain.py
+
+echo "=== ci [9/10] tier-1 tests ==="
 $PY -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider
 
 if [ "$FAST" = 1 ]; then
-    echo "=== ci [9/9] bench smoke: SKIPPED (--fast) ==="
+    echo "=== ci [10/10] bench smoke: SKIPPED (--fast) ==="
 else
-    echo "=== ci [9/9] bench smoke ==="
+    echo "=== ci [10/10] bench smoke ==="
     $PY bench.py --smoke
 fi
 

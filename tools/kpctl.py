@@ -380,6 +380,8 @@ def cmd_describe(c: Client, args) -> int:
         return (spec.get("objectName") == args.name
                 and ok and args.kind in (ok + "s", ok + "es"))
 
+    if args.kind == "pods":
+        _print_pod_reasons(c, args.name)
     mine = [e["spec"] for e in events if _matches(e["spec"])]
     print("Events:")
     if not mine:
@@ -390,6 +392,40 @@ def cmd_describe(c: Client, args) -> int:
               _age(e.get("time")), e.get("message", "")] for e in mine]
     _print_rows(rows, indent="  ")
     return 0
+
+
+def _print_pod_reasons(c: Client, name: str) -> None:
+    """The Reasons block of `kpctl describe pod`: the pod's current
+    structured reason code + last elimination summary from the
+    decision-audit ring (docs/reference/explain.md). Quiet against a
+    pre-explain server or an empty ring — describe must keep working."""
+    try:
+        doc = c.request("GET", f"/debug/explain?pod={name}")
+    except (urllib.error.HTTPError, urllib.error.URLError):
+        return
+    if not isinstance(doc, dict) or doc.get("found") is False \
+            or doc.get("enabled") is False or "outcome" not in doc:
+        return
+    print("Reasons:")
+    if doc["outcome"] == "scheduled":
+        print(f"  Outcome:        scheduled -> {doc.get('node', '?')} "
+              f"(pass {doc.get('pass', '?')})")
+        return
+    print(f"  Outcome:        {doc['outcome']} (pass {doc.get('pass', '?')})")
+    print(f"  Code:           {doc.get('code', '')}")
+    print(f"  Reason:         {doc.get('reason', '')}")
+    g = doc.get("group")
+    if g:
+        blame = g.get("blame")
+        elim = next((s for s in reversed(g.get("stages", []))
+                     if s.get("eliminated")), None)
+        if blame and elim is not None:
+            ex = elim.get("examples") or []
+            print(f"  Eliminated by:  {blame}: {elim['eliminated']} "
+                  f"offerings" + (f" (e.g. {ex[0]})" if ex else ""))
+        print(f"  Last summary:   group {g.get('label', '?')} — "
+              f"{g.get('remaining', 0)} offerings remained "
+              f"(kpctl explain pod {name})")
 
 
 _SOLVER_ANN = "karpenter.sh/"   # apis/wellknown.py KARPENTER_PREFIX
@@ -574,6 +610,21 @@ def _render_top(doc, server: str):
     lines.append(
         f"EVENTS    {g('events', 'published'):g} published "
         f"({g('events', 'warnings'):g} warnings)")
+    # the decision-audit ring (docs/reference/explain.md): last pass's
+    # unschedulable count + the top cumulative reason codes
+    ex = p.get("explain", {})
+    if isinstance(ex.get("passes"), (int, float)):
+        top_reasons = sorted(
+            ((k[len("reason_"):].replace("_", "-"), v)
+             for k, v in ex.items()
+             if k.startswith("reason_") and isinstance(v, (int, float))),
+            key=lambda kv: -kv[1])[:3]
+        lines.append(
+            f"EXPLAIN   passes {ex.get('passes', 0):g} "
+            f"(ring {ex.get('ring', 0):g})   "
+            f"last unschedulable {ex.get('last_unschedulable', 0):g}   "
+            + ("reasons " + "  ".join(f"{k} {v:g}" for k, v in top_reasons)
+               if top_reasons else "no unschedulable reasons recorded"))
     if "weather" in p:
         w = p["weather"]
         lines.append(
@@ -870,6 +921,133 @@ def cmd_lockorder(c: Client, args) -> int:
     return 1 if cycles else 0
 
 
+def _render_waterfall(g: dict, indent: str = "  ") -> None:
+    """One group's elimination waterfall (the /debug/explain group doc):
+    stage rows down to 'eliminated by ice: N offerings (...)'."""
+    print(f"{indent}Group:   {g.get('label', '?')}   "
+          f"({g.get('pods', 0)} pods, {g.get('poolsOk', 0)}/"
+          f"{g.get('poolsTotal', 0)} nodepools compatible)")
+    for n in g.get("notes", []):
+        print(f"{indent}Note:    {n}")
+    rows = [["STAGE", "REMAINING", "ELIMINATED", ""]]
+    for s in g.get("stages", []):
+        ex = s.get("examples") or []
+        detail = ""
+        if s.get("eliminated"):
+            detail = (f"eliminated by {s['stage']}: "
+                      f"{s['eliminated']} offerings")
+            if ex:
+                detail += f" (e.g. {', '.join(ex)})"
+        rows.append([s["stage"], str(s["remaining"]),
+                     str(s.get("eliminated", 0)) if s["stage"] != "offered"
+                     else "-", detail])
+    _print_rows(rows, indent=indent)
+
+
+def _render_rationale(r: dict, indent: str = "  ") -> None:
+    line = (f"{r.get('instanceType', '?')}/{r.get('zone', '?')}/"
+            f"{r.get('capacityType', '?')} at "
+            f"${r.get('pricePerHour', 0):g}/hr for {r.get('pods', 0)} "
+            f"pod(s), {r.get('flexibleTypes', 0)} flexible types")
+    print(f"{indent}Chosen:    {line}")
+    if "runnerUpType" in r:
+        print(f"{indent}Runner-up: {r['runnerUpType']} at "
+              f"${r.get('runnerUpPricePerHour', 0):g}/hr "
+              f"({r.get('runnerUpPriceDelta', 0):+g}/hr)")
+
+
+def cmd_explain(c: Client, args) -> int:
+    """The decision-explainability surface (docs/reference/explain.md):
+
+        kpctl explain pod NAME        why is this pod pending — the
+                                      per-stage elimination waterfall
+                                      (or where it was placed, and why)
+        kpctl explain nodeclaim NAME  the claim's placement rationale
+                                      (chosen offering, runner-up,
+                                      price delta)
+        kpctl explain pass [ID]       one pass's full decision audit
+                                      (default: the newest pass)
+    """
+    if args.what in ("pod", "nodeclaim") and not args.name:
+        raise SystemExit(f"kpctl explain {args.what} needs a name")
+    if args.what == "pod":
+        doc = c.request("GET", f"/debug/explain?pod={args.name}")
+        if doc.get("found") is False or doc.get("enabled") is False:
+            print(doc.get("message", f"pod {args.name!r} not found in "
+                                     "the decision-audit ring"))
+            return 1
+        print(f"Pod:     {doc.get('pod')}   (pass {doc.get('pass', '?')}"
+              + (f", trace {doc['traceId']}" if doc.get("traceId") else "")
+              + ")")
+        if doc.get("outcome") == "scheduled":
+            print(f"Outcome: scheduled -> {doc.get('node', '?')}")
+            if doc.get("rationale"):
+                _render_rationale(doc["rationale"])
+            return 0
+        print(f"Outcome: {doc.get('outcome')}")
+        print(f"Reason:  {doc.get('reason', '')}")
+        if doc.get("group"):
+            _render_waterfall(doc["group"])
+        return 0
+    if args.what == "nodeclaim":
+        doc = c.request("GET", f"/debug/explain?nodeclaim={args.name}")
+        if doc.get("found") is False or doc.get("enabled") is False:
+            print(doc.get("message", f"nodeclaim {args.name!r} not found "
+                                     "in the decision-audit ring"))
+            return 1
+        print(f"NodeClaim: {doc.get('nodeclaim')}   "
+              f"(pass {doc.get('pass', '?')}"
+              + (f", trace {doc['traceId']}" if doc.get("traceId") else "")
+              + ")")
+        _render_rationale(doc.get("rationale", {}))
+        return 0
+    # pass
+    q = f"?pass={args.name}" if args.name else ""
+    doc = c.request("GET", f"/debug/explain{q}")
+    if not args.name:
+        passes = doc.get("passes", [])
+        if not passes:
+            print("No passes recorded in the decision-audit ring.")
+            return 1
+        doc = c.request("GET", f"/debug/explain?pass={passes[-1]['pass']}")
+    if doc.get("found") is False or doc.get("enabled") is False:
+        print(f"pass {args.name!r} not in the decision-audit ring")
+        return 1
+    print(f"Pass:          {doc.get('pass')}"
+          + (f"   trace {doc['traceId']}" if doc.get("traceId") else ""))
+    print(f"Pods:          {doc.get('pods', 0)}   "
+          f"groups {doc.get('groups', 0)}   "
+          f"unschedulable {doc.get('unschedulable', 0)}   "
+          f"placements {doc.get('placements', 0)}")
+    if doc.get("degradedReason"):
+        print(f"Degraded:      {doc['degradedReason']}")
+    if doc.get("note"):
+        print(f"Note:          {doc['note']}")
+    reasons = doc.get("reasons", {})
+    if reasons:
+        print("Reasons:       " + "   ".join(
+            f"{k} {v}" for k, v in sorted(reasons.items())))
+    elim = doc.get("eliminations", {})
+    if elim:
+        print("Eliminations:  " + "   ".join(
+            f"{k} {v}" for k, v in sorted(elim.items())))
+    shown = 0
+    for g in doc.get("groupDetails", []):
+        if g.get("unplaced") or g.get("dropped") or shown < 3:
+            print(f"-- {'UNPLACED ' if g.get('unplaced') else ''}"
+                  f"{'(dropped at build) ' if g.get('dropped') else ''}"
+                  f"code={g.get('code', '') or '-'} "
+                  f"placed={g.get('placed', 0)} "
+                  f"unplaced={g.get('unplaced', 0)}")
+            _render_waterfall(g)
+            shown += 1
+    claims = doc.get("claims", {})
+    for name, r in sorted(claims.items()):
+        print(f"-- NodeClaim {name}")
+        _render_rationale(r)
+    return 0
+
+
 def cmd_evict(c: Client, args) -> int:
     force = "?force=1" if args.force else ""
     try:
@@ -965,6 +1143,16 @@ def main(argv=None) -> int:
                     help="also print each edge's first-witness stack "
                          "(cycle edges always print theirs)")
     lo.set_defaults(fn=cmd_lockorder)
+
+    exp = sub.add_parser(
+        "explain", help="why was this decision made — per-pod elimination "
+                        "waterfall, claim placement rationale, pass audit "
+                        "(/debug/explain; docs/reference/explain.md)")
+    exp.add_argument("what", choices=("pod", "nodeclaim", "pass"))
+    exp.add_argument("name", nargs="?", default=None,
+                     help="pod/nodeclaim name, or pass id (default: "
+                          "newest pass)")
+    exp.set_defaults(fn=cmd_explain)
 
     sk = sub.add_parser(
         "soak", help="summarize a soak time-series artifact (local file, "

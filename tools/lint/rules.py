@@ -568,9 +568,89 @@ class MetricsRule(Rule):
         return out
 
 
+# ---- rule 6: reason-code discipline ---------------------------------------
+
+class ReasonRule(Rule):
+    """Every unschedulable reason-code string literal must be DECLARED
+    in solver/taxonomy.py — the bounded enum events, metrics labels,
+    ``NodePlan.unschedulable``, and the sidecar wire all carry
+    (docs/reference/explain.md). Same declaration-lockstep discipline
+    as the metrics rule: an undeclared literal is invisible to
+    ``code_of`` (it parses as "uncoded") and to the docs table.
+
+    Flagged sites: the first argument of any ``reason(...)`` /
+    ``taxonomy.reason(...)`` call (the taxonomy's constructor — the
+    assert there catches it at runtime, this catches it at lint time),
+    and any LITERAL ``code=`` keyword (metric label / explain field).
+    Variables are never flagged — the taxonomy constructor's assert
+    owns the dynamic path."""
+
+    name = "reason-code"
+    TAXONOMY_PY = f"{PACKAGE}/solver/taxonomy.py"
+
+    def __init__(self, declared: Optional[Set[str]] = None):
+        self.declared = declared if declared is not None else set()
+
+    @staticmethod
+    def collect_declared(taxonomy_source: str) -> Set[str]:
+        """Codes declared by solver/taxonomy.py: every module-level
+        ``NAME = "literal"`` string constant assignment — EXCEPT the
+        ``UNCODED`` parse-failure sentinel, which is deliberately not a
+        member of the taxonomy (reason('uncoded', ...) must stay a lint
+        error exactly like any other undeclared literal)."""
+        tree = ast.parse(taxonomy_source)
+        out: Set[str] = set()
+        for node in tree.body:
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str) \
+                    and not any(isinstance(t, ast.Name)
+                                and t.id == "UNCODED"
+                                for t in node.targets):
+                out.add(node.value.value)
+        return out
+
+    def applies_to(self, relpath: str) -> bool:
+        return (relpath.startswith(PACKAGE + "/")
+                and relpath != self.TAXONOMY_PY)
+
+    def check_module(self, tree, relpath, source=""):
+        mods, names = module_aliases(tree)
+        out: List[Violation] = []
+
+        class V(_ContextVisitor):
+            def visit_Call(v, node):
+                d = resolve_call(node.func, mods, names)
+                tail = d.rsplit(".", 1)[-1] if d else None
+                if tail == "reason" and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str) \
+                        and node.args[0].value not in self.declared:
+                    out.append(Violation(
+                        self.name, relpath, node.lineno, v.context,
+                        node.args[0].value,
+                        f"reason code {node.args[0].value!r} is not "
+                        "declared in solver/taxonomy.py — add the "
+                        "constant (and the docs table entry)"))
+                for kw in node.keywords:
+                    if kw.arg == "code" \
+                            and isinstance(kw.value, ast.Constant) \
+                            and isinstance(kw.value.value, str) \
+                            and kw.value.value not in self.declared:
+                        out.append(Violation(
+                            self.name, relpath, node.lineno, v.context,
+                            kw.value.value,
+                            f"code= label literal {kw.value.value!r} is "
+                            "not declared in solver/taxonomy.py"))
+                v.generic_visit(node)
+
+        V().visit(tree)
+        return out
+
+
 def default_rules(repo_root) -> List[Rule]:
-    """The five project rules, wired against the real metrics catalog
-    and docs (run.py's configuration)."""
+    """The six project rules, wired against the real metrics catalog,
+    docs, and reason taxonomy (run.py's configuration)."""
     from pathlib import Path
     root = Path(repo_root)
     declared: Set[str] = set()
@@ -581,6 +661,11 @@ def default_rules(repo_root) -> List[Rule]:
     docs = root / "docs" / "reference" / "metrics.md"
     if docs.exists():
         docs_text = docs.read_text()
+    codes: Set[str] = set()
+    tp = root / PACKAGE / "solver" / "taxonomy.py"
+    if tp.exists():
+        codes = ReasonRule.collect_declared(tp.read_text())
     return [ClockRule(), LockRule(), DeterminismRule(),
             FrozenEnvelopeRule(),
-            MetricsRule(declared=declared, docs_text=docs_text)]
+            MetricsRule(declared=declared, docs_text=docs_text),
+            ReasonRule(declared=codes)]

@@ -637,6 +637,19 @@ def main(argv=None) -> int:
               f"(contended {api_doc.get('contended', 0)}, "
               f"maxWaitMs {api_doc.get('maxWaitMs', 0)}, "
               f"maxHoldMs {api_doc.get('maxHoldMs', 0)})")
+    # the per-pass reason-code histogram (solver/explain.py; the
+    # "explain" provider's reason_* counters ride every monitor sample,
+    # so the artifact embeds the full time series) — the exit report
+    # prints the final tally so a weather run's pending pods are
+    # attributable at a glance
+    ex_stats = op.provisioner.explain.stats()
+    reasons = {k[len("reason_"):].replace("_", "-"): v
+               for k, v in ex_stats.items()
+               if k.startswith("reason_") and v > 0}
+    print(f"soak: explain passes={ex_stats.get('passes', 0):g} "
+          f"reason histogram: "
+          + (" ".join(f"{k}={v:g}" for k, v in sorted(reasons.items()))
+             or "(no unschedulable pods)"))
     if args.warm_start:
         peak = summ.get("peak_latency_burn", 0.0) or 0.0
         if peak >= 2.0:

@@ -314,6 +314,184 @@ def run_sharded_config(make, lattice, solver, iters=5):
     return e2e_p50, detail
 
 
+def config11_200k_sharded():
+    """The 200k-pod mesh-production row (ISSUE 12 acceptance): 4× the
+    north-star pod count, the scale where the per-shard bin tables are
+    what keeps the solve on device at all (a single device's bin-table
+    ceiling is the 8192 bucket; 8 shards split the fleet). Mixed-shape
+    selector waves like cfg5, no accelerator pods (they pin capacity
+    the FFD referee would also pin — the row measures scale, not the
+    narrowing beat)."""
+    from karpenter_provider_aws_tpu.apis import NodePool, Pod
+    from karpenter_provider_aws_tpu.apis import wellknown as wk
+    rng = np.random.default_rng(12)
+    shapes = []
+    for _s in range(32):
+        cpu = int(rng.choice([100, 250, 500, 1000, 2000, 4000]))
+        mem = int(rng.choice([256, 512, 1024, 2048, 4096, 8192]))
+        sel = {}
+        r = rng.random()
+        if r < 0.2:
+            sel[wk.LABEL_INSTANCE_CATEGORY] = str(rng.choice(["m", "c", "r"]))
+        elif r < 0.3:
+            sel[wk.LABEL_CAPACITY_TYPE] = "on-demand"
+        shapes.append(({"cpu": f"{cpu}m", "memory": f"{mem}Mi"}, sel))
+    counts = rng.multinomial(200_000, np.ones(len(shapes)) / len(shapes))
+    pods = []
+    for s, ((req, sel), n) in enumerate(zip(shapes, counts)):
+        pods += [Pod(name=f"xx{s}-{i}", requests=req, node_selector=sel)
+                 for i in range(n)]
+    return pods, _pools_default(), []
+
+
+def run_mesh_scale(make, lattice, solver, iters=3):
+    """The mesh-production scale row: a mesh-native Solver (no per-call
+    mesh argument — the boot-planned mesh IS the path) at 200k pods,
+    refereed for the ≤2% cost envelope against the host FFD oracle of
+    the SAME problem, with conservation asserted and the delta-cache /
+    imbalance evidence recorded."""
+    from karpenter_provider_aws_tpu.solver import build_problem
+
+    pods, pools, existing = make()
+    n_pods = len(pods)
+    problem = build_problem(pods, pools, lattice, existing=existing)
+
+    t_first = time.perf_counter()
+    plan = solver.solve(problem)                      # mesh warmup+compile
+    first_ms = (time.perf_counter() - t_first) * 1000.0
+    placed = sum(len(x.pods) for x in plan.new_nodes) + \
+        sum(len(v) for v in plan.existing_assignments.values())
+    assert placed + len(plan.unschedulable) == n_pods
+
+    e2e_ms = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        problem = build_problem(pods, pools, lattice, existing=existing)
+        plan = solver.solve(problem)
+        e2e_ms.append((time.perf_counter() - t0) * 1000.0)
+    e2e_p50 = float(np.percentile(e2e_ms, 50))
+
+    ref_cost, _, referee = _run_referee(problem)
+    ratio = plan.new_node_cost / ref_cost if ref_cost > 0 else 1.0
+    st = solver.stats()
+    detail = {
+        "pods": n_pods,
+        "groups": problem.G,
+        "mesh_devices": plan.mesh_devices,
+        "new_nodes": plan.num_new_nodes,
+        "unschedulable": len(plan.unschedulable),
+        "e2e_p50_ms": round(e2e_p50, 3),
+        "compile_ms": round(max(first_ms - e2e_p50, 0.0), 3),
+        "pods_per_sec": round(n_pods / (e2e_p50 / 1000.0), 1),
+        "plan_cost_per_hour": round(plan.new_node_cost, 2),
+        "ffd_cost_per_hour": round(ref_cost, 2),
+        "cost_vs_ffd_oracle": round(ratio, 4),
+        "within_envelope": ratio <= 1.02,
+        "referee": referee,
+        "shard_imbalance": st.get("mesh_shard_imbalance", 0.0),
+        "stage_p50_ms": {k: round(v, 3)
+                         for k, v in plan.stage_ms.items()},
+    }
+    return e2e_p50, detail
+
+
+def run_mesh_parity(mesh):
+    """Mesh-vs-single-device plan parity on the capped (full-dissolve)
+    config: every shard's slice under-fills its bin, the merge dissolves
+    them all, and the refinement re-pack must be BYTE-IDENTICAL to the
+    single-device plan — recorded, not just unit-tested
+    (tests/test_mesh.py pins the same claim)."""
+    import json as _json
+
+    from karpenter_provider_aws_tpu.apis import NodePool, Pod, serde
+    from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+    from karpenter_provider_aws_tpu.solver import Solver, build_problem
+
+    big = build_lattice([s for s in build_catalog()
+                         if s.name == "m5.4xlarge"])
+    pods = [Pod(name=f"t{i}", requests={"cpu": "1", "memory": "2Gi"})
+            for i in range(16)]
+    problem = build_problem(pods, [NodePool(name="default")], big)
+    single = Solver(big).solve(problem)
+    meshed = Solver(big, mesh=mesh).solve(problem)
+
+    def canon(p):
+        return _json.dumps(serde.plan_semantic_dict(p), sort_keys=True)
+
+    return {
+        "config": "capped_full_dissolve_16pods_m5.4xlarge",
+        "mesh_devices": meshed.mesh_devices,
+        "byte_identical": canon(meshed) == canon(single),
+        "single_cost_per_hour": round(single.new_node_cost, 2),
+        "mesh_cost_per_hour": round(meshed.new_node_cost, 2),
+    }
+
+
+def run_sharded_artifact(catalog="real", devices=8,
+                         out="MULTICHIP_r06.json"):
+    """The MULTICHIP_r06 recording (`bench.py --sharded`): the 200k-pod
+    mesh row, the mesh-vs-single-device byte-parity row, and the
+    delta-on-mesh steady-state row (cfg10's harness on a mesh-native
+    solver), written as one artifact. main() pins the virtual-CPU mesh
+    sizing unless JAX_PLATFORMS is already exported as a non-cpu
+    backend (export it explicitly to record on real chips); the
+    artifact's "backend" field records which one actually ran."""
+    import jax
+
+    from karpenter_provider_aws_tpu.lattice import build_lattice
+    from karpenter_provider_aws_tpu.parallel import plan_mesh
+    from karpenter_provider_aws_tpu.solver import Solver
+
+    if catalog == "synthetic":
+        lattice, catalog_name = build_lattice(), "synthetic"
+    else:
+        from karpenter_provider_aws_tpu.lattice.realdata import load_catalog
+        path = None if catalog == "real" else catalog
+        lattice = build_lattice(load_catalog(path, require_price=True))
+        catalog_name = "real:" + (catalog if path else "reference")
+
+    mesh_plan = plan_mesh(str(devices))
+    solver = Solver(lattice, mesh=mesh_plan.mesh)
+    doc = {
+        "round": "MULTICHIP_r06",
+        "catalog": catalog_name,
+        "mesh_devices": mesh_plan.devices,
+        "backend": jax.default_backend(),
+        "rows": {},
+    }
+
+    p50, detail = run_mesh_scale(config11_200k_sharded, lattice, solver)
+    doc["rows"]["cfg11_200k_sharded"] = detail
+    print(json.dumps({"metric": "e2e_p50_latency_cfg11_200k_sharded",
+                      "value": round(p50, 3), "unit": "ms",
+                      "detail": detail}), flush=True)
+
+    parity = run_mesh_parity(mesh_plan.mesh)
+    doc["rows"]["mesh_vs_single_device_parity"] = parity
+    print(json.dumps({"metric": "mesh_vs_single_device_parity",
+                      "detail": parity}), flush=True)
+
+    # the delta-on-mesh row: cfg10's steady-state harness, verbatim, on
+    # the mesh-native solver — delta_solves == passes and per-pass
+    # upload bytes ≪ full staging are the acceptance evidence
+    d_p50, d_detail = run_steady_state_config(lattice, solver)
+    d_detail["mesh_devices"] = mesh_plan.devices
+    d_detail["delta_rode_mesh"] = (
+        d_detail["delta_solves"] == d_detail["passes"])
+    doc["rows"]["cfg12_delta_on_mesh"] = d_detail
+    print(json.dumps({"metric": "e2e_p50_latency_cfg12_delta_on_mesh",
+                      "value": round(d_p50, 3), "unit": "ms",
+                      "detail": d_detail}), flush=True)
+
+    ok = (detail["within_envelope"] and parity["byte_identical"]
+          and d_detail["delta_rode_mesh"])
+    doc["acceptance_ok"] = bool(ok)
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    print(f"wrote {out} (acceptance_ok={ok})", flush=True)
+    return 0 if ok else 1
+
+
 def build_bench_problem():
     """Back-compat hook (tests + driver round 1): the config-5 problem."""
     from karpenter_provider_aws_tpu.lattice import build_lattice
@@ -599,14 +777,12 @@ def run_overlap_config(make, lattice, solver, iters=5):
     pods, pools, existing = make()
 
     def canon(plan):
-        d = serde.plan_to_dict(plan)
-        # timings + pipelining provenance legitimately differ between
-        # modes; deviceRetries is link weather (a transient fault in one
-        # mode must not read as a determinism regression)
-        for k in ("solveSeconds", "deviceSeconds", "stageMs", "pipelined",
-                  "deviceRetries"):
-            d.pop(k)
-        return _json.dumps(d, sort_keys=True)
+        # the shared semantic surface (serde.plan_semantic_dict):
+        # timings + pipelining/mesh provenance legitimately differ
+        # between modes, and deviceRetries is link weather — a
+        # transient fault in one mode must not read as a determinism
+        # regression
+        return _json.dumps(serde.plan_semantic_dict(plan), sort_keys=True)
 
     # counter snapshots so the recorded evidence is THIS row's overlap,
     # not the whole bench run's (cfg1-7 also ran pipelined)
@@ -1007,6 +1183,19 @@ def main(argv=None):
                          "synthetic catalog), no Pallas/continuity rows — "
                          "proves the bench harness + solve path end to "
                          "end in well under a minute (tools/ci.sh)")
+    ap.add_argument("--sharded", action="store_true",
+                    help="mesh-production artifact ONLY (MULTICHIP_r06): "
+                         "the 200k-pod 8-way sharded row refereed "
+                         "against the FFD oracle, the mesh-vs-single-"
+                         "device byte-parity row, and the delta-on-mesh "
+                         "steady-state row. Forces the 8-device virtual "
+                         "CPU mesh (the multichip dry-run's sizing) "
+                         "unless JAX_PLATFORMS is already exported as a "
+                         "non-cpu backend — to record on real chips, "
+                         "export JAX_PLATFORMS explicitly; the "
+                         "artifact's \"backend\" field says which ran.")
+    ap.add_argument("--sharded-out", default="MULTICHIP_r06.json",
+                    help="artifact path for --sharded")
     ap.add_argument("--writepath", action="store_true",
                     help="API-stratum write-path row ONLY: per-pod "
                          "write+deliver cost at 1k/15k/50k stored pods x "
@@ -1017,6 +1206,23 @@ def main(argv=None):
 
     if args.writepath:
         raise SystemExit(run_writepath_bench())
+
+    if args.sharded:
+        # BEFORE the first jax import (nothing above here imports it):
+        # size the virtual CPU mesh exactly like the multichip dry-run
+        # unless a real non-cpu backend is configured
+        import os
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        if os.environ["JAX_PLATFORMS"] == "cpu":
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=8"
+                ).strip()
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        raise SystemExit(run_sharded_artifact(catalog=args.catalog,
+                                              out=args.sharded_out))
 
     from karpenter_provider_aws_tpu.lattice import build_lattice
     from karpenter_provider_aws_tpu.solver import Solver

@@ -300,7 +300,35 @@ def plan_to_dict(plan) -> Dict:
         "stageMs": {k: round(float(v), 3)
                     for k, v in plan.stage_ms.items()},
         "pipelined": plan.pipelined,
+        # the mesh that produced this plan (1 = single-device) and its
+        # split's load balance: a RemoteSolver caller sees whether the
+        # sidecar's mesh engaged — and how evenly — exactly like an
+        # in-process controller (docs/reference/sharding.md)
+        "meshDevices": plan.mesh_devices,
+        "shardImbalance": round(float(plan.shard_imbalance), 4),
     }
+
+
+# wire keys that carry timing/provenance rather than plan CONTENT: the
+# byte-identity surface parity checks (mesh-vs-single-device,
+# pipelined-vs-sequential, bench parity rows) compare plans with these
+# stripped. ONE list — a new provenance field added to plan_to_dict
+# joins it here, and every parity site stays in sync automatically.
+# NOTE "warnings" stays IN the compared surface: both sides of every
+# parity pair derive warnings from the same problem, so a path that
+# drops or duplicates them is a real regression the parity must catch.
+_PLAN_PROVENANCE_KEYS = ("solveSeconds", "deviceSeconds", "stageMs",
+                         "pipelined", "deviceRetries", "meshDevices",
+                         "shardImbalance")
+
+
+def plan_semantic_dict(plan) -> Dict:
+    """``plan_to_dict`` minus timing/provenance — the canonical content
+    two solves of the same problem must agree on byte-for-byte."""
+    d = plan_to_dict(plan)
+    for k in _PLAN_PROVENANCE_KEYS:
+        d.pop(k, None)
+    return d
 
 
 def plan_from_dict(d: Mapping):
@@ -330,6 +358,8 @@ def plan_from_dict(d: Mapping):
         device_retries=int(d.get("deviceRetries", 0)),
         stage_ms={k: float(v) for k, v in d.get("stageMs", {}).items()},
         pipelined=bool(d.get("pipelined", False)),
+        mesh_devices=int(d.get("meshDevices", 1)),
+        shard_imbalance=float(d.get("shardImbalance", 0.0)),
     )
 
 # ---- node / nodeclaim / nodeclass / pdb / lease (apiserver wire) -----------

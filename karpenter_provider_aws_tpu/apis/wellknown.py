@@ -79,6 +79,7 @@ ANNOTATION_SOLVER_DEGRADED_REASON = f"{KARPENTER_PREFIX}/solver-degraded-reason"
 ANNOTATION_SOLVER_PIPELINED = f"{KARPENTER_PREFIX}/solver-pipelined"
 ANNOTATION_SOLVER_WAVES = f"{KARPENTER_PREFIX}/solver-waves"
 ANNOTATION_SOLVER_STAGE_MS = f"{KARPENTER_PREFIX}/solver-stage-ms"
+ANNOTATION_SOLVER_MESH_DEVICES = f"{KARPENTER_PREFIX}/solver-mesh-devices"
 TAG_NAME = "Name"
 TAG_NODECLAIM = f"{KARPENTER_PREFIX}/nodeclaim"
 

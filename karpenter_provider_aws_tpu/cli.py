@@ -154,6 +154,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "address (e.g. unix:/run/karpenter/solver.sock or "
                         ":50051) so external controllers can Solve() "
                         "against the resident lattice.")
+    p.add_argument("--mesh", default=None,
+                   help="Device mesh for the sharded solver (env "
+                        "SOLVER_MESH; docs/reference/sharding.md): "
+                        "'auto' (default) uses every device of a real "
+                        "multi-chip backend and stays single-device on "
+                        "the cpu backend (whose device count is the "
+                        "--xla_force_host_platform_device_count dry-run "
+                        "knob, not hardware); an integer N forces an "
+                        "N-way mesh (falling back to the virtual cpu "
+                        "device list, as the multichip dry-run does); "
+                        "'off' pins the single-device path. With a mesh "
+                        "planned, EVERY solve — full, wave-split, and "
+                        "the steady-state delta — runs pod-axis sharded "
+                        "over it.")
     p.add_argument("--solver-address", default=None,
                    help="Delegate provisioning solves to a solver sidecar "
                         "process at this gRPC address (python -m "
@@ -243,6 +257,8 @@ def options_from_args(args: argparse.Namespace) -> Options:
         overrides["termination_grace_period"] = args.termination_grace_period
     if args.solver_address is not None:
         overrides["solver_address"] = args.solver_address
+    if args.mesh is not None:
+        overrides["mesh"] = args.mesh
     if args.compile_cache_dir is not None:
         overrides["compile_cache_dir"] = args.compile_cache_dir
     if args.api_watch_queue_bound is not None:

@@ -614,6 +614,10 @@ class Provisioner:
                 "true" if plan.pipelined else "false",
             wk.ANNOTATION_SOLVER_WAVES: str(plan.waves),
         }
+        if getattr(plan, "mesh_devices", 1) > 1:
+            # the sharded production path: which mesh packed this claim
+            # (absent = single-device; kpctl describe renders the row)
+            ann[wk.ANNOTATION_SOLVER_MESH_DEVICES] = str(plan.mesh_devices)
         if plan.degraded_reason:
             ann[wk.ANNOTATION_SOLVER_DEGRADED_REASON] = plan.degraded_reason
         if plan.stage_ms:

@@ -335,6 +335,22 @@ def wire_core_metrics(reg: Registry) -> Dict[str, _Metric]:
             "Signature groups whose membership changed per delta solve "
             "(the re-tensorized share of the problem).", (),
             buckets=(0, 1, 2, 4, 8, 16, 32, 64)),
+        # the mesh production path (parallel/mesh.py + docs/reference/
+        # sharding.md): device count of the solver's mesh and the last
+        # sharded solve's per-shard load balance. devices == 1 means the
+        # single-device passthrough; imbalance is max/mean per-shard pod
+        # load (1.0 = perfectly balanced; the round-robin whole-group
+        # assignment and shard-0 pinning of need-groups show up here).
+        "solver_mesh_devices": reg.gauge(
+            "karpenter_solver_mesh_devices",
+            "Devices in the solver's production mesh (1 = single-device "
+            "path; >1 = the pod-axis sharded solve carries every pass).",
+            ()),
+        "solver_shard_imbalance": reg.gauge(
+            "karpenter_solver_shard_imbalance_ratio",
+            "Max/mean per-shard pod load of the last sharded solve's "
+            "group split (1.0 = balanced; 0 until a sharded solve runs).",
+            ()),
         "solver_waves": reg.histogram(
             "karpenter_solver_wave_count",
             "Waves per scheduling solve (1 = one device pass; >1 = the "

@@ -230,15 +230,31 @@ class Operator:
             subnets=self.subnet_provider,
             launch_templates=self.launch_template_provider,
             version=self.version_provider), self.metrics)
+        # the mesh decision, once, at boot (parallel/mesh.py plan_mesh;
+        # docs/reference/sharding.md): a real multi-chip backend
+        # auto-meshes, --mesh/SOLVER_MESH forces a shape (the virtual-CPU
+        # dry-run / CI path), and single-device stays the byte-identical
+        # passthrough. The solver then runs EVERY solve — full,
+        # wave-split, and the steady-state delta — over this mesh.
+        from ..parallel.mesh import plan_mesh
+        self.mesh_plan = plan_mesh(self.options.mesh or "auto")
+        if self.mesh_plan.devices > 1:
+            self.log.info("solver mesh planned",
+                          devices=self.mesh_plan.devices,
+                          axis=self.mesh_plan.axis,
+                          source=self.mesh_plan.source)
         if self.options.solver_address:
             # delegate provisioning solves to the accelerator-resident
             # sidecar process; probe_batch and the degradation ladder's
             # local fallback stay on this (fully functional) local Solver
+            # — the fallback rides the same planned mesh
             from ..parallel.sidecar import RemoteSolver
             self.solver = RemoteSolver(self.lattice,
-                                       self.options.solver_address)
+                                       self.options.solver_address,
+                                       mesh=self.mesh_plan.mesh)
         else:
-            self.solver = Solver(self.lattice, clock=self.clock)
+            self.solver = Solver(self.lattice, clock=self.clock,
+                                 mesh=self.mesh_plan.mesh)
         self.provisioner = Provisioner(
             self.cluster, self.solver, self.node_pools, self.cloud_provider,
             self.unavailable, self.recorder, self.clock,
@@ -484,6 +500,14 @@ class Operator:
         self.metrics.gauge("karpenter_cluster_state_pod_count").set(len(self.cluster.pods))
         self.metrics.gauge("karpenter_ice_cache_size").set(
             sum(1 for _ in self.unavailable.entries()))
+        # the mesh surface (docs/reference/sharding.md): device count of
+        # the production mesh + the last sharded solve's load balance,
+        # straight from the solver's lock-free stats snapshot
+        sst = self.solver.stats()
+        self.metrics.gauge("karpenter_solver_mesh_devices").set(
+            float(sst.get("mesh_devices", 1)))
+        self.metrics.gauge("karpenter_solver_shard_imbalance_ratio").set(
+            float(sst.get("mesh_shard_imbalance", 0.0)))
         # pods by phase (the state pump and the provisioner also refresh
         # this between metrics passes) + the rolling SLO burn decision
         self.metrics.get("karpenter_pods_state").replace(

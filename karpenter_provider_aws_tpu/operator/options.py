@@ -73,6 +73,13 @@ class Options:
     # the accelerator-resident sidecar (parallel/sidecar.py RemoteSolver)
     # instead of running in-process; empty = resident in-process solver
     solver_address: str = ""
+    # device mesh for the sharded solver (parallel/mesh.py plan_mesh;
+    # docs/reference/sharding.md). "" or "auto" auto-selects: every
+    # device of a real multi-chip backend, single-device on the cpu
+    # backend (its device count is a dry-run knob, not hardware). An
+    # integer forces an N-way mesh (the virtual-CPU dry-run / CI shape);
+    # "off" pins the single-device path.
+    mesh: str = ""
     # directory for JAX's persistent compilation cache (solver/solve.py
     # enable_persistent_compile_cache): a RESTARTED operator loads its
     # bucket-ladder executables from disk instead of re-paying 20-40 s
@@ -105,6 +112,15 @@ class Options:
             raise ValueError("api_watch_queue_bound must be >= 1")
         if self.api_bookmark_every < 0:
             raise ValueError("api_bookmark_every must be >= 0 (0 disables)")
+        m = (self.mesh or "auto").strip().lower()
+        if m not in ("auto", "off", "none", "single"):
+            try:
+                if int(m) < 1:
+                    raise ValueError
+            except ValueError:
+                raise ValueError(
+                    f"mesh must be 'auto', 'off', or a positive device "
+                    f"count, got {self.mesh!r}")
 
     @staticmethod
     def from_env(**overrides) -> "Options":
@@ -122,6 +138,7 @@ class Options:
             spot_to_spot_consolidation=_env_bool("FEATURE_GATE_SPOT_TO_SPOT", False),
             termination_grace_period=_env("TERMINATION_GRACE_PERIOD", None, float),
             solver_address=_env("SOLVER_ADDRESS", "", str),
+            mesh=_env("SOLVER_MESH", "", str),
             compile_cache_dir=_env("COMPILE_CACHE_DIR", "", str),
             api_watch_queue_bound=_env("API_WATCH_QUEUE_BOUND", 8192, int),
             api_bookmark_every=_env("API_BOOKMARK_EVERY", 256, int),

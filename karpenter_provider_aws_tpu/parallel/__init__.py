@@ -1,8 +1,10 @@
-from .mesh import solver_mesh
-from .sharded import ShardedPack, sharded_pack, split_counts
+from .mesh import MeshPlan, plan_mesh, solver_mesh
+from .sharded import (ShardedPack, shard_groups, sharded_pack,
+                      split_counts)
 
-__all__ = ["RemoteSolver", "ShardedPack", "SolverClient", "SolverService",
-           "serve_sidecar", "solver_mesh", "sharded_pack", "split_counts"]
+__all__ = ["MeshPlan", "RemoteSolver", "ShardedPack", "SolverClient",
+           "SolverService", "plan_mesh", "serve_sidecar", "shard_groups",
+           "solver_mesh", "sharded_pack", "split_counts"]
 
 _SIDECAR = {"RemoteSolver": "RemoteSolver", "SolverClient": "SolverClient",
             "SolverService": "SolverService", "serve_sidecar": "serve"}

@@ -1,18 +1,85 @@
-"""Device mesh plumbing for the sharded solver.
+"""Device-mesh planning for the sharded solver.
 
 The reference scales its scheduling loop with controller concurrency and
 batching windows (SURVEY.md §2.3); the TPU-native scale axis is the pod
 dimension sharded over a `jax.sharding.Mesh` ('pods' axis), with XLA
 collectives (psum / all_gather over ICI) reducing pack results — the
 DP/SP slot of this build. Multi-host extends the same mesh over DCN.
+
+Since PR 12 the mesh is a boot-time decision, not a per-call argument:
+:func:`plan_mesh` resolves the operator's ``--mesh``/``SOLVER_MESH``
+setting against the devices JAX actually sees and hands the resulting
+:class:`MeshPlan` to the Solver, which then runs EVERY solve — full,
+wave-split, and the steady-state delta path — over that mesh
+(docs/reference/sharding.md).
+
+Auto policy: a real multi-chip backend (tpu/gpu with >1 device)
+auto-meshes over every device. The **cpu backend never auto-meshes**:
+its device count is the ``--xla_force_host_platform_device_count``
+dry-run knob, not hardware — 8 virtual devices time-slicing one host
+would make every solve slower, so auto stays single-device there and a
+virtual mesh must be FORCED (``--mesh 8``), exactly how the multichip
+dry-run, the sharded tests, and ``tools/smoke_sharded.py`` run.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from dataclasses import dataclass
+from typing import Optional
 
 import jax
 from jax.sharding import Mesh
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    """A resolved mesh decision: how many devices, why, and the mesh
+    itself (``None`` = the single-device passthrough — the Solver's
+    non-sharded path, byte-identical to the pre-mesh behavior)."""
+
+    devices: int
+    axis: str
+    source: str               # "auto" | "forced" | "single" | "off"
+    mesh: Optional[Mesh]
+
+
+def _single(axis: str, source: str) -> MeshPlan:
+    return MeshPlan(devices=1, axis=axis, source=source, mesh=None)
+
+
+def plan_mesh(spec: Optional[str] = None, axis: str = "pods") -> MeshPlan:
+    """Resolve a mesh spec against the visible devices.
+
+    ``spec``: ``None``/``""``/``"auto"`` auto-selects (all devices of a
+    real multi-chip backend; single-device on cpu — see the module
+    docstring), ``"off"``/``"none"``/``"single"``/``"1"`` pins the
+    single-device passthrough, and an integer string forces an N-way
+    mesh (falling back to the virtual cpu device list when the default
+    backend is short, as ``__graft_entry__.dryrun_multichip`` does).
+    Raises ValueError for an unparseable spec or an unsatisfiable
+    forced device count.
+    """
+    s = (spec or "auto").strip().lower()
+    if s in ("off", "none", "single", "1"):
+        return _single(axis, "off")
+    if s == "auto":
+        devices = jax.devices()
+        if len(devices) <= 1 or jax.default_backend() == "cpu":
+            return _single(axis, "single")
+        return MeshPlan(devices=len(devices), axis=axis, source="auto",
+                        mesh=solver_mesh(len(devices), axis=axis))
+    try:
+        n = int(s)
+    except ValueError:
+        raise ValueError(
+            f"invalid mesh spec {spec!r}: expected 'auto', 'off', or a "
+            "positive device count")
+    if n < 1:
+        raise ValueError(f"mesh device count must be >= 1, got {n}")
+    if n == 1:
+        return _single(axis, "off")
+    return MeshPlan(devices=n, axis=axis, source="forced",
+                    mesh=solver_mesh(n, axis=axis))
 
 
 def solver_mesh(n_devices: Optional[int] = None, axis: str = "pods") -> Mesh:

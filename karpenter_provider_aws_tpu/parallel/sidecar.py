@@ -135,6 +135,10 @@ class SolverService:
             "ok": True,
             "types": lat.T, "zones": lat.Z, "capacityTypes": lat.C,
             "priceVersion": lat.price_version,
+            # the sidecar's mesh shape: a caller (and `kpctl top`
+            # against the sidecar's own introspection) sees whether the
+            # accelerator-resident solve is sharded and how wide
+            "meshDevices": getattr(self.solver, "mesh_devices", 1),
         }).encode()
 
 
@@ -263,9 +267,37 @@ class RemoteSolver(Solver):
     supports_delta = False
 
     def __init__(self, lattice, address: str, timeout: float = 60.0,
-                 pipeline: bool = True):
-        super().__init__(lattice, pipeline=pipeline)
+                 pipeline: bool = True, mesh=None):
+        # the planned mesh applies to the LOCAL fallback ladder; the
+        # sidecar process plans its own (its stats/health report it)
+        super().__init__(lattice, pipeline=pipeline, mesh=mesh)
         self.client = SolverClient(address, timeout=timeout)
+        # the SIDECAR's mesh as observed from returned plans (the wire
+        # carries meshDevices + shardImbalance per plan): the operator's
+        # mesh gauges and kpctl top must describe the process that
+        # actually solves — the sidecar while delegation works, THIS
+        # process's local fallback the moment it doesn't (the
+        # unreachable path resets the observation, so an outage never
+        # keeps advertising a mesh nothing is solving on). Updated
+        # lock-free from each solve — stats() must stay non-blocking,
+        # so no health RPC from the introspection path.
+        self._remote_mesh_devices = 0
+        self._remote_mesh_solves = 0
+        self._remote_mesh_imbalance = 0.0
+
+    def stats(self) -> Dict[str, object]:
+        out = super().stats()
+        # mesh_solves is cumulative EVIDENCE (local fallback + every
+        # sharded plan the sidecar returned) — it never goes backwards
+        out["mesh_solves"] = (out.get("mesh_solves", 0)
+                              + self._remote_mesh_solves)
+        if self._remote_mesh_devices:
+            out["mesh_devices"] = self._remote_mesh_devices
+            # the imbalance of the mesh that actually solved — never the
+            # local fallback's (which has run no sharded solve)
+            out["mesh_shard_imbalance"] = round(
+                self._remote_mesh_imbalance, 4)
+        return out
 
     def _unavailable_entries(self, view) -> List:
         """Recover the ICE'd offerings from a masked lattice view by
@@ -299,6 +331,10 @@ class RemoteSolver(Solver):
                     unavailable=self._unavailable_entries(lattice))
                 sp.set(path=plan.solver_path, degraded=plan.degraded,
                        reason=plan.degraded_reason)
+                self._remote_mesh_devices = plan.mesh_devices
+                self._remote_mesh_imbalance = plan.shard_imbalance
+                if plan.mesh_devices > 1:
+                    self._remote_mesh_solves += 1
                 return plan
             except grpc.RpcError as e:
                 # the sidecar is down/unreachable: the local solver this
@@ -308,6 +344,12 @@ class RemoteSolver(Solver):
                 # tail-retains the trace and operators see WHY
                 sp.set(degraded=True, reason="sidecar-unreachable",
                        error=f"{type(e).__name__}: {e.code() if hasattr(e, 'code') else e}")
+        # delegation failed: the LOCAL solver is what solves now — stop
+        # reporting the unreachable sidecar's mesh shape (stats falls
+        # back to super()'s view until a delegated solve succeeds
+        # again; the cumulative sharded-solve count stays)
+        self._remote_mesh_devices = 0
+        self._remote_mesh_imbalance = 0.0
         plan = super().solve_relaxed(
             pods, node_pools, lattice=lattice, existing=existing,
             daemonset_pods=daemonset_pods, bound_pods=bound_pods,
@@ -342,6 +384,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "the bundled reference data")
     p.add_argument("--no-admission-window", action="store_true",
                    help="serve without the solve-coalescing window")
+    p.add_argument("--mesh", default=None,
+                   help="device mesh for the sharded solve (env "
+                        "SOLVER_MESH; parallel/mesh.py plan_mesh): "
+                        "'auto' (default), an integer device count, or "
+                        "'off' — the sidecar is the accelerator-resident "
+                        "process, so this is where the mesh actually "
+                        "lives in a --solver-address deployment")
     p.add_argument("--trace", action="store_true",
                    help="enable tracing: the Solve handler's span tree "
                         "ships back to callers in the RPC response")
@@ -360,11 +409,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from ..lattice.realdata import load_catalog
         lattice = build_lattice(load_catalog(args.catalog,
                                              require_price=True))
-    solver = Solver(lattice)
+    import os
+
+    from .mesh import plan_mesh
+    mesh_plan = plan_mesh(args.mesh or os.environ.get("SOLVER_MESH", "auto"))
+    solver = Solver(lattice, mesh=mesh_plan.mesh)
     server = serve(solver, args.address,
                    admission_window=not args.no_admission_window)
     print(f"solver sidecar serving on {args.address} "
-          f"(T={lattice.T} Z={lattice.Z} C={lattice.C})", flush=True)
+          f"(T={lattice.T} Z={lattice.Z} C={lattice.C} "
+          f"mesh={mesh_plan.devices})", flush=True)
     stop = threading.Event()
     try:
         signal.signal(signal.SIGINT, lambda *_: stop.set())

@@ -186,5 +186,14 @@ def model() -> DeviceCostModel:
     return _MODEL
 
 
-def shape_key(G: int, B: int) -> str:
+def shape_key(G: int, B: int, mesh_devices: int = 1) -> str:
+    """Cost-model key for one compiled shape. The device count is PART
+    of the key: a mesh-compiled executable is a different program (D-way
+    shard_map + collectives) with a different demonstrated-best compute
+    floor — letting it share the single-device (G,B) entry would pollute
+    the best-demonstrated baseline in both directions and make
+    ``last_vs_model`` read as phantom contention after every mesh↔single
+    transition (PR 12 bugfix; bench rows key the same way)."""
+    if mesh_devices > 1:
+        return f"G{G}_B{B}_D{mesh_devices}"
     return f"G{G}_B{B}"

@@ -31,6 +31,19 @@ the resulting problem to ``Solver.solve_delta`` (solver/solve.py), which
 keeps the fused input buffers device-resident and ships only the dirty
 blocks — together the <20 ms steady-state reconcile path of ROADMAP
 open item 2.
+
+Delta-on-mesh (PR 12, docs/reference/sharding.md): the builder is
+deliberately mesh-AGNOSTIC — the patched problem it produces is the
+same whether one device or eight solve it. The shard-awareness lives
+one layer down: ``solve_delta`` rides the boot-planned mesh, the
+resident input cache keys its entries by device count and pins them
+with the mesh-replicated sharding, and a mesh-shape change invalidates
+the resident state rather than delta-hitting stale shards — so a
+steady-state reconcile stays incremental (dirty blocks over the link,
+never a full re-upload) on a multi-chip deployment exactly as it does
+on one device. The delta-vs-full parity this module pins therefore
+holds per-plan on the mesh too (tests/test_mesh.py; MULTICHIP_r06's
+delta-on-mesh row records it at 20k pods).
 """
 
 from __future__ import annotations

@@ -133,6 +133,16 @@ class ResidentInputCache:
     bounded set of shapes). A mostly-changed buffer (> half the blocks)
     re-uploads whole — the delta machinery must never cost more than the
     thing it replaces.
+
+    ``sharding`` (a jax Sharding, e.g. parallel/sharded.py
+    ``replicated_sharding(mesh)``) pins the resident device copy's
+    placement: a mesh-replicated entry stays replicated across passes,
+    so a steady-state delta solve on an N-way mesh ships each dirty
+    block over the host link once and the on-device scatter applies it
+    under the mesh sharding — an unchanged buffer never re-replicates.
+    Callers key mesh entries by device count (solver/solve.py uses
+    ("g", D, ...)), so a mesh-shape change can never delta-hit a buffer
+    resident under the old mesh.
     """
 
     def __init__(self, max_entries: int = 128, block: int = 4096):
@@ -155,21 +165,22 @@ class ResidentInputCache:
                 "blocks_resident": self.blocks_resident,
                 "bytes_shipped": self.bytes_shipped}
 
-    def upload(self, key: Tuple, buf: np.ndarray) -> jnp.ndarray:
+    def upload(self, key: Tuple, buf: np.ndarray,
+               sharding=None) -> jnp.ndarray:
         total = int(buf.size)
         nblk = -(-total // self._block)
         padded = np.zeros((nblk, self._block), np.uint8)
         padded.reshape(-1)[:total] = buf
         ent = self._entries.get(key)
         if ent is None or ent[0].shape[0] != nblk:
-            dev2d = self._store(key, padded)
+            dev2d = self._store(key, padded, sharding)
             self.misses += 1
             self.bytes_shipped += int(padded.size)
             return dev2d.reshape(-1)[:total]
         prev, dev2d = ent
         changed = np.nonzero((padded != prev).any(axis=1))[0]
         if changed.size > nblk // 2:
-            dev2d = self._store(key, padded)
+            dev2d = self._store(key, padded, sharding)
             self.misses += 1
             self.bytes_shipped += int(padded.size)
             return dev2d.reshape(-1)[:total]
@@ -190,8 +201,10 @@ class ResidentInputCache:
         self.blocks_resident += nblk - int(changed.size)
         return dev2d.reshape(-1)[:total]
 
-    def _store(self, key: Tuple, padded: np.ndarray) -> jnp.ndarray:
-        dev2d = jnp.asarray(padded)
+    def _store(self, key: Tuple, padded: np.ndarray,
+               sharding=None) -> jnp.ndarray:
+        dev2d = (jax.device_put(padded, sharding) if sharding is not None
+                 else jnp.asarray(padded))
         if key in self._entries or len(self._entries) < self._max_entries:
             self._entries[key] = (padded, dev2d)
         # else: admission bypass. A cold key arriving at capacity uploads

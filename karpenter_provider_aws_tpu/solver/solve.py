@@ -134,6 +134,15 @@ class NodePlan:
     # True when the overlapped path produced this plan (async dispatch /
     # double-buffered waves); parity tests prove the bit-identical claim
     pipelined: bool = False
+    # devices in the mesh that produced this plan (1 = single-device).
+    # Rides the Solve wire (serde meshDevices) and the claim provenance
+    # annotation so `kpctl describe nodeclaims` shows whether the mesh
+    # was engaged (docs/reference/sharding.md)
+    mesh_devices: int = 1
+    # max/mean per-shard pod load of this plan's split (0.0 = not
+    # sharded). On the wire so a RemoteSolver caller's imbalance gauge
+    # describes the sidecar that actually solved, not its local fallback
+    shard_imbalance: float = 0.0
 
     @property
     def num_new_nodes(self) -> int:
@@ -308,8 +317,15 @@ class Solver:
     # operator's --solver-address delegation
     supports_delta = True
 
-    def __init__(self, lattice: Lattice, pipeline: bool = True, clock=None):
+    def __init__(self, lattice: Lattice, pipeline: bool = True, clock=None,
+                 mesh=None):
         self.lattice = lattice
+        # the device mesh every solve runs over (parallel/mesh.py
+        # plan_mesh resolves the operator's --mesh/SOLVER_MESH setting at
+        # boot; None = the single-device passthrough). A per-call
+        # ``mesh=`` argument still overrides — tests and the multichip
+        # dry-run force specific shapes that way.
+        self.mesh = mesh
         # the device-retry backoff sleeps on the INJECTED clock: under
         # FakeClock a weather-driven retry steps simulated time instead
         # of stalling the deterministic stratum on a real sleep
@@ -370,12 +386,43 @@ class Solver:
             "delta_dirty_groups": 0,
             "resident_problem_hits": 0,
             "resident_problem_misses": 0,
+            # sharded solves carried by the mesh (full, wave, and delta
+            # passes all count — the "is the mesh engaged?" evidence)
+            "mesh_solves": 0,
         }
+        # max/mean per-shard pod load of the last sharded solve's split
+        # (parallel/sharded.py shard_groups) — the shard-imbalance gauge
+        self._mesh_imbalance = 0.0
+        # mesh-replicated lattice tensors (_mesh_inputs): avail/price
+        # memoized per (mesh, lattice view, price version); alloc is
+        # invariant for this Solver's lifetime so it keys on the mesh
+        # alone — a weather reprice must not re-replicate it
+        self._mesh_consts = None
+        self._mesh_alloc = None
 
     def set_pipeline(self, enabled: bool) -> None:
         """Toggle the overlapped solve path (thread-safe)."""
         with self._solve_lock:
             self.pipeline = bool(enabled)
+
+    @property
+    def mesh_devices(self) -> int:
+        """Devices in the production mesh (1 = single-device path)."""
+        m = self.mesh
+        return int(m.devices.size) if m is not None else 1
+
+    def set_mesh(self, mesh) -> None:
+        """Swap the production mesh (thread-safe). Invalidates the
+        resident input cache AND the replicated lattice memo: entries
+        are keyed by device count, but buffers placed under the old
+        mesh's sharding must never serve a delta against the new shape
+        — a mesh-sized change re-uploads, it never delta-hits stale
+        shards (pinned by tests/test_mesh.py)."""
+        with self._solve_lock:
+            self.mesh = mesh
+            self._mesh_consts = None
+            self._mesh_alloc = None
+            self._resident.invalidate()
 
     def stats(self) -> Dict[str, object]:
         """Introspection snapshot (counter reads only — NEVER takes the
@@ -386,6 +433,14 @@ class Solver:
             "est_cache_entries": len(self._est_cache),
             "b_hint_entries": len(self._b_hint),
             "faults_injected": self.faults is not None,
+            # the mesh surface (docs/reference/sharding.md): device
+            # count of the production mesh (1 = single-device), sharded
+            # solves carried, and the last split's load imbalance —
+            # what `kpctl top`'s SOLVER row and the
+            # karpenter_solver_mesh_devices / _shard_imbalance_ratio
+            # gauges render
+            "mesh_devices": self.mesh_devices,
+            "mesh_shard_imbalance": round(self._mesh_imbalance, 4),
         }
         for k, v in self.pipeline_stats.items():
             out[k] = v
@@ -492,6 +547,35 @@ class Solver:
                 self._price_version = self.lattice.price_version
             return self._avail, self._price
         return jnp.asarray(problem.lattice.available), jnp.asarray(problem.lattice.price)
+
+    def _mesh_inputs(self, problem: Problem, mesh):
+        """(alloc, avail, price) replicated across ``mesh`` — the
+        759-type lattice is the 'weights' of this model: device_put
+        once, resident on every shard across passes, instead of
+        re-replicating ~MBs of lattice per solve. avail/price memoize
+        per (mesh, lattice view, price version) — a pricing refresh
+        (price_version bump) or an ICE-set change (a new masked view
+        object) re-keys exactly those two; alloc is invariant for this
+        Solver's lifetime and keys on the mesh alone, so a weather
+        reprice tick never re-ships it. The memo holds a strong ref to
+        the view so an id() can never be reused stale."""
+        from ..parallel.sharded import replicated_sharding
+        sh = None
+        ma = self._mesh_alloc
+        if ma is None or ma[0] != mesh:
+            sh = replicated_sharding(mesh)
+            ma = (mesh, jax.device_put(np.asarray(self.lattice.alloc), sh))
+            self._mesh_alloc = ma
+        lat = problem.lattice
+        key = (mesh, id(lat), lat.price_version)
+        mc = self._mesh_consts
+        if mc is None or mc[0] != key:
+            sh = sh if sh is not None else replicated_sharding(mesh)
+            mc = (key, lat,
+                  (jax.device_put(np.asarray(lat.available), sh),
+                   jax.device_put(np.asarray(lat.price), sh)))
+            self._mesh_consts = mc
+        return (ma[1],) + mc[2]
 
     # ---- padding ----
 
@@ -1035,6 +1119,11 @@ class Solver:
         pod dimension across devices — the scale-out path for 50k+ pod waves
         (the reference handles this axis with batching windows on one Go
         core; here it is data-parallel over ICI, SURVEY.md §2.3).
+        ``mesh=None`` defaults to the Solver's own production mesh
+        (``self.mesh``, resolved at boot by parallel/mesh.py plan_mesh)
+        — since PR 12 the sharded solve IS the production path when a
+        mesh is planned, and every rung of the ladder (full solve,
+        wave-split, the steady-state delta) rides it.
 
         The degradation ladder (docs/concepts/degradation.md): the primary
         device solve; a group axis past the largest compiled bucket goes
@@ -1046,6 +1135,8 @@ class Solver:
         availability.
         """
         t0 = time.perf_counter()
+        if mesh is None:
+            mesh = self.mesh
         if problem.G == 0:
             return NodePlan([], {}, dict(problem.unschedulable), 0.0,
                             time.perf_counter() - t0, 0.0)
@@ -1081,8 +1172,14 @@ class Solver:
                     # buffers with it (backend restart, OOM eviction); drop
                     # the cache so the retry — and every later solve whose
                     # unchanged inputs would otherwise delta-hit a dead
-                    # buffer — re-uploads instead
+                    # buffer — re-uploads instead. The replicated-lattice
+                    # memo holds device buffers too: left in place, a
+                    # mesh retry would re-dispatch against the same dead
+                    # arrays and turn one transient fault into a
+                    # persistent mesh outage
                     self._resident.invalidate()
+                    self._mesh_consts = None
+                    self._mesh_alloc = None
                 if is_retryable_solver_error(e) and retries < self._DEVICE_RETRIES:
                     retries += 1
                     self._count_degraded("device_retry")
@@ -1125,7 +1222,8 @@ class Solver:
         """
         t0 = time.perf_counter() if t0 is None else t0
         if mesh is not None and mesh.devices.size > 1:
-            return self._solve_sharded(problem, mesh, t0)
+            return self._solve_sharded(problem, mesh, t0, gbuf=gbuf,
+                                       overlap=overlap)
         pipelined = self.pipeline
         stages = StageTimer()
         G = _bucket(problem.G, _G_BUCKETS)
@@ -1325,10 +1423,19 @@ class Solver:
         bounds = [(lo, min(lo + wave, problem.G))
                   for lo in range(0, problem.G, wave)]
         n_waves = len(bounds)
-        # the pod-axis sharded path builds its own sharded uploads —
-        # pre-built single-device group buffers would just be wasted
+        # a multi-device mesh COMPOSES with the wave planner rather than
+        # bypassing it: each wave's fused group buffer is exactly what
+        # the sharded program replicates, so the double-buffered
+        # prefetch (and the resident delta cache, keyed by device count)
+        # rides the mesh unchanged — wave k+1's upload lands inside wave
+        # k's sharded compute window just like the single-device case
         sharded = mesh is not None and int(mesh.devices.size) > 1
-        pipelined = self.pipeline and not sharded
+        D = int(mesh.devices.size) if sharded else 1
+        wave_sharding = None
+        if sharded and self.pipeline:
+            from ..parallel.sharded import replicated_sharding
+            wave_sharding = replicated_sharding(mesh)
+        pipelined = self.pipeline
         stages = StageTimer()
 
         def wave_gbuf(i: int):
@@ -1342,7 +1449,10 @@ class Solver:
                 fnp = self._fused_inputs_np(gp, Gw)
             with stages.span("upload"):
                 if pipelined:
-                    return self._resident.upload(("w", i, Gw, fnp.size), fnp)
+                    # D in the key: a wave buffer resident under one
+                    # mesh shape must never serve another's delta
+                    return self._resident.upload(("w", D, i, Gw, fnp.size),
+                                                 fnp, sharding=wave_sharding)
                 return jnp.asarray(fnp)
 
         A = problem.A
@@ -1458,7 +1568,7 @@ class Solver:
                 f"{n_waves} wave(s) of ≤{wave} groups"],
             degraded=True, degraded_reason="g-overflow",
             solver_path="wave-split", waves=n_waves,
-            stage_ms=stages.ms, pipelined=pipelined)
+            stage_ms=stages.ms, pipelined=pipelined, mesh_devices=D)
 
     def _wave_slice(self, problem: Problem, lo: int, hi: int) -> Problem:
         """Groups [lo, hi) with carry-INDEPENDENT fields only — exactly
@@ -1735,10 +1845,22 @@ class Solver:
 
     MERGE_FILL_THRESHOLD = 0.85  # dissolve new bins filled below this fraction
 
-    def _solve_sharded(self, problem: Problem, mesh, t0: float) -> NodePlan:
-        from ..parallel.sharded import sharded_pack, split_counts
+    def _solve_sharded(self, problem: Problem, mesh, t0: float,
+                       gbuf=None, overlap=None) -> NodePlan:
+        """The mesh production path: pod-axis sharded pack + tail-bin
+        merge, with the SAME pipelining contract as the single-device
+        solve — fused inputs ride the resident delta cache (keyed by
+        device count, so a mesh-shape change can never delta-hit stale
+        shards), the result fetch streams out during host work, and the
+        wave planner's ``overlap`` hook runs inside the sharded compute
+        window. ``gbuf`` is an already-uploaded (replicated) fused
+        group+pool buffer from the wave prefetch."""
+        from ..parallel.sharded import (replicated_sharding, shard_groups,
+                                        sharded_pack, split_counts)
 
         D = int(mesh.devices.size)
+        pipelined = self.pipeline
+        stages = StageTimer()
         G = _bucket(problem.G, _G_BUCKETS)
         total_pods = int(problem.count.sum())
         caps = np.minimum(problem.max_per_bin.astype(np.int64),
@@ -1753,8 +1875,20 @@ class Solver:
         B = min(_bucket(max(b_needed, problem.E + 1), _B_BUCKETS, clamp=True),
                 self._b_ceiling())
 
-        fused = self._fused_inputs(problem, G)
-        avail, price = self._device_avail_price(problem)
+        repl = replicated_sharding(mesh) if pipelined else None
+        if gbuf is None:
+            with stages.span("build"):
+                fused_np = self._fused_inputs_np(problem, G)
+            with stages.span("upload"):
+                # ("g", D, G, size) is the mesh-resident whole-problem
+                # entry: a steady-state delta pass block-diffs against it
+                # and ships only dirty group rows over the host link; the
+                # replicated sharding keeps unchanged bytes resident on
+                # every shard (solve_delta counts hit/miss)
+                gbuf = (self._resident.upload(("g", D, G, fused_np.size),
+                                              fused_np, sharding=repl)
+                        if pipelined else jnp.asarray(fused_np))
+        alloc_r, avail, price = self._mesh_inputs(problem, mesh)
 
         count_pad = np.zeros((G,), np.int32)
         count_pad[: problem.G] = problem.count
@@ -1765,23 +1899,55 @@ class Solver:
         keep[: problem.G] = problem.single_bin
         keep |= pin
         count_split = split_counts(count_pad, D, keep_whole=keep, pin_shard0=pin)
+        # per-shard load balance of this split (max/mean; the
+        # karpenter_solver_shard_imbalance_ratio gauge reads it)
+        load = shard_groups(count_split).astype(np.float64)
+        self._mesh_imbalance = (float(load.max() / load.mean())
+                                if load.mean() > 0 else 1.0)
 
         lat = self.lattice
         A = max(problem.A, 1)
         NP = max(problem.NP, 1)
+        overlap_pending = overlap
         while True:
-            init_buf = (jnp.asarray(self._fused_init_np(problem, B))
-                        if problem.E else None)
+            init_buf = None
+            if problem.E:
+                with stages.span("build"):
+                    init_np = self._fused_init_np(problem, B)
+                with stages.span("upload"):
+                    init_buf = (self._resident.upload(
+                        ("i", D, B, init_np.size), init_np, sharding=repl)
+                        if pipelined else jnp.asarray(init_np))
             self._maybe_inject_device_fault()
+            compute_ms0 = stages.ms.get("compute", 0.0)
             td = time.perf_counter()
             try:
                 with self._trace_span("solver.pack_sharded"):
-                    sp = sharded_pack(mesh, self._alloc, avail, price, fused,
-                                      init_buf, problem.E, count_split,
-                                      B, G, lat.T, lat.Z, lat.C, NP, A)
-                    # one fused [D,B+n,W] buffer = one device→host transfer
-                    # for all shards (sync included); host-side unpack stays
-                    # off the device clock
+                    with stages.span("compute"):
+                        sp = sharded_pack(mesh, alloc_r, avail, price, gbuf,
+                                          init_buf, problem.E, count_split,
+                                          B, G, lat.T, lat.Z, lat.C, NP, A)
+                if pipelined:
+                    # stream the stacked per-shard result out the moment
+                    # the collective finishes; host overlap work below
+                    # fills the wait
+                    fetch_async(sp.packed)
+            except SolverError:
+                raise
+            except Exception as e:
+                raise SolverDeviceError(
+                    f"{type(e).__name__}: {e}", cause=e) from e
+            # host-side overlap OUTSIDE the device-error wrap, exactly
+            # like the single-device path: wave k+1's input build must
+            # classify as internal-error, never as device weather
+            if overlap_pending is not None:
+                overlap_pending()
+                overlap_pending = None
+            try:
+                with stages.span("download"):
+                    # one fused [D,B+n,W] buffer = one device→host
+                    # transfer for all shards (sync included); host-side
+                    # unpack stays off the device clock
                     packed = np.asarray(sp.packed)
             except SolverError:
                 raise
@@ -1789,8 +1955,10 @@ class Solver:
                 raise SolverDeviceError(
                     f"{type(e).__name__}: {e}", cause=e) from e
             device_s = time.perf_counter() - td
-            decs = [_unpack_decode_set(packed[d], G, lat.T, lat.Z, lat.C, A)
-                    for d in range(packed.shape[0])]
+            with stages.span("decode"):
+                decs = [_unpack_decode_set(packed[d], G, lat.T, lat.Z,
+                                           lat.C, A)
+                        for d in range(packed.shape[0])]
             leftover = np.stack([dec.leftover for dec in decs])           # [D,G]
             next_open = np.array([dec.next_open for dec in decs])          # [D]
             overflowed = bool(((leftover.sum(axis=1) > 0) & (next_open >= B)).any())
@@ -1809,9 +1977,25 @@ class Solver:
                     f"{int(leftover.sum())} pod(s) left over", axis="B")
             break
 
-        plan = self._decode_sharded(problem, sp, decs, count_split, device_s)
+        with stages.span("decode"):
+            plan = self._decode_sharded(problem, sp, decs, count_split,
+                                        device_s)
         plan.solve_seconds = time.perf_counter() - t0
         plan.warnings = list(problem.warnings)
+        plan.stage_ms = stages.ms
+        plan.pipelined = pipelined
+        plan.mesh_devices = D
+        plan.shard_imbalance = self._mesh_imbalance
+        # the mesh-compiled executable gets its OWN cost-model entry:
+        # shape_key carries the device count, so a sharded solve can
+        # never pollute the single-device (G,B) bucket's
+        # best-demonstrated baseline (or vice versa)
+        costmodel.model().observe_solve(
+            costmodel.shape_key(G, B, mesh_devices=D),
+            stages.ms.get("compute", 0.0) - compute_ms0)
+        self.pipeline_stats["mesh_solves"] += 1
+        if pipelined:
+            self.pipeline_stats["async_solves"] += 1
         return plan
 
     def _stacked_masks(self, decs: List[_DecodeSet], items: List[Tuple[int, int]]):

@@ -1,0 +1,398 @@
+"""The mesh-promoted production path (ISSUE 12 / docs/reference/sharding.md):
+
+- parallel/mesh.py plan_mesh — auto policy (single-device on the cpu
+  backend whose virtual device count is a dry-run knob), forced N-way
+  meshes, the off/1 passthrough, and the flag/env plumbing;
+- the mesh-native Solver: single-device passthrough picks the
+  non-sharded path, a forced 8-way virtual mesh matches the
+  single-device plan BYTE-IDENTICALLY on a capped (full-dissolve)
+  config, the steady-state delta path composes with the mesh
+  (resident hits, dirty-block bytes only), and a mesh-sized shape
+  change invalidates the resident problem cache instead of
+  delta-hitting stale shards;
+- the surfaces: meshDevices on the Solve wire, the claim provenance
+  annotation, the sidecar health doc, the two new gauges, the kpctl
+  SOLVER row, and the (G,B,mesh)-keyed cost model.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.apis import NodePool, Pod, serde
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+from karpenter_provider_aws_tpu.parallel import plan_mesh, shard_groups, split_counts
+from karpenter_provider_aws_tpu.solver import Solver, build_problem
+from karpenter_provider_aws_tpu.solver.solve import NodePlan
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    specs = [s for s in build_catalog() if s.family in ("m5", "c5")]
+    return build_lattice(specs)
+
+
+@pytest.fixture(scope="module")
+def capped_lattice():
+    # one big type only: every shard's slice under-fills its bin, the
+    # merge dissolves ALL shard bins and re-packs the whole problem in
+    # the single-device refinement — the exact-parity shape
+    specs = [s for s in build_catalog() if s.name == "m5.4xlarge"]
+    return build_lattice(specs)
+
+
+def _canon(plan: NodePlan) -> str:
+    """Canonical plan content (serde.plan_semantic_dict — timings and
+    provenance stripped): the byte-identity the mesh-vs-single-device
+    parity claims."""
+    return json.dumps(serde.plan_semantic_dict(plan), sort_keys=True)
+
+
+class TestMeshPlanner:
+    def test_auto_on_cpu_backend_is_single_device(self):
+        """The 8 virtual host-platform devices are a dry-run knob, not
+        hardware: auto must stay single-device on the cpu backend."""
+        plan = plan_mesh("auto")
+        assert plan.devices == 1
+        assert plan.mesh is None
+        assert plan.source == "single"
+        # "" and None spell auto too
+        assert plan_mesh(None).devices == 1
+        assert plan_mesh("").devices == 1
+
+    def test_forced_mesh(self):
+        plan = plan_mesh("8")
+        assert plan.devices == 8
+        assert plan.source == "forced"
+        assert plan.mesh is not None
+        assert plan.mesh.devices.size == 8
+        assert plan.mesh.axis_names == ("pods",)
+
+    @pytest.mark.parametrize("spec", ["off", "none", "single", "1", "OFF"])
+    def test_passthrough_specs(self, spec):
+        plan = plan_mesh(spec)
+        assert plan.devices == 1 and plan.mesh is None
+        assert plan.source == "off"
+
+    @pytest.mark.parametrize("spec", ["banana", "0", "-3", "2.5"])
+    def test_invalid_specs(self, spec):
+        with pytest.raises(ValueError):
+            plan_mesh(spec)
+
+    def test_options_validation_and_env(self, monkeypatch):
+        from karpenter_provider_aws_tpu.operator import Options
+        Options(mesh="8").validate()
+        Options(mesh="auto").validate()
+        Options(mesh="off").validate()
+        with pytest.raises(ValueError):
+            Options(mesh="nope").validate()
+        monkeypatch.setenv("SOLVER_MESH", "8")
+        assert Options.from_env().mesh == "8"
+
+    def test_cli_flag(self):
+        from karpenter_provider_aws_tpu.cli import (build_parser,
+                                                    options_from_args)
+        args = build_parser().parse_args(["--mesh", "8"])
+        assert options_from_args(args).mesh == "8"
+        # unset leaves the Options default ("" = auto)
+        args = build_parser().parse_args([])
+        assert options_from_args(args).mesh == ""
+
+    def test_shard_groups_load(self):
+        count = np.array([8, 8, 1, 1], np.int32)
+        keep = np.array([False, False, True, True])
+        split = split_counts(count, 4, keep_whole=keep)
+        load = shard_groups(split)
+        assert load.sum() == count.sum()
+        # split groups give every shard 2; the whole groups round-robin
+        # onto shards 0 and 1, which then carry the imbalance
+        assert load.tolist() == [5, 5, 4, 4]
+        assert load.max() / load.mean() == pytest.approx(10 / 9)
+
+
+class TestMeshNativeSolver:
+    def test_single_device_passthrough(self, lattice):
+        """No mesh planned → the non-sharded path, zero mesh counters."""
+        solver = Solver(lattice)
+        assert solver.mesh_devices == 1
+        pods = [Pod(name=f"p{i}", requests={"cpu": "1", "memory": "2Gi"})
+                for i in range(40)]
+        plan = solver.solve(build_problem(pods, [NodePool(name="default")],
+                                          lattice))
+        assert plan.mesh_devices == 1
+        st = solver.stats()
+        assert st["mesh_devices"] == 1
+        assert st["mesh_solves"] == 0
+
+    def test_mesh_native_solve_engages(self, lattice):
+        solver = Solver(lattice, mesh=plan_mesh("8").mesh)
+        pods = [Pod(name=f"p{i}", requests={"cpu": "1", "memory": "2Gi"})
+                for i in range(200)]
+        problem = build_problem(pods, [NodePool(name="default")], lattice)
+        # NO per-call mesh argument: the production default is the mesh
+        plan = solver.solve(problem)
+        assert plan.mesh_devices == 8
+        st = solver.stats()
+        assert st["mesh_devices"] == 8
+        assert st["mesh_solves"] == 1
+        assert st["mesh_shard_imbalance"] >= 1.0
+
+    def test_forced_mesh_matches_single_device_byte_identically(
+            self, capped_lattice):
+        """The acceptance parity: on the capped (full-dissolve) config
+        the 8-way mesh plan is byte-identical to the single-device plan
+        — not just cost-equal."""
+        pods = [Pod(name=f"t{i}", requests={"cpu": "1", "memory": "2Gi"})
+                for i in range(16)]
+        pools = [NodePool(name="default")]
+        problem = build_problem(pods, pools, capped_lattice)
+        single = Solver(capped_lattice).solve(problem)
+        meshed = Solver(capped_lattice,
+                        mesh=plan_mesh("8").mesh).solve(problem)
+        assert meshed.mesh_devices == 8
+        assert _canon(meshed) == _canon(single)
+
+    def test_delta_on_mesh_stays_resident(self, lattice):
+        """solve_delta rides the mesh: the whole-problem entry goes
+        resident on the first pass, later passes delta-hit and ship
+        only dirty blocks — never a full re-upload."""
+        solver = Solver(lattice, mesh=plan_mesh("8").mesh)
+        # 40 scheduling signatures so the fused buffer spans multiple
+        # delta blocks (a 1-block buffer legitimately re-uploads whole)
+        pods = [Pod(name=f"p{s}-{i}",
+                    requests={"cpu": f"{100 + s * 25}m", "memory": "1Gi"})
+                for s in range(40) for i in range(5)]
+        pools = [NodePool(name="default")]
+        problem = build_problem(pods, pools, lattice)
+        p1 = solver.solve_delta(problem)
+        assert p1.mesh_devices == 8
+        st1 = solver.stats()
+        full_bytes = st1["resident_bytes_shipped"]
+        assert st1["resident_problem_misses"] == 1  # cold entry
+        # an unchanged problem delta-hits with zero new blocks
+        solver.solve_delta(problem)
+        st2 = solver.stats()
+        assert st2["resident_problem_hits"] == 1
+        assert st2["resident_bytes_shipped"] == full_bytes
+        # a small churn (one group's count moves) ships only the dirty
+        # block, never the full staging
+        churned = build_problem(pods[:-3], pools, lattice)
+        p3 = solver.solve_delta(churned, dirty_groups=(39,))
+        st3 = solver.stats()
+        assert st3["resident_problem_hits"] == 2
+        delta_bytes = st3["resident_bytes_shipped"] - full_bytes
+        assert 0 < delta_bytes < full_bytes
+        # and the plans still cover the pending set exactly
+        placed = sum(len(n.pods) for n in p3.new_nodes) + sum(
+            len(v) for v in p3.existing_assignments.values())
+        assert placed + len(p3.unschedulable) == len(pods) - 3
+
+    def test_mesh_shape_change_invalidates_resident_cache(self, lattice):
+        """A mesh-sized shape change must re-upload, never delta-hit
+        buffers resident under the old mesh (stale shards)."""
+        solver = Solver(lattice, mesh=plan_mesh("8").mesh)
+        pods = [Pod(name=f"p{i}", requests={"cpu": "1", "memory": "2Gi"})
+                for i in range(160)]
+        problem = build_problem(pods, [NodePool(name="default")], lattice)
+        solver.solve_delta(problem)
+        solver.solve_delta(problem)
+        assert solver.stats()["resident_problem_hits"] == 1
+        solver.set_mesh(plan_mesh("4").mesh)
+        assert solver.mesh_devices == 4
+        plan = solver.solve_delta(problem)
+        assert plan.mesh_devices == 4
+        st = solver.stats()
+        # the re-shaped pass is a MISS (full re-upload under the new
+        # mesh), not a hit against the 8-way entries
+        assert st["resident_problem_hits"] == 1
+        assert st["resident_problem_misses"] == 2
+
+    def test_device_retry_invalidates_replicated_lattice_memo(
+            self, lattice):
+        """A retryable device fault may have taken the replicated
+        lattice buffers with it (backend restart / OOM eviction): the
+        retry must rebuild them, not re-dispatch against the dead memo
+        — one transient fault must never become a persistent mesh
+        outage."""
+        from karpenter_provider_aws_tpu.solver.faults import FaultInjector
+        solver = Solver(lattice, mesh=plan_mesh("8").mesh)
+        pods = [Pod(name=f"p{i}", requests={"cpu": "1", "memory": "2Gi"})
+                for i in range(60)]
+        problem = build_problem(pods, [NodePool(name="default")], lattice)
+        solver.solve(problem)
+        pre_consts = solver._mesh_consts
+        pre_alloc = solver._mesh_alloc
+        assert pre_consts is not None and pre_alloc is not None
+        solver.inject_faults(FaultInjector(device_errors=1))
+        plan = solver.solve(problem)
+        assert plan.device_retries == 1
+        assert plan.solver_path == "device"   # the retry recovered
+        # both memo halves were dropped and rebuilt for the retry
+        assert solver._mesh_consts is not pre_consts
+        assert solver._mesh_alloc is not pre_alloc
+
+    def test_reprice_rekeys_prices_but_not_alloc(self, lattice):
+        """A weather reprice (price_version bump) must re-replicate
+        avail/price only — the invariant alloc tensor stays resident."""
+        solver = Solver(lattice, mesh=plan_mesh("8").mesh)
+        pods = [Pod(name=f"p{i}", requests={"cpu": "1", "memory": "2Gi"})
+                for i in range(60)]
+        problem = build_problem(pods, [NodePool(name="default")], lattice)
+        solver.solve(problem)
+        pre_consts = solver._mesh_consts
+        pre_alloc = solver._mesh_alloc
+        object.__setattr__(lattice, "price_version",
+                           lattice.price_version + 1)
+        try:
+            solver.solve(problem)
+        finally:
+            object.__setattr__(lattice, "price_version",
+                               lattice.price_version - 1)
+        assert solver._mesh_consts is not pre_consts   # re-keyed
+        assert solver._mesh_alloc is pre_alloc         # stayed resident
+
+    def test_per_call_mesh_still_overrides(self, lattice):
+        """Tests and the multichip dry-run force shapes per call; an
+        explicit mesh= wins over the production default."""
+        solver = Solver(lattice)   # no production mesh
+        pods = [Pod(name=f"p{i}", requests={"cpu": "1", "memory": "2Gi"})
+                for i in range(60)]
+        problem = build_problem(pods, [NodePool(name="default")], lattice)
+        plan = solver.solve(problem, mesh=plan_mesh("8").mesh)
+        assert plan.mesh_devices == 8
+        assert solver.stats()["mesh_solves"] == 1
+
+
+class TestMeshSurfaces:
+    def test_plan_wire_round_trips_mesh_devices(self):
+        plan = NodePlan([], {}, {}, 0.0, 0.0, 0.0, mesh_devices=8,
+                        shard_imbalance=1.25)
+        d = serde.plan_to_dict(plan)
+        assert d["meshDevices"] == 8
+        assert d["shardImbalance"] == 1.25
+        back = serde.plan_from_dict(d)
+        assert back.mesh_devices == 8
+        assert back.shard_imbalance == 1.25
+        # a pre-mesh sidecar's wire doc defaults to 1 / unsharded
+        d.pop("meshDevices")
+        d.pop("shardImbalance")
+        back = serde.plan_from_dict(d)
+        assert back.mesh_devices == 1
+        assert back.shard_imbalance == 0.0
+
+    def test_provenance_annotation(self, lattice):
+        from karpenter_provider_aws_tpu.apis import wellknown as wk
+        from karpenter_provider_aws_tpu.cache.unavailable import (
+            UnavailableOfferings)
+        from karpenter_provider_aws_tpu.cloud import FakeCloud
+        from karpenter_provider_aws_tpu.cloudprovider.cloudprovider import (
+            CloudProvider)
+        from karpenter_provider_aws_tpu.controllers.provisioning import (
+            Provisioner)
+        from karpenter_provider_aws_tpu.state.cluster import ClusterState
+        from karpenter_provider_aws_tpu.utils.clock import FakeClock
+        clock = FakeClock()
+        cloud = FakeCloud(clock)
+        prov = Provisioner(
+            ClusterState(clock), Solver(lattice),
+            {"default": NodePool(name="default")},
+            CloudProvider(lattice, cloud, UnavailableOfferings(clock),
+                          None, clock),
+            UnavailableOfferings(clock), clock=clock)
+        meshed = NodePlan([], {}, {}, 0.0, 0.0, 0.0, mesh_devices=8)
+        ann = prov._provenance_annotations(meshed)
+        assert ann[wk.ANNOTATION_SOLVER_MESH_DEVICES] == "8"
+        # single-device plans stay clean (absent, not "1")
+        single = NodePlan([], {}, {}, 0.0, 0.0, 0.0)
+        assert wk.ANNOTATION_SOLVER_MESH_DEVICES not in \
+            prov._provenance_annotations(single)
+
+    def test_sidecar_health_reports_mesh(self, lattice):
+        from karpenter_provider_aws_tpu.parallel.sidecar import SolverService
+        svc = SolverService(Solver(lattice, mesh=plan_mesh("8").mesh))
+        doc = json.loads(svc.health(b"{}").decode())
+        assert doc["meshDevices"] == 8
+
+    def test_remote_solver_reports_sidecar_mesh(self, lattice, tmp_path):
+        """In a --solver-address deployment the SIDECAR's mesh is the
+        one that solves: the operator-side stats (and so the mesh
+        gauges / kpctl top) must report the mesh observed on returned
+        plans, not the local fallback's (usually meshless) plan."""
+        from karpenter_provider_aws_tpu.parallel.sidecar import (
+            RemoteSolver, serve)
+        sidecar_solver = Solver(lattice, mesh=plan_mesh("8").mesh)
+        addr = f"unix:{tmp_path}/mesh-sidecar.sock"
+        server = serve(sidecar_solver, addr, admission_window=False)
+        try:
+            rs = RemoteSolver(lattice, addr)   # NO local mesh
+            assert rs.stats()["mesh_devices"] == 1   # nothing observed yet
+            pods = [Pod(name=f"p{i}",
+                        requests={"cpu": "1", "memory": "2Gi"})
+                    for i in range(24)]
+            plan = rs.solve_relaxed(pods, [NodePool(name="default")])
+            assert plan.mesh_devices == 8            # rode the wire
+            assert plan.shard_imbalance >= 1.0       # so did the split
+            st = rs.stats()
+            assert st["mesh_devices"] == 8
+            assert st["mesh_solves"] >= 1
+            assert st["mesh_shard_imbalance"] >= 1.0
+        finally:
+            server.stop(grace=None)
+        # the sidecar is GONE: the fallback local solver is what solves
+        # now, and the surface must say so — an outage must never keep
+        # advertising a mesh nothing is solving on (the cumulative
+        # sharded-solve evidence stays)
+        plan = rs.solve_relaxed(pods, [NodePool(name="default")])
+        assert plan.degraded_reason == "sidecar-unreachable"
+        st = rs.stats()
+        assert st["mesh_devices"] == 1               # local fallback
+        assert st["mesh_shard_imbalance"] == 0.0
+        assert st["mesh_solves"] >= 1                # evidence retained
+
+    def test_kpctl_solver_row_renders_mesh(self, monkeypatch):
+        import pathlib
+        monkeypatch.syspath_prepend(str(
+            pathlib.Path(__file__).resolve().parent.parent / "tools"))
+        import kpctl
+        doc = {"providers": {"solver": {"mesh_devices": 8,
+                                        "mesh_solves": 12,
+                                        "pipeline": 1}}}
+        lines = kpctl._render_top(doc, "srv")
+        solver_row = next(l for l in lines if l.startswith("SOLVER"))
+        assert "mesh 8dev" in solver_row
+        assert "(12 sharded)" in solver_row
+
+    def test_cost_model_keys_mesh_separately(self):
+        from karpenter_provider_aws_tpu.solver.costmodel import (
+            DeviceCostModel, shape_key)
+        assert shape_key(64, 512) == "G64_B512"
+        assert shape_key(64, 512, mesh_devices=1) == "G64_B512"
+        assert shape_key(64, 512, mesh_devices=8) == "G64_B512_D8"
+        m = DeviceCostModel()
+        # a fast mesh solve must not become the single-device entry's
+        # best-demonstrated floor (the PR 12 collision bugfix)
+        m.observe_solve(shape_key(64, 512), 40.0)
+        m.observe_solve(shape_key(64, 512, mesh_devices=8), 8.0)
+        shapes = m.summary()["shapes"]
+        assert shapes["G64_B512"]["best_ms"] == 40.0
+        assert shapes["G64_B512_D8"]["best_ms"] == 8.0
+
+    def test_operator_emits_mesh_gauges(self, lattice):
+        from karpenter_provider_aws_tpu.cloud import FakeCloud
+        from karpenter_provider_aws_tpu.operator import Operator, Options
+        from karpenter_provider_aws_tpu.utils.clock import FakeClock
+        clock = FakeClock()
+        op = Operator(options=Options(mesh="8"), lattice=lattice,
+                      cloud=FakeCloud(clock), clock=clock)
+        assert op.mesh_plan.devices == 8
+        assert op.solver.mesh_devices == 8
+        op.emit_gauges()
+        text = op.metrics.render()
+        assert "karpenter_solver_mesh_devices 8.0" in text
+        assert "karpenter_solver_shard_imbalance_ratio" in text
+        # default auto boot on the cpu backend stays single-device
+        op2 = Operator(lattice=lattice, cloud=FakeCloud(clock), clock=clock)
+        assert op2.mesh_plan.devices == 1
+        op2.emit_gauges()
+        assert "karpenter_solver_mesh_devices 1.0" in op2.metrics.render()

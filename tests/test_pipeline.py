@@ -78,12 +78,10 @@ def cfg5_shaped_pods(n=3000):
 
 def canonical(plan) -> str:
     """The plan's byte-comparable identity: everything except wall-clock
-    timings and pipelining provenance (which NAME the path taken and so
-    legitimately differ between the two modes)."""
-    d = serde.plan_to_dict(plan)
-    for k in ("solveSeconds", "deviceSeconds", "stageMs", "pipelined"):
-        d.pop(k)
-    return json.dumps(d, sort_keys=True)
+    timings and path provenance, which NAME the path taken and so
+    legitimately differ between the two modes (one shared key list —
+    serde.plan_semantic_dict — so every parity site stays in sync)."""
+    return json.dumps(serde.plan_semantic_dict(plan), sort_keys=True)
 
 
 def assert_nothing_dropped(plan, n_pods):

@@ -188,6 +188,16 @@ class TestShardedSolve:
         assert not sharded.unschedulable
         assert sharded.new_node_cost <= single.new_node_cost * 1.02
         assert sharded.num_new_nodes == single.num_new_nodes
+        # full-dissolve configs are BYTE-IDENTICAL to the single-device
+        # plan, not just cost-equal (the PR 12 mesh-parity acceptance;
+        # tests/test_mesh.py pins the same claim on the mesh-native path)
+        import json
+        from karpenter_provider_aws_tpu.apis import serde
+
+        def canon(p):
+            return json.dumps(serde.plan_semantic_dict(p), sort_keys=True)
+
+        assert canon(sharded) == canon(single)
 
     def test_weighted_pools_respected(self, lattice, mesh):
         pools = [NodePool(name="default"),

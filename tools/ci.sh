@@ -27,19 +27,27 @@
 #               passes, asserts the incremental build + delta solve
 #               actually engaged (counter > 0) and the plans match the
 #               full-rebuild referee
-#   5. prof   — continuous-profiling gate (tools/smoke_profile.py):
+#   5. sharded— mesh-production-path gate (tools/smoke_sharded.py):
+#               boots the operator on a forced 8-device virtual CPU
+#               mesh (XLA host-platform sizing, as the multichip
+#               dry-run does), drives churn passes, asserts the mesh
+#               engaged (devices > 1 in solver stats, sharded solves
+#               carried passes), the delta path rode the mesh
+#               (delta_solves > 0), and sampled plans match a
+#               single-device referee solve exactly
+#   6. prof   — continuous-profiling gate (tools/smoke_profile.py):
 #               boots an operator with the sampling profiler on, drives
 #               a pass over live HTTP, asserts non-empty folded stacks,
 #               contention counters for every instrumented hot lock,
 #               the gzip negotiation, and the live scrape (with the new
 #               karpenter_lock_wait_seconds family) linting clean
-#   6. write  — API-stratum write-path gate (tools/smoke_writepath.py):
+#   7. write  — API-stratum write-path gate (tools/smoke_writepath.py):
 #               boots an API-mode operator, drives a churn burst through
 #               ApiWriter, asserts the bulk/coalesced write path engaged
 #               (counters > 0), zero fan-out envelope copies, the
 #               watch-fed mirror converging to the store, and the live
 #               /metrics scrape (karpenter_api_* series) linting clean
-#   7. weather— adversarial-weather gate (tools/smoke_weather.py): the
+#   8. weather— adversarial-weather gate (tools/smoke_weather.py): the
 #               60 s `squall` scenario on FakeClock — the degradation
 #               ladder must engage (degraded_total > 0), the SLO burn
 #               must recover below 1.0 after the storm, invariants hold
@@ -47,15 +55,15 @@
 #               bodies counted as malformed), and two runs with the
 #               same seed must record identical weather timelines (and
 #               the lock-order witness reports zero cycles at exit)
-#   8. explain— decision-explainability gate (tools/smoke_explain.py):
+#   9. explain— decision-explainability gate (tools/smoke_explain.py):
 #               an operator under a short squall with one deliberately
 #               ICE'd-out pod — /debug/explain over live HTTP must
 #               attribute the pending pod to the ice elimination stage,
 #               `kpctl explain pod` must render the waterfall, the
 #               FailedScheduling dedup must hold, and the explain
 #               provider's reason-code histogram must report
-#   9. tier-1 — the full non-slow test suite on the CPU backend
-#  10. bench  — `bench.py --smoke`: one fast config through the real
+#  10. tier-1 — the full non-slow test suite on the CPU backend
+#  11. bench  — `bench.py --smoke`: one fast config through the real
 #               harness, so a broken solve path can never ride in on a
 #               green unit-test run
 
@@ -67,7 +75,7 @@ PY=${PYTHON:-python}
 FAST=0
 [ "${1:-}" = "--fast" ] && FAST=1
 
-echo "=== ci [1/10] generated-artifact drift ==="
+echo "=== ci [1/11] generated-artifact drift ==="
 $PY tools/gen_crds.py --check
 tmp=$(mktemp -d)
 trap 'rm -rf "$tmp"' EXIT
@@ -82,35 +90,38 @@ done
 [ "$stale" = 0 ] || exit 1
 echo "drift: clean"
 
-echo "=== ci [2/10] graftlint (project-invariant static analysis) ==="
+echo "=== ci [2/11] graftlint (project-invariant static analysis) ==="
 $PY tools/lint/run.py --check
 
-echo "=== ci [3/10] introspection smoke + metrics lint ==="
+echo "=== ci [3/11] introspection smoke + metrics lint ==="
 $PY tools/smoke_introspect.py
 
-echo "=== ci [4/10] steady-state delta churn smoke ==="
+echo "=== ci [4/11] steady-state delta churn smoke ==="
 $PY tools/smoke_delta.py
 
-echo "=== ci [5/10] continuous-profiling smoke ==="
+echo "=== ci [5/11] sharded mesh smoke ==="
+$PY tools/smoke_sharded.py
+
+echo "=== ci [6/11] continuous-profiling smoke ==="
 $PY tools/smoke_profile.py
 
-echo "=== ci [6/10] write-path smoke ==="
+echo "=== ci [7/11] write-path smoke ==="
 $PY tools/smoke_writepath.py
 
-echo "=== ci [7/10] adversarial-weather smoke ==="
+echo "=== ci [8/11] adversarial-weather smoke ==="
 $PY tools/smoke_weather.py
 
-echo "=== ci [8/10] decision-explainability smoke ==="
+echo "=== ci [9/11] decision-explainability smoke ==="
 $PY tools/smoke_explain.py
 
-echo "=== ci [9/10] tier-1 tests ==="
+echo "=== ci [10/11] tier-1 tests ==="
 $PY -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider
 
 if [ "$FAST" = 1 ]; then
-    echo "=== ci [10/10] bench smoke: SKIPPED (--fast) ==="
+    echo "=== ci [11/11] bench smoke: SKIPPED (--fast) ==="
 else
-    echo "=== ci [10/10] bench smoke ==="
+    echo "=== ci [11/11] bench smoke ==="
     $PY bench.py --smoke
 fi
 

@@ -450,6 +450,9 @@ def _print_solver_provenance(obj) -> None:
     waves = ann.get(_SOLVER_ANN + "solver-waves")
     if waves is not None:
         print(f"  Waves:          {waves}")
+    mesh = ann.get(_SOLVER_ANN + "solver-mesh-devices")
+    if mesh is not None:
+        print(f"  Mesh:           {mesh} devices (pod-axis sharded)")
     reason = ann.get(_SOLVER_ANN + "solver-degraded-reason")
     print(f"  Degraded:       {reason if reason else 'false'}")
     stage_ms = ann.get(_SOLVER_ANN + "solver-stage-ms")
@@ -572,6 +575,8 @@ def _render_top(doc, server: str):
         f"last {_fmt_ms(g('provisioner', 'last_pass_solve_ms', None))} "
         f"({g('provisioner', 'last_pass_pods'):g} pods)   "
         f"pipeline {'on' if g('solver', 'pipeline') else 'off'}   "
+        f"mesh {g('solver', 'mesh_devices', 1):g}dev "
+        f"({g('solver', 'mesh_solves'):g} sharded)   "
         f"async {g('solver', 'async_solves'):g}   "
         f"delta {g('solver', 'delta_solves'):g} "
         f"({g('solver', 'delta_dirty_groups'):g} dirty grp)   "

@@ -180,6 +180,14 @@ def main(argv=None) -> int:
                          "tunnel link') under sustained load: force the "
                          "pipelined path on and FAIL the soak if it never "
                          "engaged (async solves / resident-cache counters)")
+    ap.add_argument("--mesh", default="",
+                    help="device mesh for the sharded production path "
+                         "(docs/reference/sharding.md; '' = auto, an "
+                         "integer forces an N-way mesh — needs the "
+                         "virtual-CPU XLA sizing in the environment, as "
+                         "tools/smoke_sharded.py sets up). Set, the soak "
+                         "FAILS unless sharded solves actually carried "
+                         "passes (mesh_solves > 0)")
     args = ap.parse_args(argv)
     fault_schedule = parse_fault_schedule(args.fault_schedule)
 
@@ -196,6 +204,7 @@ def main(argv=None) -> int:
                                   batch_idle_duration=0.05,
                                   batch_max_duration=0.5,
                                   interruption_queue="soak-q",
+                                  mesh=args.mesh,
                                   compile_cache_dir=args.compile_cache_dir),
                   lattice=lattice, interruption_queue=q,
                   api_server=api_server)
@@ -650,6 +659,24 @@ def main(argv=None) -> int:
           f"reason histogram: "
           + (" ".join(f"{k}={v:g}" for k, v in sorted(reasons.items()))
              or "(no unschedulable pods)"))
+    # the mesh verdict (docs/reference/sharding.md): with a mesh
+    # requested, sharded solves must actually have carried passes — a
+    # planner silently falling back to single-device must not read as a
+    # survived mesh soak
+    sst = op.solver.stats()
+    print(f"soak: mesh devices={sst.get('mesh_devices', 1):g} "
+          f"sharded_solves={sst.get('mesh_solves', 0):g} "
+          f"imbalance={sst.get('mesh_shard_imbalance', 0.0):g}")
+    # same normalization as plan_mesh: only a FORCING spec arms the
+    # gate — "auto" legitimately plans single-device on the cpu backend
+    mesh_spec = (args.mesh or "").strip().lower()
+    if mesh_spec and mesh_spec not in ("auto", "off", "none", "single", "1"):
+        if sst.get("mesh_devices", 1) <= 1 or sst.get("mesh_solves", 0) == 0:
+            print(f"soak: --mesh {args.mesh} requested but the sharded "
+                  "path never carried a pass (mesh_devices="
+                  f"{sst.get('mesh_devices')}, "
+                  f"mesh_solves={sst.get('mesh_solves')})")
+            ok = False
     if args.warm_start:
         peak = summ.get("peak_latency_burn", 0.0) or 0.0
         if peak >= 2.0:

@@ -169,13 +169,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "the steady-state delta — runs pod-axis sharded "
                         "over it.")
     p.add_argument("--solver-address", default=None,
-                   help="Delegate provisioning solves to a solver sidecar "
-                        "process at this gRPC address (python -m "
+                   help="Delegate provisioning solves to a POOL of solver "
+                        "sidecar processes: a comma-separated list of "
+                        "gRPC addresses (python -m "
                         "karpenter_provider_aws_tpu.parallel.sidecar; env "
-                        "SOLVER_ADDRESS). The lattice stays resident next "
-                        "to the accelerator; this process ships pod "
-                        "deltas + the ICE mask and falls back to its "
-                        "local solver if the sidecar is unreachable.")
+                        "SOLVER_ADDRESSES, singular SOLVER_ADDRESS still "
+                        "works). Each endpoint gets a circuit breaker "
+                        "with health-checked half-open probation; solves "
+                        "fail over to the least-loaded healthy endpoint, "
+                        "and the local solver is the final rung only "
+                        "when the whole pool is dark "
+                        "(docs/reference/solver-pool.md).")
+    p.add_argument("--solver-solve-deadline", type=float, default=None,
+                   help="Solve RPC deadline in seconds against pool "
+                        "endpoints (env SOLVER_SOLVE_DEADLINE; 0 = "
+                        "derive from the SLO latency budget x 50, i.e. "
+                        "10 s at the 200 ms bar). A hung sidecar costs "
+                        "at most one deadline before its breaker opens.")
+    p.add_argument("--solver-health-deadline", type=float, default=None,
+                   help="Health/liveness RPC deadline in seconds (env "
+                        "SOLVER_HEALTH_DEADLINE, default 1.0): probes "
+                        "against a hung sidecar answer in about a "
+                        "second instead of a solve timeout.")
     p.add_argument("--duration", type=float, default=0.0,
                    help="Run for this many seconds then exit "
                         "(0 = run until SIGINT/SIGTERM).")
@@ -257,6 +272,10 @@ def options_from_args(args: argparse.Namespace) -> Options:
         overrides["termination_grace_period"] = args.termination_grace_period
     if args.solver_address is not None:
         overrides["solver_address"] = args.solver_address
+    if args.solver_solve_deadline is not None:
+        overrides["solver_solve_deadline"] = args.solver_solve_deadline
+    if args.solver_health_deadline is not None:
+        overrides["solver_health_deadline"] = args.solver_health_deadline
     if args.mesh is not None:
         overrides["mesh"] = args.mesh
     if args.compile_cache_dir is not None:

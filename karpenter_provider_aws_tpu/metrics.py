@@ -316,7 +316,8 @@ def wire_core_metrics(reg: Registry) -> Dict[str, _Metric]:
             "Scheduling passes that left the primary device-solve path, "
             "by degradation rung (path: wave-split | host-ffd | none) and "
             "reason (g-overflow | b-exhausted | device-error | "
-            "internal-error | solve-error).", ("path", "reason")),
+            "internal-error | solve-error | sidecar-hung | "
+            "sidecar-unreachable | pool-exhausted).", ("path", "reason")),
         "solver_device_retries": reg.counter(
             "karpenter_solver_device_retries_total",
             "Transient device-solve failures retried before any fallback "
@@ -351,6 +352,33 @@ def wire_core_metrics(reg: Registry) -> Dict[str, _Metric]:
             "Max/mean per-shard pod load of the last sharded solve's "
             "group split (1.0 = balanced; 0 until a sharded solve runs).",
             ()),
+        # the solver failover pool (parallel/pool.py SolverPool;
+        # docs/reference/solver-pool.md): endpoint count/health, the
+        # cumulative failed-attempt counter, local final-rung solves,
+        # and one breaker-state series per endpoint address. All zero /
+        # absent without --solver-address.
+        "solver_pool_endpoints": reg.gauge(
+            "karpenter_solver_pool_endpoints",
+            "Solver sidecar endpoints configured in the failover pool "
+            "(0 = in-process solver, no pool).", ()),
+        "solver_pool_healthy": reg.gauge(
+            "karpenter_solver_pool_healthy_endpoints",
+            "Pool endpoints whose circuit breaker is closed (routable "
+            "for solves).", ()),
+        "solver_pool_failovers": reg.gauge(
+            "karpenter_solver_pool_failovers",
+            "Cumulative failed endpoint attempts that fell through to "
+            "another endpoint or the local rung (monotonic; mirrored "
+            "from pool stats each gauge pass).", ()),
+        "solver_pool_local_solves": reg.gauge(
+            "karpenter_solver_pool_local_solves",
+            "Cumulative passes the LOCAL solver carried because every "
+            "pool endpoint was dark (degraded_reason=pool-exhausted).",
+            ()),
+        "solver_pool_breaker_state": reg.gauge(
+            "karpenter_solver_pool_breaker_state",
+            "Per-endpoint circuit breaker state (0 = closed, 1 = "
+            "half-open probation, 2 = open).", ("endpoint",)),
         "solver_waves": reg.histogram(
             "karpenter_solver_wave_count",
             "Waves per scheduling solve (1 = one device pass; >1 = the "

@@ -244,14 +244,26 @@ class Operator:
                           axis=self.mesh_plan.axis,
                           source=self.mesh_plan.source)
         if self.options.solver_address:
-            # delegate provisioning solves to the accelerator-resident
-            # sidecar process; probe_batch and the degradation ladder's
-            # local fallback stay on this (fully functional) local Solver
-            # — the fallback rides the same planned mesh
-            from ..parallel.sidecar import RemoteSolver
-            self.solver = RemoteSolver(self.lattice,
-                                       self.options.solver_address,
-                                       mesh=self.mesh_plan.mesh)
+            # delegate provisioning solves to the failover POOL of
+            # accelerator-resident sidecar processes (parallel/pool.py;
+            # docs/reference/solver-pool.md): per-endpoint circuit
+            # breakers on THIS operator's injected clock, solve/health
+            # deadlines split by purpose, least-outstanding failover
+            # routing. probe_batch and the degradation ladder's local
+            # fallback stay on this (fully functional) local Solver —
+            # the fallback rides the same planned mesh, and it solves
+            # only when the whole pool is dark (pool-exhausted).
+            from ..parallel.pool import SolverPool
+            self.solver = SolverPool(
+                self.lattice, self.options.solver_address,
+                clock=self.clock, mesh=self.mesh_plan.mesh,
+                solve_deadline=self.options.solver_solve_deadline or None,
+                health_deadline=self.options.solver_health_deadline,
+                latency_budget_seconds=self.slo.latency_budget_seconds)
+            self.log.info("solver pool configured",
+                          endpoints=len(self.solver.endpoints),
+                          solve_deadline_s=self.solver.solve_deadline,
+                          health_deadline_s=self.solver.health_deadline)
         else:
             self.solver = Solver(self.lattice, clock=self.clock,
                                  mesh=self.mesh_plan.mesh)
@@ -325,6 +337,12 @@ class Operator:
         reg = introspect.registry()
         reg.register("cluster", self.cluster.stats)
         reg.register("solver", self.solver.stats)
+        if hasattr(self.solver, "pool_stats"):
+            # the solver-pool surface (docs/reference/solver-pool.md):
+            # per-endpoint breaker states, failovers, deadlines — the
+            # POOL row in kpctl top and the karpenter_solver_pool_*
+            # gauges read this provider
+            reg.register("solver_pool", self.solver.pool_stats)
         reg.register("provisioner", self.provisioner.stats)
         # the decision-audit ring (solver/explain.py; docs/reference/
         # explain.md): per-pass reason-code histogram + elimination
@@ -508,6 +526,26 @@ class Operator:
             float(sst.get("mesh_devices", 1)))
         self.metrics.gauge("karpenter_solver_shard_imbalance_ratio").set(
             float(sst.get("mesh_shard_imbalance", 0.0)))
+        # the solver-pool surface (parallel/pool.py; docs/reference/
+        # solver-pool.md): endpoint/health/failover gauges plus one
+        # breaker-state gauge per endpoint address — replace() so a
+        # re-configured pool never leaves stale endpoint labels
+        if hasattr(self.solver, "pool_stats"):
+            pst = self.solver.pool_stats()
+            self.metrics.gauge("karpenter_solver_pool_endpoints").set(
+                float(pst.get("endpoints", 0)))
+            self.metrics.gauge(
+                "karpenter_solver_pool_healthy_endpoints").set(
+                float(pst.get("healthy", 0)))
+            self.metrics.gauge("karpenter_solver_pool_failovers").set(
+                float(pst.get("failovers", 0)))
+            self.metrics.gauge("karpenter_solver_pool_local_solves").set(
+                float(pst.get("local_solves", 0)))
+            self.metrics.get(
+                "karpenter_solver_pool_breaker_state").replace(
+                {(addr,): float({"closed": 0, "half-open": 1,
+                                 "open": 2}[state])
+                 for addr, state in self.solver.breaker_states().items()})
         # pods by phase (the state pump and the provisioner also refresh
         # this between metrics passes) + the rolling SLO burn decision
         self.metrics.get("karpenter_pods_state").replace(

@@ -68,11 +68,24 @@ class Options:
     termination_grace_period: Optional[float] = None
     # sim-only knob: seconds between launch and (fake) kubelet registration
     registration_delay: float = 5.0
-    # gRPC address of a solver SIDECAR process (parallel/sidecar.py main).
-    # Set, the operator's provisioning solves ship over the Solve RPC to
-    # the accelerator-resident sidecar (parallel/sidecar.py RemoteSolver)
-    # instead of running in-process; empty = resident in-process solver
+    # gRPC address(es) of solver SIDECAR processes (parallel/sidecar.py
+    # main), COMMA-SEPARATED (env SOLVER_ADDRESSES; the singular
+    # SOLVER_ADDRESS still works). Set, the operator's provisioning
+    # solves ship over the Solve RPC to a failover POOL of
+    # accelerator-resident sidecars (parallel/pool.py SolverPool:
+    # per-endpoint circuit breakers, split solve/health deadlines,
+    # least-outstanding routing, local solve only when the whole pool is
+    # dark — docs/reference/solver-pool.md); empty = resident in-process
+    # solver
     solver_address: str = ""
+    # solve RPC deadline in seconds; 0 = derive from the SLO latency
+    # budget (budget x pool.SOLVE_DEADLINE_MULTIPLIER — 10 s at the
+    # paper's 200 ms bar). The old behavior was a flat 60 s shared with
+    # health probes.
+    solver_solve_deadline: float = 0.0
+    # health/liveness RPC deadline in seconds: a probe against a HUNG
+    # sidecar must answer in about a second, not a solve timeout
+    solver_health_deadline: float = 1.0
     # device mesh for the sharded solver (parallel/mesh.py plan_mesh;
     # docs/reference/sharding.md). "" or "auto" auto-selects: every
     # device of a real multi-chip backend, single-device on the cpu
@@ -110,6 +123,19 @@ class Options:
             raise ValueError("batch windows: need 0 <= idle <= max")
         if self.api_watch_queue_bound < 1:
             raise ValueError("api_watch_queue_bound must be >= 1")
+        if self.solver_address and not [
+                a.strip() for a in self.solver_address.split(",")
+                if a.strip()]:
+            # same normalization parallel/pool.py parse_addresses applies
+            # (kept inline: Options must stay importable without the
+            # solver stack)
+            raise ValueError(
+                f"solver_address: no endpoint in {self.solver_address!r}")
+        if self.solver_solve_deadline < 0:
+            raise ValueError("solver_solve_deadline must be >= 0 "
+                             "(0 = derive from the latency budget)")
+        if self.solver_health_deadline <= 0:
+            raise ValueError("solver_health_deadline must be > 0")
         if self.api_bookmark_every < 0:
             raise ValueError("api_bookmark_every must be >= 0 (0 disables)")
         m = (self.mesh or "auto").strip().lower()
@@ -137,7 +163,14 @@ class Options:
             drift_enabled=_env_bool("FEATURE_GATE_DRIFT", True),
             spot_to_spot_consolidation=_env_bool("FEATURE_GATE_SPOT_TO_SPOT", False),
             termination_grace_period=_env("TERMINATION_GRACE_PERIOD", None, float),
-            solver_address=_env("SOLVER_ADDRESS", "", str),
+            # empty counts as unset on BOTH vars: the deploy template
+            # ships SOLVER_ADDRESSES="" as a placeholder, which must not
+            # shadow an overlay's legacy SOLVER_ADDRESS
+            solver_address=(_env("SOLVER_ADDRESSES", "", str)
+                            or _env("SOLVER_ADDRESS", "", str)),
+            solver_solve_deadline=_env("SOLVER_SOLVE_DEADLINE", 0.0, float),
+            solver_health_deadline=_env("SOLVER_HEALTH_DEADLINE", 1.0,
+                                        float),
             mesh=_env("SOLVER_MESH", "", str),
             compile_cache_dir=_env("COMPILE_CACHE_DIR", "", str),
             api_watch_queue_bound=_env("API_WATCH_QUEUE_BOUND", 8192, int),

@@ -2,19 +2,25 @@ from .mesh import MeshPlan, plan_mesh, solver_mesh
 from .sharded import (ShardedPack, shard_groups, sharded_pack,
                       split_counts)
 
-__all__ = ["MeshPlan", "RemoteSolver", "ShardedPack", "SolverClient",
+__all__ = ["ChaosSidecar", "MeshPlan", "RemoteSolver", "ShardedPack",
+           "SidecarProtocolError", "SolverClient", "SolverPool",
            "SolverService", "plan_mesh", "serve_sidecar", "shard_groups",
            "solver_mesh", "sharded_pack", "split_counts"]
 
-_SIDECAR = {"RemoteSolver": "RemoteSolver", "SolverClient": "SolverClient",
-            "SolverService": "SolverService", "serve_sidecar": "serve"}
+_SIDECAR = {"ChaosSidecar": "ChaosSidecar", "RemoteSolver": "RemoteSolver",
+            "SidecarProtocolError": "SidecarProtocolError",
+            "SolverClient": "SolverClient", "SolverService": "SolverService",
+            "serve_sidecar": "serve"}
 
 
 def __getattr__(name):
-    # lazy: the sidecar pulls in grpc, which must stay optional for the
-    # sharded-solve path (solver/solve.py imports this package on every
-    # multi-chip solve)
+    # lazy: the sidecar/pool pull in grpc, which must stay optional for
+    # the sharded-solve path (solver/solve.py imports this package on
+    # every multi-chip solve)
     if name in _SIDECAR:
         from . import sidecar
         return getattr(sidecar, _SIDECAR[name])
+    if name == "SolverPool":
+        from .pool import SolverPool
+        return SolverPool
     raise AttributeError(name)

@@ -34,6 +34,20 @@ from ..solver.solve import NodePlan, Solver
 _SOLVE = "/karpenter.solver.v1.Solver/Solve"
 _HEALTH = "/karpenter.solver.v1.Solver/Health"
 
+# liveness deadline: the Health RPC answers from the resident lattice
+# (no device work), so ~1 s bounds a probe against a hung process
+HEALTH_TIMEOUT_SECONDS = 1.0
+
+
+class SidecarProtocolError(RuntimeError):
+    """The sidecar ANSWERED, but not with a NodePlan: the connection
+    died after a partial body, or the body failed to decode (garbage
+    JSON back). Distinct from grpc.RpcError — the transport worked —
+    but it classifies exactly the same way at the call site: a sidecar
+    failure that falls through the ladder (breaker failure + failover /
+    local fallback), never a json.JSONDecodeError out of a provisioning
+    pass."""
+
 
 class SolverService:
     """Server-side request handling around a resident Solver.
@@ -190,12 +204,28 @@ class SolverClient:
     and returns a real NodePlan (decoded from the wire)."""
 
     def __init__(self, address: str = "unix:/tmp/karpenter-solver.sock",
-                 timeout: float = 60.0):
+                 timeout: float = 60.0,
+                 health_timeout: float = HEALTH_TIMEOUT_SECONDS):
         self.address = address
-        self._channel = grpc.insecure_channel(address)
+        # bound the channel's OWN reconnect backoff: grpc's default
+        # schedule grows toward 120 s after repeated failures, which
+        # would push the first post-restart connection attempt far past
+        # a 1 s health probe's wait_for_ready window — recovery would be
+        # detected minutes late. ≤500 ms keeps at least one attempt
+        # inside every probe deadline.
+        self._channel = grpc.insecure_channel(address, options=[
+            ("grpc.initial_reconnect_backoff_ms", 250),
+            ("grpc.min_reconnect_backoff_ms", 250),
+            ("grpc.max_reconnect_backoff_ms", 500),
+        ])
         self._solve = self._channel.unary_unary(_SOLVE)
         self._health = self._channel.unary_unary(_HEALTH)
         self.timeout = timeout
+        # liveness must NEVER share the solve deadline: a health probe
+        # against a HUNG sidecar (accepts, stalls) has to answer in ~1 s
+        # so kpctl and the pool's breaker probes are cheap — with the
+        # old shared timeout it stalled a full solve timeout (60 s)
+        self.health_timeout = health_timeout
 
     def solve(self, pods: Sequence, node_pools: Sequence,
               existing: Sequence = (), daemonset_pods: Sequence = (),
@@ -234,7 +264,18 @@ class SolverClient:
             # process boundary (docs/reference/tracing.md wire format)
             req["traceContext"] = tc
         resp = self._solve(json.dumps(req).encode(), timeout=self.timeout)
-        doc = json.loads(resp.decode())
+        # a response that is not a NodePlan document classifies as a
+        # SIDECAR failure (SidecarProtocolError), exactly like an
+        # RpcError: the caller's ladder/pool handles it — a junk body
+        # must never surface as a JSONDecodeError out of a pass
+        try:
+            doc = json.loads(resp.decode())
+            if not isinstance(doc, dict):
+                raise ValueError(f"non-object response ({type(doc).__name__})")
+        except (ValueError, UnicodeDecodeError) as e:
+            raise SidecarProtocolError(
+                f"sidecar {self.address} returned an undecodable "
+                f"response: {e}") from e
         remote_spans = doc.pop("traceSpans", None)
         if remote_spans and tc:
             # the sidecar shipped its completed spans back: land them in
@@ -243,13 +284,37 @@ class SolverClient:
             rec = trace.recorder()
             if rec is not None:
                 rec.ingest(remote_spans)
-        return serde.plan_from_dict(doc)
+        try:
+            return serde.plan_from_dict(doc)
+        except (KeyError, TypeError, ValueError) as e:
+            raise SidecarProtocolError(
+                f"sidecar {self.address} returned a malformed plan "
+                f"document: {type(e).__name__}: {e}") from e
 
     def health(self) -> Dict:
-        return json.loads(self._health(b"{}", timeout=self.timeout).decode())
+        # wait_for_ready: a probe against a just-restarted endpoint must
+        # FORCE a reconnect attempt instead of failing fast out of the
+        # channel's own TRANSIENT_FAILURE backoff — recovery detection
+        # is this RPC's whole job, and the ~1 s deadline bounds it
+        return json.loads(
+            self._health(b"{}", timeout=self.health_timeout,
+                         wait_for_ready=True).decode())
 
     def close(self) -> None:
         self._channel.close()
+
+
+def classify_sidecar_failure(exc) -> str:
+    """Sidecar RPC failure → bounded taxonomy code (solver/taxonomy.py):
+    ``sidecar-hung`` for a deadline-class failure (the endpoint accepted
+    and stalled — the failure mode that costs a whole solve deadline),
+    ``sidecar-unreachable`` for everything else (connection refused /
+    reset, junk response, mid-body death)."""
+    from ..solver.taxonomy import SIDECAR_HUNG, SIDECAR_UNREACHABLE
+    if isinstance(exc, grpc.RpcError) and hasattr(exc, "code"):
+        if exc.code() == grpc.StatusCode.DEADLINE_EXCEEDED:
+            return SIDECAR_HUNG
+    return SIDECAR_UNREACHABLE
 
 
 class RemoteSolver(Solver):
@@ -336,28 +401,153 @@ class RemoteSolver(Solver):
                 if plan.mesh_devices > 1:
                     self._remote_mesh_solves += 1
                 return plan
-            except grpc.RpcError as e:
-                # the sidecar is down/unreachable: the local solver this
-                # subclasses is fully functional — degrade to it (one more
-                # rung under the device ladder) rather than failing the
-                # pass; provenance marks the plan so the flight recorder
-                # tail-retains the trace and operators see WHY
-                sp.set(degraded=True, reason="sidecar-unreachable",
-                       error=f"{type(e).__name__}: {e.code() if hasattr(e, 'code') else e}")
+            except (grpc.RpcError, SidecarProtocolError) as e:
+                # the sidecar is down, hung, or talking garbage: the
+                # local solver this subclasses is fully functional —
+                # degrade to it (one more rung under the device ladder)
+                # rather than failing the pass; provenance marks the
+                # plan with the bounded taxonomy code so the flight
+                # recorder tail-retains the trace and operators see WHY.
+                # A mid-response failure (connection died after a
+                # partial body / junk JSON back) arrives here as
+                # SidecarProtocolError — never a JSONDecodeError out of
+                # the pass.
+                reason = classify_sidecar_failure(e)
+                sp.set(degraded=True, reason=reason,
+                       error=f"{type(e).__name__}: {e.code() if isinstance(e, grpc.RpcError) and hasattr(e, 'code') else e}")
         # delegation failed: the LOCAL solver is what solves now — stop
         # reporting the unreachable sidecar's mesh shape (stats falls
         # back to super()'s view until a delegated solve succeeds
         # again; the cumulative sharded-solve count stays)
         self._remote_mesh_devices = 0
         self._remote_mesh_imbalance = 0.0
+        self._count_degraded(reason)
         plan = super().solve_relaxed(
             pods, node_pools, lattice=lattice, existing=existing,
             daemonset_pods=daemonset_pods, bound_pods=bound_pods,
             pvcs=pvcs, storage_classes=storage_classes, mesh=mesh,
             pool_headroom=pool_headroom, problem0=problem0)
         plan.degraded = True
-        plan.degraded_reason = plan.degraded_reason or "sidecar-unreachable"
+        plan.degraded_reason = plan.degraded_reason or reason
         return plan
+
+
+class ChaosSolverService(SolverService):
+    """A SolverService with injectable failure modes — the server half
+    of control-plane weather (weather/scenario.py ``SidecarOutage``) and
+    the pool failover tests:
+
+    - **hang**: the handler ACCEPTS the RPC and stalls until the mode
+      clears (bounded far past any deadline) — the failure mode a
+      connect error never exercises; the caller's deadline, not the
+      sidecar, ends the wait;
+    - **junk**: the handler answers with bytes that are not a NodePlan
+      document — the mid-response/garbage failure SolverClient must
+      classify as SidecarProtocolError, never leak as JSONDecodeError.
+    """
+
+    # hang cap: far past any sane deadline, bounded so a torn-down test
+    # or soak can never leak a stalled worker thread forever
+    HANG_CAP_SECONDS = 120.0
+
+    def __init__(self, solver: Solver, window=None):
+        super().__init__(solver, window)
+        import threading
+        self._hanging = False
+        self._junk = False
+        self._release = threading.Event()
+        self._release.set()
+
+    def set_hang(self, on: bool) -> None:
+        if on:
+            self._release.clear()
+            self._hanging = True
+        else:
+            self._hanging = False
+            self._release.set()
+
+    def set_junk(self, on: bool) -> None:
+        self._junk = bool(on)
+
+    def _maybe_misbehave(self) -> Optional[bytes]:
+        if self._hanging:
+            # stall in small waits so set_hang(False) releases promptly;
+            # the loop bound (not a deadline of our own) caps a leak
+            waited = 0.0
+            while self._hanging and waited < self.HANG_CAP_SECONDS:
+                if self._release.wait(0.1):
+                    break
+                waited += 0.1
+        if self._junk:
+            return b"\x7bgarbage: this is not a NodePlan\x00"
+        return None
+
+    def solve(self, payload: bytes) -> bytes:
+        bad = self._maybe_misbehave()
+        return bad if bad is not None else super().solve(payload)
+
+    def health(self, payload: bytes) -> bytes:
+        # a hung PROCESS hangs everything, liveness included — that is
+        # exactly what the split health deadline exists to bound
+        bad = self._maybe_misbehave()
+        return bad if bad is not None else super().health(payload)
+
+
+class ChaosSidecar:
+    """One controllable sidecar endpoint: serve/kill/restart on a fixed
+    address plus the ChaosSolverService failure modes. The handle the
+    weather simulator drives (``WeatherSimulator(sidecars=[...])``) and
+    tools/soak.py ``--solver-pool`` / tools/smoke_pool.py manage."""
+
+    def __init__(self, solver: Solver, address: str):
+        self.solver = solver
+        self.address = address
+        self.service = ChaosSolverService(solver)
+        self.server: Optional[grpc.Server] = None
+        self.alive = False
+
+    def start(self) -> "ChaosSidecar":
+        from concurrent.futures import ThreadPoolExecutor
+        server = grpc.server(ThreadPoolExecutor(max_workers=4))
+        server.add_generic_rpc_handlers((_Handler(self.service),))
+        if server.add_insecure_port(self.address) == 0:
+            raise RuntimeError(f"chaos sidecar failed to bind "
+                               f"{self.address!r}")
+        server.start()
+        self.server = server
+        self.alive = True
+        return self
+
+    def kill(self) -> None:
+        """The endpoint goes DARK (connection refused), releasing any
+        hung handlers so worker threads never leak."""
+        self.service.set_hang(False)
+        if self.server is not None:
+            self.server.stop(grace=None)
+            self.server = None
+        self.alive = False
+
+    def restart(self) -> None:
+        """Re-serve on the SAME address (the pool's endpoint list is
+        fixed — recovery means the address answers again), with failure
+        modes cleared: a restarted process comes back healthy."""
+        self.service.set_hang(False)
+        self.service.set_junk(False)
+        if not self.alive:
+            self.start()
+
+    def set_hang(self, on: bool) -> None:
+        self.service.set_hang(on)
+
+    def set_junk(self, on: bool) -> None:
+        self.service.set_junk(on)
+
+    def restore(self) -> None:
+        """Fair weather: alive, no failure modes."""
+        self.restart()
+
+    def stop(self) -> None:
+        self.kill()
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:

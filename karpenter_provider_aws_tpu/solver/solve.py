@@ -119,8 +119,10 @@ class NodePlan:
     # degradation-ladder provenance (docs/concepts/degradation.md): which
     # rung produced this plan, and what pushed the solve off the primary
     # device path. ``degraded_reason`` is a bounded enum ("g-overflow",
-    # "b-exhausted", "device-error", "internal-error") so it can ride a
-    # metric label; the human detail lands in ``warnings``.
+    # "b-exhausted", "device-error", "internal-error", and the sidecar
+    # family "sidecar-hung" / "sidecar-unreachable" / "pool-exhausted" —
+    # solver/taxonomy.py) so it can ride a metric label; the human
+    # detail lands in ``warnings``.
     degraded: bool = False
     degraded_reason: str = ""
     solver_path: str = "device"                  # device | wave-split | host-ffd

@@ -56,11 +56,27 @@ POOL_LIMITS = "pool-limits"
 # provisioning: the solve itself failed; the whole batch stays pending
 # for the next pass (partial-result guard)
 SOLVE_ERROR = "solve-error"
+# control-plane degradation provenance (parallel/pool.py SolverPool;
+# docs/reference/solver-pool.md): these ride NodePlan.degraded_reason
+# (and the karpenter_solver_degraded_total reason label), never a pod's
+# unschedulable reason — the pool's job is that pods still place.
+# sidecar RPC missed its solve deadline: the endpoint accepted the
+# connection and stalled (a hung process, the failure mode a flat
+# connect error never surfaces); its breaker opens immediately
+SIDECAR_HUNG = "sidecar-hung"
+# sidecar RPC failed any other way: connection refused/reset, or the
+# endpoint answered with something that is not a NodePlan (junk body,
+# connection died mid-response)
+SIDECAR_UNREACHABLE = "sidecar-unreachable"
+# every pool endpoint's breaker is open: the pass ran on the LOCAL
+# solver — the final ladder rung below the whole sidecar fleet
+POOL_EXHAUSTED = "pool-exhausted"
 
 CODES = frozenset({
     UNKNOWN_RESOURCE, NO_OFFERING, ICE_HOLD, ZONE_ANTI_AFFINITY,
     NO_FIT, NO_EXISTING_FIT, NO_NEW_NODE_SHAPE, SINGLE_BIN_FULL,
     AFFINITY_PRESENCE, POOL_LIMITS, SOLVE_ERROR,
+    SIDECAR_HUNG, SIDECAR_UNREACHABLE, POOL_EXHAUSTED,
 })
 
 # the parse-failure sentinel for strings minted before the taxonomy (or

@@ -2,11 +2,11 @@
 spot-market + interruption-storm chaos driving the degradation ladder."""
 
 from .fields import IceField, SpotMarketField
-from .scenario import (IceSpell, NAMED_SCENARIOS, Regime, Storm,
-                       WeatherScenario, load_scenario, named)
+from .scenario import (IceSpell, NAMED_SCENARIOS, Regime, SidecarOutage,
+                       Storm, WeatherScenario, load_scenario, named)
 from .simulator import WeatherSimulator, inject_device_errors
 
 __all__ = ["WeatherScenario", "Regime", "Storm", "IceSpell",
-           "NAMED_SCENARIOS", "named", "load_scenario",
+           "SidecarOutage", "NAMED_SCENARIOS", "named", "load_scenario",
            "SpotMarketField", "IceField",
            "WeatherSimulator", "inject_device_errors"]

@@ -74,6 +74,28 @@ class IceSpell:
     hold_seconds: float = 60.0          # mean hold before a pool thaws
 
 
+@dataclass(frozen=True)
+class SidecarOutage:
+    """CONTROL-PLANE weather (docs/reference/solver-pool.md): while
+    active, one solver-pool endpoint misbehaves. Modes:
+
+    - ``kill``: the endpoint goes dark (connection refused); with
+      ``restart_after`` (default) it restarts when the window closes —
+      the breaker's half-open probe must then re-close it;
+    - ``hang``: the endpoint ACCEPTS the RPC and stalls past every
+      deadline — the failure a connect error never exercises;
+    - ``junk``: the endpoint answers bytes that are not a NodePlan.
+
+    Purely deterministic (no RNG): the timeline records outage/restore
+    on the ticks the window edges cross, exactly like storms."""
+
+    at: float
+    duration: float
+    endpoint: int = 0                   # index into the pool's endpoint list
+    mode: str = "kill"                  # kill | hang | junk
+    restart_after: bool = True          # kill mode: restart at window end
+
+
 @dataclass
 class WeatherScenario:
     name: str = "custom"
@@ -90,6 +112,7 @@ class WeatherScenario:
     regimes: Tuple[Regime, ...] = ()
     storms: Tuple[Storm, ...] = ()
     ice: Tuple[IceSpell, ...] = ()
+    sidecar_outages: Tuple[SidecarOutage, ...] = ()
 
     # ---- serialization (replayable byte-for-byte from a seed) -----------
 
@@ -108,6 +131,9 @@ class WeatherScenario:
         kw["regimes"] = tup(kw.get("regimes"), Regime)
         kw["storms"] = tup(kw.get("storms"), Storm)
         kw["ice"] = tup(kw.get("ice"), IceSpell)
+        if "sidecar_outages" in kw:   # absent in pre-PR-13 scenario JSON
+            kw["sidecar_outages"] = tup(kw.get("sidecar_outages"),
+                                        SidecarOutage)
         known = {f.name for f in dataclasses.fields(cls)}
         unknown = set(kw) - known
         if unknown:
@@ -174,11 +200,32 @@ def named(name: str) -> WeatherScenario:
             regimes=(Regime(at=30.0, mu=0.6),   # ≈1.8x while the front rages
                      Regime(at=185.0, mu=0.0)),
             storms=storms, ice=spells)
+    if name == "blackout":
+        # control-plane weather against a 2-sidecar solver pool
+        # (docs/reference/solver-pool.md): endpoint 0 dies outright and
+        # endpoint 1 HANGS while 0 is still dark — a full-pool blackout
+        # window (30-45 s) where the local solve is the only rung —
+        # then 1 recovers, 0 restarts (breaker must re-close via the
+        # half-open probe), and a late junk-response spell on 1 forces
+        # failovers onto the recovered 0. Market stays mild: the
+        # artifact isolates the control plane's own failure ladder.
+        return WeatherScenario(
+            name="blackout", tick_seconds=1.0, duration_seconds=120.0,
+            market_sigma=0.02,
+            sidecar_outages=(
+                SidecarOutage(at=15.0, duration=40.0, endpoint=0,
+                              mode="kill"),
+                SidecarOutage(at=30.0, duration=15.0, endpoint=1,
+                              mode="hang"),
+                SidecarOutage(at=75.0, duration=15.0, endpoint=1,
+                              mode="junk"),
+            ))
     raise ValueError(f"unknown weather scenario {name!r} "
                      f"(named: {', '.join(NAMED_SCENARIOS)})")
 
 
-NAMED_SCENARIOS = ("calm", "squall", "spot-crash", "ice-age", "storm-front")
+NAMED_SCENARIOS = ("calm", "squall", "spot-crash", "ice-age",
+                   "storm-front", "blackout")
 
 
 def load_scenario(spec: str) -> WeatherScenario:

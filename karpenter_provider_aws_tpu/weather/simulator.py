@@ -51,9 +51,17 @@ class WeatherSimulator:
     def __init__(self, scenario: WeatherScenario, lattice,
                  seed: Optional[int] = None, clock: Optional[Clock] = None,
                  pricing=None, cloud=None, unavailable=None, queue=None,
-                 solver=None, metrics=None):
+                 solver=None, metrics=None, sidecars=None):
         """Every control-plane seam is optional: with all of them None
-        the simulator is a pure replay engine (timeline only)."""
+        the simulator is a pure replay engine (timeline only).
+
+        ``sidecars`` is the control-plane-weather seam (PR 13): a
+        sequence of handles with ``kill()/restart()/set_hang()/
+        set_junk()`` (parallel/sidecar.py ChaosSidecar) that scenario
+        ``SidecarOutage`` elements drive — one handle per solver-pool
+        endpoint index. An outage naming an endpoint beyond the list is
+        recorded in the timeline but applies to nothing (the timeline
+        stays a pure function of the scenario either way)."""
         self.scenario = scenario
         self.seed = scenario.seed if seed is None else int(seed)
         self.lattice = lattice
@@ -63,6 +71,7 @@ class WeatherSimulator:
         self.unavailable = unavailable
         self.queue = queue
         self.solver = solver
+        self.sidecars = list(sidecars) if sidecars else []
         self.market = SpotMarketField(lattice, scenario)
         self.ice = IceField(lattice, scenario)
         self._fam_of = {s.name: s.family for s in lattice.specs}
@@ -72,6 +81,7 @@ class WeatherSimulator:
             "messages_sent": 0, "spot_interruptions": 0, "rebalances": 0,
             "scheduled_changes": 0, "state_changes": 0, "junk_sent": 0,
             "ice_marks": 0, "ice_thaws": 0, "device_errors": 0,
+            "sidecar_outages": 0, "sidecar_restores": 0,
         }
         self.ticks = 0
         self._t0: Optional[float] = None
@@ -221,6 +231,26 @@ class WeatherSimulator:
             if storm.at <= now_s and prev_s < end_s <= now_s:
                 self._event("storm-end", storm=i)
 
+        # 4b. sidecar outages (control-plane weather; parallel/pool.py).
+        # Purely deterministic — no RNG draw, so the timeline events are
+        # a function of (scenario, tick) alone and replay with no
+        # sidecar handles attached. Same edge pairing as storms: an
+        # outage shorter than tick_seconds still runs
+        # outage → restore on the tick it slips past.
+        for i, o in enumerate(sc.sidecar_outages):
+            end_s = o.at + o.duration
+            started = (prev_s < o.at <= now_s or (t == 0 and o.at <= 0))
+            if started:
+                self.counters["sidecar_outages"] += 1
+                self._event("sidecar-outage", outage=i,
+                            endpoint=o.endpoint, mode=o.mode)
+                self._apply_outage(o)
+            if o.at <= now_s and prev_s < end_s <= now_s:
+                self.counters["sidecar_restores"] += 1
+                self._event("sidecar-restore", outage=i,
+                            endpoint=o.endpoint, mode=o.mode)
+                self._restore_outage(o)
+
         # 5. device weather (independent draws per active storm, fixed
         # order — deterministic)
         for i, storm in enumerate(sc.storms):
@@ -241,6 +271,31 @@ class WeatherSimulator:
             self._gauges["mult_mean"].set(round(mean, 4))
             self._gauges["mult_max"].set(round(mx, 4))
             self._gauges["ticks"].set(float(self.ticks))
+
+    def _apply_outage(self, o) -> None:
+        """Drive one SidecarOutage onto its endpoint handle (no-op when
+        no handle is attached at that index — pure replay)."""
+        if not (0 <= o.endpoint < len(self.sidecars)):
+            return
+        h = self.sidecars[o.endpoint]
+        if o.mode == "kill":
+            h.kill()
+        elif o.mode == "hang":
+            h.set_hang(True)
+        elif o.mode == "junk":
+            h.set_junk(True)
+
+    def _restore_outage(self, o) -> None:
+        if not (0 <= o.endpoint < len(self.sidecars)):
+            return
+        h = self.sidecars[o.endpoint]
+        if o.mode == "kill":
+            if o.restart_after:
+                h.restart()
+        elif o.mode == "hang":
+            h.set_hang(False)
+        elif o.mode == "junk":
+            h.set_junk(False)
 
     def _burst(self, rng, idx: int, storm) -> None:
         """One storm tick: the deterministic part (junk count, timeline
@@ -331,6 +386,11 @@ class WeatherSimulator:
                     self.unavailable.delete(ct, it, z)
             if self.pricing is not None and self.market.base:
                 self.pricing.update_spot_pricing(dict(self.market.base))
+            # control-plane weather clears with the rest: every sidecar
+            # handle returns to fair weather (alive, no hang/junk) so
+            # the convergence tail runs against a healthy pool
+            for h in self.sidecars:
+                h.restore()
             if self._gauges is not None:
                 self._gauges["storm"].set(0.0)
                 self._gauges["ice"].set(0.0)

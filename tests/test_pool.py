@@ -1,0 +1,638 @@
+"""Solver-pool failover (parallel/pool.py; docs/reference/solver-pool.md):
+circuit-breaker state machine on the injected clock, deadline-bounded
+RPCs split by purpose, least-outstanding failover routing, the local
+solve as the final rung only when the whole pool is dark, and the
+control-plane weather (SidecarOutage) that drives all of it."""
+
+import time
+
+import pytest
+
+from karpenter_provider_aws_tpu import trace
+from karpenter_provider_aws_tpu.apis import NodePool, Pod
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+from karpenter_provider_aws_tpu.solver import Solver
+from karpenter_provider_aws_tpu.solver import taxonomy as tx
+from karpenter_provider_aws_tpu.parallel.pool import (
+    CircuitBreaker, SOLVE_DEADLINE_MULTIPLIER, SolverPool,
+    derive_solve_deadline, parse_addresses)
+from karpenter_provider_aws_tpu.parallel.sidecar import (
+    ChaosSidecar, HEALTH_TIMEOUT_SECONDS, SidecarProtocolError,
+    SolverClient, classify_sidecar_failure)
+from karpenter_provider_aws_tpu.trace import FlightRecorder
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    return build_lattice([s for s in build_catalog()
+                          if s.family in ("m5", "c5")])
+
+
+def mkpods(n=4):
+    return [Pod(name=f"p{i}", requests={"cpu": "500m", "memory": "1Gi"})
+            for i in range(n)]
+
+
+POOLS = [NodePool(name="default")]
+
+
+@pytest.fixture()
+def two_sidecars(lattice, tmp_path):
+    s0 = ChaosSidecar(Solver(lattice), f"unix:{tmp_path}/s0.sock").start()
+    s1 = ChaosSidecar(Solver(lattice), f"unix:{tmp_path}/s1.sock").start()
+    yield s0, s1
+    s0.set_hang(False)
+    s1.set_hang(False)
+    s0.kill()
+    s1.kill()
+
+
+def mkpool(lattice, sidecars, clock, **kw):
+    # generous default: the first solve in a fresh process pays an XLA
+    # compile; hang-specific tests override with a short deadline (the
+    # handler stalls before any solve, so compile cost never applies)
+    kw.setdefault("solve_deadline", 15.0)
+    return SolverPool(lattice, ",".join(s.address for s in sidecars),
+                      clock=clock, **kw)
+
+
+# ---------------------------------------------------------------------------
+
+
+class TestAddressParsing:
+    def test_comma_list_with_whitespace(self):
+        assert parse_addresses(" unix:/a.sock, host:50051 ,") == \
+            ("unix:/a.sock", "host:50051")
+
+    def test_sequence_accepted(self):
+        assert parse_addresses(["a", "b"]) == ("a", "b")
+
+    def test_empty_rejected(self):
+        with pytest.raises(ValueError):
+            parse_addresses(" , ")
+
+    def test_options_layering_env_and_validation(self, monkeypatch):
+        from karpenter_provider_aws_tpu.operator.options import Options
+        monkeypatch.setenv("SOLVER_ADDRESSES", "unix:/a.sock,unix:/b.sock")
+        assert Options.from_env().solver_address == \
+            "unix:/a.sock,unix:/b.sock"
+        # the singular legacy var still works, the plural wins
+        monkeypatch.delenv("SOLVER_ADDRESSES")
+        monkeypatch.setenv("SOLVER_ADDRESS", "unix:/c.sock")
+        assert Options.from_env().solver_address == "unix:/c.sock"
+        # a SET-BUT-EMPTY plural (the deploy template's placeholder)
+        # counts as unset — it must not shadow the legacy var
+        monkeypatch.setenv("SOLVER_ADDRESSES", "")
+        assert Options.from_env().solver_address == "unix:/c.sock"
+        with pytest.raises(ValueError):
+            Options(solver_address=" , ").validate()
+        with pytest.raises(ValueError):
+            Options(solver_solve_deadline=-1.0).validate()
+        with pytest.raises(ValueError):
+            Options(solver_health_deadline=0.0).validate()
+
+
+class TestDeadlines:
+    def test_solve_deadline_derives_from_latency_budget(self):
+        assert derive_solve_deadline(0.2) == pytest.approx(
+            0.2 * SOLVE_DEADLINE_MULTIPLIER)
+
+    def test_pool_derives_when_unset(self, lattice):
+        p = SolverPool(lattice, "unix:/nowhere.sock", clock=FakeClock(),
+                       latency_budget_seconds=0.2)
+        assert p.solve_deadline == pytest.approx(10.0)
+        assert p.health_deadline == pytest.approx(1.0)
+
+    def test_explicit_deadline_wins(self, lattice):
+        p = SolverPool(lattice, "unix:/nowhere.sock", clock=FakeClock(),
+                       solve_deadline=3.5)
+        assert p.solve_deadline == 3.5
+
+    def test_health_rpc_has_its_own_short_deadline(self, lattice,
+                                                   tmp_path):
+        """Satellite pin: liveness against a HUNG sidecar returns in
+        about the health deadline (~1 s), never the solve timeout."""
+        sc = ChaosSidecar(Solver(lattice),
+                          f"unix:{tmp_path}/hung.sock").start()
+        try:
+            client = SolverClient(sc.address, timeout=60.0)
+            assert client.health()["ok"]
+            assert client.health_timeout == HEALTH_TIMEOUT_SECONDS
+            sc.set_hang(True)
+            t0 = time.perf_counter()
+            import grpc
+            with pytest.raises(grpc.RpcError) as ei:
+                client.health()
+            elapsed = time.perf_counter() - t0
+            assert ei.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+            # well under the old shared 60 s solve timeout
+            assert elapsed < 5.0
+            client.close()
+        finally:
+            sc.set_hang(False)
+            sc.kill()
+
+
+class TestCircuitBreaker:
+    def test_consecutive_failures_open_then_probe_recloses(self):
+        clk = FakeClock()
+        br = CircuitBreaker(clk, name="t")
+        for _ in range(2):
+            br.record_failure()
+        assert br.state == "closed"
+        br.record_failure()
+        assert br.state == "open" and br.opens == 1
+        # probation rides the INJECTED clock, never wall time
+        assert not br.probe_due()
+        clk.step(60.0)
+        assert br.probe_due()
+        br.begin_probe()
+        assert br.state == "half-open"
+        br.record_success()
+        assert br.state == "closed" and br.consecutive_failures == 0
+
+    def test_half_open_failure_reopens_with_backoff(self):
+        clk = FakeClock()
+        br = CircuitBreaker(clk, name="t2")
+        for _ in range(3):
+            br.record_failure()
+        first_window = br._probe_at - clk.monotonic()
+        clk.step(60.0)
+        br.begin_probe()
+        br.record_failure()     # probe failed: re-open, doubled window
+        assert br.state == "open" and br.opens == 2
+        second_window = br._probe_at - clk.monotonic()
+        # jitter is [0.5, 1.5): a doubled base strictly dominates even
+        # max-jitter-first vs min-jitter-second comparisons on average,
+        # so compare against the deterministic base bounds instead
+        assert first_window <= br.open_seconds * 1.5
+        assert second_window <= br.open_seconds * 2 * 1.5
+        assert second_window >= br.open_seconds * 2 * 0.5
+
+    def test_fatal_failure_opens_immediately(self):
+        br = CircuitBreaker(FakeClock(), name="t3")
+        br.record_failure(fatal=True)
+        assert br.state == "open"
+
+    def test_success_resets_streak(self):
+        br = CircuitBreaker(FakeClock(), name="t4")
+        br.record_failure()
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        br.record_failure()
+        assert br.state == "closed"
+
+    def test_jitter_is_deterministic_per_name(self):
+        a1 = CircuitBreaker(FakeClock(), name="same")
+        a2 = CircuitBreaker(FakeClock(), name="same")
+        for br in (a1, a2):
+            for _ in range(3):
+                br.record_failure()
+        assert a1._probe_at == a2._probe_at
+
+    def test_backoff_caps_at_max(self):
+        clk = FakeClock()
+        br = CircuitBreaker(clk, name="cap", open_seconds=2.0,
+                            max_open_seconds=30.0)
+        for _ in range(12):
+            for _ in range(3):
+                br.record_failure()
+            clk.step(100.0)
+            br.begin_probe()
+        for _ in range(3):
+            br.record_failure()
+        assert br._probe_at - clk.monotonic() <= 30.0 * 1.5
+
+
+class TestFailover:
+    def test_healthy_pool_delegates_no_failover(self, lattice,
+                                                two_sidecars):
+        pool = mkpool(lattice, two_sidecars, FakeClock())
+        plan = pool.solve_relaxed(mkpods(), POOLS)
+        assert not plan.degraded and not plan.unschedulable
+        st = pool.pool_stats()
+        assert st["delegated_solves"] == 1 and st["failovers"] == 0
+        pool.close()
+
+    def test_dead_endpoint_fails_over_to_survivor(self, lattice,
+                                                  two_sidecars):
+        s0, s1 = two_sidecars
+        clock = FakeClock()
+        pool = mkpool(lattice, two_sidecars, clock)
+        s0.kill()
+        plan = pool.solve_relaxed(mkpods(), POOLS)
+        # the pass SUCCEEDED on the survivor: not degraded, but the
+        # burned attempt is recorded (failover counter + plan warning)
+        assert not plan.degraded
+        st = pool.pool_stats()
+        assert st["failovers"] >= 1 and st["ep1_solves"] == 1
+        assert any("sidecar-unreachable" in w for w in plan.warnings)
+        pool.close()
+
+    def test_outstanding_balanced_on_unexpected_exception(self, lattice,
+                                                          two_sidecars):
+        """An exception OUTSIDE the expected (RpcError, protocol) set
+        must still balance the outstanding counter — a leaked +1 would
+        permanently demote the endpoint in least-outstanding routing."""
+        pool = mkpool(lattice, two_sidecars, FakeClock())
+
+        class _Boom(RuntimeError):
+            pass
+
+        def explode(*a, **k):
+            raise _Boom("not an rpc failure")
+
+        pool.endpoints[0].client().solve = explode
+        with pytest.raises(_Boom):
+            pool.solve_relaxed(mkpods(), POOLS)
+        assert pool.endpoints[0].outstanding == 0
+        pool.close()
+
+    def test_least_outstanding_routing_deterministic_tie_break(
+            self, lattice, two_sidecars):
+        pool = mkpool(lattice, two_sidecars, FakeClock())
+        order = pool._routable()
+        # all-zero outstanding: index breaks the tie
+        assert [ep.index for ep in order] == [0, 1]
+        pool.endpoints[0].outstanding = 2
+        assert [ep.index for ep in pool._routable()] == [1, 0]
+        pool.close()
+
+    def test_whole_pool_dark_goes_local_pool_exhausted(self, lattice,
+                                                       two_sidecars):
+        s0, s1 = two_sidecars
+        pool = mkpool(lattice, two_sidecars, FakeClock())
+        s0.kill()
+        s1.kill()
+        plan = pool.solve_relaxed(mkpods(), POOLS)
+        assert plan.degraded
+        assert plan.degraded_reason == tx.POOL_EXHAUSTED
+        assert not plan.unschedulable      # the local rung still places
+        st = pool.pool_stats()
+        assert st["local_solves"] == 1
+        assert pool.degraded_counts.get(tx.POOL_EXHAUSTED) == 1
+        pool.close()
+
+    def test_open_breakers_skip_straight_to_local(self, lattice,
+                                                  two_sidecars):
+        s0, s1 = two_sidecars
+        clock = FakeClock()
+        pool = mkpool(lattice, two_sidecars, clock)
+        s0.kill()
+        s1.kill()
+        for _ in range(3):
+            pool.solve_relaxed(mkpods(), POOLS)
+        st = pool.pool_stats()
+        assert st["ep0_state"] == 2 and st["ep1_state"] == 2
+        before = st["failovers"]
+        pool.solve_relaxed(mkpods(), POOLS)
+        # no routable endpoint: the pass pays ZERO failed RPC attempts
+        assert pool.pool_stats()["failovers"] == before
+        pool.close()
+
+    def test_junk_response_classifies_and_falls_through(self, lattice,
+                                                        two_sidecars):
+        """Satellite pin: garbage back from a sidecar is a SIDECAR
+        failure (failover / local rung), never a JSONDecodeError out of
+        the pass."""
+        s0, s1 = two_sidecars
+        pool = mkpool(lattice, two_sidecars, FakeClock())
+        s0.set_junk(True)
+        plan = pool.solve_relaxed(mkpods(), POOLS)
+        assert not plan.degraded           # survivor carried it
+        assert any("sidecar-unreachable" in w for w in plan.warnings)
+        # both junking: the local rung answers, still no decode error
+        s1.set_junk(True)
+        plan = pool.solve_relaxed(mkpods(), POOLS)
+        assert plan.degraded
+        assert plan.degraded_reason == tx.POOL_EXHAUSTED
+        pool.close()
+
+    def test_recovery_probe_recloses_breaker_and_delegation_resumes(
+            self, lattice, two_sidecars):
+        s0, s1 = two_sidecars
+        clock = FakeClock()
+        pool = mkpool(lattice, two_sidecars, clock)
+        s0.kill()
+        s1.kill()
+        for _ in range(3):
+            pool.solve_relaxed(mkpods(), POOLS)
+        assert pool.pool_stats()["healthy"] == 0
+        s0.restart()
+        s1.restart()
+        clock.step(120.0)
+        pool.check_endpoints()
+        st = pool.pool_stats()
+        assert st["healthy"] == 2
+        plan = pool.solve_relaxed(mkpods(), POOLS)
+        assert not plan.degraded
+        assert pool.pool_stats()["delegated_solves"] >= 1
+        pool.close()
+
+
+class TestHang:
+    def test_hung_sidecar_bounded_by_deadline_plus_one_failover(
+            self, lattice, two_sidecars):
+        """Satellite pin (threaded hang): the sidecar ACCEPTS and
+        stalls; the pass completes within the solve deadline + one
+        failover, the breaker opens (deadline-class = fatal), and a
+        half-open probe re-closes it after the sidecar recovers.
+        FakeClock drives probation — the only real time spent is the
+        deliberately short RPC deadline itself."""
+        s0, s1 = two_sidecars
+        clock = FakeClock()
+        pool = mkpool(lattice, two_sidecars, clock, solve_deadline=0.5)
+        pool.solve_relaxed(mkpods(), POOLS)        # warm both paths
+        s0.set_hang(True)
+        t0 = time.perf_counter()
+        plan = pool.solve_relaxed(mkpods(), POOLS)
+        elapsed = time.perf_counter() - t0
+        assert not plan.degraded                   # survivor carried it
+        # deadline (0.5 s) + the survivor's solve + slack — nowhere near
+        # the old 60 s stall
+        assert elapsed < 10.0
+        st = pool.pool_stats()
+        assert st["ep0_state"] == 2                # opened on ONE hang
+        assert any(tx.SIDECAR_HUNG in w for w in plan.warnings)
+        # recovery: release the hang, step probation, probe re-closes
+        s0.set_hang(False)
+        clock.step(120.0)
+        pool.check_endpoints()
+        assert pool.pool_stats()["ep0_state"] == 0
+        pool.close()
+
+
+class TestRemoteSolverHardening:
+    def test_classify_table(self):
+        import grpc
+
+        class _Dead(grpc.RpcError):
+            def code(self):
+                return grpc.StatusCode.UNAVAILABLE
+
+        class _Hung(grpc.RpcError):
+            def code(self):
+                return grpc.StatusCode.DEADLINE_EXCEEDED
+
+        assert classify_sidecar_failure(_Dead()) == tx.SIDECAR_UNREACHABLE
+        assert classify_sidecar_failure(_Hung()) == tx.SIDECAR_HUNG
+        assert classify_sidecar_failure(
+            SidecarProtocolError("junk")) == tx.SIDECAR_UNREACHABLE
+
+    def test_single_remote_solver_junk_falls_back_local(self, lattice,
+                                                        tmp_path):
+        """Satellite pin: the legacy single-address RemoteSolver also
+        classifies a junk response as sidecar failure and takes the
+        local rung with a coded reason."""
+        from karpenter_provider_aws_tpu.parallel.sidecar import RemoteSolver
+        sc = ChaosSidecar(Solver(lattice),
+                          f"unix:{tmp_path}/junk.sock").start()
+        try:
+            sc.set_junk(True)
+            rs = RemoteSolver(lattice, sc.address)
+            plan = rs.solve_relaxed(mkpods(), POOLS)
+            assert plan.degraded
+            assert plan.degraded_reason == tx.SIDECAR_UNREACHABLE
+            assert not plan.unschedulable
+            assert rs.degraded_counts.get(tx.SIDECAR_UNREACHABLE) == 1
+            rs.client.close()
+        finally:
+            sc.kill()
+
+    def test_taxonomy_codes_declared(self):
+        for code in (tx.SIDECAR_HUNG, tx.SIDECAR_UNREACHABLE,
+                     tx.POOL_EXHAUSTED):
+            assert code in tx.CODES
+            assert tx.code_of(tx.reason(code, "detail")) == code
+
+
+class TestTraceContinuity:
+    def test_failover_pass_records_one_connected_trace(self, lattice,
+                                                       two_sidecars):
+        """Satellite pin: a pass that fails over mid-ladder still
+        records ONE connected trace — the failed attempt span marked
+        status=error with the coded reason, and the winning endpoint's
+        sidecar spans in the same tree."""
+        s0, s1 = two_sidecars
+        rec = FlightRecorder(ring=64, retained=16,
+                             latency_budget_ms=60000.0)
+        trace.enable(rec)
+        try:
+            pool = mkpool(lattice, two_sidecars, FakeClock())
+            s0.kill()
+            with trace.span("provision.test") as root:
+                trace_id = root.trace_id
+                plan = pool.solve_relaxed(mkpods(), POOLS)
+            assert not plan.degraded
+            spans = rec.get(trace_id)
+            assert spans, "no spans recorded for the failover pass"
+            by_name = {}
+            for s in spans:
+                by_name.setdefault(s.name, []).append(s)
+            attempts = by_name.get("solver.remote", [])
+            assert len(attempts) == 2
+            failed = [s for s in attempts if s.status == "error"]
+            won = [s for s in attempts if s.status == "ok"]
+            assert len(failed) == 1 and len(won) == 1
+            assert failed[0].attrs.get("address") == s0.address
+            assert failed[0].attrs.get("reason") == tx.SIDECAR_UNREACHABLE
+            assert won[0].attrs.get("address") == s1.address
+            # the winning endpoint's in-process sidecar spans landed in
+            # the SAME tree (one trace id end to end)
+            assert "sidecar.solve" in by_name
+            assert all(s.trace_id == trace_id for s in spans)
+            # every parent resolves inside the tree — no orphans
+            ids = {s.span_id for s in spans}
+            for s in spans:
+                assert s.parent_id is None or s.parent_id in ids
+            pool.close()
+        finally:
+            trace.disable()
+            trace.get_tracer().recorder = None
+
+
+class TestPoolObservation:
+    def test_stats_report_endpoint_that_solved(self, lattice,
+                                               two_sidecars):
+        pool = mkpool(lattice, two_sidecars, FakeClock())
+        pool.solve_relaxed(mkpods(), POOLS)
+        st = pool.pool_stats()
+        assert st["endpoints"] == 2 and st["healthy"] == 2
+        assert st["ep0_solves"] + st["ep1_solves"] == 1
+        assert st["ep0_address"] == two_sidecars[0].address
+        # solver stats stay non-blocking and carry the pool's mesh view
+        sst = pool.stats()
+        assert "mesh_devices" in sst
+        pool.close()
+
+    def test_breaker_states_map(self, lattice, two_sidecars):
+        s0, s1 = two_sidecars
+        pool = mkpool(lattice, two_sidecars, FakeClock())
+        s0.kill()
+        s1.kill()
+        for _ in range(3):
+            pool.solve_relaxed(mkpods(), POOLS)
+        assert pool.breaker_states() == {s0.address: "open",
+                                         s1.address: "open"}
+        pool.close()
+
+    def test_operator_wires_pool_and_gauges(self, lattice, two_sidecars):
+        from karpenter_provider_aws_tpu import introspect
+        from karpenter_provider_aws_tpu.cloud import FakeCloud
+        from karpenter_provider_aws_tpu.operator import Operator, Options
+        s0, s1 = two_sidecars
+        clock = FakeClock()
+        op = Operator(options=Options(
+            registration_delay=0.5,
+            solver_address=f"{s0.address},{s1.address}",
+            solver_solve_deadline=2.0),
+            lattice=lattice, cloud=FakeCloud(clock), clock=clock)
+        assert isinstance(op.solver, SolverPool)
+        assert op.solver.solve_deadline == 2.0
+        assert "solver_pool" in introspect.registry().names()
+        for i in range(4):
+            op.cluster.add_pod(Pod(name=f"g{i}",
+                                   requests={"cpu": "500m",
+                                             "memory": "1Gi"}))
+        op.settle(max_rounds=20)
+        assert not op.cluster.pending_pods()
+        op.emit_gauges()
+        text = op.metrics.render()
+        assert "karpenter_solver_pool_endpoints 2.0" in text
+        assert "karpenter_solver_pool_healthy_endpoints 2.0" in text
+        assert f'karpenter_solver_pool_breaker_state{{endpoint="{s0.address}"}} 0.0' in text
+        from karpenter_provider_aws_tpu.metrics import lint_exposition
+        assert lint_exposition(text) == []
+        op.solver.close()
+
+    def test_kpctl_top_pool_row(self, lattice, two_sidecars):
+        import importlib
+        import sys
+        from pathlib import Path
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                               / "tools"))
+        kpctl = importlib.import_module("kpctl")
+        s0, s1 = two_sidecars
+        doc = {"providers": {"solver_pool": {
+            "endpoints": 2, "healthy": 1, "failovers": 3,
+            "delegated_solves": 7, "local_solves": 1,
+            "ep0_state": 2, "ep1_state": 0}}}
+        lines = kpctl._render_top(doc, "t")
+        row = next(ln for ln in lines if ln.startswith("POOL"))
+        assert "2 endpoints (1 healthy)" in row
+        assert "failovers 3" in row and "local 1" in row
+        assert "open,closed" in row
+        # provider errored ({"error": ...}): the row degrades, the view
+        # survives
+        doc = {"providers": {"solver_pool": {"error": "boom"}}}
+        assert any(ln.startswith("POOL")
+                   for ln in kpctl._render_top(doc, "t"))
+
+
+class TestSidecarOutageWeather:
+    def test_scenario_round_trip_and_unknown_fields(self):
+        from karpenter_provider_aws_tpu.weather import (SidecarOutage,
+                                                        WeatherScenario)
+        sc = WeatherScenario(name="x", sidecar_outages=(
+            SidecarOutage(at=5.0, duration=10.0, endpoint=1,
+                          mode="hang", restart_after=False),))
+        rt = WeatherScenario.from_json(sc.to_json())
+        assert rt == sc
+        # pre-PR-13 scenario JSON (no field) still loads
+        d = sc.to_dict()
+        d.pop("sidecar_outages")
+        assert WeatherScenario.from_dict(d).sidecar_outages == ()
+
+    def test_simulator_drives_outage_and_restore(self, lattice,
+                                                 two_sidecars):
+        from karpenter_provider_aws_tpu.weather import (SidecarOutage,
+                                                        WeatherScenario,
+                                                        WeatherSimulator)
+        s0, s1 = two_sidecars
+        sc = WeatherScenario(
+            name="t", tick_seconds=1.0, reprice_every=0,
+            sidecar_outages=(
+                SidecarOutage(at=2.0, duration=3.0, endpoint=0,
+                              mode="kill"),
+                SidecarOutage(at=3.0, duration=2.0, endpoint=1,
+                              mode="junk")))
+        sim = WeatherSimulator(sc, lattice, seed=1,
+                               sidecars=[s0, s1])
+        sim.step(4)    # ticks 0-3: kill lands on tick 2, junk on tick 3
+        assert not s0.alive
+        assert s1.service._junk
+        sim.step(2)    # ticks 4-5: both windows close on tick 5
+        assert s0.alive                    # restart_after default
+        assert not s1.service._junk
+        kinds = [e["kind"] for e in sim.timeline
+                 if e["kind"].startswith("sidecar")]
+        assert kinds == ["sidecar-outage", "sidecar-outage",
+                         "sidecar-restore", "sidecar-restore"]
+        assert sim.counters["sidecar_outages"] == 2
+        assert sim.counters["sidecar_restores"] == 2
+
+    def test_stop_restores_sidecars(self, lattice, two_sidecars):
+        from karpenter_provider_aws_tpu.weather import (SidecarOutage,
+                                                        WeatherScenario,
+                                                        WeatherSimulator)
+        s0, s1 = two_sidecars
+        sc = WeatherScenario(
+            name="t", tick_seconds=1.0, reprice_every=0,
+            sidecar_outages=(
+                SidecarOutage(at=0.0, duration=100.0, endpoint=0,
+                              mode="kill"),
+                SidecarOutage(at=0.0, duration=100.0, endpoint=1,
+                              mode="hang")))
+        sim = WeatherSimulator(sc, lattice, seed=1, sidecars=[s0, s1])
+        sim.step(2)
+        assert not s0.alive and s1.service._hanging
+        sim.stop()
+        assert s0.alive and not s1.service._hanging
+
+    def test_replay_identical_with_no_handles(self, lattice):
+        from karpenter_provider_aws_tpu.weather import (WeatherSimulator,
+                                                        named)
+        sc = named("blackout")
+        ticks = int(sc.duration_seconds / sc.tick_seconds) + 5
+        a = WeatherSimulator.replay(sc, lattice, ticks, seed=13)
+        b = WeatherSimulator.replay(sc, lattice, ticks, seed=13)
+        assert a == b
+        ev = [e["kind"] for e in a if e["kind"].startswith("sidecar")]
+        assert ev.count("sidecar-outage") == 3
+        assert ev.count("sidecar-restore") == 3
+
+    def test_blackout_in_library_and_full_blackout_window(self):
+        from karpenter_provider_aws_tpu.weather import (NAMED_SCENARIOS,
+                                                        load_scenario)
+        assert "blackout" in NAMED_SCENARIOS
+        sc = load_scenario("blackout")
+        assert sc.sidecar_outages
+        modes = {o.mode for o in sc.sidecar_outages}
+        assert {"kill", "hang", "junk"} <= modes
+        import sys
+        from pathlib import Path
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                               / "tools"))
+        import importlib
+        soak = importlib.import_module("soak")
+        # the scripted kill+hang overlap IS a full 2-endpoint blackout,
+        # and a third endpoint would break it
+        assert soak.full_blackout_scripted(sc, 2)
+        assert not soak.full_blackout_scripted(sc, 3)
+
+    def test_outage_beyond_handle_list_is_recorded_not_applied(
+            self, lattice):
+        from karpenter_provider_aws_tpu.weather import (SidecarOutage,
+                                                        WeatherScenario,
+                                                        WeatherSimulator)
+        sc = WeatherScenario(
+            name="t", tick_seconds=1.0, reprice_every=0,
+            sidecar_outages=(SidecarOutage(at=0.0, duration=2.0,
+                                           endpoint=7, mode="kill"),))
+        sim = WeatherSimulator(sc, lattice, seed=1, sidecars=[])
+        sim.step(4)    # must not raise; timeline stays deterministic
+        assert [e["kind"] for e in sim.timeline
+                if e["kind"].startswith("sidecar")] == \
+            ["sidecar-outage", "sidecar-restore"]

@@ -55,15 +55,25 @@
 #               bodies counted as malformed), and two runs with the
 #               same seed must record identical weather timelines (and
 #               the lock-order witness reports zero cycles at exit)
-#   9. explain— decision-explainability gate (tools/smoke_explain.py):
+#   9. pool   — solver-pool failover gate (tools/smoke_pool.py): an
+#               operator against a 2-sidecar unix-socket pool, one
+#               sidecar killed mid-churn — passes keep landing on the
+#               survivor (failovers > 0, the local rung never engages
+#               while a sidecar is healthy), a junk-talking endpoint
+#               classifies as sidecar failure, breaker state renders in
+#               the kpctl top POOL row and the karpenter_solver_pool_*
+#               gauges over live HTTP (scrape lints clean), and the
+#               restarted sidecar's breaker re-closes via the half-open
+#               probe
+#  10. explain— decision-explainability gate (tools/smoke_explain.py):
 #               an operator under a short squall with one deliberately
 #               ICE'd-out pod — /debug/explain over live HTTP must
 #               attribute the pending pod to the ice elimination stage,
 #               `kpctl explain pod` must render the waterfall, the
 #               FailedScheduling dedup must hold, and the explain
 #               provider's reason-code histogram must report
-#  10. tier-1 — the full non-slow test suite on the CPU backend
-#  11. bench  — `bench.py --smoke`: one fast config through the real
+#  11. tier-1 — the full non-slow test suite on the CPU backend
+#  12. bench  — `bench.py --smoke`: one fast config through the real
 #               harness, so a broken solve path can never ride in on a
 #               green unit-test run
 
@@ -75,7 +85,7 @@ PY=${PYTHON:-python}
 FAST=0
 [ "${1:-}" = "--fast" ] && FAST=1
 
-echo "=== ci [1/11] generated-artifact drift ==="
+echo "=== ci [1/12] generated-artifact drift ==="
 $PY tools/gen_crds.py --check
 tmp=$(mktemp -d)
 trap 'rm -rf "$tmp"' EXIT
@@ -90,38 +100,41 @@ done
 [ "$stale" = 0 ] || exit 1
 echo "drift: clean"
 
-echo "=== ci [2/11] graftlint (project-invariant static analysis) ==="
+echo "=== ci [2/12] graftlint (project-invariant static analysis) ==="
 $PY tools/lint/run.py --check
 
-echo "=== ci [3/11] introspection smoke + metrics lint ==="
+echo "=== ci [3/12] introspection smoke + metrics lint ==="
 $PY tools/smoke_introspect.py
 
-echo "=== ci [4/11] steady-state delta churn smoke ==="
+echo "=== ci [4/12] steady-state delta churn smoke ==="
 $PY tools/smoke_delta.py
 
-echo "=== ci [5/11] sharded mesh smoke ==="
+echo "=== ci [5/12] sharded mesh smoke ==="
 $PY tools/smoke_sharded.py
 
-echo "=== ci [6/11] continuous-profiling smoke ==="
+echo "=== ci [6/12] continuous-profiling smoke ==="
 $PY tools/smoke_profile.py
 
-echo "=== ci [7/11] write-path smoke ==="
+echo "=== ci [7/12] write-path smoke ==="
 $PY tools/smoke_writepath.py
 
-echo "=== ci [8/11] adversarial-weather smoke ==="
+echo "=== ci [8/12] adversarial-weather smoke ==="
 $PY tools/smoke_weather.py
 
-echo "=== ci [9/11] decision-explainability smoke ==="
+echo "=== ci [9/12] solver-pool failover smoke ==="
+$PY tools/smoke_pool.py
+
+echo "=== ci [10/12] decision-explainability smoke ==="
 $PY tools/smoke_explain.py
 
-echo "=== ci [10/11] tier-1 tests ==="
+echo "=== ci [11/12] tier-1 tests ==="
 $PY -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider
 
 if [ "$FAST" = 1 ]; then
-    echo "=== ci [11/11] bench smoke: SKIPPED (--fast) ==="
+    echo "=== ci [12/12] bench smoke: SKIPPED (--fast) ==="
 else
-    echo "=== ci [11/11] bench smoke ==="
+    echo "=== ci [12/12] bench smoke ==="
     $PY bench.py --smoke
 fi
 

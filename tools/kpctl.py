@@ -581,6 +581,24 @@ def _render_top(doc, server: str):
         f"delta {g('solver', 'delta_solves'):g} "
         f"({g('solver', 'delta_dirty_groups'):g} dirty grp)   "
         f"degraded {degraded:g}")
+    # the solver failover pool (docs/reference/solver-pool.md): endpoint
+    # health, breaker states, failovers. Absent without --solver-address.
+    if "solver_pool" in p:
+        sp_ = p["solver_pool"]
+        n_ep = sp_.get("endpoints", 0)
+        states = []
+        if isinstance(n_ep, (int, float)):
+            for i in range(int(n_ep)):
+                st = sp_.get(f"ep{i}_state")
+                states.append({0: "closed", 1: "half-open",
+                               2: "open"}.get(st, "?"))
+        lines.append(
+            f"POOL      {n_ep:g} endpoints "
+            f"({sp_.get('healthy', 0):g} healthy)   "
+            f"delegated {sp_.get('delegated_solves', 0):g}   "
+            f"failovers {sp_.get('failovers', 0):g}   "
+            f"local {sp_.get('local_solves', 0):g}   "
+            f"breakers " + (",".join(states) or "-"))
     rh, rm = g("solver", "resident_hits"), g("solver", "resident_misses")
     hitpct = 100.0 * rh / (rh + rm) if (rh + rm) else 0.0
     ph = g("solver", "resident_problem_hits")

@@ -52,6 +52,15 @@ the ladder demonstrably engaged, interruptions demonstrably handled,
 and the recorded weather timeline byte-identical to a same-seed replay.
 The verdict + timeline land in a ``WEATHER_*.json.gz`` artifact
 (``--weather-out``).
+
+``--solver-pool N`` composes CONTROL-PLANE weather with all of the
+above: N chaos-capable solver sidecars are spawned in-process on unix
+sockets and the operator runs against them as a failover pool
+(parallel/pool.py SolverPool). Scenario ``SidecarOutage`` elements (the
+``blackout`` scenario) kill/hang/junk the endpoints mid-run; the run
+then gates on failovers > 0, zero solve-error passes, the local rung
+engaging ONLY under a scripted full blackout, and every breaker closed
+again after the outage window (docs/reference/solver-pool.md).
 """
 
 from __future__ import annotations
@@ -92,6 +101,23 @@ def parse_fault_schedule(spec: str):
             raise SystemExit(f"fault action {name} needs =N")
         out.append((float(at), name, int(val) if val else None))
     return sorted(out)
+
+
+def full_blackout_scripted(scenario, n_endpoints: int) -> bool:
+    """True when the scenario's SidecarOutage windows cover EVERY pool
+    endpoint at some instant — the only condition under which the local
+    solve rung is allowed to engage (degraded_reason=pool-exhausted)."""
+    if n_endpoints <= 0 or not scenario.sidecar_outages:
+        return False
+    edges = sorted({o.at for o in scenario.sidecar_outages}
+                   | {o.at + o.duration for o in scenario.sidecar_outages})
+    for a, b in zip(edges, edges[1:]):
+        mid = (a + b) / 2.0
+        out = {o.endpoint for o in scenario.sidecar_outages
+               if o.at <= mid < o.at + o.duration}
+        if set(range(n_endpoints)) <= out:
+            return True
+    return False
 
 
 def apply_fault(solver, name: str, val):
@@ -162,6 +188,26 @@ def main(argv=None) -> int:
     ap.add_argument("--weather-out", default="",
                     help="weather artifact path (default "
                          "WEATHER_<scenario>.json.gz; '' means default)")
+    ap.add_argument("--solver-pool", type=int, default=0,
+                    help="spawn N in-process chaos-capable solver "
+                         "sidecars on unix sockets and run the operator "
+                         "against them as a failover pool "
+                         "(parallel/pool.py SolverPool; docs/reference/"
+                         "solver-pool.md). Weather SidecarOutage "
+                         "elements (the 'blackout' scenario) drive "
+                         "kill/hang/junk against these endpoints; the "
+                         "run then GATES on failovers > 0, the pool "
+                         "recovering (every breaker closed at exit), "
+                         "zero solve-error passes, and the local rung "
+                         "engaging only under a scripted full blackout")
+    ap.add_argument("--solver-solve-deadline", type=float, default=5.0,
+                    help="solve RPC deadline against pool endpoints "
+                         "(seconds; --solver-pool only). 5 s bounds a "
+                         "hung endpoint's cost per pass in a wall-clock "
+                         "soak while leaving room for a cold bucket "
+                         "compile (run --warm-start with a populated "
+                         "--compile-cache-dir to take compiles out of "
+                         "the run entirely)")
     ap.add_argument("--compile-cache-dir", default="",
                     help="persistent XLA compile cache directory "
                          "(solver/solve.py enable_persistent_compile_cache)"
@@ -200,11 +246,32 @@ def main(argv=None) -> int:
         from karpenter_provider_aws_tpu.kube.apiserver import NotFoundError as KubeNotFound
         api_server = FakeAPIServer()
         client = KubeClient(api_server)
+    chaos_sidecars = []
+    solver_address = ""
+    if args.solver_pool:
+        # N chaos-capable sidecars in THIS process (own Solver each,
+        # shared jit cache) — the weather simulator's SidecarOutage seam
+        # and the pool's failover ladder run against real gRPC endpoints
+        import tempfile
+        from karpenter_provider_aws_tpu.parallel.sidecar import ChaosSidecar
+        from karpenter_provider_aws_tpu.solver import Solver as _Solver
+        pool_dir = tempfile.mkdtemp(prefix="soak-pool-")
+        for n in range(args.solver_pool):
+            sc = ChaosSidecar(_Solver(lattice),
+                              f"unix:{pool_dir}/sidecar{n}.sock").start()
+            chaos_sidecars.append(sc)
+        solver_address = ",".join(s.address for s in chaos_sidecars)
+        print(f"soak: solver pool of {args.solver_pool} sidecars "
+              f"({solver_address})")
     op = Operator(options=Options(registration_delay=0.2,
                                   batch_idle_duration=0.05,
                                   batch_max_duration=0.5,
                                   interruption_queue="soak-q",
                                   mesh=args.mesh,
+                                  solver_address=solver_address,
+                                  solver_solve_deadline=(
+                                      args.solver_solve_deadline
+                                      if args.solver_pool else 0.0),
                                   compile_cache_dir=args.compile_cache_dir),
                   lattice=lattice, interruption_queue=q,
                   api_server=api_server)
@@ -230,7 +297,12 @@ def main(argv=None) -> int:
                   else args.weather_seed),
             clock=op.clock, pricing=op.pricing_provider, cloud=op.cloud,
             unavailable=op.unavailable, queue=q, solver=op.solver,
-            metrics=op.metrics)
+            metrics=op.metrics, sidecars=chaos_sidecars)
+        if scenario.sidecar_outages and not chaos_sidecars:
+            print("soak: scenario scripts sidecar outages but no "
+                  "--solver-pool is attached — the control-plane "
+                  "weather would be vacuous")
+            return 1
         introspect.registry().register("weather", weather_sim.stats)
         print(f"soak: weather scenario {scenario.name!r} "
               f"seed={weather_sim.seed} tick={scenario.tick_seconds}s "
@@ -567,6 +639,58 @@ def main(argv=None) -> int:
             print("soak: weather regimes configured but none activated "
                   "(regime_shifts=0)")
             ok = False
+        # control-plane weather gates (docs/reference/solver-pool.md):
+        # a blackout drill must demonstrably have exercised the pool —
+        # failovers happened, the local rung engaged ONLY under a
+        # scripted full blackout, no pass was lost to a solve error,
+        # and the pool RECOVERED (every breaker closed again after the
+        # outage windows + convergence tail)
+        if wsc.sidecar_outages and chaos_sidecars:
+            # give the breakers their probation: the half-open probe
+            # rides the injected clock (wall time here), and repeated
+            # opens back off up to ~30 s — poll until every endpoint is
+            # closed again or the recovery budget runs out
+            recover_deadline = time.monotonic() + 45.0
+            while time.monotonic() < recover_deadline:
+                op.solver.check_endpoints()
+                pst = op.solver.pool_stats()
+                if pst["healthy"] == pst["endpoints"]:
+                    break
+                time.sleep(0.5)
+            pst = op.solver.pool_stats()
+            full_blackout = full_blackout_scripted(wsc,
+                                                   len(chaos_sidecars))
+            print(f"soak: pool endpoints={pst['endpoints']} "
+                  f"healthy={pst['healthy']} "
+                  f"failovers={pst['failovers']} "
+                  f"delegated={pst['delegated_solves']} "
+                  f"local={pst['local_solves']} "
+                  f"breakers="
+                  + ",".join(op.solver.breaker_states().values()))
+            if pst["failovers"] == 0:
+                print("soak: sidecar outages scripted but the pool "
+                      "never failed over (failovers=0)")
+                ok = False
+            if pst["healthy"] != pst["endpoints"]:
+                print("soak: pool did not recover after the outage "
+                      f"window ({pst['healthy']}/{pst['endpoints']} "
+                      "breakers closed)")
+                ok = False
+            if full_blackout and pst["local_solves"] == 0:
+                print("soak: a full blackout was scripted but the "
+                      "local rung never engaged (local_solves=0)")
+                ok = False
+            if not full_blackout and pst["local_solves"] > 0:
+                print(f"soak: local rung engaged {pst['local_solves']}x "
+                      "without a scripted full blackout (a healthy "
+                      "endpoint existed the whole run)")
+                ok = False
+            solve_errors = op.provisioner.explain.stats().get(
+                "reason_solve_error", 0)
+            if solve_errors:
+                print(f"soak: {solve_errors:g} passes lost to "
+                      "solve-error under control-plane weather")
+                ok = False
         t_base = monitor.samples[0]["t"] if monitor.samples else 0.0
         burn_series = [
             [round(s["t"] - t_base, 1),
@@ -577,6 +701,8 @@ def main(argv=None) -> int:
             slo=slo, burn_series=burn_series,
             degraded_counts=dict(op.solver.degraded_counts),
             solver_faults_fired=solver_fired,
+            solver_pool=(op.solver.pool_stats()
+                         if chaos_sidecars else None),
             interruption=intr, interruptions_handled=handled,
             replay_match=replay_match,
             soak={"pods_churned": i, "minutes": args.minutes,
@@ -708,6 +834,15 @@ def main(argv=None) -> int:
         print(f"soak: weather artifact -> {wout} "
               f"({len(weather_doc['timeline'])} timeline events, "
               f"{len(weather_doc['burn_series'])} burn samples)")
+    if chaos_sidecars:
+        pst = op.solver.pool_stats()
+        print(f"soak: pool exit state endpoints={pst['endpoints']} "
+              f"healthy={pst['healthy']} failovers={pst['failovers']} "
+              f"delegated={pst['delegated_solves']} "
+              f"local={pst['local_solves']}")
+        op.solver.close()
+        for sc_h in chaos_sidecars:
+            sc_h.kill()
     print("soak: INVARIANTS " + ("OK" if ok else "VIOLATED"))
     if not ok:
         print(dump_state(op))

@@ -1033,6 +1033,277 @@ def run_steady_state_config(lattice, solver):
     return delta_p50, detail
 
 
+# the device-resident microloop row (BENCH_r14, `bench.py --device-delta`):
+# per-pass link legs are bounded — one dirty upload plus one CONDITIONAL
+# plan fetch on a single device; a mesh pass whose plan moved pays two
+# more for the fused tail-bin merge — and the <20 ms bar is judged on
+# the PLUMBING share (e2e minus the device kernel wait): on the CPU
+# stand-in backend the kernel alone is ~40x the whole budget
+# (BENCH_r06: 768 ms), while BENCH_r05 measured the real-device kernel
+# at 3-9 ms, so kernel time is refereed separately via the device cost
+# model (last_vs_model ≫ 1 = plumbing, not kernel) exactly as ROADMAP
+# item 2 prescribes.
+MICRO_LEGS_BOUND = 2           # single-device steady pass
+MICRO_LEGS_BOUND_MERGE = 4     # mesh pass that re-ran the tail-bin merge
+MICRO_LVM_BOUND = 25.0         # last_vs_model sanity bound for the record
+MICRO_NOCHURN_EVERY = 4        # every Nth pass churns nothing: the
+                               # fingerprint must suppress the plan fetch
+
+
+def run_microloop_config(lattice, solver, parity_every=1,
+                         require_target=True):
+    """The BENCH_r14 harness: cfg10's steady-state shape driven through
+    the incremental builder + the device-resident microloop, with
+    per-pass link legs recorded, no-churn passes interleaved (the
+    skipped-sync evidence), byte-exact plan parity against a
+    full-rebuild referee SOLVER (its own instance — the comparison can
+    never ride the resident state it referees), and the device cost
+    model's last_vs_model as the kernel-vs-plumbing referee."""
+    from karpenter_provider_aws_tpu.apis import Pod, serde
+    from karpenter_provider_aws_tpu.solver import Solver, build_problem
+    from karpenter_provider_aws_tpu.solver import costmodel
+    from karpenter_provider_aws_tpu.solver.incremental import (
+        IncrementalProblemBuilder)
+    from karpenter_provider_aws_tpu.solver.problem import ExistingBin
+    from karpenter_provider_aws_tpu.state.cluster import DirtySet
+
+    def canon(plan):
+        return json.dumps(serde.plan_semantic_dict(plan), sort_keys=True)
+
+    pods, pools, shapes = config10_steady_state()
+    rng = np.random.default_rng(14)
+    referee = Solver(lattice, mesh=solver.mesh)
+
+    from karpenter_provider_aws_tpu.apis.resources import RESOURCE_AXES
+    gpuish = [RESOURCE_AXES.index(a) for a in RESOURCE_AXES
+              if "gpu" in a or "neuron" in a or "gaudi" in a]
+    cand_pool = [(s_.od_price, s_.name) for s_ in lattice.specs
+                 if s_.od_price > 0 and s_.vcpus >= 8
+                 and not any(lattice.capacity[lattice.name_to_idx[s_.name], ax]
+                             for ax in gpuish)]
+    cands = [n for _, n in sorted(cand_pool)[:4]] or list(lattice.names[:4])
+    existing = []
+    for i in range(120):
+        itype = cands[int(rng.integers(len(cands)))]
+        ti = lattice.name_to_idx[itype]
+        used = (lattice.alloc[ti] * 0.2).astype(np.float32)
+        existing.append(ExistingBin(
+            name=f"node-{i}", node_pool="default", instance_type=itype,
+            zone=lattice.zones[int(rng.integers(len(lattice.zones)))],
+            capacity_type="on-demand", used=used))
+
+    builder = IncrementalProblemBuilder()
+
+    # cold pass: compile + full build + microloop priming (excluded)
+    t_first = time.perf_counter()
+    res = builder.build(pods, pools, lattice, existing=list(existing),
+                        dirty=DirtySet(since=-1, rev=0, full=True))
+    solver.solve(res.problem)
+    solver.solve_delta(res.problem)     # prime the resident problem state
+    first_ms = (time.perf_counter() - t_first) * 1000.0
+
+    pass_ms, pass_rtt, pass_plumbing = [], [], []
+    pass_legs, merge_passes, pass_regrows = [], [], []
+    parity_all = True
+    fallbacks = []
+    serial = 0
+    pre_skipped = solver.pipeline_stats["micro_skipped_syncs"]
+    pre_micro = solver.pipeline_stats["micro_solves"]
+    pre_delta = solver.pipeline_stats["delta_solves"]
+    for pass_i in range(DELTA_PASSES):
+        nochurn = (pass_i % MICRO_NOCHURN_EVERY) == (MICRO_NOCHURN_EVERY - 1)
+        touched = {}
+        if not nochurn:
+            k = max(1, int(len(pods) * DELTA_CHURN_FRACTION))
+            gone_idx = set(int(i) for i in
+                           rng.choice(len(pods), size=k, replace=False))
+            removed = [pods[i] for i in gone_idx]
+            pods = [p for i, p in enumerate(pods) if i not in gone_idx]
+            added = []
+            for _ in range(k):
+                serial += 1
+                req, sel = shapes[int(rng.integers(len(shapes)))]
+                added.append(Pod(name=f"churn-{serial}", requests=req,
+                                 node_selector=sel))
+            pods += added
+            for b in rng.choice(len(existing), size=2, replace=False):
+                u = existing[int(b)].used.copy()
+                u[0] += 0.25
+                existing[int(b)].used = u
+            touched = {p.name: ("gone", None) for p in removed}
+            touched.update({p.name: ("pending", p) for p in added})
+        dirty = DirtySet(since=builder.rev, rev=builder.rev + 1,
+                         pods=set(touched), bins=not nochurn)
+
+        pre_merge = solver.pipeline_stats["micro_merge_solves"]
+        pre_regrow = solver.pipeline_stats["micro_merge_regrows"]
+        t0 = time.perf_counter()
+        res = builder.build(pods, pools, lattice,
+                            existing=lambda: list(existing),
+                            dirty=dirty, touched=touched)
+        if res.incremental:
+            plan = solver.solve_delta(res.problem,
+                                      dirty_groups=res.dirty_groups)
+        else:
+            fallbacks.append(res.reason)
+            plan = solver.solve(res.problem)
+        t_end = time.perf_counter()
+        pass_ms.append((t_end - t0) * 1000.0)
+        pass_plumbing.append((t_end - t0 - plan.device_seconds) * 1000.0)
+        pass_rtt.append(_rtt_probe())
+        if res.incremental:
+            # micro_last_legs is only meaningful for delta passes; a
+            # full-build fallback never updates it and its re-staging
+            # legs are exactly what the fallback list already flags
+            pass_legs.append(solver.pipeline_stats["micro_last_legs"])
+            merge_passes.append(
+                solver.pipeline_stats["micro_merge_solves"] > pre_merge)
+            pass_regrows.append(
+                solver.pipeline_stats["micro_merge_regrows"] - pre_regrow)
+
+        if pass_i % parity_every == 0:
+            # two referees, two claims: (1) the MICROLOOP's — its plan
+            # is byte-identical to a full-staging solve of the SAME
+            # problem (delta machinery changes bytes moved, never the
+            # answer); (2) the BUILDER's — the incrementally-patched
+            # problem plans the same node multiset at the same cost as
+            # a from-scratch build (pod ordering inside groups may
+            # differ, so byte identity is not the builder's contract —
+            # solver/incremental.py, tests/test_incremental.py)
+            ref_same = referee.solve(res.problem)
+            if canon(plan) != canon(ref_same):
+                parity_all = False
+            scratch = build_problem(pods, pools, lattice,
+                                    existing=list(existing))
+            ref = referee.solve(scratch)
+            if (sorted((n.instance_type, n.zone, len(n.pods))
+                       for n in plan.new_nodes)
+                    != sorted((n.instance_type, n.zone, len(n.pods))
+                              for n in ref.new_nodes)
+                    or abs(plan.new_node_cost - ref.new_node_cost) > 1e-6):
+                parity_all = False
+
+    skipped = solver.pipeline_stats["micro_skipped_syncs"] - pre_skipped
+    micro = solver.pipeline_stats["micro_solves"] - pre_micro
+    deltas = solver.pipeline_stats["delta_solves"] - pre_delta
+    # a merge bin-table regrow retry re-stages and re-fetches (2 more
+    # accounted legs) — behaviorally correct, so the bound stretches by
+    # exactly what the regrows paid, never silently
+    legs_ok = all(
+        legs <= (MICRO_LEGS_BOUND_MERGE if merged else MICRO_LEGS_BOUND)
+        + 2 * regrows
+        for legs, merged, regrows in zip(pass_legs, merge_passes,
+                                         pass_regrows))
+    cm = costmodel.model().stats()
+    lvm = float(cm.get("last_vs_model", 0.0))
+    e2e_p50 = float(np.percentile(pass_ms, 50))
+    plumbing_p50 = float(np.percentile(pass_plumbing, 50))
+    algo_p50 = float(np.percentile(
+        [max(d - r, 0.0) for d, r in zip(pass_ms, pass_rtt)], 50))
+    st = solver.stats()
+    detail = {
+        "pods": len(pods),
+        "groups": res.problem.G,
+        "existing_nodes": len(existing),
+        "passes": DELTA_PASSES,
+        "churn_pct": round(2 * DELTA_CHURN_FRACTION * 100, 2),
+        "mesh_devices": st.get("mesh_devices", 1),
+        "e2e_p50_ms": round(e2e_p50, 3),
+        "e2e_algo_p50_ms": round(algo_p50, 3),
+        # the share the microloop controls (e2e minus the device kernel
+        # wait) — the <20 ms judgement basis on the CPU stand-in, per
+        # the MICRO_LEGS_BOUND comment above
+        "plumbing_p50_ms": round(plumbing_p50, 3),
+        "compile_prime_ms": round(max(first_ms - e2e_p50, 0.0), 3),
+        "micro_solves": micro,
+        "delta_solves": deltas,
+        "micro_engaged_every_delta": micro == deltas,
+        "full_build_fallbacks": fallbacks,
+        "skipped_syncs": skipped,
+        "nochurn_passes": DELTA_PASSES // MICRO_NOCHURN_EVERY,
+        "legs_per_pass": pass_legs,
+        "legs_max": max(pass_legs) if pass_legs else 0,
+        "merge_passes": int(sum(merge_passes)),
+        "merge_regrows": int(sum(pass_regrows)),
+        "legs_bound": MICRO_LEGS_BOUND,
+        "legs_bound_merge": MICRO_LEGS_BOUND_MERGE,
+        "legs_within_bound": legs_ok,
+        "link_upload_bytes": st["link_upload_bytes"],
+        "link_fetch_bytes": st["link_fetch_bytes"],
+        "upload_bytes_per_pass": int(st["link_upload_bytes"]
+                                     / max(DELTA_PASSES, 1)),
+        "last_vs_model": round(lvm, 3),
+        "last_vs_model_bound": MICRO_LVM_BOUND,
+        "plan_parity_vs_full_rebuild": parity_all,
+        "delta_target_ms": DELTA_TARGET_MS,
+        "within_target": plumbing_p50 <= DELTA_TARGET_MS,
+        # the <20 ms bar binds the single-device device-backend row;
+        # the mesh row records its plumbing honestly (8x per-shard host
+        # decode on the VIRTUAL mesh is host work a real multi-chip
+        # backend does not serialize) but is gated on parity/legs only
+        "target_gated": require_target,
+    }
+    ok = (parity_all and legs_ok
+          and (detail["within_target"] or not require_target)
+          and micro > 0 and skipped > 0
+          and (lvm == 0.0 or lvm <= MICRO_LVM_BOUND))
+    return e2e_p50, detail, ok
+
+
+def run_device_delta_artifact(catalog="real",
+                              out="BENCH_r14_device_delta.json"):
+    """The BENCH_r14 recording (`bench.py --device-delta`): the
+    device-resident microloop's steady-state row on a single device AND
+    composed with the forced 8-way virtual mesh, next to the cfg10
+    baseline numbers those rows improve on. main() pins the virtual-CPU
+    mesh sizing exactly like --sharded; the artifact's "backend" field
+    records which backend actually ran."""
+    import jax
+
+    from karpenter_provider_aws_tpu.lattice import build_lattice
+    from karpenter_provider_aws_tpu.parallel import plan_mesh
+    from karpenter_provider_aws_tpu.solver import Solver
+
+    if catalog == "synthetic":
+        lattice, catalog_name = build_lattice(), "synthetic"
+    else:
+        from karpenter_provider_aws_tpu.lattice.realdata import load_catalog
+        path = None if catalog == "real" else catalog
+        lattice = build_lattice(load_catalog(path, require_price=True))
+        catalog_name = "real:" + (catalog if path else "reference")
+
+    doc = {
+        "round": "BENCH_r14",
+        "catalog": catalog_name,
+        "backend": jax.default_backend(),
+        "link_rtt_ms": round(measure_link_rtt(), 3),
+        "rows": {},
+    }
+
+    p50, detail, ok1 = run_microloop_config(lattice, Solver(lattice))
+    doc["rows"]["cfg14_micro_single_device"] = detail
+    print(json.dumps({"metric": "e2e_p50_latency_cfg14_micro_single_device",
+                      "value": round(p50, 3), "unit": "ms",
+                      "detail": detail}), flush=True)
+
+    mesh_plan = plan_mesh("8")
+    mp50, mdetail, ok2 = run_microloop_config(
+        lattice, Solver(lattice, mesh=mesh_plan.mesh), parity_every=3,
+        require_target=False)
+    mdetail["mesh_devices"] = mesh_plan.devices
+    doc["rows"]["cfg14_micro_on_mesh"] = mdetail
+    print(json.dumps({"metric": "e2e_p50_latency_cfg14_micro_on_mesh",
+                      "value": round(mp50, 3), "unit": "ms",
+                      "detail": mdetail}), flush=True)
+
+    ok = bool(ok1 and ok2 and mdetail["mesh_devices"] > 1)
+    doc["acceptance_ok"] = ok
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    print(f"wrote {out} (acceptance_ok={ok})", flush=True)
+    return 0 if ok else 1
+
+
 # budget on ALGORITHM-controlled time for the north-star config: e2e p50
 # minus the measured link RTT must stay under this, so link weather and
 # real regressions are distinguishable in the bench record. Recalibrated
@@ -1196,6 +1467,18 @@ def main(argv=None):
                          "artifact's \"backend\" field says which ran.")
     ap.add_argument("--sharded-out", default="MULTICHIP_r06.json",
                     help="artifact path for --sharded")
+    ap.add_argument("--device-delta", action="store_true",
+                    help="device-resident microloop artifact ONLY "
+                         "(BENCH_r14): cfg10's steady-state shape driven "
+                         "through the reconcile microloop on a single "
+                         "device and on the forced 8-way virtual mesh — "
+                         "per-pass link legs bounded, fingerprint-"
+                         "suppressed plan fetches counted, byte-exact "
+                         "parity vs a full-rebuild referee, last_vs_model "
+                         "as the kernel-vs-plumbing referee. Forces the "
+                         "virtual CPU mesh exactly like --sharded.")
+    ap.add_argument("--device-delta-out", default="BENCH_r14_device_delta.json",
+                    help="artifact path for --device-delta")
     ap.add_argument("--writepath", action="store_true",
                     help="API-stratum write-path row ONLY: per-pod "
                          "write+deliver cost at 1k/15k/50k stored pods x "
@@ -1207,7 +1490,7 @@ def main(argv=None):
     if args.writepath:
         raise SystemExit(run_writepath_bench())
 
-    if args.sharded:
+    if args.sharded or args.device_delta:
         # BEFORE the first jax import (nothing above here imports it):
         # size the virtual CPU mesh exactly like the multichip dry-run
         # unless a real non-cpu backend is configured
@@ -1221,6 +1504,9 @@ def main(argv=None):
                 ).strip()
             import jax
             jax.config.update("jax_platforms", "cpu")
+        if args.device_delta:
+            raise SystemExit(run_device_delta_artifact(
+                catalog=args.catalog, out=args.device_delta_out))
         raise SystemExit(run_sharded_artifact(catalog=args.catalog,
                                               out=args.sharded_out))
 

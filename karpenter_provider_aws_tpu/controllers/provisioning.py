@@ -130,6 +130,12 @@ class Provisioner:
         # "Steady-state reconciles & the compile cache")
         self.inc_builder = IncrementalProblemBuilder()
         self._delta_enabled = bool(getattr(solver, "supports_delta", False))
+        from ..state.cluster import DirtyJournalCoalescer
+        # journal → device-block coalescer (docs/reference/microloop.md):
+        # batch-window polls drain the dirty journal incrementally, so a
+        # pass starts from an already-merged delta covering every tick
+        # since the last build instead of one long locked journal walk
+        self.journal_coalescer = DirtyJournalCoalescer(cluster)
         m = wire_core_metrics(metrics or Registry())  # single source of truth
         self._m_sched = m["scheduling_duration"]
         self._m_sim = m["scheduling_simulation_duration"]
@@ -144,6 +150,11 @@ class Provisioner:
         self._m_stage = m["solver_stage_duration"]
         self._m_delta = m["solver_delta_solves"]
         self._m_dirty_groups = m["solver_dirty_groups"]
+        self._m_link_legs = m["solver_link_legs"]
+        self._m_link_bytes = m["solver_link_bytes"]
+        # last mirrored solver link_stats values (the counters are
+        # cumulative on the Solver; the metric counters inc by delta)
+        self._link_prev: Dict[str, int] = {}
         self._m_pods_state = m["pods_state"]
         self._m_unsched_reasons = m["pods_unschedulable_reasons"]
         self._m_eliminations = m["explain_eliminations"]
@@ -180,7 +191,14 @@ class Provisioner:
         """Has the pending-pod batch window closed? New arrivals reset the
         idle timer; the max window bounds total latency. Arrival detection
         compares the pending-pod NAME set, not its size — one pod binding
-        while another arrives in the same window is still an arrival."""
+        while another arrives in the same window is still an arrival.
+
+        Every poll also streams the dirty journal into the coalescer:
+        the open batch window is exactly when the controller is "behind"
+        on ticks, and draining here keeps the pass-start journal walk
+        O(since last poll) instead of O(since last pass)."""
+        if self._delta_enabled:
+            self.journal_coalescer.tick(self.inc_builder.rev)
         now = self.clock.now()
         with self._lock:
             names = frozenset(p.name for p in self.cluster.pending_pods())
@@ -277,9 +295,13 @@ class Provisioner:
             return resolved["bound"]
 
         problem0 = None   # the round-0 problem (carries the ledgers)
+        batched = [False]   # overlap seam fired (observation staged)?
         try:
             if self._delta_enabled:
-                dirty = self.cluster.dirty_since(self.inc_builder.rev)
+                # the coalescer already merged every journal tick since
+                # the last build (batch_ready polls drain it); take() is
+                # one short drain, not the whole backlog
+                dirty = self.journal_coalescer.take(self.inc_builder.rev)
                 if rev0 is not None:
                     # key the build at the pre-snapshot revision: journal
                     # entries racing the pending snapshot stay > rev0 and
@@ -294,12 +316,22 @@ class Provisioner:
                     pool_headroom=headroom, dirty=dirty, touched=touched)
                 problem0 = build.problem
                 if build.incremental:
-                    # the steady-state fast path: patched problem, device-
-                    # resident inputs, dirty blocks only over the link
+                    # the steady-state fast path: patched problem, the
+                    # device-resident microloop, dirty blocks only over
+                    # the link. Admission bookkeeping rides the in-
+                    # flight dispatch through the overlap seam instead
+                    # of serializing behind the solve.
+                    # the seam only STAGES the observation — the commit
+                    # happens after the solve lands, so a pass whose
+                    # dispatch fired the seam but then dropped its wave
+                    # (post-dispatch device fault + fallback failure)
+                    # never skews the admission histograms
+                    def _admission_overlap():
+                        batched[0] = True
                     plan = self.solver.solve_delta(
-                        build.problem, dirty_groups=build.dirty_groups)
+                        build.problem, dirty_groups=build.dirty_groups,
+                        overlap=_admission_overlap)
                     self._m_delta.inc()
-                    self._m_dirty_groups.observe(len(build.dirty_groups))
                 else:
                     # full path; round 0 reuses the problem already built
                     plan = self.solver.solve_relaxed(
@@ -319,9 +351,14 @@ class Provisioner:
             # PARTIAL (empty) result — the pods stay pending and the next
             # pass retries — instead of dropping the wave with a crash.
             return self._solve_failed(e, len(pending))
+        # admission metrics commit only for a LANDED wave (a failed pass
+        # returned above) — the staged overlap observation included
         self._m_batch.observe(len(pending))
+        if batched[0]:
+            self._m_dirty_groups.observe(len(build.dirty_groups))
         self._m_sched.observe(plan.solve_seconds)
         self._m_sim.observe(plan.device_seconds)
+        self._mirror_link_metrics()
         if self.slo is not None:
             # the rolling latency window behind
             # karpenter_slo_latency_budget_burn; the cost referee is
@@ -594,10 +631,35 @@ class Provisioner:
                 # the solver provider)
                 "incremental_builds": self.inc_builder.incremental_builds,
                 "full_builds": self.inc_builder.full_builds,
+                # journal → device-block coalescer activity (state/
+                # cluster.py DirtyJournalCoalescer): batch-window drains,
+                # pass-start takes, and anchor-mismatch fallbacks
+                "journal_ticks": self.journal_coalescer.ticks,
+                "journal_takes": self.journal_coalescer.takes,
+                "journal_take_fallbacks": self.journal_coalescer.fallbacks,
             }
             out.update({"last_pass_" + k: v
                         for k, v in self._last_pass.items()})
         return out
+
+    def _mirror_link_metrics(self) -> None:
+        """Mirror the solver's cumulative link accounting into the
+        karpenter_solver_link_legs_total / _link_bytes_total counters
+        (per-pass delta inc — the solver counts transfers, the metric
+        registry owns exposition). A solver without link accounting
+        (RemoteSolver, SolverPool) simply never moves these."""
+        ls = getattr(self.solver, "link_stats", None)
+        if not ls:
+            return
+        for direction in ("upload", "fetch"):
+            for kind, metric in (("legs", self._m_link_legs),
+                                 ("bytes", self._m_link_bytes)):
+                k = f"{direction}_{kind}"
+                cur = int(ls.get(k, 0))
+                d = cur - self._link_prev.get(k, 0)
+                if d > 0:
+                    metric.inc(d, direction=direction)
+                self._link_prev[k] = cur
 
     # ---- degradation observation (docs/concepts/degradation.md) ----------
 

@@ -336,6 +336,25 @@ def wire_core_metrics(reg: Registry) -> Dict[str, _Metric]:
             "Signature groups whose membership changed per delta solve "
             "(the re-tensorized share of the problem).", (),
             buckets=(0, 1, 2, 4, 8, 16, 32, 64)),
+        # host↔device link accounting (docs/reference/microloop.md): a
+        # LEG is a transfer whose size scales with the problem or plan
+        # (fused input uploads, dirty-block scatters, result fetches);
+        # O(1) control syncs — the microloop's changed-plan fingerprint
+        # — are excluded, because they cannot regress to full
+        # re-staging. A steady-state microloop pass pays ≤2 legs (one
+        # dirty upload, one CONDITIONAL plan fetch); a pass that
+        # silently regresses to full re-staging shows up here without
+        # waiting for a bench.
+        "solver_link_legs": reg.counter(
+            "karpenter_solver_link_legs_total",
+            "Host-device link transfers on the solve path (direction: "
+            "upload | fetch). Steady-state microloop passes are bounded "
+            "at one dirty upload plus one conditional plan fetch.",
+            ("direction",)),
+        "solver_link_bytes": reg.counter(
+            "karpenter_solver_link_bytes_total",
+            "Bytes that crossed the host-device link on the solve path "
+            "(direction: upload | fetch).", ("direction",)),
         # the mesh production path (parallel/mesh.py + docs/reference/
         # sharding.md): device count of the solver's mesh and the last
         # sharded solve's per-shard load balance. devices == 1 means the

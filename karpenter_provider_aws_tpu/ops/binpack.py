@@ -670,6 +670,84 @@ def pack_packed_combined(alloc: jnp.ndarray, avail: jnp.ndarray,
                               lean=lean)
 
 
+def seed_layout(B: int, T: int, Z: int, C: int, R: int,
+                A: int) -> Tuple[Tuple[FieldSpec, ...], int]:
+    """Byte layout of the fused SEEDED-BinState upload (the sharded
+    solve's tail-bin merge). Unlike the existing-bin table
+    (init_layout), merge seed rows are mid-pack state rebuilt from
+    shard results: full cum/alloc_cap rows, multi-hot masks, OPEN
+    non-fixed bins, live pm/po accumulators, and an explicit next_open
+    cursor — so they cannot ride the one-hot init staging. Staged
+    per-array this was eleven device_puts per merge; fused it is one.
+    FieldSpec.src is unused here (the host writes rows straight from
+    decoded shard state, solver/solve.py _merge_solve)."""
+    fields = [
+        ("s_cum", np.float32, (B, R), "", 0),
+        ("s_alloc", np.float32, (B, R), "", np.inf),
+        ("s_pm", np.int32, (B, A), "", 0),
+        ("s_np", np.int32, (B,), "", -1),
+        ("s_npods", np.int32, (B,), "", 0),
+        ("s_next", np.int32, (1,), "", 0),
+        ("s_tmask", np.uint8, (B, T), "", 0),
+        ("s_zmask", np.uint8, (B, Z), "", 0),
+        ("s_cmask", np.uint8, (B, C), "", 0),
+        ("s_open", np.uint8, (B,), "", 0),
+        ("s_fixed", np.uint8, (B,), "", 0),
+        ("s_po", np.uint8, (B, A), "", 0),
+    ]
+    out, off = [], 0
+    for name, dt, shape, src, fill in fields:
+        out.append(FieldSpec(name, off, dt, shape, src, fill))
+        off += int(np.prod(shape)) * np.dtype(dt).itemsize
+    return tuple(out), off
+
+
+def _unpack_seed(buf: jnp.ndarray, B: int, T: int, Z: int, C: int,
+                 A: int, R: int) -> BinState:
+    """Fused seed upload → BinState, bit-exact with the per-array
+    staging it replaces (bitcasts and bool casts only)."""
+    layout, _total = seed_layout(B, T, Z, C, R, A)
+    vals = {}
+    for f in layout:
+        n = int(np.prod(f.shape))
+        if f.dtype is np.uint8:
+            vals[f.name] = buf[f.offset: f.offset + n].reshape(f.shape).astype(bool)
+        else:
+            tgt = jnp.float32 if f.dtype is np.float32 else jnp.int32
+            vals[f.name] = jax.lax.bitcast_convert_type(
+                buf[f.offset: f.offset + 4 * n].reshape(n, 4), tgt
+            ).reshape(f.shape)
+    return BinState(
+        cum=vals["s_cum"], tmask=vals["s_tmask"], zmask=vals["s_zmask"],
+        cmask=vals["s_cmask"], np_id=vals["s_np"], npods=vals["s_npods"],
+        open=vals["s_open"], fixed=vals["s_fixed"],
+        alloc_cap=vals["s_alloc"], pm=vals["s_pm"], po=vals["s_po"],
+        next_open=vals["s_next"].reshape(()),
+    )
+
+
+@partial(jax.jit,
+         static_argnames=("split", "B", "G", "T", "Z", "C", "NP", "A",
+                          "lean"))
+def pack_packed_seeded(alloc: jnp.ndarray, avail: jnp.ndarray,
+                       price: jnp.ndarray, buf: jnp.ndarray, split: int,
+                       B: int, G: int, T: int, Z: int, C: int, NP: int,
+                       A: int, lean: bool = False) -> jnp.ndarray:
+    """One-round-trip pack over a SEEDED bin table: groups+pools AND the
+    merge-seed BinState ride ONE uint8 upload (``buf[:split]`` /
+    ``buf[split:]`` per seed_layout). The tail-bin merge refinement of
+    every sharded solve goes through here — per-array BinState staging
+    paid eleven link legs per merge; this pays exactly one upload and
+    one result transfer, which is what lets the device-resident
+    microloop bound a merge pass's legs."""
+    assert not lean or NP < 2 ** 15
+    R_ = alloc.shape[1]
+    groups, pools = _unpack_inputs(buf[:split], G, T, Z, C, NP, A, R_)
+    init = _unpack_seed(buf[split:], B, T, Z, C, A, R_)
+    return _encode_decode_set(pack(alloc, avail, price, groups, pools, init),
+                              lean=lean)
+
+
 @partial(jax.jit,
          static_argnames=("B", "G", "T", "Z", "C", "NP", "A"))
 def pack_probe_fused(alloc: jnp.ndarray, avail: jnp.ndarray,

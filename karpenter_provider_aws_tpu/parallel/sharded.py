@@ -41,7 +41,7 @@ constants), so the Solver can keep them device-resident across passes
 
 from __future__ import annotations
 
-from functools import lru_cache
+from functools import lru_cache, partial
 from typing import NamedTuple, Optional
 
 import jax
@@ -129,6 +129,27 @@ def split_counts(count: np.ndarray, n_devices: int,
             out[:, g] = 0
             out[0, g] = count[g]
     return out
+
+
+@partial(jax.jit, static_argnames=("D", "offset", "G"))
+def device_split_counts(gbuf: jnp.ndarray, D: int, offset: int,
+                        G: int) -> jnp.ndarray:
+    """Balanced [D,G] pod-count split derived ON DEVICE from the fused
+    group buffer's resident ``count`` field (``offset`` from
+    ops/binpack.group_layout). The device-resident microloop uses this
+    instead of shipping a host-built count_split every pass — the count
+    bytes already crossed the link inside the dirty-block delta, so
+    re-uploading their split is a pure extra leg. Bit-identical to
+    ``split_counts`` with no keep_whole/pin flags (the microloop aborts
+    to the standard path when co-location or shard-0 pinning is in
+    play); device d gets count // D plus one of the count % D
+    remainders."""
+    count = jax.lax.bitcast_convert_type(
+        gbuf[offset: offset + 4 * G].reshape(G, 4), jnp.int32).reshape(G)
+    base = count // D
+    extra = count % D
+    d = jnp.arange(D, dtype=jnp.int32)[:, None]
+    return base[None, :] + (d < extra[None, :]).astype(jnp.int32)
 
 
 def shard_groups(count_split: np.ndarray) -> np.ndarray:

@@ -27,10 +27,15 @@ churn-sequence parity test (tests/test_incremental.py) pins the
 equivalence at every step.
 
 The provisioning controller owns one builder per Provisioner and hands
-the resulting problem to ``Solver.solve_delta`` (solver/solve.py), which
-keeps the fused input buffers device-resident and ships only the dirty
-blocks — together the <20 ms steady-state reconcile path of ROADMAP
-open item 2.
+the resulting problem to ``Solver.solve_delta`` (solver/solve.py),
+which since PR 14 runs the device-resident reconcile MICROLOOP
+(docs/reference/microloop.md): the whole fused problem stays resident
+on device, the patched build here becomes one dirty-block donated
+scatter over the link, and the plan only syncs back when an on-device
+fingerprint says it moved — together the <20 ms steady-state reconcile
+path of ROADMAP open item 2. The journal this builder consumes arrives
+pre-coalesced (state/cluster.py DirtyJournalCoalescer batches ticks
+between passes); ``BuildResult.journal_ticks`` records how many.
 
 Delta-on-mesh (PR 12, docs/reference/sharding.md): the builder is
 deliberately mesh-AGNOSTIC — the patched problem it produces is the
@@ -71,6 +76,9 @@ class BuildResult:
     dirty_groups: Tuple[int, ...] = ()
     reason: str = ""            # why a full rebuild ran ("" = incremental)
     rev: int = -1               # cluster-state revision this build is keyed at
+    journal_ticks: int = 1      # coalesced journal drains behind this build
+                                # (>1 = the controller fell behind and the
+                                # coalescer batched ticks into one delta)
 
 
 def _resolve(x):
@@ -164,6 +172,7 @@ class IncrementalProblemBuilder:
         state/cluster.py DirtySet; ``touched`` maps each dirty pod name
         to its (state, pod) classification (ClusterState.touched_pods).
         """
+        ticks = dirty.ticks if dirty is not None else 1
         reason = self._delta_blocker(pods, node_pools, lattice,
                                      pool_headroom, dirty, touched)
         if reason is None:
@@ -171,12 +180,15 @@ class IncrementalProblemBuilder:
             if res is not None:
                 self.incremental_builds += 1
                 self.last_reason = ""
+                res.journal_ticks = ticks
                 return res
             reason = self.last_reason or "delta-failed"
-        return self._build_full(pods, node_pools, lattice, existing,
-                                daemonset_pods, bound_pods, pvcs,
-                                storage_classes, pool_headroom, dirty,
-                                reason)
+        res = self._build_full(pods, node_pools, lattice, existing,
+                               daemonset_pods, bound_pods, pvcs,
+                               storage_classes, pool_headroom, dirty,
+                               reason)
+        res.journal_ticks = ticks
+        return res
 
     # ---- gates ----------------------------------------------------------
 

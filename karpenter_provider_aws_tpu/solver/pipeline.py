@@ -32,7 +32,8 @@ is what makes the pipelined-vs-sequential byte-parity tests
 from __future__ import annotations
 
 import time
-from typing import Dict, Optional, Tuple
+import warnings
+from typing import Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -115,6 +116,52 @@ def _apply_blocks(base2d: jnp.ndarray, rows: jnp.ndarray,
     return base2d.at[idx].set(rows)
 
 
+# the DONATED delta program (docs/reference/microloop.md): the resident
+# base buffer is consumed and the updated problem state is written in
+# place instead of allocating a second device copy per pass. Only the
+# microloop requests this (``upload(..., donate=True)``) — the caller
+# contract is that NOTHING may read the previous resident buffer after
+# the scatter dispatches, which the cache upholds by replacing its entry
+# atomically with the scatter's output. Backends without donation
+# support (cpu) warn and fall back to a copy; the warning is filtered
+# here because the fallback is exactly the non-donated semantics.
+_apply_blocks_donated = jax.jit(
+    lambda base2d, rows, idx: base2d.at[idx].set(rows),
+    donate_argnums=(0,))
+
+# installed ONCE at import: a per-call catch_warnings() would mutate
+# process-global filter state on the hottest per-pass path and race
+# every other thread's warning evaluation (operator controllers run
+# concurrently)
+warnings.filterwarnings(
+    "ignore", message=".*[Dd]onat.*")   # "Some donated buffers…"
+
+
+def _run_donated_scatter(base2d, rows, idx):
+    return _apply_blocks_donated(base2d, rows, idx)
+
+
+@jax.jit
+def _differs(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Changed-plan fingerprint: EXACT on-device inequality reduction
+    between this pass's fused result buffer and the retained previous
+    one. A bool scalar crosses the link instead of the whole plan; the
+    microloop fetches the full buffer only when this says the plan
+    actually moved. Composes with the mesh unchanged: comparing two
+    identically-sharded stacked buffers reduces shard-locally and the
+    replicated bool is fetched once."""
+    return jnp.any(a != b)
+
+
+def plan_changed(new_buf, prev_buf) -> bool:
+    """Host-side wrapper over :func:`_differs` (the one O(1) sync of a
+    skipped-fetch pass). Shape mismatch = trivially changed, no device
+    work at all."""
+    if prev_buf is None or new_buf.shape != prev_buf.shape:
+        return True
+    return bool(_differs(new_buf, prev_buf))
+
+
 def _pow2(n: int) -> int:
     p = 1
     while p < n:
@@ -158,6 +205,18 @@ class ResidentInputCache:
                                  # (full uploads + delta blocks) — the
                                  # steady-state bench row's upload-bytes
                                  # evidence
+        # link-leg accounting hook (docs/reference/microloop.md): the
+        # owning Solver installs a callable(direction, nbytes) invoked
+        # once per TRANSFER that actually crosses the host↔device link
+        # (a delta upload whose diff found zero changed blocks calls
+        # nothing — no bytes moved). Feeds the
+        # karpenter_solver_link_legs_total / _link_bytes_total counters.
+        self.account: Optional[Callable[[str, int], None]] = None
+
+    def _ship(self, nbytes: int) -> None:
+        self.bytes_shipped += int(nbytes)
+        if self.account is not None:
+            self.account("upload", int(nbytes))
 
     def stats(self) -> Dict[str, int]:
         return {"hits": self.hits, "misses": self.misses,
@@ -166,7 +225,13 @@ class ResidentInputCache:
                 "bytes_shipped": self.bytes_shipped}
 
     def upload(self, key: Tuple, buf: np.ndarray,
-               sharding=None) -> jnp.ndarray:
+               sharding=None, donate: bool = False) -> jnp.ndarray:
+        """``donate=True`` routes the delta scatter through the DONATED
+        program: the previous resident device buffer is consumed and the
+        update lands in place (one device allocation per steady-state
+        pass instead of two). Safe exactly because the entry swap below
+        is the only live reference to the consumed buffer — callers get
+        back a fresh view of the NEW buffer, never the old one."""
         total = int(buf.size)
         nblk = -(-total // self._block)
         padded = np.zeros((nblk, self._block), np.uint8)
@@ -175,14 +240,14 @@ class ResidentInputCache:
         if ent is None or ent[0].shape[0] != nblk:
             dev2d = self._store(key, padded, sharding)
             self.misses += 1
-            self.bytes_shipped += int(padded.size)
+            self._ship(padded.size)
             return dev2d.reshape(-1)[:total]
         prev, dev2d = ent
         changed = np.nonzero((padded != prev).any(axis=1))[0]
         if changed.size > nblk // 2:
             dev2d = self._store(key, padded, sharding)
             self.misses += 1
-            self.bytes_shipped += int(padded.size)
+            self._ship(padded.size)
             return dev2d.reshape(-1)[:total]
         if changed.size:
             # pad the scatter to a power-of-two row count (duplicate
@@ -192,10 +257,21 @@ class ResidentInputCache:
             idx = np.empty((k,), np.int32)
             idx[: changed.size] = changed
             idx[changed.size:] = changed[0]
-            dev2d = _apply_blocks(dev2d, jnp.asarray(padded[idx]),
-                                  jnp.asarray(idx))
+            apply = _run_donated_scatter if donate else _apply_blocks
+            try:
+                dev2d = apply(dev2d, jnp.asarray(padded[idx]),
+                              jnp.asarray(idx))
+            except Exception:
+                if donate:
+                    # the scatter may have consumed the donated base
+                    # before failing: drop the entry so no later upload
+                    # can delta against a dead buffer
+                    self._entries.pop(key, None)
+                raise
             self.blocks_shipped += int(changed.size)
-            self.bytes_shipped += int(changed.size) * self._block
+            # the rows and their index vector ride one dispatch: ONE
+            # coalesced leg carrying both payloads
+            self._ship(k * self._block + idx.nbytes)
             self._entries[key] = (padded, dev2d)
         self.hits += 1
         self.blocks_resident += nblk - int(changed.size)

@@ -44,7 +44,8 @@ from ..ops import binpack
 from . import costmodel, taxonomy
 from .explain import unplaced_reason
 from .faults import FaultInjector
-from .pipeline import ResidentInputCache, StageTimer, fetch_async
+from .pipeline import (ResidentInputCache, StageTimer, fetch_async,
+                       plan_changed)
 from .problem import Problem
 
 _G_BUCKETS = (16, 32, 64, 96, 128, 192, 256, 512, 1024, 4096)
@@ -149,6 +150,49 @@ class NodePlan:
     @property
     def num_new_nodes(self) -> int:
         return len(self.new_nodes)
+
+
+@dataclass
+class _MicroState:
+    """Retained cross-pass state of the device-resident reconcile
+    microloop (docs/reference/microloop.md). ``key`` pins the layout
+    this state was built under — any bucket/mesh/size drift is a cold
+    restart, never a stale reuse. ``prev_dev`` is the previous pass's
+    device result buffer (the changed-plan fingerprint compares against
+    it ON DEVICE); ``prev_host`` its host copy, re-decoded with the
+    current pass's pod names whenever the fingerprint says the packing
+    did not move (the skipped-sync path). The mesh merge refinement
+    retains its own result the same way."""
+
+    key: Tuple
+    prev_dev: object = None
+    prev_host: Optional[np.ndarray] = None
+    prev_cost: float = 0.0                       # mesh: psum'd raw cost
+    prev_merge: Optional[Tuple[np.ndarray, int]] = None  # (result, B2)
+    # the lattice VIEW (strong ref — an id() can never be reused stale)
+    # and price version this state solved against: the merge refinement
+    # reads avail/price tensors the shard-result fingerprint cannot
+    # see, so a reprice or a new ICE-masked view invalidates retention
+    # outright rather than risking a stale reuse
+    lattice: object = None
+    price_version: int = -1
+
+
+class _MicroIneligible(Exception):
+    """Internal: this pass cannot ride the microloop (shape, ceiling, or
+    feature outside the steady-state envelope) — fall back to the
+    standard solve ladder. Never surfaces to callers."""
+
+
+class _CostShim:
+    """Stands in for a ShardedPack when the microloop already holds the
+    psum'd raw cost on the host (skipped-sync passes reuse it instead of
+    re-fetching a device scalar)."""
+
+    __slots__ = ("total_cost",)
+
+    def __init__(self, total_cost: float):
+        self.total_cost = total_cost
 
 
 @dataclass
@@ -391,7 +435,44 @@ class Solver:
             # sharded solves carried by the mesh (full, wave, and delta
             # passes all count — the "is the mesh engaged?" evidence)
             "mesh_solves": 0,
+            # the device-resident reconcile microloop (solve_delta →
+            # _solve_micro; docs/reference/microloop.md): passes it
+            # carried, plan fetches its fingerprint suppressed, plan
+            # fetches it paid, merge refinements it ran/skipped, passes
+            # that fell back to the standard ladder, O(1) fingerprint
+            # syncs, and admission-bookkeeping closures it overlapped
+            # with the in-flight dispatch
+            "micro_solves": 0,
+            "micro_skipped_syncs": 0,
+            "micro_fetches": 0,
+            "micro_merge_solves": 0,
+            "micro_merge_skips": 0,
+            # merge bin-table overflow regrows: each retry pays one more
+            # upload+fetch pair, so the smoke/bench leg bounds allow
+            # +2 legs per regrow on the pass that paid it
+            "micro_merge_regrows": 0,
+            "micro_aborts": 0,
+            "micro_tiny_syncs": 0,
+            "overlapped_admission": 0,
+            # link legs of the LAST delta pass (upload+fetch transfers;
+            # the smoke gate's per-pass ≤-bound evidence)
+            "micro_last_legs": 0,
         }
+        # cumulative host↔device link accounting (the
+        # karpenter_solver_link_legs_total/_link_bytes_total source): a
+        # LEG is a transfer whose size scales with the problem or plan
+        # (fused input uploads, dirty-block scatters, result fetches);
+        # O(1) control scalars — the microloop's changed-plan
+        # fingerprint, n_existing — are counted as micro_tiny_syncs,
+        # not legs, because they cannot regress to full re-staging
+        self.link_stats: Dict[str, int] = {
+            "upload_legs": 0, "upload_bytes": 0,
+            "fetch_legs": 0, "fetch_bytes": 0,
+        }
+        self._resident.account = self._account_link
+        # retained microloop state (None = cold); reset by every
+        # device-state invalidation (fault recovery, mesh swap)
+        self._micro: Optional[_MicroState] = None
         # max/mean per-shard pod load of the last sharded solve's split
         # (parallel/sharded.py shard_groups) — the shard-imbalance gauge
         self._mesh_imbalance = 0.0
@@ -422,9 +503,25 @@ class Solver:
         shards (pinned by tests/test_mesh.py)."""
         with self._solve_lock:
             self.mesh = mesh
-            self._mesh_consts = None
-            self._mesh_alloc = None
-            self._resident.invalidate()
+            self._invalidate_device_state()
+
+    def _account_link(self, direction: str, nbytes: int) -> None:
+        """One host↔device transfer crossed the link (see link_stats)."""
+        self.link_stats[direction + "_legs"] += 1
+        self.link_stats[direction + "_bytes"] += int(nbytes)
+
+    def _invalidate_device_state(self) -> None:
+        """Drop EVERY retained device buffer: resident input entries,
+        the replicated-lattice memo, and the microloop's retained result
+        (its fingerprint base and donated problem state). One helper so
+        the fault-recovery ladder and set_mesh can never forget a layer
+        — a donated buffer surviving an invalidation would be
+        re-dispatched after the backend consumed it (the donation-safety
+        pin, tests/test_microloop.py)."""
+        self._resident.invalidate()
+        self._mesh_consts = None
+        self._mesh_alloc = None
+        self._micro = None
 
     def stats(self) -> Dict[str, object]:
         """Introspection snapshot (counter reads only — NEVER takes the
@@ -443,9 +540,15 @@ class Solver:
             # gauges render
             "mesh_devices": self.mesh_devices,
             "mesh_shard_imbalance": round(self._mesh_imbalance, 4),
+            # the microloop surface: engaged + retained-state presence
+            # read without the solve lock or any device sync (the
+            # stats-never-blocks pin extends to every counter below)
+            "micro_engaged": self._micro is not None,
         }
         for k, v in self.pipeline_stats.items():
             out[k] = v
+        for k, v in self.link_stats.items():
+            out["link_" + k] = v
         for k, v in self.degraded_counts.items():
             out["degraded_" + k.replace("-", "_")] = v
         for k, v in self._resident.stats().items():
@@ -1083,36 +1186,319 @@ class Solver:
 
     @_locked
     def solve_delta(self, problem: Problem, dirty_groups: Sequence[int] = (),
-                    mesh=None) -> NodePlan:
+                    mesh=None, overlap=None) -> NodePlan:
         """The steady-state delta-solve entry point (ROADMAP item 2,
         docs/concepts/performance.md "Steady-state reconciles"). The
         problem arrived via solver/incremental.py, so the fused input
         buffers differ from the previous pass only in the dirty-group
-        blocks: the pipelined path's resident-input cache ships just
-        those blocks and the device solve seeds from the resident carry
-        state. Forces the pipelined path for the duration of the call
-        (delta semantics REQUIRE the resident cache) and records the
-        delta evidence counters soaks/benches/`kpctl top` assert on.
-        Plans are identical to :meth:`solve` of the same problem — the
-        delta is in bytes moved, never in the answer."""
+        blocks: the device-resident reconcile MICROLOOP
+        (docs/reference/microloop.md) ships exactly those blocks as one
+        donated-scatter upload, dispatches against the resident problem
+        state, and fetches the plan back only when the on-device
+        changed-plan fingerprint says it moved. Any pass outside the
+        microloop's envelope falls back to the standard solve ladder —
+        the fallback is visible in the micro_aborts counter and the
+        link-leg gauges, never silent. Forces the pipelined path for
+        the duration of the call (delta semantics REQUIRE the resident
+        cache) and records the delta evidence counters
+        soaks/benches/`kpctl top` assert on. Plans are identical to
+        :meth:`solve` of the same problem — the delta is in bytes
+        moved, never in the answer.
+
+        ``overlap`` (zero-arg callable) is the admission-bookkeeping
+        seam: it runs INSIDE the device compute window (between
+        dispatch and the fingerprint sync), so the provisioner's host
+        work rides the in-flight dispatch instead of serializing
+        behind it. It runs at most once per call — on the fallback
+        rungs only AFTER the fallback solve lands. A post-dispatch
+        failure can fire the seam and still drop the wave, so callers
+        recording metrics from it must STAGE in the seam and commit
+        after this returns (controllers/provisioning.py does)."""
         with trace.span("solver.solve_delta", groups=problem.G,
                         dirty=len(dirty_groups)) as sp:
             pre_hits = self._resident.hits
+            pre_legs = (self.link_stats["upload_legs"]
+                        + self.link_stats["fetch_legs"])
             was_pipelined = self.pipeline
             self.pipeline = True
+            overlap_once = [overlap] if overlap is not None else []
+
+            def run_overlap():
+                if overlap_once:
+                    fn = overlap_once.pop()
+                    fn()
+                    self.pipeline_stats["overlapped_admission"] += 1
+
             try:
-                plan = self._solve_problem(problem, mesh=mesh)
+                try:
+                    plan = self._solve_micro(problem, mesh=mesh,
+                                             overlap=run_overlap)
+                    self.pipeline_stats["micro_solves"] += 1
+                except _MicroIneligible:
+                    self.pipeline_stats["micro_aborts"] += 1
+                    plan = self._solve_problem(problem, mesh=mesh)
+                    # only after the fallback lands: a failing pass must
+                    # not record admission bookkeeping for a dropped wave
+                    run_overlap()
+                except Exception:
+                    # the microloop's device state may be gone (and its
+                    # donated buffers consumed): rebuild from scratch
+                    # rather than re-dispatch against dead arrays, then
+                    # let the standard ladder own retry/fallback —
+                    # degradation in latency, never availability
+                    self.pipeline_stats["micro_aborts"] += 1
+                    self._invalidate_device_state()
+                    plan = self._solve_problem(problem, mesh=mesh)
+                    run_overlap()
             finally:
                 self.pipeline = was_pipelined
             self.pipeline_stats["delta_solves"] += 1
             self.pipeline_stats["delta_dirty_groups"] += len(dirty_groups)
+            self.pipeline_stats["micro_last_legs"] = (
+                self.link_stats["upload_legs"]
+                + self.link_stats["fetch_legs"] - pre_legs)
             if self._resident.hits > pre_hits:
                 self.pipeline_stats["resident_problem_hits"] += 1
             else:
                 self.pipeline_stats["resident_problem_misses"] += 1
             sp.set(path=plan.solver_path, degraded=plan.degraded,
-                   resident_hit=self._resident.hits > pre_hits)
+                   resident_hit=self._resident.hits > pre_hits,
+                   legs=self.pipeline_stats["micro_last_legs"])
             return plan
+
+    # ---- the device-resident reconcile microloop (ROADMAP item 2) --------
+
+    def _solve_micro(self, problem: Problem, mesh=None,
+                     overlap=None) -> NodePlan:
+        """One steady-state reconcile pass against device-RESIDENT
+        problem state (docs/reference/microloop.md).
+
+        The whole fused problem (groups+pools and, when present, the
+        existing-bin table) lives as ONE resident device buffer; the
+        pass block-diffs against it and ships exactly the dirty blocks
+        in a single donated-scatter upload (leg 1). The solve dispatches
+        against the updated resident state — on a mesh, against
+        replicated device SLICES of it, with the per-shard count split
+        derived on device (parallel/sharded.py device_split_counts) so
+        no split bytes cross the link. Admission bookkeeping and decode
+        prep run inside the compute window; the only mandatory sync is
+        the O(1) changed-plan fingerprint (solver/pipeline.py
+        plan_changed), and the full plan buffer is fetched (leg 2) only
+        when it says the packing moved — an unchanged plan re-decodes
+        the retained host bytes against the current pod names. Steady
+        state therefore pays ≤2 data legs per pass: one dirty upload,
+        one CONDITIONAL plan fetch (a mesh pass whose plan moved pays
+        two more for the fused tail-bin merge refinement).
+
+        Raises :class:`_MicroIneligible` for anything outside the
+        envelope (wave-scale G, co-location/pinned groups on a mesh,
+        bin-table overflow) — solve_delta falls back to the standard
+        ladder, counted in micro_aborts. Plans are byte-identical to
+        :meth:`solve` of the same problem, pinned by
+        tests/test_microloop.py and the smoke/bench referees."""
+        t0 = time.perf_counter()
+        if mesh is None:
+            mesh = self.mesh
+        if problem.G == 0 or not self.pipeline:
+            raise _MicroIneligible("empty or unpipelined")
+        if problem.G > self._g_ceiling():
+            raise _MicroIneligible("wave-scale G")
+        lat = self.lattice
+        D = int(mesh.devices.size) if mesh is not None else 1
+        sharded = D > 1
+        NP = max(problem.NP, 1)
+        A = max(problem.A, 1)
+        if sharded and (bool(problem.single_bin.any())
+                        or (problem.A and bool(problem.g_need.any()))):
+            # co-location / shard-0 pinning need the host split planner
+            raise _MicroIneligible("pinned groups on mesh")
+        stages = StageTimer()
+        G = _bucket(problem.G, _G_BUCKETS)
+        fresh = None
+        if sharded:
+            B = self._b_budget_sharded(problem, D)
+        else:
+            fresh, B = self._b_budget_single(problem, G)
+
+        with stages.span("build"):
+            fused_np = self._fused_inputs_np(problem, G)
+            g_size = int(fused_np.size)
+            combined_np = (np.concatenate(
+                [fused_np, self._fused_init_np(problem, B)])
+                if problem.E else fused_np)
+        repl = None
+        if sharded:
+            from ..parallel.sharded import (device_split_counts,
+                                            replicated_sharding,
+                                            sharded_pack)
+            repl = replicated_sharding(mesh)
+        # the resident problem identity: mesh size, group/bin buckets,
+        # and exact byte length — any drift is a cold re-upload, and
+        # the retained fingerprint state below keys on the same tuple
+        key = ("m", D, G, B, int(combined_np.size))
+        with stages.span("upload"):
+            comb_dev = self._resident.upload(key, combined_np,
+                                             sharding=repl, donate=True)
+        ms = self._micro
+        if ms is not None and (
+                ms.key != key
+                or ms.lattice is not problem.lattice
+                or ms.price_version != problem.lattice.price_version):
+            # layout drift, a new (ICE-masked) lattice view, or a
+            # reprice: retained results solved against other tensors —
+            # cold restart, never a stale fingerprint match
+            ms = None
+
+        self._maybe_inject_device_fault()
+        compute_ms0 = stages.ms.get("compute", 0.0)
+        td = time.perf_counter()
+        sp_res = None
+        try:
+            if sharded:
+                alloc_r, avail, price = self._mesh_inputs(problem, mesh)
+                gslice = comb_dev[:g_size]
+                islice = comb_dev[g_size:] if problem.E else None
+                count_off = next(
+                    f.offset for f in binpack.group_layout(
+                        G, lat.T, lat.Z, lat.C, NP, A, R)[0]
+                    if f.name == "count")
+                csplit = device_split_counts(gslice, D, count_off, G)
+                with self._trace_span("solver.pack_micro"):
+                    with stages.span("compute"):
+                        sp_res = sharded_pack(
+                            mesh, alloc_r, avail, price, gslice, islice,
+                            problem.E, csplit, B, G, lat.T, lat.Z, lat.C,
+                            NP, A)
+                new_dev = sp_res.packed
+            else:
+                avail, price = self._device_avail_price(problem)
+                with self._trace_span("solver.pack_micro"):
+                    with stages.span("compute"):
+                        if problem.E:
+                            new_dev = binpack.pack_packed_combined(
+                                self._alloc, avail, price, comb_dev,
+                                g_size, problem.E, B, G, lat.T, lat.Z,
+                                lat.C, NP, A, lean=True)
+                        else:
+                            new_dev = binpack.pack_packed_efused(
+                                self._alloc, avail, price, comb_dev,
+                                None, 0, B, G, lat.T, lat.Z, lat.C,
+                                NP, A, lean=True)
+        except SolverError:
+            raise
+        except Exception as e:
+            raise SolverDeviceError(f"{type(e).__name__}: {e}",
+                                    cause=e) from e
+        # host work rides the in-flight dispatch: the provisioner's
+        # admission bookkeeping (the fetch_async seam's successor here —
+        # the fingerprint below replaces the eager result stream) and
+        # the plan-independent decode prep
+        if overlap is not None:
+            overlap()
+        # prep feeds only the single-device _decode below; the sharded
+        # tail rebuilds its own inside _decode_sharded
+        prep = None if sharded else self._decode_prep(problem)
+        try:
+            with stages.span("download"):
+                # the one mandatory sync: O(1) changed-plan fingerprint
+                changed = plan_changed(new_dev,
+                                       ms.prev_dev if ms else None)
+                self.pipeline_stats["micro_tiny_syncs"] += 1
+                if changed:
+                    buf = np.asarray(new_dev)
+                    self._account_link("fetch", buf.nbytes)
+                    self.pipeline_stats["micro_fetches"] += 1
+                else:
+                    buf = ms.prev_host
+                    self.pipeline_stats["micro_skipped_syncs"] += 1
+        except SolverError:
+            raise
+        except Exception as e:
+            raise SolverDeviceError(f"{type(e).__name__}: {e}",
+                                    cause=e) from e
+        device_s = time.perf_counter() - td
+        if ms is None:
+            ms = _MicroState(key=key)
+        self._micro = ms
+        ms.lattice = problem.lattice
+        ms.price_version = problem.lattice.price_version
+        ms.prev_dev = new_dev
+        if changed:
+            ms.prev_host = buf
+            ms.prev_merge = None
+            if sharded:
+                # the merge comparison's psum'd raw cost: fetched once
+                # here (O(1)), reused by every skipped-sync pass
+                ms.prev_cost = float(sp_res.total_cost)
+                self.pipeline_stats["micro_tiny_syncs"] += 1
+
+        if sharded:
+            plan = self._micro_decode_sharded(problem, ms, buf, changed,
+                                              G, B, D, stages, device_s)
+        else:
+            with stages.span("decode"):
+                dec = _unpack_decode_set(buf, G, lat.T, lat.Z, lat.C, A,
+                                         lean=True)
+            if (dec.leftover.sum() > 0) and dec.next_open >= B:
+                # bin-table overflow: the standard ladder owns growth
+                self._micro = None
+                raise _MicroIneligible("bin-table overflow")
+            needed = _bucket(max(dec.next_open, problem.E + 1, 1),
+                             _B_BUCKETS, clamp=True)
+            self._b_hint[G] = (fresh, needed)
+            with stages.span("decode"):
+                plan = self._decode(problem, dec, device_s, prep=prep)
+        plan.solve_seconds = time.perf_counter() - t0
+        plan.warnings = list(problem.warnings)
+        plan.stage_ms = stages.ms
+        plan.pipelined = True
+        plan.mesh_devices = D
+        if sharded:
+            plan.shard_imbalance = self._mesh_imbalance
+            self.pipeline_stats["mesh_solves"] += 1
+        costmodel.model().observe_solve(
+            costmodel.shape_key(G, B, mesh_devices=D),
+            stages.ms.get("compute", 0.0) - compute_ms0)
+        self.pipeline_stats["async_solves"] += 1
+        return plan
+
+    def _micro_decode_sharded(self, problem: Problem, ms: _MicroState,
+                              buf: np.ndarray, changed: bool, G: int,
+                              B: int, D: int, stages: StageTimer,
+                              device_s: float) -> NodePlan:
+        """Mesh tail of the microloop: per-shard decode + the (possibly
+        reused) merge refinement, byte-identical to _solve_sharded's."""
+        from ..parallel.sharded import shard_groups, split_counts
+        lat = self.lattice
+        A = max(problem.A, 1)
+        with stages.span("decode"):
+            decs = [_unpack_decode_set(buf[d], G, lat.T, lat.Z, lat.C, A)
+                    for d in range(buf.shape[0])]
+        leftover = np.stack([dec.leftover for dec in decs])
+        next_open = np.array([dec.next_open for dec in decs])
+        if bool(((leftover.sum(axis=1) > 0) & (next_open >= B)).any()):
+            self._micro = None
+            raise _MicroIneligible("sharded bin-table overflow")
+        # host mirror of the device-derived balanced split (identical
+        # formula — the microloop aborted if pinning was in play), for
+        # pod-name slicing and the imbalance gauge
+        count_pad = np.zeros((G,), np.int32)
+        count_pad[: problem.G] = problem.count
+        count_split = split_counts(count_pad, D)
+        load = shard_groups(count_split).astype(np.float64)
+        self._mesh_imbalance = (float(load.max() / load.mean())
+                                if load.mean() > 0 else 1.0)
+        merge_ctx = {"reuse": None if changed else ms.prev_merge}
+        with stages.span("decode"):
+            plan = self._decode_sharded(problem, _CostShim(ms.prev_cost),
+                                        decs, count_split, device_s,
+                                        merge_ctx=merge_ctx)
+        if merge_ctx.get("ran"):
+            ms.prev_merge = merge_ctx["result"]
+            self.pipeline_stats["micro_merge_solves"] += 1
+        elif merge_ctx.get("reused"):
+            self.pipeline_stats["micro_merge_skips"] += 1
+        return plan
 
     def _solve_problem(self, problem: Problem, mesh=None) -> NodePlan:
         """Solve a problem into a NodePlan, degrading gracefully.
@@ -1175,13 +1561,12 @@ class Solver:
                     # the cache so the retry — and every later solve whose
                     # unchanged inputs would otherwise delta-hit a dead
                     # buffer — re-uploads instead. The replicated-lattice
-                    # memo holds device buffers too: left in place, a
-                    # mesh retry would re-dispatch against the same dead
+                    # memo and the microloop's retained (donated) state
+                    # hold device buffers too: left in place, a mesh
+                    # retry would re-dispatch against the same dead
                     # arrays and turn one transient fault into a
                     # persistent mesh outage
-                    self._resident.invalidate()
-                    self._mesh_consts = None
-                    self._mesh_alloc = None
+                    self._invalidate_device_state()
                 if is_retryable_solver_error(e) and retries < self._DEVICE_RETRIES:
                     retries += 1
                     self._count_degraded("device_retry")
@@ -1202,6 +1587,48 @@ class Solver:
         plan.warnings = list(problem.warnings) + [
             f"solver degraded to host FFD ({reason}: {detail})"]
         return plan
+
+    def _b_budget_single(self, problem: Problem,
+                         G: int) -> Tuple[int, int]:
+        """The single-device bin budget, including the ``_b_hint``
+        fast-restart dance — THE formula, shared by :meth:`_solve_device`
+        and the microloop (:meth:`_solve_micro`) so the two paths can
+        never drift apart (a divergent micro B silently changes the
+        resident key every pass). Returns ``(fresh, B)``; callers feed
+        ``fresh`` back into ``_b_hint`` after decode."""
+        total_pods = int(problem.count.sum())
+        b_needed = problem.E + min(total_pods,
+                                   self._estimate_bins(problem) + 64)
+        fresh = _bucket(max(b_needed, problem.E + 1), _B_BUCKETS,
+                        clamp=True)
+        prev = self._b_hint.get(G)
+        if prev is not None and fresh >= prev[0]:
+            # a same-or-larger problem shape than the one that last
+            # forced a retry: start directly at the size that worked
+            B = max(fresh, prev[1])
+        else:
+            B = fresh
+        return fresh, min(B, self._b_ceiling())
+
+    def _b_budget_sharded(self, problem: Problem, D: int) -> int:
+        """The per-shard bin budget of the mesh pack — THE formula,
+        shared by :meth:`_solve_sharded` and the microloop: existing
+        bins (shard 0) + this shard's slice of the splittable groups +
+        one tail bin per group + whole (pinned/co-located) groups +
+        slack. The whole-group term is 0 inside the micro envelope
+        (pinned groups abort to the host planner first), so sharing the
+        full formula keeps the two paths' resident keys identical."""
+        total_pods = int(problem.count.sum())
+        caps = np.minimum(problem.max_per_bin.astype(np.int64),
+                          np.maximum(problem.count.astype(np.int64), 1))
+        capped_bins = int(np.ceil(problem.count
+                                  / np.maximum(caps, 1)).sum())
+        n_whole = int(problem.single_bin.sum()) + (
+            int(problem.g_need.any(axis=1).sum()) if problem.A else 0)
+        b_needed = problem.E + min(
+            total_pods, -(-capped_bins // D) + problem.G + n_whole + 64)
+        return min(_bucket(max(b_needed, problem.E + 1), _B_BUCKETS,
+                           clamp=True), self._b_ceiling())
 
     def _solve_device(self, problem: Problem, mesh=None,
                       t0: Optional[float] = None, gbuf=None,
@@ -1229,17 +1656,7 @@ class Solver:
         pipelined = self.pipeline
         stages = StageTimer()
         G = _bucket(problem.G, _G_BUCKETS)
-        total_pods = int(problem.count.sum())
-        b_needed = problem.E + min(total_pods, self._estimate_bins(problem) + 64)
-        fresh = _bucket(max(b_needed, problem.E + 1), _B_BUCKETS, clamp=True)
-        prev = self._b_hint.get(G)
-        if prev is not None and fresh >= prev[0]:
-            # a same-or-larger problem shape than the one that last forced a
-            # retry: start directly at the size that worked
-            B = max(fresh, prev[1])
-        else:
-            B = fresh
-        B = min(B, self._b_ceiling())
+        fresh, B = self._b_budget_single(problem, G)
 
         fused_np = None
         if gbuf is None:
@@ -1256,9 +1673,12 @@ class Solver:
                 # identity: a steady-state reconcile landing on the same
                 # layout bucket delta-refreshes it (solve_delta counts
                 # hit/miss via the cache's own counters)
-                gbuf = (self._resident.upload(("g", G, fused_np.size),
-                                              fused_np)
-                        if pipelined else jnp.asarray(fused_np))
+                if pipelined:
+                    gbuf = self._resident.upload(("g", G, fused_np.size),
+                                                 fused_np)
+                else:
+                    gbuf = jnp.asarray(fused_np)
+                    self._account_link("upload", fused_np.nbytes)
         avail, price = self._device_avail_price(problem)
 
         lat = self.lattice
@@ -1285,9 +1705,13 @@ class Solver:
                             with stages.span("build"):
                                 init_np = self._fused_init_np(problem, B)
                             with stages.span("upload"):
-                                init_dev = (self._resident.upload(
-                                    ("i", B, init_np.size), init_np)
-                                    if pipelined else jnp.asarray(init_np))
+                                if pipelined:
+                                    init_dev = self._resident.upload(
+                                        ("i", B, init_np.size), init_np)
+                                else:
+                                    init_dev = jnp.asarray(init_np)
+                                    self._account_link("upload",
+                                                       init_np.nbytes)
                         with stages.span("compute"):
                             dev_buf = binpack.pack_packed_efused(
                                 self._alloc, avail, price, gbuf, init_dev,
@@ -1298,8 +1722,11 @@ class Solver:
                         with stages.span("build"):
                             init_np = self._fused_init_np(problem, B)
                         with stages.span("upload"):
-                            combined = jnp.asarray(
-                                np.concatenate([fused_np, init_np]))
+                            combined_host = np.concatenate(
+                                [fused_np, init_np])
+                            combined = jnp.asarray(combined_host)
+                            self._account_link("upload",
+                                               combined_host.nbytes)
                         with stages.span("compute"):
                             dev_buf = binpack.pack_packed_combined(
                                 self._alloc, avail, price, combined,
@@ -1334,6 +1761,7 @@ class Solver:
             try:
                 with stages.span("download"):
                     buf = np.asarray(dev_buf)
+                    self._account_link("fetch", buf.nbytes)
             except SolverError:
                 raise
             except Exception as e:
@@ -1864,18 +2292,7 @@ class Solver:
         pipelined = self.pipeline
         stages = StageTimer()
         G = _bucket(problem.G, _G_BUCKETS)
-        total_pods = int(problem.count.sum())
-        caps = np.minimum(problem.max_per_bin.astype(np.int64),
-                          np.maximum(problem.count.astype(np.int64), 1))
-        capped_bins = int(np.ceil(problem.count / np.maximum(caps, 1)).sum())
-        n_whole = int(problem.single_bin.sum()) + (
-            int(problem.g_need.any(axis=1).sum()) if problem.A else 0)
-        # per-shard bin budget: existing bins (shard 0) + this shard's slice
-        # of the splittable groups + one tail bin per group + whole groups
-        b_needed = problem.E + min(total_pods,
-                                   -(-capped_bins // D) + problem.G + n_whole + 64)
-        B = min(_bucket(max(b_needed, problem.E + 1), _B_BUCKETS, clamp=True),
-                self._b_ceiling())
+        B = self._b_budget_sharded(problem, D)
 
         repl = replicated_sharding(mesh) if pipelined else None
         if gbuf is None:
@@ -1887,9 +2304,12 @@ class Solver:
                 # and ships only dirty group rows over the host link; the
                 # replicated sharding keeps unchanged bytes resident on
                 # every shard (solve_delta counts hit/miss)
-                gbuf = (self._resident.upload(("g", D, G, fused_np.size),
-                                              fused_np, sharding=repl)
-                        if pipelined else jnp.asarray(fused_np))
+                if pipelined:
+                    gbuf = self._resident.upload(("g", D, G, fused_np.size),
+                                                 fused_np, sharding=repl)
+                else:
+                    gbuf = jnp.asarray(fused_np)
+                    self._account_link("upload", fused_np.nbytes)
         alloc_r, avail, price = self._mesh_inputs(problem, mesh)
 
         count_pad = np.zeros((G,), np.int32)
@@ -1917,15 +2337,23 @@ class Solver:
                 with stages.span("build"):
                     init_np = self._fused_init_np(problem, B)
                 with stages.span("upload"):
-                    init_buf = (self._resident.upload(
-                        ("i", D, B, init_np.size), init_np, sharding=repl)
-                        if pipelined else jnp.asarray(init_np))
+                    if pipelined:
+                        init_buf = self._resident.upload(
+                            ("i", D, B, init_np.size), init_np,
+                            sharding=repl)
+                    else:
+                        init_buf = jnp.asarray(init_np)
+                        self._account_link("upload", init_np.nbytes)
             self._maybe_inject_device_fault()
             compute_ms0 = stages.ms.get("compute", 0.0)
             td = time.perf_counter()
             try:
                 with self._trace_span("solver.pack_sharded"):
                     with stages.span("compute"):
+                        # the [D,G] split ships from host here (the
+                        # microloop derives it on device instead —
+                        # parallel/sharded.py device_split_counts)
+                        self._account_link("upload", count_split.nbytes)
                         sp = sharded_pack(mesh, alloc_r, avail, price, gbuf,
                                           init_buf, problem.E, count_split,
                                           B, G, lat.T, lat.Z, lat.C, NP, A)
@@ -1951,6 +2379,7 @@ class Solver:
                     # transfer for all shards (sync included); host-side
                     # unpack stays off the device clock
                     packed = np.asarray(sp.packed)
+                    self._account_link("fetch", packed.nbytes)
             except SolverError:
                 raise
             except Exception as e:
@@ -2018,7 +2447,8 @@ class Solver:
         return tm, zm, cm
 
     def _decode_sharded(self, problem: Problem, sp, decs: List[_DecodeSet],
-                        count_split: np.ndarray, device_s: float) -> NodePlan:
+                        count_split: np.ndarray, device_s: float,
+                        merge_ctx: Optional[Dict] = None) -> NodePlan:
         lat = self.lattice
         D = count_split.shape[0]
 
@@ -2103,7 +2533,8 @@ class Solver:
             return raw_plan()
 
         merged = self._merge_solve(problem, decs, kept, tail_names,
-                                   existing_assignments, unschedulable, device_s)
+                                   existing_assignments, unschedulable,
+                                   device_s, merge_ctx=merge_ctx)
         # the merge is a refinement: take it when it schedules at least as
         # many pods and does not raise cost; otherwise keep the raw packing.
         # Compare on aggregates (total_cost is the psum'd live-bin price sum,
@@ -2119,10 +2550,21 @@ class Solver:
 
     def _merge_solve(self, problem: Problem, decs: List[_DecodeSet], kept,
                      tail_names, existing_assignments: Dict[str, List[str]],
-                     unschedulable: Dict[str, str], device_s: float):
+                     unschedulable: Dict[str, str], device_s: float,
+                     merge_ctx: Optional[Dict] = None):
         """Re-pack dissolved tail bins + spilled pods in one single-device
         refinement solve seeded with existing bins (fixed) and kept bins
-        (open, re-priced at finalization for maximum offering flexibility)."""
+        (open, re-priced at finalization for maximum offering flexibility).
+
+        The merge-count group buffer AND the seeded bin table ride ONE
+        fused upload (ops/binpack.py pack_packed_seeded) — the per-array
+        BinState staging this replaces paid eleven link legs per merge.
+        ``merge_ctx`` is the microloop's retention seam: ``reuse``
+        (result bytes, B2) skips the device round trip entirely on a
+        fingerprint-unchanged pass (identical shard results ⇒ identical
+        merge inputs ⇒ identical merge result — only the pod NAMES
+        decode differently); ``ran``/``result`` hand the fresh result
+        back for the next pass's reuse."""
         lat = self.lattice
         E = problem.E
         K = len(kept)
@@ -2141,78 +2583,114 @@ class Solver:
         b_needed = E + K + min(tail_total, capped_bins + 64)
         B2 = _bucket(b_needed, _B_BUCKETS, clamp=True)
 
-        fused = self._fused_inputs(problem, G, count_override=merge_count)
-        avail, price = self._device_avail_price(problem)
-        k_tm, k_zm, k_cm = self._stacked_masks(decs, [(d, b) for d, b, _ in kept])
-
-        while True:
-            s_cum = np.zeros((B2, R), np.float32)
-            s_tm = np.zeros((B2, lat.T), bool)
-            s_zm = np.zeros((B2, lat.Z), bool)
-            s_cm = np.zeros((B2, lat.C), bool)
-            s_np = np.full((B2,), -1, np.int32)
-            s_npods = np.zeros((B2,), np.int32)
-            s_open = np.zeros((B2,), bool)
-            s_fixed = np.zeros((B2,), bool)
-            s_alloc = np.full((B2, R), np.inf, np.float32)
-            s_pm = np.zeros((B2, A), np.int32)
-            s_po = np.zeros((B2, A), bool)
-            # rows [0,E): existing bins, post-pack shard-0 state (fixed)
-            if E:
-                d0 = decs[0]
-                e_rows = np.arange(E)
-                s_cum[:E] = d0.cum[:E]
-                s_tm[:E] = d0.tmask(e_rows, lat.T)
-                s_zm[:E] = d0.zmask(e_rows, lat.Z)
-                s_cm[:E] = d0.cmask(e_rows, lat.C)
-                s_np[:E] = d0.np_id[:E]
-                s_npods[:E] = d0.npods[:E]
-                s_open[:E] = True
-                s_fixed[:E] = True
-                s_alloc[:E] = d0.alloc_cap[:E]
-                s_pm[:E] = d0.pm[:E]
-                s_po[:E] = d0.po[:E]
-            # rows [E,E+K): kept new bins from all shards (open, re-priced)
-            for i, (d, b, _content) in enumerate(kept):
-                r = E + i
-                dec = decs[d]
-                s_cum[r] = dec.cum[b]
-                s_tm[r] = k_tm[i]
-                s_zm[r] = k_zm[i]
-                s_cm[r] = k_cm[i]
-                s_np[r] = dec.np_id[b]
-                s_npods[r] = dec.npods[b]
-                s_open[r] = True
-                s_pm[r] = dec.pm[b]
-                s_po[r] = dec.po[b]
-            init = binpack.BinState(
-                cum=jnp.asarray(s_cum), tmask=jnp.asarray(s_tm),
-                zmask=jnp.asarray(s_zm), cmask=jnp.asarray(s_cm),
-                np_id=jnp.asarray(s_np), npods=jnp.asarray(s_npods),
-                open=jnp.asarray(s_open), fixed=jnp.asarray(s_fixed),
-                alloc_cap=jnp.asarray(s_alloc), pm=jnp.asarray(s_pm),
-                po=jnp.asarray(s_po), next_open=jnp.array(E + K, jnp.int32),
-            )
-            td = time.perf_counter()
-            # group/pool inputs ride the same single fused upload as the
-            # primary solve; the seeded BinState stages per-array (its rows
-            # are rebuilt from shard results each retry)
-            buf = np.asarray(binpack.pack_packed_fused(
-                self._alloc, avail, price, fused, init,
-                G, lat.T, lat.Z, lat.C, max(problem.NP, 1), A, lean=True))
-            device_s += time.perf_counter() - td
+        reuse = merge_ctx.get("reuse") if merge_ctx else None
+        if reuse is not None:
+            buf, B2 = reuse
             mdec = _unpack_decode_set(buf, G, lat.T, lat.Z, lat.C, A,
                                       lean=True)
             leftover2 = mdec.leftover
-            overflowed = (leftover2.sum() > 0) and mdec.next_open >= B2
-            if overflowed:
-                B2, grew = _grow_bucket(B2)
-                if grew:
-                    continue
-            break
+            merge_ctx["reused"] = True
+        else:
+            fused_np = self._fused_inputs_np(problem, G,
+                                             count_override=merge_count)
+            avail, price = self._device_avail_price(problem)
+            k_tm, k_zm, k_cm = self._stacked_masks(
+                decs, [(d, b) for d, b, _ in kept])
+
+            while True:
+                seed_np = self._merge_seed_np(problem, decs, kept, B2,
+                                              k_tm, k_zm, k_cm)
+                combined = np.concatenate([fused_np, seed_np])
+                td = time.perf_counter()
+                comb_dev = jnp.asarray(combined)
+                self._account_link("upload", combined.nbytes)
+                buf = np.asarray(binpack.pack_packed_seeded(
+                    self._alloc, avail, price, comb_dev, int(fused_np.size),
+                    B2, G, lat.T, lat.Z, lat.C, max(problem.NP, 1), A,
+                    lean=True))
+                self._account_link("fetch", buf.nbytes)
+                device_s += time.perf_counter() - td
+                mdec = _unpack_decode_set(buf, G, lat.T, lat.Z, lat.C, A,
+                                          lean=True)
+                leftover2 = mdec.leftover
+                overflowed = (leftover2.sum() > 0) and mdec.next_open >= B2
+                if overflowed:
+                    B2, grew = _grow_bucket(B2)
+                    if grew:
+                        # the retry re-stages and re-fetches: 2 more
+                        # accounted legs, excused from the per-pass
+                        # bound via this counter
+                        self.pipeline_stats["micro_merge_regrows"] += 1
+                        continue
+                break
+            if merge_ctx is not None:
+                merge_ctx["ran"] = True
+                merge_ctx["result"] = (buf, B2)
 
         # -- decode the merged table
         assign2 = mdec.assign
+        return self._merge_decode(problem, mdec, leftover2, assign2, kept,
+                                  tail_names, existing_assignments,
+                                  unschedulable, device_s)
+
+    def _merge_seed_np(self, problem: Problem, decs: List[_DecodeSet],
+                       kept, B2: int, k_tm: np.ndarray, k_zm: np.ndarray,
+                       k_cm: np.ndarray) -> np.ndarray:
+        """The merge's seeded bin table as ONE host uint8 buffer
+        (ops/binpack.seed_layout): rows [0,E) are the existing bins at
+        their post-pack shard-0 state (fixed), rows [E,E+K) the kept new
+        bins from all shards (open, re-priced at finalization). Values
+        are bit-exact with the per-array staging this replaced."""
+        lat = self.lattice
+        E = problem.E
+        K = len(kept)
+        layout, total = binpack.seed_layout(B2, lat.T, lat.Z, lat.C, R,
+                                            max(problem.A, 1))
+        buf = np.zeros((total,), np.uint8)
+        v: Dict[str, np.ndarray] = {}
+        for f in layout:
+            n = int(np.prod(f.shape)) * np.dtype(f.dtype).itemsize
+            view = buf[f.offset: f.offset + n].view(f.dtype).reshape(f.shape)
+            if f.fill != 0:
+                view.fill(f.fill)
+            v[f.name] = view
+        if E:
+            d0 = decs[0]
+            e_rows = np.arange(E)
+            v["s_cum"][:E] = d0.cum[:E]
+            v["s_tmask"][:E] = d0.tmask(e_rows, lat.T)
+            v["s_zmask"][:E] = d0.zmask(e_rows, lat.Z)
+            v["s_cmask"][:E] = d0.cmask(e_rows, lat.C)
+            v["s_np"][:E] = d0.np_id[:E]
+            v["s_npods"][:E] = d0.npods[:E]
+            v["s_open"][:E] = 1
+            v["s_fixed"][:E] = 1
+            v["s_alloc"][:E] = d0.alloc_cap[:E]
+            v["s_pm"][:E] = d0.pm[:E]
+            v["s_po"][:E] = d0.po[:E]
+        for i, (d, b, _content) in enumerate(kept):
+            r = E + i
+            dec = decs[d]
+            v["s_cum"][r] = dec.cum[b]
+            v["s_tmask"][r] = k_tm[i]
+            v["s_zmask"][r] = k_zm[i]
+            v["s_cmask"][r] = k_cm[i]
+            v["s_np"][r] = dec.np_id[b]
+            v["s_npods"][r] = dec.npods[b]
+            v["s_open"][r] = 1
+            v["s_pm"][r] = dec.pm[b]
+            v["s_po"][r] = dec.po[b]
+        v["s_next"][0] = E + K
+        return buf
+
+    def _merge_decode(self, problem: Problem, mdec: _DecodeSet,
+                      leftover2: np.ndarray, assign2: np.ndarray, kept,
+                      tail_names, existing_assignments: Dict[str, List[str]],
+                      unschedulable: Dict[str, str],
+                      device_s: float) -> NodePlan:
+        """Decode the merged table into the refinement NodePlan."""
+        lat = self.lattice
+        E = problem.E
         m_np_id = mdec.np_id
         m_ct = mdec.chosen_t
         m_cz = mdec.chosen_z

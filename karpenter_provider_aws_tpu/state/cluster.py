@@ -65,6 +65,78 @@ class DirtySet:
     volumes: bool = False      # PVC / StorageClass mutations
     daemonsets: bool = False   # daemonset pod set changed (ds_overhead)
     other: bool = False        # anything the journal cannot localize
+    # journal drains merged into this set (DirtyJournalCoalescer): >1
+    # means the controller fell behind and several batch-window ticks
+    # were coalesced into one device-block delta
+    ticks: int = 1
+
+    def merge(self, newer: "DirtySet") -> None:
+        """Fold a LATER drain into this one. Valid only when ``newer``
+        continues exactly where this set ends (newer.since == rev) —
+        the coalescer guarantees it, so the merged set covers
+        (self.since, newer.rev] with no gap."""
+        assert newer.since == self.rev, "non-contiguous journal drains"
+        self.rev = newer.rev
+        self.full = self.full or newer.full
+        self.pods |= newer.pods
+        self.bins = self.bins or newer.bins
+        self.volumes = self.volumes or newer.volumes
+        self.daemonsets = self.daemonsets or newer.daemonsets
+        self.other = self.other or newer.other
+        self.ticks += newer.ticks
+
+
+class DirtyJournalCoalescer:
+    """Streams the dirty journal into a pending device-block delta
+    BETWEEN provisioning passes (docs/reference/microloop.md).
+
+    ``dirty_since`` walks the journal tail under the cluster mirror's
+    lock — the hottest lock in the process. A controller that falls
+    behind (long batch window, slow pass) otherwise pays one long
+    locked walk at pass start, exactly when latency matters most. The
+    coalescer drains in small increments on every batch-window poll
+    (:meth:`tick`) and merges the drains, so the pass itself picks up
+    an already-coalesced set covering every journal tick since the
+    last build (:meth:`take`) — one short drain instead of the whole
+    backlog. An anchor mismatch (builder rebuilt at a different
+    revision, another life of the mirror) falls back to a direct
+    ``dirty_since`` — never a silently-partial answer.
+    """
+
+    def __init__(self, cluster: "ClusterState"):
+        self._cluster = cluster
+        self._merged: Optional[DirtySet] = None
+        # observability: provisioner stats surface these
+        self.ticks = 0
+        self.takes = 0
+        self.fallbacks = 0
+
+    def tick(self, since: int) -> None:
+        """Drain journal entries newer than what is already pending
+        (anchored at ``since``, the incremental builder's revision)."""
+        self.ticks += 1
+        m = self._merged
+        if m is not None and m.since == since:
+            if m.rev != self._cluster.state_rev:
+                m.merge(self._cluster.dirty_since(m.rev))
+            return
+        self._merged = self._cluster.dirty_since(since)
+
+    def take(self, since: int) -> DirtySet:
+        """The coalesced set covering (``since``, now] — consumed. Falls
+        back to a direct journal read when the pending set is anchored
+        elsewhere (or nothing was ticked)."""
+        self.takes += 1
+        m, self._merged = self._merged, None
+        if m is None or m.since != since:
+            if m is not None:
+                self.fallbacks += 1
+            return self._cluster.dirty_since(since)
+        if m.rev != self._cluster.state_rev:
+            # mutations landed after the last tick: top the set up so
+            # the pass never builds against a stale horizon
+            m.merge(self._cluster.dirty_since(m.rev))
+        return m
 
 
 class ClusterState:

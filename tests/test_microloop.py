@@ -1,0 +1,345 @@
+"""The device-resident reconcile microloop (ISSUE 14 /
+docs/reference/microloop.md):
+
+- plan parity: solve_delta (the microloop) is byte-identical to a
+  full-staging solve of the same problem, across churn, on one device
+  and on the forced 8-way virtual mesh;
+- the changed-plan fingerprint: an unchanged problem skips the plan
+  fetch (and, on a mesh, the tail-bin merge) while still re-decoding
+  correctly; link legs per steady pass stay within the bound;
+- donation safety: a device fault mid-microloop rebuilds donated state
+  (resident invalidation) instead of re-dispatching against a consumed
+  buffer, and recovery restores parity AND re-engages the microloop;
+- mesh-shape invalidation resets the retained microloop state;
+- the admission-overlap seam runs exactly once per solve_delta call,
+  fallback included;
+- stats() reports every microloop counter without touching the solve
+  lock (the stats-never-blocks pin extended to the new surface);
+- the journal → device-block coalescer: contiguous drains merge, a
+  mismatched anchor falls back to a direct journal read, and batched
+  ticks surface in DirtySet.ticks.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.apis import NodePool, Pod, serde
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+from karpenter_provider_aws_tpu.parallel import plan_mesh
+from karpenter_provider_aws_tpu.solver import Solver, build_problem
+from karpenter_provider_aws_tpu.solver.faults import FaultInjector
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    return build_lattice([s for s in build_catalog()
+                          if s.family in ("m5", "c5")])
+
+
+def _canon(plan) -> str:
+    return json.dumps(serde.plan_semantic_dict(plan), sort_keys=True)
+
+
+def _pods(n_sigs=10, per=5):
+    return [Pod(name=f"p{s}-{i}",
+                requests={"cpu": f"{100 + s * 25}m", "memory": "1Gi"})
+            for s in range(n_sigs) for i in range(per)]
+
+
+class TestMicroloopSingleDevice:
+    def test_parity_across_churn(self, lattice):
+        """Byte-identical to a full-staging solve of the SAME problem
+        at every step — the delta is in bytes moved, never the answer."""
+        solver = Solver(lattice)
+        referee = Solver(lattice)
+        pools = [NodePool(name="default")]
+        pods = _pods()
+        for cut in (0, 3, 7, 1):
+            pods = pods[cut:]
+            problem = build_problem(pods, pools, lattice)
+            got = solver.solve_delta(problem)
+            assert _canon(got) == _canon(referee.solve(problem))
+            assert got.pipelined and got.solver_path == "device"
+        st = solver.stats()
+        assert st["micro_solves"] == 4
+        assert st["micro_aborts"] == 0
+
+    def test_fingerprint_skips_unchanged_plan(self, lattice):
+        """An unchanged problem pays ZERO data legs: no dirty blocks to
+        upload, and the fingerprint suppresses the plan fetch."""
+        solver = Solver(lattice)
+        problem = build_problem(_pods(), [NodePool(name="default")],
+                                lattice)
+        p1 = solver.solve_delta(problem)
+        legs0 = (solver.link_stats["upload_legs"]
+                 + solver.link_stats["fetch_legs"])
+        p2 = solver.solve_delta(problem)
+        st = solver.stats()
+        assert st["micro_skipped_syncs"] == 1
+        assert st["micro_tiny_syncs"] >= 2
+        assert (solver.link_stats["upload_legs"]
+                + solver.link_stats["fetch_legs"]) == legs0
+        assert st["micro_last_legs"] == 0
+        assert _canon(p1) == _canon(p2)
+
+    def test_steady_churn_pays_at_most_two_legs(self, lattice):
+        solver = Solver(lattice)
+        pools = [NodePool(name="default")]
+        pods = _pods(n_sigs=40)   # multi-block fused buffer
+        solver.solve_delta(build_problem(pods, pools, lattice))
+        for cut in (3, 2, 4):
+            pods = pods[cut:]
+            solver.solve_delta(build_problem(pods, pools, lattice))
+            assert solver.pipeline_stats["micro_last_legs"] <= 2
+
+    def test_skipped_sync_redecodes_with_current_names(self, lattice):
+        """Pod NAMES churn even when the packing doesn't: the retained
+        result bytes must decode against the current problem's names."""
+        solver = Solver(lattice)
+        pools = [NodePool(name="default")]
+        a = build_problem(_pods(), pools, lattice)
+        solver.solve_delta(a)
+        renamed = [Pod(name=f"r{s}-{i}",
+                       requests={"cpu": f"{100 + s * 25}m",
+                                 "memory": "1Gi"})
+                   for s in range(10) for i in range(5)]
+        b = build_problem(renamed, pools, lattice)
+        plan = solver.solve_delta(b)
+        # identical packing → fetch skipped, but the plan names the NEW pods
+        assert solver.stats()["micro_skipped_syncs"] == 1
+        placed = {p for n in plan.new_nodes for p in n.pods} | {
+            p for v in plan.existing_assignments.values() for p in v}
+        assert placed == {p.name for p in renamed} - set(plan.unschedulable)
+
+    def test_overlap_runs_exactly_once(self, lattice):
+        solver = Solver(lattice)
+        problem = build_problem(_pods(), [NodePool(name="default")],
+                                lattice)
+        calls = []
+        solver.solve_delta(problem, overlap=lambda: calls.append(1))
+        assert calls == [1]
+        assert solver.stats()["overlapped_admission"] == 1
+        # fallback path (wave-scale G is ineligible) still runs it once
+        fi = FaultInjector(g_limit=2)
+        solver.inject_faults(fi)
+        pods = [Pod(name=f"w{s}", requests={"cpu": f"{100 + s}m"})
+                for s in range(8)]
+        wave = build_problem(pods, [NodePool(name="default")], lattice)
+        calls.clear()
+        plan = solver.solve_delta(wave, overlap=lambda: calls.append(1))
+        solver.inject_faults(None)
+        assert calls == [1]
+        assert plan.solver_path == "wave-split"
+        assert solver.stats()["micro_aborts"] == 1
+
+
+class TestDonationSafety:
+    def test_fault_mid_microloop_rebuilds_donated_state(self, lattice):
+        """The donation-safety pin: a device fault mid-microloop must
+        invalidate the resident (donated) state so recovery re-uploads
+        fresh — never re-dispatches a consumed buffer — and the faulted
+        pass still returns a parity plan via the ladder."""
+        solver = Solver(lattice)
+        referee = Solver(lattice)
+        pools = [NodePool(name="default")]
+        problem = build_problem(_pods(), pools, lattice)
+        solver.solve_delta(problem)
+        misses0 = solver._resident.misses
+        solver.inject_faults(FaultInjector(device_errors=1))
+        faulted = solver.solve_delta(problem)
+        solver.inject_faults(None)
+        ref = referee.solve(problem)
+        assert _canon(faulted) == _canon(ref)
+        # the recovery re-uploaded (resident state was dropped, not reused)
+        assert solver._resident.misses > misses0
+        assert solver.stats()["micro_aborts"] == 1
+        assert solver.stats()["micro_engaged"] is False
+        # and the NEXT pass re-engages the microloop with parity intact
+        again = solver.solve_delta(problem)
+        assert _canon(again) == _canon(ref)
+        assert solver.stats()["micro_solves"] == 2
+        assert solver.stats()["micro_engaged"] is True
+
+    def test_donated_entry_replaced_never_reread(self, lattice):
+        """After a donated delta scatter the cache entry holds the
+        scatter OUTPUT; the consumed base is unreachable. The returned
+        views across passes are distinct live arrays."""
+        from karpenter_provider_aws_tpu.solver.pipeline import (
+            ResidentInputCache)
+        cache = ResidentInputCache(block=64)
+        a = np.arange(1024, dtype=np.uint8)
+        d1 = cache.upload(("k",), a, donate=True)
+        b = a.copy()
+        b[3] ^= 0xFF
+        d2 = cache.upload(("k",), b, donate=True)
+        assert cache.hits == 1 and cache.blocks_shipped >= 1
+        assert np.asarray(d2)[3] == b[3]
+        # a third no-op upload serves from the (replaced) entry
+        d3 = cache.upload(("k",), b, donate=True)
+        assert np.array_equal(np.asarray(d3), b)
+
+
+class TestMicroloopOnMesh:
+    def test_mesh_micro_parity_and_merge_reuse(self, lattice):
+        solver = Solver(lattice, mesh=plan_mesh("8").mesh)
+        referee = Solver(lattice)
+        pools = [NodePool(name="default")]
+        problem = build_problem(_pods(n_sigs=16, per=8), pools, lattice)
+        p1 = solver.solve_delta(problem)
+        assert p1.mesh_devices == 8
+        assert _canon(p1) == _canon(referee.solve(problem))
+        merge_ran = solver.pipeline_stats["micro_merge_solves"]
+        p2 = solver.solve_delta(problem)
+        st = solver.stats()
+        assert st["micro_skipped_syncs"] == 1
+        assert st["micro_last_legs"] == 0
+        if merge_ran:
+            # identical shard results reuse the retained merge bytes
+            assert st["micro_merge_skips"] == 1
+            assert st["micro_merge_solves"] == merge_ran
+        assert _canon(p2) == _canon(p1)
+
+    def test_mesh_shape_change_resets_micro_state(self, lattice):
+        solver = Solver(lattice, mesh=plan_mesh("8").mesh)
+        problem = build_problem(_pods(), [NodePool(name="default")],
+                                lattice)
+        solver.solve_delta(problem)
+        assert solver.stats()["micro_engaged"] is True
+        solver.set_mesh(plan_mesh("4").mesh)
+        assert solver.stats()["micro_engaged"] is False
+        plan = solver.solve_delta(problem)
+        assert plan.mesh_devices == 4
+        # cold under the new mesh: a full fetch, never a stale skip
+        assert solver.stats()["micro_skipped_syncs"] == 0
+
+    def test_pinned_groups_abort_to_standard_planner(self, lattice):
+        """single_bin (co-location) groups need the host split planner:
+        the microloop must abort, and the ladder must still deliver."""
+        from karpenter_provider_aws_tpu.apis import wellknown as wk
+        from karpenter_provider_aws_tpu.apis.objects import PodAffinityTerm
+        solver = Solver(lattice, mesh=plan_mesh("8").mesh)
+        pods = [Pod(name=f"aff{i}",
+                    requests={"cpu": "500m", "memory": "512Mi"},
+                    pod_affinity=[PodAffinityTerm(
+                        topology_key=wk.LABEL_HOSTNAME, anti=False,
+                        label_selector=(("app", "aff"),))],
+                    labels={"app": "aff"}) for i in range(6)]
+        problem = build_problem(pods, [NodePool(name="default")], lattice)
+        if not problem.single_bin.any():
+            pytest.skip("lattice/problem shape did not produce "
+                        "single-bin groups")
+        plan = solver.solve_delta(problem)
+        assert solver.stats()["micro_aborts"] == 1
+        placed = sum(len(n.pods) for n in plan.new_nodes) + sum(
+            len(v) for v in plan.existing_assignments.values())
+        assert placed + len(plan.unschedulable) == len(pods)
+
+
+class TestStatsNeverBlocks:
+    def test_stats_while_solve_lock_held(self, lattice):
+        """The PR 5 pin extended to the microloop counters: stats()
+        must return while another thread holds the solve lock."""
+        solver = Solver(lattice)
+        solver.solve_delta(build_problem(_pods(),
+                                         [NodePool(name="default")],
+                                         lattice))
+        hold = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with solver._solve_lock:
+                hold.set()
+                release.wait(5.0)
+
+        t = threading.Thread(target=holder, daemon=True)
+        t.start()
+        assert hold.wait(5.0)
+        try:
+            done = threading.Event()
+            out = {}
+
+            def snap():
+                out["st"] = solver.stats()
+                done.set()
+
+            threading.Thread(target=snap, daemon=True).start()
+            assert done.wait(2.0), "stats() blocked on the solve lock"
+            for key in ("micro_solves", "micro_last_legs",
+                        "micro_skipped_syncs", "link_upload_legs",
+                        "link_fetch_bytes", "micro_engaged"):
+                assert key in out["st"]
+        finally:
+            release.set()
+            t.join(5.0)
+
+
+class TestJournalCoalescer:
+    def test_contiguous_ticks_merge(self):
+        from karpenter_provider_aws_tpu.state.cluster import (
+            ClusterState, DirtyJournalCoalescer)
+        cs = ClusterState()
+        co = DirtyJournalCoalescer(cs)
+        base = cs.state_rev
+        cs.add_pod(Pod(name="a", requests={"cpu": "1"}))
+        co.tick(base)
+        cs.add_pod(Pod(name="b", requests={"cpu": "1"}))
+        co.tick(base)
+        cs.touch_capacity()
+        d = co.take(base)
+        assert d.since == base and d.rev == cs.state_rev
+        assert {"a", "b"} <= d.pods and d.bins
+        assert not d.full
+        # matches what one direct walk would have answered
+        direct = cs.dirty_since(base)
+        assert d.pods == direct.pods and d.bins == direct.bins
+
+    def test_anchor_mismatch_falls_back(self):
+        from karpenter_provider_aws_tpu.state.cluster import (
+            ClusterState, DirtyJournalCoalescer)
+        cs = ClusterState()
+        co = DirtyJournalCoalescer(cs)
+        cs.add_pod(Pod(name="x", requests={"cpu": "1"}))
+        mid = cs.state_rev
+        co.tick(0)                       # pending set anchored at 0
+        cs.add_pod(Pod(name="y", requests={"cpu": "1"}))
+        d = co.take(mid)                 # builder rebuilt at `mid`
+        assert co.fallbacks == 1
+        assert "y" in d.pods and "x" not in d.pods
+        assert d.since == mid
+
+    def test_ticks_counted(self):
+        from karpenter_provider_aws_tpu.state.cluster import (
+            ClusterState, DirtyJournalCoalescer)
+        cs = ClusterState()
+        co = DirtyJournalCoalescer(cs)
+        base = cs.state_rev
+        for i in range(3):
+            cs.add_pod(Pod(name=f"t{i}", requests={"cpu": "1"}))
+            co.tick(base)
+        d = co.take(base)
+        assert d.ticks >= 3
+
+
+class TestLinkAccounting:
+    def test_full_solve_counts_legs_both_directions(self, lattice):
+        solver = Solver(lattice)
+        solver.solve(build_problem(_pods(), [NodePool(name="default")],
+                                   lattice))
+        ls = solver.link_stats
+        assert ls["upload_legs"] >= 1 and ls["upload_bytes"] > 0
+        assert ls["fetch_legs"] >= 1 and ls["fetch_bytes"] > 0
+
+    def test_metrics_mirror(self, lattice):
+        """The provisioner mirrors solver link counters into the
+        karpenter_solver_link_* families by per-pass delta."""
+        from karpenter_provider_aws_tpu.metrics import (Registry,
+                                                        wire_core_metrics)
+        reg = Registry()
+        m = wire_core_metrics(reg)
+        assert "solver_link_legs" in m and "solver_link_bytes" in m
+        text = reg.render()
+        assert "karpenter_solver_link_legs_total" in text
+        assert "karpenter_solver_link_bytes_total" in text

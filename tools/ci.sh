@@ -35,19 +35,27 @@
 #               carried passes), the delta path rode the mesh
 #               (delta_solves > 0), and sampled plans match a
 #               single-device referee solve exactly
-#   6. prof   — continuous-profiling gate (tools/smoke_profile.py):
+#   6. micro  — device-resident microloop gate (tools/smoke_microloop.py):
+#               operator churn at <5% churn on the forced 8-way virtual
+#               mesh — every delta pass rides the microloop
+#               (micro_solves == delta_solves), link legs per pass stay
+#               within the bound (≤2; ≤4 when the fused tail-bin merge
+#               re-ran), unchanged-plan passes skip the plan fetch
+#               (fingerprint), and sampled plans are byte-identical to a
+#               single-device full-rebuild referee
+#   7. prof   — continuous-profiling gate (tools/smoke_profile.py):
 #               boots an operator with the sampling profiler on, drives
 #               a pass over live HTTP, asserts non-empty folded stacks,
 #               contention counters for every instrumented hot lock,
 #               the gzip negotiation, and the live scrape (with the new
 #               karpenter_lock_wait_seconds family) linting clean
-#   7. write  — API-stratum write-path gate (tools/smoke_writepath.py):
+#   8. write  — API-stratum write-path gate (tools/smoke_writepath.py):
 #               boots an API-mode operator, drives a churn burst through
 #               ApiWriter, asserts the bulk/coalesced write path engaged
 #               (counters > 0), zero fan-out envelope copies, the
 #               watch-fed mirror converging to the store, and the live
 #               /metrics scrape (karpenter_api_* series) linting clean
-#   8. weather— adversarial-weather gate (tools/smoke_weather.py): the
+#   9. weather— adversarial-weather gate (tools/smoke_weather.py): the
 #               60 s `squall` scenario on FakeClock — the degradation
 #               ladder must engage (degraded_total > 0), the SLO burn
 #               must recover below 1.0 after the storm, invariants hold
@@ -55,7 +63,7 @@
 #               bodies counted as malformed), and two runs with the
 #               same seed must record identical weather timelines (and
 #               the lock-order witness reports zero cycles at exit)
-#   9. pool   — solver-pool failover gate (tools/smoke_pool.py): an
+#  10. pool   — solver-pool failover gate (tools/smoke_pool.py): an
 #               operator against a 2-sidecar unix-socket pool, one
 #               sidecar killed mid-churn — passes keep landing on the
 #               survivor (failovers > 0, the local rung never engages
@@ -65,15 +73,15 @@
 #               gauges over live HTTP (scrape lints clean), and the
 #               restarted sidecar's breaker re-closes via the half-open
 #               probe
-#  10. explain— decision-explainability gate (tools/smoke_explain.py):
+#  11. explain— decision-explainability gate (tools/smoke_explain.py):
 #               an operator under a short squall with one deliberately
 #               ICE'd-out pod — /debug/explain over live HTTP must
 #               attribute the pending pod to the ice elimination stage,
 #               `kpctl explain pod` must render the waterfall, the
 #               FailedScheduling dedup must hold, and the explain
 #               provider's reason-code histogram must report
-#  11. tier-1 — the full non-slow test suite on the CPU backend
-#  12. bench  — `bench.py --smoke`: one fast config through the real
+#  12. tier-1 — the full non-slow test suite on the CPU backend
+#  13. bench  — `bench.py --smoke`: one fast config through the real
 #               harness, so a broken solve path can never ride in on a
 #               green unit-test run
 
@@ -85,7 +93,7 @@ PY=${PYTHON:-python}
 FAST=0
 [ "${1:-}" = "--fast" ] && FAST=1
 
-echo "=== ci [1/12] generated-artifact drift ==="
+echo "=== ci [1/13] generated-artifact drift ==="
 $PY tools/gen_crds.py --check
 tmp=$(mktemp -d)
 trap 'rm -rf "$tmp"' EXIT
@@ -100,41 +108,44 @@ done
 [ "$stale" = 0 ] || exit 1
 echo "drift: clean"
 
-echo "=== ci [2/12] graftlint (project-invariant static analysis) ==="
+echo "=== ci [2/13] graftlint (project-invariant static analysis) ==="
 $PY tools/lint/run.py --check
 
-echo "=== ci [3/12] introspection smoke + metrics lint ==="
+echo "=== ci [3/13] introspection smoke + metrics lint ==="
 $PY tools/smoke_introspect.py
 
-echo "=== ci [4/12] steady-state delta churn smoke ==="
+echo "=== ci [4/13] steady-state delta churn smoke ==="
 $PY tools/smoke_delta.py
 
-echo "=== ci [5/12] sharded mesh smoke ==="
+echo "=== ci [5/13] sharded mesh smoke ==="
 $PY tools/smoke_sharded.py
 
-echo "=== ci [6/12] continuous-profiling smoke ==="
+echo "=== ci [6/13] device-resident microloop smoke ==="
+$PY tools/smoke_microloop.py
+
+echo "=== ci [7/13] continuous-profiling smoke ==="
 $PY tools/smoke_profile.py
 
-echo "=== ci [7/12] write-path smoke ==="
+echo "=== ci [8/13] write-path smoke ==="
 $PY tools/smoke_writepath.py
 
-echo "=== ci [8/12] adversarial-weather smoke ==="
+echo "=== ci [9/13] adversarial-weather smoke ==="
 $PY tools/smoke_weather.py
 
-echo "=== ci [9/12] solver-pool failover smoke ==="
+echo "=== ci [10/13] solver-pool failover smoke ==="
 $PY tools/smoke_pool.py
 
-echo "=== ci [10/12] decision-explainability smoke ==="
+echo "=== ci [11/13] decision-explainability smoke ==="
 $PY tools/smoke_explain.py
 
-echo "=== ci [11/12] tier-1 tests ==="
+echo "=== ci [12/13] tier-1 tests ==="
 $PY -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider
 
 if [ "$FAST" = 1 ]; then
-    echo "=== ci [12/12] bench smoke: SKIPPED (--fast) ==="
+    echo "=== ci [13/13] bench smoke: SKIPPED (--fast) ==="
 else
-    echo "=== ci [12/12] bench smoke ==="
+    echo "=== ci [13/13] bench smoke ==="
     $PY bench.py --smoke
 fi
 

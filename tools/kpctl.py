@@ -580,6 +580,9 @@ def _render_top(doc, server: str):
         f"async {g('solver', 'async_solves'):g}   "
         f"delta {g('solver', 'delta_solves'):g} "
         f"({g('solver', 'delta_dirty_groups'):g} dirty grp)   "
+        f"micro {g('solver', 'micro_solves'):g} "
+        f"({g('solver', 'micro_last_legs'):g} legs/pass, "
+        f"{g('solver', 'micro_skipped_syncs'):g} skipped syncs)   "
         f"degraded {degraded:g}")
     # the solver failover pool (docs/reference/solver-pool.md): endpoint
     # health, breaker states, failovers. Absent without --solver-address.

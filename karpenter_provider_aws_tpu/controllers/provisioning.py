@@ -262,6 +262,41 @@ class Provisioner:
                    unschedulable=result.pods_unschedulable)
             return result
 
+    def warm_build(self, solve: bool = False) -> bool:
+        """Standby pre-build (state/replication.py StandbyReplica): run
+        the pass's problem build — and optionally a PURE solve — over
+        the replicated mirror WITHOUT dispatching a single write. The
+        resident device problem and the persistent compile cache warm up
+        exactly as a real pass would, so the first post-promotion pass
+        is a delta, not a compile storm. Returns True when a problem was
+        built."""
+        lattice = masked_view_versioned(self.solver.lattice, self.unavailable)
+        pvcs, storage_classes = self.cluster.volume_state()
+        headroom = self._pool_headroom(self.cluster.pool_usage())
+        pools = list(self.node_pools.values())
+        pending = self.cluster.pending_pods()
+        dirty = self.journal_coalescer.take(self.inc_builder.rev)
+        touched = (self.cluster.touched_pods(dirty.pods)
+                   if dirty.pods and not dirty.full else {})
+        build = self.inc_builder.build(
+            pending, pools, lattice,
+            existing=lambda: self.cluster.existing_bins(lattice),
+            daemonset_pods=self.cluster.daemonset_pods,
+            bound_pods=self.cluster.bound_pods,
+            pvcs=pvcs, storage_classes=storage_classes,
+            pool_headroom=headroom, dirty=dirty, touched=touched)
+        if solve and pending:
+            # solve_relaxed is side-effect free: plans are computed, never
+            # acted on — this is compile/trace warmth only
+            self.solver.solve_relaxed(
+                pending, pools, lattice,
+                existing=self.cluster.existing_bins(lattice),
+                daemonset_pods=self.cluster.daemonset_pods(),
+                bound_pods=self.cluster.bound_pods(),
+                pvcs=pvcs, storage_classes=storage_classes,
+                pool_headroom=headroom, problem0=build.problem)
+        return build.problem is not None
+
     def _provision(self, pending: Sequence[Pod],
                    rev0: Optional[int] = None) -> ProvisionResult:
         # versioned memo: the SAME view object comes back while prices and

@@ -32,6 +32,23 @@ from .apiserver import (
 from .client import KubeClient
 
 
+class FencedWriteError(RuntimeError):
+    """A side-effectful write was attempted under a fencing token the
+    lease store no longer carries — a demoted (zombie) leader's queued
+    eviction/claim/bind. Raised AT THE VERB so the write never reaches
+    the store; the controller runtime counts it like any reconcile error
+    and the zombie's loop goes quiet instead of racing the new leader."""
+
+    def __init__(self, verb: str, fence: int):
+        # lazy: kube must stay importable without the solver package
+        from ..solver.taxonomy import FENCED_WRITE_REJECTED, reason
+        self.verb = verb
+        self.fence = fence
+        self.reason = reason(FENCED_WRITE_REJECTED,
+                             f"{verb} under rotated fence (held {fence})")
+        super().__init__(self.reason)
+
+
 class WriterCounts:
     """Per-verb write-throughput counters shared by both writer
     implementations — the introspection registry's ``writer`` provider,
@@ -46,6 +63,24 @@ class WriterCounts:
         # is the serializer
         from ..introspect import contention
         self._counts_lock = contention.lock("writer")
+        # handoff fencing (operator/leaderelection.py FenceGuard):
+        # unarmed (None) in single-operator deployments — one attribute
+        # read on the write path
+        self._fence = None
+
+    def set_fence(self, guard) -> None:
+        """Arm handoff fencing: every side-effectful verb re-checks the
+        lease store's fencing token first and raises
+        :class:`FencedWriteError` (counted as ``fenced_reject``) when it
+        rotated — the zombie-leader write barrier."""
+        self._fence = guard
+
+    def _check_fence(self, verb: str) -> None:
+        g = self._fence
+        if g is None or g.check():
+            return
+        self._count("fenced_reject")
+        raise FencedWriteError(verb, g.fence)
 
     def _count(self, verb: str, n: int = 1) -> None:
         with self._counts_lock:
@@ -67,15 +102,18 @@ class DirectWriter(WriterCounts):
     # ---- claims ------------------------------------------------------------
 
     def create_claim(self, claim: NodeClaim) -> None:
+        self._check_fence("create_claim")
         self._count("create_claim")
         self.cluster.add_claim(claim)
 
     def update_claim_status(self, claim: NodeClaim) -> None:
         # in-place mutation is already visible through the mirror
+        self._check_fence("update_claim_status")
         self._count("update_claim_status")
 
     def mark_claim_deleting(self, name: str) -> None:
         """The k8s delete that starts the finalizer/termination flow."""
+        self._check_fence("mark_claim_deleting")
         self._count("mark_claim_deleting")
         claim = self.cluster.claims.get(name)
         if claim is None:
@@ -89,23 +127,27 @@ class DirectWriter(WriterCounts):
     def rollback_claim(self, name: str) -> None:
         """Hard delete of a claim whose instance never materialized (or is
         already gone) — no drain, no finalizer round."""
+        self._check_fence("rollback_claim")
         self._count("rollback_claim")
         self.cluster.delete_claim(name)
 
     def finalize_claim(self, claim: NodeClaim) -> None:
         """Termination complete: remove the claim object."""
+        self._check_fence("finalize_claim")
         self._count("finalize_claim")
         self.cluster.delete_claim(claim.name)
 
     # ---- nodes -------------------------------------------------------------
 
     def register_node(self, node: Node, lease: Optional[Lease] = None) -> None:
+        self._check_fence("register_node")
         self._count("register_node")
         self.cluster.add_node(node)
         if lease is not None:
             self.cluster.add_lease(lease)
 
     def cordon(self, node: Node, taint) -> bool:
+        self._check_fence("cordon")
         if all(t.key != taint.key for t in node.taints):
             self._count("cordon")
             node.taints.append(taint)
@@ -113,16 +155,19 @@ class DirectWriter(WriterCounts):
         return False
 
     def drain_node(self, node_name: str) -> Tuple[List[Pod], List[Pod]]:
+        self._check_fence("drain_node")
         self._count("drain_node")
         return self.cluster.drain_node(node_name)
 
     def teardown_node(self, node_name: str) -> None:
+        self._check_fence("teardown_node")
         self._count("teardown_node")
         self.cluster.evict_node(node_name)
 
     # ---- pods / volumes / leases ------------------------------------------
 
     def bind_pod(self, pod_name: str, node_name: str) -> bool:
+        self._check_fence("bind_pod")
         self._count("bind_pod")
         self.cluster.bind_pod(pod_name, node_name)
         return True
@@ -133,10 +178,12 @@ class DirectWriter(WriterCounts):
         return [self.bind_pod(p, n) for p, n in pairs]
 
     def bind_volumes(self, pod_name: str, zone: Optional[str]) -> None:
+        self._check_fence("bind_volumes")
         self._count("bind_volumes")
         self.cluster.bind_volumes(pod_name, zone)
 
     def delete_lease(self, name: str) -> None:
+        self._check_fence("delete_lease")
         self._count("delete_lease")
         self.cluster.delete_lease(name)
 
@@ -158,11 +205,13 @@ class ApiWriter(WriterCounts):
         # legs between solve and CreateFleet); contextvars carry the trace
         # across this in-process hop — the httpserver carries it when the
         # same seam is driven over the wire
+        self._check_fence("create_claim")
         self._count("create_claim")
         with trace.span("kube.create_nodeclaim", claim=claim.name):
             self.kube.create_nodeclaim(claim)
 
     def update_claim_status(self, claim: NodeClaim) -> None:
+        self._check_fence("update_claim_status")
         self._count("update_claim_status")
         try:
             self.kube.update_nodeclaim(claim)
@@ -170,6 +219,7 @@ class ApiWriter(WriterCounts):
             pass  # deleted out from under us; the next reconcile observes it
 
     def mark_claim_deleting(self, name: str) -> None:
+        self._check_fence("mark_claim_deleting")
         self._count("mark_claim_deleting")
         try:
             self.kube.delete_nodeclaim(name, now=self.clock.now())
@@ -179,6 +229,7 @@ class ApiWriter(WriterCounts):
         # lands; gauges re-render then
 
     def rollback_claim(self, name: str) -> None:
+        self._check_fence("rollback_claim")
         self._count("rollback_claim")
         try:
             self.kube.delete_nodeclaim_now(name)
@@ -186,18 +237,21 @@ class ApiWriter(WriterCounts):
             pass
 
     def finalize_claim(self, claim: NodeClaim) -> None:
+        self._check_fence("finalize_claim")
         self._count("finalize_claim")
         self.kube.remove_nodeclaim_finalizer(claim.name)
 
     # ---- nodes -------------------------------------------------------------
 
     def register_node(self, node: Node, lease: Optional[Lease] = None) -> None:
+        self._check_fence("register_node")
         self._count("register_node")
         self.kube.create_node(node)
         if lease is not None:
             self.kube.create_lease(lease)
 
     def cordon(self, node: Node, taint) -> bool:
+        self._check_fence("cordon")
         try:
             if self.kube.taint_node(node.name, taint):
                 self._count("cordon")
@@ -215,6 +269,7 @@ class ApiWriter(WriterCounts):
         flush); the server evaluates each pod's PDB allowance in order
         inside the batch, so verdicts match the per-call sequence
         exactly."""
+        self._check_fence("drain_node")
         self._count("drain_node")
         pods = [p for p in self.cluster.pods_by_node().get(node_name, [])
                 if not p.is_daemonset]
@@ -238,6 +293,7 @@ class ApiWriter(WriterCounts):
         """Final teardown: force-evict stragglers (grace-zero delete
         analog), remove daemonset pods with the node, delete the node —
         all one bulk batch (NotFound slots are raced teardowns)."""
+        self._check_fence("teardown_node")
         self._count("teardown_node")
         ops = []
         for pod in self.cluster.pods_by_node().get(node_name, []):
@@ -255,6 +311,7 @@ class ApiWriter(WriterCounts):
         watch stream carries whatever the truth is, and callers must not
         count the pod as scheduled (karpenter_pods_scheduled_total would
         overcount)."""
+        self._check_fence("bind_pod")
         try:
             with trace.span("kube.bind_pod", pod=pod_name, node=node_name):
                 self.kube.bind_pod(pod_name, node_name)
@@ -271,6 +328,7 @@ class ApiWriter(WriterCounts):
         contract (False = not scheduled)."""
         if not pairs:
             return []
+        self._check_fence("bind_pods")
         with trace.span("kube.bind_pods", pods=len(pairs)):
             oks = self.kube.bind_pods(pairs)
         n = sum(oks)
@@ -284,6 +342,7 @@ class ApiWriter(WriterCounts):
         controller analog); the mirror converges via the pvcs informer."""
         if not zone:
             return
+        self._check_fence("bind_volumes")
         self._count("bind_volumes")
         pod = self.cluster.pods.get(pod_name)
         if pod is None:
@@ -297,5 +356,6 @@ class ApiWriter(WriterCounts):
                     pass
 
     def delete_lease(self, name: str) -> None:
+        self._check_fence("delete_lease")
         self._count("delete_lease")
         self.kube.delete_lease(name)

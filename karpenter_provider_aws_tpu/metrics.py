@@ -551,6 +551,41 @@ def wire_core_metrics(reg: Registry) -> Dict[str, _Metric]:
             "karpenter_cluster_state_pod_count", "Pods tracked by cluster state.", ()),
         "ice_cache_size": reg.gauge(
             "karpenter_ice_cache_size", "Offerings currently marked unavailable.", ()),
+        # zero-downtime operator handoff (state/replication.py +
+        # operator/leaderelection.py; docs/reference/handoff.md): leader/
+        # standby role, the monotonic fencing token, and the replication
+        # stream's progress — only exported once wire_handoff() ran
+        "operator_leader_state": reg.gauge(
+            "karpenter_operator_leader_state",
+            "1 while this replica holds the leader lease, 0 on a standby "
+            "(mirrors the elector's view; flips on promotion/demotion).", ()),
+        "handoff_fence_token": reg.gauge(
+            "karpenter_operator_handoff_fence_token",
+            "Fencing token under which this replica last held the lease "
+            "(monotonic across takeovers; a zombie leader's writes carry "
+            "a stale token and are rejected).", ()),
+        "handoff_fenced_writes": reg.gauge(
+            "karpenter_operator_handoff_fenced_writes",
+            "Side-effectful writes rejected by the fence guard because "
+            "the lease was lost or the token rotated (each one is a "
+            "zombie-leader action that did NOT race the new leader).", ()),
+        "handoff_snapshots": reg.gauge(
+            "karpenter_operator_handoff_snapshots",
+            "Full state snapshots taken over the replication stream "
+            "(leader: served; standby: applied).", ()),
+        "handoff_deltas": reg.gauge(
+            "karpenter_operator_handoff_deltas",
+            "Incremental journal deltas streamed over the replication "
+            "transport (leader: served; standby: applied).", ()),
+        "handoff_rebuilds": reg.gauge(
+            "karpenter_operator_handoff_rebuilds",
+            "Standby full rebuilds forced by the cutover ladder, by "
+            "reason (stale-anchor | snapshot-version-mismatch).",
+            ("reason",)),
+        "handoff_lease_transitions": reg.gauge(
+            "karpenter_operator_handoff_lease_transitions",
+            "Leadership transitions this elector observed on itself "
+            "(promotions + demotions).", ()),
     }
 
 

@@ -324,7 +324,73 @@ class Operator:
                 self.interruption_queue, self.cluster, self.termination,
                 self.unavailable, self.recorder, self.clock, self.metrics)
         self._last_cache_cleanup = 0.0
+        # handoff wiring (wire_handoff): unarmed by default — a single
+        # operator pays one None check per write verb and no gauges
+        self.elector = None
+        self.handoff_replica = None
+        self.handoff_source = None
+        self._fence_guard = None
         self._wire_introspection()
+
+    def wire_handoff(self, elector, replica=None, source=None) -> None:
+        """Arm the operator-handoff surfaces (docs/reference/handoff.md):
+        thread the elector's fence guard through the write seam, register
+        the ``handoff`` introspection provider, and hook promotion side
+        effects — the orphaned-lease sweep (holders that died in the
+        blackout window) and the introspection re-wire (two in-process
+        operators share the replace-by-name registry; the one now in
+        charge re-asserts its providers). ``replica`` is this operator's
+        StandbyReplica when it runs warm behind a leader; ``source`` its
+        ReplicationSource when it serves one."""
+        self.elector = elector
+        self.handoff_replica = replica
+        self.handoff_source = source
+        self._fence_guard = elector.fence_guard()
+        self.writer.set_fence(self._fence_guard)
+        prev_promote = elector.on_promote
+
+        def _promoted():
+            self.cluster.sweep_orphaned_leases(self.writer.delete_lease)
+            self._wire_introspection()
+            self._register_handoff_provider()
+            if prev_promote is not None:
+                prev_promote()
+
+        elector.on_promote = _promoted
+        self._register_handoff_provider()
+
+    def _register_handoff_provider(self) -> None:
+        from .. import introspect
+        introspect.registry().register("handoff", self.handoff_stats)
+
+    def handoff_stats(self) -> Dict[str, object]:
+        """The ``handoff`` introspection provider: leadership, fencing,
+        and replication counters — the LEADER row in kpctl top and the
+        karpenter_operator_handoff_* gauges read this."""
+        el = self.elector
+        if el is None:
+            return {"wired": False}
+        out: Dict[str, object] = {
+            "wired": True,
+            "leader": bool(el.is_leader),
+            "identity": el.identity,
+            "fence": el.fence,
+            "transitions": el.transitions,
+            "promotions_blocked": el.promotions_blocked,
+            "leases_swept": self.cluster.leases_swept,
+        }
+        if self._fence_guard is not None:
+            out["fence_checks"] = self._fence_guard.checks
+            out["fenced_rejections"] = self._fence_guard.rejections
+        if hasattr(el.store, "corrupt_reads"):
+            out["lease_corrupt_reads"] = el.store.corrupt_reads
+        if self.handoff_replica is not None:
+            out.update({f"replica_{k}": v
+                        for k, v in self.handoff_replica.stats().items()})
+        if self.handoff_source is not None:
+            out.update({f"source_{k}": v
+                        for k, v in self.handoff_source.stats().items()})
+        return out
 
     def _wire_introspection(self) -> None:
         """Register every stateful subsystem's stats() with the
@@ -546,6 +612,34 @@ class Operator:
                 {(addr,): float({"closed": 0, "half-open": 1,
                                  "open": 2}[state])
                  for addr, state in self.solver.breaker_states().items()})
+        # the handoff surface (state/replication.py + operator/
+        # leaderelection.py; docs/reference/handoff.md): role, fencing
+        # token, fenced-write rejections, and replication-stream progress
+        # — exported only once wire_handoff() attached an elector
+        if self.elector is not None:
+            ho = self.handoff_stats()
+            self.metrics.gauge("karpenter_operator_leader_state").set(
+                1.0 if ho.get("leader") else 0.0)
+            self.metrics.gauge("karpenter_operator_handoff_fence_token").set(
+                float(ho.get("fence", 0)))
+            self.metrics.gauge(
+                "karpenter_operator_handoff_fenced_writes").set(
+                float(ho.get("fenced_rejections", 0)))
+            self.metrics.gauge(
+                "karpenter_operator_handoff_lease_transitions").set(
+                float(ho.get("transitions", 0)))
+            # a replica reports what it applied; a serving leader reports
+            # what it streamed out — whichever side this process is on
+            self.metrics.gauge("karpenter_operator_handoff_snapshots").set(
+                float(ho.get("replica_snapshots",
+                             ho.get("source_snapshots", 0))))
+            self.metrics.gauge("karpenter_operator_handoff_deltas").set(
+                float(ho.get("replica_deltas", ho.get("source_deltas", 0))))
+            self.metrics.get("karpenter_operator_handoff_rebuilds").replace(
+                {("stale-anchor",): float(
+                    ho.get("replica_stale_anchor_rebuilds", 0)),
+                 ("snapshot-version-mismatch",): float(
+                    ho.get("replica_version_mismatch_rebuilds", 0))})
         # pods by phase (the state pump and the provisioner also refresh
         # this between metrics passes) + the rolling SLO burn decision
         self.metrics.get("karpenter_pods_state").replace(

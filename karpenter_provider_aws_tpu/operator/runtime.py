@@ -52,12 +52,22 @@ class ControllerRuntime:
                 interval=RETRY_PERIOD, gate_on_leadership=False))
         self._on_error = on_error
         self._stop = threading.Event()
+        self._pause = threading.Event()
         self._threads: List[threading.Thread] = []
         self.error_counts: Dict[str, int] = {}
         self._lock = threading.Lock()
 
     def _run(self, spec: ControllerSpec) -> None:
         while not self._stop.is_set():
+            if self._pause.is_set():
+                # hung-operator chaos (weather OperatorKill mode="hang"):
+                # nothing reconciles and — critically — the election tick
+                # stops renewing, so the lease expires and a standby
+                # promotes while this process still believes it leads.
+                # resume() releases the queued work straight into the
+                # write fence, where it is rejected, not raced.
+                self._stop.wait(0.05)
+                continue
             try:
                 if (self.elector is None or not spec.gate_on_leadership
                         or self.elector.is_leader):
@@ -120,6 +130,38 @@ class ControllerRuntime:
             sys.setswitchinterval(self._prev_switch_interval)
             self._prev_switch_interval = None
         return not self._threads
+
+    def crash_stop(self, timeout: float = 5.0) -> bool:
+        """kill -9 semantics for chaos (weather OperatorKill
+        mode="kill"): stop every thread WITHOUT releasing the lease — a
+        crashed process never runs its shutdown path, so the standby
+        must wait out the full lease duration before it may promote
+        (the blackout window the orphaned-lease sweep cleans up after).
+        The tightened switch interval is still restored: the embedding
+        process lives on, only the operator 'died'."""
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout)
+        self._threads = [t for t in self._threads if t.is_alive()]
+        if not self._threads and getattr(self, "_prev_switch_interval",
+                                         None) is not None:
+            import sys
+            sys.setswitchinterval(self._prev_switch_interval)
+            self._prev_switch_interval = None
+        return not self._threads
+
+    def pause(self) -> None:
+        """Freeze every controller thread in place (OperatorKill
+        mode="hang"): loops keep spinning but reconcile nothing,
+        including the election tick — the hung-leader failure mode."""
+        self._pause.set()
+
+    def resume(self) -> None:
+        self._pause.clear()
+
+    @property
+    def paused(self) -> bool:
+        return self._pause.is_set()
 
     @property
     def running(self) -> bool:

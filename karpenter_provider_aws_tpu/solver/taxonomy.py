@@ -71,12 +71,31 @@ SIDECAR_UNREACHABLE = "sidecar-unreachable"
 # every pool endpoint's breaker is open: the pass ran on the LOCAL
 # solver — the final ladder rung below the whole sidecar fleet
 POOL_EXHAUSTED = "pool-exhausted"
+# operator-handoff provenance (state/replication.py +
+# operator/leaderelection.py; docs/reference/handoff.md): these ride the
+# standby's cutover ladder and the writer's fencing gate, never a pod's
+# unschedulable reason.
+# the standby's journal anchor fell out of the leader's dirty-journal
+# window (or is from another life of the mirror): the delta stream
+# cannot answer and the standby re-snapshots / the promoted operator
+# full-rebuilds — the same always-correct fallback the delta solve path
+# takes on a coalescer miss
+STALE_ANCHOR = "stale-anchor"
+# the leader streams a snapshot format this standby does not speak:
+# refuse to apply it (a half-understood snapshot is worse than a cold
+# start) and keep rebuilding from scratch
+SNAPSHOT_VERSION_MISMATCH = "snapshot-version-mismatch"
+# a side-effectful write was attempted under a fencing token the lease
+# store no longer carries: a demoted (zombie) leader's in-flight
+# eviction/claim write, rejected instead of raced
+FENCED_WRITE_REJECTED = "fenced-write-rejected"
 
 CODES = frozenset({
     UNKNOWN_RESOURCE, NO_OFFERING, ICE_HOLD, ZONE_ANTI_AFFINITY,
     NO_FIT, NO_EXISTING_FIT, NO_NEW_NODE_SHAPE, SINGLE_BIN_FULL,
     AFFINITY_PRESENCE, POOL_LIMITS, SOLVE_ERROR,
     SIDECAR_HUNG, SIDECAR_UNREACHABLE, POOL_EXHAUSTED,
+    STALE_ANCHOR, SNAPSHOT_VERSION_MISMATCH, FENCED_WRITE_REJECTED,
 })
 
 # the parse-failure sentinel for strings minted before the taxonomy (or

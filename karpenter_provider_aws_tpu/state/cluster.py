@@ -172,6 +172,8 @@ class ClusterState:
         # rebuild path, never a silently-partial answer.
         self.state_rev = 0
         self._journal: Deque[Tuple[int, str, str]] = deque(maxlen=_JOURNAL_MAX)
+        # leases GC'd by sweep_orphaned_leases (promotion wires it in)
+        self.leases_swept = 0
 
     # ---- dirty journal ----------------------------------------------------
 
@@ -383,6 +385,19 @@ class ClusterState:
             return [l.name for l in self.leases.values()
                     if l.owner_node is None or l.owner_node not in self.nodes]
 
+    def sweep_orphaned_leases(self, delete) -> int:
+        """GC every orphaned lease through ``delete(name)`` (the writer's
+        delete_lease verb), counting the sweep in :meth:`stats`. A newly
+        promoted leader runs this once: holders that died during the
+        blackout window left leases the periodic GC would only catch on
+        its long interval."""
+        names = self.orphaned_leases()
+        for name in names:
+            delete(name)
+        with self._lock:
+            self.leases_swept += len(names)
+        return len(names)
+
     # ---- PodDisruptionBudgets ---------------------------------------------
 
     def add_pdb(self, pdb) -> None:
@@ -551,6 +566,7 @@ class ClusterState:
                 "claims_deleting": claims_deleting,
                 "pvcs": len(self.pvcs),
                 "leases": len(self.leases),
+                "leases_swept": self.leases_swept,
                 "pdbs": len(self.pdbs),
                 "capacity_rev": self.capacity_rev,
             }

@@ -96,6 +96,31 @@ class SidecarOutage:
     restart_after: bool = True          # kill mode: restart at window end
 
 
+@dataclass(frozen=True)
+class OperatorKill:
+    """OPERATOR weather (docs/reference/handoff.md): at ``at`` seconds
+    the targeted operator runtime dies mid-storm. Modes:
+
+    - ``kill``: crash semantics — the runtime crash-stops WITHOUT
+      releasing its lease (a kill -9 never runs the shutdown path), so
+      the standby must wait out the lease duration before promoting;
+    - ``hang``: the runtime's threads freeze in place — renewal stops,
+      the lease expires, a standby promotes, and when the window closes
+      (``restart_after``) the zombie resumes straight into the write
+      fence, where its queued side effects are rejected.
+
+    Deterministic like :class:`SidecarOutage`: the timeline records
+    kill/restore on the ticks the window edges cross. ``restart_after``
+    defaults to False — a killed leader staying dead is the handoff
+    acceptance shape (the standby must carry the rest of the run)."""
+
+    at: float
+    duration: float
+    target: int = 0                     # index into the operator-handle list
+    mode: str = "kill"                  # kill | hang
+    restart_after: bool = False
+
+
 @dataclass
 class WeatherScenario:
     name: str = "custom"
@@ -113,6 +138,7 @@ class WeatherScenario:
     storms: Tuple[Storm, ...] = ()
     ice: Tuple[IceSpell, ...] = ()
     sidecar_outages: Tuple[SidecarOutage, ...] = ()
+    operator_kills: Tuple[OperatorKill, ...] = ()
 
     # ---- serialization (replayable byte-for-byte from a seed) -----------
 
@@ -134,6 +160,9 @@ class WeatherScenario:
         if "sidecar_outages" in kw:   # absent in pre-PR-13 scenario JSON
             kw["sidecar_outages"] = tup(kw.get("sidecar_outages"),
                                         SidecarOutage)
+        if "operator_kills" in kw:    # absent in pre-PR-17 scenario JSON
+            kw["operator_kills"] = tup(kw.get("operator_kills"),
+                                       OperatorKill)
         known = {f.name for f in dataclasses.fields(cls)}
         unknown = set(kw) - known
         if unknown:
@@ -220,12 +249,30 @@ def named(name: str) -> WeatherScenario:
                 SidecarOutage(at=75.0, duration=15.0, endpoint=1,
                               mode="junk"),
             ))
+    if name == "handoff":
+        # the operator-handoff acceptance scenario (docs/reference/
+        # handoff.md): a violent squall-class storm is raging when the
+        # ACTIVE OPERATOR is killed outright mid-storm (no restart — a
+        # dead leader stays dead). The warm standby must wait out the
+        # lease, pass the bounded-staleness gate, promote behind the
+        # write fence, sweep the blackout window's orphaned leases, and
+        # carry the rest of the storm within the SLO budget. Market
+        # stays mild: the artifact isolates the handoff itself.
+        return WeatherScenario(
+            name="handoff", tick_seconds=1.0, duration_seconds=120.0,
+            market_sigma=0.02,
+            storms=(Storm(at=25.0, duration=40.0, zones=_STD_ZONES[:2],
+                          intensity=0.35, junk_rate=0.2),),
+            ice=(IceSpell(at=25.0, duration=30.0, rate=1.0,
+                          zones=_STD_ZONES[:1], hold_seconds=20.0),),
+            operator_kills=(OperatorKill(at=45.0, duration=60.0,
+                                         target=0, mode="kill"),))
     raise ValueError(f"unknown weather scenario {name!r} "
                      f"(named: {', '.join(NAMED_SCENARIOS)})")
 
 
 NAMED_SCENARIOS = ("calm", "squall", "spot-crash", "ice-age",
-                   "storm-front", "blackout")
+                   "storm-front", "blackout", "handoff")
 
 
 def load_scenario(spec: str) -> WeatherScenario:

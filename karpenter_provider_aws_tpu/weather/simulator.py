@@ -51,7 +51,7 @@ class WeatherSimulator:
     def __init__(self, scenario: WeatherScenario, lattice,
                  seed: Optional[int] = None, clock: Optional[Clock] = None,
                  pricing=None, cloud=None, unavailable=None, queue=None,
-                 solver=None, metrics=None, sidecars=None):
+                 solver=None, metrics=None, sidecars=None, operators=None):
         """Every control-plane seam is optional: with all of them None
         the simulator is a pure replay engine (timeline only).
 
@@ -61,7 +61,14 @@ class WeatherSimulator:
         ``SidecarOutage`` elements drive — one handle per solver-pool
         endpoint index. An outage naming an endpoint beyond the list is
         recorded in the timeline but applies to nothing (the timeline
-        stays a pure function of the scenario either way)."""
+        stays a pure function of the scenario either way).
+
+        ``operators`` is the operator-weather seam (handoff chaos): a
+        sequence of handles with ``kill()/restart()/set_hang()/
+        restore()`` (tools/soak.py OperatorHandle over a
+        ControllerRuntime) that scenario ``OperatorKill`` elements
+        drive — one handle per operator index. Same out-of-range /
+        pure-replay semantics as ``sidecars``."""
         self.scenario = scenario
         self.seed = scenario.seed if seed is None else int(seed)
         self.lattice = lattice
@@ -72,6 +79,7 @@ class WeatherSimulator:
         self.queue = queue
         self.solver = solver
         self.sidecars = list(sidecars) if sidecars else []
+        self.operators = list(operators) if operators else []
         self.market = SpotMarketField(lattice, scenario)
         self.ice = IceField(lattice, scenario)
         self._fam_of = {s.name: s.family for s in lattice.specs}
@@ -82,6 +90,7 @@ class WeatherSimulator:
             "scheduled_changes": 0, "state_changes": 0, "junk_sent": 0,
             "ice_marks": 0, "ice_thaws": 0, "device_errors": 0,
             "sidecar_outages": 0, "sidecar_restores": 0,
+            "operator_kills": 0, "operator_restores": 0,
         }
         self.ticks = 0
         self._t0: Optional[float] = None
@@ -251,6 +260,24 @@ class WeatherSimulator:
                             endpoint=o.endpoint, mode=o.mode)
                 self._restore_outage(o)
 
+        # 4c. operator kills (handoff chaos; operator/runtime.py +
+        # state/replication.py). Deterministic like 4b: the timeline is
+        # a function of (scenario, tick) with or without operator
+        # handles attached.
+        for i, k in enumerate(sc.operator_kills):
+            end_s = k.at + k.duration
+            started = (prev_s < k.at <= now_s or (t == 0 and k.at <= 0))
+            if started:
+                self.counters["operator_kills"] += 1
+                self._event("operator-kill", kill=i,
+                            target=k.target, mode=k.mode)
+                self._apply_opkill(k)
+            if k.at <= now_s and prev_s < end_s <= now_s:
+                self.counters["operator_restores"] += 1
+                self._event("operator-restore", kill=i,
+                            target=k.target, mode=k.mode)
+                self._restore_opkill(k)
+
         # 5. device weather (independent draws per active storm, fixed
         # order — deterministic)
         for i, storm in enumerate(sc.storms):
@@ -296,6 +323,27 @@ class WeatherSimulator:
             h.set_hang(False)
         elif o.mode == "junk":
             h.set_junk(False)
+
+    def _apply_opkill(self, k) -> None:
+        """Drive one OperatorKill onto its operator handle (no-op when
+        no handle is attached at that index — pure replay)."""
+        if not (0 <= k.target < len(self.operators)):
+            return
+        h = self.operators[k.target]
+        if k.mode == "kill":
+            h.kill()
+        elif k.mode == "hang":
+            h.set_hang(True)
+
+    def _restore_opkill(self, k) -> None:
+        if not (0 <= k.target < len(self.operators)):
+            return
+        h = self.operators[k.target]
+        if k.mode == "kill":
+            if k.restart_after:
+                h.restart()
+        elif k.mode == "hang":
+            h.set_hang(False)
 
     def _burst(self, rng, idx: int, storm) -> None:
         """One storm tick: the deterministic part (junk count, timeline
@@ -391,6 +439,10 @@ class WeatherSimulator:
             # the convergence tail runs against a healthy pool
             for h in self.sidecars:
                 h.restore()
+            # operator handles are deliberately NOT restored: a killed
+            # leader staying dead is the handoff acceptance shape — the
+            # promoted standby carries the convergence tail (a hung
+            # runtime still stops cleanly; pause never blocks stop)
             if self._gauges is not None:
                 self._gauges["storm"].set(0.0)
                 self._gauges["ice"].set(0.0)

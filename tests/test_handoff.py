@@ -1,0 +1,414 @@
+"""Zero-downtime operator handoff (docs/reference/handoff.md):
+state/replication.py snapshot + delta streaming, the fenced cutover
+ladder in operator/leaderelection.py, the write barrier in
+kube/writer.py, and the OperatorKill weather element."""
+
+import json
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import Node, NodeClaim, Pod
+from karpenter_provider_aws_tpu.apis.objects import Lease as NodeLease
+from karpenter_provider_aws_tpu.kube.writer import (
+    DirectWriter, FencedWriteError,
+)
+from karpenter_provider_aws_tpu.operator.leaderelection import (
+    FileLeaseStore, LeaderElector, MemoryLeaseStore,
+)
+from karpenter_provider_aws_tpu.state.cluster import ClusterState
+from karpenter_provider_aws_tpu.state.replication import (
+    SNAPSHOT_VERSION, ReplicationService, ReplicationSource, StandbyReplica,
+)
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    from karpenter_provider_aws_tpu.lattice import (
+        build_catalog, build_lattice,
+    )
+    return build_lattice([s for s in build_catalog()
+                          if s.family in ("m5", "t3")])
+
+
+class _LocalClient:
+    """ReplicationClient stand-in that talks straight to the service
+    layer (same JSON bodies, no socket) — the transport is covered by
+    tools/smoke_handoff.py with two real processes."""
+
+    def __init__(self, service):
+        self._service = service
+        self.dead = False
+
+    def snapshot(self):
+        if self.dead:
+            raise ConnectionError("leader unreachable")
+        return json.loads(self._service.snapshot(b"{}").decode())
+
+    def delta(self, since):
+        if self.dead:
+            raise ConnectionError("leader unreachable")
+        return json.loads(self._service.delta(
+            json.dumps({"since": since}).encode()).decode())
+
+
+def _pod(name, node=None):
+    p = Pod(name=name, requests={"cpu": "1", "memory": "1Gi"})
+    if node:
+        p.node_name = node
+    return p
+
+
+def _leader_cluster():
+    c = ClusterState()
+    c.add_pod(_pod("p-0"))
+    c.add_pod(_pod("p-1"))
+    return c
+
+
+def _pair(leader_cluster=None):
+    src = ReplicationSource(leader_cluster or _leader_cluster())
+    client = _LocalClient(ReplicationService(src))
+    replica = StandbyReplica(ClusterState(), client)
+    return src, client, replica
+
+
+class TestReplicationStream:
+    def test_snapshot_then_delta(self):
+        c = _leader_cluster()
+        src, client, replica = _pair(c)
+        assert replica.sync_once() is True
+        assert set(replica.cluster.pods) == {"p-0", "p-1"}
+        assert replica.anchor == c.state_rev
+        # churn on the leader rides the next delta, not a re-snapshot
+        c.add_pod(_pod("p-2"))
+        c.delete_pod("p-0")
+        assert replica.sync_once() is True
+        st = replica.stats()
+        assert st["snapshots"] == 1 and st["deltas"] == 1
+        assert set(replica.cluster.pods) == {"p-1", "p-2"}
+        assert replica.anchor == c.state_rev
+
+    def test_leases_and_pdbs_ride_every_delta(self):
+        # leases never journal (their appliers don't _note), so the
+        # stream must carry them as full tables on each delta
+        c = _leader_cluster()
+        src, client, replica = _pair(c)
+        replica.sync_once()
+        c.add_lease(NodeLease(name="ghost", owner_node=None))
+        replica.sync_once()
+        assert "ghost" in replica.cluster.leases
+        c.delete_lease("ghost")
+        replica.sync_once()
+        assert "ghost" not in replica.cluster.leases
+
+
+class TestCutoverLadder:
+    """Table-driven: each rung of the standby's apply ladder."""
+
+    CASES = [
+        # (mutate_doc, expect_applied, expect_counter, anchor_dropped)
+        ("fresh", True, None, False),
+        ("stale", False, "stale_anchor_rebuilds", True),
+        ("version", False, "version_mismatch_rebuilds", False),
+    ]
+
+    @pytest.mark.parametrize("kind,applied,counter,dropped", CASES)
+    def test_delta_ladder(self, kind, applied, counter, dropped):
+        c = _leader_cluster()
+        src, client, replica = _pair(c)
+        assert replica.sync_once()
+        anchor0 = replica.anchor
+        c.add_pod(_pod("p-new"))
+        doc = client.delta(anchor0)
+        if kind == "stale":
+            doc = {"version": SNAPSHOT_VERSION, "full": True,
+                   "anchor": doc["anchor"], "since": anchor0, "ticks": 0}
+        elif kind == "version":
+            doc["version"] = SNAPSHOT_VERSION + 1
+        ok = replica._apply_delta(doc)
+        assert ok is applied
+        if counter:
+            assert replica.stats()[counter] == 1
+        if dropped:
+            assert replica.anchor == -1
+        elif not applied:
+            # version mismatch keeps the last-good anchor AND state
+            assert replica.anchor == anchor0
+            assert "p-new" not in replica.cluster.pods
+
+    def test_stale_anchor_resnapshots_in_the_same_poll(self):
+        c = _leader_cluster()
+        src, client, replica = _pair(c)
+        assert replica.sync_once()
+        # an anchor from another life of the mirror: the journal cannot
+        # answer it, the source says full, the SAME sync re-snapshots
+        replica.anchor = 10 ** 9
+        c.add_pod(_pod("p-2"))
+        assert replica.sync_once() is True
+        st = replica.stats()
+        assert st["stale_anchor_rebuilds"] == 1
+        assert st["snapshots"] == 2
+        assert "p-2" in replica.cluster.pods
+        assert replica.anchor == c.state_rev
+
+    def test_version_mismatch_snapshot_refused(self):
+        src, client, replica = _pair()
+        doc = client.snapshot()
+        doc["version"] = SNAPSHOT_VERSION + 1
+        assert replica._apply_snapshot(doc) is False
+        assert replica.anchor == -1
+        assert not replica.cluster.pods
+        assert "snapshot-version-mismatch" in replica.last_reason
+
+
+class TestPromotionGate:
+    def test_no_snapshot_blocks_promotion(self):
+        src, client, replica = _pair()
+        client.dead = True
+        assert replica.promotion_ready() is False
+        assert replica.stats()["promotions_blocked"] == 1
+        # and the elector leaves the lease on the floor
+        store = MemoryLeaseStore()
+        elector = LeaderElector(store, "standby", 15.0, FakeClock(),
+                                promotion_gate=replica.promotion_ready)
+        assert elector.try_acquire_or_renew() is False
+        assert store.get() is None
+        assert elector.promotions_blocked == 1
+
+    def test_anchored_replica_promotes_stale(self):
+        src, client, replica = _pair()
+        assert replica.sync_once()
+        client.dead = True
+        assert replica.promotion_ready() is True
+        assert replica.stats()["stale_promotions"] == 1
+
+    def test_fresh_sync_promotes(self):
+        src, client, replica = _pair()
+        assert replica.sync_once()
+        assert replica.promotion_ready() is True
+        assert replica.stats()["stale_promotions"] == 0
+
+
+class TestFencing:
+    def _electors(self, tmp_path):
+        clock = FakeClock()
+        store = FileLeaseStore(str(tmp_path / "lease.json"))
+        a = LeaderElector(store, "op-a", 15.0, clock)
+        b = LeaderElector(store, "op-b", 15.0, clock)
+        return clock, store, a, b
+
+    def test_fence_rotates_on_takeover_not_renewal(self, tmp_path):
+        clock, store, a, b = self._electors(tmp_path)
+        assert a.try_acquire_or_renew()
+        assert a.fence == 1
+        clock.step(5.0)
+        assert a.try_acquire_or_renew()
+        assert a.fence == 1           # renewal keeps the token
+        clock.step(20.0)           # a stops renewing (killed)
+        assert b.try_acquire_or_renew()
+        assert b.fence == 2           # takeover bumps it
+
+    def test_zombie_writes_rejected(self, tmp_path):
+        clock, store, a, b = self._electors(tmp_path)
+        assert a.try_acquire_or_renew()
+        cluster = ClusterState()
+        writer = DirectWriter(cluster, clock)
+        writer.set_fence(a.fence_guard())
+        claim = NodeClaim(name="c-0", node_pool="default",
+                          instance_type="m5.large", zone="us-east-1a",
+                          capacity_type="on-demand")
+        writer.create_claim(claim)    # fence held: write passes
+        clock.step(20.0)
+        assert b.try_acquire_or_renew()   # rotates the fence under a
+        # the zombie's election thread never ticked, but the guard
+        # re-reads the store: every queued side effect bounces
+        with pytest.raises(FencedWriteError) as exc:
+            writer.create_claim(NodeClaim(
+                name="c-1", node_pool="default",
+                instance_type="m5.large", zone="us-east-1a",
+                capacity_type="on-demand"))
+        assert "fenced-write-rejected" in exc.value.reason
+        assert "c-1" not in cluster.claims
+        assert writer.stats()["fenced_reject"] == 1
+        # a bulk verb bounces identically
+        with pytest.raises(FencedWriteError):
+            writer.bind_pods([(_pod("p-z"), "n-0")])
+
+    def test_reacquire_after_expiry_restores_writes(self, tmp_path):
+        clock, store, a, b = self._electors(tmp_path)
+        assert a.try_acquire_or_renew()
+        writer = DirectWriter(ClusterState(), clock)
+        writer.set_fence(a.fence_guard())
+        clock.step(20.0)
+        assert b.try_acquire_or_renew()
+        clock.step(20.0)           # b dies too; a takes back over
+        assert a.try_acquire_or_renew()
+        assert a.fence == 3
+        writer.create_claim(NodeClaim(
+            name="c-2", node_pool="default",
+            instance_type="m5.large", zone="us-east-1a",
+            capacity_type="on-demand"))
+
+
+class TestFileLeaseStoreCrashSafety:
+    CORRUPT_BODIES = [
+        b"",                                   # zero-byte (torn create)
+        b'{"holder": "op-a", "renewT',         # truncated mid-write
+        b"[1, 2, 3]",                          # wrong shape: array
+        b'"op-a"',                             # wrong shape: scalar
+        b'{"holder": 7, "renewTime": 1.0}',    # non-string holder
+        b'{"renewTime": 1.0}',                 # missing holder
+        b"not json at all",
+    ]
+
+    @pytest.mark.parametrize("body", CORRUPT_BODIES)
+    def test_corrupt_lease_reads_unheld(self, tmp_path, body):
+        path = tmp_path / "lease.json"
+        path.write_bytes(body)
+        store = FileLeaseStore(str(path))
+        assert store.get() is None
+        assert store.corrupt_reads == 1
+
+    def test_election_proceeds_over_the_wreckage(self, tmp_path):
+        path = tmp_path / "lease.json"
+        path.write_bytes(b'{"holder": "op-a", "ren')
+        store = FileLeaseStore(str(path))
+        elector = LeaderElector(store, "op-b", 15.0, FakeClock())
+        assert elector.try_acquire_or_renew() is True
+        assert store.get().holder == "op-b"
+        # the wreckage carried no readable fence: takeover starts at 1
+        assert elector.fence == 1
+
+
+class TestOrphanedLeaseSweep:
+    def test_sweep_counts_and_deletes(self):
+        c = ClusterState()
+        c.add_node(Node(name="n-0",
+                        provider_id="fake:///us-east-1a/i-0", ready=True))
+        c.add_lease(NodeLease(name="n-0", owner_node="n-0"))
+        c.add_lease(NodeLease(name="dead-node", owner_node="gone"))
+        c.add_lease(NodeLease(name="ownerless", owner_node=None))
+        deleted = []
+        assert c.sweep_orphaned_leases(deleted.append) == 2
+        assert sorted(deleted) == ["dead-node", "ownerless"]
+        assert c.stats()["leases_swept"] == 2
+
+    def test_promotion_sweeps_through_the_writer(self):
+        # the on_promote wiring: a newly promoted leader GCs leases whose
+        # holders died during the blackout, through its own write verb
+        clock = FakeClock()
+        c = ClusterState()
+        c.add_lease(NodeLease(name="blackout-victim", owner_node="gone"))
+        writer = DirectWriter(c, clock)
+        store = MemoryLeaseStore()
+        elector = LeaderElector(
+            store, "standby", 15.0, clock,
+            on_promote=lambda: c.sweep_orphaned_leases(writer.delete_lease))
+        assert elector.try_acquire_or_renew()
+        assert "blackout-victim" not in c.leases
+        assert c.stats()["leases_swept"] == 1
+        assert writer.stats()["delete_lease"] == 1
+
+
+class TestOperatorKillWeather:
+    def _scenario(self, mode="kill"):
+        from karpenter_provider_aws_tpu.weather.scenario import (
+            OperatorKill, WeatherScenario,
+        )
+        return WeatherScenario(
+            name="t", tick_seconds=1.0, duration_seconds=10.0,
+            operator_kills=(OperatorKill(at=2.0, duration=3.0, target=0,
+                                         mode=mode, restart_after=True),))
+
+    def test_scenario_round_trip(self):
+        from karpenter_provider_aws_tpu.weather.scenario import (
+            WeatherScenario,
+        )
+        sc = self._scenario()
+        rt = WeatherScenario.from_dict(sc.to_dict())
+        assert rt == sc
+        assert rt.operator_kills[0].mode == "kill"
+
+    def test_pre_pr17_json_still_loads(self):
+        from karpenter_provider_aws_tpu.weather.scenario import (
+            WeatherScenario,
+        )
+        d = self._scenario().to_dict()
+        del d["operator_kills"]
+        assert WeatherScenario.from_dict(d).operator_kills == ()
+
+    def test_named_handoff_scenario(self):
+        from karpenter_provider_aws_tpu.weather.scenario import (
+            NAMED_SCENARIOS, named,
+        )
+        assert "handoff" in NAMED_SCENARIOS
+        sc = named("handoff")
+        (kill,) = sc.operator_kills
+        assert kill.mode == "kill" and kill.at == 45.0
+
+    def test_simulator_kill_and_restore_events(self, lattice):
+        from karpenter_provider_aws_tpu.weather.simulator import (
+            WeatherSimulator,
+        )
+
+        class Handle:
+            def __init__(self):
+                self.calls = []
+
+            def kill(self):
+                self.calls.append("kill")
+
+            def restart(self):
+                self.calls.append("restart")
+
+            def set_hang(self, hung):
+                self.calls.append(f"hang={hung}")
+
+        handle = Handle()
+        sim = WeatherSimulator(self._scenario(), lattice, seed=7,
+                               operators=[handle])
+        for _ in range(8):
+            sim.step()
+        kinds = [e["kind"] for e in sim.timeline
+                 if e["kind"].startswith("operator-")]
+        assert kinds == ["operator-kill", "operator-restore"]
+        assert handle.calls == ["kill", "restart"]
+        assert sim.counters["operator_kills"] == 1
+        assert sim.counters["operator_restores"] == 1
+
+    def test_hang_mode_pauses_and_resumes(self, lattice):
+        from karpenter_provider_aws_tpu.weather.simulator import (
+            WeatherSimulator,
+        )
+
+        class Handle:
+            def __init__(self):
+                self.calls = []
+
+            def kill(self):
+                self.calls.append("kill")
+
+            def restart(self):
+                self.calls.append("restart")
+
+            def set_hang(self, hung):
+                self.calls.append(f"hang={hung}")
+
+        handle = Handle()
+        sim = WeatherSimulator(self._scenario(mode="hang"), lattice,
+                               seed=7, operators=[handle])
+        for _ in range(8):
+            sim.step()
+        assert handle.calls == ["hang=True", "hang=False"]
+
+    def test_replay_identical_with_kills(self, lattice):
+        from karpenter_provider_aws_tpu.weather.simulator import (
+            WeatherSimulator,
+        )
+        sc = self._scenario()
+        sim = WeatherSimulator(sc, lattice, seed=11)
+        for _ in range(10):
+            sim.step()
+        assert WeatherSimulator.replay(sc, lattice, sim.ticks,
+                                       seed=11) == list(sim.timeline)

@@ -80,8 +80,18 @@
 #               `kpctl explain pod` must render the waterfall, the
 #               FailedScheduling dedup must hold, and the explain
 #               provider's reason-code histogram must report
-#  12. tier-1 — the full non-slow test suite on the CPU backend
-#  13. bench  — `bench.py --smoke`: one fast config through the real
+#  12. handoff— zero-downtime operator handoff gate
+#               (tools/smoke_handoff.py): TWO real OS processes on a
+#               shared FileLeaseStore + replication stream — the leader
+#               is SIGKILLed mid-churn, the warm standby must promote
+#               within the lease window with a rotated fence token and
+#               CARRY passes on its replicated mirror (delta solves
+#               engage, new pods get capacity, zero duplicate launches
+#               for already-bound pods), with the LEADER/HANDOFF kpctl
+#               rows, karpenter_operator_* gauges, and a cycle-free
+#               lock-order witness in BOTH processes
+#  13. tier-1 — the full non-slow test suite on the CPU backend
+#  14. bench  — `bench.py --smoke`: one fast config through the real
 #               harness, so a broken solve path can never ride in on a
 #               green unit-test run
 
@@ -93,7 +103,7 @@ PY=${PYTHON:-python}
 FAST=0
 [ "${1:-}" = "--fast" ] && FAST=1
 
-echo "=== ci [1/13] generated-artifact drift ==="
+echo "=== ci [1/14] generated-artifact drift ==="
 $PY tools/gen_crds.py --check
 tmp=$(mktemp -d)
 trap 'rm -rf "$tmp"' EXIT
@@ -108,44 +118,47 @@ done
 [ "$stale" = 0 ] || exit 1
 echo "drift: clean"
 
-echo "=== ci [2/13] graftlint (project-invariant static analysis) ==="
+echo "=== ci [2/14] graftlint (project-invariant static analysis) ==="
 $PY tools/lint/run.py --check
 
-echo "=== ci [3/13] introspection smoke + metrics lint ==="
+echo "=== ci [3/14] introspection smoke + metrics lint ==="
 $PY tools/smoke_introspect.py
 
-echo "=== ci [4/13] steady-state delta churn smoke ==="
+echo "=== ci [4/14] steady-state delta churn smoke ==="
 $PY tools/smoke_delta.py
 
-echo "=== ci [5/13] sharded mesh smoke ==="
+echo "=== ci [5/14] sharded mesh smoke ==="
 $PY tools/smoke_sharded.py
 
-echo "=== ci [6/13] device-resident microloop smoke ==="
+echo "=== ci [6/14] device-resident microloop smoke ==="
 $PY tools/smoke_microloop.py
 
-echo "=== ci [7/13] continuous-profiling smoke ==="
+echo "=== ci [7/14] continuous-profiling smoke ==="
 $PY tools/smoke_profile.py
 
-echo "=== ci [8/13] write-path smoke ==="
+echo "=== ci [8/14] write-path smoke ==="
 $PY tools/smoke_writepath.py
 
-echo "=== ci [9/13] adversarial-weather smoke ==="
+echo "=== ci [9/14] adversarial-weather smoke ==="
 $PY tools/smoke_weather.py
 
-echo "=== ci [10/13] solver-pool failover smoke ==="
+echo "=== ci [10/14] solver-pool failover smoke ==="
 $PY tools/smoke_pool.py
 
-echo "=== ci [11/13] decision-explainability smoke ==="
+echo "=== ci [11/14] decision-explainability smoke ==="
 $PY tools/smoke_explain.py
 
-echo "=== ci [12/13] tier-1 tests ==="
+echo "=== ci [12/14] zero-downtime handoff smoke ==="
+$PY tools/smoke_handoff.py
+
+echo "=== ci [13/14] tier-1 tests ==="
 $PY -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider
 
 if [ "$FAST" = 1 ]; then
-    echo "=== ci [13/13] bench smoke: SKIPPED (--fast) ==="
+    echo "=== ci [14/14] bench smoke: SKIPPED (--fast) ==="
 else
-    echo "=== ci [13/13] bench smoke ==="
+    echo "=== ci [14/14] bench smoke ==="
     $PY bench.py --smoke
 fi
 

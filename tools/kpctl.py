@@ -602,6 +602,33 @@ def _render_top(doc, server: str):
             f"failovers {sp_.get('failovers', 0):g}   "
             f"local {sp_.get('local_solves', 0):g}   "
             f"breakers " + (",".join(states) or "-"))
+    # the operator-handoff surface (docs/reference/handoff.md): role +
+    # fence token, replication stream progress, fenced-write rejections.
+    # Absent until wire_handoff() attached an elector to the operator.
+    if "handoff" in p:
+        ho = p["handoff"]
+        role = "leader" if ho.get("leader") else "standby"
+        seg = [f"LEADER    {role} (fence {ho.get('fence', 0):g}, "
+               f"{ho.get('transitions', 0):g} transitions)   "
+               f"fenced writes {ho.get('fenced_rejections', 0):g}   "
+               f"leases swept {ho.get('leases_swept', 0):g}"]
+        if "replica_anchor" in ho:
+            rebuilds = (ho.get("replica_stale_anchor_rebuilds", 0)
+                        + ho.get("replica_version_mismatch_rebuilds", 0))
+            seg.append(
+                f"HANDOFF   anchor {ho.get('replica_anchor', -1):g}   "
+                f"snapshots {ho.get('replica_snapshots', 0):g}   "
+                f"deltas {ho.get('replica_deltas', 0):g} "
+                f"({ho.get('replica_delta_pods', 0):g} pods)   "
+                f"rebuilds {rebuilds:g}   "
+                f"prebuilds {ho.get('replica_prebuilds', 0):g}")
+        elif "source_deltas" in ho:
+            seg.append(
+                f"HANDOFF   serving   "
+                f"snapshots {ho.get('source_snapshots', 0):g}   "
+                f"deltas {ho.get('source_deltas', 0):g} "
+                f"({ho.get('source_full_answers', 0):g} full answers)")
+        lines.extend(seg)
     rh, rm = g("solver", "resident_hits"), g("solver", "resident_misses")
     hitpct = 100.0 * rh / (rh + rm) if (rh + rm) else 0.0
     ph = g("solver", "resident_problem_hits")

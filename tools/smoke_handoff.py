@@ -1,0 +1,380 @@
+#!/usr/bin/env python
+"""CI smoke for zero-downtime operator handoff (ci.sh handoff gate).
+
+Two REAL OS processes on a shared tmpdir: a leader operator churning
+pods behind a FileLeaseStore lease + replication stream (unix socket),
+and a warm standby applying snapshot + journal deltas into its own
+mirror while pre-building through IncrementalProblemBuilder. The parent
+SIGKILLs the leader mid-churn (kill -9: no lease release, no goodbye)
+and asserts the things the handoff subsystem exists to prove:
+
+1. the standby streams BEFORE the kill: snapshot applied, deltas > 0,
+   prebuilds > 0 — and it is NOT leader (the lease holds it out),
+2. after the kill the standby PROMOTES within the lease window (+ slack)
+   with a rotated fence token, and CARRIES passes: provisioner passes
+   grow, new pods get capacity (create_claim > 0), and the delta solve
+   path engages on the replicated mirror (delta_solves > 0 — the warm
+   standby was actually warm, not a cold rebuild),
+3. zero duplicate launches: pods bound at promotion stay on their nodes
+   (no relaunch of capacity the dead leader already provisioned),
+4. the surfaces tell the story over live HTTP: the handoff introspection
+   provider, a kpctl top LEADER/HANDOFF row, karpenter_operator_* gauges
+   on a /metrics scrape that lints clean,
+5. the lock-order witness is cycle-free in BOTH processes.
+
+Fast by design: small-family lattice, ~3 s lease. The cutover-ladder
+matrix (stale anchor, version mismatch, corrupt lease files) lives in
+tests/test_handoff.py; this gate is the end-to-end two-process proof.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+LEASE_DURATION = 3.0
+PROMOTE_SLACK = 20.0      # lease window + election cadence + CI jitter
+
+
+# ---------------------------------------------------------------- children
+
+def _election_loop(elector, replica=None, period: float = 0.5) -> None:
+    """The dedicated election thread (what ControllerRuntime registers as
+    its leader-election controller): a pass blocked in an XLA compile
+    must not cost the incumbent its lease. On a standby the same thread
+    pumps the replication stream between ticks."""
+    while True:
+        if replica is not None and not elector.is_leader:
+            replica.sync_once()
+        elector.try_acquire_or_renew()
+        time.sleep(period)
+
+
+def _build_operator(workdir: Path):
+    from karpenter_provider_aws_tpu.lattice import (build_catalog,
+                                                    build_lattice)
+    from karpenter_provider_aws_tpu.operator import Operator, Options
+    lattice = build_lattice([s for s in build_catalog()
+                             if s.family in ("m5", "c5")])
+    return Operator(options=Options(
+        registration_delay=0.5,
+        compile_cache_dir=str(workdir / "compile-cache")),
+        lattice=lattice)
+
+
+def run_leader(workdir: Path) -> int:
+    from karpenter_provider_aws_tpu.apis import Pod
+    from karpenter_provider_aws_tpu.cli import start_server
+    from karpenter_provider_aws_tpu.kube.writer import FencedWriteError
+    from karpenter_provider_aws_tpu.operator.leaderelection import (
+        FileLeaseStore, LeaderElector)
+    from karpenter_provider_aws_tpu.state.replication import (
+        ReplicationService, ReplicationSource, serve_replication)
+
+    import threading
+
+    op = _build_operator(workdir)
+    src = ReplicationSource(op.cluster)
+    repl = serve_replication(ReplicationService(src),
+                             f"unix:{workdir}/repl.sock")
+    elector = LeaderElector(FileLeaseStore(str(workdir / "lease.json")),
+                            "leader", lease_duration=LEASE_DURATION)
+    op.wire_handoff(elector, source=src)
+    threading.Thread(target=_election_loop, args=(elector,),
+                     daemon=True).start()
+    http = start_server(op, 0)
+    (workdir / "leader.port").write_text(str(http.server_address[1]))
+    serial = 0
+    try:
+        while True:   # until the parent SIGKILLs us (that's the point)
+            if elector.is_leader:
+                for _ in range(2):
+                    serial += 1
+                    op.cluster.add_pod(Pod(
+                        name=f"lp{serial}",
+                        requests={"cpu": "500m", "memory": "1Gi"}))
+                try:
+                    op.run_once(force_provision=True)
+                except FencedWriteError:
+                    pass   # demoted mid-pass: correctly fenced, go quiet
+            src.tick()
+            time.sleep(0.3)
+    finally:
+        repl.stop(0)
+        http.shutdown()
+    return 0
+
+
+def run_standby(workdir: Path) -> int:
+    from karpenter_provider_aws_tpu import introspect
+    from karpenter_provider_aws_tpu.apis import Pod
+    from karpenter_provider_aws_tpu.cli import start_server
+    from karpenter_provider_aws_tpu.cloud.fake import (CloudInstance,
+                                                       parse_instance_id)
+    from karpenter_provider_aws_tpu.operator.leaderelection import (
+        FileLeaseStore, LeaderElector)
+    from karpenter_provider_aws_tpu.state.replication import (
+        ReplicationClient, StandbyReplica)
+
+    op = _build_operator(workdir)
+    replica = StandbyReplica(
+        op.cluster, ReplicationClient(f"unix:{workdir}/repl.sock"),
+        prebuild=lambda: op.provisioner.warm_build())
+    elector = LeaderElector(FileLeaseStore(str(workdir / "lease.json")),
+                            "standby", lease_duration=LEASE_DURATION,
+                            promotion_gate=replica.promotion_ready)
+
+    smoke = {"promoted": False, "rebinds": 0, "bound_at_promotion": 0,
+             "convergence_claims": 0}
+    bound0 = {}
+
+    def on_promote() -> None:
+        # adopt the dead leader's fleet: the mirror replicated its claims,
+        # so materialize their instances in OUR cloud before any
+        # controller lists it (otherwise GC reads the fleet as vanished
+        # and the convergence passes relaunch everything — the exact
+        # duplicate-launch failure this smoke gates on)
+        for c in list(op.cluster.claims.values()):
+            if not c.provider_id:
+                continue
+            iid = parse_instance_id(c.provider_id)
+            op.cloud.instances[iid] = CloudInstance(
+                id=iid, instance_type=c.instance_type or "m5.large",
+                zone=c.zone or "us-west-2a",
+                capacity_type=c.capacity_type or "on-demand",
+                launch_time=c.launched_at or 0.0)
+        bound0.update({p.name: p.node_name
+                       for p in op.cluster.pods.values() if p.node_name})
+        smoke["promoted"] = True
+        smoke["bound_at_promotion"] = len(bound0)
+        introspect.registry().register("smoke", lambda: dict(smoke))
+
+    elector.on_promote = on_promote          # wire_handoff chains onto it
+    op.wire_handoff(elector, replica=replica)
+    import threading
+    threading.Thread(target=_election_loop, args=(elector, replica),
+                     daemon=True).start()
+    http = start_server(op, 0)
+    (workdir / "standby.port").write_text(str(http.server_address[1]))
+    serial = 0
+    passes = 0
+    try:
+        while True:
+            # gate passes on the PROMOTE HOOK having finished (not bare
+            # is_leader): the fleet adoption above must land before the
+            # first pass lists the cloud
+            if not smoke["promoted"]:
+                pass   # the election thread streams + gates promotion
+            else:
+                passes += 1
+                if passes > 3:   # first passes are pure convergence:
+                    serial += 1  # nothing new to place, nothing launched
+                    op.cluster.add_pod(Pod(
+                        name=f"sp{serial}",
+                        requests={"cpu": "500m", "memory": "1Gi"}))
+                op.run_once(force_provision=True)
+                if passes == 3:
+                    smoke["convergence_claims"] = \
+                        op.writer.counts.get("create_claim", 0)
+                smoke["rebinds"] = sum(
+                    1 for name, node in bound0.items()
+                    if (p := op.cluster.pods.get(name)) is not None
+                    and p.node_name != node)
+                (workdir / "standby.status.json").write_text(
+                    json.dumps(smoke))
+            time.sleep(0.3)
+    finally:
+        http.shutdown()
+    return 0
+
+
+# ---------------------------------------------------------------- parent
+
+def _fetch(base: str, path: str, timeout: float = 10.0):
+    return urllib.request.urlopen(f"{base}{path}", timeout=timeout).read()
+
+
+def _vars(base: str) -> dict:
+    return json.loads(_fetch(base, "/debug/vars"))
+
+
+def _wait(what: str, deadline: float, fn):
+    """Poll ``fn`` until it returns a truthy value; raise past deadline."""
+    while True:
+        try:
+            v = fn()
+        except Exception:
+            v = None
+        if v:
+            return v
+        if time.time() > deadline:
+            raise TimeoutError(f"timed out waiting for {what}")
+        time.sleep(0.25)
+
+
+def _spawn(workdir: Path, role: str) -> subprocess.Popen:
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=1")
+    logf = open(workdir / f"{role}.log", "w")
+    return subprocess.Popen(
+        [sys.executable, __file__, "--role", role, "--dir", str(workdir)],
+        cwd=str(REPO), env=env, stdout=logf, stderr=subprocess.STDOUT)
+
+
+def main() -> int:
+    import tempfile
+    workdir = Path(tempfile.mkdtemp(prefix="smoke-handoff-"))
+    failures = []
+    leader = standby = None
+    try:
+        # phase 1: leader boots, wins the lease, provisions under churn
+        leader = _spawn(workdir, "leader")
+        port_a = int(_wait("leader port file", time.time() + 120,
+                           lambda: (workdir / "leader.port").exists()
+                           and (workdir / "leader.port").read_text()))
+        base_a = f"http://127.0.0.1:{port_a}"
+        doc_a = _wait("leader first passes", time.time() + 180, lambda: (
+            lambda d: d if (d["providers"]["provisioner"].get("passes", 0)
+                            >= 2 and d["providers"]["cluster"].get("nodes",
+                                                                   0) > 0)
+            else None)(_vars(base_a)))
+        ho_a = doc_a["providers"].get("handoff", {})
+        if not ho_a.get("leader"):
+            failures.append(f"leader process not leading: {ho_a}")
+        if ho_a.get("fence", 0) < 1:
+            failures.append(f"leader fence never rotated up: {ho_a}")
+        if doc_a["providers"].get("lockorder", {}).get("cycles", 1) != 0:
+            failures.append("lock-order witness cycle in the LEADER")
+
+        # phase 2: standby streams while the leader lives — and stays out
+        standby = _spawn(workdir, "standby")
+        port_b = int(_wait("standby port file", time.time() + 120,
+                           lambda: (workdir / "standby.port").exists()
+                           and (workdir / "standby.port").read_text()))
+        base_b = f"http://127.0.0.1:{port_b}"
+        ho_b = _wait("standby streaming", time.time() + 180, lambda: (
+            lambda h: h if (h.get("replica_anchor", -1) >= 0
+                            and h.get("replica_deltas", 0) > 0
+                            and h.get("replica_prebuilds", 0) > 0)
+            else None)(_vars(base_b)["providers"].get("handoff", {})))
+        if ho_b.get("leader"):
+            failures.append("standby leads while the leader is alive")
+        if ho_b.get("replica_snapshots", 0) < 1:
+            failures.append(f"standby never applied a snapshot: {ho_b}")
+
+        # phase 3: kill -9 the leader mid-churn; standby must promote
+        # within the lease window (+ slack) with a rotated fence
+        leader_fence = ho_a.get("fence", 0)
+        os.kill(leader.pid, signal.SIGKILL)
+        leader.wait(15)
+        t_kill = time.time()
+        ho_b = _wait("standby promotion",
+                     t_kill + LEASE_DURATION + PROMOTE_SLACK,
+                     lambda: (lambda h: h if h.get("leader") else None)(
+                         _vars(base_b)["providers"].get("handoff", {})))
+        promote_latency = time.time() - t_kill
+        if ho_b.get("fence", 0) <= leader_fence:
+            failures.append(f"promotion did not rotate the fence "
+                            f"(leader {leader_fence} -> {ho_b.get('fence')})")
+
+        # phase 4: the promoted standby CARRIES passes — new pods get
+        # capacity, the delta solve path engages on the replicated
+        # mirror, and nothing already-bound is relaunched
+        def _carrying():
+            d = _vars(base_b)
+            pr = d["providers"]
+            ok = (pr["provisioner"].get("passes", 0) >= 5
+                  and pr.get("writer", {}).get("create_claim", 0) > 0
+                  and pr["solver"].get("delta_solves", 0) > 0)
+            return d if ok else None
+        doc_b = _wait("promoted standby carrying passes",
+                      time.time() + 180, _carrying)
+        status = json.loads((workdir / "standby.status.json").read_text())
+        if not status.get("promoted"):
+            failures.append(f"standby status never marked promoted: {status}")
+        if status.get("bound_at_promotion", 0) <= 0:
+            failures.append("vacuous handoff: no pods were bound at "
+                            "promotion (leader never really worked)")
+        if status.get("rebinds", 0) != 0:
+            failures.append(f"{status['rebinds']} pods rebound after "
+                            "promotion (duplicate launch territory)")
+        if status.get("convergence_claims", 0) != 0:
+            failures.append(f"{status['convergence_claims']} claims "
+                            "launched during pure convergence passes — "
+                            "duplicate capacity for already-bound pods")
+
+        # phase 5: the surfaces — kpctl rows, /metrics lint, lockorder
+        from karpenter_provider_aws_tpu.metrics import lint_exposition
+        import kpctl
+        top = "\n".join(kpctl._render_top(doc_b, base_b))
+        leader_rows = [ln for ln in top.splitlines()
+                       if ln.startswith("LEADER")]
+        if not leader_rows or "leader" not in leader_rows[0]:
+            failures.append(f"kpctl top LEADER row wrong: {leader_rows}")
+        if not any(ln.startswith("HANDOFF") for ln in top.splitlines()):
+            failures.append("kpctl top renders no HANDOFF row")
+        scrape = _fetch(base_b, "/metrics").decode()
+        for series in ("karpenter_operator_leader_state",
+                       "karpenter_operator_handoff_fence_token",
+                       "karpenter_operator_handoff_deltas",
+                       "karpenter_operator_handoff_rebuilds"):
+            if series not in scrape:
+                failures.append(f"/metrics missing {series}")
+        lint = lint_exposition(scrape)
+        if lint:
+            failures.append(f"live scrape lint: {lint[:3]}")
+        if doc_b["providers"].get("lockorder", {}).get("cycles", 1) != 0:
+            failures.append("lock-order witness cycle in the STANDBY")
+    except Exception as e:  # noqa: BLE001 - any escape is the failure
+        failures.append(f"{type(e).__name__}: {e}")
+    finally:
+        for proc in (leader, standby):
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(15)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+    if failures:
+        print("handoff smoke: FAIL")
+        for f in failures:
+            print(f"  - {f}")
+        for role in ("leader", "standby"):
+            log = workdir / f"{role}.log"
+            if log.exists():
+                tail = log.read_text().splitlines()[-15:]
+                print(f"  --- {role}.log tail ---")
+                for ln in tail:
+                    print(f"  {ln}")
+        return 1
+    print(f"handoff smoke: OK (promoted in {promote_latency:.1f}s, "
+          f"fence {leader_fence}->{ho_b.get('fence')}, "
+          f"deltas={ho_b.get('replica_deltas')}, "
+          f"carried {status['bound_at_promotion']} bound pods, "
+          f"0 rebinds, 0 convergence launches)")
+    return 0
+
+
+if __name__ == "__main__":
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--role", choices=("leader", "standby"))
+    ap.add_argument("--dir")
+    a = ap.parse_args()
+    if a.role == "leader":
+        raise SystemExit(run_leader(Path(a.dir)))
+    if a.role == "standby":
+        raise SystemExit(run_standby(Path(a.dir)))
+    raise SystemExit(main())

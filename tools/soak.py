@@ -61,6 +61,19 @@ sockets and the operator runs against them as a failover pool
 then gates on failovers > 0, zero solve-error passes, the local rung
 engaging ONLY under a scripted full blackout, and every breaker closed
 again after the outage window (docs/reference/solver-pool.md).
+
+``--standby`` spawns a live WARM STANDBY operator (state/replication.py
++ operator/leaderelection.py; docs/reference/handoff.md): a second
+Operator sharing the clock/cloud/lattice/queue, its mirror fed by
+snapshot + journal-delta streaming over a unix-socket replication
+server, pre-building every delta through IncrementalProblemBuilder,
+its controllers leadership-gated behind a fence-carrying FileLeaseStore
+lease. Scenario ``OperatorKill`` elements (the ``handoff`` scenario)
+crash-stop or hang the ACTIVE operator mid-storm; the run then gates on
+the standby promoting within the lease window, carrying its first
+provisioning pass promptly, the fence token rotating, no duplicate
+provider IDs across the handoff, and the usual weather bars (burn,
+replay-identical timeline) holding ACROSS the cutover.
 """
 
 from __future__ import annotations
@@ -118,6 +131,33 @@ def full_blackout_scripted(scenario, n_endpoints: int) -> bool:
         if set(range(n_endpoints)) <= out:
             return True
     return False
+
+
+class OperatorHandle:
+    """The weather simulator's operator-chaos seam (weather/simulator.py
+    ``operators=``): kill = crash-stop the runtime WITHOUT releasing the
+    lease (a crashed process never runs its shutdown path — the standby
+    must wait out the lease), hang = freeze every controller thread
+    including the election tick (the zombie-leader mode: resume releases
+    the queued writes straight into the write fence)."""
+
+    def __init__(self, runtime):
+        self.runtime = runtime
+        self.killed_at = None
+
+    def kill(self) -> None:
+        self.killed_at = time.monotonic()
+        self.runtime.crash_stop()
+
+    def restart(self) -> None:
+        pass   # a dead leader staying dead is the acceptance shape
+
+    def set_hang(self, hung: bool) -> None:
+        if hung:
+            self.killed_at = time.monotonic()
+            self.runtime.pause()
+        else:
+            self.runtime.resume()
 
 
 def apply_fault(solver, name: str, val):
@@ -200,6 +240,15 @@ def main(argv=None) -> int:
                          "recovering (every breaker closed at exit), "
                          "zero solve-error passes, and the local rung "
                          "engaging only under a scripted full blackout")
+    ap.add_argument("--standby", action="store_true",
+                    help="spawn a live warm-standby operator behind a "
+                         "fence-carrying FileLeaseStore lease, fed by "
+                         "snapshot + journal-delta replication "
+                         "(docs/reference/handoff.md). Requires a "
+                         "--weather scenario with OperatorKill elements "
+                         "(the 'handoff' scenario) — the run gates on "
+                         "the standby promoting within the lease window "
+                         "and carrying passes across the cutover")
     ap.add_argument("--solver-solve-deadline", type=float, default=5.0,
                     help="solve RPC deadline against pool endpoints "
                          "(seconds; --solver-pool only). 5 s bounds a "
@@ -285,19 +334,81 @@ def main(argv=None) -> int:
                          background=True,
                          aot=bool(args.compile_cache_dir),
                          on_done=op.slo.end_warmup)
+    # ---- warm standby (--standby): a second operator behind the lease --
+    op_a = op
+    op_b = replica = elector_a = elector_b = None
+    repl_server = repl_client = handle_a = None
+    if args.standby:
+        if not args.weather:
+            print("soak: --standby without a --weather scenario scripting "
+                  "operator kills would be vacuous (nothing ever kills "
+                  "the leader)")
+            return 1
+        import tempfile as _tempfile
+        from karpenter_provider_aws_tpu.operator.leaderelection import (
+            FileLeaseStore, LeaderElector)
+        from karpenter_provider_aws_tpu.state.replication import (
+            ReplicationClient, ReplicationService, ReplicationSource,
+            StandbyReplica, serve_replication)
+        handoff_dir = _tempfile.mkdtemp(prefix="soak-handoff-")
+        repl_src = ReplicationSource(op.cluster)
+        repl_server = serve_replication(ReplicationService(repl_src),
+                                        f"unix:{handoff_dir}/repl.sock")
+        lease_store = FileLeaseStore(f"{handoff_dir}/lease.json")
+        # the standby shares the WORLD (clock, cloud, queue, lattice) but
+        # owns its mirror: state arrives ONLY over the replication stream
+        op_b = Operator(options=Options(registration_delay=0.2,
+                                        batch_idle_duration=0.05,
+                                        batch_max_duration=0.5),
+                        lattice=lattice, cloud=op.cloud, clock=op.clock,
+                        interruption_queue=q)
+        repl_client = ReplicationClient(f"unix:{handoff_dir}/repl.sock")
+        replica = StandbyReplica(
+            op_b.cluster, repl_client,
+            prebuild=lambda: op_b.provisioner.warm_build())
+        elector_a = LeaderElector(lease_store, "op-a", clock=op.clock)
+        elector_b = LeaderElector(lease_store, "op-b", clock=op.clock,
+                                  promotion_gate=replica.promotion_ready)
+        # introspection is a process-global replace-by-name registry:
+        # op_b's construction just claimed every surface, so hand them
+        # back to the LEADER (op_b's promote hook re-wires on cutover);
+        # wire_handoff order matters for the same reason — standby first,
+        # leader last
+        op_b.wire_handoff(elector_b, replica=replica)
+        op._wire_introspection()
+        op.wire_handoff(elector_a, source=repl_src)
+        print(f"soak: warm standby armed (lease store "
+              f"{handoff_dir}/lease.json, replication "
+              f"unix:{handoff_dir}/repl.sock)")
     weather_sim = None
     if args.weather:
         from karpenter_provider_aws_tpu import introspect
         from karpenter_provider_aws_tpu.weather import (WeatherSimulator,
                                                         load_scenario)
         scenario = load_scenario(args.weather)
+        if args.standby and not scenario.operator_kills:
+            print(f"soak: --standby but scenario {scenario.name!r} "
+                  "scripts no operator kills — the standby would idle "
+                  "the whole run (vacuous handoff)")
+            return 1
+        if scenario.operator_kills and not args.standby:
+            print("soak: scenario scripts operator kills but no "
+                  "--standby is attached — killing the only operator "
+                  "would just end the control plane")
+            return 1
+        if args.standby:
+            # the runtime the handle crash-stops is created below; the
+            # simulator only fires kills after start(), by which point
+            # the handle is armed
+            handle_a = OperatorHandle(None)
         weather_sim = WeatherSimulator(
             scenario, lattice,
             seed=(args.seed if args.weather_seed is None
                   else args.weather_seed),
             clock=op.clock, pricing=op.pricing_provider, cloud=op.cloud,
             unavailable=op.unavailable, queue=q, solver=op.solver,
-            metrics=op.metrics, sidecars=chaos_sidecars)
+            metrics=op.metrics, sidecars=chaos_sidecars,
+            operators=([handle_a] if handle_a is not None else None))
         if scenario.sidecar_outages and not chaos_sidecars:
             print("soak: scenario scripts sidecar outages but no "
                   "--solver-pool is attached — the control-plane "
@@ -308,7 +419,21 @@ def main(argv=None) -> int:
               f"seed={weather_sim.seed} tick={scenario.tick_seconds}s "
               f"(storms={len(scenario.storms)} ice={len(scenario.ice)} "
               f"regimes={len(scenario.regimes)})")
-    rt = ControllerRuntime(operator_specs(op)).start()
+    rt = ControllerRuntime(operator_specs(op), elector=elector_a).start()
+    rt_b = None
+    if args.standby:
+        handle_a.runtime = rt
+        from karpenter_provider_aws_tpu.operator.runtime import \
+            ControllerSpec
+        specs_b = operator_specs(op_b)
+        # the replication pump runs UNGATED (standbys stream; leaders
+        # don't poll themselves) and goes quiet on promotion
+        specs_b.append(ControllerSpec(
+            "handoff-sync",
+            lambda: (replica.sync_once()
+                     if not elector_b.is_leader else None),
+            interval=0.2, gate_on_leadership=False))
+        rt_b = ControllerRuntime(specs_b, elector=elector_b).start()
     from karpenter_provider_aws_tpu.debug import Monitor, dump_state
     monitor = Monitor(op).start(interval=1.0)
     # the extra watcher fleet: N pods subscriptions drained by a few
@@ -351,6 +476,7 @@ def main(argv=None) -> int:
     stop = t_start + args.minutes * 60.0
     i = 0
     pending_faults = list(fault_schedule)
+    promote_t = b_first_pass_t = None
 
     def safe_instances():
         try:
@@ -385,6 +511,21 @@ def main(argv=None) -> int:
                       f"{'' if fval is None else '=' + str(fval)}")
             if weather_sim is not None:
                 weather_sim.advance()
+            # churn lands on the ACTIVE operator: after a cutover the
+            # promoted standby's mirror is the live one (the dead
+            # leader's would silently swallow every wave)
+            aop = op
+            if args.standby and elector_b.is_leader:
+                aop = op_b
+                if promote_t is None:
+                    promote_t = time.monotonic()
+                    print(f"soak: standby PROMOTED (fence "
+                          f"{elector_b.fence}) "
+                          f"{promote_t - (handle_a.killed_at or promote_t):.1f}s "
+                          "after the leader kill")
+                if b_first_pass_t is None and \
+                        op_b.provisioner.stats().get("passes", 0) > 0:
+                    b_first_pass_t = time.monotonic()
             r = rng.random()
             if r < 0.5:
                 wave = []
@@ -401,14 +542,14 @@ def main(argv=None) -> int:
                     client.create_pods(wave)
                 else:
                     for pod in wave:
-                        op.cluster.add_pod(pod)
+                        aop.cluster.add_pod(pod)
             elif r < 0.8:
                 # heavy deletion waves -> underutilized nodes -> consolidation.
                 # Bounded at 10% of the population per wave so scaled
                 # churn GROWS the cluster instead of strip-mining it —
                 # the 100k-churn soak must also hold 100+ nodes under
                 # fire, not just cycle a small one fast
-                names = list(op.cluster.pods)
+                names = list(aop.cluster.pods)
                 doomed = rng.sample(
                     names, min(len(names), max(len(names) // 10, 1),
                                rng.randint(5, 30) * args.churn_scale))
@@ -416,7 +557,7 @@ def main(argv=None) -> int:
                     client.delete_pods(doomed)   # NotFound raced = ignored
                 else:
                     for name in doomed:
-                        op.cluster.delete_pod(name)
+                        aop.cluster.delete_pod(name)
             elif r < 0.88:
                 insts = safe_instances()
                 if insts:
@@ -426,7 +567,7 @@ def main(argv=None) -> int:
                 # controller must roll stale-hash nodes while the rest
                 # of the storm rages (API mode: server-side, so the
                 # config watch delivers it like any operator would)
-                pool = op.node_pools.get("default")
+                pool = aop.node_pools.get("default")
                 if pool is not None:
                     pool.labels["soak/rev"] = f"r{i}"
                     if client is not None:
@@ -445,6 +586,9 @@ def main(argv=None) -> int:
         # invariants must never be read over live mutation
         while not rt.stop():
             print("soak: waiting for a blocked controller thread...")
+        if rt_b is not None:
+            while not rt_b.stop():
+                print("soak: waiting for a blocked standby thread...")
         monitor.stop()
         watch_stop.set()
         for t in watch_threads:
@@ -454,6 +598,83 @@ def main(argv=None) -> int:
                   f"{watch_stats['delivered']} "
                   f"resubscribes={watch_stats['resubscribes']}")
 
+    # the handoff verdict BEFORE any rebind: the gates read both sides
+    handoff_ok = True
+    handoff_report = None
+    if args.standby:
+        promoted = elector_b.is_leader or promote_t is not None
+        kill_t = handle_a.killed_at
+        latency = (promote_t - kill_t) if (promote_t and kill_t) else None
+        first_pass = (b_first_pass_t - promote_t) if (b_first_pass_t
+                                                      and promote_t) else None
+        rs = replica.stats()
+        b_passes = op_b.provisioner.stats().get("passes", 0)
+        b_deltas = op_b.solver.pipeline_stats.get("delta_solves", 0)
+        # duplicate-launch evidence on the SHARED cloud: across both
+        # mirrors no provider ID may back two claims (a standby
+        # relaunching capacity the dead leader already provisioned
+        # would mint a second instance for the same workload)
+        # claims replicated to BOTH mirrors legitimately share provider
+        # IDs — only collisions WITHIN one mirror are duplicates
+        dup_providers = sum(
+            len(ps) - len(set(ps)) for ps in (
+                [c.provider_id for c in o.cluster.claims.values()
+                 if c.provider_id] for o in (op_a, op_b)))
+        handoff_report = {
+            "promoted": promoted, "fence": elector_b.fence,
+            "promote_latency_s": latency, "first_pass_s": first_pass,
+            "kill_at_s": (kill_t - t_start) if kill_t else None,
+            "standby_passes": b_passes, "standby_delta_solves": b_deltas,
+            "replica": rs, "dup_provider_ids": dup_providers,
+            "fence_rejections": (op_a._fence_guard.rejections
+                                 if op_a._fence_guard else 0),
+            "leases_swept": op_b.cluster.leases_swept,
+            "promotions_blocked": elector_b.promotions_blocked,
+        }
+        print(f"soak: handoff {handoff_report}")
+        if kill_t is None:
+            print("soak: scenario scripted an operator kill but the "
+                  "handle never fired (vacuous handoff)")
+            handoff_ok = False
+        if not promoted:
+            print("soak: leader killed but the standby never promoted")
+            handoff_ok = False
+        if latency is not None and latency > \
+                elector_b.lease_duration + 3 * 2.0 + 5.0:
+            print(f"soak: promotion took {latency:.1f}s — outside the "
+                  "lease window + election cadence")
+            handoff_ok = False
+        if promoted and first_pass is None:
+            print("soak: standby promoted but never carried a "
+                  "provisioning pass")
+            handoff_ok = False
+        elif first_pass is not None and first_pass > 10.0:
+            print(f"soak: first post-promotion pass took {first_pass:.1f}s "
+                  "(> 10s SLO window)")
+            handoff_ok = False
+        if promoted and rs.get("prebuilds", 0) == 0:
+            # delta solves post-promotion are NOT required: the handoff
+            # scenario reprices every tick, and price-changed correctly
+            # forces the incremental builder onto the full path — warmth
+            # is evidenced by the pre-promotion prebuild stream instead
+            print("soak: promoted standby never prebuilt — "
+                  "the warm mirror was not actually warm")
+            handoff_ok = False
+        if promoted and rs.get("snapshots", 0) < 1:
+            print("soak: standby promoted without ever applying a "
+                  "snapshot")
+            handoff_ok = False
+        if dup_providers:
+            print(f"soak: {dup_providers} duplicate provider IDs across "
+                  "the handoff (capacity launched twice)")
+            handoff_ok = False
+        # hand the exit/convergence machinery the PROMOTED operator: its
+        # mirror is the live control plane now. Its runtime released the
+        # lease on stop, so re-acquire once — the single-threaded
+        # convergence loop below writes through the fence guard.
+        if promoted:
+            elector_b.try_acquire_or_renew()
+            op = op_b
     # converge: clear injected faults (all controller threads have joined,
     # so plain writes are race-free here), then let the single-threaded
     # loop settle PAST the GC grace window so every reapable leak is reaped
@@ -516,6 +737,8 @@ def main(argv=None) -> int:
         print(f"soak: solver degraded_counts={op.solver.degraded_counts} "
               f"faults_fired={solver_fired}")
     ok = not pending and not leaked and not orphans
+    if args.standby:
+        ok = ok and handoff_ok
     if args.pipeline:
         # the overlapped path must have actually carried the soak's
         # solves — a flag that silently fell back to sequential would
@@ -567,7 +790,17 @@ def main(argv=None) -> int:
         from karpenter_provider_aws_tpu.weather import WeatherSimulator as _WS
         wsc = weather_sim.scenario
         wstats = weather_sim.stats()
-        intr = op.interruption.stats() if op.interruption else {}
+        if args.standby:
+            # the storm straddles the cutover: A consumed messages before
+            # the kill, B after promotion — the evidence bar sums both
+            intr = {}
+            for o in (op_a, op_b):
+                if o is None or o.interruption is None:
+                    continue
+                for k, v in o.interruption.stats().items():
+                    intr[k] = intr.get(k, 0) + v
+        else:
+            intr = op.interruption.stats() if op.interruption else {}
         # real interruption schemas only — junk (malformed/unknown) is
         # counted separately and must not pad the >100 evidence bar
         handled = sum(intr.get(f"received_{k}", 0)
@@ -705,6 +938,7 @@ def main(argv=None) -> int:
                          if chaos_sidecars else None),
             interruption=intr, interruptions_handled=handled,
             replay_match=replay_match,
+            handoff=(handoff_report if args.standby else None),
             soak={"pods_churned": i, "minutes": args.minutes,
                   "seed": args.seed, "api_mode": bool(args.api_mode),
                   "churn_scale": args.churn_scale})

@@ -25,6 +25,13 @@ Method semantics:
 
 Replacement safety: replacements launch FIRST; originals are drained only
 after every replacement's node registers (disruption.md:23-25).
+
+The consolidation method's what-if dispatch, zero-leg probe cache, host
+fallback, savings referee, weather gate, and "why NOT consolidated" skip
+ledger live in solver/consolidate.ConsolidationEngine (constructed here as
+``self.engine``; docs/reference/consolidation.md). This controller keeps
+the policy: method order, budgets, candidate ranking, the prefix ladder +
+single-node scan, and launch-before-drain.
 """
 
 from __future__ import annotations
@@ -44,6 +51,8 @@ from ..errors import UnfulfillableCapacityError
 from ..events import Recorder
 from ..lattice.tensors import masked_view_versioned
 from ..metrics import Registry, wire_core_metrics
+from ..solver import taxonomy
+from ..solver.consolidate import ConsolidationEngine
 from ..solver.solve import NodePlan, ProbeResult, Solver
 from ..state.cluster import ClusterState
 from ..utils.clock import Clock
@@ -102,6 +111,21 @@ class DisruptionController:
         # budget-truncated pass (so repeat passes verify NEW candidates
         # instead of deterministically repeating the same window)
         self._scan_cursor = 0
+        # coverage accounting for the negative cache: a failed pass may
+        # only be cached once every candidate in the frontier has been
+        # probed as a single under the CURRENT fingerprint — a pass whose
+        # probe window or what-if budget covered part of the frontier
+        # proved nothing about the rest (see _reconcile_once)
+        self._covered: set = set()
+        self._last_search_fp = None
+        self._last_frontier: set = set()
+        self._search_truncated = False
+        # the vmapped what-if engine: batched candidate dispatch, zero-leg
+        # probe cache, host fallback, savings referee, weather gate, and
+        # the per-node skip-reason ledger (kpctl explain node)
+        self.engine = ConsolidationEngine(
+            cluster, solver, node_pools, unavailable, clock=self.clock,
+            metrics=metrics, audit=getattr(provisioner, "explain", None))
         # (node, pdb) pairs whose Unconsolidatable event already published
         # for the current blockage episode (see _candidates)
         self._pdb_blocked_logged: set = set()
@@ -237,6 +261,11 @@ class DisruptionController:
                     self.recorder.publish(
                         "Normal", "Unconsolidatable", "Node", node.name,
                         f"pdb {pdb} prevents pod evictions (pod {pod})")
+                    # same episode dedup keeps the event, the skip metric
+                    # label, and the explain ledger in lockstep
+                    self.engine.note_skip(
+                        node.name, taxonomy.NOT_CONSOLIDATABLE_PDB,
+                        f"pdb {pdb} prevents pod evictions (pod {pod})")
                 continue
             out.append(claim)
         # unblocked pairs may re-publish if they block again later
@@ -303,75 +332,19 @@ class DisruptionController:
 
     def _probe_whatifs(self, removed_sets: Sequence[Sequence[NodeClaim]],
                        node_by_claim=None, by_node=None):
-        """All of a pass's what-ifs as ONE batched device call.
-
-        Builds one padded problem per candidate set and rides the vmapped
-        probe kernel (solver.probe_batch / ops/binpack.pack_probe_fused). Pods are
-        probed with their soft constraints fully relaxed — the loosest state
-        solve_relaxed can reach — so a probe's infeasible verdict is
-        trustworthy while a feasible one is optimistic; the winning probe is
-        re-verified by one exact _what_if before any node is touched.
-        Returns [(ProbeResult, removed $/hr)] aligned with removed_sets."""
-        from ..apis.objects import relax_pod, relaxation_depth
-        from ..solver.problem import build_problem
-
-        lattice = masked_view_versioned(self.solver.lattice,
-                                        self.unavailable)
-        all_bins = self.cluster.existing_bins(lattice)
-        bound_all = self.cluster.bound_pods()
-        pvcs, storage_classes = self.cluster.volume_state()
-        ds = self.cluster.daemonset_pods()
-        pools = list(self.node_pools.values())
-        # index once per pass: the probe sets are prefixes/singles of one
-        # candidate list, so per-set _pods_on/node_for_claim scans would be
-        # O(sets × cluster) of pure host work. The caller threads in its own
-        # snapshots so the candidate filter and this map agree; a set whose
-        # claim lost its node anyway (snapshot drift) is reported INFEASIBLE
-        # rather than silently shrunk — results must stay aligned with the
-        # caller's sets, and the caller must never disrupt a claim the
-        # probe did not actually evaluate.
-        if node_by_claim is None:
-            node_by_claim = self.cluster.nodes_by_claim()
-        if by_node is None:
-            by_node = self.cluster.pods_by_node(include_daemonsets=False)
-        valid = [bool(rs) and all(c.name in node_by_claim for c in rs)
-                 for rs in removed_sets]
-        claim_names = {c.name for rs, ok in zip(removed_sets, valid) if ok
-                       for c in rs}
-        node_of = {n: node_by_claim[n].name for n in claim_names}
-        relaxed: Dict[str, Pod] = {}
-        for n in claim_names:
-            for p in by_node.get(node_of[n], ()):
-                if p.name not in relaxed:
-                    relaxed[p.name] = relax_pod(p, relaxation_depth(p))
-        problems, prices = [], []
-        for removed, ok in zip(removed_sets, valid):
-            if not ok:
-                continue
-            removed_nodes = {node_of[c.name] for c in removed}
-            removed_names = {c.name for c in removed}
-            pods = [relaxed[p.name] for c in removed
-                    for p in by_node.get(node_of[c.name], ())]
-            existing = [b for b in all_bins
-                        if b.name not in removed_nodes
-                        and b.name not in removed_names]
-            bound = [bp for bp in bound_all
-                     if bp.node_name not in removed_nodes]
-            problems.append(build_problem(
-                pods, pools, lattice, existing=existing, daemonset_pods=ds,
-                bound_pods=bound, pvcs=pvcs, storage_classes=storage_classes))
-            prices.append(self._removed_price(lattice, removed))
-        probed = self.solver.probe_batch(problems) if problems else []
-        dead = ProbeResult(feasible=False, n_new=0, new_cost=0.0,
-                           new_cap_type=None, flex=0)
-        out, vi = [], 0
-        for ok in valid:
-            if ok:
-                out.append((probed[vi], prices[vi]))
-                vi += 1
-            else:
-                out.append((dead, 0.0))
-        return out
+        """All of a pass's what-ifs as ONE batched device call — delegated
+        to ConsolidationEngine.probe (solver/consolidate.py), which adds
+        the zero-leg probe cache and the vmapped-envelope host-fallback
+        split. Pods are probed with their soft constraints fully relaxed —
+        the loosest state solve_relaxed can reach — so a probe's infeasible
+        verdict is trustworthy while a feasible one is optimistic; the
+        winning probe is re-verified by one exact _what_if before any node
+        is touched. Returns [(ProbeResult, removed $/hr)] aligned with
+        removed_sets."""
+        verdicts = self.engine.probe(removed_sets,
+                                     node_by_claim=node_by_claim,
+                                     by_node=by_node)
+        return [(v.probe, v.removed_price) for v in verdicts]
 
     def _within_budgets(self, removed: Sequence[NodeClaim],
                         reason: str) -> bool:
@@ -453,6 +426,14 @@ class DisruptionController:
             tuple(sorted(c.name for c in consolidatable)),
             # ... and when a scheduled budget's window opens or closes
             self._budget_window_state(),
+            # ... and when a budget SPEC is edited (an unscheduled
+            # budget has no window state, but raising its nodes value
+            # un-blocks candidates the last search skipped)
+            tuple(sorted(
+                (p.name, tuple((str(b.nodes), b.schedule, b.duration,
+                                tuple(b.reasons))
+                               for b in p.disruption.budgets))
+                for p in self.node_pools.values())),
         )
 
     def reconcile(self) -> None:
@@ -484,14 +465,27 @@ class DisruptionController:
         fp = self._fingerprint(consolidatable)
         if fp == self._last_failed_fingerprint:
             return False  # nothing changed since the search came up empty
+        if fp != self._last_search_fp:
+            # the base state moved: prior passes' coverage proves nothing
+            # under the new fingerprint
+            self._covered = set()
+            self._last_search_fp = fp
+        self._search_truncated = False
+        frontier = {c.name for c in consolidatable}
         if self._reconcile_consolidation(consolidatable):
+            self._last_frontier = frontier
             self._last_failed_fingerprint = None
             return True
-        if self._whatif_used < self.max_whatif_per_pass:
+        if (self._whatif_used < self.max_whatif_per_pass
+                and not self._search_truncated
+                and frontier <= self._covered):
             self._last_failed_fingerprint = fp
-        # a pass truncated by the what-if budget proved nothing about the
-        # remaining candidates — never negative-cache it; the next pass
-        # resumes the search with a fresh budget
+        # a pass truncated by the what-if budget, the probe window, or a
+        # weather hold proved nothing about the candidates it never
+        # reached — never negative-cache it; repeat passes keep sweeping
+        # (cursor advance + coverage set) until the WHOLE frontier has
+        # been probed under this fingerprint
+        self._last_frontier = frontier
         return False
 
     def _advance_in_flight(self) -> None:
@@ -665,10 +659,22 @@ class DisruptionController:
             candidates = self._consolidatable()
         if not candidates:
             return False
+        node_by_claim = self.cluster.nodes_by_claim()
+        hold = self.engine.weather_hold()
+        if hold:
+            # never consolidate INTO an active storm or spot-crash window
+            # (weather/simulator.py consolidation_advisory; an ice-age
+            # never holds). A held pass proved nothing — mark it truncated
+            # so it is not negative-cached and the search resumes the
+            # moment the advisory clears.
+            self.engine.note_weather_hold(
+                [node_by_claim[c.name].name for c in candidates
+                 if c.name in node_by_claim], hold)
+            self._search_truncated = True
+            return False
         # cheapest-to-disrupt first (consolidation.md scoring) off one
         # locked snapshot instead of an O(pods) scan per candidate
         by_node = self.cluster.pods_by_node(include_daemonsets=False)
-        node_by_claim = self.cluster.nodes_by_claim()
         cost = {c.name: float(sum(
             1 + p.priority
             for p in by_node.get(node_by_claim[c.name].name, ())))
@@ -693,9 +699,17 @@ class DisruptionController:
             ks = []
         start = self._scan_cursor % K
         rotated = candidates[start:] + candidates[:start]
+        # candidates that entered the frontier since the last pass jump
+        # the window queue: a budget- or window-truncated sweep must
+        # re-verify NEW candidates next pass, not make them wait a full
+        # rotation behind ones already probed (stable sort keeps the
+        # cheapest-first order within each class)
+        new_names = {c.name for c in candidates} - self._last_frontier
+        if new_names:
+            rotated.sort(key=lambda c: c.name not in new_names)
         singles = rotated[: self.MAX_SINGLE_PROBES]
         probe_sets = [candidates[:k] for k in ks] + [[c] for c in singles]
-        probes = self._probe_whatifs(probe_sets, node_by_claim=node_by_claim,
+        verdicts = self.engine.probe(probe_sets, node_by_claim=node_by_claim,
                                      by_node=by_node)
         n_prefix = len(ks)
         # the prefix ladder may only spend half the pass's exact-solve
@@ -705,24 +719,40 @@ class DisruptionController:
         prefix_budget = max(self.max_whatif_per_pass // 2, 1)
 
         # multi-node: largest probe-feasible prefix, verified by one exact
-        # solve (the probe is optimistic — soft constraints fully relaxed)
+        # solve (the probe is optimistic — soft constraints fully relaxed).
+        # A host-fallback set (outside the vmapped envelope) has no probe
+        # verdict: it goes straight to the exact solve under the budget.
         for i in range(n_prefix - 1, -1, -1):
             removed = probe_sets[i]
-            pr, probe_price = probes[i]
-            if not self._probe_ok(removed, pr, probe_price):
+            v = verdicts[i]
+            if not v.host and not self._probe_ok(removed, v.probe,
+                                                 v.removed_price):
                 continue
             if not self._within_budgets(removed, "Underutilized"):
                 continue  # budget can admit a smaller prefix — keep walking
             if self._whatif_used >= prefix_budget:
+                # probe-positive prefixes remain unverified: the pass must
+                # not be negative-cached on their account
+                self._search_truncated = True
                 break
             plan, removed_price = self._what_if(removed)
             ok = (not plan.unschedulable and len(plan.new_nodes) <= 1
                   and plan.new_node_cost < removed_price - CONSOLIDATION_SAVINGS_EPS
                   and self._spot_guard_ok(removed, plan))
             if ok:
+                accepted, ratio = self.engine.referee(
+                    removed, plan, node_by_claim=node_by_claim,
+                    by_node=by_node)
+                if not accepted:
+                    # the device plan's costing disagrees with the host
+                    # FFD oracle beyond the ≤2% envelope: a smaller
+                    # prefix (or a single) may still referee clean
+                    continue
                 if self._begin("Underutilized", removed, plan,
                                max_replacement_cost=removed_price
                                - CONSOLIDATION_SAVINGS_EPS):
+                    self.engine.note_accept(
+                        removed, removed_price - plan.new_node_cost)
                     return True
                 # _begin rejections surviving the budget pre-check (pool
                 # limits, launch failure) are pass-invariant: stop paying
@@ -734,10 +764,34 @@ class DisruptionController:
         # solve; bounded by the pass's remaining what-if budget
         truncated_at = None
         for j, claim in enumerate(singles):
-            pr, probe_price = probes[n_prefix + j]
-            if not self._probe_ok([claim], pr, probe_price):
+            v = verdicts[n_prefix + j]
+            node_name = node_by_claim[claim.name].name
+            if not v.host and not self._probe_ok([claim], v.probe,
+                                                 v.removed_price):
+                # a probe-negative single IS the pass's answer for that
+                # node — code it so `kpctl explain node` has one even when
+                # the fleet is already tight (probes are optimistic, so a
+                # probe-level "no savings" is conclusive, not provisional)
+                if (v.probe.feasible and v.probe.n_new <= 1
+                        and v.probe.new_cost
+                        < v.removed_price - CONSOLIDATION_SAVINGS_EPS):
+                    self.engine.note_skip(
+                        node_name, taxonomy.CONSOLIDATION_SPOT_GUARD,
+                        "spot replacement below the 15-type flexibility "
+                        "floor or the spot-to-spot gate is off")
+                else:
+                    self.engine.note_skip(
+                        node_name, taxonomy.CONSOLIDATION_NO_SAVINGS,
+                        "probe: no repack within one replacement node "
+                        f"cheaper than ${v.removed_price:.4f}/hr"
+                        if not v.probe.feasible or v.probe.n_new > 1 else
+                        f"probe: replacement ${v.probe.new_cost:.4f}/hr "
+                        f"vs removed ${v.removed_price:.4f}/hr")
                 continue
             if not self._within_budgets([claim], "Underutilized"):
+                self.engine.note_skip(
+                    node_name, taxonomy.NOT_CONSOLIDATABLE_BUDGET,
+                    f"pool {claim.node_pool} disruption budget exhausted")
                 continue
             if self._whatif_used >= self.max_whatif_per_pass:
                 truncated_at = j
@@ -746,22 +800,55 @@ class DisruptionController:
             if plan.unschedulable or len(plan.new_nodes) > 1:
                 continue
             if plan.new_node_cost >= removed_price - CONSOLIDATION_SAVINGS_EPS:
+                self.engine.note_skip(
+                    node_name, taxonomy.CONSOLIDATION_NO_SAVINGS,
+                    f"replacement ${plan.new_node_cost:.4f}/hr vs removed "
+                    f"${removed_price:.4f}/hr")
                 continue
             if not self._spot_guard_ok([claim], plan):
+                self.engine.note_skip(
+                    node_name, taxonomy.CONSOLIDATION_SPOT_GUARD,
+                    "spot replacement below the 15-type flexibility floor "
+                    "or the spot-to-spot gate is off")
+                continue
+            accepted, ratio = self.engine.referee(
+                [claim], plan, node_by_claim=node_by_claim, by_node=by_node)
+            if not accepted:
+                self.engine.note_skip(
+                    node_name, taxonomy.CONSOLIDATION_NO_SAVINGS,
+                    f"device plan costs {ratio:.3f}x the host FFD referee "
+                    f"(envelope 1.02)")
                 continue
             if self._begin("Underutilized", [claim], plan,
                            max_replacement_cost=removed_price
                            - CONSOLIDATION_SAVINGS_EPS):
+                self.engine.note_accept(
+                    [claim], removed_price - plan.new_node_cost)
                 return True
+        # every single probed this pass is covered under the current
+        # fingerprint (probe-negative IS an answer); candidates past a
+        # budget truncation are not
+        self._covered.update(
+            c.name for c in (singles if truncated_at is None
+                             else singles[:truncated_at]))
         if truncated_at is not None:
             # budget-truncated mid-window: resume exactly where the scan
             # stopped next pass (reconcile() skips the negative cache), and
             # always advance by >=1 so a deterministic repeat can't starve
             # the tail
+            self._search_truncated = True
             self._scan_cursor = (start + max(truncated_at, 1)) % K
         elif self._whatif_used >= self.max_whatif_per_pass:
             # exhausted exactly at the window's end: next window
+            self._search_truncated = True
             self._scan_cursor = (start + max(len(singles), 1)) % K
+        elif len(singles) < K:
+            # the window covered only part of the frontier even without
+            # budget pressure (K > MAX_SINGLE_PROBES): advance so repeat
+            # passes sweep the tail instead of deterministically
+            # re-probing the same window — the coverage set keeps the
+            # pass from negative-caching until the sweep completes
+            self._scan_cursor = (start + len(singles)) % K
         else:
             self._scan_cursor = 0
         return False

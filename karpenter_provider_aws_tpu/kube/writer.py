@@ -122,7 +122,7 @@ class DirectWriter(WriterCounts):
             claim.deletion_timestamp = self.clock.now()
             claim.phase = NodeClaimPhase.TERMINATING
             # the claim leaves pool_usage() immediately: re-render gauges
-            self.cluster.touch_capacity()
+            self.cluster.touch_capacity(name)
 
     def rollback_claim(self, name: str) -> None:
         """Hard delete of a claim whose instance never materialized (or is

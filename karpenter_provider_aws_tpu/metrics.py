@@ -490,6 +490,39 @@ def wire_core_metrics(reg: Registry) -> Dict[str, _Metric]:
         "nodeclaims_disrupted": reg.counter(
             "karpenter_nodeclaims_disrupted_total", "NodeClaims voluntarily disrupted.",
             ("nodepool", "reason")),
+        # the vmapped consolidation engine (solver/consolidate.py;
+        # docs/reference/consolidation.md): batched what-if dispatch,
+        # zero-leg cache hits, host-ladder fallbacks, the FFD savings
+        # referee, and the coded not-consolidated skip reasons
+        "disruption_vmapped_whatifs": reg.counter(
+            "karpenter_disruption_vmapped_whatifs_total",
+            "Batched consolidation what-if dispatches (one vmapped probe "
+            "kernel launch covering a whole candidate batch).", ()),
+        "disruption_whatif_candidates": reg.counter(
+            "karpenter_disruption_whatif_candidates_total",
+            "Candidate removal sets evaluated by batched consolidation "
+            "what-if dispatches.", ()),
+        "disruption_whatif_cached": reg.counter(
+            "karpenter_disruption_whatif_cached_total",
+            "Candidate removal sets served from the fingerprint-unchanged "
+            "delta cache at zero device sync legs.", ()),
+        "disruption_whatif_host_fallbacks": reg.counter(
+            "karpenter_disruption_whatif_host_fallbacks_total",
+            "Candidate removal sets outside the vmapped envelope "
+            "(wave-scale G, pinned groups on a mesh) evaluated on the "
+            "host what-if ladder instead.", ()),
+        "disruption_consolidation_skips": reg.counter(
+            "karpenter_disruption_consolidation_skips_total",
+            "Nodes skipped by the consolidation engine, by coded reason "
+            "(solver/taxonomy.py: not-consolidatable-pdb | "
+            "not-consolidatable-budget | consolidation-no-savings | "
+            "consolidation-weather-hold | consolidation-spot-guard).",
+            ("code",)),
+        "disruption_consolidation_savings": reg.gauge(
+            "karpenter_disruption_consolidation_savings_per_hour",
+            "Cumulative accepted consolidation savings in $/hr (removed "
+            "capacity price minus replacement price, summed over accepted "
+            "removals).", ()),
         "interruption_received": reg.counter(
             "karpenter_interruption_received_messages_total",
             "Interruption queue messages received.", ("message_type",)),

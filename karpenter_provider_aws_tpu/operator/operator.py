@@ -416,6 +416,12 @@ class Operator:
         # itself serves /debug/explain on both HTTP servers
         reg.register("explain", self.provisioner.explain.stats)
         introspect.set_explain_ring(self.provisioner.explain)
+        # the vmapped consolidation engine (solver/consolidate.py;
+        # docs/reference/consolidation.md): batched what-if dispatches,
+        # zero-leg cache hits, host fallbacks, referee verdicts, skip
+        # codes, and the savings-per-hour tally — the CONSOLIDATION row
+        # in kpctl top and the soak savings trajectory read this
+        reg.register("consolidation", self.disruption.engine.stats)
         reg.register("ice_cache", self.unavailable.stats)
         reg.register("writer", self.writer.stats)
         reg.register("events", self.recorder.stats)

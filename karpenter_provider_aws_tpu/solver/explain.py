@@ -33,7 +33,7 @@ delta-built pass explains identically to a full rebuild
 from __future__ import annotations
 
 import threading
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -472,12 +472,18 @@ class DecisionAuditRing:
     sampler rings (and therefore soak artifacts) carry the per-pass
     reason-code histogram as ordinary per-subsystem series."""
 
+    # per-node decision entries kept (newest wins; move-to-end on update)
+    NODE_LEDGER_MAX = 256
+
     def __init__(self, size: int = 64):
         self._ring: deque = deque(maxlen=size)
         self._lock = threading.Lock()
         self.passes_recorded = 0
         self._reason_totals: Dict[str, int] = {}
         self._elim_totals: Dict[str, int] = {}
+        # node -> latest "why was this node NOT disrupted" decision (the
+        # consolidation engine's skip codes land here: kpctl explain node)
+        self._node_ledger: "OrderedDict[str, dict]" = OrderedDict()
 
     def record(self, expl: PassExplanation) -> None:
         with self._lock:
@@ -489,6 +495,25 @@ class DecisionAuditRing:
             for stage, n in expl.eliminations.items():
                 self._elim_totals[stage] = \
                     self._elim_totals.get(stage, 0) + n
+
+    def record_node(self, node_name: str, code: str, detail: str = "",
+                    t: float = 0.0) -> None:
+        """Record a per-node skip decision (taxonomy-coded). Counted into
+        the same reason totals the pass explanations feed, so the skip
+        codes surface in stats()/soak series as reason_* like every other
+        code; the per-node entry keeps only the LATEST decision with a
+        per-(node, code) repeat count."""
+        assert code in taxonomy.CODES, code
+        with self._lock:
+            self._reason_totals[code] = self._reason_totals.get(code, 0) + 1
+            prev = self._node_ledger.pop(node_name, None)
+            seen = (prev["count"] if prev is not None
+                    and prev["code"] == code else 0)
+            self._node_ledger[node_name] = {
+                "node": node_name, "code": code, "detail": detail,
+                "t": round(float(t), 3), "count": seen + 1}
+            while len(self._node_ledger) > self.NODE_LEDGER_MAX:
+                self._node_ledger.popitem(last=False)
 
     # ---- lookups ---------------------------------------------------------
 
@@ -538,6 +563,13 @@ class DecisionAuditRing:
                         "traceId": e.trace_id, "rationale": e.claims[name]}
         return None
 
+    def find_node(self, name: str) -> Optional[dict]:
+        """The node's latest skip decision ("why was this node NOT
+        consolidated"), recorded by the consolidation engine."""
+        with self._lock:
+            entry = self._node_ledger.get(name)
+            return dict(entry) if entry is not None else None
+
     # ---- surfaces --------------------------------------------------------
 
     def stats(self) -> Dict[str, float]:
@@ -553,6 +585,7 @@ class DecisionAuditRing:
                 "last_unschedulable": float(
                     last.unschedulable_total) if last else 0.0,
                 "last_groups": float(last.groups_total) if last else 0.0,
+                "node_entries": float(len(self._node_ledger)),
             }
             for code, n in sorted(self._reason_totals.items()):
                 out["reason_" + code.replace("-", "_")] = float(n)
@@ -579,6 +612,13 @@ class DecisionAuditRing:
             return found if found is not None else {
                 "nodeclaim": q("nodeclaim"), "found": False,
                 "message": "nodeclaim not in the decision-audit ring"}
+        if q("node"):
+            found = self.find_node(q("node"))
+            return found if found is not None else {
+                "node": q("node"), "found": False,
+                "message": "node has no recorded skip decision (it was "
+                           "consolidated, never a candidate, or the entry "
+                           "aged out of the node ledger)"}
         if q("pass"):
             try:
                 pid = int(q("pass"))

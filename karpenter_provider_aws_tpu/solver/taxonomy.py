@@ -89,6 +89,29 @@ SNAPSHOT_VERSION_MISMATCH = "snapshot-version-mismatch"
 # store no longer carries: a demoted (zombie) leader's in-flight
 # eviction/claim write, rejected instead of raced
 FENCED_WRITE_REJECTED = "fenced-write-rejected"
+# consolidation provenance (solver/consolidate.py ConsolidationEngine;
+# docs/reference/consolidation.md): these answer "why was this node NOT
+# consolidated" — they ride the per-node explain ledger
+# (`kpctl explain node`) and the karpenter_disruption_consolidation_
+# skips_total code label, never a pod's unschedulable reason.
+# a PodDisruptionBudget leaves zero eviction headroom for a pod on the
+# node: the node cannot drain (reference Unconsolidatable event)
+NOT_CONSOLIDATABLE_PDB = "not-consolidatable-pdb"
+# the NodePool's disruption budget window currently allows zero (or too
+# few) voluntary disruptions: the decision is deferred, not rejected
+NOT_CONSOLIDATABLE_BUDGET = "not-consolidatable-budget"
+# the what-if repack found no plan that saves money — or the device
+# plan lost to the host FFD referee's costing of the same what-if by
+# more than the ≤2% envelope (the savings referee rule)
+CONSOLIDATION_NO_SAVINGS = "consolidation-no-savings"
+# the weather advisory holds voluntary consolidation: an active storm
+# or spot-crash regime window — consolidating INTO distressed capacity
+# trades a standing node for one about to be reclaimed or repriced
+CONSOLIDATION_WEATHER_HOLD = "consolidation-weather-hold"
+# spot-to-spot replacement consolidation gated off: the feature flag is
+# disabled, or the replacement lacks the minimum instance-type
+# flexibility the reference demands (SpotToSpotConsolidation)
+CONSOLIDATION_SPOT_GUARD = "consolidation-spot-guard"
 
 CODES = frozenset({
     UNKNOWN_RESOURCE, NO_OFFERING, ICE_HOLD, ZONE_ANTI_AFFINITY,
@@ -96,6 +119,9 @@ CODES = frozenset({
     AFFINITY_PRESENCE, POOL_LIMITS, SOLVE_ERROR,
     SIDECAR_HUNG, SIDECAR_UNREACHABLE, POOL_EXHAUSTED,
     STALE_ANCHOR, SNAPSHOT_VERSION_MISMATCH, FENCED_WRITE_REJECTED,
+    NOT_CONSOLIDATABLE_PDB, NOT_CONSOLIDATABLE_BUDGET,
+    CONSOLIDATION_NO_SAVINGS, CONSOLIDATION_WEATHER_HOLD,
+    CONSOLIDATION_SPOT_GUARD,
 })
 
 # the parse-failure sentinel for strings minted before the taxonomy (or

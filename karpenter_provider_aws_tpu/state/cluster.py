@@ -62,6 +62,13 @@ class DirtySet:
     full: bool = False
     pods: Set[str] = field(default_factory=set)   # names to re-examine
     bins: bool = False         # existing-bin inputs changed
+    # node/claim names the bin mutations localized to, when the journal
+    # entry carried one; ``bins_unnamed=True`` means at least one bin
+    # mutation could NOT be localized, so per-name consumers (the
+    # consolidation engine's candidate-delta cache) must treat the whole
+    # bin table as dirty — never a silently-partial answer
+    bin_names: Set[str] = field(default_factory=set)
+    bins_unnamed: bool = False
     volumes: bool = False      # PVC / StorageClass mutations
     daemonsets: bool = False   # daemonset pod set changed (ds_overhead)
     other: bool = False        # anything the journal cannot localize
@@ -80,6 +87,8 @@ class DirtySet:
         self.full = self.full or newer.full
         self.pods |= newer.pods
         self.bins = self.bins or newer.bins
+        self.bin_names |= newer.bin_names
+        self.bins_unnamed = self.bins_unnamed or newer.bins_unnamed
         self.volumes = self.volumes or newer.volumes
         self.daemonsets = self.daemonsets or newer.daemonsets
         self.other = self.other or newer.other
@@ -201,6 +210,10 @@ class ClusterState:
                     out.pods.add(name)
                 elif kind == "bin":
                     out.bins = True
+                    if name:
+                        out.bin_names.add(name)
+                    else:
+                        out.bins_unnamed = True
                 elif kind == "volume":
                     out.volumes = True
                 elif kind == "dspod":
@@ -214,6 +227,8 @@ class ClusterState:
             if self._nominations:
                 out.pods.update(self._nominations.keys())
                 out.bins = True
+                out.bin_names.update(n.target
+                                     for n in self._nominations.values())
             return out
 
     def touched_pods(self, names) -> Dict[str, Tuple[str, Optional[Pod]]]:
@@ -251,7 +266,7 @@ class ClusterState:
             if pod.node_name is not None:
                 # first seen ALREADY BOUND (sync relist, external
                 # scheduler): its node's used vector just grew
-                self._note("bin")
+                self._note("bin", pod.node_name)
             # arrival stamp for the pods_startup_time metric (reference
             # karpenter_pods_startup_time_seconds: created → scheduled).
             # Already-bound pods (operator resync) are NOT arrivals — a
@@ -269,7 +284,7 @@ class ClusterState:
                        else "pod", name)
             if pod is not None and pod.node_name is not None:
                 # a bound pod leaving frees its node's used vector
-                self._note("bin")
+                self._note("bin", pod.node_name)
 
     def drain_startup_samples(self) -> List[float]:
         """Newly-observed pod startup latencies (arrival → first bind)
@@ -286,7 +301,7 @@ class ClusterState:
                 # a bind changes BOTH the pending set and the target
                 # bin's used vector
                 self._note("pod", pod_name)
-                self._note("bin")
+                self._note("bin", node_name)
                 if pod.node_name is None:
                     added = self._pod_added.pop(pod_name, None)
                     if added is not None:
@@ -364,7 +379,7 @@ class ClusterState:
                     self._note("pod", pod.name)
                     out.append(pod)
             if out:
-                self._note("bin")
+                self._note("bin", node_name)
             return out
 
     # ---- node leases (kube-node-lease mirror) -----------------------------
@@ -489,7 +504,7 @@ class ClusterState:
                         allowance[n] -= 1
                     pod.node_name = None
                     self._note("pod", pod.name)
-                    self._note("bin")
+                    self._note("bin", node_name)
                     evicted.append(pod)
                 else:
                     blocked.append(pod)
@@ -501,7 +516,7 @@ class ClusterState:
             # nominated pods charge their unregistered claim's bin
             # (existing_bins sums nominated usage)
             self._note("pod", pod_name)
-            self._note("bin")
+            self._note("bin", target)
 
     def nominated_pods(self, target: str) -> List[Pod]:
         now = self._clock.now()
@@ -573,37 +588,38 @@ class ClusterState:
 
     # ---- nodes / claims ---------------------------------------------------
 
-    def touch_capacity(self) -> None:
+    def touch_capacity(self, name: str = "") -> None:
         """Record an in-place mutation that changes pool_usage() without
         an add/delete (a claim marked for deletion, a node cordon that
-        excludes it from capacity)."""
+        excludes it from capacity). ``name`` localizes the mutation to a
+        node/claim for the dirty journal; "" poisons per-name consumers."""
         with self._lock:
             self.capacity_rev += 1
-            self._note("bin")
+            self._note("bin", name)
 
     def add_node(self, node: Node) -> None:
         with self._lock:
             self.nodes[node.name] = node
             self.capacity_rev += 1
-            self._note("bin")
+            self._note("bin", node.name)
 
     def delete_node(self, name: str) -> None:
         with self._lock:
             self.nodes.pop(name, None)
             self.capacity_rev += 1
-            self._note("bin")
+            self._note("bin", name)
 
     def add_claim(self, claim: NodeClaim) -> None:
         with self._lock:
             self.claims[claim.name] = claim
             self.capacity_rev += 1
-            self._note("bin")
+            self._note("bin", claim.name)
 
     def delete_claim(self, name: str) -> None:
         with self._lock:
             self.claims.pop(name, None)
             self.capacity_rev += 1
-            self._note("bin")
+            self._note("bin", name)
             stale = [p for p, n in self._nominations.items() if n.target == name]
             for p in stale:
                 del self._nominations[p]
@@ -794,7 +810,9 @@ class ClusterState:
                 if new_node is not None or old_node is not None:
                     # a refresh of a bound pod can change its requests —
                     # its node's used vector moves with it
-                    self._note("bin")
+                    self._note("bin", new_node or old_node or "")
+                    if old_node and new_node and old_node != new_node:
+                        self._note("bin", old_node)
 
     def apply_node(self, node: Node) -> None:
         with self._lock:
@@ -803,7 +821,7 @@ class ClusterState:
                 # semantics without an add/delete
                 self.nodes[node.name] = node
                 self.capacity_rev += 1
-                self._note("bin")
+                self._note("bin", node.name)
             else:
                 self.add_node(node)
 
@@ -814,7 +832,7 @@ class ClusterState:
                 self.add_claim(claim)
                 return
             self.claims[claim.name] = claim
-            self._note("bin")
+            self._note("bin", claim.name)
             if (bool(prev.deletion_timestamp) != bool(claim.deletion_timestamp)
                     or prev.phase != claim.phase):
                 # deletion stamp / phase flips change pool_usage() without

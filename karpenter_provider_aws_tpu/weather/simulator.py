@@ -46,6 +46,11 @@ from .scenario import WeatherScenario
 
 _ICE_REASON = "WeatherIce"
 
+# consolidation_advisory's spot-crash detector: a regime target μ (log
+# price multiplier) at or past this reads as distressed spot capacity
+# (e^0.5 ≈ a sustained 1.65× surge) — voluntary consolidation holds
+CONSOL_HOLD_MU = 0.5
+
 
 class WeatherSimulator:
     def __init__(self, scenario: WeatherScenario, lattice,
@@ -478,6 +483,29 @@ class WeatherSimulator:
         }
         out.update(self.counters)
         return out
+
+    def consolidation_advisory(self) -> Dict[str, object]:
+        """Should voluntary consolidation HOLD right now? The engine's
+        weather gate (solver/consolidate.py): consolidating INTO an
+        active storm window or a spot-crash regime trades a standing
+        node for capacity about to be reclaimed or repriced. Returns
+        ``{"hold": bool, "reason": "storm" | "spot-crash" | ""}``.
+
+        ICE spells deliberately never hold — an ice-age holds capacity
+        OUT of the market, which makes consolidating onto what remains
+        MORE valuable, not less. A crash regime is detected from the
+        live regime targets (``_mu``): any family/zone pushed past
+        :data:`CONSOL_HOLD_MU` (≈ a sustained 1.6× price surge) reads
+        as distressed spot capacity."""
+        if self._stopped:
+            return {"hold": False, "reason": ""}
+        sc = self.scenario
+        now_s = self.ticks * sc.tick_seconds
+        if any(s.at <= now_s < s.at + s.duration for s in sc.storms):
+            return {"hold": True, "reason": "storm"}
+        if any(mu >= CONSOL_HOLD_MU for mu in self._mu.values()):
+            return {"hold": True, "reason": "spot-crash"}
+        return {"hold": False, "reason": ""}
 
     def artifact(self, **extra) -> Dict:
         """The WEATHER artifact body (docs/reference/weather.md): the

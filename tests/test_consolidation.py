@@ -1,0 +1,418 @@
+"""Vmapped consolidation engine tests (solver/consolidate.py +
+controllers/disruption.py; behavioral spec docs/reference/consolidation.md).
+
+Covers the engine seams the end-to-end disruption tests can't isolate:
+the zero-leg probe cache (pending-churn hits, bin/price/unavailability
+invalidation), the counted host fallback, the host-FFD savings referee,
+the skip-code ledger lockstep (metric label + per-node ledger + audit
+ring), the weather-advisory hold, the frontier re-verification rule (a
+truncated/covered pass must probe NEW candidates first next pass), and
+the per-(node, pdb) Unconsolidatable dedup + re-arm.
+"""
+
+import types
+
+import pytest
+
+from karpenter_provider_aws_tpu.apis import (
+    NodePool, Operator as ReqOp, Pod, Requirement,
+)
+from karpenter_provider_aws_tpu.apis import wellknown as wk
+from karpenter_provider_aws_tpu.apis.objects import (
+    DisruptionBudget, NodePoolDisruption, PodAffinityTerm,
+    PodDisruptionBudget,
+)
+from karpenter_provider_aws_tpu.cloud import FakeCloud
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+from karpenter_provider_aws_tpu.operator import Operator, Options
+from karpenter_provider_aws_tpu.solver import taxonomy
+from karpenter_provider_aws_tpu.solver.faults import FaultInjector
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+_FAMILIES = ("m5", "c5")
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    return build_lattice([s for s in build_catalog()
+                          if s.family in _FAMILIES])
+
+
+def make_env(lattice, **pool_disruption):
+    clock = FakeClock()
+    disruption = (NodePoolDisruption(**pool_disruption)
+                  if pool_disruption else NodePoolDisruption())
+    pool = NodePool(name="default", disruption=disruption, requirements=[
+        Requirement(wk.LABEL_CAPACITY_TYPE, ReqOp.IN, ("on-demand",))])
+    return Operator(options=Options(registration_delay=1.0),
+                    lattice=lattice, cloud=FakeCloud(clock), clock=clock,
+                    node_pools=[pool])
+
+
+def spread_pods(n, cpu="500m", mem="1Gi", prefix="sp", start=0):
+    """One pod per node via hostname self-anti-affinity on the group."""
+    anti = [PodAffinityTerm(topology_key=wk.LABEL_HOSTNAME,
+                            label_selector=(("grp", prefix),), anti=True)]
+    return [Pod(name=f"{prefix}-{i}", labels={"grp": prefix},
+                requests={"cpu": cpu, "memory": mem},
+                pod_affinity=list(anti))
+            for i in range(start, start + n)]
+
+
+def overprovisioned_env(lattice, n=4, consolidate_after=5.0):
+    """n oversized nodes each pinned non-empty by one tiny anti-affine
+    pod: emptiness can't claim them, consolidation can."""
+    env = make_env(lattice, consolidation_policy="WhenUnderutilized",
+                   consolidate_after=consolidate_after)
+    for p in spread_pods(n, cpu="3", mem="6Gi", prefix="big"):
+        env.cluster.add_pod(p)
+    env.settle(max_rounds=30)
+    assert len(env.cluster.nodes) == n
+    for i in range(n):
+        env.cluster.delete_pod(f"big-{i}")
+    anti = [PodAffinityTerm(topology_key=wk.LABEL_HOSTNAME,
+                            label_selector=(("grp", "big"),), anti=True)]
+    for i in range(n):
+        env.cluster.add_pod(Pod(name=f"tiny-{i}", labels={"grp": "big"},
+                                requests={"cpu": "250m", "memory": "256Mi"},
+                                pod_affinity=list(anti)))
+    env.settle(max_rounds=10)
+    assert len(env.cluster.nodes) == n
+    env.clock.step(consolidate_after + 1.0)
+    return env
+
+
+def singles(env):
+    return [[c] for c in env.cluster.claims.values()]
+
+
+class TestZeroLegCache:
+    def test_pending_churn_served_from_cache(self, lattice):
+        env = overprovisioned_env(lattice)
+        eng = env.disruption.engine
+        sets = singles(env)
+        v1 = eng.probe(sets)
+        assert eng.counters["vmapped_whatifs"] == 1
+        assert eng.counters["batched_candidates"] == len(sets)
+        assert not any(v.cached for v in v1)
+        # same base problem: every verdict from cache, zero dispatches
+        v2 = eng.probe(sets)
+        assert all(v.cached for v in v2)
+        assert eng.counters["vmapped_whatifs"] == 1
+        assert eng.counters["fp_unchanged"] == len(sets)
+        # pending-pod churn does not move the bin table
+        env.cluster.add_pod(Pod(name="pending-only",
+                                requests={"cpu": "100m",
+                                          "memory": "64Mi"}))
+        v3 = eng.probe(sets)
+        assert all(v.cached for v in v3)
+        assert eng.counters["vmapped_whatifs"] == 1
+        # cached verdicts agree with the originals
+        assert [v.probe for v in v3] == [v.probe for v in v1]
+
+    def test_bin_change_invalidates(self, lattice):
+        env = overprovisioned_env(lattice)
+        eng = env.disruption.engine
+        sets = singles(env)
+        eng.probe(sets)
+        assert all(v.cached for v in eng.probe(sets))
+        # a BOUND pod leaving dirties its node's bin: whole cache clears
+        env.cluster.delete_pod("tiny-0")
+        v = eng.probe(sets)
+        assert not any(x.cached for x in v)
+        assert eng.counters["cache_invalidations"] == 1
+        assert eng.counters["vmapped_whatifs"] == 2
+
+    def test_price_and_unavailability_invalidate(self, lattice):
+        env = overprovisioned_env(lattice)
+        eng = env.disruption.engine
+        sets = singles(env)
+        eng.probe(sets)
+        env.unavailable.mark_unavailable(
+            "InsufficientInstanceCapacity", "on-demand", "m5.large",
+            lattice.zones[0])
+        assert not any(v.cached for v in eng.probe(sets))
+        assert eng.counters["cache_invalidations"] == 1
+        # repopulated under the new anchor; a price refresh clears again
+        assert all(v.cached for v in eng.probe(sets))
+        env.solver.lattice.price_version += 1
+        assert not any(v.cached for v in eng.probe(sets))
+        assert eng.counters["cache_invalidations"] == 2
+
+
+class TestHostFallback:
+    def test_wave_scale_set_flagged_and_counted(self, lattice):
+        # 4 pods bound onto one node -> the candidate's what-if carries
+        # 4 evictee groups; a g_limit of 1 puts it past the compiled
+        # bucket ceiling and outside the vmapped envelope
+        env = make_env(lattice)
+        # distinct requests: identical pods coalesce into ONE group and
+        # G=1 never crosses the ceiling
+        for i in range(4):
+            env.cluster.add_pod(Pod(name=f"p-{i}",
+                                    requests={"cpu": f"{500 + 10 * i}m",
+                                              "memory": "1Gi"}))
+        env.settle()
+        assert len(env.cluster.claims) == 1
+        eng = env.disruption.engine
+        # settle's own disruption passes may have probed (and cached)
+        # this very set under no faults — the envelope check only runs
+        # for cache misses
+        eng._cache.clear()
+        dispatches = eng.counters["vmapped_whatifs"]
+        env.solver.inject_faults(FaultInjector(g_limit=1))
+        try:
+            v = eng.probe(singles(env))
+            assert v[0].host and not v[0].cached
+            assert eng.counters["host_fallbacks"] == 1
+            # a fallback set pays no dispatch and is never cached
+            assert eng.counters["vmapped_whatifs"] == dispatches
+            assert not eng._cache
+        finally:
+            env.solver.inject_faults(None)
+
+
+class TestReferee:
+    def test_accepts_within_envelope(self, lattice):
+        env = overprovisioned_env(lattice, n=2)
+        eng = env.disruption.engine
+        claim = next(iter(env.cluster.claims.values()))
+        ok, ratio = eng.referee([claim],
+                                types.SimpleNamespace(new_node_cost=0.0))
+        assert ok
+        assert eng.counters["referee_checks"] == 1
+        assert eng.counters["referee_rejects"] == 0
+
+    def test_rejects_outside_envelope(self, lattice):
+        env = overprovisioned_env(lattice, n=2)
+        eng = env.disruption.engine
+        claim = next(iter(env.cluster.claims.values()))
+        # the oracle can always place one tiny evictee; a device plan
+        # claiming a $1e9/hr replacement is outside any 2% envelope
+        ok, ratio = eng.referee([claim],
+                                types.SimpleNamespace(new_node_cost=1e9))
+        assert not ok and ratio > 1.02
+        assert eng.counters["referee_rejects"] == 1
+
+
+class TestSkipLedger:
+    def test_note_skip_lockstep(self, lattice):
+        env = make_env(lattice)
+        eng = env.disruption.engine
+        eng.note_skip("node-a", taxonomy.NOT_CONSOLIDATABLE_PDB,
+                      "pdb web-pdb prevents pod evictions")
+        st = eng.stats()
+        assert st["skip_not_consolidatable_pdb"] == 1
+        doc = eng.ledger_doc()["node-a"]
+        assert doc["code"] == taxonomy.NOT_CONSOLIDATABLE_PDB
+        assert "web-pdb" in doc["detail"]
+        # the decision-audit ring (kpctl explain node) got the same entry
+        entry = eng.audit.find_node("node-a")
+        assert entry and entry["code"] == taxonomy.NOT_CONSOLIDATABLE_PDB
+
+    def test_unknown_code_rejected(self, lattice):
+        env = make_env(lattice)
+        with pytest.raises(AssertionError):
+            env.disruption.engine.note_skip("n", "not-a-real-code")
+
+    def test_note_accept_clears_ledger(self, lattice):
+        env = make_env(lattice)
+        eng = env.disruption.engine
+        eng.note_skip("node-b", taxonomy.CONSOLIDATION_NO_SAVINGS)
+        eng.note_accept([types.SimpleNamespace(name="node-b")], 0.25)
+        assert "node-b" not in eng.ledger_doc()
+        assert eng.counters["nodes_consolidated"] == 1
+        assert eng.counters["savings_per_hour"] == pytest.approx(0.25)
+
+    def test_taxonomy_codes_declared(self):
+        for code in (taxonomy.NOT_CONSOLIDATABLE_PDB,
+                     taxonomy.NOT_CONSOLIDATABLE_BUDGET,
+                     taxonomy.CONSOLIDATION_NO_SAVINGS,
+                     taxonomy.CONSOLIDATION_WEATHER_HOLD,
+                     taxonomy.CONSOLIDATION_SPOT_GUARD):
+            assert code in taxonomy.CODES
+
+
+class TestWeatherGate:
+    def test_hold_blocks_then_resumes(self, lattice):
+        env = overprovisioned_env(lattice)
+        eng = env.disruption.engine
+        eng.weather_advisory = lambda: {"hold": True, "reason": "spot-crash"}
+        before = set(env.cluster.claims)
+        for _ in range(3):
+            env.disruption._reconcile_once()
+        assert set(env.cluster.claims) == before
+        assert eng.counters["weather_holds"] >= 1
+        assert eng.stats()["skip_consolidation_weather_hold"] >= len(before)
+        codes = {d["code"] for d in eng.ledger_doc().values()}
+        assert codes == {taxonomy.CONSOLIDATION_WEATHER_HOLD}
+        # a held pass is truncated, never negative-cached: the search
+        # resumes the moment the advisory clears
+        eng.weather_advisory = lambda: {"hold": False, "reason": ""}
+        assert env.disruption._reconcile_once()
+        assert eng.counters["accepted"] >= 1
+
+    def test_broken_advisory_never_wedges(self, lattice):
+        env = make_env(lattice)
+        eng = env.disruption.engine
+
+        def boom():
+            raise RuntimeError("advisory down")
+
+        eng.weather_advisory = boom
+        assert eng.weather_hold() == ""
+
+
+class TestBudgetPacing:
+    def test_zero_budget_codes_and_refuses(self, lattice):
+        env = overprovisioned_env(lattice)
+        pool = env.node_pools["default"]
+        pool.disruption.budgets = [DisruptionBudget(nodes="0")]
+        before = set(env.cluster.claims)
+        for _ in range(2):
+            env.disruption._reconcile_once()
+        assert set(env.cluster.claims) == before
+        assert not env.disruption._in_flight
+        st = env.disruption.engine.stats()
+        assert st["skip_not_consolidatable_budget"] >= 1
+        # probes still ran (pre-checked budget, not a dead pass)
+        assert st["vmapped_whatifs"] >= 1
+        # opening the budget lets the SAME state consolidate (the budget
+        # skip never negative-cached the pass)
+        pool.disruption.budgets = [DisruptionBudget(nodes="1")]
+        assert env.disruption._reconcile_once()
+        assert env.disruption.engine.counters["accepted"] == 1
+
+
+class TestFrontierReverification:
+    def test_new_candidate_jumps_the_scan_window(self, lattice):
+        """Satellite pin: after the frontier is fully covered under one
+        fingerprint, a candidate that ENTERS the frontier by pure time
+        passage (its consolidate_after window elapsing — no pod/claim
+        motion) must be probed in the very next pass, ahead of nodes the
+        sweep already probed, even with a 1-wide scan window."""
+        ca = 60.0
+        env = make_env(lattice, consolidation_policy="WhenUnderutilized",
+                       consolidate_after=ca)
+        # right-sized one-pod-per-node fleet: every probe is negative
+        # (anti-affinity pins pods, a same-price replacement saves $0),
+        # so passes sweep and cover without ever consolidating
+        for p in spread_pods(3, prefix="sp"):
+            env.cluster.add_pod(p)
+        env.settle(max_rounds=30)
+        assert len(env.cluster.claims) == 3
+        env.disruption.MAX_SINGLE_PROBES = 1
+        env.clock.step(ca + 1.0)
+        old = set(env.cluster.claims)
+        for _ in range(3):
+            assert not env.disruption._reconcile_once()
+        assert env.disruption._covered == old
+        # a 4th node joins, too YOUNG to be a candidate. Disruption is
+        # suppressed while it binds: mid-settle its pod is NOMINATED,
+        # not bound, and a nominated pod's anti-affinity is invisible to
+        # the what-if — the transient would thrash the fleet and rotate
+        # every claim name out from under the test
+        orig_reconcile = env.disruption.reconcile
+        env.disruption.reconcile = lambda: None
+        try:
+            env.cluster.add_pod(spread_pods(1, prefix="sp", start=3)[0])
+            env.settle(max_rounds=30)
+        finally:
+            env.disruption.reconcile = orig_reconcile
+        new_name = (set(env.cluster.claims) - old).pop()
+        new_claim = env.cluster.claims[new_name]
+        for _ in range(3):   # re-cover the old frontier under the new fp
+            env.disruption._reconcile_once()
+        assert env.disruption._covered == old
+        # pure time passage: the new claim ages into the frontier with
+        # zero journal movement. The next 1-wide window must probe IT —
+        # not resume the rotation at an already-covered node
+        ref = new_claim.initialized_at or new_claim.created_at
+        remaining = (ref + ca) - env.clock.now()
+        assert remaining > 0, "premise broken: new claim already eligible"
+        env.clock.step(remaining + 0.5)
+        env.disruption._reconcile_once()
+        assert env.disruption._covered == {new_name}
+
+
+class TestPdbDedupRearm:
+    def _blocked_env(self, lattice):
+        # budget 0: no disruption method may ACT (emptiness would claim
+        # a node the moment its web pod leaves, and a terminating claim
+        # can never re-enter candidacy) — episode bookkeeping only
+        env = make_env(lattice, consolidation_policy="WhenUnderutilized",
+                       consolidate_after=5.0,
+                       budgets=[DisruptionBudget(nodes="0")])
+        for p in spread_pods(3, prefix="web"):
+            env.cluster.add_pod(p)
+        env.settle(max_rounds=30)
+        assert len(env.cluster.nodes) == 3
+        env.clock.step(6.0)
+        env.cluster.add_pdb(PodDisruptionBudget(
+            name="web-pdb", label_selector={"grp": "web"},
+            max_unavailable=0))
+        return env
+
+    def test_one_event_and_skip_per_episode(self, lattice):
+        env = self._blocked_env(lattice)
+        nodes = set(env.cluster.nodes)
+        for _ in range(4):
+            env.disruption._reconcile_once()
+        events = env.recorder.events(reason="Unconsolidatable")
+        # once per (node, pdb) episode — 4 passes must not republish
+        assert len(events) == len(nodes)
+        st = env.disruption.engine.stats()
+        assert st["skip_not_consolidatable_pdb"] == len(nodes)
+        ledger = env.disruption.engine.ledger_doc()
+        assert {n for n in ledger} == nodes
+        assert all(d["code"] == taxonomy.NOT_CONSOLIDATABLE_PDB
+                   for d in ledger.values())
+
+    def test_rearm_on_pdb_change(self, lattice):
+        env = self._blocked_env(lattice)
+        nodes = set(env.cluster.nodes)
+        for _ in range(2):
+            env.disruption._reconcile_once()
+        assert len(env.recorder.events(reason="Unconsolidatable")) \
+            == len(nodes)
+        # the pdb relaxes: blockage episode ends, dedup re-arms...
+        env.cluster.delete_pdb("web-pdb")
+        env.disruption._reconcile_once()
+        # ...and a NEW zero-allowance pdb is a NEW episode per node
+        env.cluster.add_pdb(PodDisruptionBudget(
+            name="web-pdb", label_selector={"grp": "web"},
+            max_unavailable=0))
+        for _ in range(2):
+            env.disruption._reconcile_once()
+        assert len(env.recorder.events(reason="Unconsolidatable")) \
+            == 2 * len(nodes)
+        assert env.disruption.engine.stats()[
+            "skip_not_consolidatable_pdb"] == 2 * len(nodes)
+
+    def test_rearm_on_pod_churn(self, lattice):
+        env = self._blocked_env(lattice)
+        for _ in range(2):
+            env.disruption._reconcile_once()
+        node = next(iter(env.cluster.nodes))
+        victim = next(p for p in env.cluster.snapshot_pods()
+                      if p.node_name == node and not p.is_daemonset)
+        before = len(env.recorder.events(reason="Unconsolidatable"))
+        # the blocking pod leaves: the node's episode ends
+        env.cluster.delete_pod(victim.name)
+        env.disruption._reconcile_once()
+        # a fresh pod under the same pdb re-blocks it: new episode.
+        # Anti-affinity on the group pins it to the ONE node with no web
+        # pod left (the victim's) — or a fresh node; either is a new
+        # (node, pdb) episode
+        anti = [PodAffinityTerm(topology_key=wk.LABEL_HOSTNAME,
+                                label_selector=(("grp", "web"),),
+                                anti=True)]
+        env.cluster.add_pod(Pod(name="web-again", labels={"grp": "web"},
+                                requests={"cpu": "250m",
+                                          "memory": "256Mi"},
+                                pod_affinity=anti))
+        env.settle(max_rounds=10)
+        for _ in range(2):
+            env.disruption._reconcile_once()
+        assert len(env.recorder.events(reason="Unconsolidatable")) \
+            == before + 1

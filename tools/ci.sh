@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # One-command CI gate: generated-artifact drift, graftlint, introspection
-# smoke, tier-1 tests, bench smoke.
+# smoke, subsystem smokes, tier-1 tests, bench smoke.
 #
 #     bash tools/ci.sh            # the full gate (exit != 0 on any failure)
 #     bash tools/ci.sh --fast     # drift + smokes + tier-1 only (skip bench)
@@ -90,8 +90,18 @@
 #               for already-bound pods), with the LEADER/HANDOFF kpctl
 #               rows, karpenter_operator_* gauges, and a cycle-free
 #               lock-order witness in BOTH processes
-#  13. tier-1 — the full non-slow test suite on the CPU backend
-#  14. bench  — `bench.py --smoke`: one fast config through the real
+#  13. consol — vmapped consolidation gate
+#               (tools/smoke_consolidation.py): an operator churned to
+#               an over-provisioned steady state must consolidate >=2
+#               nodes via the batched device path (vmapped dispatches
+#               carrying >1 candidate set, zero host-ladder fallbacks),
+#               with the host-FFD savings referee and disruption-budget
+#               pacing both observably engaged, pending-only churn
+#               served from the zero-leg probe cache, and the
+#               CONSOLIDATION kpctl row + `consolidation` provider +
+#               `kpctl explain node` live over HTTP
+#  14. tier-1 — the full non-slow test suite on the CPU backend
+#  15. bench  — `bench.py --smoke`: one fast config through the real
 #               harness, so a broken solve path can never ride in on a
 #               green unit-test run
 
@@ -103,7 +113,7 @@ PY=${PYTHON:-python}
 FAST=0
 [ "${1:-}" = "--fast" ] && FAST=1
 
-echo "=== ci [1/14] generated-artifact drift ==="
+echo "=== ci [1/15] generated-artifact drift ==="
 $PY tools/gen_crds.py --check
 tmp=$(mktemp -d)
 trap 'rm -rf "$tmp"' EXIT
@@ -118,47 +128,50 @@ done
 [ "$stale" = 0 ] || exit 1
 echo "drift: clean"
 
-echo "=== ci [2/14] graftlint (project-invariant static analysis) ==="
+echo "=== ci [2/15] graftlint (project-invariant static analysis) ==="
 $PY tools/lint/run.py --check
 
-echo "=== ci [3/14] introspection smoke + metrics lint ==="
+echo "=== ci [3/15] introspection smoke + metrics lint ==="
 $PY tools/smoke_introspect.py
 
-echo "=== ci [4/14] steady-state delta churn smoke ==="
+echo "=== ci [4/15] steady-state delta churn smoke ==="
 $PY tools/smoke_delta.py
 
-echo "=== ci [5/14] sharded mesh smoke ==="
+echo "=== ci [5/15] sharded mesh smoke ==="
 $PY tools/smoke_sharded.py
 
-echo "=== ci [6/14] device-resident microloop smoke ==="
+echo "=== ci [6/15] device-resident microloop smoke ==="
 $PY tools/smoke_microloop.py
 
-echo "=== ci [7/14] continuous-profiling smoke ==="
+echo "=== ci [7/15] continuous-profiling smoke ==="
 $PY tools/smoke_profile.py
 
-echo "=== ci [8/14] write-path smoke ==="
+echo "=== ci [8/15] write-path smoke ==="
 $PY tools/smoke_writepath.py
 
-echo "=== ci [9/14] adversarial-weather smoke ==="
+echo "=== ci [9/15] adversarial-weather smoke ==="
 $PY tools/smoke_weather.py
 
-echo "=== ci [10/14] solver-pool failover smoke ==="
+echo "=== ci [10/15] solver-pool failover smoke ==="
 $PY tools/smoke_pool.py
 
-echo "=== ci [11/14] decision-explainability smoke ==="
+echo "=== ci [11/15] decision-explainability smoke ==="
 $PY tools/smoke_explain.py
 
-echo "=== ci [12/14] zero-downtime handoff smoke ==="
+echo "=== ci [12/15] zero-downtime handoff smoke ==="
 $PY tools/smoke_handoff.py
 
-echo "=== ci [13/14] tier-1 tests ==="
+echo "=== ci [13/15] vmapped consolidation smoke ==="
+$PY tools/smoke_consolidation.py
+
+echo "=== ci [14/15] tier-1 tests ==="
 $PY -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider
 
 if [ "$FAST" = 1 ]; then
-    echo "=== ci [14/14] bench smoke: SKIPPED (--fast) ==="
+    echo "=== ci [15/15] bench smoke: SKIPPED (--fast) ==="
 else
-    echo "=== ci [14/14] bench smoke ==="
+    echo "=== ci [15/15] bench smoke ==="
     $PY bench.py --smoke
 fi
 

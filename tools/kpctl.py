@@ -678,6 +678,28 @@ def _render_top(doc, server: str):
             f"last unschedulable {ex.get('last_unschedulable', 0):g}   "
             + ("reasons " + "  ".join(f"{k} {v:g}" for k, v in top_reasons)
                if top_reasons else "no unschedulable reasons recorded"))
+    # the vmapped consolidation engine (docs/reference/consolidation.md):
+    # batched dispatch/cache/fallback counters, accepted savings, and the
+    # top skip codes ("why was this node NOT consolidated")
+    co = p.get("consolidation", {})
+    if isinstance(co.get("vmapped_whatifs"), (int, float)):
+        top_skips = sorted(
+            ((k[len("skip_"):].replace("_", "-"), v)
+             for k, v in co.items()
+             if k.startswith("skip_") and isinstance(v, (int, float))),
+            key=lambda kv: -kv[1])[:3]
+        lines.append(
+            f"CONSOLIDATION dispatches {co.get('vmapped_whatifs', 0):g} "
+            f"({co.get('batched_candidates', 0):g} sets)   "
+            f"cached {co.get('fp_unchanged', 0):g}   "
+            f"host {co.get('host_fallbacks', 0):g}   "
+            f"accepted {co.get('accepted', 0):g} "
+            f"({co.get('nodes_consolidated', 0):g} nodes, "
+            f"${co.get('savings_per_hour', 0):.2f}/hr saved)   "
+            f"referee {co.get('referee_rejects', 0):g}/"
+            f"{co.get('referee_checks', 0):g} rejects"
+            + ("   skips " + "  ".join(f"{k} {v:g}" for k, v in top_skips)
+               if top_skips else ""))
     if "weather" in p:
         w = p["weather"]
         lines.append(
@@ -1018,10 +1040,13 @@ def cmd_explain(c: Client, args) -> int:
         kpctl explain nodeclaim NAME  the claim's placement rationale
                                       (chosen offering, runner-up,
                                       price delta)
+        kpctl explain node NAME       why was this node NOT consolidated
+                                      — the engine's latest coded skip
+                                      (solver/taxonomy.py) for the node
         kpctl explain pass [ID]       one pass's full decision audit
                                       (default: the newest pass)
     """
-    if args.what in ("pod", "nodeclaim") and not args.name:
+    if args.what in ("pod", "nodeclaim", "node") and not args.name:
         raise SystemExit(f"kpctl explain {args.what} needs a name")
     if args.what == "pod":
         doc = c.request("GET", f"/debug/explain?pod={args.name}")
@@ -1053,6 +1078,20 @@ def cmd_explain(c: Client, args) -> int:
               + (f", trace {doc['traceId']}" if doc.get("traceId") else "")
               + ")")
         _render_rationale(doc.get("rationale", {}))
+        return 0
+    if args.what == "node":
+        doc = c.request("GET", f"/debug/explain?node={args.name}")
+        if doc.get("found") is False or doc.get("enabled") is False:
+            print(doc.get("message", f"node {args.name!r} has no recorded "
+                                     "skip decision"))
+            return 1
+        print(f"Node:    {doc.get('node')}")
+        print(f"Skip:    {doc.get('code', '?')}"
+              + (f"   (x{doc['count']:g} this episode)"
+                 if doc.get("count", 0) > 1 else ""))
+        if doc.get("detail"):
+            print(f"Detail:  {doc['detail']}")
+        print(f"At:      t={doc.get('t', 0)}s")
         return 0
     # pass
     q = f"?pass={args.name}" if args.name else ""
@@ -1201,7 +1240,7 @@ def main(argv=None) -> int:
         "explain", help="why was this decision made — per-pod elimination "
                         "waterfall, claim placement rationale, pass audit "
                         "(/debug/explain; docs/reference/explain.md)")
-    exp.add_argument("what", choices=("pod", "nodeclaim", "pass"))
+    exp.add_argument("what", choices=("pod", "nodeclaim", "node", "pass"))
     exp.add_argument("name", nargs="?", default=None,
                      help="pod/nodeclaim name, or pass id (default: "
                           "newest pass)")

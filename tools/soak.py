@@ -53,6 +53,21 @@ and the recorded weather timeline byte-identical to a same-seed replay.
 The verdict + timeline land in a ``WEATHER_*.json.gz`` artifact
 (``--weather-out``).
 
+``--consol-out PATH`` arms the CONSOLIDATION verdict
+(docs/reference/consolidation.md): the default pool gets
+WhenUnderutilized consolidation (``--consolidate-after``), the deletion
+waves carve out underutilized nodes, and the run GATES on the vmapped
+engine demonstrably carrying the search — accepted removals with
+cumulative savings, batched dispatches (>1 candidate set per device
+call), fingerprint-unchanged candidates served from the zero-leg probe
+cache, and every accept refereed against the host FFD oracle. The
+savings-per-hour trajectory (per-sample ``consolidation`` provider
+series) lands in a ``CONSOL_*.json.gz`` artifact. With a weather
+scenario attached, consolidation additionally rides the advisory: a
+scripted spot-crash regime must record HOLDS during the crash window
+(``consolidation-weather-hold`` counted > 0) and savings RESUMING after
+it clears — zero activity alone never passes.
+
 ``--solver-pool N`` composes CONTROL-PLANE weather with all of the
 above: N chaos-capable solver sidecars are spawned in-process on unix
 sockets and the operator runs against them as a failover pool
@@ -228,6 +243,20 @@ def main(argv=None) -> int:
     ap.add_argument("--weather-out", default="",
                     help="weather artifact path (default "
                          "WEATHER_<scenario>.json.gz; '' means default)")
+    ap.add_argument("--consol-out", default="",
+                    help="consolidation artifact path (CONSOL_*.json.gz):"
+                         " set, the run FAILS unless the vmapped "
+                         "consolidation engine demonstrably engaged — "
+                         "accepted removals, >1 candidate set per "
+                         "dispatch, zero-leg cache hits, every accept "
+                         "refereed — and the savings-per-hour trajectory "
+                         "is recorded (docs/reference/consolidation.md)")
+    ap.add_argument("--consolidate-after", type=float, default=None,
+                    help="enable WhenUnderutilized consolidation on the "
+                         "default pool after N seconds of eligibility "
+                         "(default: 5 when --consol-out or a spot-crash "
+                         "weather scenario is attached, else Never; "
+                         "0 forces Never)")
     ap.add_argument("--solver-pool", type=int, default=0,
                     help="spawn N in-process chaos-capable solver "
                          "sidecars on unix sockets and run the operator "
@@ -316,6 +345,7 @@ def main(argv=None) -> int:
                                   batch_idle_duration=0.05,
                                   batch_max_duration=0.5,
                                   interruption_queue="soak-q",
+                                  spot_to_spot_consolidation=True,
                                   mesh=args.mesh,
                                   solver_address=solver_address,
                                   solver_solve_deadline=(
@@ -359,7 +389,8 @@ def main(argv=None) -> int:
         # owns its mirror: state arrives ONLY over the replication stream
         op_b = Operator(options=Options(registration_delay=0.2,
                                         batch_idle_duration=0.05,
-                                        batch_max_duration=0.5),
+                                        batch_max_duration=0.5,
+                                        spot_to_spot_consolidation=True),
                         lattice=lattice, cloud=op.cloud, clock=op.clock,
                         interruption_queue=q)
         repl_client = ReplicationClient(f"unix:{handoff_dir}/repl.sock")
@@ -415,11 +446,51 @@ def main(argv=None) -> int:
                   "weather would be vacuous")
             return 1
         introspect.registry().register("weather", weather_sim.stats)
+        # voluntary consolidation rides the weather: hold through storm
+        # windows and crash regimes, keep packing through ice
+        # (docs/reference/consolidation.md "Weather gates")
+        op.disruption.engine.weather_advisory = \
+            weather_sim.consolidation_advisory
+        if op_b is not None:
+            op_b.disruption.engine.weather_advisory = \
+                weather_sim.consolidation_advisory
         print(f"soak: weather scenario {scenario.name!r} "
               f"seed={weather_sim.seed} tick={scenario.tick_seconds}s "
               f"(storms={len(scenario.storms)} ice={len(scenario.ice)} "
               f"regimes={len(scenario.regimes)})")
-    rt = ControllerRuntime(operator_specs(op), elector=elector_a).start()
+    # consolidation enablement: the pool default is Never; the CONSOL
+    # verdict and the spot-crash advisory gate both need the engine live
+    from karpenter_provider_aws_tpu.weather.simulator import CONSOL_HOLD_MU
+    crash_scripted = (weather_sim is not None and any(
+        r.mu >= CONSOL_HOLD_MU for r in weather_sim.scenario.regimes))
+    consolidate_after = args.consolidate_after
+    if consolidate_after is None and (args.consol_out or crash_scripted):
+        # short enough that storm-churned nodes still age into
+        # eligibility mid-window — 15 s leaves the candidate set empty
+        # through an interruption storm and the weather gate vacuous
+        consolidate_after = 5.0
+    if consolidate_after:
+        for o in (op, op_b):
+            if o is None:
+                continue
+            dflt = o.node_pools.get("default")
+            if dflt is not None:
+                dflt.disruption.consolidation_policy = "WhenUnderutilized"
+                dflt.disruption.consolidate_after = consolidate_after
+                if client is not None and o is op:
+                    client.update_nodepool(dflt)   # API mode: via watch
+        print(f"soak: consolidation armed (WhenUnderutilized, "
+              f"consolidate_after={consolidate_after}s)")
+    specs_a = operator_specs(op)
+    if consolidate_after:
+        # one voluntary disruption per pass at the default 10 s cadence
+        # starves the consolidation verdict on a minutes-long soak —
+        # emptiness alone eats every pass. Same controller, just paced
+        # to the soak's churn tempo.
+        for sp in specs_a:
+            if sp.name == "disruption":
+                sp.interval = 2.0
+    rt = ControllerRuntime(specs_a, elector=elector_a).start()
     rt_b = None
     if args.standby:
         handle_a.runtime = rt
@@ -477,6 +548,9 @@ def main(argv=None) -> int:
     i = 0
     pending_faults = list(fault_schedule)
     promote_t = b_first_pass_t = None
+    # engine stats frozen at the LAST advisory-held instant: the
+    # "savings resumed after the crash" gate compares against these
+    consol_stats_at_hold = None
 
     def safe_instances():
         try:
@@ -511,6 +585,8 @@ def main(argv=None) -> int:
                       f"{'' if fval is None else '=' + str(fval)}")
             if weather_sim is not None:
                 weather_sim.advance()
+                if weather_sim.consolidation_advisory()["hold"]:
+                    consol_stats_at_hold = op.disruption.engine.stats()
             # churn lands on the ACTIVE operator: after a cutover the
             # promoted standby's mirror is the live one (the dead
             # leader's would silently swallow every wave)
@@ -566,8 +642,14 @@ def main(argv=None) -> int:
                 # drift churn: rev the pool template; the drift
                 # controller must roll stale-hash nodes while the rest
                 # of the storm rages (API mode: server-side, so the
-                # config watch delivers it like any operator would)
-                pool = aop.node_pools.get("default")
+                # config watch delivers it like any operator would).
+                # With consolidation armed the rev is suppressed: a
+                # template revved every second keeps EVERY node
+                # perpetually drift-stale, and drift (earlier in the
+                # method order) would eat every disruption pass —
+                # the consolidation verdict would starve by design.
+                pool = (None if consolidate_after
+                        else aop.node_pools.get("default"))
                 if pool is not None:
                     pool.labels["soak/rev"] = f"r{i}"
                     if client is not None:
@@ -697,6 +779,57 @@ def main(argv=None) -> int:
     # "zero pending" is about involuntary state, not about catching the
     # optimizer between a drain and its rebind. Termination/GC keep
     # running so every in-flight drain still completes.
+    if consolidate_after:
+        # the zero-leg coda: the cache's steady-state claim needs a calm
+        # instant the storm never offers. Budget pinned to 0 so nothing
+        # moves; one search repopulates the probe cache (the ICE flush
+        # above invalidated it), then a pending-only wiggle re-runs the
+        # search — every candidate verdict must come back cached, at
+        # zero device legs (docs/reference/consolidation.md)
+        from karpenter_provider_aws_tpu.apis.objects import \
+            DisruptionBudget
+        dflt = op.node_pools.get("default")
+        if dflt is not None:
+            dflt.disruption.budgets = [DisruptionBudget(nodes="0")]
+            # churn-fresh replacements are younger than consolidate_after
+            # at cutoff; the coda is about the cache, not pacing, so make
+            # every initialized node eligible for the search
+            dflt.disruption.consolidate_after = 0.0
+        # finish whatever the storm left mid-flight — draining originals,
+        # unregistered replacements, evicted pods re-pending all keep
+        # dirtying bins pass after pass; the coda needs a genuinely calm
+        # cluster, and budget 0 keeps anything NEW from starting
+        calm, calm_deadline = 0, time.monotonic() + 25.0
+        while time.monotonic() < calm_deadline:
+            op.run_once()
+            if not op.cluster.pending_pods() \
+                    and not op.disruption._in_flight:
+                calm += 1
+                if calm >= 5:
+                    break
+            else:
+                calm = 0
+            time.sleep(0.05)
+        for _ in range(8):
+            if not op.disruption._reconcile_once():
+                break
+        pre_coda = post_coda = op.disruption.engine.stats()
+        for attempt in range(3):
+            wiggle = f"consol-coda-{attempt}"
+            op.cluster.add_pod(Pod(name=wiggle,
+                                   requests={"cpu": "100m",
+                                             "memory": "64Mi"}))
+            op.disruption._reconcile_once()
+            op.cluster.delete_pod(wiggle)
+            post_coda = op.disruption.engine.stats()
+            if post_coda.get("fp_unchanged", 0) > \
+                    pre_coda.get("fp_unchanged", 0):
+                break
+        print(f"soak: consolidation coda zero-leg hits "
+              f"{pre_coda.get('fp_unchanged', 0):g} -> "
+              f"{post_coda.get('fp_unchanged', 0):g} "
+              f"(dispatches {pre_coda.get('vmapped_whatifs', 0):g} -> "
+              f"{post_coda.get('vmapped_whatifs', 0):g})")
     op.disruption.reconcile = lambda: None
     solver_fired = dict(op.solver.faults.fired) if op.solver.faults else {}
     op.solver.inject_faults(None)
@@ -872,6 +1005,36 @@ def main(argv=None) -> int:
             print("soak: weather regimes configured but none activated "
                   "(regime_shifts=0)")
             ok = False
+        # the consolidation weather gate must be NON-VACUOUS on a crash
+        # scenario (docs/reference/consolidation.md "Weather gates"):
+        # holds demonstrably recorded DURING the crash window, and the
+        # engine demonstrably resuming (savings growing) after it
+        # cleared — a run that merely never consolidated proves nothing
+        if crash_scripted:
+            cst = op.disruption.engine.stats()
+            held = cst.get("weather_holds", 0)
+            hold_skips = cst.get("skip_consolidation_weather_hold", 0)
+            print(f"soak: consolidation weather gate holds={held:g} "
+                  f"hold_skips={hold_skips:g} "
+                  f"savings_at_last_hold="
+                  f"{(consol_stats_at_hold or {}).get('savings_per_hour')} "
+                  f"savings_final={cst.get('savings_per_hour', 0.0):g}")
+            if held == 0 or hold_skips == 0:
+                print("soak: a spot-crash regime was scripted but "
+                      "consolidation never recorded a weather hold "
+                      "(vacuous gate — was the engine ever eligible "
+                      "during the window?)")
+                ok = False
+            if consol_stats_at_hold is None:
+                print("soak: crash regime scripted but the advisory "
+                      "never reported hold to the churn loop")
+                ok = False
+            elif cst.get("savings_per_hour", 0.0) <= \
+                    consol_stats_at_hold.get("savings_per_hour", 0.0) \
+                    + 1e-9:
+                print("soak: consolidation never RESUMED after the "
+                      "crash window (savings flat since the last hold)")
+                ok = False
         # control-plane weather gates (docs/reference/solver-pool.md):
         # a blackout drill must demonstrably have exercised the pool —
         # failovers happened, the local rung engaged ONLY under a
@@ -1068,6 +1231,82 @@ def main(argv=None) -> int:
         print(f"soak: weather artifact -> {wout} "
               f"({len(weather_doc['timeline'])} timeline events, "
               f"{len(weather_doc['burn_series'])} burn samples)")
+    if args.consol_out:
+        # the CONSOLIDATION verdict (docs/reference/consolidation.md
+        # "Gates"): the vmapped engine must demonstrably have carried
+        # the run's consolidation — each bar names the machinery it
+        # proves, so a quietly-dead engine can't ride a green soak
+        eng = op.disruption.engine
+        cst = eng.stats()
+        cb = monitor.samples[0]["t"] if monitor.samples else 0.0
+        consol_series = [
+            [round(s["t"] - cb, 1)] + [
+                s["subsystems"]["consolidation"].get(k, 0.0)
+                for k in ("savings_per_hour", "nodes_consolidated",
+                          "vmapped_whatifs", "batched_candidates",
+                          "fp_unchanged", "host_fallbacks",
+                          "weather_holds")]
+            for s in monitor.samples
+            if "consolidation" in s.get("subsystems", {})]
+        print(f"soak: consolidation accepted={cst.get('accepted', 0):g} "
+              f"nodes={cst.get('nodes_consolidated', 0):g} "
+              f"savings/hr=${cst.get('savings_per_hour', 0.0):.4f} "
+              f"dispatches={cst.get('vmapped_whatifs', 0):g} "
+              f"({cst.get('batched_candidates', 0):g} sets) "
+              f"cached={cst.get('fp_unchanged', 0):g} "
+              f"host={cst.get('host_fallbacks', 0):g} "
+              f"referee={cst.get('referee_checks', 0):g}/"
+              f"{cst.get('referee_rejects', 0):g} rejects")
+        if cst.get("accepted", 0) == 0:
+            print("soak: --consol-out set but the engine never accepted "
+                  "a removal (no savings recorded)")
+            ok = False
+        if cst.get("vmapped_whatifs", 0) == 0:
+            print("soak: --consol-out set but no batched device "
+                  "dispatch ever ran")
+            ok = False
+        elif cst.get("batched_candidates", 0) <= \
+                cst.get("vmapped_whatifs", 0):
+            print("soak: dispatches averaged <=1 candidate set — the "
+                  "candidate axis never actually batched")
+            ok = False
+        if cst.get("fp_unchanged", 0) == 0:
+            print("soak: the zero-leg probe cache never served a "
+                  "fingerprint-unchanged candidate")
+            ok = False
+        if cst.get("referee_checks", 0) < cst.get("accepted", 0):
+            print("soak: fewer referee checks than accepted removals — "
+                  "an accept bypassed the host FFD envelope")
+            ok = False
+        import gzip as _gzip
+        import json as _json
+        consol_doc = {
+            "engine": cst,
+            "series_fields": ["t", "savings_per_hour",
+                              "nodes_consolidated", "vmapped_whatifs",
+                              "batched_candidates", "fp_unchanged",
+                              "host_fallbacks", "weather_holds"],
+            "series": consol_series,
+            "slo": slo,
+            "referee_envelope": 0.02,
+            "weather": (weather_sim.scenario.name
+                        if weather_sim is not None else None),
+            "replay_identical": (bool(weather_doc["replay_match"])
+                                 if weather_doc is not None else None),
+            "soak": {"pods_churned": i, "minutes": args.minutes,
+                     "seed": args.seed,
+                     "consolidate_after": consolidate_after,
+                     "churn_scale": args.churn_scale},
+            "invariants_ok": ok,
+        }
+        if args.consol_out.endswith(".gz"):
+            with _gzip.open(args.consol_out, "wt") as f:
+                _json.dump(consol_doc, f, separators=(",", ":"))
+        else:
+            with open(args.consol_out, "w") as f:
+                _json.dump(consol_doc, f, indent=1)
+        print(f"soak: consolidation artifact -> {args.consol_out} "
+              f"({len(consol_series)} trajectory samples)")
     if chaos_sidecars:
         pst = op.solver.pool_stats()
         print(f"soak: pool exit state endpoints={pst['endpoints']} "

@@ -198,3 +198,19 @@ class Batcher(Generic[T, U]):
                 max_batch = max(max_batch, b.max_batch)
         return {"buckets": len(buckets), "pending": pending,
                 "batches": batches, "items": items, "max_batch": max_batch}
+
+    def headroom_probe(self) -> Dict[str, float]:
+        """Deepest bucket vs the max_items drain trigger
+        (introspect/headroom.py). ``kind="ring"`` in the registry's
+        sense — hitting max_items forces an immediate drain (the bound
+        is a flush trigger, not a loss edge), so full is by design."""
+        with self._lock:
+            buckets = list(self._buckets.values())
+        deepest = 0
+        for b in buckets:
+            with b.lock:
+                if len(b.pending) > deepest:
+                    deepest = len(b.pending)
+        return {"depth": float(deepest),
+                "capacity": float(self.opts.max_items),
+                "kind": "ring"}

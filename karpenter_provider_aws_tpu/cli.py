@@ -228,6 +228,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "carrying the current resourceVersion (env "
                         "API_BOOKMARK_EVERY, default 256; 0 disables) — "
                         "keeps idle watchers' resume points fresh")
+    p.add_argument("--headroom-high-water-fraction", type=float,
+                   default=None,
+                   help="Occupancy fraction at which a bounded queue "
+                        "counts as saturating (env "
+                        "HEADROOM_HIGH_WATER_FRACTION, default 0.9): "
+                        "crossing it fires one burn-capture per episode "
+                        "(docs/reference/headroom.md)")
     p.add_argument("--api-insecure", action="store_true",
                    help="Explicitly allow serving the write-capable REST "
                         "surface beyond loopback WITHOUT TLS + token.")
@@ -284,6 +291,9 @@ def options_from_args(args: argparse.Namespace) -> Options:
         overrides["api_watch_queue_bound"] = args.api_watch_queue_bound
     if args.api_bookmark_every is not None:
         overrides["api_bookmark_every"] = args.api_bookmark_every
+    if args.headroom_high_water_fraction is not None:
+        overrides["headroom_high_water_fraction"] = \
+            args.headroom_high_water_fraction
     for gate in (args.feature_gates or "").split(","):
         gate = gate.strip()
         if not gate:
@@ -386,7 +396,8 @@ def start_server(op: Operator, port: int,
             if self.path.startswith("/debug/statusz") or \
                     self.path.startswith("/debug/vars") or \
                     self.path.startswith("/debug/pprof") or \
-                    self.path.startswith("/debug/explain"):
+                    self.path.startswith("/debug/explain") or \
+                    self.path.startswith("/debug/headroom"):
                 # the introspection surfaces (docs/reference/
                 # introspection.md), mounted here like /debug/traces so
                 # deployments without --api-port still reach them

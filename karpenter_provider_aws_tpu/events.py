@@ -73,6 +73,17 @@ class Recorder:
             return {"ring": len(self._events), "published": self.published,
                     "warnings": self.warnings}
 
+    def headroom_probe(self) -> dict:
+        """Event-ring occupancy (introspect/headroom.py). ``kind="ring"``
+        — aging the oldest events out is the retention policy a real
+        apiserver applies too, not data loss; "drops" reports how many
+        have aged out so the registry's counter parity holds."""
+        with self._lock:
+            return {"depth": float(len(self._events)),
+                    "capacity": float(MAX_EVENTS),
+                    "drops": float(max(self.published - len(self._events), 0)),
+                    "kind": "ring"}
+
     def reset(self) -> None:
         with self._lock:
             self._events.clear()

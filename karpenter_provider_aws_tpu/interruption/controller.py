@@ -56,7 +56,10 @@ class InterruptionController:
         self._m_deleted = m["interruption_deleted"]
         self._m_actions = m["interruption_actions"]
         self._m_messages = m["interruption_messages"]
-        self._m_qdepth = m["interruption_queue_depth"]
+        # NOTE: karpenter_interruption_queue_depth is emitted by
+        # Operator.emit_gauges from the headroom registry's reading
+        # (introspect/headroom.py) — one source of truth for the depth,
+        # never two code paths reporting different numbers
         # plain counters mirrored into stats() (the introspection
         # registry's "interruption" provider): per-kind totals plus the
         # two robustness signals a storm soak asserts on
@@ -100,7 +103,6 @@ class InterruptionController:
         loop nor wedge it via endless redelivery while a storm rages."""
         msgs = self.queue.receive()
         if not msgs:
-            self._m_qdepth.set(float(len(self.queue)))
             return 0
         claims_by_id = self._claims_by_instance_id()
 
@@ -138,7 +140,6 @@ class InterruptionController:
             return 1
 
         n = sum(self._pool.run(msgs, one))
-        self._m_qdepth.set(float(len(self.queue)))
         return n
 
     def stats(self) -> Dict:
@@ -151,6 +152,16 @@ class InterruptionController:
             out["poison_dropped"] = self.poison_dropped
         out["queue_depth"] = len(self.queue)
         return out
+
+    def headroom_probe(self) -> Dict[str, float]:
+        """Interruption backlog (introspect/headroom.py): undeleted
+        messages. Unbounded (a real SQS queue buffers days), so the
+        forecast rides the fill rate; drops = the pre-existing poison
+        counter (the only way this controller ever discards)."""
+        with self._stats_lock:
+            poison = self.poison_dropped
+        return {"depth": float(len(self.queue)), "capacity": 0.0,
+                "drops": float(poison)}
 
     def _handle(self, msg: InterruptionMessage, claims_by_id: Dict[str, NodeClaim]) -> None:
         for iid in msg.instance_ids:

@@ -30,6 +30,7 @@ import time
 from typing import Dict, List, Optional
 
 from . import contention
+from .headroom import HeadroomRegistry
 from .profiler import BurnCapture, SamplingProfiler
 from .registry import IntrospectRegistry, StatsProvider
 from .sampler import Sampler
@@ -37,11 +38,12 @@ from .slo import SloTracker
 
 __all__ = [
     "IntrospectRegistry", "Sampler", "SloTracker", "StatsProvider",
-    "SamplingProfiler", "BurnCapture", "contention",
+    "SamplingProfiler", "BurnCapture", "HeadroomRegistry", "contention",
     "registry", "sampler", "set_sampler", "statusz_text", "vars_doc",
     "debug_doc", "profiler_instance", "set_profiler", "enable_profiling",
     "profiler_stats", "burn_capture", "set_burn_capture",
     "explain_ring", "set_explain_ring",
+    "headroom_registry", "set_headroom",
 ]
 
 _REGISTRY = IntrospectRegistry()
@@ -49,6 +51,7 @@ _SAMPLER: Optional[Sampler] = None
 _PROFILER: Optional[SamplingProfiler] = None
 _BURN_CAPTURE: Optional[BurnCapture] = None
 _EXPLAIN = None   # solver/explain.py DecisionAuditRing
+_HEADROOM: Optional[HeadroomRegistry] = None
 _STARTED_AT = time.time()
 
 
@@ -118,6 +121,18 @@ def explain_ring():
 def set_explain_ring(ring) -> None:
     global _EXPLAIN
     _EXPLAIN = ring
+
+
+def headroom_registry() -> Optional[HeadroomRegistry]:
+    """The published saturation observatory (introspect/headroom.py
+    HeadroomRegistry), or None before any Operator wired one — the
+    store behind /debug/headroom and `kpctl headroom`."""
+    return _HEADROOM
+
+
+def set_headroom(hr: Optional[HeadroomRegistry]) -> None:
+    global _HEADROOM
+    _HEADROOM = hr
 
 
 # ---- the two debug documents ---------------------------------------------
@@ -190,6 +205,16 @@ def debug_doc(path: str, query: Dict[str, List[str]]):
         doc = (ring.doc(query) if ring is not None
                else {"enabled": False,
                      "message": "no decision-audit ring published "
+                                "(operator still constructing?)"})
+        return json.dumps(doc).encode(), "application/json"
+    if p == "/debug/headroom":
+        # the saturation observatory (docs/reference/headroom.md): the
+        # ranked first-to-break table of every bounded resource. Served
+        # on BOTH HTTP servers like the rest.
+        hr = _HEADROOM
+        doc = (hr.doc() if hr is not None
+               else {"enabled": False,
+                     "message": "no headroom registry published "
                                 "(operator still constructing?)"})
         return json.dumps(doc).encode(), "application/json"
     if p.startswith("/debug/pprof"):

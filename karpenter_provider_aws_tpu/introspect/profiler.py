@@ -288,6 +288,16 @@ class SamplingProfiler:
                                and self._thread.is_alive()) else 0.0,
         }
 
+    def headroom_probe(self) -> Dict[str, float]:
+        """Unique-stack store occupancy (introspect/headroom.py): a
+        queue-kind bound — exhausting it means NEW stacks stop being
+        attributed (counted by the pre-existing ``dropped_stacks``),
+        which is evidence loss, not retention policy."""
+        with self._lock:
+            unique = len(self._counts)
+        return {"depth": float(unique), "capacity": float(self.max_stacks),
+                "drops": float(self.dropped_stacks)}
+
 
 # ---- burn-triggered capture -------------------------------------------------
 
@@ -409,3 +419,14 @@ class BurnCapture:
             return {"captures": list(self.captures),
                     "total": self.capture_count,
                     "retain": self.captures.maxlen}
+
+    def headroom_probe(self) -> Dict[str, float]:
+        """Capture-ring occupancy (introspect/headroom.py).
+        ``kind="ring"`` — flight-recorder retention: keeping only the
+        newest N episodes is the design, not loss."""
+        with self._lock:
+            depth = len(self.captures)
+            return {"depth": float(depth),
+                    "capacity": float(self.captures.maxlen or 0),
+                    "drops": float(max(self.capture_count - depth, 0)),
+                    "kind": "ring"}

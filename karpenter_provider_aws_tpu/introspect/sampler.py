@@ -84,6 +84,15 @@ class Sampler:
             self._thread.join(2.0)
             self._thread = None
 
+    def headroom_probe(self) -> Dict[str, float]:
+        """Ring occupancy (introspect/headroom.py): the FULLEST
+        per-provider ring vs the shared depth. ``kind="ring"`` — a full
+        ring is 10 minutes of history, exactly as designed."""
+        with self._lock:
+            fullest = max((len(r) for r in self._rings.values()), default=0)
+        return {"depth": float(fullest), "capacity": float(self.ring),
+                "kind": "ring"}
+
     # ---- series export ----------------------------------------------------
 
     def series(self) -> Dict[str, Dict]:

@@ -106,6 +106,15 @@ class SloTracker:
         if capture is not None:
             self.on_sustained = capture.on_sustained_burn
 
+    def headroom_probe(self) -> Dict[str, float]:
+        """Sample-window ring occupancy (introspect/headroom.py): the
+        fuller of the latency/cost rings. ``kind="ring"`` — the windows
+        are bounded by design; old samples aging out IS the window."""
+        with self._lock:
+            depth = max(len(self._lat), len(self._cost))
+        return {"depth": float(depth), "capacity": float(MAX_SAMPLES),
+                "kind": "ring"}
+
     # ---- boot warmup window ----------------------------------------------
 
     def begin_warmup(self, max_seconds: float = 600.0) -> None:

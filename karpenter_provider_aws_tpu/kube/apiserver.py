@@ -255,6 +255,7 @@ class Watch:
         self._since_bookmark = 0
         self.drops = 0             # events discarded at overflow
         self.bookmarks = 0
+        self.max_depth = 0         # deepest this queue ever got (monotonic)
 
     def _push(self, ev: WatchEvent, replay: bool = False) -> bool:
         """Append the SHARED event object (no copy). Returns True when it
@@ -282,6 +283,8 @@ class Watch:
                     self._on_drop(n)
                 return False
             self._events.append(ev)
+            if len(self._events) > self.max_depth:
+                self.max_depth = len(self._events)
             if ev.type != "BOOKMARK":
                 self._last_rv = ev.resource_version
                 self._since_bookmark += 1
@@ -302,6 +305,8 @@ class Watch:
                 object=freeze({"kind": self.kind,
                                "metadata": {"resourceVersion": self._last_rv}}),
                 resource_version=self._last_rv))
+            if len(self._events) > self.max_depth:
+                self.max_depth = len(self._events)
             self.bookmarks += 1
             self._cond.notify_all()
             return True
@@ -438,6 +443,11 @@ class FakeAPIServer:
         # construction — the bench writepath row records it as the
         # no-copy pin (a reintroduced copy must increment it)
         self.fanout_envelope_copies = 0
+        # process-monotonic watch-queue high water: folded from each
+        # watch's own max_depth when it unsubscribes, so stats() never
+        # regresses when a deep (or dropped) watcher goes away — the
+        # headroom registry's monotonic-high-water contract
+        self._watch_hw = 0
         # the PDB math's namespace index (policy/v1 allowance is computed
         # over one namespace's pods, never a full-store scan)
         self.add_index("pods", "namespace",
@@ -473,16 +483,22 @@ class FakeAPIServer:
         writer."""
         watchers = 0
         queued = 0
-        max_depth = 0
+        # seeded from the unsubscribe fold: watch_max_depth is monotonic
+        # per process, not "max over watchers that happen to be alive"
+        max_depth = self._watch_hw
+        deepest = 0
         for ws in self._watches.values():
             for w in tuple(ws):
                 watchers += 1
                 d = w.depth()
                 queued += d
-                if d > max_depth:
-                    max_depth = d
+                if d > deepest:
+                    deepest = d
+                if w.max_depth > max_depth:
+                    max_depth = w.max_depth
         objects = sum(len(s) for s in self._store.values())
         return {"watchers": watchers, "watch_queue_depth": queued,
+                "watch_deepest": deepest,
                 "watch_max_depth": max_depth,
                 "watch_drops": self.watch_drops,
                 "bookmarks": self.bookmarks_sent,
@@ -490,6 +506,34 @@ class FakeAPIServer:
                 "bulk_calls": self.bulk_calls, "bulk_ops": self.bulk_ops,
                 "fanout_envelope_copies": self.fanout_envelope_copies,
                 "last_rv": self.last_rv}
+
+    # ---- headroom probes (introspect/headroom.py) --------------------------
+
+    def headroom_probe(self) -> Dict[str, float]:
+        """Per-watcher queue saturation: depth = the DEEPEST live queue
+        (first watcher to hit the bound 410s regardless of the others),
+        capacity = the shared bound, drops/high-water = the cumulative
+        hub counters that survive a dropped watcher's unsubscribe."""
+        deepest = 0
+        hw = self._watch_hw
+        for ws in self._watches.values():
+            for w in tuple(ws):
+                d = w.depth()
+                if d > deepest:
+                    deepest = d
+                if w.max_depth > hw:
+                    hw = w.max_depth
+        return {"depth": float(deepest),
+                "capacity": float(self.watch_queue_bound),
+                "highwater": float(hw),
+                "drops": float(self.watch_drops)}
+
+    def headroom_probe_publish(self) -> Dict[str, float]:
+        """Fan-out publish backlog: events appended by writers but not
+        yet delivered by the combining flushers. Unbounded (capacity 0)
+        — the forecast watches the fill rate, not an occupancy."""
+        return {"depth": float(sum(len(q) for q in self._pub.values())),
+                "capacity": 0.0}
 
     # ---- admission (webhook seam) -----------------------------------------
 
@@ -1079,6 +1123,8 @@ class FakeAPIServer:
         with self._pub_mutex[w.kind]:
             if w in self._watches[w.kind]:
                 self._watches[w.kind].remove(w)
+            if w.max_depth > self._watch_hw:
+                self._watch_hw = w.max_depth
         w.stop()
 
     # ---- subresources ------------------------------------------------------

@@ -450,6 +450,38 @@ def wire_core_metrics(reg: Registry) -> Dict[str, _Metric]:
             "karpenter_api_fanout_envelope_copies",
             "Per-watcher envelope copies made on the watch fan-out path "
             "(pinned 0: delivery shares one frozen envelope per RV).", ()),
+        # the saturation observatory (introspect/headroom.py;
+        # docs/reference/headroom.md): one row per registered bounded
+        # resource, emitted via Gauge.replace each gauge pass so a
+        # resource that unregisters disappears instead of flatlining
+        "headroom_depth": reg.gauge(
+            "karpenter_headroom_depth",
+            "Current occupancy of a registered bounded resource, by "
+            "resource.", ("resource",)),
+        "headroom_capacity": reg.gauge(
+            "karpenter_headroom_capacity",
+            "Configured capacity of a registered bounded resource (0 = "
+            "unbounded, forecast-only), by resource.", ("resource",)),
+        "headroom_highwater": reg.gauge(
+            "karpenter_headroom_highwater",
+            "Process-monotonic high-water occupancy of a registered "
+            "bounded resource (never resets on read or on structure "
+            "churn), by resource.", ("resource",)),
+        "headroom_drops": reg.gauge(
+            "karpenter_headroom_drops",
+            "Cumulative overflow/drop count of a registered bounded "
+            "resource (mirrors the structure's own drop counter), by "
+            "resource.", ("resource",)),
+        "headroom_fill_rate": reg.gauge(
+            "karpenter_headroom_fill_rate",
+            "EWMA inflow pressure of a registered bounded resource in "
+            "items/second (drops count as inflow), by resource.",
+            ("resource",)),
+        "headroom_tte": reg.gauge(
+            "karpenter_headroom_seconds_to_exhaustion",
+            "Forecast seconds until a queue-kind resource exhausts its "
+            "capacity at the current EWMA net fill (-1 = no exhaustion "
+            "in sight), by resource.", ("resource",)),
         # lock contention accounting (introspect/contention.py): wait to
         # acquire a hot control-plane lock, observed ONLY on contention
         # (the uncontended path records nothing). Labeled by lock name —

@@ -358,6 +358,11 @@ class Operator:
 
         elector.on_promote = _promoted
         self._register_handoff_provider()
+        if source is not None:
+            # the replication journal window joins the observatory: a
+            # standby falling behind the journal is a forecastable break
+            self.headroom.register_probe("replication_window",
+                                         source.headroom_probe)
 
     def _register_handoff_provider(self) -> None:
         from .. import introspect
@@ -492,6 +497,71 @@ class Operator:
         # stratum.
         self.sampler = introspect.Sampler(reg)
         introspect.set_sampler(self.sampler)
+        self._wire_headroom(reg)
+
+    def _wire_headroom(self, reg) -> None:
+        """Register every bounded structure's cheap probe with the
+        saturation observatory (introspect/headroom.py; docs/reference/
+        headroom.md) and publish it for /debug/headroom + kpctl. The
+        registry itself is per-operator (its monotonic high-water marks
+        live exactly as long as the structures they watch) and survives
+        a promotion re-wire; probes are replace-by-name like the
+        introspection providers."""
+        from .. import introspect
+        from ..introspect import profiler as _prof
+        hr = getattr(self, "headroom", None)
+        if hr is None:
+            hr = self.headroom = introspect.HeadroomRegistry(
+                self.clock,
+                high_water_fraction=(
+                    self.options.headroom_high_water_fraction))
+        # a queue-kind resource crossing the high-water fraction fires
+        # the same capture machinery the SLO burn episodes feed
+        hr.attach_capture(self.burn_capture)
+        hr.register_probe("journal_ring", self.cluster.headroom_probe)
+        hr.register_probe("journal_coalescer",
+                          self.provisioner.journal_coalescer.headroom_probe)
+        hr.register_probe("decision_audit_ring",
+                          self.provisioner.explain.headroom_probe)
+        hr.register_probe("consolidation_probe_cache",
+                          self.disruption.engine.headroom_probe)
+        hr.register_probe("events_ring", self.recorder.headroom_probe)
+        hr.register_probe("slo_rings", self.slo.headroom_probe)
+        hr.register_probe("burn_captures", self.burn_capture.headroom_probe)
+        hr.register_probe("sampler_rings", self.sampler.headroom_probe)
+        cp = self.cloud_provider
+        hr.register_probe("cloud_launch_batcher",
+                          cp._launch_batcher.headroom_probe)
+        hr.register_probe("cloud_terminate_batcher",
+                          cp._terminate_batcher.headroom_probe)
+        resident = getattr(self.solver, "_resident", None)
+        if resident is not None:
+            hr.register_probe("solver_resident_cache",
+                              resident.headroom_probe)
+        if hasattr(self.solver, "pool_stats"):
+            hr.register_probe("pool_outstanding",
+                              self.solver.headroom_probe)
+        if self.api_server is not None:
+            hr.register_probe("api_watch_queues",
+                              self.api_server.headroom_probe)
+            hr.register_probe("api_publish_queues",
+                              self.api_server.headroom_probe_publish)
+        if self.interruption is not None:
+            hr.register_probe("interruption_queue",
+                              self.interruption.headroom_probe)
+
+        def _profiler_probe():
+            # the profiler is published lazily (--profile); until then
+            # the bound exists with nothing in it
+            p = introspect.profiler_instance()
+            if p is None:
+                return {"depth": 0.0,
+                        "capacity": float(_prof.MAX_UNIQUE_STACKS)}
+            return p.headroom_probe()
+
+        hr.register_probe("profiler_stacks", _profiler_probe)
+        reg.register("headroom", hr.stats)
+        introspect.set_headroom(hr)
 
     def _validate_pool_config(self, pool: NodePool,
                               node_classes: Dict[str, NodeClass]):
@@ -652,6 +722,31 @@ class Operator:
             {(k,): float(v)
              for k, v in self.cluster.pod_phase_counts().items()})
         self.slo.update()
+        # the saturation observatory (introspect/headroom.py): one
+        # probe sweep per gauge pass feeds the EWMA fill/drain rates,
+        # the first-to-break forecast, and the high-water capture edge;
+        # the karpenter_headroom_* families re-render via replace() so
+        # an unregistered resource disappears instead of flatlining
+        self.headroom.observe()
+        hr_rows = self.headroom.table()
+        for key, gname in (
+                ("depth", "karpenter_headroom_depth"),
+                ("capacity", "karpenter_headroom_capacity"),
+                ("highwater", "karpenter_headroom_highwater"),
+                ("drops", "karpenter_headroom_drops"),
+                ("fill_rate", "karpenter_headroom_fill_rate")):
+            self.metrics.get(gname).replace(
+                {(row["resource"],): float(row[key]) for row in hr_rows})
+        self.metrics.get("karpenter_headroom_seconds_to_exhaustion").replace(
+            {(row["resource"],): (float(row["seconds_to_exhaustion"])
+                                  if row["seconds_to_exhaustion"] is not None
+                                  else -1.0)
+             for row in hr_rows})
+        # depth/drop readouts that predate the observatory now FOLD from
+        # the same registry read — one source of truth per number
+        if self.interruption is not None:
+            self.metrics.get("karpenter_interruption_queue_depth").set(
+                self.headroom.read("interruption_queue").get("depth", 0.0))
         # pod startup latency samples observed since the last pass
         startup = self.metrics.get("karpenter_pods_startup_time_seconds")
         for s in self.cluster.drain_startup_samples():
@@ -727,14 +822,20 @@ class Operator:
             for key, gname in (
                     ("watchers", "karpenter_api_watchers"),
                     ("watch_queue_depth", "karpenter_api_watch_queue_depth"),
-                    ("watch_max_depth", "karpenter_api_watch_max_queue_depth"),
                     ("events_emitted", "karpenter_api_watch_events_delivered"),
                     ("bookmarks", "karpenter_api_watch_bookmarks"),
-                    ("watch_drops", "karpenter_api_watch_drops"),
                     ("bulk_ops", "karpenter_api_bulk_ops"),
                     ("fanout_envelope_copies",
                      "karpenter_api_fanout_envelope_copies")):
                 self.metrics.gauge(gname).set(float(api.get(key, 0)))
+            # deepest-queue + drop gauges fold from the headroom
+            # registry's reading of the SAME probe — never a second
+            # hand-walked number
+            watch_row = self.headroom.read("api_watch_queues")
+            self.metrics.gauge("karpenter_api_watch_max_queue_depth").set(
+                float(watch_row.get("depth", 0.0)))
+            self.metrics.gauge("karpenter_api_watch_drops").set(
+                float(watch_row.get("drops", 0.0)))
         # offering gauge surface: re-emit only when pricing or the ICE set
         # actually changed (both are versioned)
         gstate = (self.lattice.price_version, self.unavailable.seq_num)

@@ -107,6 +107,11 @@ class Options:
     # disables them.
     api_watch_queue_bound: int = 8192
     api_bookmark_every: int = 256
+    # saturation observatory (introspect/headroom.py; docs/reference/
+    # headroom.md): a queue-kind resource whose occupancy crosses this
+    # fraction of its capacity triggers one burn-capture per episode
+    # (profile + contention evidence at /debug/pprof/captures)
+    headroom_high_water_fraction: float = 0.9
 
     def validate(self) -> None:
         if not self.cluster_name:
@@ -138,6 +143,9 @@ class Options:
             raise ValueError("solver_health_deadline must be > 0")
         if self.api_bookmark_every < 0:
             raise ValueError("api_bookmark_every must be >= 0 (0 disables)")
+        if not (0.0 < self.headroom_high_water_fraction <= 1.0):
+            raise ValueError(
+                "headroom_high_water_fraction must be in (0, 1]")
         m = (self.mesh or "auto").strip().lower()
         if m not in ("auto", "off", "none", "single"):
             try:
@@ -175,6 +183,8 @@ class Options:
             compile_cache_dir=_env("COMPILE_CACHE_DIR", "", str),
             api_watch_queue_bound=_env("API_WATCH_QUEUE_BOUND", 8192, int),
             api_bookmark_every=_env("API_BOOKMARK_EVERY", 256, int),
+            headroom_high_water_fraction=_env(
+                "HEADROOM_HIGH_WATER_FRACTION", 0.9, float),
         )
         for k, v in overrides.items():
             setattr(opts, k, v)

@@ -415,6 +415,9 @@ class SolverPool(Solver):
             "solve_deadline_s": self.solve_deadline,
             "health_deadline_s": self.health_deadline,
         }
+        # the total rides the SAME read the headroom registry probes —
+        # one source of truth, never a second hand-summed code path
+        out["outstanding_total"] = self.headroom_probe()["depth"]
         for ep in self.endpoints:
             pre = f"ep{ep.index}"
             out[f"{pre}_address"] = ep.address
@@ -425,6 +428,16 @@ class SolverPool(Solver):
             out[f"{pre}_breaker_opens"] = ep.breaker.opens
             out[f"{pre}_mesh_devices"] = ep.mesh_devices
         return out
+
+    def headroom_probe(self) -> Dict[str, float]:
+        """In-flight solve RPCs across the pool (introspect/headroom.py).
+        Unbounded in code — the forecast watches the fill rate: a rate
+        that outruns the sidecars' drain is the elastic-fleet scale-up
+        signal. drops = failovers (attempts that fell through)."""
+        return {"depth": float(sum(ep.outstanding
+                                   for ep in self.endpoints)),
+                "capacity": 0.0,
+                "drops": float(self.failovers)}
 
     def breaker_states(self) -> Dict[str, str]:
         """address → breaker state (the per-endpoint gauge labels)."""

@@ -433,6 +433,16 @@ class ConsolidationEngine:
                 out["skip_" + code.replace("-", "_")] = float(n)
             return out
 
+    def headroom_probe(self) -> Dict[str, float]:
+        """Zero-leg probe-cache occupancy (introspect/headroom.py).
+        Unbounded dict in code, but bounded in practice by the candidate
+        frontier — a fill rate that never drains means the invalidation
+        anchors stopped firing. drops = whole-cache invalidations."""
+        with self._lock:
+            inval = self.counters["cache_invalidations"]
+        return {"depth": float(len(self._cache)), "capacity": 0.0,
+                "drops": float(inval)}
+
     def ledger_doc(self) -> Dict[str, Dict]:
         """Per-node skip ledger snapshot (`kpctl explain node` falls back
         here via the audit ring; /debug/explain?node= serves the ring)."""

@@ -515,6 +515,17 @@ class DecisionAuditRing:
             while len(self._node_ledger) > self.NODE_LEDGER_MAX:
                 self._node_ledger.popitem(last=False)
 
+    def headroom_probe(self) -> Dict[str, float]:
+        """Audit-ring occupancy (introspect/headroom.py). ``kind="ring"``
+        — evicting the oldest pass explanation is the retention policy
+        /debug/explain documents; "drops" counts evicted passes."""
+        with self._lock:
+            depth = len(self._ring)
+            return {"depth": float(depth),
+                    "capacity": float(self._ring.maxlen or 0),
+                    "drops": float(max(self.passes_recorded - depth, 0)),
+                    "kind": "ring"}
+
     # ---- lookups ---------------------------------------------------------
 
     def _snapshot(self) -> List[PassExplanation]:

@@ -224,6 +224,15 @@ class ResidentInputCache:
                 "blocks_resident": self.blocks_resident,
                 "bytes_shipped": self.bytes_shipped}
 
+    def headroom_probe(self) -> Dict[str, float]:
+        """Residency occupancy (introspect/headroom.py). ``kind="ring"``
+        in the registry's sense — full-by-design: at capacity, cold keys
+        take the admission bypass (plain uploads, never thrash), so a
+        full cache is a working-set fact, not impending loss."""
+        return {"depth": float(len(self._entries)),
+                "capacity": float(self._max_entries),
+                "kind": "ring"}
+
     def upload(self, key: Tuple, buf: np.ndarray,
                sharding=None, donate: bool = False) -> jnp.ndarray:
         """``donate=True`` routes the delta scatter through the DONATED

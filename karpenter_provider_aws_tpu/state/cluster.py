@@ -147,6 +147,19 @@ class DirtyJournalCoalescer:
             m.merge(self._cluster.dirty_since(m.rev))
         return m
 
+    def headroom_probe(self) -> Dict[str, float]:
+        """Undrained journal backlog (introspect/headroom.py): revisions
+        landed since the pending set's horizon. It exhausts at
+        _JOURNAL_MAX — a backlog older than the ring retains forces the
+        full-rebuild fallback, the latency cliff the forecast exists to
+        see coming. ``fallbacks`` is the pre-existing miss counter."""
+        m = self._merged
+        backlog = self._cluster.state_rev - (m.rev if m is not None
+                                             else self._cluster.state_rev)
+        return {"depth": float(max(backlog, 0)),
+                "capacity": float(_JOURNAL_MAX),
+                "drops": float(self.fallbacks)}
+
 
 class ClusterState:
     def __init__(self, clock: Optional[Clock] = None):
@@ -190,6 +203,15 @@ class ClusterState:
         """Append one journal entry (caller holds the lock)."""
         self.state_rev += 1
         self._journal.append((self.state_rev, kind, name))
+
+    def headroom_probe(self) -> Dict[str, float]:
+        """The dirty-journal ring itself (introspect/headroom.py).
+        ``kind="ring"``: sitting full is its retention policy, not data
+        loss — readers that fall off the tail get the full-rebuild
+        answer, which the coalescer probe's queue-kind row forecasts."""
+        return {"depth": float(len(self._journal)),
+                "capacity": float(_JOURNAL_MAX),
+                "kind": "ring"}
 
     def dirty_since(self, since: int) -> DirtySet:
         """What changed in (``since``, ``state_rev``]. ``full=True`` when

@@ -52,7 +52,7 @@ import grpc
 
 from ..apis import serde
 from ..solver.taxonomy import SNAPSHOT_VERSION_MISMATCH, STALE_ANCHOR, reason
-from .cluster import ClusterState, DirtyJournalCoalescer
+from .cluster import _JOURNAL_MAX, ClusterState, DirtyJournalCoalescer
 
 # bump when the snapshot/delta document shape changes incompatibly: a
 # standby refuses (and counts) any document carrying a different version
@@ -112,6 +112,18 @@ class ReplicationSource:
         with self._lock:
             if self._last_rev >= 0:
                 self._coalescer.tick(self._last_rev)
+
+    def headroom_probe(self) -> Dict[str, float]:
+        """Replication window (introspect/headroom.py): revisions the
+        standby has not acknowledged yet. Exhausting the journal window
+        forces a ``full: true`` delta → standby re-snapshot — counted by
+        the pre-existing ``full_answers``."""
+        with self._lock:
+            last = self._last_rev
+        window = (self._cluster.state_rev - last) if last >= 0 else 0
+        return {"depth": float(max(window, 0)),
+                "capacity": float(_JOURNAL_MAX),
+                "drops": float(self.full_answers)}
 
     def snapshot_doc(self) -> Dict:
         """The whole mirror under ONE lock hold, anchored at the revision

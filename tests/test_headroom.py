@@ -1,0 +1,402 @@
+"""Saturation observatory tests (docs/reference/headroom.md).
+
+FakeClock-driven forecaster math (EWMA fill/drain convergence, the
+linear-fill time-to-exhaustion check, drain-beats-fill = infinite
+headroom), probe-error isolation, drop-counter parity, the monotonic
+high-water pin (registry AND the apiserver's watch_max_depth), the
+once-per-episode high-water capture, ring-kind exclusion from ranking
+and capture, and the operator wiring (>= 12 probes, the `headroom`
+provider, /debug/headroom, the registry-read folds).
+"""
+
+import json
+
+import pytest
+
+from karpenter_provider_aws_tpu import introspect
+from karpenter_provider_aws_tpu.cloud import FakeCloud
+from karpenter_provider_aws_tpu.introspect.headroom import (
+    DEFAULT_HIGH_WATER_FRACTION, HeadroomRegistry)
+from karpenter_provider_aws_tpu.kube.apiserver import FakeAPIServer
+from karpenter_provider_aws_tpu.lattice import build_catalog, build_lattice
+from karpenter_provider_aws_tpu.operator import Operator, Options
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+
+class ScriptedQueue:
+    """A probe whose depth/drops follow a script the test controls."""
+
+    def __init__(self, capacity=1000.0, kind="queue"):
+        self.depth = 0.0
+        self.capacity = capacity
+        self.drops = 0.0
+        self.kind = kind
+
+    def probe(self):
+        return {"depth": self.depth, "capacity": self.capacity,
+                "drops": self.drops, "kind": self.kind}
+
+
+class CaptureSpy:
+    def __init__(self):
+        self.calls = []
+
+    def capture(self, reason, **evidence):
+        self.calls.append((reason, evidence))
+
+
+def registry(clock=None, **kw):
+    return HeadroomRegistry(clock or FakeClock(), **kw)
+
+
+class TestForecasterMath:
+    def test_ewma_fill_rate_converges_on_linear_fill(self):
+        clock = FakeClock()
+        hr = registry(clock)
+        q = ScriptedQueue(capacity=100_000.0)
+        hr.register_probe("q", q.probe)
+        # 5 items/s for 300 s >> tau=30 s: EWMA must converge to 5
+        for _ in range(300):
+            hr.observe()
+            q.depth += 5.0
+            clock.step(1.0)
+        row = hr.read("q")
+        assert row["fill_rate"] == pytest.approx(5.0, rel=0.01)
+        assert row["drain_rate"] == pytest.approx(0.0, abs=1e-9)
+
+    def test_tte_matches_linear_fill(self):
+        clock = FakeClock()
+        hr = registry(clock)
+        q = ScriptedQueue(capacity=10_000.0)
+        hr.register_probe("q", q.probe)
+        for _ in range(300):
+            hr.observe()
+            q.depth += 4.0
+            clock.step(1.0)
+        row = hr.read("q")
+        expect = (10_000.0 - row["depth"]) / 4.0
+        assert row["seconds_to_exhaustion"] == pytest.approx(expect,
+                                                             rel=0.02)
+        st = hr.stats()
+        assert st["first_to_break"] == "q"
+        assert st["min_tte_seconds"] == pytest.approx(expect, rel=0.02)
+
+    def test_drain_faster_than_fill_is_infinite_headroom(self):
+        clock = FakeClock()
+        hr = registry(clock)
+        q = ScriptedQueue(capacity=100.0)
+        q.depth = 80.0
+        hr.register_probe("q", q.probe)
+        for _ in range(120):
+            hr.observe()
+            q.depth = max(q.depth - 0.5, 0.0)   # draining
+            clock.step(1.0)
+        row = hr.read("q")
+        assert row["seconds_to_exhaustion"] is None
+        assert row["drain_rate"] > 0.0
+        st = hr.stats()
+        assert st["min_tte_seconds"] == -1.0 and st["first_to_break"] == ""
+
+    def test_flat_queue_never_forecasts(self):
+        clock = FakeClock()
+        hr = registry(clock)
+        q = ScriptedQueue(capacity=100.0)
+        q.depth = 50.0
+        hr.register_probe("q", q.probe)
+        for _ in range(10):
+            hr.observe()
+            clock.step(1.0)
+        assert hr.read("q")["seconds_to_exhaustion"] is None
+
+    def test_unbounded_resource_never_forecasts(self):
+        clock = FakeClock()
+        hr = registry(clock)
+        q = ScriptedQueue(capacity=0.0)
+        hr.register_probe("q", q.probe)
+        for _ in range(60):
+            hr.observe()
+            q.depth += 10.0
+            clock.step(1.0)
+        assert hr.read("q")["seconds_to_exhaustion"] is None
+
+    def test_drops_count_as_fill_pressure(self):
+        """A queue pinned at its bound while dropping is still FILLING
+        at the drop rate — the depth delta alone would read 0."""
+        clock = FakeClock()
+        hr = registry(clock)
+        q = ScriptedQueue(capacity=100.0)
+        q.depth = 100.0
+        hr.register_probe("q", q.probe)
+        for _ in range(300):
+            hr.observe()
+            q.drops += 3.0          # depth stays pinned at the bound
+            clock.step(1.0)
+        hr.observe()
+        row = hr.read("q")
+        assert row["fill_rate"] == pytest.approx(3.0, rel=0.01)
+        assert row["drops"] == q.drops   # drop-counter parity: the row
+        # re-reports the structure's own cumulative counter verbatim
+
+    def test_zero_dt_observation_skips_rate_update(self):
+        clock = FakeClock()
+        hr = registry(clock)
+        q = ScriptedQueue()
+        hr.register_probe("q", q.probe)
+        hr.observe()
+        q.depth += 50.0
+        hr.observe()               # same clock reading: no dt
+        assert hr.read("q")["fill_rate"] == 0.0
+
+    def test_ranking_tte_then_occupancy_then_name(self):
+        clock = FakeClock()
+        hr = registry(clock)
+        soon = ScriptedQueue(capacity=100.0)
+        late = ScriptedQueue(capacity=100_000.0)
+        idle_b = ScriptedQueue(capacity=100.0)
+        idle_a = ScriptedQueue(capacity=100.0)
+        idle_b.depth = 60.0
+        hr.register_probe("soon", soon.probe)
+        hr.register_probe("late", late.probe)
+        hr.register_probe("idle_b", idle_b.probe)
+        hr.register_probe("idle_a", idle_a.probe)
+        for _ in range(120):
+            hr.observe()
+            soon.depth = min(soon.depth + 0.5, 95.0)
+            late.depth += 0.5
+            clock.step(1.0)
+        # keep 'soon' filling on the final reads (it plateaus at 95)
+        order = [r["resource"] for r in hr.table()]
+        assert order[0] == "soon" or order[0] == "late"
+        # finite-TTE rows lead; among no-forecast rows occupancy ranks
+        assert order.index("idle_b") < order.index("idle_a")
+
+
+class TestProbeIsolation:
+    def test_broken_probe_marks_its_row_only(self):
+        clock = FakeClock()
+        hr = registry(clock)
+        ok = ScriptedQueue(capacity=10.0)
+        hr.register_probe("ok", ok.probe)
+        hr.register_probe("bad", lambda: 1 / 0)
+        for _ in range(3):
+            hr.observe()
+            clock.step(1.0)
+        rows = {r["resource"]: r for r in hr.table()}
+        assert "error" in rows["bad"] and "ZeroDivisionError" in \
+            rows["bad"]["error"]
+        assert "error" not in rows["ok"]
+        # one error TRANSITION = one count, not one per sweep
+        assert hr.stats()["probe_errors"] == 1.0
+
+    def test_probe_recovery_clears_error(self):
+        clock = FakeClock()
+        hr = registry(clock)
+        state = {"boom": True}
+
+        def flaky():
+            if state["boom"]:
+                raise RuntimeError("x")
+            return {"depth": 1.0, "capacity": 10.0}
+
+        hr.register_probe("flaky", flaky.__call__)
+        hr.observe()
+        clock.step(1.0)
+        state["boom"] = False
+        hr.observe()
+        assert "error" not in hr.read("flaky")
+
+    def test_missing_depth_is_an_error_not_a_crash(self):
+        hr = registry()
+        hr.register_probe("bad", lambda: {"capacity": 5.0})
+        hr.observe()
+        assert "error" in hr.read("bad")
+
+    def test_read_unknown_resource_is_empty(self):
+        assert registry().read("nope") == {}
+
+    def test_register_replaces_by_name(self):
+        hr = registry()
+        hr.register_probe("q", lambda: {"depth": 1.0})
+        hr.register_probe("q", lambda: {"depth": 7.0})
+        hr.observe()
+        assert hr.read("q")["depth"] == 7.0
+        hr.unregister_probe("q")
+        assert hr.names() == []
+
+
+class TestMonotonicHighWater:
+    def test_registry_high_water_never_resets(self):
+        clock = FakeClock()
+        hr = registry(clock)
+        q = ScriptedQueue(capacity=100.0)
+        hr.register_probe("q", q.probe)
+        for depth in (10.0, 90.0, 5.0, 40.0):
+            q.depth = depth
+            hr.observe()
+            clock.step(1.0)
+        assert hr.read("q")["highwater"] == 90.0
+
+    def test_probe_supplied_high_water_folds_in(self):
+        hr = registry()
+        hr.register_probe("q", lambda: {"depth": 1.0, "capacity": 10.0,
+                                        "highwater": 8.0})
+        hr.observe()
+        assert hr.read("q")["highwater"] == 8.0
+
+    def test_apiserver_watch_high_water_survives_dropped_watcher(self):
+        """The satellite-6 pin: FakeAPIServer.stats()['watch_max_depth']
+        was live-watchers-only and RESET when the deep watcher went away
+        — it must be monotonic per process."""
+        clock = FakeClock()
+        api = FakeAPIServer(clock=clock, watch_queue_bound=64)
+        w = api.watch("pods")
+        for i in range(8):
+            api.create("pods", {"name": f"p-{i}"})
+        assert api.stats()["watch_max_depth"] >= 8.0
+        api.stop_watch(w)
+        st = api.stats()
+        assert st["watch_max_depth"] >= 8.0, \
+            "high water must not reset when the deep watcher is dropped"
+        assert st["watch_deepest"] == 0.0   # the LIVE readout may drop
+        probe = api.headroom_probe()
+        assert probe["highwater"] >= 8.0
+
+
+class TestEpisodeCapture:
+    def test_capture_fires_once_per_episode_and_rearms(self):
+        clock = FakeClock()
+        hr = registry(clock)
+        spy = CaptureSpy()
+        hr.attach_capture(spy)
+        q = ScriptedQueue(capacity=100.0)
+        hr.register_probe("q", q.probe)
+
+        def tick(depth):
+            q.depth = depth
+            hr.observe()
+            clock.step(1.0)
+
+        tick(50.0)
+        tick(95.0)        # crosses 0.9: fire
+        tick(99.0)        # still above: no second fire
+        tick(100.0)
+        assert len(spy.calls) == 1
+        reason, evidence = spy.calls[0]
+        assert reason == "headroom-q"
+        assert evidence["resource"] == "q"
+        assert evidence["occupancy"] >= DEFAULT_HIGH_WATER_FRACTION
+        tick(10.0)        # recovery re-arms
+        tick(95.0)        # second episode
+        assert len(spy.calls) == 2
+        assert hr.read("q")["episodes"] == 2
+
+    def test_ring_kind_never_fires_or_ranks(self):
+        clock = FakeClock()
+        hr = registry(clock)
+        spy = CaptureSpy()
+        hr.attach_capture(spy)
+        ring = ScriptedQueue(capacity=10.0, kind="ring")
+        ring.depth = 10.0   # full by design
+        hr.register_probe("ring", ring.probe)
+        for _ in range(60):
+            hr.observe()
+            clock.step(1.0)
+        assert spy.calls == []
+        row = hr.read("ring")
+        assert row["seconds_to_exhaustion"] is None
+        assert row["burn"] == 0.0
+        assert hr.stats()["saturated"] == 0.0
+
+    def test_capture_failure_does_not_fail_the_sweep(self):
+        clock = FakeClock()
+        hr = registry(clock)
+
+        class Broken:
+            def capture(self, reason, **kw):
+                raise RuntimeError("disk full")
+
+        hr.attach_capture(Broken())
+        q = ScriptedQueue(capacity=10.0)
+        q.depth = 10.0
+        hr.register_probe("q", q.probe)
+        hr.observe()
+        clock.step(1.0)
+        hr.observe()
+        assert hr.read("q")["episodes"] == 1
+
+
+_FAMILIES = ("m5", "c5")
+
+
+@pytest.fixture(scope="module")
+def lattice():
+    return build_lattice([s for s in build_catalog()
+                          if s.family in _FAMILIES])
+
+
+@pytest.fixture()
+def op(lattice):
+    clock = FakeClock()
+    return Operator(options=Options(registration_delay=1.0),
+                    lattice=lattice, cloud=FakeCloud(clock), clock=clock)
+
+
+class TestOperatorWiring:
+    def test_at_least_twelve_probes_in_direct_mode(self, op):
+        hr = introspect.headroom_registry()
+        assert hr is op.headroom
+        assert len(hr.names()) >= 12
+        for expect in ("journal_ring", "journal_coalescer", "events_ring",
+                       "decision_audit_ring", "slo_rings", "burn_captures",
+                       "sampler_rings", "cloud_launch_batcher",
+                       "cloud_terminate_batcher", "solver_resident_cache",
+                       "consolidation_probe_cache", "profiler_stacks"):
+            assert expect in hr.names(), expect
+
+    def test_headroom_provider_and_debug_doc(self, op):
+        op.emit_gauges()
+        snap = introspect.registry().collect()
+        assert "headroom" in snap
+        assert snap["headroom"]["resources"] >= 12.0
+        body, ctype = introspect.debug_doc("/debug/headroom", {})
+        assert ctype == "application/json"
+        doc = json.loads(body)
+        assert doc["enabled"] is True
+        assert len(doc["resources"]) >= 12
+        for row in doc["resources"]:
+            assert {"resource", "kind", "depth", "capacity", "highwater",
+                    "drops", "occupancy"} <= set(row)
+
+    def test_gauge_families_emitted_per_resource(self, op):
+        op.emit_gauges()
+        text = op.metrics.render()
+        assert 'karpenter_headroom_depth{resource="journal_ring"}' in text
+        assert 'karpenter_headroom_capacity{resource="events_ring"}' in text
+        assert "karpenter_headroom_seconds_to_exhaustion" in text
+
+    def test_interruption_gauge_folds_from_registry(self, lattice):
+        clock = FakeClock()
+        op = Operator(options=Options(registration_delay=1.0,
+                                      interruption_queue="q"),
+                      lattice=lattice, cloud=FakeCloud(clock), clock=clock)
+        assert "interruption_queue" in op.headroom.names()
+        op.emit_gauges()
+        text = op.metrics.render()
+        assert "karpenter_interruption_queue_depth 0" in text
+
+    def test_high_water_fraction_option_reaches_registry(self, lattice):
+        clock = FakeClock()
+        op = Operator(options=Options(registration_delay=1.0,
+                                      headroom_high_water_fraction=0.5),
+                      lattice=lattice, cloud=FakeCloud(clock), clock=clock)
+        assert op.headroom.high_water_fraction == 0.5
+
+    def test_debug_doc_without_registry_is_error_shaped(self):
+        saved = introspect.headroom_registry()
+        try:
+            introspect.set_headroom(None)
+            body, _ = introspect.debug_doc("/debug/headroom", {})
+            doc = json.loads(body)
+            assert doc["enabled"] is False and "message" in doc
+        finally:
+            introspect.set_headroom(saved)

@@ -20,9 +20,10 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO / "tools"))
 
 from lint import baseline as baseline_mod                    # noqa: E402
-from lint.rules import (ClockRule, DeterminismRule,          # noqa: E402
-                        FrozenEnvelopeRule, LockRule, MetricsRule,
-                        PACKAGE, ReasonRule, Violation, default_rules)
+from lint.rules import (BoundedResourceRule, ClockRule,      # noqa: E402
+                        DeterminismRule, FrozenEnvelopeRule, LockRule,
+                        MetricsRule, PACKAGE, ReasonRule, Violation,
+                        default_rules)
 from lint.run import run_checks                              # noqa: E402
 import lint.run as lint_run                                  # noqa: E402
 
@@ -366,6 +367,97 @@ class TestReasonRule:
         assert vs == [], [str(v) for v in vs]
 
 
+# ---- rule 7: bounded-resource discipline -----------------------------------
+
+class TestBoundedResourceRule:
+    def test_unprobed_bounded_deque_flagged(self):
+        src = ("import collections\n"
+               "class Ring:\n"
+               "    def __init__(self):\n"
+               "        self._ring = collections.deque(maxlen=256)\n")
+        vs = check(BoundedResourceRule(), src)
+        assert len(vs) == 1
+        assert vs[0].rule == "bounded-resource"
+        assert vs[0].call == "deque(maxlen)"
+        assert vs[0].context == "Ring.__init__"
+        assert "headroom probe" in vs[0].message
+
+    def test_alias_and_positional_maxlen_cannot_dodge(self):
+        src = ("from collections import deque as dq\n"
+               "def f():\n"
+               "    a = dq(maxlen=8)\n"
+               "    b = dq([], 8)\n")
+        vs = check(BoundedResourceRule(), src)
+        assert len(vs) == 2
+
+    def test_module_with_headroom_probe_clean(self):
+        src = ("import collections\n"
+               "class Ring:\n"
+               "    def __init__(self):\n"
+               "        self._ring = collections.deque(maxlen=256)\n"
+               "    def headroom_probe(self):\n"
+               "        return {'depth': float(len(self._ring)),\n"
+               "                'capacity': 256.0, 'kind': 'ring'}\n")
+        assert check(BoundedResourceRule(), src) == []
+
+    def test_module_calling_register_probe_clean(self):
+        src = ("import collections\n"
+               "def wire(hr):\n"
+               "    ring = collections.deque(maxlen=256)\n"
+               "    hr.register_probe('ring', lambda: {\n"
+               "        'depth': float(len(ring)), 'capacity': 256.0})\n")
+        assert check(BoundedResourceRule(), src) == []
+
+    def test_unbounded_and_none_maxlen_clean(self):
+        src = ("import collections\n"
+               "def f():\n"
+               "    a = collections.deque()\n"
+               "    b = collections.deque(maxlen=None)\n"
+               "    c = collections.deque([1, 2])\n")
+        assert check(BoundedResourceRule(), src) == []
+
+    def test_scoping_is_package_only(self):
+        rule = BoundedResourceRule()
+        assert rule.applies_to(f"{PACKAGE}/state/cluster.py")
+        assert not rule.applies_to("tools/soak.py")
+        assert not rule.applies_to("tests/test_headroom.py")
+
+    def test_repo_bounded_buffers_all_probed_or_baselined(self):
+        """Every deque(maxlen) module in the package either exposes a
+        headroom probe or carries a reasoned baseline entry — the
+        standing lockstep gate, mirroring the reason-code one."""
+        rule = [r for r in default_rules(REPO)
+                if r.name == "bounded-resource"][0]
+        vs = []
+        for py in (REPO / PACKAGE).rglob("*.py"):
+            rel = py.relative_to(REPO).as_posix()
+            if rule.applies_to(rel):
+                src = py.read_text()
+                vs += rule.check_module(ast.parse(src), rel, src)
+        entries = [e for e in baseline_mod.load(
+            REPO / "tools" / "lint" / "baseline.json")
+            if e["rule"] == "bounded-resource"]
+        un, used, stale = baseline_mod.apply(vs, entries)
+        assert un == [], [str(v) for v in un]
+        assert stale == [], "stale bounded-resource baseline entries"
+        for e in entries:
+            assert str(e.get("reason", "")).strip(), e
+
+    def test_instrumented_modules_have_no_violations(self):
+        """The structures the saturation observatory instruments lint
+        clean WITHOUT baseline help — their probes are the exemption."""
+        rule = BoundedResourceRule()
+        for rel in (f"{PACKAGE}/state/cluster.py",
+                    f"{PACKAGE}/solver/explain.py",
+                    f"{PACKAGE}/introspect/sampler.py",
+                    f"{PACKAGE}/introspect/slo.py",
+                    f"{PACKAGE}/introspect/profiler.py",
+                    f"{PACKAGE}/kube/apiserver.py",
+                    f"{PACKAGE}/events.py"):
+            src = (REPO / rel).read_text()
+            assert rule.check_module(ast.parse(src), rel, src) == [], rel
+
+
 # ---- baseline round-trip ---------------------------------------------------
 
 class TestBaseline:
@@ -458,10 +550,13 @@ class TestRepoGate:
          SCRATCH_VIOLATIONS["metrics-discipline"]),
         ("reason-code", "scratch.py",
          "def f(m):\n    m.inc(1, code='bogus-code')\n"),
+        ("bounded-resource", "scratch.py",
+         "import collections\ndef f():\n"
+         "    return collections.deque(maxlen=5)\n"),
     ])
     def test_scratch_violation_fails_the_gate(self, tmp_path, rule, rel,
                                               src):
-        """Re-introducing any of the six rule violations in a scratch
+        """Re-introducing any of the seven rule violations in a scratch
         file makes run.py exit non-zero (the acceptance pin)."""
         pkg = tmp_path / PACKAGE
         (pkg / Path(rel).parent).mkdir(parents=True, exist_ok=True)

@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Headroom-registry overhead bench (docs/reference/headroom.md).
+
+Runs the SAME operator churn loop twice — once with the saturation
+observatory's full probe set wired (the production default: every
+bounded queue/ring registered, observed and rendered into the
+karpenter_headroom_* families on each gauge pass) and once with every
+probe unregistered — and records the end-to-end per-pass p50 delta.
+The timed window is provision_once + emit_gauges, because the gauge
+pass is where the registry actually runs (Operator.emit_gauges calls
+observe() and re-renders the six families). Acceptance bar: < 1% e2e
+p50 regression, the same bound every observability layer before it
+carried (PROF_r08, EXPLAIN_r11).
+
+    python tools/bench_headroom.py [--pods 4000] [--passes 30] \
+           [--out HEADROOM_r20_overhead.json]
+
+Both runs share one process and warm JAX compile caches; the measured
+window starts AFTER a warmup pass, and the probes-ON run goes FIRST so
+any residual warm-up cost lands on the observatory's side (overhead
+reads as an upper bound, the PROF_r08 discipline).
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def run_loop(probes: bool, n_pods: int, n_passes: int) -> dict:
+    from karpenter_provider_aws_tpu.apis import Pod
+    from karpenter_provider_aws_tpu.cloud import FakeCloud
+    from karpenter_provider_aws_tpu.lattice import build_lattice
+    from karpenter_provider_aws_tpu.operator import Operator, Options
+    from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    op = Operator(options=Options(registration_delay=0.5),
+                  lattice=build_lattice(), cloud=FakeCloud(clock),
+                  clock=clock)
+    n_probes = len(op.headroom.names())
+    if not probes:
+        # the OFF side: an empty registry — observe()/table() sweep
+        # nothing, the gauge families render zero rows
+        for name in list(op.headroom.names()):
+            op.headroom.unregister_probe(name)
+    serial = 0
+    for _ in range(n_pods):
+        serial += 1
+        op.cluster.add_pod(Pod(name=f"b{serial}",
+                               requests={"cpu": "250m", "memory": "512Mi"}))
+    # warmup: the first pass pays compile + cold caches on both sides
+    op.provisioner.provision_once()
+    op.emit_gauges()
+    clock.step(1.0)
+    times = []
+    for _ in range(n_passes):
+        # ~1% churn per pass: the steady-state shape a gauge-cadence
+        # probe sweep actually rides in production
+        for _ in range(max(n_pods // 100, 1)):
+            serial += 1
+            op.cluster.add_pod(Pod(name=f"b{serial}",
+                                   requests={"cpu": "250m",
+                                             "memory": "512Mi"}))
+        gc.collect()
+        t0 = time.perf_counter()
+        op.provisioner.provision_once()
+        op.emit_gauges()
+        times.append(time.perf_counter() - t0)
+        clock.step(1.0)
+    times.sort()
+    return {
+        "probes": n_probes if probes else 0,
+        "passes": n_passes,
+        "e2e_p50_ms": round(times[len(times) // 2] * 1000.0, 3),
+        "e2e_p90_ms": round(times[int(len(times) * 0.9)] * 1000.0, 3),
+        "resources": len(op.headroom.table()),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pods", type=int, default=4000)
+    ap.add_argument("--passes", type=int, default=30)
+    ap.add_argument("--out", default="HEADROOM_r20_overhead.json")
+    args = ap.parse_args()
+
+    on = run_loop(True, args.pods, args.passes)
+    off = run_loop(False, args.pods, args.passes)
+    delta_pct = (100.0 * (on["e2e_p50_ms"] - off["e2e_p50_ms"])
+                 / max(off["e2e_p50_ms"], 1e-9))
+    doc = {
+        "bench": "headroom_registry_overhead",
+        "pods": args.pods,
+        "probes_on": on, "probes_off": off,
+        "e2e_p50_delta_pct": round(delta_pct, 3),
+        "bound_pct": 1.0,
+        "within_bound": delta_pct < 1.0,
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"headroom overhead: on={on['e2e_p50_ms']}ms "
+          f"({on['probes']} probes) off={off['e2e_p50_ms']}ms "
+          f"delta={delta_pct:+.2f}% (bound <1%) -> {args.out}")
+    return 0 if doc["within_bound"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -100,8 +100,16 @@
 #               served from the zero-leg probe cache, and the
 #               CONSOLIDATION kpctl row + `consolidation` provider +
 #               `kpctl explain node` live over HTTP
-#  14. tier-1 — the full non-slow test suite on the CPU backend
-#  15. bench  — `bench.py --smoke`: one fast config through the real
+#  14. headroom— saturation-observatory gate (tools/smoke_headroom.py):
+#               an API-mode operator with a deliberately tiny watch
+#               queue bound and an idle watcher — the forecaster must
+#               rank the tightened queue first-to-break over live HTTP
+#               BEFORE its first overflow, the high-water capture must
+#               fire exactly once per episode, the probe must reuse the
+#               apiserver's own drop counter after the overflow, and
+#               `kpctl headroom` must render (and degrade error-shaped)
+#  15. tier-1 — the full non-slow test suite on the CPU backend
+#  16. bench  — `bench.py --smoke`: one fast config through the real
 #               harness, so a broken solve path can never ride in on a
 #               green unit-test run
 
@@ -113,7 +121,7 @@ PY=${PYTHON:-python}
 FAST=0
 [ "${1:-}" = "--fast" ] && FAST=1
 
-echo "=== ci [1/15] generated-artifact drift ==="
+echo "=== ci [1/16] generated-artifact drift ==="
 $PY tools/gen_crds.py --check
 tmp=$(mktemp -d)
 trap 'rm -rf "$tmp"' EXIT
@@ -128,50 +136,53 @@ done
 [ "$stale" = 0 ] || exit 1
 echo "drift: clean"
 
-echo "=== ci [2/15] graftlint (project-invariant static analysis) ==="
+echo "=== ci [2/16] graftlint (project-invariant static analysis) ==="
 $PY tools/lint/run.py --check
 
-echo "=== ci [3/15] introspection smoke + metrics lint ==="
+echo "=== ci [3/16] introspection smoke + metrics lint ==="
 $PY tools/smoke_introspect.py
 
-echo "=== ci [4/15] steady-state delta churn smoke ==="
+echo "=== ci [4/16] steady-state delta churn smoke ==="
 $PY tools/smoke_delta.py
 
-echo "=== ci [5/15] sharded mesh smoke ==="
+echo "=== ci [5/16] sharded mesh smoke ==="
 $PY tools/smoke_sharded.py
 
-echo "=== ci [6/15] device-resident microloop smoke ==="
+echo "=== ci [6/16] device-resident microloop smoke ==="
 $PY tools/smoke_microloop.py
 
-echo "=== ci [7/15] continuous-profiling smoke ==="
+echo "=== ci [7/16] continuous-profiling smoke ==="
 $PY tools/smoke_profile.py
 
-echo "=== ci [8/15] write-path smoke ==="
+echo "=== ci [8/16] write-path smoke ==="
 $PY tools/smoke_writepath.py
 
-echo "=== ci [9/15] adversarial-weather smoke ==="
+echo "=== ci [9/16] adversarial-weather smoke ==="
 $PY tools/smoke_weather.py
 
-echo "=== ci [10/15] solver-pool failover smoke ==="
+echo "=== ci [10/16] solver-pool failover smoke ==="
 $PY tools/smoke_pool.py
 
-echo "=== ci [11/15] decision-explainability smoke ==="
+echo "=== ci [11/16] decision-explainability smoke ==="
 $PY tools/smoke_explain.py
 
-echo "=== ci [12/15] zero-downtime handoff smoke ==="
+echo "=== ci [12/16] zero-downtime handoff smoke ==="
 $PY tools/smoke_handoff.py
 
-echo "=== ci [13/15] vmapped consolidation smoke ==="
+echo "=== ci [13/16] vmapped consolidation smoke ==="
 $PY tools/smoke_consolidation.py
 
-echo "=== ci [14/15] tier-1 tests ==="
+echo "=== ci [14/16] saturation-headroom smoke ==="
+$PY tools/smoke_headroom.py
+
+echo "=== ci [15/16] tier-1 tests ==="
 $PY -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider
 
 if [ "$FAST" = 1 ]; then
-    echo "=== ci [15/15] bench smoke: SKIPPED (--fast) ==="
+    echo "=== ci [16/16] bench smoke: SKIPPED (--fast) ==="
 else
-    echo "=== ci [15/15] bench smoke ==="
+    echo "=== ci [16/16] bench smoke ==="
     $PY bench.py --smoke
 fi
 

@@ -653,13 +653,26 @@ def _render_top(doc, server: str):
                                             for k, v in top_verbs)
                                  or "(no writes yet)"))
     if "watch_hub" in p:
+        # deepest-queue + drop readouts fold from the headroom registry's
+        # reading of the same probe when the observatory is live (one
+        # source of truth); the hub's own stats remain the fallback
+        hrp = p.get("headroom", {})
+        deepest = (hrp["api_watch_queues_depth"]
+                   if isinstance(hrp.get("api_watch_queues_depth"),
+                                 (int, float))
+                   else g("watch_hub", "watch_deepest"))
+        wdrops = (hrp["api_watch_queues_drops"]
+                  if isinstance(hrp.get("api_watch_queues_drops"),
+                                (int, float))
+                  else g("watch_hub", "watch_drops"))
         lines.append(
             f"WATCHES   {g('watch_hub', 'watchers'):g} watchers   "
             f"queue {g('watch_hub', 'watch_queue_depth'):g} "
-            f"(max {g('watch_hub', 'watch_max_depth'):g})   "
+            f"(deepest {deepest:g}, "
+            f"hw {g('watch_hub', 'watch_max_depth'):g})   "
             f"delivered {g('watch_hub', 'events_emitted'):g}   "
             f"bulk {g('watch_hub', 'bulk_ops'):g}   "
-            f"drops {g('watch_hub', 'watch_drops'):g}")
+            f"drops {wdrops:g}")
     lines.append(
         f"EVENTS    {g('events', 'published'):g} published "
         f"({g('events', 'warnings'):g} warnings)")
@@ -772,6 +785,21 @@ def _render_top(doc, server: str):
         f"cost burn {slo.get('cost_burn', 0):.2f} "
         f"(ratio {slo.get('cost_ratio_p50', 0):.4f})   "
         f"captures {p.get('burn_captures', {}).get('retained', 0):g}")
+    # the saturation observatory (docs/reference/headroom.md): resource
+    # count, the first-to-break forecast, and saturation-episode totals.
+    # Numeric guard: an errored provider must drop the cell, not the view
+    hrs = p.get("headroom", {})
+    if isinstance(hrs.get("resources"), (int, float)):
+        tte = hrs.get("min_tte_seconds", -1.0)
+        first = hrs.get("first_to_break") or ""
+        fcast = (f"first-to-break {first} in {tte:g}s"
+                 if first and isinstance(tte, (int, float)) and tte >= 0
+                 else "no exhaustion forecast")
+        lines.append(
+            f"HEADROOM  {hrs.get('resources', 0):g} resources   {fcast}   "
+            f"saturated {hrs.get('saturated', 0):g}   "
+            f"episodes {hrs.get('episodes', 0):g}   "
+            f"probe-errors {hrs.get('probe_errors', 0):g}")
     fr = p.get("flight_recorder", {})
     if fr.get("enabled", True) is not False:
         lines.append(
@@ -994,6 +1022,71 @@ def cmd_lockorder(c: Client, args) -> int:
             for fr in m.get("stack", []):
                 print(f"      {fr}")
     return 1 if cycles else 0
+
+
+def _fmt_tte(tte) -> str:
+    """Seconds-to-exhaustion cell: None = nothing forecast to break."""
+    if not isinstance(tte, (int, float)):
+        return "-"
+    if tte >= 3600:
+        return f"{tte / 3600:.1f}h"
+    if tte >= 60:
+        return f"{tte / 60:.1f}m"
+    return f"{tte:.1f}s"
+
+
+def _render_headroom(doc: dict) -> int:
+    """The ranked first-to-break table from one /debug/headroom doc."""
+    if not isinstance(doc, dict) or doc.get("enabled") is False \
+            or "resources" not in doc:
+        # tolerate the provider-less shape (operator still constructing)
+        # and the registry's {"error"} shape like the lockorder command
+        msg = (doc.get("message") or doc.get("error") or "bad response") \
+            if isinstance(doc, dict) else "bad response"
+        print(f"headroom: unavailable ({msg})")
+        return 1
+    rows = [["RESOURCE", "KIND", "DEPTH", "CAP", "OCC%", "HIGHWATER",
+             "DROPS", "FILL/s", "EXHAUSTION"]]
+    for r in doc["resources"]:
+        if r.get("error"):
+            rows.append([r.get("resource", "?"), "error", "-", "-", "-",
+                         "-", "-", "-", str(r["error"])[:40]])
+            continue
+        cap = r.get("capacity", 0)
+        rows.append([
+            r.get("resource", "?"), r.get("kind", "queue"),
+            f"{r.get('depth', 0):g}",
+            f"{cap:g}" if cap else "inf",
+            f"{100 * r.get('occupancy', 0):.0f}" if cap else "-",
+            f"{r.get('highwater', 0):g}",
+            f"{r.get('drops', 0):g}",
+            f"{r.get('fill_rate', 0):.3g}",
+            _fmt_tte(r.get("seconds_to_exhaustion")),
+        ])
+    print(f"headroom: {len(doc['resources'])} resources   "
+          f"high-water fraction {doc.get('high_water_fraction', 0.9):g}   "
+          f"probe errors {doc.get('probe_errors', 0):g}")
+    _print_rows(rows)
+    return 0
+
+
+def cmd_headroom(c: Client, args) -> int:
+    """The saturation observatory (docs/reference/headroom.md): every
+    registered bounded resource's occupancy, monotonic high water,
+    drop count, EWMA fill rate, and time-to-exhaustion forecast,
+    ranked first-to-break. ``--watch`` refreshes in place."""
+    import time
+    while True:
+        try:
+            doc = c.request("GET", "/debug/headroom")
+            if not args.watch:
+                return _render_headroom(doc)
+            sys.stdout.write("\x1b[2J\x1b[H")
+            _render_headroom(doc)
+            sys.stdout.flush()
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
 
 
 def _render_waterfall(g: dict, indent: str = "  ") -> None:
@@ -1235,6 +1328,17 @@ def main(argv=None) -> int:
                     help="also print each edge's first-witness stack "
                          "(cycle edges always print theirs)")
     lo.set_defaults(fn=cmd_lockorder)
+
+    hrp = sub.add_parser(
+        "headroom", help="ranked first-to-break table of every bounded "
+                         "resource (/debug/headroom; docs/reference/"
+                         "headroom.md) — occupancy, fill rate, "
+                         "time-to-exhaustion forecast")
+    hrp.add_argument("--watch", action="store_true",
+                     help="refresh the table in place until Ctrl-C")
+    hrp.add_argument("--interval", type=float, default=2.0,
+                     help="watch refresh period in seconds (default 2)")
+    hrp.set_defaults(fn=cmd_headroom)
 
     exp = sub.add_parser(
         "explain", help="why was this decision made — per-pod elimination "

@@ -648,8 +648,83 @@ class ReasonRule(Rule):
         return out
 
 
+# ---- rule 7: bounded-resource discipline ----------------------------------
+
+class BoundedResourceRule(Rule):
+    """Every bounded buffer a production module constructs —
+    ``deque(maxlen=...)`` is the repo's ring/queue idiom — must be
+    visible to the saturation observatory (introspect/headroom.py):
+    the module either defines a ``headroom_probe`` method/function
+    (the convention every instrumented structure follows — the operator
+    wires it into the HeadroomRegistry) or calls ``register_probe``
+    directly. A bound without a probe is a silent cliff: the structure
+    fills, drops, and nothing forecast it (docs/reference/headroom.md).
+
+    The check is module-granular by design: a module that exposes ONE
+    probe for several internal rings (slo.py's latency+cost pair) is
+    compliant — the probe contract reports the fullest. A genuinely
+    probe-free bound (a test fake's history buffer) goes to the
+    baseline with a reason, same as every other rule."""
+
+    name = "bounded-resource"
+    _DEQUE = {"collections.deque", "deque"}
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(PACKAGE + "/")
+
+    @staticmethod
+    def _has_probe(tree: ast.AST, mods, names) -> bool:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == "headroom_probe":
+                return True
+            if isinstance(node, ast.Call):
+                d = resolve_call(node.func, mods, names)
+                if d and d.rsplit(".", 1)[-1] == "register_probe":
+                    return True
+        return False
+
+    def check_module(self, tree, relpath, source=""):
+        mods, names = module_aliases(tree)
+        rule = self
+        probed = self._has_probe(tree, mods, names)
+
+        class V(_ContextVisitor):
+            def __init__(self):
+                super().__init__()
+                self.out: List[Violation] = []
+
+            def visit_Call(self, node):
+                d = resolve_call(node.func, mods, names)
+                bounded = False
+                if d in rule._DEQUE:
+                    # deque(iterable, maxlen) positional, or maxlen= kw
+                    # with a non-None bound (maxlen=None is unbounded —
+                    # a different problem, not this rule's)
+                    if len(node.args) >= 2:
+                        bounded = True
+                    for kw in node.keywords:
+                        if kw.arg == "maxlen" and not (
+                                isinstance(kw.value, ast.Constant)
+                                and kw.value.value is None):
+                            bounded = True
+                if bounded and not probed:
+                    self.out.append(Violation(
+                        rule.name, relpath, node.lineno, self.context,
+                        "deque(maxlen)",
+                        "bounded buffer with no headroom probe — give "
+                        "the module a headroom_probe() (or call "
+                        "register_probe) so the saturation observatory "
+                        "can forecast it, or baseline with a reason"))
+                self.generic_visit(node)
+
+        v = V()
+        v.visit(tree)
+        return v.out
+
+
 def default_rules(repo_root) -> List[Rule]:
-    """The six project rules, wired against the real metrics catalog,
+    """The seven project rules, wired against the real metrics catalog,
     docs, and reason taxonomy (run.py's configuration)."""
     from pathlib import Path
     root = Path(repo_root)
@@ -668,4 +743,5 @@ def default_rules(repo_root) -> List[Rule]:
     return [ClockRule(), LockRule(), DeterminismRule(),
             FrozenEnvelopeRule(),
             MetricsRule(declared=declared, docs_text=docs_text),
-            ReasonRule(declared=codes)]
+            ReasonRule(declared=codes),
+            BoundedResourceRule()]

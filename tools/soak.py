@@ -89,6 +89,19 @@ the standby promoting within the lease window, carrying its first
 provisioning pass promptly, the fence token rotating, no duplicate
 provider IDs across the handoff, and the usual weather bars (burn,
 replay-identical timeline) holding ACROSS the cutover.
+
+Every soak ends with the SATURATION verdict (introspect/headroom.py;
+docs/reference/headroom.md): the final first-to-break table prints,
+and any queue-kind resource whose monotonic high water reached its
+capacity must be explained by the weather scenario or a deliberately
+tightened bound, or the run fails. ``--api-watch-queue-bound N`` arms
+the prediction drill on top: one deliberately idle pods watcher is
+parked so its queue fills at the churn event rate, and the run GATES
+on the forecaster ranking ``api_watch_queues`` first-to-break BEFORE
+its first overflow — the observatory must predict the break, not
+narrate it. ``--headroom-out`` records the ranked table, the
+per-sample saturation trajectory, and the forecast-vs-overflow
+timestamps in a ``HEADROOM_*.json.gz`` artifact.
 """
 
 from __future__ import annotations
@@ -312,6 +325,24 @@ def main(argv=None) -> int:
                          "tools/smoke_sharded.py sets up). Set, the soak "
                          "FAILS unless sharded solves actually carried "
                          "passes (mesh_solves > 0)")
+    ap.add_argument("--api-watch-queue-bound", type=int, default=0,
+                    help="tighten the per-watcher watch queue bound "
+                         "(API mode; 0 = the Options default, 8192). "
+                         "Set, the soak parks ONE deliberately idle "
+                         "pods watcher whose queue fills at the churn "
+                         "event rate, and the exit verdict GATES on the "
+                         "headroom forecaster ranking api_watch_queues "
+                         "first-to-break BEFORE its first overflow "
+                         "(docs/reference/headroom.md) — the "
+                         "observatory must predict the break, not "
+                         "narrate it")
+    ap.add_argument("--headroom-out", default="",
+                    help="headroom artifact path (HEADROOM_*.json.gz): "
+                         "the final ranked first-to-break table, the "
+                         "per-sample saturation trajectory, and the "
+                         "forecast-vs-overflow timestamps. The "
+                         "no-unexplained-saturation verdict itself "
+                         "gates EVERY soak, artifact or not")
     args = ap.parse_args(argv)
     fault_schedule = parse_fault_schedule(args.fault_schedule)
 
@@ -341,12 +372,16 @@ def main(argv=None) -> int:
         solver_address = ",".join(s.address for s in chaos_sidecars)
         print(f"soak: solver pool of {args.solver_pool} sidecars "
               f"({solver_address})")
+    opt_extra = {}
+    if args.api_watch_queue_bound:
+        opt_extra["api_watch_queue_bound"] = args.api_watch_queue_bound
     op = Operator(options=Options(registration_delay=0.2,
                                   batch_idle_duration=0.05,
                                   batch_max_duration=0.5,
                                   interruption_queue="soak-q",
                                   spot_to_spot_consolidation=True,
                                   mesh=args.mesh,
+                                  **opt_extra,
                                   solver_address=solver_address,
                                   solver_solve_deadline=(
                                       args.solver_solve_deadline
@@ -542,6 +577,16 @@ def main(argv=None) -> int:
             for i in range(n_drainers)]
         for t in watch_threads:
             t.start()
+    # the deliberately idle watcher (--api-watch-queue-bound): never
+    # drained, so its queue fills at the raw churn event rate. The
+    # drained fleet above never shows the forecaster a rising depth —
+    # THIS queue is the one the prediction-before-overflow gate reads
+    idle_watch = None
+    if args.api_watch_queue_bound and api_server is not None:
+        idle_watch = api_server.watch("pods")
+        print(f"soak: idle watcher parked against a watch queue bound "
+              f"of {args.api_watch_queue_bound} — the headroom "
+              "forecaster must name it before it overflows")
     rng = random.Random(args.seed)
     t_start = time.monotonic()
     stop = t_start + args.minutes * 60.0
@@ -679,6 +724,10 @@ def main(argv=None) -> int:
             print(f"soak: watcher fleet ({args.watchers}) delivered="
                   f"{watch_stats['delivered']} "
                   f"resubscribes={watch_stats['resubscribes']}")
+        if idle_watch is not None:
+            # stop_watch folds its depth into the server's monotonic
+            # high water; the registry's own high water already holds it
+            api_server.stop_watch(idle_watch)
 
     # the handoff verdict BEFORE any rebind: the gates read both sides
     handoff_ok = True
@@ -1231,6 +1280,116 @@ def main(argv=None) -> int:
         print(f"soak: weather artifact -> {wout} "
               f"({len(weather_doc['timeline'])} timeline events, "
               f"{len(weather_doc['burn_series'])} burn samples)")
+    # ---- the saturation verdict (docs/reference/headroom.md) ----------
+    # Gated on EVERY soak: the final first-to-break table prints, and
+    # any queue-kind resource whose monotonic high water reached its
+    # capacity must be EXPLAINED — by the weather scenario or by a
+    # deliberately tightened --api-watch-queue-bound — or the run
+    # fails. "The bound worked, silently" is exactly the failure mode
+    # the observatory exists to end.
+    hr_rows = op.headroom.table()
+    hr_sum = op.headroom.stats()
+    print(f"soak: headroom first-to-break table (top 5 of "
+          f"{len(hr_rows)}):")
+    for row in hr_rows[:5]:
+        tte = row["seconds_to_exhaustion"]
+        print(f"soak:   {row['resource']:<26} {row['kind']:<5} "
+              f"depth={row['depth']:g}/{row['capacity']:g} "
+              f"hw={row['highwater']:g} drops={row['drops']:g} "
+              f"occ={row['occupancy']:.2f} "
+              f"tte={'-' if tte is None else format(tte, '.1f') + 's'}")
+    print(f"soak: headroom saturated={hr_sum['saturated']:g} "
+          f"episodes={hr_sum['episodes']:g} "
+          f"probe_errors={hr_sum['probe_errors']:g} "
+          f"first_to_break={hr_sum['first_to_break'] or '(none)'}")
+    unexplained = [
+        row["resource"] for row in hr_rows
+        if row["kind"] == "queue" and row["capacity"] > 0
+        and row["highwater"] >= row["capacity"]
+        and not (weather_sim is not None
+                 or (row["resource"] == "api_watch_queues"
+                     and args.api_watch_queue_bound))]
+    if unexplained:
+        print("soak: UNEXPLAINED SATURATION — queue-kind resources hit "
+              "their bound with no weather scenario or deliberately "
+              f"tightened bound to blame: {unexplained}")
+        ok = False
+    # the prediction-before-overflow gate (armed by the tightened
+    # bound): in the monitor's per-sample headroom trajectory, the
+    # first sample ranking api_watch_queues first-to-break must
+    # PRECEDE the first sample showing a drop — and both must exist,
+    # or the drill was vacuous
+    hr_t0 = monitor.samples[0]["t"] if monitor.samples else 0.0
+    first_rank_t = first_drop_t = None
+    for s in monitor.samples:
+        h = s.get("subsystems", {}).get("headroom", {})
+        if not h:
+            continue
+        if first_rank_t is None and \
+                h.get("first_to_break") == "api_watch_queues":
+            first_rank_t = round(s["t"] - hr_t0, 1)
+        if first_drop_t is None and \
+                h.get("api_watch_queues_drops", 0.0) > 0:
+            first_drop_t = round(s["t"] - hr_t0, 1)
+    if args.api_watch_queue_bound:
+        if first_drop_t is None:
+            print("soak: --api-watch-queue-bound set but the idle "
+                  "watcher never overflowed (vacuous prediction drill "
+                  "— bound too loose for this churn rate)")
+            ok = False
+        elif first_rank_t is None or first_rank_t >= first_drop_t:
+            print("soak: the forecaster never ranked api_watch_queues "
+                  "first-to-break BEFORE its first overflow (ranked_at="
+                  f"{first_rank_t} first_drop={first_drop_t}) — the "
+                  "observatory narrated the break instead of "
+                  "predicting it")
+            ok = False
+        else:
+            print(f"soak: headroom forecast led the first overflow by "
+                  f"{first_drop_t - first_rank_t:.1f}s "
+                  f"(ranked at t={first_rank_t}s, first drop at "
+                  f"t={first_drop_t}s)")
+    if args.headroom_out:
+        import gzip as _gzip
+        import json as _json
+        hfields = ["t", "min_tte_seconds", "saturated", "episodes",
+                   "probe_errors", "first_to_break",
+                   "api_watch_queues_depth", "api_watch_queues_occ",
+                   "api_watch_queues_drops"]
+        head_series = [
+            [round(s["t"] - hr_t0, 1)] + [
+                h.get(k, 0.0) for k in hfields[1:]]
+            for s in monitor.samples
+            for h in [s.get("subsystems", {}).get("headroom", {})]
+            if h]
+        head_doc = {
+            "final_table": hr_rows,
+            "summary": hr_sum,
+            "series_fields": hfields,
+            "series": head_series,
+            "watch_queue_bound": args.api_watch_queue_bound or None,
+            "forecast_ranked_at_s": first_rank_t,
+            "first_overflow_at_s": first_drop_t,
+            "forecast_lead_s": (round(first_drop_t - first_rank_t, 1)
+                                if first_rank_t is not None
+                                and first_drop_t is not None else None),
+            "unexplained_saturation": unexplained,
+            "weather": (weather_sim.scenario.name
+                        if weather_sim is not None else None),
+            "soak": {"pods_churned": i, "minutes": args.minutes,
+                     "seed": args.seed, "api_mode": bool(args.api_mode),
+                     "watchers": args.watchers,
+                     "churn_scale": args.churn_scale},
+            "invariants_ok": ok,
+        }
+        if args.headroom_out.endswith(".gz"):
+            with _gzip.open(args.headroom_out, "wt") as f:
+                _json.dump(head_doc, f, separators=(",", ":"))
+        else:
+            with open(args.headroom_out, "w") as f:
+                _json.dump(head_doc, f, indent=1)
+        print(f"soak: headroom artifact -> {args.headroom_out} "
+              f"({len(head_series)} trajectory samples)")
     if args.consol_out:
         # the CONSOLIDATION verdict (docs/reference/consolidation.md
         # "Gates"): the vmapped engine must demonstrably have carried
